@@ -1,0 +1,18 @@
+from .message import Message, NullMessage, topic_matcher
+from .loopback import (
+    LoopbackBroker, LoopbackMessage, get_broker, reset_brokers,
+)
+from .mqtt import MQTTMessage, PAHO_AVAILABLE
+
+
+def create_message(transport: str, **kwargs) -> Message:
+    """Transport factory keyed by the service's ``transport`` field
+    (reference default "mqtt", ``main/context.py:50``; ours defaults to
+    "loopback" via AIKO_TRANSPORT)."""
+    if transport in ("loopback", "memory"):
+        return LoopbackMessage(**kwargs)
+    if transport == "mqtt":
+        return MQTTMessage(**kwargs)
+    if transport in ("null", "castaway", "none"):
+        return NullMessage(**kwargs)
+    raise ValueError(f"Unknown transport: {transport}")

@@ -1,0 +1,124 @@
+"""MQTT transport over paho-mqtt (optional).
+
+Reference parity: ``/root/reference/src/aiko_services/main/message/
+mqtt.py:65-289``.  This image does not ship ``paho-mqtt``; the class is
+import-gated and raises a clear error when constructed without it.  When
+paho is present: connects with LWT, TLS/username/password from the
+environment (:func:`aiko_services_tpu.utils.config.get_mqtt_configuration`),
+subscribes with wildcard support, and delivers via ``message_handler`` on
+the paho network thread (callers queue into their event engine).
+
+Unlike the reference there is no busy-wait ``wait_connected``/
+``wait_published`` (``mqtt.py:255-289``): publishes before the connection
+completes are buffered and flushed from ``on_connect``.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Iterable, Optional, Union
+
+from ..utils.config import get_mqtt_configuration
+from .message import Message
+
+try:  # pragma: no cover - exercised only when paho is installed
+    import paho.mqtt.client as paho_mqtt
+    PAHO_AVAILABLE = True
+except ImportError:
+    paho_mqtt = None
+    PAHO_AVAILABLE = False
+
+__all__ = ["MQTTMessage", "PAHO_AVAILABLE"]
+
+
+class MQTTMessage(Message):  # pragma: no cover - needs broker + paho
+    def __init__(self, message_handler: Optional[Callable] = None,
+                 topics: Optional[Iterable[str]] = None,
+                 lwt_topic: Optional[str] = None,
+                 lwt_payload: Union[str, bytes, None] = None,
+                 lwt_retain: bool = False):
+        if not PAHO_AVAILABLE:
+            raise ImportError(
+                "paho-mqtt is not installed; use the 'loopback' transport "
+                "(AIKO_TRANSPORT=loopback) or install paho-mqtt")
+        self.message_handler = message_handler
+        self._connected = threading.Event()
+        self._pending = []
+        self._subscriptions = {}
+        host, port, tls, username, password = get_mqtt_configuration()
+        self._client = paho_mqtt.Client()
+        if lwt_topic is not None:
+            self._client.will_set(lwt_topic, lwt_payload, retain=lwt_retain)
+        if username:
+            self._client.username_pw_set(username, password)
+        if tls:
+            self._client.tls_set()
+        self._client.on_connect = self._on_connect
+        self._client.on_message = self._on_message
+        self._client.connect_async(host, port)
+        self._client.loop_start()
+        if topics:
+            self.subscribe(topics)
+
+    def _on_connect(self, client, userdata, flags, rc):
+        self._connected.set()
+        for pattern in list(self._subscriptions):
+            client.subscribe(pattern)
+        pending, self._pending = self._pending, []
+        for topic, payload, retain in pending:
+            client.publish(topic, payload, retain=retain)
+
+    def _on_message(self, client, userdata, message):
+        if self.message_handler is None:
+            return
+        payload = message.payload
+        binary = self._subscriptions.get(message.topic, False)
+        if not binary:
+            try:
+                payload = payload.decode()
+            except UnicodeDecodeError:
+                pass
+        self.message_handler(message.topic, payload)
+
+    @property
+    def connected(self) -> bool:
+        return self._connected.is_set()
+
+    def publish(self, topic, payload, retain=False, wait=False):
+        if not self._connected.is_set():
+            self._pending.append((topic, payload, retain))
+            return
+        info = self._client.publish(topic, payload, retain=retain)
+        if wait:
+            info.wait_for_publish(timeout=2.0)
+
+    def subscribe(self, topic, binary=False):
+        patterns = [topic] if isinstance(topic, str) else list(topic)
+        for pattern in patterns:
+            self._subscriptions[pattern] = binary
+            if self._connected.is_set():
+                self._client.subscribe(pattern)
+
+    def unsubscribe(self, topic):
+        patterns = [topic] if isinstance(topic, str) else list(topic)
+        for pattern in patterns:
+            self._subscriptions.pop(pattern, None)
+            if self._connected.is_set():
+                self._client.unsubscribe(pattern)
+
+    def set_last_will_and_testament(self, topic=None, payload=None,
+                                    retain=False):
+        # paho requires a reconnect cycle for a LWT change.
+        self._client.loop_stop()
+        self._client.disconnect()
+        if topic is not None:
+            self._client.will_set(topic, payload, retain=retain)
+        self._connected.clear()
+        self._client.reconnect()
+        self._client.loop_start()
+
+    def disconnect(self, graceful=True):
+        if graceful:
+            self._client.disconnect()
+        self._client.loop_stop()
+        self._connected.clear()

@@ -1,0 +1,12 @@
+from .sexpr import (
+    generate, generate_expression, parse, parse_tree,
+    parse_int, parse_float, parse_number,
+)
+from .graph import Graph, Node
+from .lru_cache import LRUCache
+from .state_machine import StateMachine, StateMachineError
+from .logger import get_logger, get_log_level, TopicLogHandler
+from .config import (
+    get_namespace, get_hostname, get_pid,
+    get_mqtt_configuration, get_default_transport,
+)

@@ -1,0 +1,64 @@
+"""Environment-driven configuration.
+
+Reference parity: ``/root/reference/src/aiko_services/main/utilities/
+configuration.py:52-158``.  Same environment variables so deployments carry
+over unchanged:
+
+* ``AIKO_NAMESPACE`` (default ``"aiko"``)
+* ``AIKO_MQTT_HOST`` / ``AIKO_MQTT_PORT`` / ``AIKO_MQTT_TRANSPORT``
+* ``AIKO_MQTT_TLS``, ``AIKO_USERNAME`` / ``AIKO_PASSWORD`` (TLS auto-enables
+  when a username is set)
+* ``AIKO_LOG_LEVEL`` / ``AIKO_LOG_LEVEL_<SUBSYSTEM>`` and ``AIKO_LOG_MQTT``
+  are consumed by :mod:`aiko_services_tpu.utils.logger`.
+
+New for the TPU build: ``AIKO_TRANSPORT`` selects the default control-plane
+transport (``"loopback"`` in-process broker — the default here, since the
+image carries no MQTT client — or ``"mqtt"`` when paho is installed).
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+from typing import Optional, Tuple
+
+__all__ = [
+    "get_namespace", "get_hostname", "get_pid",
+    "get_mqtt_configuration", "get_default_transport",
+]
+
+DEFAULT_NAMESPACE = "aiko"
+DEFAULT_MQTT_HOST = "localhost"
+DEFAULT_MQTT_PORT = 1883
+
+
+def get_namespace() -> str:
+    return os.environ.get("AIKO_NAMESPACE", DEFAULT_NAMESPACE)
+
+
+def get_hostname() -> str:
+    hostname = os.environ.get("AIKO_HOSTNAME")
+    if hostname:
+        return hostname
+    return socket.gethostname().split(".")[0]
+
+
+def get_pid() -> str:
+    return str(os.getpid())
+
+
+def get_default_transport() -> str:
+    return os.environ.get("AIKO_TRANSPORT", "loopback")
+
+
+def get_mqtt_configuration() -> Tuple[str, int, bool,
+                                      Optional[str], Optional[str]]:
+    """Returns (host, port, tls_enabled, username, password)."""
+    host = os.environ.get("AIKO_MQTT_HOST", DEFAULT_MQTT_HOST)
+    port = int(os.environ.get("AIKO_MQTT_PORT", DEFAULT_MQTT_PORT))
+    username = os.environ.get("AIKO_USERNAME")
+    password = os.environ.get("AIKO_PASSWORD")
+    tls = os.environ.get("AIKO_MQTT_TLS", "").lower() in ("1", "true", "yes")
+    if username:
+        tls = True
+    return host, port, tls, username, password

@@ -1,0 +1,70 @@
+"""Logging: console and distributed (topic-published) handlers.
+
+Reference parity: ``/root/reference/src/aiko_services/main/utilities/
+logger.py:98-172``.  ``get_logger(name)`` honours ``AIKO_LOG_LEVEL`` and
+per-subsystem overrides ``AIKO_LOG_LEVEL_<NAME>``.  ``TopicLogHandler``
+publishes every record to a service's ``…/log`` topic through whatever
+``Message`` transport the process uses, ring-buffering up to 128 records
+until the transport connects — the seam the Recorder/Dashboard consume.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+from collections import deque
+from typing import Optional
+
+__all__ = ["get_logger", "get_log_level", "TopicLogHandler", "LOG_FORMAT"]
+
+LOG_FORMAT = "%(asctime)s.%(msecs)03d %(levelname)-5s [%(name)s] %(message)s"
+LOG_DATE_FORMAT = "%Y-%m-%d %H:%M:%S"
+_RING_SIZE = 128  # records buffered before the transport connects
+
+
+def get_log_level(name: str = "", default: str = "INFO") -> str:
+    subsystem = name.rsplit(".", 1)[-1].upper()
+    return os.environ.get(f"AIKO_LOG_LEVEL_{subsystem}",
+                          os.environ.get("AIKO_LOG_LEVEL", default))
+
+
+def get_logger(name: str, log_level: Optional[str] = None,
+               handler: Optional[logging.Handler] = None) -> logging.Logger:
+    logger = logging.getLogger(name)
+    level = (log_level or get_log_level(name)).upper()
+    logger.setLevel(level)
+    if handler is not None:
+        logger.addHandler(handler)
+    elif not logger.handlers and not logging.getLogger().handlers:
+        console = logging.StreamHandler(sys.stderr)
+        console.setFormatter(logging.Formatter(LOG_FORMAT, LOG_DATE_FORMAT))
+        logger.addHandler(console)
+    return logger
+
+
+class TopicLogHandler(logging.Handler):
+    """Publish log records to ``topic`` via a ``Message`` transport.
+
+    Records emitted before the transport is connected are ring-buffered
+    (most recent ``_RING_SIZE``) and flushed on first successful publish.
+    """
+
+    def __init__(self, message, topic: str):
+        super().__init__()
+        self.message = message
+        self.topic = topic
+        self._ring: deque = deque(maxlen=_RING_SIZE)
+        self.setFormatter(logging.Formatter(LOG_FORMAT, LOG_DATE_FORMAT))
+
+    def emit(self, record: logging.LogRecord):
+        try:
+            payload = self.format(record)
+            if self.message is not None and self.message.connected:
+                while self._ring:
+                    self.message.publish(self.topic, self._ring.popleft())
+                self.message.publish(self.topic, payload)
+            else:
+                self._ring.append(payload)
+        except Exception:  # logging must never raise into application code
+            self.handleError(record)

@@ -1,0 +1,55 @@
+"""Bounded LRU cache (reference parity:
+``/root/reference/src/aiko_services/main/utilities/lru_cache.py:22-47``).
+
+Used by the Recorder's per-topic log rings and the audio sliding-window
+elements.  Thin wrapper over an ordered dict with move-to-end on access.
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Any, List
+
+__all__ = ["LRUCache"]
+
+
+class LRUCache:
+    def __init__(self, size: int):
+        if size <= 0:
+            raise ValueError("LRUCache size must be positive")
+        self.size = size
+        self._items: "OrderedDict[Any, Any]" = OrderedDict()
+
+    def __contains__(self, key) -> bool:
+        return key in self._items
+
+    def __len__(self) -> int:
+        return len(self._items)
+
+    def get(self, key, default=None):
+        if key not in self._items:
+            return default
+        self._items.move_to_end(key)
+        return self._items[key]
+
+    def put(self, key, value):
+        if key in self._items:
+            self._items.move_to_end(key)
+        self._items[key] = value
+        while len(self._items) > self.size:
+            self._items.popitem(last=False)
+
+    def delete(self, key):
+        self._items.pop(key, None)
+
+    def keys(self) -> List:
+        return list(self._items.keys())
+
+    def values(self) -> List:
+        return list(self._items.values())
+
+    def items(self):
+        return list(self._items.items())
+
+    def clear(self):
+        self._items.clear()

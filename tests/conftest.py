@@ -1,0 +1,31 @@
+"""Test configuration.
+
+JAX tests run on a virtual 8-device CPU mesh (no TPU needed): the env vars
+must be set before jax initializes, hence the top-of-file placement.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        xla_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _fresh_brokers():
+    """Isolate loopback-broker state between tests."""
+    from aiko_services_tpu.transport import reset_brokers
+    reset_brokers()
+    yield
+    reset_brokers()
+
+
+@pytest.fixture()
+def engine():
+    """Deterministic event engine driven by a virtual clock."""
+    from aiko_services_tpu.runtime.event import EventEngine, VirtualClock
+    return EventEngine(clock=VirtualClock())

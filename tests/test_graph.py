@@ -1,0 +1,59 @@
+"""Graph DAG tests (reference behavior: utilities/graph.py:42-181)."""
+
+from aiko_services_tpu.utils import Graph, Node
+
+
+def names(nodes):
+    return [n.name for n in nodes]
+
+
+def test_traverse_linear():
+    g = Graph.traverse(["(a b c)"])
+    assert names(g.get_path()) == ["a", "b", "c"]
+
+
+def test_traverse_fan_out_fan_in():
+    # Diamond: d must run after both b and c.
+    g = Graph.traverse(["(a (b d) (c d))"])
+    assert names(g.get_path()) == ["a", "b", "c", "d"]
+
+
+def test_traverse_properties_callback():
+    seen = []
+    Graph.traverse(
+        ["(a (b d (key_0: value_0)) (c d (key_1: value_1)))"],
+        lambda node, props, pred: seen.append((node, props, pred)))
+    assert seen == [("d", {"key_0": "value_0"}, "b"),
+                    ("d", {"key_1": "value_1"}, "c")]
+
+
+def test_multiple_heads():
+    g = Graph.traverse(["(a b)", "(x y)"])
+    assert g.head_names == ["a", "x"]
+    assert names(g.get_path("x")) == ["x", "y"]
+    assert names(g.get_path()) == ["a", "b"]
+
+
+def test_iterate_after():
+    g = Graph.traverse(["(a b c d)"])
+    assert names(g.iterate_after("b")) == ["c", "d"]
+    assert names(g.iterate_after("d")) == []
+    assert names(g.iterate_after("zz")) == []
+
+
+def test_path_local_remote():
+    assert Graph.path_local("p1:p2") == "p1"
+    assert Graph.path_remote("p1:p2") == "p2"
+    assert Graph.path_local("p1") == "p1"
+    assert Graph.path_remote("p1") is None
+    assert Graph.path_local(None) is None
+
+
+def test_manual_construction():
+    g = Graph()
+    a, b = Node("a"), Node("b")
+    a.add("b")
+    g.add(a, head=True)
+    g.add(b)
+    assert names(g.get_path()) == ["a", "b"]
+    assert "a" in g and "z" not in g

@@ -1,0 +1,92 @@
+"""S-expression codec round-trip tests.
+
+Covers the reference's own inverse-law examples
+(``utilities/parser.py:229-251``) plus canonical/binary/dict edge cases.
+"""
+
+import pytest
+
+from aiko_services_tpu.utils import generate, parse, parse_tree
+from aiko_services_tpu.utils.sexpr import SExprError, parse_number
+
+
+ROUND_TRIPS = [
+    ("a", []),
+    ("a", ["b", None, "c"]),
+    ("a", ["b", []]),
+    ("a", ["b", ["c", "d"]]),
+    ("a", ["b", ["c", "d"], ["e", "f", ["g", "h"]]]),
+    ("a", {"b": "1", "c": "2"}),
+    ("a", {"b": "1", "c": ["d", "e"]}),
+    ("a", {"b": "1", "c": {"d": "1", "e": "2"}}),
+    ("a b c d", []),                      # canonical head symbol
+    ("add", ["topic", "protocol", "owner", ["a=b", "c=d"]]),
+    ("update", ["key", ""]),              # empty-string value
+    ("x", ["with space", "with(paren", "3:fake"]),
+]
+
+
+@pytest.mark.parametrize("command,parameters", ROUND_TRIPS)
+def test_round_trip(command, parameters):
+    payload = generate(command, parameters)
+    assert parse(payload) == (command, parameters)
+
+
+PARSE_CASES = [
+    ("(a 0: b)", ("a", [None, "b"])),
+    ("(a b ())", ("a", ["b", []])),
+    ("(a b (c d))", ("a", ["b", ["c", "d"]])),
+    ("(a b: 1 c: 2)", ("a", {"b": "1", "c": "2"})),
+    ("(a b: 1 c: (d e))", ("a", {"b": "1", "c": ["d", "e"]})),
+    ("(a b: 1 c: (d: 1 e: 2))", ("a", {"b": "1", "c": {"d": "1", "e": "2"}})),
+    ("(7:a b c d)", ("a b c d", [])),
+    ("(3:a b 3:c d)", ("a b", ["c d"])),
+    ("('aloha honua')", ("aloha honua", [])),
+    ('("aloha honua")', ("aloha honua", [])),
+    ("(a (b: ''))", ("a", [{"b": ""}])),
+]
+
+
+@pytest.mark.parametrize("payload,expected", PARSE_CASES)
+def test_parse(payload, expected):
+    assert parse(payload) == expected
+
+
+def test_parse_tree_nested():
+    assert parse_tree("(a (b (c)))") == ["a", ["b", ["c"]]]
+
+
+def test_dict_mixing_is_error():
+    with pytest.raises(SExprError):
+        parse("(a b: 1 c)")
+
+
+def test_unbalanced_is_error():
+    with pytest.raises(SExprError):
+        parse("(a (b)")
+
+
+def test_parse_number():
+    assert parse_number("3") == 3
+    assert parse_number("3.5") == 3.5
+    assert parse_number("x", 7) == 7
+
+
+def test_trailing_colon_value_roundtrip():
+    # A *value* ending in ":" must not be re-parsed as a dict keyword
+    # (emitted canonically; only bare "k:" tokens introduce dicts).
+    for value in ["0:", "a:", "weird::"]:
+        payload = generate("cmd", [value, "x"])
+        assert parse(payload) == ("cmd", [value, "x"])
+
+
+def test_dict_keyword_must_be_simple():
+    with pytest.raises(SExprError):
+        generate("cmd", {"bad key": "v"})
+
+
+def test_canonical_binary_roundtrip():
+    # Symbols with delimiters must survive the wire.
+    weird = ["a b", "(x)", "10:prefix", "", "tab\tchar", "new\nline"]
+    payload = generate("cmd", weird)
+    assert parse(payload) == ("cmd", weird)

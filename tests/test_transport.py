@@ -1,0 +1,84 @@
+"""Loopback broker tests: wildcards, retained messages, LWT."""
+
+from aiko_services_tpu.transport import (
+    LoopbackMessage, NullMessage, get_broker, topic_matcher,
+)
+
+
+def test_topic_matcher():
+    assert topic_matcher("a/b/c", "a/b/c")
+    assert topic_matcher("a/+/c", "a/b/c")
+    assert not topic_matcher("a/+/c", "a/b/d")
+    assert topic_matcher("a/#", "a/b/c/d")
+    assert topic_matcher("#", "anything/at/all")
+    assert not topic_matcher("a/b", "a/b/c")
+    assert not topic_matcher("a/b/c", "a/b")
+    assert topic_matcher("+/+/+/+/state", "ns/host/123/0/state")
+
+
+def test_publish_subscribe():
+    got = []
+    sub = LoopbackMessage(lambda t, p: got.append((t, p)))
+    pub = LoopbackMessage()
+    sub.subscribe("ns/+/in")
+    pub.publish("ns/svc/in", "(hello)")
+    pub.publish("ns/svc/out", "(ignored)")
+    assert got == [("ns/svc/in", "(hello)")]
+
+
+def test_retained_replay_on_subscribe():
+    pub = LoopbackMessage()
+    pub.publish("ns/service/registrar", "(primary found x 2 0)", retain=True)
+    got = []
+    sub = LoopbackMessage(lambda t, p: got.append(p))
+    sub.subscribe("ns/service/registrar")
+    assert got == ["(primary found x 2 0)"]
+    # Empty retained payload clears it.
+    pub.publish("ns/service/registrar", "", retain=True)
+    got2 = []
+    sub2 = LoopbackMessage(lambda t, p: got2.append(p))
+    sub2.subscribe("ns/service/registrar")
+    assert got2 == []
+
+
+def test_lwt_fires_on_ungraceful_disconnect():
+    got = []
+    watcher = LoopbackMessage(lambda t, p: got.append((t, p)))
+    watcher.subscribe("ns/+/+/+/state")
+    client = LoopbackMessage(lwt_topic="ns/h/1/0/state",
+                             lwt_payload="(absent)")
+    client.disconnect(graceful=False)
+    assert got == [("ns/h/1/0/state", "(absent)")]
+
+
+def test_lwt_not_fired_on_graceful_disconnect():
+    got = []
+    watcher = LoopbackMessage(lambda t, p: got.append(p))
+    watcher.subscribe("#")
+    client = LoopbackMessage(lwt_topic="t", lwt_payload="(absent)")
+    client.disconnect(graceful=True)
+    assert got == []
+
+
+def test_binary_topics():
+    got = []
+    sub = LoopbackMessage(lambda t, p: got.append(p))
+    sub.subscribe("data/raw", binary=True)
+    LoopbackMessage().publish("data/raw", b"\x00\x01\x02")
+    assert got == [b"\x00\x01\x02"]
+
+
+def test_broker_isolation():
+    got = []
+    a = LoopbackMessage(lambda t, p: got.append(p), broker="universe_a")
+    a.subscribe("#")
+    b = LoopbackMessage(broker="universe_b")
+    b.publish("t", "x")
+    assert got == []
+
+
+def test_null_message_is_silent():
+    null = NullMessage(lambda t, p: None)
+    null.publish("t", "x")
+    null.subscribe("t")
+    assert not null.connected
