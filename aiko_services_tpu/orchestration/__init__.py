@@ -1,0 +1,5 @@
+from .process_manager import ProcessManager
+from .lifecycle import (
+    LifeCycleManager, LifeCycleClient,
+    HANDSHAKE_LEASE_TIME, DELETION_LEASE_TIME,
+)

@@ -1,0 +1,99 @@
+"""ProcessManager: OS child-process supervisor.
+
+Reference parity: ``/root/reference/src/aiko_services/main/
+process_manager.py:48-110``.  ``create(id, command, arguments)`` resolves
+python-module commands to the interpreter, Popens the child, and a poll
+timer (0.2 s) detects exits and fires the exit handler;
+``delete(id, kill=…)`` terminates or kills.
+"""
+
+from __future__ import annotations
+
+import shutil
+import subprocess
+import sys
+from typing import Callable, Dict, List, Optional
+
+from ..utils.logger import get_logger
+from ..runtime.event import EventEngine, event as default_engine
+
+__all__ = ["ProcessManager"]
+
+_logger = get_logger(__name__)
+POLL_PERIOD = 0.2  # reference process_manager.py:41
+
+
+class ProcessManager:
+    def __init__(self, exit_handler: Optional[Callable] = None,
+                 engine: Optional[EventEngine] = None):
+        self.exit_handler = exit_handler
+        self.processes: Dict[str, subprocess.Popen] = {}
+        self.commands: Dict[str, List[str]] = {}
+        self._engine = engine or default_engine
+        self._polling = False
+
+    def __contains__(self, id) -> bool:
+        return str(id) in self.processes
+
+    def create(self, id, command: str,
+               arguments: Optional[List[str]] = None) -> subprocess.Popen:
+        """Start a child.  ``command`` may be an executable on PATH, a
+        path, or a python file / ``-m module`` spec."""
+        id = str(id)
+        if id in self.processes:
+            raise ValueError(f"ProcessManager already has id: {id}")
+        argv = self._resolve(command) + [str(a) for a in (arguments or [])]
+        process = subprocess.Popen(argv)
+        self.processes[id] = process
+        self.commands[id] = argv
+        if not self._polling:
+            self._engine.add_timer_handler(self._poll, POLL_PERIOD)
+            self._polling = True
+        return process
+
+    @staticmethod
+    def _resolve(command: str) -> List[str]:
+        if command.endswith(".py"):
+            return [sys.executable, command]
+        if command.startswith("-m "):
+            return [sys.executable, "-m", command[3:]]
+        if shutil.which(command):
+            return [command]
+        return [sys.executable, command]
+
+    def delete(self, id, kill: bool = False, wait: float = 0.0):
+        id = str(id)
+        process = self.processes.pop(id, None)
+        self.commands.pop(id, None)
+        if process is None:
+            return
+        if process.poll() is None:
+            if kill:
+                process.kill()
+            else:
+                process.terminate()
+            if wait:
+                try:
+                    process.wait(timeout=wait)
+                except subprocess.TimeoutExpired:
+                    process.kill()
+
+    def terminate_all(self, kill: bool = False):
+        for id in list(self.processes):
+            self.delete(id, kill=kill)
+        if self._polling:
+            self._engine.remove_timer_handler(self._poll)
+            self._polling = False
+
+    def _poll(self):
+        for id, process in list(self.processes.items()):
+            return_code = process.poll()
+            if return_code is not None:
+                self.processes.pop(id, None)
+                command = self.commands.pop(id, None)
+                _logger.info("Child %s exited: %s", id, return_code)
+                if self.exit_handler:
+                    self.exit_handler(id, command, return_code)
+        if not self.processes and self._polling:
+            self._engine.remove_timer_handler(self._poll)
+            self._polling = False

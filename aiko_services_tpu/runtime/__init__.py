@@ -1,0 +1,15 @@
+from .event import EventEngine, VirtualClock, event
+from .lease import Lease
+from .connection import Connection, ConnectionState
+from .context import (
+    ServiceContext, PipelineElementContext, PipelineContext,
+    service_args, actor_args, pipeline_element_args, pipeline_args,
+    compose_instance,
+)
+from .service import (
+    Service, ServiceFields, ServiceFilter, ServiceTags, ServiceTopicPath,
+    Services,
+)
+from .process import Process, default_process, set_default_process
+from .actor import Actor, ActorMessage, Mailbox
+from .proxy import get_actor_proxy, make_remote_proxy, get_public_methods

@@ -30,12 +30,10 @@ class Lease:
         self.automatic_extend = automatic_extend
         self.terminated = False
         self._engine = engine or _default_engine
+        self._engine.add_timer_handler(self._expired, lease_time, once=True)
         if automatic_extend:
             self._engine.add_timer_handler(
                 self._auto_extend, lease_time * _EXTEND_FRACTION)
-        else:
-            self._engine.add_timer_handler(
-                self._expired, lease_time, once=True)
 
     def _auto_extend(self):
         if not self.terminated:
@@ -59,10 +57,9 @@ class Lease:
             return
         if lease_time is not None:
             self.lease_time = lease_time
-        if not self.automatic_extend:
-            self._engine.remove_timer_handler(self._expired)
-            self._engine.add_timer_handler(
-                self._expired, self.lease_time, once=True)
+        self._engine.remove_timer_handler(self._expired)
+        self._engine.add_timer_handler(
+            self._expired, self.lease_time, once=True)
 
     def terminate(self):
         self.terminated = True

@@ -1,0 +1,68 @@
+"""Remote actor proxies: RPC by reflection.
+
+Reference parity: ``/root/reference/src/aiko_services/main/transport/
+transport_mqtt.py:109-141``.  A proxy enumerates the public methods of an
+interface class and synthesizes a stand-in whose calls serialize to
+``(method arg…)`` S-expressions published to the target's ``…/in`` topic.
+Fire-and-forget: responses, by convention, arrive as separate messages on
+a caller-chosen response topic (see the Storage actor's request/response
+idiom, reference ``main/storage.py:87-103``).
+"""
+
+from __future__ import annotations
+
+import inspect
+from typing import List, Type
+
+from ..utils.sexpr import generate
+
+__all__ = ["get_public_methods", "make_remote_proxy", "get_actor_proxy"]
+
+
+def get_public_methods(cls: Type) -> List[str]:
+    methods = []
+    for name, member in inspect.getmembers(cls):
+        if name.startswith("_"):
+            continue
+        if inspect.isfunction(member) or inspect.ismethod(member):
+            methods.append(name)
+    return methods
+
+
+class RemoteProxy:
+    """Synthesized stand-in for an Actor living in another process."""
+
+    def __init__(self, publish, topic_in: str, method_names: List[str]):
+        self._publish = publish
+        self._proxy_topic_in = topic_in
+        for name in method_names:
+            setattr(self, name, self._make_stub(name))
+
+    def _make_stub(self, method_name: str):
+        def stub(*args, **kwargs):
+            parameters = list(args)
+            if kwargs:
+                if parameters:
+                    raise TypeError(
+                        "Remote calls take either positional or keyword "
+                        "arguments, not both (wire format limitation)")
+                parameters = kwargs
+            self._publish(self._proxy_topic_in,
+                          generate(method_name, parameters))
+        stub.__name__ = method_name
+        return stub
+
+    def __repr__(self):
+        return f"RemoteProxy({self._proxy_topic_in})"
+
+
+def make_remote_proxy(publish, topic_in: str, cls: Type) -> RemoteProxy:
+    return RemoteProxy(publish, topic_in, get_public_methods(cls))
+
+
+def get_actor_proxy(topic_path: str, cls: Type, process) -> RemoteProxy:
+    """Proxy for the actor at ``topic_path`` using the process transport
+    (reference ``get_actor_mqtt``, transport_mqtt.py:138-141)."""
+    topic_in = topic_path if topic_path.endswith("/in") \
+        else f"{topic_path}/in"
+    return make_remote_proxy(process.message.publish, topic_in, cls)

@@ -59,14 +59,13 @@ class LoopbackBroker:
             self._clients.append(client)
 
     def detach(self, client: "LoopbackMessage", graceful: bool):
-        lwt = None
+        wills: List[Tuple[str, Union[str, bytes], bool]] = []
         with self._lock:
             if client in self._clients:
                 self._clients.remove(client)
                 if not graceful:
-                    lwt = client._lwt
-        if lwt:
-            topic, payload, retain = lwt
+                    wills = list(client._wills)
+        for topic, payload, retain in wills:
             self.publish(topic, payload, retain)
 
     # -- pub/sub ----------------------------------------------------------- #
@@ -111,13 +110,14 @@ class LoopbackMessage(Message):
                  lwt_retain: bool = False,
                  broker: Union[str, LoopbackBroker] = "default"):
         self.message_handler = message_handler
+        self.connection_handler = None  # optional: called with (connected)
         self._broker = (broker if isinstance(broker, LoopbackBroker)
                         else get_broker(broker))
         self._subscriptions: Dict[str, bool] = {}  # pattern -> binary
-        self._lwt: Optional[Tuple[str, Union[str, bytes], bool]] = None
+        self._wills: List[Tuple[str, Union[str, bytes], bool]] = []
         self._connected = False
         if lwt_topic is not None:
-            self._lwt = (lwt_topic, lwt_payload, lwt_retain)
+            self._wills.append((lwt_topic, lwt_payload, lwt_retain))
         self._broker.attach(self)
         self._connected = True
         if topics:
@@ -149,7 +149,15 @@ class LoopbackMessage(Message):
                                     retain=False):
         # Unlike paho (which requires a disconnect/reconnect cycle,
         # reference mqtt.py:192-201), the loopback broker updates in place.
-        self._lwt = None if topic is None else (topic, payload, retain)
+        # Replace-all semantics for MQTT parity.
+        self._wills = [] if topic is None else [(topic, payload, retain)]
+
+    def add_last_will_and_testament(self, topic, payload, retain=False):
+        self._wills = [w for w in self._wills if w[0] != topic]
+        self._wills.append((topic, payload, retain))
+
+    def remove_last_will_and_testament(self, topic):
+        self._wills = [w for w in self._wills if w[0] != topic]
 
     def disconnect(self, graceful=True):
         if not self._connected:

@@ -65,6 +65,18 @@ class Message(ABC):
             payload: Union[str, bytes, None] = None,
             retain: bool = False): ...
 
+    def add_last_will_and_testament(self, topic: str,
+                                    payload: Union[str, bytes],
+                                    retain: bool = False):
+        """Arm an *additional* will.  Loopback supports many wills per
+        client; single-will transports (MQTT) fall back to replacement —
+        callers needing both a liveness and an election will should prefer
+        a dedicated client there."""
+        self.set_last_will_and_testament(topic, payload, retain)
+
+    def remove_last_will_and_testament(self, topic: str):
+        self.set_last_will_and_testament(None)
+
     @abstractmethod
     def disconnect(self, graceful: bool = True): ...
 

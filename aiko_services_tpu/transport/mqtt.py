@@ -19,7 +19,7 @@ import threading
 from typing import Callable, Iterable, Optional, Union
 
 from ..utils.config import get_mqtt_configuration
-from .message import Message
+from .message import Message, topic_matcher
 
 try:  # pragma: no cover - exercised only when paho is installed
     import paho.mqtt.client as paho_mqtt
@@ -42,6 +42,7 @@ class MQTTMessage(Message):  # pragma: no cover - needs broker + paho
                 "paho-mqtt is not installed; use the 'loopback' transport "
                 "(AIKO_TRANSPORT=loopback) or install paho-mqtt")
         self.message_handler = message_handler
+        self.connection_handler = None  # optional: called with (connected)
         self._connected = threading.Event()
         self._pending = []
         self._subscriptions = {}
@@ -67,12 +68,17 @@ class MQTTMessage(Message):  # pragma: no cover - needs broker + paho
         pending, self._pending = self._pending, []
         for topic, payload, retain in pending:
             client.publish(topic, payload, retain=retain)
+        if self.connection_handler:
+            self.connection_handler(True)
 
     def _on_message(self, client, userdata, message):
         if self.message_handler is None:
             return
         payload = message.payload
-        binary = self._subscriptions.get(message.topic, False)
+        # Wildcard-aware: a message arriving via a binary "+/#" pattern
+        # subscription must stay bytes (mirrors loopback._deliver).
+        binary = any(flag and topic_matcher(pattern, message.topic)
+                     for pattern, flag in self._subscriptions.items())
         if not binary:
             try:
                 payload = payload.decode()

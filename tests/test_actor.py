@@ -1,0 +1,154 @@
+"""Process/Service/Actor tests over the loopback transport.
+
+Multi-"process" scenarios run several Process instances against one
+shared loopback broker within a single event engine — the in-process
+equivalent of the reference's many-OS-processes + mosquitto setup.
+"""
+
+import pytest
+
+from aiko_services_tpu.runtime import (
+    Actor, Process, ServiceFilter, ServiceFields, ServiceTags,
+    ServiceTopicPath, Services, actor_args, compose_instance,
+    get_actor_proxy,
+)
+from aiko_services_tpu.runtime.event import EventEngine, VirtualClock
+
+
+@pytest.fixture()
+def process(engine):
+    return Process(namespace="test", hostname="h", pid="1",
+                   engine=engine, broker="t")
+
+
+class Greeter(Actor):
+    def __init__(self, context, process=None):
+        super().__init__(context, process)
+        self.greetings = []
+        self.controls = []
+
+    def aloha(self, name):
+        self.greetings.append(name)
+
+    def ctl(self, value):
+        self.controls.append(value)
+
+
+def test_service_identity(process):
+    actor = compose_instance(Greeter, actor_args("greeter"), process=process)
+    assert actor.topic_path == "test/h/1/1"
+    assert actor.topic_in == "test/h/1/1/in"
+    assert actor.topic_state == "test/h/1/1/state"
+    second = compose_instance(Greeter, actor_args("g2"), process=process)
+    assert second.service_id == 2
+
+
+def test_actor_command_dispatch(process, engine):
+    actor = compose_instance(Greeter, actor_args("greeter"), process=process)
+    process.message.publish(actor.topic_in, "(aloha Pele)")
+    engine.drain()
+    assert actor.greetings == ["Pele"]
+
+
+def test_control_mailbox_priority(process, engine):
+    from aiko_services_tpu.runtime.actor import ActorMessage, Mailbox
+    actor = compose_instance(Greeter, actor_args("greeter"), process=process)
+    order = []
+    actor.aloha = lambda name: order.append(("in", name))
+    actor.ctl = lambda v: order.append(("control", v))
+    actor._post_message(Mailbox.IN, ActorMessage("aloha", ["a"]))
+    actor._post_message(Mailbox.CONTROL, ActorMessage("ctl", ["b"]))
+    engine.drain()
+    # Control message processed first despite being posted second.
+    assert order == [("control", "b"), ("in", "a")]
+
+
+def test_actor_share_is_ec_backed(process, engine):
+    """Every Actor auto-creates an ECProducer on its share dict; remote
+    (update …) on the control topic mutates it (reference actor.py:199-205)."""
+    actor = compose_instance(Greeter, actor_args("greeter"), process=process)
+    assert actor.ec_producer is not None
+    process.message.publish(actor.topic_control, "(update log_level DEBUG)")
+    engine.drain()
+    assert actor.share["log_level"] == "DEBUG"
+
+
+def test_unknown_and_private_commands_ignored(process, engine):
+    actor = compose_instance(Greeter, actor_args("greeter"), process=process)
+    process.message.publish(actor.topic_in, "(nonexistent x)")
+    process.message.publish(actor.topic_in, "(_post_message hack)")
+    process.message.publish(actor.topic_in, "not even an s-expression (")
+    engine.drain()  # nothing raises, nothing dispatched
+    assert actor.greetings == []
+
+
+def test_remote_proxy_rpc(engine):
+    """Two processes on one broker: caller proxies callee's interface."""
+    p1 = Process(namespace="test", hostname="h", pid="1",
+                 engine=engine, broker="t")
+    p2 = Process(namespace="test", hostname="h", pid="2",
+                 engine=engine, broker="t")
+    callee = compose_instance(Greeter, actor_args("callee"), process=p2)
+    proxy = get_actor_proxy(callee.topic_path, Greeter, p1)
+    proxy.aloha("Honua")
+    engine.drain()
+    assert callee.greetings == ["Honua"]
+
+
+def test_registrar_bootstrap_announce(engine):
+    """A process announces services when a registrar primary appears."""
+    p = Process(namespace="test", hostname="h", pid="1",
+                engine=engine, broker="t")
+    compose_instance(Greeter, actor_args("greeter", protocol="greet:0"),
+                     process=p)
+    seen = []
+    # Fake registrar: watch its /in topic.
+    from aiko_services_tpu.transport import LoopbackMessage
+    reg = LoopbackMessage(lambda t, pl: seen.append(pl), broker="t")
+    reg.subscribe("test/h/99/1/in")
+    reg.publish("test/service/registrar",
+                "(primary found test/h/99/1 2 0)", retain=True)
+    engine.drain()
+    assert any(s.startswith("(add test/h/1/1 greeter greet:0")
+               for s in seen), seen
+
+
+def test_services_collection_and_filters():
+    services = Services()
+    f1 = ServiceFields("ns/h/1/1", "alpha", "proto:0", "loopback", "me",
+                       ["a=1"])
+    f2 = ServiceFields("ns/h/1/2", "beta", "other:0", "loopback", "me",
+                       ["a=2"])
+    f3 = ServiceFields("ns/h/2/1", "alpha", "proto:0", "loopback", "you",
+                       ["a=1"])
+    for f in (f1, f2, f3):
+        services.add(f)
+    assert len(services) == 3
+    assert services.get("ns/h/1/2").name == "beta"
+    assert [f.name for f in services.filter(ServiceFilter(name="alpha"))] \
+        == ["alpha", "alpha"]
+    assert [f.topic_path for f in
+            services.filter(ServiceFilter(protocol="proto"))] \
+        == ["ns/h/1/1", "ns/h/2/1"]
+    assert [f.topic_path for f in
+            services.filter(ServiceFilter(tags=["a=1"], owner="me"))] \
+        == ["ns/h/1/1"]
+    removed = services.remove_process("ns/h/1")
+    assert {f.name for f in removed} == {"alpha", "beta"}
+    assert len(services) == 1
+
+
+def test_service_topic_path_parse():
+    tp = ServiceTopicPath.parse("ns/host/123/4")
+    assert tp.process_path == "ns/host/123"
+    assert tp.terse == "host/123/4"
+    assert str(tp) == "ns/host/123/4"
+    assert ServiceTopicPath.parse("too/short") is None
+
+
+def test_service_tags():
+    assert ServiceTags.parse(["a=1", "b=2"]) == {"a": "1", "b": "2"}
+    assert ServiceTags.generate({"a": "1"}) == ["a=1"]
+    assert ServiceTags.match(["a=1", "b=2"], ["a=1"])
+    assert not ServiceTags.match(["a=1"], ["b=2"])
+    assert ServiceTags.match(["a=1"], ["*"])

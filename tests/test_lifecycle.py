@@ -1,0 +1,96 @@
+"""LifeCycleManager/Client fleet tests with in-process spawners
+(the automated version of the reference's ``lifecycle.py manager N``
+manual harness)."""
+
+from aiko_services_tpu.runtime import Process, actor_args
+from aiko_services_tpu.orchestration import (
+    LifeCycleClient, LifeCycleManager,
+)
+
+
+def make_process(engine, pid, broker="lcm"):
+    return Process(namespace="test", hostname="h", pid=str(pid),
+                   engine=engine, broker=broker)
+
+
+def build_fleet(engine, broker="lcm"):
+    manager_process = make_process(engine, 1, broker)
+    workers = {}
+
+    def spawner(client_id, manager_topic_control):
+        p = make_process(engine, 100 + int(client_id), broker)
+        workers[client_id] = LifeCycleClient(
+            actor_args(f"worker_{client_id}"), process=p,
+            manager_topic_control=manager_topic_control,
+            client_id=client_id)
+
+    killed = []
+    manager = LifeCycleManager(
+        process=manager_process, spawner=spawner,
+        killer=killed.append,
+        handshake_lease_time=30.0, deletion_lease_time=30.0)
+    return manager, workers, killed
+
+
+def test_create_handshake(engine):
+    manager, workers, killed = build_fleet(engine)
+    for i in range(3):
+        manager.create_client(i)
+    engine.drain()
+    assert manager.client_count(ready_only=True) == 3
+    assert manager.clients["1"] == workers["1"].topic_path
+    assert killed == []
+
+
+def test_missed_handshake_force_deletes(engine):
+    manager_process = make_process(engine, 1, broker="lcm2")
+    killed = []
+    manager = LifeCycleManager(
+        process=manager_process,
+        spawner=lambda cid, topic: None,   # spawns nothing: no handshake
+        killer=killed.append)
+    manager.create_client("a")
+    engine.advance(31.0)
+    assert killed == ["a"]
+    assert manager.client_count() == 0
+
+
+def test_delete_client_clean_exit(engine):
+    manager, workers, killed = build_fleet(engine, broker="lcm3")
+    manager.create_client("0")
+    engine.drain()
+    assert manager.client_count(ready_only=True) == 1
+
+    exits = []
+    manager._client_exit_handler = exits.append
+    manager.delete_client("0")
+    engine.drain()   # (terminate) -> client announces remove_client
+    assert manager.client_count() == 0
+    assert exits == ["0"]
+    assert killed == []   # clean exit, no force kill
+    engine.advance(40.0)  # deletion lease cancelled, no late kill
+    assert killed == []
+
+
+def test_delete_unresponsive_client_force_kills(engine):
+    broker = "lcm4"
+    manager_process = make_process(engine, 1, broker)
+    killed = []
+
+    def spawner(client_id, manager_topic_control):
+        # A worker that handshakes but never honours (terminate):
+        p = make_process(engine, 200, broker)
+        client = LifeCycleClient(actor_args("zombie"), process=p,
+                                 manager_topic_control=manager_topic_control,
+                                 client_id=client_id)
+        client.terminate = lambda: None   # ignores terminate
+
+    manager = LifeCycleManager(process=manager_process, spawner=spawner,
+                               killer=killed.append)
+    manager.create_client("z")
+    engine.drain()
+    assert manager.client_count(ready_only=True) == 1
+    manager.delete_client("z")
+    engine.advance(31.0)
+    assert killed == ["z"]
+    assert manager.client_count() == 0

@@ -1,0 +1,232 @@
+"""Distributed-core integration tests: registrar election/failover, EC
+shares, services cache — multiple Process instances over one loopback
+broker, deterministic via the virtual clock.
+
+These are the automated equivalents of the reference's manual harnesses
+(``share.py ec_test`` / ``sc_test``, registrar mosquitto probing —
+reference SURVEY.md §4).
+"""
+
+import pytest
+
+from aiko_services_tpu.runtime import (
+    Actor, Process, ServiceFilter, actor_args, compose_instance,
+)
+from aiko_services_tpu.runtime.connection import ConnectionState
+from aiko_services_tpu.registry import (
+    ECConsumer, ECProducer, Registrar, ServicesCache,
+)
+
+
+def make_process(engine, pid, broker="net"):
+    return Process(namespace="test", hostname="h", pid=str(pid),
+                   engine=engine, broker=broker)
+
+
+# --------------------------------------------------------------------------- #
+# Registrar election
+
+def test_single_registrar_promotes_to_primary(engine):
+    p = make_process(engine, 1)
+    registrar = Registrar(process=p)
+    assert registrar.state == "primary_search"
+    engine.advance(4.0)
+    assert registrar.state == "primary"
+    # Process connection reached REGISTRAR and the retained message exists.
+    assert p.connection.state == ConnectionState.REGISTRAR
+    assert p.registrar["topic_path"] == registrar.topic_path
+
+
+def test_second_registrar_becomes_secondary(engine):
+    p1, p2 = make_process(engine, 1), make_process(engine, 2)
+    r1 = Registrar(process=p1)
+    engine.advance(4.0)
+    assert r1.state == "primary"
+    r2 = Registrar(process=p2)
+    engine.drain()   # retained (primary found …) replays immediately
+    assert r2.state == "secondary"
+    engine.advance(10.0)
+    assert r2.state == "secondary"  # stays secondary while primary alive
+
+
+def test_failover_secondary_promotes_on_primary_death(engine):
+    p1, p2 = make_process(engine, 1), make_process(engine, 2)
+    r1 = Registrar(process=p1)
+    engine.advance(4.0)
+    r2 = Registrar(process=p2)
+    engine.drain()
+    assert (r1.state, r2.state) == ("primary", "secondary")
+
+    p1.kill()        # ungraceful: LWT "(primary absent)" fires
+    engine.drain()
+    assert r2.state == "primary_search"
+    engine.advance(4.0)
+    assert r2.state == "primary"
+    # Other processes see the new primary.
+    assert p2.registrar["topic_path"] == r2.topic_path
+
+
+def test_service_announced_and_evicted_on_death(engine):
+    p1 = make_process(engine, 1)
+    registrar = Registrar(process=p1)
+    engine.advance(4.0)
+
+    p2 = make_process(engine, 2)
+    actor = compose_instance(Actor, actor_args("worker", protocol="w:0"),
+                             process=p2)
+    engine.drain()
+    assert registrar.services.get(actor.topic_path).name == "worker"
+
+    p2.kill()        # LWT (absent) on p2's state topic
+    engine.drain()
+    assert registrar.services.get(actor.topic_path) is None
+    assert registrar.history[0][0].name == "worker"
+
+
+def test_primary_death_fires_both_wills(engine):
+    """A primary registrar's process death must publish BOTH the election
+    will (primary absent, retained) and the process liveness will
+    ((absent) on its state topic) so its other services get evicted."""
+    p1, p2 = make_process(engine, 1), make_process(engine, 2)
+    r1 = Registrar(process=p1)
+    engine.advance(4.0)
+    r2 = Registrar(process=p2)
+    engine.drain()
+    # A sibling service lives in the primary's process.
+    sibling = compose_instance(Actor, actor_args("sibling", protocol="s:0"),
+                               process=p1)
+    engine.drain()
+    p1.kill()
+    engine.advance(8.0)
+    assert r2.state == "primary"
+    # New primary never saw the sibling's (absent)? It must NOT retain it.
+    assert r2.services.get(sibling.topic_path) is None
+
+
+def test_graceful_registrar_stop_hands_over(engine):
+    p1, p2 = make_process(engine, 1), make_process(engine, 2)
+    r1 = Registrar(process=p1)
+    engine.advance(4.0)
+    r2 = Registrar(process=p2)
+    engine.drain()
+    r1.stop()
+    engine.advance(8.0)
+    assert r2.state == "primary"
+    # The old process's liveness will is still armed after handover.
+    assert p1.message._wills and \
+        p1.message._wills[0][0] == p1.topic_state
+
+
+# --------------------------------------------------------------------------- #
+# EC shares
+
+def test_ec_share_snapshot_and_live_updates(engine):
+    broker = "ec"
+    p1, p2 = make_process(engine, 1, broker), make_process(engine, 2, broker)
+    producer_actor = compose_instance(Actor, actor_args("prod"), process=p1)
+    producer = producer_actor.ec_producer  # auto-created on the share dict
+    producer.add("count", 0)
+    engine.drain()
+
+    cache = {}
+    synced = []
+    ECConsumer(p2, cache, producer_actor.topic_control,
+               sync_handler=lambda c: synced.append(dict(c)))
+    engine.drain()
+    assert cache["lifecycle"] == "ready"
+    assert cache["count"] == "0"
+    assert synced and synced[0]["lifecycle"] == "ready"
+
+    producer.update("count", 5)
+    engine.drain()
+    assert cache["count"] == "5"
+
+    producer.add("nested.leaf", "x")
+    producer.remove("lifecycle")
+    engine.drain()
+    assert cache["nested"] == {"leaf": "x"}
+    assert "lifecycle" not in cache
+
+
+def test_ec_share_lease_expires_without_extension(engine):
+    broker = "ec2"
+    p1, p2 = make_process(engine, 1, broker), make_process(engine, 2, broker)
+    actor = compose_instance(Actor, actor_args("prod"), process=p1)
+    producer = actor.ec_producer
+    producer.add("k", "v")
+    cache = {}
+    consumer = ECConsumer(p2, cache, actor.topic_control, lease_time=10.0)
+    engine.drain()
+    assert cache["k"] == "v"
+
+    # Kill the consumer's auto-extension: its lease on the producer dies.
+    consumer.terminate()
+    engine.advance(11.0)
+    producer.update("k", "v2")
+    engine.drain()
+    assert cache["k"] == "v"   # no longer pushed
+
+    # While an active consumer keeps receiving (auto-extends at 0.8x).
+    cache2 = {}
+    ECConsumer(p2, cache2, actor.topic_control, lease_time=10.0)
+    engine.advance(35.0)       # several extension cycles
+    producer.update("k", "v3")
+    engine.drain()
+    assert cache2["k"] == "v3"
+
+
+def test_ec_remote_mutation_via_control_topic(engine):
+    """(update k v) published to the producer's control topic mutates the
+    share and echoes on the state topic."""
+    broker = "ec3"
+    p1, p2 = make_process(engine, 1, broker), make_process(engine, 2, broker)
+    actor = compose_instance(Actor, actor_args("prod"), process=p1)
+    producer = actor.ec_producer
+    producer.add("k", "v")
+    seen = []
+    p2.add_message_handler(lambda t, pl: seen.append(pl),
+                           actor.topic_state)
+    p2.message.publish(actor.topic_control, "(update k v9)")
+    engine.drain()
+    assert producer.share["k"] == "v9"
+    assert "(update k v9)" in seen
+
+
+# --------------------------------------------------------------------------- #
+# ServicesCache discovery
+
+def test_services_cache_discovers_current_and_future(engine):
+    broker = "sc"
+    p1 = make_process(engine, 1, broker)
+    Registrar(process=p1)
+    engine.advance(4.0)
+
+    p2 = make_process(engine, 2, broker)
+    existing = compose_instance(Actor, actor_args("svc_a", protocol="pa:0"),
+                                process=p2)
+    engine.drain()
+
+    p3 = make_process(engine, 3, broker)
+    cache = ServicesCache(p3)
+    engine.drain()
+    assert cache.state == "loaded"
+    assert cache.services.get(existing.topic_path) is not None
+
+    added, removed = [], []
+    cache.add_handler(ServiceFilter(protocol="pa"),
+                      lambda f: added.append(f.name),
+                      lambda f: removed.append(f.name))
+    assert added == ["svc_a"]              # replay of current matches
+
+    late = compose_instance(Actor, actor_args("svc_b", protocol="pa:0"),
+                            process=p2)
+    other = compose_instance(Actor, actor_args("svc_c", protocol="px:0"),
+                             process=p2)
+    engine.drain()
+    assert added == ["svc_a", "svc_b"]     # filter excludes px:0
+
+    p2.kill()
+    engine.drain()
+    assert sorted(removed) == ["svc_a", "svc_b"]
+    assert cache.services.get(late.topic_path) is None
+    assert cache.services.get(other.topic_path) is None
