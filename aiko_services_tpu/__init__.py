@@ -1,0 +1,21 @@
+"""aiko_services_tpu: TPU-native distributed service & pipeline framework.
+
+A ground-up re-design of the aiko_services capability set
+(distributed actors, registrar discovery, eventual-consistency shared
+state, streaming dataflow pipelines) with TPU (JAX/XLA/Pallas/pjit) as
+the first-class execution backend.
+"""
+
+__version__ = "0.1.0"
+
+from .utils import parse, generate, Graph
+from .runtime import (
+    Actor, Process, Service, ServiceFilter, ServiceFields,
+    actor_args, service_args, pipeline_args, pipeline_element_args,
+    compose_instance, default_process, get_actor_proxy,
+)
+from .registry import Registrar, ECProducer, ECConsumer, ServicesCache
+from .pipeline import (
+    Pipeline, PipelineElement, Stream, Frame, StreamEvent, StreamState,
+    parse_pipeline_definition, load_pipeline_definition,
+)

@@ -1,0 +1,4 @@
+from .common_io import DataSource, DataTarget, parse_data_url
+from .text_io import (
+    TextOutput, TextReadFile, TextSample, TextTransform, TextWriteFile,
+)

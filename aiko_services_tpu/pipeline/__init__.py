@@ -1,0 +1,10 @@
+from .stream import (
+    Stream, Frame, StreamEvent, StreamState, DEFAULT_STREAM_ID,
+)
+from .definition import (
+    PipelineDefinition, PipelineElementDefinition,
+    parse_pipeline_definition, load_pipeline_definition,
+)
+from .codec import encode_swag, decode_swag, encode_value, decode_value
+from .element import PipelineElement
+from .pipeline import Pipeline, PipelineRemote, DEFAULT_GRACE_TIME

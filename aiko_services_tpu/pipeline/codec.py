@@ -1,0 +1,72 @@
+"""Swag wire codec for remote pipeline-element crossings.
+
+The reference marshals tensors ad hoc: base64 numpy inside S-expressions
+(``examples/pipeline/elements.py:298-324``) or zlib'd ``np.save`` bytes on
+raw binary side-channel topics (``elements/media/audio_io.py:585-593``).
+Here one typed codec covers the control-plane path: every swag value is
+encoded as ``"<tag>:<text>"`` where the tag selects str/int/float/bool/
+json/numpy(+zlib+base64).  JAX arrays are converted to numpy at the
+process boundary — on-pod element hand-offs never hit this codec (device
+buffers stay resident; see the TPU execution layer).
+"""
+
+from __future__ import annotations
+
+import base64
+import io
+import json
+import zlib
+from typing import Any, Dict
+
+import numpy as np
+
+__all__ = ["encode_value", "decode_value", "encode_swag", "decode_swag"]
+
+
+def encode_value(value: Any) -> str:
+    if value is None:
+        return "z:"
+    if isinstance(value, str):
+        return f"s:{value}"
+    if isinstance(value, bool):
+        return f"b:{int(value)}"
+    if isinstance(value, int):
+        return f"i:{value}"
+    if isinstance(value, float):
+        return f"f:{value!r}"
+    if hasattr(value, "__array__") or isinstance(value, np.ndarray):
+        array = np.asarray(value)
+        buffer = io.BytesIO()
+        np.save(buffer, array, allow_pickle=False)
+        packed = base64.b64encode(zlib.compress(buffer.getvalue()))
+        return f"n:{packed.decode('ascii')}"
+    # Lists / dicts of JSON-compatible values.
+    return f"j:{json.dumps(value)}"
+
+
+def decode_value(text: str) -> Any:
+    tag, _, body = text.partition(":")
+    if tag == "z":
+        return None
+    if tag == "s":
+        return body
+    if tag == "b":
+        return bool(int(body))
+    if tag == "i":
+        return int(body)
+    if tag == "f":
+        return float(body)
+    if tag == "n":
+        raw = zlib.decompress(base64.b64decode(body.encode("ascii")))
+        return np.load(io.BytesIO(raw), allow_pickle=False)
+    if tag == "j":
+        return json.loads(body)
+    raise ValueError(f"Unknown codec tag: {tag!r}")
+
+
+def encode_swag(swag: Dict[str, Any]) -> Dict[str, str]:
+    return {key: encode_value(value) for key, value in swag.items()}
+
+
+def decode_swag(encoded: Dict[str, str]) -> Dict[str, Any]:
+    return {key: decode_value(value) for key, value in encoded.items()}

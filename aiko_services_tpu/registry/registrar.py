@@ -55,6 +55,7 @@ _TRANSITIONS = [
     {"source": "primary_search", "trigger": "promote", "dest": "primary"},
     {"source": "secondary", "trigger": "promote", "dest": "primary"},
     {"source": "secondary", "trigger": "absent", "dest": "primary_search"},
+    {"source": "primary", "trigger": "demote", "dest": "secondary"},
 ]
 
 
@@ -134,9 +135,28 @@ class Registrar(Actor):
         action = parameters[0]
         if action == "found":
             found_topic = parameters[1] if len(parameters) > 1 else None
-            if found_topic != self.topic_path and \
-                    self._machine.state in ("primary_search",):
+            if found_topic == self.topic_path or found_topic is None:
+                return
+            if self._machine.state == "primary_search":
                 self._machine.transition("found")
+            elif self._machine.state == "primary":
+                # Dual-primary reconciliation (partition heal / races the
+                # jitter didn't prevent): deterministic total order — the
+                # lexicographically-smaller topic path keeps the crown.
+                if self.topic_path < found_topic:
+                    # I win: reassert my retained claim.
+                    self.process.message.publish(
+                        self._topic_boot,
+                        generate("primary",
+                                 ["found", self.topic_path, "2",
+                                  str(time.time())]),
+                        retain=True)
+                else:
+                    # I lose: disarm my election will and stand down.
+                    self.process.message.remove_last_will_and_testament(
+                        self._topic_boot)
+                    self.share["lifecycle"] = "secondary"
+                    self._machine.transition("demote")
         elif action == "absent":
             if self._machine.state == "secondary":
                 self._machine.transition("absent")
@@ -215,11 +235,13 @@ class Registrar(Actor):
 
     def stop(self):
         if self._is_primary():
-            # Graceful handover: disarm the election will (the process
-            # liveness will stays armed) and tell everyone the primary
-            # is gone.
+            # Graceful handover: disarm the election will and re-arm the
+            # process liveness will (on single-will transports add_ had
+            # replaced it), then tell everyone the primary is gone.
             self.process.message.remove_last_will_and_testament(
                 self._topic_boot)
+            self.process.message.set_last_will_and_testament(
+                self.process.topic_state, "(absent)")
             self.process.message.publish(self._topic_boot, "", retain=True)
             self.process.message.publish(self._topic_boot,
                                          "(primary absent)")

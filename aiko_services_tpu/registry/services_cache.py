@@ -154,12 +154,12 @@ class ServicesCache:
                 self._event_handler, f"{self._registrar_topic}/out")
 
 
-_singletons: Dict[int, ServicesCache] = {}
-
-
 def services_cache_create_singleton(process) -> ServicesCache:
-    """One cache per process (reference share.py:641-649)."""
-    key = id(process)
-    if key not in _singletons:
-        _singletons[key] = ServicesCache(process)
-    return _singletons[key]
+    """One cache per process (reference share.py:641-649).  Stored on the
+    process object itself so its lifetime tracks the process (no global
+    id()-keyed map to leak or collide)."""
+    cache = getattr(process, "_services_cache_singleton", None)
+    if cache is None:
+        cache = ServicesCache(process)
+        process._services_cache_singleton = cache
+    return cache

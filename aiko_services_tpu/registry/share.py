@@ -217,6 +217,7 @@ class ECConsumer:
         self.synced = False
         self._item_count: Optional[int] = None
         self._items_seen = 0
+        self._snapshot_paths: Optional[set] = None
         consumer_id = next(self._ids)
         self.response_topic = (
             f"{process.topic_path_process}/0/ec/{consumer_id}")
@@ -242,12 +243,23 @@ class ECConsumer:
         if command == "item_count" and parameters:
             self._item_count = int(parameters[0])
             self._items_seen = 0
+            self._snapshot_paths = set()
         elif command in ("add", "update") and len(parameters) >= 2:
             dict_path_set(self.cache, parameters[0], parameters[1])
             self._items_seen += 1
+            if self._snapshot_paths is not None:
+                self._snapshot_paths.add(parameters[0])
         elif command == "remove" and parameters:
             dict_path_delete(self.cache, parameters[0])
         elif command == "sync":
+            # Prune keys absent from the snapshot: removes that happened
+            # while we were disconnected must not survive the re-sync.
+            if self._snapshot_paths is not None:
+                for path, _ in dict_to_flat_commands(self.cache):
+                    if path not in self._snapshot_paths and \
+                            ECProducer._filter_matches(self.filter, path):
+                        dict_path_delete(self.cache, path)
+                self._snapshot_paths = None
             self.synced = True
             if self.sync_handler:
                 self.sync_handler(self.cache)

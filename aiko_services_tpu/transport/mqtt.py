@@ -119,6 +119,8 @@ class MQTTMessage(Message):  # pragma: no cover - needs broker + paho
         self._client.disconnect()
         if topic is not None:
             self._client.will_set(topic, payload, retain=retain)
+        else:
+            self._client.will_clear()
         self._connected.clear()
         self._client.reconnect()
         self._client.loop_start()

@@ -1,0 +1,84 @@
+"""Pipeline elements used by the engine tests (the analog of the
+reference's ``examples/pipeline/elements.py`` arithmetic demos)."""
+
+from aiko_services_tpu.pipeline import PipelineElement, StreamEvent
+
+
+class PE_Emit(PipelineElement):
+    """Source: emits the frame_data it was given (identity on swag)."""
+
+    def process_frame(self, stream, **inputs):
+        return StreamEvent.OKAY, inputs
+
+
+class PE_Add(PipelineElement):
+    def process_frame(self, stream, i):
+        amount, _ = self.get_parameter("amount", 1, stream=stream)
+        return StreamEvent.OKAY, {"i": int(i) + int(amount)}
+
+
+class PE_Double(PipelineElement):
+    def process_frame(self, stream, i):
+        return StreamEvent.OKAY, {"i": int(i) * 2}
+
+
+class PE_Sum(PipelineElement):
+    """Fan-in: sums two renamed inputs."""
+
+    def process_frame(self, stream, a, b):
+        return StreamEvent.OKAY, {"total": int(a) + int(b)}
+
+
+class PE_DropOdd(PipelineElement):
+    def process_frame(self, stream, i):
+        if int(i) % 2:
+            return StreamEvent.DROP_FRAME, {}
+        return StreamEvent.OKAY, {"i": i}
+
+
+class PE_StopAt(PipelineElement):
+    def process_frame(self, stream, i):
+        limit, _ = self.get_parameter("limit", 3, stream=stream)
+        if int(i) >= int(limit):
+            return StreamEvent.STOP, {}
+        return StreamEvent.OKAY, {"i": i}
+
+
+class PE_Boom(PipelineElement):
+    def process_frame(self, stream, **inputs):
+        raise RuntimeError("boom")
+
+
+class PE_Collect(PipelineElement):
+    """Sink: records everything it sees on the class, keyed by element
+    name (test observation point)."""
+
+    seen = {}
+
+    def start_stream(self, stream, stream_id):
+        self.seen.setdefault(self.name, [])
+        return StreamEvent.OKAY, None
+
+    def process_frame(self, stream, **inputs):
+        self.seen.setdefault(self.name, []).append(dict(inputs))
+        return StreamEvent.OKAY, inputs
+
+
+class PE_CountSource(PipelineElement):
+    """DataSource-style element: start_stream launches a paced generator
+    producing integers 0..limit-1."""
+
+    def start_stream(self, stream, stream_id):
+        limit, _ = self.get_parameter("limit", 5, stream=stream)
+        rate, _ = self.get_parameter("rate", 0, stream=stream)
+
+        def generate(stream_, frame_id):
+            if frame_id >= int(limit):
+                return StreamEvent.STOP, None
+            return StreamEvent.OKAY, {"i": frame_id}
+
+        self.create_frames(stream, generate, rate=float(rate) or None)
+        return StreamEvent.OKAY, None
+
+    def process_frame(self, stream, i):
+        return StreamEvent.OKAY, {"i": i}

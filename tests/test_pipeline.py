@@ -1,0 +1,284 @@
+"""Pipeline engine tests: definitions, hot loop, fan-in mapping, stream
+events, parameters, generator sources, remote elements."""
+
+import queue
+
+import pytest
+
+from aiko_services_tpu.pipeline import (
+    Pipeline, PipelineElement, StreamEvent,
+    parse_pipeline_definition,
+)
+from aiko_services_tpu.pipeline.pipeline import REMOTE_RETRY_DELAY
+from aiko_services_tpu.runtime import Process, pipeline_args, compose_instance
+from aiko_services_tpu.registry import Registrar
+
+from .pipeline_elements import PE_Collect
+
+MODULE = "tests.pipeline_elements"
+
+
+def element(name, cls, inputs, outputs, parameters=None):
+    return {
+        "name": name,
+        "input": [{"name": n, "type": t} for n, t in inputs],
+        "output": [{"name": n, "type": t} for n, t in outputs],
+        "parameters": parameters or {},
+        "deploy": {"local": {"module": MODULE, "class_name": cls}},
+    }
+
+
+def make_pipeline(engine, document, pid="1", broker="pipe", name=None):
+    process = Process(namespace="test", hostname="h", pid=pid,
+                      engine=engine, broker=broker)
+    definition = parse_pipeline_definition(document)
+    return compose_instance(
+        Pipeline, pipeline_args(name or definition.name,
+                                definition=definition),
+        process=process), process
+
+
+def run_frames(engine, pipeline, frames, stream_id="s1", parameters=None):
+    out = queue.Queue()
+    pipeline.create_stream(stream_id, parameters=parameters,
+                           queue_response=out)
+    for frame in frames:
+        pipeline.post_frame(stream_id, frame)
+    engine.drain()
+    results = []
+    while not out.empty():
+        results.append(out.get()[2])
+    return results
+
+
+LINEAR = {
+    "version": 0, "name": "p_linear", "runtime": "python",
+    "graph": ["(PE_Add PE_Double)"],
+    "elements": [
+        element("PE_Add", "PE_Add", [("i", "int")], [("i", "int")],
+                {"amount": 3}),
+        element("PE_Double", "PE_Double", [("i", "int")], [("i", "int")]),
+    ],
+}
+
+
+def test_linear_pipeline(engine):
+    pipeline, _ = make_pipeline(engine, LINEAR)
+    results = run_frames(engine, pipeline, [{"i": 1}, {"i": 10}])
+    assert results == [{"i": 8}, {"i": 26}]    # (i+3)*2
+
+
+def test_definition_validation_rejects_bad():
+    with pytest.raises(Exception):
+        parse_pipeline_definition({"version": 1, "name": "x",
+                                   "runtime": "python", "graph": [],
+                                   "elements": []})
+    with pytest.raises(Exception):
+        parse_pipeline_definition({
+            "version": 0, "name": "x", "runtime": "python",
+            "graph": ["(A)"],
+            "elements": [{"name": "A", "input": [], "output": [],
+                          "deploy": {}}]})
+
+
+def test_comment_keys_stripped():
+    doc = dict(LINEAR, **{"#note": "ignore me"})
+    definition = parse_pipeline_definition(doc)
+    assert definition.name == "p_linear"
+
+
+FAN = {
+    "version": 0, "name": "p_fan", "runtime": "python",
+    "graph": ["(PE_Emit (PE_Add PE_Sum (a: i)) (PE_Double PE_Sum (b: i)))"],
+    "elements": [
+        element("PE_Emit", "PE_Emit", [("i", "int")], [("i", "int")]),
+        element("PE_Add", "PE_Add", [("i", "int")], [("i", "int")]),
+        element("PE_Double", "PE_Double", [("i", "int")], [("i", "int")]),
+        element("PE_Sum", "PE_Sum", [("a", "int"), ("b", "int")],
+                [("total", "int")]),
+    ],
+}
+
+
+def test_fan_out_fan_in_with_input_mapping(engine):
+    """Diamond with edge-property renames: PE_Sum(a=from Add, b=from
+    Double).  NOTE: both branches output 'i'; the rename maps whichever is
+    in swag — the final swag 'i' is the last writer's, and a/b pull from
+    'i' as mapped."""
+    pipeline, _ = make_pipeline(engine, FAN, broker="fan")
+    results = run_frames(engine, pipeline, [{"i": 5}])
+    # Path order: Emit, Add, Double, Sum. Add: i=6; Double doubles the
+    # *current* swag i (6) -> 12. Sum: a=i(12)? -- mapping pulls from swag
+    # key "i" for both: total = 12 + 12 = 24.
+    assert results == [{"total": 24}]
+
+
+def test_stream_stop_event_destroys_stream(engine):
+    doc = {
+        "version": 0, "name": "p_stop", "runtime": "python",
+        "graph": ["(PE_StopAt PE_Collect)"],
+        "elements": [
+            element("PE_StopAt", "PE_StopAt", [("i", "int")],
+                    [("i", "int")], {"limit": 2}),
+            element("PE_Collect", "PE_Collect", [], []),
+        ],
+    }
+    pipeline, _ = make_pipeline(engine, doc, broker="stop")
+    PE_Collect.seen.clear()
+    pipeline.create_stream("s")
+    for i in range(5):
+        pipeline.post_frame("s", {"i": i})
+    engine.drain()
+    assert "s" not in pipeline.streams          # stopped at i=2
+    assert len(PE_Collect.seen.get("PE_Collect", [])) == 2
+
+
+def test_drop_frame_keeps_stream(engine):
+    doc = {
+        "version": 0, "name": "p_drop", "runtime": "python",
+        "graph": ["(PE_DropOdd PE_Collect)"],
+        "elements": [
+            element("PE_DropOdd", "PE_DropOdd", [("i", "int")],
+                    [("i", "int")]),
+            element("PE_Collect", "PE_Collect", [], []),
+        ],
+    }
+    pipeline, _ = make_pipeline(engine, doc, broker="drop")
+    PE_Collect.seen.clear()
+    results = run_frames(engine, pipeline, [{"i": i} for i in range(6)])
+    assert [r["i"] for r in results] == [0, 2, 4]
+    assert "s1" in pipeline.streams             # stream still alive
+
+
+def test_element_exception_becomes_stream_error(engine):
+    doc = {
+        "version": 0, "name": "p_boom", "runtime": "python",
+        "graph": ["(PE_Boom)"],
+        "elements": [element("PE_Boom", "PE_Boom", [], [])],
+    }
+    pipeline, _ = make_pipeline(engine, doc, broker="boom")
+    pipeline.create_stream("s")
+    pipeline.post_frame("s", {})
+    engine.drain()
+    assert "s" not in pipeline.streams          # ERROR destroyed it
+
+
+def test_parameter_precedence(engine):
+    pipeline, _ = make_pipeline(engine, LINEAR, broker="params")
+    # stream[element] beats element definition:
+    results = run_frames(engine, pipeline, [{"i": 1}],
+                         parameters={"PE_Add.amount": 10})
+    assert results == [{"i": 22}]               # (1+10)*2
+    # plain stream parameter beats pipeline, loses to element definition:
+    results = run_frames(engine, pipeline, [{"i": 1}], stream_id="s2",
+                         parameters={"amount": 100})
+    assert results == [{"i": 8}]                # element def amount=3 wins
+
+
+def test_generator_source_with_stream_stop(engine):
+    doc = {
+        "version": 0, "name": "p_gen", "runtime": "python",
+        "graph": ["(PE_CountSource PE_Collect)"],
+        "elements": [
+            element("PE_CountSource", "PE_CountSource",
+                    [("i", "int")], [("i", "int")], {"limit": 4}),
+            element("PE_Collect", "PE_Collect", [("i", "int")],
+                    [("i", "int")]),
+        ],
+    }
+    pipeline, _ = make_pipeline(engine, doc, broker="gen")
+    PE_Collect.seen.clear()
+    pipeline.create_stream("g")
+    # Generator thread posts frames; pump until the stream self-stops.
+    import time
+    deadline = time.time() + 5.0
+    while time.time() < deadline and "g" in pipeline.streams:
+        engine.drain()
+        time.sleep(0.01)
+    assert [f["i"] for f in PE_Collect.seen["PE_Collect"]] == [0, 1, 2, 3]
+    assert "g" not in pipeline.streams
+
+
+def test_stream_lease_expiry_destroys_idle_stream(engine):
+    pipeline, _ = make_pipeline(engine, LINEAR, broker="lease")
+    pipeline.create_stream("idle", grace_time=5.0)
+    assert "idle" in pipeline.streams
+    engine.advance(6.0)
+    assert "idle" not in pipeline.streams
+
+
+# --------------------------------------------------------------------------- #
+# Remote pipeline elements
+
+REMOTE_CALLER = {
+    "version": 0, "name": "p_caller", "runtime": "python",
+    "graph": ["(PE_Add PE_RemoteStage PE_Collect)"],
+    "elements": [
+        element("PE_Add", "PE_Add", [("i", "int")], [("i", "int")]),
+        {
+            "name": "PE_RemoteStage",
+            "input": [{"name": "i", "type": "int"}],
+            "output": [{"name": "i", "type": "int"}],
+            "deploy": {"remote": {"service_filter":
+                                  {"name": "p_remote"}}},
+        },
+        element("PE_Collect", "PE_Collect", [("i", "int")],
+                [("i", "int")]),
+    ],
+}
+
+REMOTE_CALLEE = {
+    "version": 0, "name": "p_remote", "runtime": "python",
+    "graph": ["(PE_Double)"],
+    "elements": [
+        element("PE_Double", "PE_Double", [("i", "int")], [("i", "int")]),
+    ],
+}
+
+
+def test_remote_element_crossing(engine):
+    """Frame pauses at the remote node, crosses to the callee pipeline,
+    resumes with the response: (i+1)*2 observed by the caller's sink."""
+    broker = "remote"
+    # Registrar so the caller's ServicesCache can discover the callee.
+    reg_process = Process(namespace="test", hostname="h", pid="9",
+                          engine=engine, broker=broker)
+    registrar = Registrar(process=reg_process)
+    engine.advance(4.0)
+    assert registrar.state == "primary"
+
+    callee, _ = make_pipeline(engine, REMOTE_CALLEE, pid="2", broker=broker)
+    caller, _ = make_pipeline(engine, REMOTE_CALLER, pid="3", broker=broker)
+    engine.drain()
+    assert caller.remote_proxies["PE_RemoteStage"] is not None
+
+    PE_Collect.seen.clear()
+    caller.create_stream("r")
+    caller.post_frame("r", {"i": 1})
+    caller.post_frame("r", {"i": 10})
+    engine.drain()
+    assert [f["i"] for f in PE_Collect.seen["PE_Collect"]] == [4, 22]
+
+
+def test_remote_element_retries_until_discovered(engine):
+    broker = "late"
+    reg_process = Process(namespace="test", hostname="h", pid="9",
+                          engine=engine, broker=broker)
+    Registrar(process=reg_process)
+    engine.advance(4.0)
+
+    caller, _ = make_pipeline(engine, REMOTE_CALLER, pid="3", broker=broker)
+    engine.drain()
+    assert caller.remote_proxies["PE_RemoteStage"] is None
+
+    PE_Collect.seen.clear()
+    caller.create_stream("r")
+    caller.post_frame("r", {"i": 1})
+    engine.drain()
+    assert not PE_Collect.seen.get("PE_Collect")   # parked, retrying
+
+    # Callee shows up late; the retry finds it.
+    make_pipeline(engine, REMOTE_CALLEE, pid="2", broker=broker)
+    engine.advance(REMOTE_RETRY_DELAY + 1.0)
+    engine.drain()
+    assert [f["i"] for f in PE_Collect.seen["PE_Collect"]] == [4]

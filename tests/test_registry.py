@@ -103,6 +103,43 @@ def test_primary_death_fires_both_wills(engine):
     assert r2.services.get(sibling.topic_path) is None
 
 
+def test_dual_primary_reconciles_deterministically(engine):
+    """Partition-heal scenario: force both registrars primary; on seeing
+    each other's claims the lexicographically-smaller topic path keeps the
+    crown and the other demotes."""
+    p1, p2 = make_process(engine, 1), make_process(engine, 2)
+    r1 = Registrar(process=p1)
+    r2 = Registrar(process=p2)
+    # Both promote before seeing each other (partition):
+    r1._machine.state = "primary"
+    r2._machine.state = "primary"
+    r1.on_enter_primary({})
+    r2.on_enter_primary({})
+    engine.drain()
+    states = sorted([r1.state, r2.state])
+    assert states == ["primary", "secondary"]
+    # r1 ("test/h/1/1") < r2 ("test/h/2/1") lexicographically: r1 wins.
+    assert r1.state == "primary"
+
+
+def test_ec_consumer_resync_prunes_stale_keys(engine):
+    """A remove that the consumer missed is corrected on the next
+    snapshot re-sync (0.8x lease refresh)."""
+    broker = "prune"
+    p1, p2 = make_process(engine, 1, broker), make_process(engine, 2, broker)
+    actor = compose_instance(Actor, actor_args("prod"), process=p1)
+    actor.ec_producer.add("gone", "soon")
+    cache = {}
+    ECConsumer(p2, cache, actor.topic_control, lease_time=10.0)
+    engine.drain()
+    assert cache["gone"] == "soon"
+    # Simulate the missed remove: mutate the producer share directly
+    # (no broadcast), as if the consumer was disconnected.
+    del actor.share["gone"]
+    engine.advance(9.0)   # refresh timer at 8s re-requests the snapshot
+    assert "gone" not in cache
+
+
 def test_graceful_registrar_stop_hands_over(engine):
     p1, p2 = make_process(engine, 1), make_process(engine, 2)
     r1 = Registrar(process=p1)
