@@ -2,3 +2,11 @@ from .common_io import DataSource, DataTarget, parse_data_url
 from .text_io import (
     TextOutput, TextReadFile, TextSample, TextTransform, TextWriteFile,
 )
+from .ml import (
+    TextClassifierElement, DetectorElement, LlamaChatElement,
+    ImageNormalize,
+)
+from .image_io import (
+    ImageReadFile, ImageResize, ImageOverlay, ImageWriteFile, ImageOutput,
+)
+from .video_io import VideoReadFile, VideoSample, VideoWriteFile, VideoOutput

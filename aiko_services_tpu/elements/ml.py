@@ -1,0 +1,138 @@
+"""ML pipeline elements: the model families as PipelineElements.
+
+The reference's ML elements shell out to third-party libraries on one
+device (YOLO via ultralytics, LLM via Ollama HTTP — SURVEY.md §2.5);
+here the models are the framework's own JAX functions, so ML stages are
+first-class TpuElements (fusable, device-resident swag) and the chat
+element runs a jitted prefill/decode loop with a KV cache.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models import classifier as classifier_model
+from ..models import detector as detector_model
+from ..models import llama as llama_model
+from ..pipeline.element import PipelineElement
+from ..pipeline.stream import StreamEvent
+from ..pipeline.tpu_stage import TpuElement
+
+__all__ = ["TextClassifierElement", "DetectorElement", "LlamaChatElement",
+           "ImageNormalize"]
+
+
+class ImageNormalize(TpuElement):
+    """uint8 images → normalized float (fusable preprocessing)."""
+
+    def compute(self, params, inputs):
+        image = inputs["image"].astype(jnp.float32) / 255.0
+        return {"image": image}
+
+
+class TextClassifierElement(TpuElement):
+    """``tokens`` (batch, seq) int32 → ``logits`` + ``label_id``."""
+
+    def init_params(self, key):
+        name, _ = self.get_parameter("model_config", "tiny")
+        self.config = classifier_model.CONFIGS[str(name)]
+        return classifier_model.init_params(self.config, key)
+
+    def compute(self, params, inputs):
+        logits = classifier_model.forward(params, inputs["tokens"],
+                                          self.config)
+        return {"logits": logits, "label_id": logits.argmax(-1)}
+
+
+class DetectorElement(TpuElement):
+    """``image`` (batch, H, W, 3) → raw grid + decoded boxes/scores."""
+
+    def init_params(self, key):
+        name, _ = self.get_parameter("model_config", "tiny")
+        self.config = detector_model.CONFIGS[str(name)]
+        return detector_model.init_params(self.config, key)
+
+    def compute(self, params, inputs):
+        raw = detector_model.forward(params, inputs["image"], self.config)
+        boxes, scores, classes, keep = detector_model.decode_boxes(
+            raw, self.config)
+        return {"boxes": boxes, "scores": scores, "classes": classes,
+                "keep": keep}
+
+
+class LlamaChatElement(PipelineElement):
+    """Autoregressive chat: ``tokens`` (batch, prompt_len) int32 →
+    ``tokens_out`` (batch, prompt+new) plus decode throughput metrics.
+
+    Parameters: ``model_config`` (llama.CONFIGS key), ``max_new_tokens``,
+    ``temperature`` (0 = greedy).  The KV cache is per-stream state
+    (stream.variables), sized at start_stream.
+    """
+
+    def __init__(self, context, process=None):
+        super().__init__(context, process)
+        name, _ = self.get_parameter("model_config", "tiny")
+        self.config = llama_model.CONFIGS[str(name)]
+        seed, _ = self.get_parameter("seed", 0)
+        self.params = llama_model.init_params(
+            self.config, jax.random.PRNGKey(int(seed)))
+
+    def start_stream(self, stream, stream_id):
+        return StreamEvent.OKAY, None
+
+    def process_frame(self, stream, tokens):
+        tokens = jnp.asarray(np.asarray(tokens), jnp.int32)
+        if tokens.ndim == 1:
+            tokens = tokens[None]
+        batch, prompt_len = tokens.shape
+        max_new, _ = self.get_parameter("max_new_tokens", 16,
+                                        stream=stream)
+        max_new = int(max_new)
+        budget = self.config.max_seq_len - prompt_len
+        if budget <= 0:
+            self.logger.error(
+                "%s: prompt (%d) exceeds max_seq_len (%d)",
+                self.my_id(stream), prompt_len, self.config.max_seq_len)
+            return StreamEvent.ERROR, {}
+        if max_new > budget:
+            self.logger.warning(
+                "%s: clamping max_new_tokens %d -> %d (max_seq_len %d)",
+                self.my_id(stream), max_new, budget,
+                self.config.max_seq_len)
+            max_new = budget
+        max_seq = prompt_len + max_new
+
+        temperature, _ = self.get_parameter("temperature", 0.0,
+                                            stream=stream)
+        temperature = float(temperature)
+        seed, _ = self.get_parameter("sample_seed", 0, stream=stream)
+        rng_key = jax.random.PRNGKey(int(seed))
+        cache = llama_model.init_cache(self.config, batch, max_seq)
+        logits, cache = llama_model.prefill(self.params, tokens, cache,
+                                            self.config)
+        if temperature > 0:
+            rng_key, first_key = jax.random.split(rng_key)
+            first = jax.random.categorical(
+                first_key, logits[:, -1] / temperature) \
+                .astype(jnp.int32)[:, None]
+        else:
+            first = logits[:, -1].argmax(-1).astype(jnp.int32)[:, None]
+        import time as _time
+        started = _time.perf_counter()
+        # One compiled program for the whole decode (lax.scan).
+        new_tokens, _ = llama_model.generate_tokens(
+            self.params, first, cache, jnp.int32(prompt_len),
+            max_new - 1, self.config, temperature=temperature,
+            rng_key=rng_key)
+        tokens_out = jnp.concatenate([tokens, first, new_tokens], axis=1)
+        np.asarray(tokens_out)          # host readback = real completion
+        elapsed = _time.perf_counter() - started
+        decoded = max(1, max_new - 1) * batch
+        return StreamEvent.OKAY, {
+            "tokens_out": tokens_out,
+            "tokens_per_second": decoded / max(elapsed, 1e-9),
+        }

@@ -1,0 +1,104 @@
+"""Video pipeline elements.
+
+Reference parity: ``/root/reference/src/aiko_services/elements/media/
+video_io.py`` — VideoReadFile (cv2.VideoCapture generator), VideoSample,
+VideoWriteFile, VideoOutput.  cv2 is present in this image; elements
+degrade with a clear error if a file cannot be opened.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..pipeline.element import PipelineElement
+from ..pipeline.stream import StreamEvent
+from .common_io import DataTarget, parse_data_url
+
+__all__ = ["VideoReadFile", "VideoSample", "VideoWriteFile",
+           "VideoOutput"]
+
+
+class VideoReadFile(PipelineElement):
+    """``data_sources`` video file → one frame per video frame
+    (``{"images": [array]}``), paced by the ``rate`` parameter."""
+
+    def start_stream(self, stream, stream_id):
+        import cv2
+        data_sources, found = self.get_parameter("data_sources",
+                                                 stream=stream)
+        if not found:
+            self.logger.error("%s: data_sources required",
+                              self.my_id(stream))
+            return StreamEvent.ERROR, None
+        path = parse_data_url(
+            data_sources[0] if isinstance(data_sources, list)
+            else data_sources)
+        capture = cv2.VideoCapture(path)
+        if not capture.isOpened():
+            self.logger.error("%s: cannot open %s", self.my_id(stream),
+                              path)
+            return StreamEvent.ERROR, None
+
+        def generator(stream_, frame_id):
+            okay, bgr = capture.read()
+            if not okay:
+                capture.release()
+                return StreamEvent.STOP, None
+            return StreamEvent.OKAY, {"images": [bgr[:, :, ::-1]]}
+
+        rate, _ = self.get_parameter("rate", 0, stream=stream)
+        self.create_frames(stream, generator, rate=float(rate) or None)
+        return StreamEvent.OKAY, None
+
+    def process_frame(self, stream, images):
+        return StreamEvent.OKAY, {"images": images}
+
+
+class VideoSample(PipelineElement):
+    """Keep every Nth frame (``sample_rate``)."""
+
+    def process_frame(self, stream, images):
+        rate, _ = self.get_parameter("sample_rate", 1, stream=stream)
+        counter = stream.variables.setdefault("video_sample_counter", 0)
+        stream.variables["video_sample_counter"] = counter + 1
+        if counter % max(1, int(rate)):
+            return StreamEvent.DROP_FRAME, {}
+        return StreamEvent.OKAY, {"images": images}
+
+
+class VideoWriteFile(DataTarget):
+    def start_stream(self, stream, stream_id):
+        stream.variables["video_writer"] = None
+        return StreamEvent.OKAY, None
+
+    def process_frame(self, stream, images):
+        import cv2
+        writer = stream.variables.get("video_writer")
+        if writer is None:
+            path = self.target_path(stream)
+            if not path:
+                self.logger.error("%s: data_targets required",
+                                  self.my_id(stream))
+                return StreamEvent.ERROR, {}
+            rate, _ = self.get_parameter("rate", 30.0, stream=stream)
+            height, width = np.asarray(images[0]).shape[:2]
+            writer = cv2.VideoWriter(
+                path, cv2.VideoWriter_fourcc(*"mp4v"), float(rate),
+                (width, height))
+            stream.variables["video_writer"] = writer
+        for image in images:
+            writer.write(np.asarray(image, np.uint8)[:, :, ::-1])
+        return StreamEvent.OKAY, {"images": images}
+
+    def stop_stream(self, stream, stream_id):
+        writer = stream.variables.get("video_writer")
+        if writer is not None:
+            writer.release()
+        return StreamEvent.OKAY, None
+
+
+class VideoOutput(PipelineElement):
+    def process_frame(self, stream, images):
+        print(f"video frame: {len(images)} image(s), "
+              f"shape {np.asarray(images[0]).shape if images else '-'}")
+        return StreamEvent.OKAY, {"images": images}

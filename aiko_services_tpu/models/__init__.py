@@ -1,0 +1,3 @@
+from . import llama
+from . import classifier
+from . import detector

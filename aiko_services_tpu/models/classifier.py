@@ -1,0 +1,100 @@
+"""Text classifier: a compact transformer encoder (DistilBERT-class) for
+the sentiment-pipeline workload (BASELINE.json config 1).
+
+Pure functional JAX like :mod:`.llama`: params pytree + jittable
+``forward``.  Mean-pooled encoder → 2-layer head.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.attention import attention_reference
+
+__all__ = ["ClassifierConfig", "init_params", "forward", "CONFIGS"]
+
+
+@dataclasses.dataclass(frozen=True)
+class ClassifierConfig:
+    vocab_size: int = 30_522          # bert-style
+    d_model: int = 256
+    n_layers: int = 4
+    n_heads: int = 4
+    d_ff: int = 1024
+    n_classes: int = 2
+    max_seq_len: int = 512
+    dtype: Any = jnp.bfloat16
+
+
+CONFIGS: Dict[str, ClassifierConfig] = {
+    "tiny": ClassifierConfig(vocab_size=1024, d_model=64, n_layers=2,
+                             n_heads=2, d_ff=128, max_seq_len=128),
+    "distilbert": ClassifierConfig(vocab_size=30_522, d_model=768,
+                                   n_layers=6, n_heads=12, d_ff=3072),
+}
+
+
+def init_params(config: ClassifierConfig, key) -> Dict:
+    keys = jax.random.split(key, config.n_layers + 3)
+    dt = config.dtype
+    d, f = config.d_model, config.d_ff
+
+    def dense(k, shape):
+        return (jax.random.normal(k, shape, jnp.float32)
+                * shape[0] ** -0.5).astype(dt)
+
+    layers = []
+    for i in range(config.n_layers):
+        lk = jax.random.split(keys[i], 6)
+        layers.append({
+            "norm1": jnp.ones((d,), dt),
+            "wqkv": dense(lk[0], (d, 3 * d)),
+            "wo": dense(lk[1], (d, d)),
+            "norm2": jnp.ones((d,), dt),
+            "w1": dense(lk[2], (d, f)),
+            "w2": dense(lk[3], (f, d)),
+        })
+    return {
+        "embed": dense(keys[-3], (config.vocab_size, d)),
+        "pos_embed": dense(keys[-2], (config.max_seq_len, d)),
+        "layers": layers,
+        "head_w1": dense(keys[-1], (d, d)),
+        "head_w2": (jax.random.normal(
+            jax.random.fold_in(keys[-1], 1),
+            (d, config.n_classes), jnp.float32) * d ** -0.5).astype(dt),
+    }
+
+
+def _norm(x, weight):
+    x32 = x.astype(jnp.float32)
+    mean = jnp.mean(x32, axis=-1, keepdims=True)
+    var = jnp.var(x32, axis=-1, keepdims=True)
+    return ((x32 - mean) * jax.lax.rsqrt(var + 1e-6)).astype(x.dtype) \
+        * weight
+
+
+@functools.partial(jax.jit, static_argnames=("config",))
+def forward(params, tokens, config: ClassifierConfig):
+    """tokens (batch, seq) int32 → logits (batch, n_classes) f32."""
+    batch, seq = tokens.shape
+    x = params["embed"][tokens] + params["pos_embed"][:seq][None]
+    h = config.n_heads
+    hd = config.d_model // h
+    for layer in params["layers"]:
+        normed = _norm(x, layer["norm1"])
+        qkv = (normed @ layer["wqkv"]).reshape(batch, seq, 3, h, hd)
+        q, k, v = (qkv[:, :, i].transpose(0, 2, 1, 3) for i in range(3))
+        out = attention_reference(q, k, v, causal=False)
+        out = out.transpose(0, 2, 1, 3).reshape(batch, seq, -1)
+        x = x + (out @ layer["wo"]).astype(x.dtype)
+        normed = _norm(x, layer["norm2"])
+        x = x + (jax.nn.gelu((normed @ layer["w1"]).astype(jnp.float32))
+                 .astype(x.dtype) @ layer["w2"])
+    pooled = jnp.mean(x.astype(jnp.float32), axis=1)
+    hidden = jnp.tanh(pooled @ params["head_w1"].astype(jnp.float32))
+    return hidden @ params["head_w2"].astype(jnp.float32)

@@ -1,0 +1,107 @@
+"""Single-shot object detector: YOLO-class conv backbone + grid head
+(the object-detection video-pipeline workload, BASELINE.json config 2).
+
+Anchor-free YOLO-style output: for each grid cell, ``(x, y, w, h,
+objectness, class…)``.  NHWC layout (TPU-native), bf16 weights, all
+convs lower to MXU matmuls via ``lax.conv_general_dilated``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["DetectorConfig", "init_params", "forward", "decode_boxes",
+           "CONFIGS"]
+
+
+@dataclasses.dataclass(frozen=True)
+class DetectorConfig:
+    image_size: int = 320
+    n_classes: int = 80
+    widths: Tuple[int, ...] = (16, 32, 64, 128, 256)
+    dtype: Any = jnp.bfloat16
+
+    @property
+    def grid_size(self) -> int:
+        return self.image_size // (2 ** len(self.widths))
+
+    @property
+    def out_channels(self) -> int:
+        return 5 + self.n_classes
+
+
+CONFIGS: Dict[str, DetectorConfig] = {
+    "tiny": DetectorConfig(image_size=64, n_classes=4,
+                           widths=(8, 16, 32)),
+    "yolo_n": DetectorConfig(image_size=320, n_classes=80,
+                             widths=(16, 32, 64, 128, 256)),
+}
+
+
+def init_params(config: DetectorConfig, key) -> Dict:
+    keys = jax.random.split(key, len(config.widths) + 1)
+    dt = config.dtype
+    layers = []
+    c_in = 3
+    for i, width in enumerate(config.widths):
+        fan = 3 * 3 * c_in
+        layers.append({
+            "w": (jax.random.normal(keys[i], (3, 3, c_in, width),
+                                    jnp.float32)
+                  * (2.0 / fan) ** 0.5).astype(dt),
+            "b": jnp.zeros((width,), dt),
+        })
+        c_in = width
+    head = {
+        "w": (jax.random.normal(keys[-1],
+                                (1, 1, c_in, config.out_channels),
+                                jnp.float32) * c_in ** -0.5).astype(dt),
+        "b": jnp.zeros((config.out_channels,), dt),
+    }
+    return {"layers": layers, "head": head}
+
+
+def _conv(x, w, b, stride):
+    out = jax.lax.conv_general_dilated(
+        x, w, window_strides=(stride, stride), padding="SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        preferred_element_type=jnp.float32)
+    return (out + b.astype(jnp.float32)).astype(x.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("config",))
+def forward(params, images, config: DetectorConfig):
+    """images (batch, H, W, 3) → raw grid (batch, gh, gw, 5+classes)."""
+    x = images.astype(config.dtype)
+    for layer in params["layers"]:
+        x = jax.nn.silu(_conv(x, layer["w"], layer["b"], stride=2))
+    head = params["head"]
+    return _conv(x, head["w"], head["b"], stride=1).astype(jnp.float32)
+
+
+def decode_boxes(raw, config: DetectorConfig,
+                 score_threshold: float = 0.5):
+    """Raw grid → (boxes xyxy [0,1], scores, classes) with a static-shape
+    mask (XLA-friendly: no dynamic shapes; filter host-side if needed)."""
+    batch, gh, gw, _ = raw.shape
+    xy_cell = jax.nn.sigmoid(raw[..., 0:2])
+    wh = jax.nn.sigmoid(raw[..., 2:4])
+    obj = jax.nn.sigmoid(raw[..., 4])
+    cls_logits = raw[..., 5:]
+    col = jax.lax.broadcasted_iota(jnp.float32, (gh, gw), 1)
+    row = jax.lax.broadcasted_iota(jnp.float32, (gh, gw), 0)
+    cx = (xy_cell[..., 0] + col) / gw
+    cy = (xy_cell[..., 1] + row) / gh
+    half_w, half_h = wh[..., 0] / 2, wh[..., 1] / 2
+    boxes = jnp.stack([cx - half_w, cy - half_h,
+                       cx + half_w, cy + half_h], axis=-1)
+    scores = obj * jax.nn.softmax(cls_logits, axis=-1).max(-1)
+    classes = cls_logits.argmax(-1)
+    keep = scores >= score_threshold
+    return (boxes.reshape(batch, -1, 4), scores.reshape(batch, -1),
+            classes.reshape(batch, -1), keep.reshape(batch, -1))

@@ -1,0 +1,334 @@
+"""Llama-3-architecture decoder-only transformer, TPU-first.
+
+The flagship model family for the llm_chat workload (the reference calls
+an external Ollama llama3.1 over HTTP, ``examples/llm/elements_llm.py:
+191-220``; here the model *is* the framework's).  Pure functional JAX:
+parameters are a pytree dict, the forward is jit/pjit-friendly, and every
+parameter carries a logical sharding spec so the same code runs single-
+chip or TP/DP-sharded over a mesh.
+
+Architecture (Llama 3): RMSNorm pre-norm, rotary position embeddings,
+grouped-query attention, SwiGLU MLP, untied LM head, bfloat16 params with
+f32 layernorm/softmax accumulation.  Prefill uses the Pallas flash
+attention kernel; single-token decode attends over a preallocated KV
+cache (dense dot — one query row doesn't need flash).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..ops.attention import attention_reference, flash_attention
+
+__all__ = ["LlamaConfig", "init_params", "forward", "init_cache",
+           "decode_step", "generate_tokens", "prefill", "param_specs",
+           "CONFIGS"]
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 32_000
+    d_model: int = 512
+    n_layers: int = 4
+    n_heads: int = 8
+    n_kv_heads: int = 4
+    d_ff: int = 1376
+    rope_theta: float = 500_000.0
+    norm_eps: float = 1e-5
+    max_seq_len: int = 2048
+    dtype: Any = jnp.bfloat16
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+
+#: Named configs: tiny/small for tests+bench on one chip, the real ones
+#: for parity with BASELINE.json targets.
+CONFIGS: Dict[str, LlamaConfig] = {
+    "tiny": LlamaConfig(vocab_size=1024, d_model=128, n_layers=2,
+                        n_heads=4, n_kv_heads=2, d_ff=352,
+                        max_seq_len=512),
+    "small": LlamaConfig(vocab_size=32_000, d_model=1024, n_layers=8,
+                         n_heads=16, n_kv_heads=8, d_ff=2816,
+                         max_seq_len=2048),
+    "1b": LlamaConfig(vocab_size=128_256, d_model=2048, n_layers=16,
+                      n_heads=32, n_kv_heads=8, d_ff=8192,
+                      max_seq_len=8192),
+    "llama3_8b": LlamaConfig(vocab_size=128_256, d_model=4096,
+                             n_layers=32, n_heads=32, n_kv_heads=8,
+                             d_ff=14_336, max_seq_len=8192),
+    "llama3_70b": LlamaConfig(vocab_size=128_256, d_model=8192,
+                              n_layers=80, n_heads=64, n_kv_heads=8,
+                              d_ff=28_672, max_seq_len=8192),
+}
+
+
+# --------------------------------------------------------------------------- #
+# Parameters
+
+def _dense_init(key, shape, dtype, scale=None):
+    fan_in = shape[0]
+    scale = scale if scale is not None else fan_in ** -0.5
+    return (jax.random.normal(key, shape, jnp.float32) * scale).astype(dtype)
+
+
+def init_params(config: LlamaConfig, key) -> Dict:
+    keys = jax.random.split(key, config.n_layers + 3)
+    dt = config.dtype
+    d, h, kv, hd, f = (config.d_model, config.n_heads, config.n_kv_heads,
+                       config.head_dim, config.d_ff)
+    layers = []
+    for i in range(config.n_layers):
+        lk = jax.random.split(keys[i], 7)
+        layers.append({
+            "attn_norm": jnp.ones((d,), dt),
+            "wq": _dense_init(lk[0], (d, h * hd), dt),
+            "wk": _dense_init(lk[1], (d, kv * hd), dt),
+            "wv": _dense_init(lk[2], (d, kv * hd), dt),
+            "wo": _dense_init(lk[3], (h * hd, d), dt),
+            "mlp_norm": jnp.ones((d,), dt),
+            "w_gate": _dense_init(lk[4], (d, f), dt),
+            "w_up": _dense_init(lk[5], (d, f), dt),
+            "w_down": _dense_init(lk[6], (f, d), dt),
+        })
+    return {
+        "embed": _dense_init(keys[-3], (config.vocab_size, d), dt, 1.0),
+        "layers": layers,
+        "final_norm": jnp.ones((d,), dt),
+        "lm_head": _dense_init(keys[-2], (d, config.vocab_size), dt),
+    }
+
+
+def param_specs(config: LlamaConfig) -> Dict:
+    """PartitionSpecs for tensor parallelism over the "tp" mesh axis
+    (megatron-style: column-parallel qkv/gate/up, row-parallel o/down;
+    vocab-sharded embedding + head)."""
+    layer = {
+        "attn_norm": P(),
+        "wq": P(None, "tp"), "wk": P(None, "tp"), "wv": P(None, "tp"),
+        "wo": P("tp", None),
+        "mlp_norm": P(),
+        "w_gate": P(None, "tp"), "w_up": P(None, "tp"),
+        "w_down": P("tp", None),
+    }
+    return {
+        "embed": P("tp", None),
+        "layers": [dict(layer) for _ in range(config.n_layers)],
+        "final_norm": P(),
+        "lm_head": P(None, "tp"),
+    }
+
+
+# --------------------------------------------------------------------------- #
+# Building blocks
+
+def rms_norm(x, weight, eps):
+    x32 = x.astype(jnp.float32)
+    rms = jax.lax.rsqrt(jnp.mean(x32 * x32, axis=-1, keepdims=True) + eps)
+    return (x32 * rms).astype(x.dtype) * weight
+
+
+def _rope_freqs(config: LlamaConfig, positions):
+    """positions: (batch, seq) int32 → cos/sin (batch, seq, head_dim/2)."""
+    dim = config.head_dim
+    inv_freq = 1.0 / (config.rope_theta **
+                      (jnp.arange(0, dim, 2, dtype=jnp.float32) / dim))
+    angles = positions[..., None].astype(jnp.float32) * inv_freq
+    return jnp.cos(angles), jnp.sin(angles)
+
+
+def apply_rope(x, cos, sin):
+    """x: (batch, seq, heads, head_dim); rotate-half convention."""
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    cos = cos[:, :, None, :]
+    sin = sin[:, :, None, :]
+    return jnp.concatenate(
+        [x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1).astype(x.dtype)
+
+
+def _attention_block(layer, config, x, cos, sin, cache_layer=None,
+                     cache_index=None, use_flash=True):
+    """Returns (output, new_cache_layer)."""
+    batch, seq, _ = x.shape
+    h, kv, hd = config.n_heads, config.n_kv_heads, config.head_dim
+    normed = rms_norm(x, layer["attn_norm"], config.norm_eps)
+    q = (normed @ layer["wq"]).reshape(batch, seq, h, hd)
+    k = (normed @ layer["wk"]).reshape(batch, seq, kv, hd)
+    v = (normed @ layer["wv"]).reshape(batch, seq, kv, hd)
+    q = apply_rope(q, cos, sin)
+    k = apply_rope(k, cos, sin)
+
+    if cache_layer is not None:
+        # Decode: write this step's k/v at cache_index, attend over cache.
+        k_cache = jax.lax.dynamic_update_slice(
+            cache_layer["k"], k.astype(cache_layer["k"].dtype),
+            (0, cache_index, 0, 0))
+        v_cache = jax.lax.dynamic_update_slice(
+            cache_layer["v"], v.astype(cache_layer["v"].dtype),
+            (0, cache_index, 0, 0))
+        new_cache = {"k": k_cache, "v": v_cache}
+        k_all = k_cache.transpose(0, 2, 1, 3)     # (b, kv, max_seq, hd)
+        v_all = v_cache.transpose(0, 2, 1, 3)
+        q_t = q.transpose(0, 2, 1, 3)             # (b, h, seq, hd)
+        group = h // kv
+        k_all = jnp.repeat(k_all, group, axis=1)
+        v_all = jnp.repeat(v_all, group, axis=1)
+        s = jnp.einsum("bhqd,bhkd->bhqk", q_t, k_all,
+                       preferred_element_type=jnp.float32) * hd ** -0.5
+        # Mask cache positions beyond the current step.
+        valid = (jnp.arange(cache_layer["k"].shape[1])[None, :]
+                 <= cache_index)
+        s = jnp.where(valid[None, None, :, :], s, -1e30)
+        weights = jax.nn.softmax(s, axis=-1)
+        out = jnp.einsum("bhqk,bhkd->bhqd",
+                         weights.astype(v_all.dtype), v_all)
+        out = out.transpose(0, 2, 1, 3)
+    else:
+        new_cache = None
+        group = h // kv
+        q_t = q.transpose(0, 2, 1, 3)
+        k_t = jnp.repeat(k.transpose(0, 2, 1, 3), group, axis=1)
+        v_t = jnp.repeat(v.transpose(0, 2, 1, 3), group, axis=1)
+        attend = flash_attention if use_flash else attention_reference
+        out = attend(q_t, k_t, v_t, causal=True)
+        out = out.transpose(0, 2, 1, 3)
+
+    out = out.reshape(batch, seq, h * hd) @ layer["wo"]
+    return x + out.astype(x.dtype), new_cache
+
+
+def _mlp_block(layer, config, x):
+    normed = rms_norm(x, layer["mlp_norm"], config.norm_eps)
+    gate = jax.nn.silu((normed @ layer["w_gate"]).astype(jnp.float32))
+    up = (normed @ layer["w_up"]).astype(jnp.float32)
+    return x + ((gate * up).astype(x.dtype) @ layer["w_down"])
+
+
+# --------------------------------------------------------------------------- #
+# Entry points
+
+@functools.partial(jax.jit, static_argnames=("config", "use_flash"))
+def forward(params, tokens, config: LlamaConfig, use_flash: bool = True):
+    """Full-sequence forward (training / prefill-style): tokens
+    (batch, seq) int32 → logits (batch, seq, vocab) f32."""
+    batch, seq = tokens.shape
+    positions = jnp.broadcast_to(jnp.arange(seq), (batch, seq))
+    cos, sin = _rope_freqs(config, positions)
+    x = params["embed"][tokens]
+    for layer in params["layers"]:
+        x, _ = _attention_block(layer, config, x, cos, sin,
+                                use_flash=use_flash)
+        x = _mlp_block(layer, config, x)
+    x = rms_norm(x, params["final_norm"], config.norm_eps)
+    return (x @ params["lm_head"]).astype(jnp.float32)
+
+
+def init_cache(config: LlamaConfig, batch: int,
+               max_seq: Optional[int] = None) -> list:
+    max_seq = max_seq or config.max_seq_len
+    shape = (batch, max_seq, config.n_kv_heads, config.head_dim)
+    return [{"k": jnp.zeros(shape, config.dtype),
+             "v": jnp.zeros(shape, config.dtype)}
+            for _ in range(config.n_layers)]
+
+
+@functools.partial(jax.jit, static_argnames=("config",))
+def prefill(params, tokens, cache, config: LlamaConfig):
+    """Run the prompt through the model filling the KV cache; returns
+    (logits_last, cache)."""
+    batch, seq = tokens.shape
+    positions = jnp.broadcast_to(jnp.arange(seq), (batch, seq))
+    cos, sin = _rope_freqs(config, positions)
+    x = params["embed"][tokens]
+    new_cache = []
+    for layer, cache_layer in zip(params["layers"], cache):
+        k_cache = cache_layer["k"]
+        normed = rms_norm(x, layer["attn_norm"], config.norm_eps)
+        h, kv, hd = config.n_heads, config.n_kv_heads, config.head_dim
+        q = (normed @ layer["wq"]).reshape(batch, seq, h, hd)
+        k = (normed @ layer["wk"]).reshape(batch, seq, kv, hd)
+        v = (normed @ layer["wv"]).reshape(batch, seq, kv, hd)
+        q, k = apply_rope(q, cos, sin), apply_rope(k, cos, sin)
+        k_cache = jax.lax.dynamic_update_slice(
+            cache_layer["k"], k.astype(cache_layer["k"].dtype),
+            (0, 0, 0, 0))
+        v_cache = jax.lax.dynamic_update_slice(
+            cache_layer["v"], v.astype(cache_layer["v"].dtype),
+            (0, 0, 0, 0))
+        new_cache.append({"k": k_cache, "v": v_cache})
+        group = h // kv
+        q_t = q.transpose(0, 2, 1, 3)
+        k_t = jnp.repeat(k.transpose(0, 2, 1, 3), group, axis=1)
+        v_t = jnp.repeat(v.transpose(0, 2, 1, 3), group, axis=1)
+        out = flash_attention(q_t, k_t, v_t, causal=True)
+        out = out.transpose(0, 2, 1, 3).reshape(batch, seq, h * hd)
+        x = x + (out @ layer["wo"]).astype(x.dtype)
+        x = _mlp_block(layer, config, x)
+    x = rms_norm(x, params["final_norm"], config.norm_eps)
+    logits = (x[:, -1:] @ params["lm_head"]).astype(jnp.float32)
+    return logits, new_cache
+
+
+def _decode_core(params, token, cache, cache_index, config: LlamaConfig):
+    """One autoregressive step (traceable core): token (batch, 1) +
+    cache position → (logits (batch, 1, vocab), new_cache)."""
+    batch = token.shape[0]
+    positions = jnp.full((batch, 1), cache_index, jnp.int32)
+    cos, sin = _rope_freqs(config, positions)
+    x = params["embed"][token]
+    new_cache = []
+    for layer, cache_layer in zip(params["layers"], cache):
+        x, updated = _attention_block(layer, config, x, cos, sin,
+                                      cache_layer=cache_layer,
+                                      cache_index=cache_index)
+        new_cache.append(updated)
+        x = _mlp_block(layer, config, x)
+    x = rms_norm(x, params["final_norm"], config.norm_eps)
+    logits = (x @ params["lm_head"]).astype(jnp.float32)
+    return logits, new_cache
+
+
+decode_step = functools.partial(jax.jit, static_argnames=("config",),
+                                donate_argnames=("cache",))(_decode_core)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("config", "num_steps", "temperature"),
+                   donate_argnames=("cache",))
+def generate_tokens(params, first_token, cache, start_index, num_steps,
+                    config: LlamaConfig, temperature: float = 0.0,
+                    rng_key=None):
+    """Greedy (or sampled) decode of ``num_steps`` tokens as ONE compiled
+    program (``lax.scan`` over steps) — a single device dispatch instead
+    of one per token, which matters both for dispatch overhead and for
+    XLA's ability to keep the KV cache resident.
+
+    Returns (tokens (batch, num_steps), cache)."""
+    if rng_key is None:
+        rng_key = jax.random.PRNGKey(0)
+
+    def body(carry, step):
+        token, cache, key = carry
+        logits, cache = _decode_core(params, token, cache,
+                                     start_index + step, config)
+        logits = logits[:, -1]
+        if temperature and temperature > 0:
+            key, sample_key = jax.random.split(key)
+            next_token = jax.random.categorical(
+                sample_key, logits / temperature).astype(jnp.int32)
+        else:
+            next_token = logits.argmax(-1).astype(jnp.int32)
+        next_token = next_token[:, None]
+        return (next_token, cache, key), next_token[:, 0]
+
+    (_, cache, _), tokens = jax.lax.scan(
+        body, (first_token, cache, rng_key),
+        jnp.arange(num_steps, dtype=jnp.int32))
+    return tokens.T, cache   # (batch, num_steps)

@@ -1,0 +1,161 @@
+"""Attention ops: Pallas flash-attention kernel for TPU with a reference
+jnp fallback.
+
+The reference framework has no attention code at all (SURVEY.md §5.7) —
+its LLM examples call Ollama over HTTP.  Here attention is a first-class
+op: the kernel implements online-softmax flash attention (one pass over
+K/V blocks, f32 running max/denominator in VMEM scratch, bf16-friendly
+inputs) tiled for the MXU; the fallback is a numerically-identical jnp
+implementation used on CPU and for testing (the kernel itself is also
+testable on CPU via ``interpret=True``).
+
+Layout: ``(batch, heads, seq, head_dim)``; ``head_dim`` ≤ 128 rides the
+lane dimension, query blocks ride sublanes.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # pallas TPU backend only exists on TPU-enabled jaxlibs
+    from jax.experimental.pallas import tpu as pltpu
+    _PALLAS_TPU = True
+except ImportError:  # pragma: no cover
+    pltpu = None
+    _PALLAS_TPU = False
+
+__all__ = ["flash_attention", "attention_reference", "NEG_INF"]
+
+NEG_INF = -1e30
+
+DEFAULT_BLOCK_Q = 128
+DEFAULT_BLOCK_K = 128
+
+
+def attention_reference(q, k, v, causal: bool = True,
+                        sm_scale: Optional[float] = None):
+    """Plain jnp attention (the numerics oracle and CPU path)."""
+    if sm_scale is None:
+        sm_scale = q.shape[-1] ** -0.5
+    logits = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * sm_scale
+    if causal:
+        q_len, k_len = logits.shape[-2], logits.shape[-1]
+        q_ids = jnp.arange(q_len)[:, None] + (k_len - q_len)
+        k_ids = jnp.arange(k_len)[None, :]
+        logits = jnp.where(k_ids <= q_ids, logits, NEG_INF)
+    weights = jax.nn.softmax(logits, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd",
+                      weights.astype(v.dtype), v).astype(q.dtype)
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref,
+                  m_scratch, l_scratch, acc_scratch,
+                  *, sm_scale: float, causal: bool,
+                  block_q: int, block_k: int, k_len: int, q_len: int):
+    """Grid: (batch*heads, q_blocks, k_blocks); k fastest-varying.
+
+    Scratch carries the online-softmax state (running max ``m``, sum
+    ``l``, accumulator ``acc``) across the k-block sweep for one q block.
+    """
+    k_idx = pl.program_id(2)
+    num_k = pl.num_programs(2)
+
+    @pl.when(k_idx == 0)
+    def _init():
+        m_scratch[:] = jnp.full_like(m_scratch, NEG_INF)
+        l_scratch[:] = jnp.zeros_like(l_scratch)
+        acc_scratch[:] = jnp.zeros_like(acc_scratch)
+
+    q = q_ref[0].astype(jnp.float32)          # (block_q, d)
+    k = k_ref[0].astype(jnp.float32)          # (block_k, d)
+    v = v_ref[0].astype(jnp.float32)          # (block_k, d)
+
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * sm_scale   # (bq, bk)
+
+    if causal:
+        q_ids = jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0) \
+            + pl.program_id(1) * block_q + (k_len - q_len)
+        k_ids = jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1) + k_idx * block_k
+        s = jnp.where(k_ids <= q_ids, s, NEG_INF)
+
+    m_prev = m_scratch[:]                      # (bq, 1)
+    m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+    p = jnp.exp(s - m_new)                     # (bq, bk)
+    correction = jnp.exp(m_prev - m_new)       # (bq, 1)
+    l_new = correction * l_scratch[:] + jnp.sum(p, axis=-1, keepdims=True)
+    acc_scratch[:] = acc_scratch[:] * correction + jax.lax.dot_general(
+        p, v, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    m_scratch[:] = m_new
+    l_scratch[:] = l_new
+
+    @pl.when(k_idx == num_k - 1)
+    def _finish():
+        denom = jnp.where(l_scratch[:] == 0.0, 1.0, l_scratch[:])
+        o_ref[0] = (acc_scratch[:] / denom).astype(o_ref.dtype)
+
+
+def flash_attention(q, k, v, causal: bool = True,
+                    sm_scale: Optional[float] = None,
+                    block_q: int = DEFAULT_BLOCK_Q,
+                    block_k: int = DEFAULT_BLOCK_K,
+                    interpret: bool = False):
+    """Flash attention; dispatches to the Pallas kernel on TPU (or in
+    interpret mode), else the jnp reference."""
+    if sm_scale is None:
+        sm_scale = q.shape[-1] ** -0.5
+    on_tpu = jax.default_backend() == "tpu"
+    if not (_PALLAS_TPU and (on_tpu or interpret)):
+        return attention_reference(q, k, v, causal=causal,
+                                   sm_scale=sm_scale)
+
+    batch, heads, q_len, head_dim = q.shape
+    k_len = k.shape[2]
+    block_q = min(block_q, q_len)
+    block_k = min(block_k, k_len)
+    if q_len % block_q or k_len % block_k:
+        return attention_reference(q, k, v, causal=causal,
+                                   sm_scale=sm_scale)
+
+    bh = batch * heads
+    q3 = q.reshape(bh, q_len, head_dim)
+    k3 = k.reshape(bh, k_len, head_dim)
+    v3 = v.reshape(bh, k_len, head_dim)
+
+    grid = (bh, q_len // block_q, k_len // block_k)
+    kernel = functools.partial(
+        _flash_kernel, sm_scale=sm_scale, causal=causal,
+        block_q=block_q, block_k=block_k, k_len=k_len, q_len=q_len)
+
+    out = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, head_dim),
+                         lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, head_dim),
+                         lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, head_dim),
+                         lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, head_dim),
+                               lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, q_len, head_dim), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, head_dim), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q3, k3, v3)
+    return out.reshape(batch, heads, q_len, head_dim)

@@ -1,0 +1,2 @@
+from .mesh import MeshSpec, make_mesh, named_sharding, logical_axis_rules
+from .ring_attention import ring_attention, ring_attention_sharded

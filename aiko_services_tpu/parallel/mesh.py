@@ -1,0 +1,100 @@
+"""Device meshes and sharding policy.
+
+The TPU build's answer to the reference's process-fleet scaling
+(SURVEY.md §2.6): instead of NCCL/MPI-style point-to-point plumbing, a
+``jax.sharding.Mesh`` over the chip topology with named axes, and
+``NamedSharding`` annotations that let XLA insert ICI collectives.
+
+Axis conventions (the "How to Scale Your Model" recipe):
+
+* ``dp``    — data parallel (batch dimension)
+* ``tp``    — tensor parallel (hidden / heads dimension)
+* ``sp``    — sequence/context parallel (ring attention over this axis)
+* ``pp``    — pipeline-parallel stage axis (inter-stage hand-off)
+
+``make_mesh`` builds a mesh from whatever devices exist (real TPU chips,
+or the 8 virtual CPU devices used in tests via
+``--xla_force_host_platform_device_count=8``).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+__all__ = [
+    "MeshSpec", "make_mesh", "named_sharding", "shard_batch_spec",
+    "logical_axis_rules", "DEFAULT_AXES",
+]
+
+DEFAULT_AXES = ("dp", "tp")
+
+P = PartitionSpec
+
+
+class MeshSpec:
+    """Declarative mesh shape: ``MeshSpec(dp=2, tp=4)``.
+
+    ``-1`` for one axis means "all remaining devices".
+    """
+
+    def __init__(self, **axes: int):
+        if not axes:
+            axes = {"dp": -1}
+        self.axes: Dict[str, int] = dict(axes)
+
+    def resolve(self, device_count: int) -> Dict[str, int]:
+        sizes = dict(self.axes)
+        wildcard = [k for k, v in sizes.items() if v == -1]
+        if len(wildcard) > 1:
+            raise ValueError("Only one mesh axis may be -1")
+        fixed = math.prod(v for v in sizes.values() if v != -1)
+        if wildcard:
+            if device_count % fixed:
+                raise ValueError(
+                    f"{device_count} devices not divisible by {fixed}")
+            sizes[wildcard[0]] = device_count // fixed
+        elif fixed != device_count:
+            raise ValueError(
+                f"Mesh {sizes} needs {fixed} devices, have {device_count}")
+        return sizes
+
+    def build(self, devices: Optional[Sequence] = None) -> Mesh:
+        devices = list(devices if devices is not None else jax.devices())
+        sizes = self.resolve(len(devices))
+        shape = tuple(sizes.values())
+        array = np.asarray(devices).reshape(shape)
+        return Mesh(array, tuple(sizes.keys()))
+
+
+def make_mesh(devices: Optional[Sequence] = None, **axes: int) -> Mesh:
+    return MeshSpec(**axes).build(devices)
+
+
+def named_sharding(mesh: Mesh, *spec) -> NamedSharding:
+    return NamedSharding(mesh, P(*spec))
+
+
+def shard_batch_spec(mesh: Mesh) -> PartitionSpec:
+    """Batch sharded over dp (and sp if present merges into batch rows)."""
+    return P("dp") if "dp" in mesh.axis_names else P()
+
+
+#: Logical-axis → mesh-axis rules for model parameter shardings
+#: (flax-linen style but framework-agnostic).
+def logical_axis_rules(mesh: Mesh) -> Dict[str, Optional[str]]:
+    names = mesh.axis_names
+    return {
+        "batch": "dp" if "dp" in names else None,
+        "seq": "sp" if "sp" in names else None,
+        "heads": "tp" if "tp" in names else None,
+        "kv_heads": "tp" if "tp" in names else None,
+        "embed": None,
+        "mlp": "tp" if "tp" in names else None,
+        "vocab": "tp" if "tp" in names else None,
+        "stage": "pp" if "pp" in names else None,
+    }

@@ -1,0 +1,108 @@
+"""Ring attention: exact attention over sequences sharded across a mesh
+axis (context parallelism).
+
+The reference has no long-context machinery (SURVEY.md §5.7); this is the
+TPU-native design: Q/K/V are sharded over the ``sp`` mesh axis on their
+sequence dimension; each device computes blockwise attention against the
+K/V shard it currently holds while rotating K/V shards around the ring
+with ``ppermute`` (ICI neighbor exchange), merging partial results with
+the online-softmax recurrence — so memory per device stays O(seq/n) and
+the full-sequence result is exact (Liu et al. ring attention, via
+blockwise attention numerics).
+
+Causality is handled with *global* position ids so the mask is correct
+regardless of which ring step a K/V block arrives on.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+try:
+    from jax import shard_map  # jax >= 0.8
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map
+
+from ..ops.attention import NEG_INF
+
+__all__ = ["ring_attention", "ring_attention_sharded"]
+
+
+def _block_attend(q, k, v, q_offset, k_offset, sm_scale, causal,
+                  m, l, acc):
+    """One blockwise-attention accumulation step (f32 state)."""
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * sm_scale
+    if causal:
+        q_len, k_len = q.shape[2], k.shape[2]
+        q_ids = jnp.arange(q_len)[:, None] + q_offset
+        k_ids = jnp.arange(k_len)[None, :] + k_offset
+        s = jnp.where(k_ids <= q_ids, s, NEG_INF)
+    m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+    p = jnp.exp(s - m_new)
+    correction = jnp.exp(m - m_new)
+    l_new = correction * l + jnp.sum(p, axis=-1, keepdims=True)
+    acc_new = acc * correction + jnp.einsum(
+        "bhqk,bhkd->bhqd", p, v.astype(jnp.float32),
+        preferred_element_type=jnp.float32)
+    return m_new, l_new, acc_new
+
+
+def ring_attention(q, k, v, axis_name: str, causal: bool = True,
+                   sm_scale: Optional[float] = None):
+    """Inside-shard_map body: local q/k/v shards of shape
+    ``(batch, heads, seq_local, head_dim)``; returns the local output
+    shard.  K/V rotate ``axis_size`` steps around the ring."""
+    if sm_scale is None:
+        sm_scale = q.shape[-1] ** -0.5
+    axis_size = jax.lax.psum(1, axis_name)
+    axis_index = jax.lax.axis_index(axis_name)
+    seq_local = q.shape[2]
+    q_offset = axis_index * seq_local
+
+    batch, heads, _, head_dim = q.shape
+    m = jnp.full((batch, heads, seq_local, 1), NEG_INF, jnp.float32)
+    l = jnp.zeros((batch, heads, seq_local, 1), jnp.float32)
+    acc = jnp.zeros((batch, heads, seq_local, head_dim), jnp.float32)
+    if hasattr(jax.lax, "pvary"):
+        # shard_map's varying-axis tracking: the carry becomes 'sp'-varying
+        # after the first step, so the init must be marked varying too.
+        m, l, acc = (jax.lax.pvary(x, axis_name) for x in (m, l, acc))
+
+    perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+
+    def step(i, carry):
+        k_cur, v_cur, m, l, acc = carry
+        # The block currently held arrived from device (index - i).
+        src = (axis_index - i) % axis_size
+        k_offset = src * seq_local
+        m, l, acc = _block_attend(q, k_cur, v_cur, q_offset, k_offset,
+                                  sm_scale, causal, m, l, acc)
+        k_next = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_next = jax.lax.ppermute(v_cur, axis_name, perm)
+        return k_next, v_next, m, l, acc
+
+    _, _, m, l, acc = jax.lax.fori_loop(
+        0, axis_size, step, (k, v, m, l, acc))
+    denom = jnp.where(l == 0.0, 1.0, l)
+    return (acc / denom).astype(q.dtype)
+
+
+def ring_attention_sharded(q, k, v, mesh: Mesh, axis: str = "sp",
+                           causal: bool = True,
+                           sm_scale: Optional[float] = None):
+    """Global entry: q/k/v are full arrays (batch, heads, seq, head_dim);
+    shard_map shards the sequence dimension over ``axis`` and runs the
+    ring.  Heads are additionally sharded over ``tp`` when present."""
+    head_axis = "tp" if "tp" in mesh.axis_names else None
+    spec = P(None, head_axis, axis, None)
+    fn = shard_map(
+        functools.partial(ring_attention, axis_name=axis, causal=causal,
+                          sm_scale=sm_scale),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+    return fn(q, k, v)

@@ -1,0 +1,87 @@
+"""Sharded training step for the flagship model.
+
+Used by ``__graft_entry__.dryrun_multichip`` and as the template for
+full training runs: next-token cross-entropy over a dp×tp mesh, optax
+optimizer, parameters/optimizer state sharded by the model's
+``param_specs`` so XLA inserts the psum/all-gather collectives over ICI.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..models import llama
+
+__all__ = ["make_train_step", "init_train_state", "shard_train_state"]
+
+
+def cross_entropy(logits, targets):
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    picked = jnp.take_along_axis(logp, targets[..., None], axis=-1)
+    return -jnp.mean(picked)
+
+
+def make_train_step(config: llama.LlamaConfig, optimizer):
+    def loss_fn(params, tokens):
+        logits = llama.forward(params, tokens[:, :-1], config,
+                               use_flash=False)
+        return cross_entropy(logits, tokens[:, 1:])
+
+    def train_step(params, opt_state, tokens):
+        loss, grads = jax.value_and_grad(loss_fn)(params, tokens)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    return train_step
+
+
+def init_train_state(config: llama.LlamaConfig, key, optimizer):
+    params = llama.init_params(config, key)
+    opt_state = optimizer.init(params)
+    return params, opt_state
+
+
+def shard_train_state(params, opt_state, mesh: Mesh,
+                      config: llama.LlamaConfig):
+    """Place params (and matching optimizer state leaves) with the
+    model's TP partition specs."""
+    specs = llama.param_specs(config)
+
+    def place(tree, tree_specs):
+        return jax.tree.map(
+            lambda leaf, spec: jax.device_put(
+                leaf, NamedSharding(mesh, spec)),
+            tree, tree_specs,
+            is_leaf=lambda x: not isinstance(x, (dict, list)))
+
+    params = place(params, specs)
+
+    # Re-place adam moments along the params structure when shapes match;
+    # scalar leaves (step counts) are left for pjit to replicate.
+    def place_like_params(opt_tree):
+        if isinstance(opt_tree, (optax.EmptyState, type(None))):
+            return opt_tree
+        try:
+            return jax.tree.map(
+                lambda leaf, spec: jax.device_put(
+                    leaf, NamedSharding(mesh, spec))
+                if hasattr(leaf, "shape") and leaf.ndim > 0 else leaf,
+                opt_tree, specs,
+                is_leaf=lambda x: not isinstance(x, (dict, list)))
+        except (ValueError, TypeError):
+            return opt_tree
+
+    new_opt_state = []
+    for item in opt_state:
+        if hasattr(item, "mu") and hasattr(item, "nu"):
+            item = item._replace(mu=place_like_params(item.mu),
+                                 nu=place_like_params(item.nu))
+        new_opt_state.append(item)
+    return params, tuple(new_opt_state)

@@ -98,6 +98,19 @@ class Pipeline(PipelineElement):
         self.graph = Graph.traverse(self.definition.graph,
                                     self._node_properties)
         self._create_elements()
+        # TPU runtime: fuse contiguous TpuElement runs into single jitted
+        # stages (device-resident swag between them; see tpu_stage.py).
+        self._fused_stages: Dict[str, Any] = {}
+        if self.definition.runtime == "tpu":
+            from .tpu_stage import build_fused_stages
+            for head in self.graph.head_names:
+                path = list(self.graph.get_path(head))
+                self._fused_stages.update(build_fused_stages(
+                    path, self.elements, self._node_mappings))
+            if self._fused_stages:
+                self.logger.info(
+                    "%s: fused TPU stages: %s", self.name,
+                    [s.name for s in self._fused_stages.values()])
         self._command_handlers.update({
             "process_frame": self._wire_process_frame,
             "process_frame_response": self._wire_process_frame_response,
@@ -366,10 +379,32 @@ class Pipeline(PipelineElement):
                 names = [n.name for n in nodes]
                 if resume_at in names:
                     nodes = nodes[names.index(resume_at):]
+        nodes = list(nodes)
         self._stream_current = stream
         stream.frame = frame
         try:
-            for node in nodes:
+            i = 0
+            while i < len(nodes):
+                node = nodes[i]
+                stage = self._fused_stages.get(node.name)
+                if stage is not None and \
+                        [n.name for n in
+                         nodes[i:i + len(stage.node_names)]] == \
+                        stage.node_names:
+                    started = time.perf_counter()
+                    try:
+                        frame.swag = stage(frame.swag)
+                    except Exception:  # noqa: BLE001
+                        self.logger.exception("%s: fused stage %s failed",
+                                              self.name, stage.name)
+                        self._handle_stream_event(stream, frame,
+                                                  stage.name,
+                                                  StreamEvent.ERROR)
+                        return
+                    frame.metrics[f"time_{stage.name}"] = \
+                        time.perf_counter() - started
+                    i += len(stage.node_names)
+                    continue
                 element = self.elements.get(node.name)
                 if element is not None:
                     if not self._invoke_local(stream, frame, node, element):
@@ -377,6 +412,7 @@ class Pipeline(PipelineElement):
                 else:
                     self._invoke_remote(stream, frame, node)
                     return   # frame paused; response resumes it
+                i += 1
             self._complete_frame(stream, frame)
         finally:
             stream.frame = None

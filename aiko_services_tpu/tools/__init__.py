@@ -1,0 +1,2 @@
+from .recorder import Recorder
+from .storage import Storage

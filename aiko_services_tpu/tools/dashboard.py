@@ -1,0 +1,187 @@
+"""Dashboard: terminal UI over the live service directory.
+
+Reference parity: ``/root/reference/src/aiko_services/main/dashboard.py:
+286-760`` — a services table fed by the ServicesCache, a live variable
+view via an ECConsumer on the selected service, and a log page fed by
+the service's ``…/log`` topic.  The reference uses asciimatics (not in
+this image); this implementation uses stdlib ``curses`` with the same
+page structure, plus a ``--headless`` snapshot mode that prints the
+directory once (scriptable, and usable in tests).
+
+Keys: ↑/↓ select service · ENTER variables page · L log page ·
+ESC/q back/quit.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional
+
+import click
+
+from ..runtime.process import default_process
+from ..runtime.service import ServiceFilter
+from ..registry.services_cache import services_cache_create_singleton
+from ..registry.share import ECConsumer
+
+REFRESH_SECONDS = 0.25   # 4 Hz, reference dashboard.py:224-226
+
+
+class DashboardState:
+    def __init__(self, process):
+        self.process = process
+        self.cache = services_cache_create_singleton(process)
+        self.selected = 0
+        self.page = "services"
+        self.variables: Dict = {}
+        self.logs: List[str] = []
+        self._consumer: Optional[ECConsumer] = None
+        self._log_topic: Optional[str] = None
+
+    def services(self):
+        return list(self.cache.services)
+
+    def select(self, index: int):
+        services = self.services()
+        if not services:
+            return
+        self.selected = max(0, min(index, len(services) - 1))
+
+    def open_variables(self):
+        services = self.services()
+        if not services:
+            return
+        fields = services[self.selected]
+        self.close_views()
+        self.variables = {}
+        self._consumer = ECConsumer(
+            self.process, self.variables, f"{fields.topic_path}/control")
+        self.page = "variables"
+
+    def open_log(self):
+        services = self.services()
+        if not services:
+            return
+        fields = services[self.selected]
+        self.close_views()
+        self.logs = []
+        self._log_topic = f"{fields.topic_path}/log"
+        self.process.add_message_handler(self._on_log, self._log_topic)
+        self.page = "log"
+
+    def _on_log(self, topic, payload):
+        self.logs.append(str(payload))
+        del self.logs[:-200]
+
+    def close_views(self):
+        if self._consumer is not None:
+            self._consumer.terminate()
+            self._consumer = None
+        if self._log_topic is not None:
+            self.process.remove_message_handler(self._on_log,
+                                                self._log_topic)
+            self._log_topic = None
+        self.page = "services"
+
+
+def _render(stdscr, state: DashboardState):
+    import curses
+    stdscr.erase()
+    height, width = stdscr.getmaxyx()
+    title = (f" aiko_services_tpu dashboard — {state.process.namespace} "
+             f"— {state.cache.state} ")
+    stdscr.addnstr(0, 0, title.ljust(width), width - 1,
+                   curses.A_REVERSE)
+    if state.page == "services":
+        header = f"  {'SERVICE':24} {'PROTOCOL':20} {'TOPIC PATH':30}"
+        stdscr.addnstr(1, 0, header, width - 1, curses.A_BOLD)
+        for i, fields in enumerate(state.services()[:height - 3]):
+            attr = curses.A_REVERSE if i == state.selected else 0
+            line = (f"  {fields.name:24.24} "
+                    f"{(fields.protocol or '-'):20.20} "
+                    f"{fields.topic_path:30.30}")
+            stdscr.addnstr(2 + i, 0, line, width - 1, attr)
+        footer = " ↑/↓ select · ENTER variables · L log · Q quit"
+    elif state.page == "variables":
+        stdscr.addnstr(1, 0, "  VARIABLE = VALUE", width - 1,
+                       curses.A_BOLD)
+        items = sorted(_flatten(state.variables))[:height - 3]
+        for i, (key, value) in enumerate(items):
+            stdscr.addnstr(2 + i, 0, f"  {key} = {value}", width - 1)
+        footer = " ESC back · Q quit"
+    else:
+        stdscr.addnstr(1, 0, "  LOG", width - 1, curses.A_BOLD)
+        for i, line in enumerate(state.logs[-(height - 3):]):
+            stdscr.addnstr(2 + i, 0, f"  {line}", width - 1)
+        footer = " ESC back · Q quit"
+    stdscr.addnstr(height - 1, 0, footer.ljust(width - 1), width - 1,
+                   curses.A_REVERSE)
+    stdscr.refresh()
+
+
+def _flatten(tree, prefix=""):
+    for key, value in tree.items():
+        if isinstance(value, dict):
+            yield from _flatten(value, f"{prefix}{key}.")
+        else:
+            yield f"{prefix}{key}", value
+
+
+def run_dashboard(stdscr, process):
+    import curses
+    curses.curs_set(0)
+    stdscr.nodelay(True)
+    state = DashboardState(process)
+    while True:
+        _render(stdscr, state)
+        deadline = time.time() + REFRESH_SECONDS
+        while time.time() < deadline:
+            key = stdscr.getch()
+            if key == -1:
+                time.sleep(0.02)
+                continue
+            if key in (ord("q"), ord("Q")):
+                return
+            if state.page == "services":
+                if key == curses.KEY_UP:
+                    state.select(state.selected - 1)
+                elif key == curses.KEY_DOWN:
+                    state.select(state.selected + 1)
+                elif key in (10, 13, curses.KEY_ENTER):
+                    state.open_variables()
+                elif key in (ord("l"), ord("L")):
+                    state.open_log()
+            elif key == 27:   # ESC
+                state.close_views()
+            break
+
+
+@click.command()
+@click.option("--headless", is_flag=True,
+              help="Print one directory snapshot and exit")
+@click.option("--wait", default=3.0, type=float,
+              help="Seconds to wait for the directory in headless mode")
+def main(headless, wait):
+    process = default_process()
+    thread = process.run(in_thread=True)
+    if headless:
+        cache = services_cache_create_singleton(process)
+        deadline = time.time() + wait
+        while time.time() < deadline and cache.state != "loaded":
+            time.sleep(0.05)
+        print(f"directory state: {cache.state}")
+        for fields in cache.services:
+            print(f"{fields.topic_path:32} {fields.name:24} "
+                  f"{fields.protocol or '-'}")
+        process.terminate()
+        return
+    import curses
+    try:
+        state_process = process
+        curses.wrapper(run_dashboard, state_process)
+    finally:
+        process.terminate()
+
+
+if __name__ == "__main__":
+    main()

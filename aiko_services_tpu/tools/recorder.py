@@ -1,0 +1,56 @@
+"""Recorder: aggregates distributed log topics into ring buffers exposed
+as an EC share.
+
+Reference parity: ``/root/reference/src/aiko_services/main/recorder.py:
+50-96``.  Subscribes ``{namespace}/+/+/+/log``, keeps an LRU of
+per-topic rings, republishes counts/last-lines into its own share for
+the Dashboard.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Deque, Dict
+
+from ..utils.lru_cache import LRUCache
+from ..runtime.actor import Actor
+from ..runtime.context import actor_args
+
+__all__ = ["Recorder"]
+
+RING_SIZE = 128
+TOPIC_CACHE_SIZE = 64
+
+
+class Recorder(Actor):
+    def __init__(self, context=None, process=None):
+        context = context or actor_args("recorder", protocol="recorder:0")
+        super().__init__(context, process)
+        self.rings: LRUCache = LRUCache(TOPIC_CACHE_SIZE)
+        self._log_pattern = f"{self.process.namespace}/+/+/+/log"
+        self.process.add_message_handler(self._log_handler,
+                                         self._log_pattern)
+        self.share["log_topics"] = 0
+
+    def _log_handler(self, topic: str, payload: str):
+        ring: Deque = self.rings.get(topic)
+        if ring is None:
+            ring = deque(maxlen=RING_SIZE)
+            self.rings.put(topic, ring)
+            if self.ec_producer:
+                self.ec_producer.update("log_topics", len(self.rings))
+        ring.append(payload)
+        if self.ec_producer:
+            # Terse topic: host/pid/sid.
+            terse = "/".join(topic.split("/")[1:4])
+            self.ec_producer.update(f"last_log.{terse.replace('/', '_')}",
+                                    payload[-120:])
+
+    def get_log(self, topic: str) -> list:
+        ring = self.rings.get(topic)
+        return list(ring) if ring else []
+
+    def stop(self):
+        self.process.remove_message_handler(self._log_handler,
+                                            self._log_pattern)
+        super().stop()

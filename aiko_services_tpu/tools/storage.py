@@ -1,0 +1,73 @@
+"""Storage actor: sqlite-backed persistent key/value store with the
+framework's request/response idiom.
+
+Reference parity: ``/root/reference/src/aiko_services/main/storage.py:
+49-103``.  Request: publish ``(put key value)`` / ``(get response_topic
+key)`` / ``(keys response_topic)`` to the actor's ``…/in``; responses
+arrive on the caller-chosen response topic as ``(item_count N)`` followed
+by N ``(item key value)`` messages — the same shape the EC share and
+registrar queries use.
+"""
+
+from __future__ import annotations
+
+import sqlite3
+from typing import Optional
+
+from ..utils.sexpr import generate
+from ..runtime.actor import Actor
+from ..runtime.context import actor_args
+
+__all__ = ["Storage"]
+
+
+class Storage(Actor):
+    def __init__(self, context=None, process=None,
+                 database_pathname: str = ":memory:"):
+        context = context or actor_args("storage", protocol="storage:0")
+        super().__init__(context, process)
+        self._connection = sqlite3.connect(database_pathname,
+                                           check_same_thread=False)
+        self._connection.execute(
+            "CREATE TABLE IF NOT EXISTS store "
+            "(key TEXT PRIMARY KEY, value TEXT)")
+        self.share["database"] = database_pathname
+
+    # -- wire commands -------------------------------------------------------- #
+
+    def put(self, key, value):
+        with self._connection:
+            self._connection.execute(
+                "INSERT INTO store (key, value) VALUES (?, ?) "
+                "ON CONFLICT(key) DO UPDATE SET value = excluded.value",
+                (str(key), str(value)))
+
+    def delete(self, key):
+        with self._connection:
+            self._connection.execute("DELETE FROM store WHERE key = ?",
+                                     (str(key),))
+
+    def get(self, response_topic, key):
+        row = self._connection.execute(
+            "SELECT value FROM store WHERE key = ?",
+            (str(key),)).fetchone()
+        publish = self.process.message.publish
+        if row is None:
+            publish(str(response_topic), generate("item_count", ["0"]))
+        else:
+            publish(str(response_topic), generate("item_count", ["1"]))
+            publish(str(response_topic),
+                    generate("item", [str(key), row[0]]))
+
+    def keys(self, response_topic):
+        rows = self._connection.execute(
+            "SELECT key FROM store ORDER BY key").fetchall()
+        publish = self.process.message.publish
+        publish(str(response_topic),
+                generate("item_count", [str(len(rows))]))
+        for (key,) in rows:
+            publish(str(response_topic), generate("item", [key]))
+
+    def stop(self):
+        self._connection.close()
+        super().stop()

@@ -6,11 +6,18 @@ must be set before jax initializes, hence the top-of-file placement.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# The sandbox pins JAX_PLATFORMS=axon via the environment and a
+# sitecustomize hook, so plain env overrides are ignored; force the CPU
+# backend through jax.config (works post-import, pre-backend-init) and an
+# 8-device virtual host platform for mesh tests.
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
     os.environ["XLA_FLAGS"] = (
         xla_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 

@@ -1,0 +1,214 @@
+"""TPU execution layer tests: stage fusion, device-resident swag, ML
+elements inside pipelines (CPU backend, tiny configs)."""
+
+import queue
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from aiko_services_tpu.pipeline import Pipeline, parse_pipeline_definition
+from aiko_services_tpu.runtime import (
+    Process, compose_instance, pipeline_args,
+)
+
+TPU_MODULE = "tests.tpu_elements"
+ML_MODULE = "aiko_services_tpu.elements"
+
+
+def element(name, cls, inputs, outputs, parameters=None,
+            module=TPU_MODULE):
+    return {
+        "name": name,
+        "input": [{"name": n, "type": t} for n, t in inputs],
+        "output": [{"name": n, "type": t} for n, t in outputs],
+        "parameters": parameters or {},
+        "deploy": {"local": {"module": module, "class_name": cls}},
+    }
+
+
+def make_pipeline(engine, document, pid="1", broker="tpu"):
+    process = Process(namespace="test", hostname="h", pid=pid,
+                      engine=engine, broker=broker)
+    definition = parse_pipeline_definition(document)
+    return compose_instance(
+        Pipeline, pipeline_args(definition.name, definition=definition),
+        process=process)
+
+
+def run_one(engine, pipeline, frame, stream_id="s"):
+    out = queue.Queue()
+    pipeline.create_stream(stream_id, queue_response=out)
+    pipeline.post_frame(stream_id, frame)
+    engine.drain()
+    return out.get_nowait()[2]
+
+
+def test_contiguous_tpu_elements_fuse(engine):
+    doc = {
+        "version": 0, "name": "p_fuse", "runtime": "tpu",
+        "graph": ["(TE_Scale TE_Bias TE_Relu)"],
+        "elements": [
+            element("TE_Scale", "TE_Scale", [("x", "array")],
+                    [("x", "array")], {"factor": 3.0}),
+            element("TE_Bias", "TE_Bias", [("x", "array")],
+                    [("x", "array")], {"bias": -5.0}),
+            element("TE_Relu", "TE_Relu", [("x", "array")],
+                    [("x", "array")]),
+        ],
+    }
+    pipeline = make_pipeline(engine, doc)
+    # One fused stage covering all three elements.
+    assert list(pipeline._fused_stages) == ["TE_Scale"]
+    stage = pipeline._fused_stages["TE_Scale"]
+    assert stage.node_names == ["TE_Scale", "TE_Bias", "TE_Relu"]
+
+    result = run_one(engine, pipeline, {"x": jnp.asarray([1.0, 2.0, 3.0])})
+    np.testing.assert_allclose(np.asarray(result["x"]),
+                               [0.0, 1.0, 4.0])   # relu(3x - 5)
+    # Metrics show ONE fused timing entry, not three element entries.
+    # (frame is gone; assert via stage name only)
+
+
+def test_fused_stage_coerces_lists_and_output_precedence(engine):
+    """A plain Python list input (JSON/CLI frame data) must work fused,
+    and computed outputs must beat stale passthrough values of the same
+    name — matching non-fused semantics."""
+    doc = {
+        "version": 0, "name": "p_coerce", "runtime": "tpu",
+        "graph": ["(TE_Scale TE_Bias)"],
+        "elements": [
+            element("TE_Scale", "TE_Scale", [("x", "array")],
+                    [("x", "array")], {"factor": 2.0}),
+            element("TE_Bias", "TE_Bias", [("x", "array")],
+                    [("x", "array")], {"bias": 1.0}),
+        ],
+    }
+    pipeline = make_pipeline(engine, doc, broker="coerce")
+    result = run_one(engine, pipeline,
+                     {"x": [1.0, 2.0], "note": "passthrough"})
+    np.testing.assert_allclose(np.asarray(result["x"]), [3.0, 5.0])
+    # Stage-level: passthrough survives, computed outputs win over stale
+    # same-name values.
+    stage = pipeline._fused_stages["TE_Scale"]
+    out = stage({"x": [1.0], "note": "kept"})
+    assert out["note"] == "kept"
+    np.testing.assert_allclose(np.asarray(out["x"]), [3.0])
+
+
+def test_python_element_breaks_fusion(engine):
+    doc = {
+        "version": 0, "name": "p_break", "runtime": "tpu",
+        "graph": ["(TE_Scale PE_Collect TE_Bias TE_Relu)"],
+        "elements": [
+            element("TE_Scale", "TE_Scale", [("x", "array")],
+                    [("x", "array")]),
+            element("PE_Collect", "PE_Collect", [("x", "array")],
+                    [("x", "array")], module="tests.pipeline_elements"),
+            element("TE_Bias", "TE_Bias", [("x", "array")],
+                    [("x", "array")]),
+            element("TE_Relu", "TE_Relu", [("x", "array")],
+                    [("x", "array")]),
+        ],
+    }
+    pipeline = make_pipeline(engine, doc, broker="brk")
+    # Only the TE_Bias+TE_Relu tail fuses (length-2 run).
+    assert list(pipeline._fused_stages) == ["TE_Bias"]
+
+
+def test_fused_stage_respects_input_mapping(engine):
+    doc = {
+        "version": 0, "name": "p_map", "runtime": "tpu",
+        "graph": ["(TE_Scale (TE_Renamed (y: x)))"],
+        "elements": [
+            element("TE_Scale", "TE_Scale", [("x", "array")],
+                    [("x", "array")], {"factor": 2.0}),
+            element("TE_Renamed", "TE_Renamed", [("y", "array")],
+                    [("z", "array")]),
+        ],
+    }
+    pipeline = make_pipeline(engine, doc, broker="map")
+    result = run_one(engine, pipeline, {"x": jnp.asarray([1.0])})
+    np.testing.assert_allclose(np.asarray(result["z"]), [20.0])
+
+
+def test_runtime_python_does_not_fuse(engine):
+    doc = {
+        "version": 0, "name": "p_nofuse", "runtime": "python",
+        "graph": ["(TE_Scale TE_Bias)"],
+        "elements": [
+            element("TE_Scale", "TE_Scale", [("x", "array")],
+                    [("x", "array")]),
+            element("TE_Bias", "TE_Bias", [("x", "array")],
+                    [("x", "array")]),
+        ],
+    }
+    pipeline = make_pipeline(engine, doc, broker="nf")
+    assert pipeline._fused_stages == {}
+    result = run_one(engine, pipeline, {"x": jnp.asarray([2.0])})
+    np.testing.assert_allclose(np.asarray(result["x"]), [5.0])
+
+
+def test_classifier_element_in_pipeline(engine):
+    doc = {
+        "version": 0, "name": "p_cls", "runtime": "tpu",
+        "graph": ["(TextClassifierElement)"],
+        "elements": [
+            element("TextClassifierElement", "TextClassifierElement",
+                    [("tokens", "array")],
+                    [("logits", "array"), ("label_id", "array")],
+                    {"model_config": "tiny"}, module=ML_MODULE),
+        ],
+    }
+    pipeline = make_pipeline(engine, doc, broker="cls")
+    tokens = np.zeros((2, 16), np.int32)
+    result = run_one(engine, pipeline, {"tokens": tokens})
+    assert result["logits"].shape == (2, 2)
+    assert result["label_id"].shape == (2,)
+
+
+def test_llama_chat_element_generates(engine):
+    doc = {
+        "version": 0, "name": "p_chat", "runtime": "python",
+        "graph": ["(LlamaChatElement)"],
+        "elements": [
+            element("LlamaChatElement", "LlamaChatElement",
+                    [("tokens", "array")],
+                    [("tokens_out", "array"),
+                     ("tokens_per_second", "float")],
+                    {"model_config": "tiny", "max_new_tokens": 4},
+                    module=ML_MODULE),
+        ],
+    }
+    pipeline = make_pipeline(engine, doc, broker="chat")
+    prompt = np.arange(8, dtype=np.int32)[None]
+    result = run_one(engine, pipeline, {"tokens": prompt})
+    assert result["tokens_out"].shape == (1, 12)     # 8 prompt + 4 new
+    assert float(result["tokens_per_second"]) > 0
+    # Prompt is preserved verbatim at the front.
+    np.testing.assert_array_equal(np.asarray(result["tokens_out"])[0, :8],
+                                  prompt[0])
+
+
+def test_detector_element(engine):
+    doc = {
+        "version": 0, "name": "p_det", "runtime": "tpu",
+        "graph": ["(ImageNormalize DetectorElement)"],
+        "elements": [
+            element("ImageNormalize", "ImageNormalize",
+                    [("image", "array")], [("image", "array")],
+                    module=ML_MODULE),
+            element("DetectorElement", "DetectorElement",
+                    [("image", "array")],
+                    [("boxes", "array"), ("scores", "array"),
+                     ("classes", "array"), ("keep", "array")],
+                    {"model_config": "tiny"}, module=ML_MODULE),
+        ],
+    }
+    pipeline = make_pipeline(engine, doc, broker="det")
+    # Fusion: normalize + detector = one compiled program.
+    assert list(pipeline._fused_stages) == ["ImageNormalize"]
+    image = np.random.randint(0, 255, (1, 64, 64, 3), np.uint8)
+    result = run_one(engine, pipeline, {"image": image})
+    assert result["boxes"].shape[-1] == 4
+    assert result["scores"].shape == result["classes"].shape

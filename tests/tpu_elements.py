@@ -1,0 +1,35 @@
+"""Fusable TpuElements for stage-fusion tests."""
+
+import jax.numpy as jnp
+
+from aiko_services_tpu.pipeline.tpu_stage import TpuElement
+
+
+class TE_Scale(TpuElement):
+    def init_params(self, key):
+        factor, _ = self.get_parameter("factor", 2.0)
+        return {"factor": jnp.float32(factor)}
+
+    def compute(self, params, inputs):
+        return {"x": inputs["x"] * params["factor"]}
+
+
+class TE_Bias(TpuElement):
+    def init_params(self, key):
+        bias, _ = self.get_parameter("bias", 1.0)
+        return {"bias": jnp.float32(bias)}
+
+    def compute(self, params, inputs):
+        return {"x": inputs["x"] + params["bias"]}
+
+
+class TE_Relu(TpuElement):
+    def compute(self, params, inputs):
+        return {"x": jnp.maximum(inputs["x"], 0.0)}
+
+
+class TE_Renamed(TpuElement):
+    """Consumes input 'y' (mapped from swag 'x' via edge properties)."""
+
+    def compute(self, params, inputs):
+        return {"z": inputs["y"] * 10.0}
