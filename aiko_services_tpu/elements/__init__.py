@@ -10,3 +10,8 @@ from .image_io import (
     ImageReadFile, ImageResize, ImageOverlay, ImageWriteFile, ImageOutput,
 )
 from .video_io import VideoReadFile, VideoSample, VideoWriteFile, VideoOutput
+from .audio_io import (
+    AudioReadFile, AudioFraming, AudioResampler, AudioFFT,
+    RemoteSend, RemoteReceive,
+)
+from .ml import ASRElement, VisionEncoderElement

@@ -1,0 +1,185 @@
+"""Audio pipeline elements.
+
+Reference parity: ``/root/reference/src/aiko_services/elements/media/
+audio_io.py`` — AudioReadFile, PE_AudioFraming (sliding-window concat),
+PE_AudioResampler, PE_FFT, RemoteSend/RemoteReceive (bulk tensors as
+zlib'd ``np.save`` bytes on raw binary side-channel topics,
+audio_io.py:537-602), microphone elements (gated: pyaudio/sounddevice
+are not in this image).
+"""
+
+from __future__ import annotations
+
+import io
+import zlib
+from collections import deque
+from typing import Optional
+
+import numpy as np
+
+from ..pipeline.element import PipelineElement
+from ..pipeline.stream import StreamEvent
+from .common_io import DataSource
+
+__all__ = ["AudioReadFile", "AudioFraming", "AudioResampler", "AudioFFT",
+           "RemoteSend", "RemoteReceive"]
+
+
+class AudioReadFile(DataSource):
+    """``data_sources`` WAV files → frames of ``{"audio": (samples,) f32,
+    "sample_rate": int}`` (stdlib ``wave``; no external deps)."""
+
+    def process_frame(self, stream, paths):
+        import wave
+        audios, rates = [], []
+        for path in paths:
+            try:
+                with wave.open(path, "rb") as w:
+                    rates.append(w.getframerate())
+                    raw = w.readframes(w.getnframes())
+                    width = w.getsampwidth()
+                    if width == 1:
+                        # WAV 8-bit PCM is UNSIGNED (silence = 0x80).
+                        audio = (np.frombuffer(raw, np.uint8)
+                                 .astype(np.float32) - 128.0) / 128.0
+                    elif width in (2, 4):
+                        dtype = np.int16 if width == 2 else np.int32
+                        audio = np.frombuffer(raw, dtype) \
+                            .astype(np.float32)
+                        audio /= float(np.iinfo(dtype).max)
+                    else:
+                        self.logger.error(
+                            "%s: unsupported WAV sample width %d in %s",
+                            self.my_id(stream), width, path)
+                        return StreamEvent.ERROR, {}
+                    if w.getnchannels() > 1:
+                        audio = audio.reshape(-1, w.getnchannels()) \
+                            .mean(axis=1)
+                    audios.append(audio)
+            except (OSError, wave.Error) as error:
+                self.logger.error("%s: %s", self.my_id(stream), error)
+                return StreamEvent.ERROR, {}
+        if len(set(rates)) > 1:
+            self.logger.error(
+                "%s: batched files have mixed sample rates %s — "
+                "resample individually first", self.my_id(stream),
+                sorted(set(rates)))
+            return StreamEvent.ERROR, {}
+        audio = np.concatenate(audios) if audios else np.zeros(0,
+                                                               np.float32)
+        rate = rates[0] if rates else 16_000
+        return StreamEvent.OKAY, {"audio": audio, "sample_rate": rate}
+
+
+class AudioFraming(PipelineElement):
+    """Sliding-window concatenation: keeps the last ``window_count``
+    audio chunks and emits their concatenation (reference
+    speech_elements.py:54-83 LRU framing)."""
+
+    def process_frame(self, stream, audio):
+        count, _ = self.get_parameter("window_count", 4, stream=stream)
+        # Keyed by element name: two AudioFraming instances on one
+        # stream keep independent windows.
+        window: deque = stream.variables.setdefault(
+            f"{self.name}.window", deque(maxlen=int(count)))
+        window.append(np.asarray(audio, np.float32))
+        return StreamEvent.OKAY, {"audio": np.concatenate(list(window))}
+
+
+class AudioResampler(PipelineElement):
+    """Linear resample ``audio`` from ``sample_rate`` to ``target_rate``."""
+
+    def process_frame(self, stream, audio, sample_rate=16_000):
+        target, _ = self.get_parameter("target_rate", 16_000,
+                                       stream=stream)
+        source = int(sample_rate)
+        target = int(target)
+        audio = np.asarray(audio, np.float32)
+        if source == target or audio.size == 0:
+            return StreamEvent.OKAY, {"audio": audio,
+                                      "sample_rate": target}
+        duration = audio.shape[-1] / source
+        n_out = int(duration * target)
+        positions = np.linspace(0, audio.shape[-1] - 1, n_out)
+        resampled = np.interp(positions, np.arange(audio.shape[-1]),
+                              audio).astype(np.float32)
+        return StreamEvent.OKAY, {"audio": resampled,
+                                  "sample_rate": target}
+
+
+class AudioFFT(PipelineElement):
+    """Magnitude spectrum of the audio frame."""
+
+    def process_frame(self, stream, audio):
+        spectrum = np.abs(np.fft.rfft(np.asarray(audio, np.float32)))
+        return StreamEvent.OKAY, {"spectrum": spectrum.astype(np.float32)}
+
+
+def _pack(array: np.ndarray) -> bytes:
+    buffer = io.BytesIO()
+    np.save(buffer, np.asarray(array), allow_pickle=False)
+    return zlib.compress(buffer.getvalue())
+
+
+def _unpack(blob: bytes) -> np.ndarray:
+    return np.load(io.BytesIO(zlib.decompress(blob)), allow_pickle=False)
+
+
+class RemoteSend(PipelineElement):
+    """Publish an array swag value as zlib'd np.save bytes on a raw
+    binary topic (``topic`` parameter) — the bulk-data side-channel
+    pattern for off-pod hops."""
+
+    def process_frame(self, stream, **inputs):
+        topic, found = self.get_parameter("topic", stream=stream)
+        key, _ = self.get_parameter("swag_key", "audio", stream=stream)
+        if not found or key not in inputs:
+            self.logger.error("%s: needs topic parameter and %r input",
+                              self.my_id(stream), key)
+            return StreamEvent.ERROR, {}
+        self.process.message.publish(str(topic), _pack(inputs[key]))
+        return StreamEvent.OKAY, dict(inputs)
+
+
+class RemoteReceive(PipelineElement):
+    """Source: subscribes a binary topic; each received blob becomes a
+    frame ``{swag_key: array}``.  Subscription state is per stream, so
+    several streams (each with its own topic parameter) coexist."""
+
+    def __init__(self, context, process=None):
+        super().__init__(context, process)
+        self._receivers: dict = {}   # stream_id -> (handler, topic)
+
+    def start_stream(self, stream, stream_id):
+        topic, found = self.get_parameter("topic", stream=stream)
+        if not found:
+            self.logger.error("%s: topic parameter required",
+                              self.my_id(stream))
+            return StreamEvent.ERROR, None
+        topic = str(topic)
+        key, _ = self.get_parameter("swag_key", "audio", stream=stream)
+        key = str(key)
+        target_stream_id = stream.stream_id
+
+        def handler(topic_, payload):
+            try:
+                array = _unpack(payload)
+            except Exception:  # noqa: BLE001 - bad blob: drop
+                self.logger.exception("%s: undecodable blob",
+                                      self.my_id())
+                return
+            self.pipeline.post_frame(target_stream_id, {key: array})
+
+        self._receivers[str(stream_id)] = (handler, topic)
+        self.process.add_message_handler(handler, topic, binary=True)
+        return StreamEvent.OKAY, None
+
+    def stop_stream(self, stream, stream_id):
+        entry = self._receivers.pop(str(stream_id), None)
+        if entry:
+            handler, topic = entry
+            self.process.remove_message_handler(handler, topic)
+        return StreamEvent.OKAY, None
+
+    def process_frame(self, stream, **inputs):
+        return StreamEvent.OKAY, dict(inputs)

@@ -15,15 +15,17 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..models import asr as asr_model
 from ..models import classifier as classifier_model
 from ..models import detector as detector_model
 from ..models import llama as llama_model
+from ..models import vision as vision_model
 from ..pipeline.element import PipelineElement
 from ..pipeline.stream import StreamEvent
 from ..pipeline.tpu_stage import TpuElement
 
 __all__ = ["TextClassifierElement", "DetectorElement", "LlamaChatElement",
-           "ImageNormalize"]
+           "ImageNormalize", "ASRElement", "VisionEncoderElement"]
 
 
 class ImageNormalize(TpuElement):
@@ -62,6 +64,47 @@ class DetectorElement(TpuElement):
             raw, self.config)
         return {"boxes": boxes, "scores": scores, "classes": classes,
                 "keep": keep}
+
+
+class ASRElement(PipelineElement):
+    """Speech → token ids: ``audio`` (samples,) f32 →
+    ``text_tokens`` (batch, ≤max_tokens) via the Whisper-architecture
+    encoder-decoder (mel → encode → greedy scan decode, all jitted)."""
+
+    def __init__(self, context, process=None):
+        super().__init__(context, process)
+        name, _ = self.get_parameter("model_config", "tiny")
+        self.config = asr_model.CONFIGS[str(name)]
+        seed, _ = self.get_parameter("seed", 0)
+        self.params = asr_model.init_params(
+            self.config, jax.random.PRNGKey(int(seed)))
+
+    def process_frame(self, stream, audio):
+        audio = np.asarray(audio, np.float32)
+        if audio.ndim == 1:
+            audio = audio[None]
+        mel = asr_model.log_mel_spectrogram(audio, self.config.n_mels)
+        features = asr_model.encode(self.params, mel, self.config)
+        max_tokens, _ = self.get_parameter("max_tokens", 16,
+                                           stream=stream)
+        tokens = asr_model.decode_greedy(self.params, features,
+                                         self.config,
+                                         max_tokens=int(max_tokens))
+        return StreamEvent.OKAY, {"text_tokens": tokens}
+
+
+class VisionEncoderElement(TpuElement):
+    """``image`` (batch, H, W, 3) float [0,1] → CLIP-style ``embedding``
+    + ``patch_features`` (fusable; the vision half of vision-LLM
+    fan-out graphs)."""
+
+    def init_params(self, key):
+        name, _ = self.get_parameter("model_config", "tiny")
+        self.config = vision_model.CONFIGS[str(name)]
+        return vision_model.init_params(self.config, key)
+
+    def compute(self, params, inputs):
+        return vision_model.encode(params, inputs["image"], self.config)
 
 
 class LlamaChatElement(PipelineElement):

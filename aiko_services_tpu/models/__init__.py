@@ -1,3 +1,5 @@
 from . import llama
 from . import classifier
 from . import detector
+from . import asr
+from . import vision

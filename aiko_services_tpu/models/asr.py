@@ -1,0 +1,200 @@
+"""Speech recognition: Whisper-architecture encoder-decoder.
+
+The speech→chat workload (BASELINE.json config 3; the reference calls
+WhisperX as an opaque library, ``examples/speech/speech_elements.py``).
+Whisper architecture: log-mel spectrogram → 2×conv subsampling →
+transformer encoder; transformer decoder with cross-attention generates
+text tokens autoregressively.  Pure functional JAX, bf16, sinusoidal
+encoder positions, learned decoder positions, scan-based greedy decode.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.attention import attention_reference
+
+__all__ = ["ASRConfig", "init_params", "encode", "decode_greedy",
+           "log_mel_spectrogram", "CONFIGS"]
+
+
+@dataclasses.dataclass(frozen=True)
+class ASRConfig:
+    n_mels: int = 80
+    n_audio_ctx: int = 1500       # encoder positions after subsampling
+    d_model: int = 384
+    n_heads: int = 6
+    n_encoder_layers: int = 4
+    n_decoder_layers: int = 4
+    vocab_size: int = 51_865      # whisper tokenizer size
+    n_text_ctx: int = 448
+    dtype: Any = jnp.bfloat16
+
+
+CONFIGS: Dict[str, ASRConfig] = {
+    "tiny": ASRConfig(n_mels=20, n_audio_ctx=64, d_model=64, n_heads=2,
+                      n_encoder_layers=2, n_decoder_layers=2,
+                      vocab_size=512, n_text_ctx=64),
+    "whisper_small": ASRConfig(n_mels=80, n_audio_ctx=1500, d_model=768,
+                               n_heads=12, n_encoder_layers=12,
+                               n_decoder_layers=12),
+}
+
+
+def _dense(key, shape, dtype):
+    return (jax.random.normal(key, shape, jnp.float32)
+            * shape[0] ** -0.5).astype(dtype)
+
+
+def _block_params(key, d, dtype, cross: bool):
+    keys = jax.random.split(key, 8)
+    block = {
+        "norm1": jnp.ones((d,), dtype),
+        "wqkv": _dense(keys[0], (d, 3 * d), dtype),
+        "wo": _dense(keys[1], (d, d), dtype),
+        "norm_mlp": jnp.ones((d,), dtype),
+        "w1": _dense(keys[2], (d, 4 * d), dtype),
+        "w2": _dense(keys[3], (4 * d, d), dtype),
+    }
+    if cross:
+        block.update({
+            "norm_cross": jnp.ones((d,), dtype),
+            "wq_cross": _dense(keys[4], (d, d), dtype),
+            "wkv_cross": _dense(keys[5], (d, 2 * d), dtype),
+            "wo_cross": _dense(keys[6], (d, d), dtype),
+        })
+    return block
+
+
+def init_params(config: ASRConfig, key) -> Dict:
+    keys = jax.random.split(key, 8)
+    d, dt = config.d_model, config.dtype
+    encoder_layers = [
+        _block_params(jax.random.fold_in(keys[0], i), d, dt, cross=False)
+        for i in range(config.n_encoder_layers)]
+    decoder_layers = [
+        _block_params(jax.random.fold_in(keys[1], i), d, dt, cross=True)
+        for i in range(config.n_decoder_layers)]
+    return {
+        "conv1": _dense(keys[2], (3, config.n_mels, d), dt),
+        "conv2": _dense(keys[3], (3, d, d), dt),
+        "encoder_layers": encoder_layers,
+        "encoder_norm": jnp.ones((d,), dt),
+        "token_embed": _dense(keys[4], (config.vocab_size, d), dt),
+        "pos_embed": _dense(keys[5], (config.n_text_ctx, d), dt),
+        "decoder_layers": decoder_layers,
+        "decoder_norm": jnp.ones((d,), dt),
+    }
+
+
+from .common import layer_norm as _norm, mha as _mha, gelu_mlp
+
+
+def _mlp(block, x):
+    return gelu_mlp(x, block["norm_mlp"], block["w1"], block["w2"])
+
+
+def _sinusoid(length, channels):
+    position = jnp.arange(length)[:, None].astype(jnp.float32)
+    div = jnp.exp(-jnp.log(10000.0)
+                  * jnp.arange(0, channels, 2) / channels)
+    angles = position * div[None, :]
+    embedding = jnp.zeros((length, channels), jnp.float32)
+    embedding = embedding.at[:, 0::2].set(jnp.sin(angles))
+    embedding = embedding.at[:, 1::2].set(jnp.cos(angles))
+    return embedding
+
+
+def _conv1d(x, w, stride):
+    # x: (b, t, c_in), w: (k, c_in, c_out)
+    return jax.lax.conv_general_dilated(
+        x, w, window_strides=(stride,), padding="SAME",
+        dimension_numbers=("NWC", "WIO", "NWC"),
+        preferred_element_type=jnp.float32).astype(x.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("config",))
+def encode(params, mel, config: ASRConfig):
+    """mel (batch, frames, n_mels) → audio features
+    (batch, frames//2, d_model)."""
+    x = jax.nn.gelu(_conv1d(mel.astype(config.dtype), params["conv1"], 1)
+                    .astype(jnp.float32)).astype(config.dtype)
+    x = jax.nn.gelu(_conv1d(x, params["conv2"], 2)
+                    .astype(jnp.float32)).astype(config.dtype)
+    positions = _sinusoid(x.shape[1], config.d_model)
+    x = x + positions[None].astype(x.dtype)
+    for block in params["encoder_layers"]:
+        normed = _norm(x, block["norm1"])
+        x = x + _mha(normed, normed, block["wqkv"], block["wo"],
+                     config.n_heads, causal=False)
+        x = _mlp(block, x)
+    return _norm(x, params["encoder_norm"])
+
+
+def _decoder_step(params, tokens, audio_features, config: ASRConfig):
+    """Full-sequence decoder (teacher-forced or re-run per step)."""
+    b, t = tokens.shape
+    x = params["token_embed"][tokens] + params["pos_embed"][:t][None]
+    for block in params["decoder_layers"]:
+        normed = _norm(x, block["norm1"])
+        x = x + _mha(normed, normed, block["wqkv"], block["wo"],
+                     config.n_heads, causal=True)
+        normed = _norm(x, block["norm_cross"])
+        x = x + _mha(normed, audio_features, block["wq_cross"],
+                     block["wo_cross"], config.n_heads, causal=False,
+                     cross=True, wkv=block["wkv_cross"])
+        x = _mlp(block, x)
+    x = _norm(x, params["decoder_norm"])
+    return (x @ params["token_embed"].T).astype(jnp.float32)
+
+
+@functools.partial(jax.jit, static_argnames=("config", "max_tokens"))
+def decode_greedy(params, audio_features, config: ASRConfig,
+                  max_tokens: int = 32, start_token: int = 1,
+                  end_token: int = 2):
+    """Greedy transcription as one compiled program: fixed-length scan
+    with an is-done latch (XLA-friendly static shapes)."""
+    batch = audio_features.shape[0]
+    tokens = jnp.full((batch, max_tokens + 1), end_token, jnp.int32)
+    tokens = tokens.at[:, 0].set(start_token)
+
+    def body(carry, step):
+        tokens, done = carry
+        logits = _decoder_step(params, tokens[:, :max_tokens],
+                               audio_features, config)
+        next_token = logits[jnp.arange(batch), step].argmax(-1) \
+            .astype(jnp.int32)
+        next_token = jnp.where(done, end_token, next_token)
+        done = done | (next_token == end_token)
+        tokens = tokens.at[:, step + 1].set(next_token)
+        return (tokens, done), ()
+
+    (tokens, _), _ = jax.lax.scan(
+        body, (tokens, jnp.zeros((batch,), bool)),
+        jnp.arange(max_tokens, dtype=jnp.int32))
+    return tokens
+
+
+def log_mel_spectrogram(audio, n_mels: int, hop: int = 160,
+                        n_fft: int = 400):
+    """waveform (batch, samples) → log-mel (batch, frames, n_mels).
+    jnp implementation (rfft on device); mel filter is a fixed matrix."""
+    audio = jnp.asarray(audio, jnp.float32)
+    n_frames = max(1, (audio.shape[-1] - n_fft) // hop + 1)
+    idx = jnp.arange(n_fft)[None, :] + hop * jnp.arange(n_frames)[:, None]
+    frames = audio[..., idx] * jnp.hanning(n_fft)
+    spectrum = jnp.abs(jnp.fft.rfft(frames, axis=-1)) ** 2
+    bins = spectrum.shape[-1]
+    # Triangular mel filterbank (linear approximation adequate here).
+    centers = jnp.linspace(0, bins - 1, n_mels + 2)
+    filterbank = jnp.maximum(
+        0.0,
+        1.0 - jnp.abs(jnp.arange(bins)[None, :] - centers[1:-1, None])
+        / jnp.maximum(1.0, (centers[2:] - centers[:-2])[:, None] / 2))
+    mel = spectrum @ filterbank.T
+    return jnp.log10(jnp.maximum(mel, 1e-10))

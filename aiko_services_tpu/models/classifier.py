@@ -70,12 +70,7 @@ def init_params(config: ClassifierConfig, key) -> Dict:
     }
 
 
-def _norm(x, weight):
-    x32 = x.astype(jnp.float32)
-    mean = jnp.mean(x32, axis=-1, keepdims=True)
-    var = jnp.var(x32, axis=-1, keepdims=True)
-    return ((x32 - mean) * jax.lax.rsqrt(var + 1e-6)).astype(x.dtype) \
-        * weight
+from .common import layer_norm as _norm, mha as _mha, gelu_mlp
 
 
 @functools.partial(jax.jit, static_argnames=("config",))
@@ -83,18 +78,11 @@ def forward(params, tokens, config: ClassifierConfig):
     """tokens (batch, seq) int32 → logits (batch, n_classes) f32."""
     batch, seq = tokens.shape
     x = params["embed"][tokens] + params["pos_embed"][:seq][None]
-    h = config.n_heads
-    hd = config.d_model // h
     for layer in params["layers"]:
         normed = _norm(x, layer["norm1"])
-        qkv = (normed @ layer["wqkv"]).reshape(batch, seq, 3, h, hd)
-        q, k, v = (qkv[:, :, i].transpose(0, 2, 1, 3) for i in range(3))
-        out = attention_reference(q, k, v, causal=False)
-        out = out.transpose(0, 2, 1, 3).reshape(batch, seq, -1)
-        x = x + (out @ layer["wo"]).astype(x.dtype)
-        normed = _norm(x, layer["norm2"])
-        x = x + (jax.nn.gelu((normed @ layer["w1"]).astype(jnp.float32))
-                 .astype(x.dtype) @ layer["w2"])
+        x = x + _mha(normed, normed, layer["wqkv"], layer["wo"],
+                     config.n_heads, causal=False)
+        x = gelu_mlp(x, layer["norm2"], layer["w1"], layer["w2"])
     pooled = jnp.mean(x.astype(jnp.float32), axis=1)
     hidden = jnp.tanh(pooled @ params["head_w1"].astype(jnp.float32))
     return hidden @ params["head_w2"].astype(jnp.float32)

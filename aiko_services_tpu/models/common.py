@@ -1,0 +1,57 @@
+"""Shared model building blocks (LayerNorm-family encoders).
+
+Used by :mod:`.classifier`, :mod:`.asr`, :mod:`.vision` — one LayerNorm
+and one multi-head-attention plumbing implementation so numerics fixes
+apply everywhere.  (:mod:`.llama` uses RMSNorm/GQA and keeps its own
+blocks.)
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.attention import attention_reference
+
+__all__ = ["layer_norm", "mha", "gelu_mlp"]
+
+LN_EPS = 1e-6
+
+
+def layer_norm(x, weight, eps: float = LN_EPS):
+    x32 = x.astype(jnp.float32)
+    mean = jnp.mean(x32, axis=-1, keepdims=True)
+    var = jnp.var(x32, axis=-1, keepdims=True)
+    return ((x32 - mean) * jax.lax.rsqrt(var + eps)).astype(x.dtype) \
+        * weight
+
+
+def mha(x_q, x_kv, w_in, wo, n_heads: int, causal: bool,
+        cross: bool = False, wkv=None):
+    """Fused-projection multi-head attention.
+
+    Self-attention: ``w_in`` is the (d, 3d) qkv projection and ``x_kv``
+    is ignored.  Cross-attention (``cross=True``): ``w_in`` is the
+    (d, d) q projection and ``wkv`` the (d_kv, 2d) kv projection over
+    ``x_kv``.
+    """
+    b, q_len, d = x_q.shape
+    hd = d // n_heads
+    if cross:
+        q = (x_q @ w_in).reshape(b, q_len, n_heads, hd)
+        kv = (x_kv @ wkv).reshape(b, x_kv.shape[1], 2, n_heads, hd)
+        k, v = kv[:, :, 0], kv[:, :, 1]
+    else:
+        qkv = (x_q @ w_in).reshape(b, q_len, 3, n_heads, hd)
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+    out = attention_reference(
+        q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
+        v.transpose(0, 2, 1, 3), causal=causal)
+    out = out.transpose(0, 2, 1, 3).reshape(b, q_len, d)
+    return (out @ wo).astype(x_q.dtype)
+
+
+def gelu_mlp(x, norm_weight, w1, w2):
+    normed = layer_norm(x, norm_weight)
+    return x + (jax.nn.gelu((normed @ w1).astype(jnp.float32))
+                .astype(x.dtype) @ w2)

@@ -1,0 +1,103 @@
+"""Vision encoder: CLIP-architecture ViT producing image embeddings.
+
+The vision half of the CLIP→LLM fan-out workload (BASELINE.json
+config 5).  Patchify → transformer encoder → pooled, L2-normalized
+embedding; ``project_to_llm`` maps embeddings into an LLM's embedding
+space (the LLaVA-style bridge for vision-chat pipelines).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.attention import attention_reference
+
+__all__ = ["VisionConfig", "init_params", "encode", "CONFIGS"]
+
+
+@dataclasses.dataclass(frozen=True)
+class VisionConfig:
+    image_size: int = 224
+    patch_size: int = 16
+    d_model: int = 512
+    n_layers: int = 8
+    n_heads: int = 8
+    embed_dim: int = 512          # output embedding dimensionality
+    dtype: Any = jnp.bfloat16
+
+    @property
+    def n_patches(self) -> int:
+        return (self.image_size // self.patch_size) ** 2
+
+
+CONFIGS: Dict[str, VisionConfig] = {
+    "tiny": VisionConfig(image_size=32, patch_size=8, d_model=64,
+                         n_layers=2, n_heads=2, embed_dim=64),
+    "clip_base": VisionConfig(image_size=224, patch_size=16, d_model=768,
+                              n_layers=12, n_heads=12, embed_dim=512),
+}
+
+
+def init_params(config: VisionConfig, key) -> Dict:
+    keys = jax.random.split(key, config.n_layers + 4)
+    d, dt = config.d_model, config.dtype
+    patch_dim = 3 * config.patch_size ** 2
+
+    def dense(k, shape):
+        return (jax.random.normal(k, shape, jnp.float32)
+                * shape[0] ** -0.5).astype(dt)
+
+    layers = []
+    for i in range(config.n_layers):
+        lk = jax.random.split(keys[i], 4)
+        layers.append({
+            "norm1": jnp.ones((d,), dt),
+            "wqkv": dense(lk[0], (d, 3 * d)),
+            "wo": dense(lk[1], (d, d)),
+            "norm2": jnp.ones((d,), dt),
+            "w1": dense(lk[2], (d, 4 * d)),
+            "w2": dense(lk[3], (4 * d, d)),
+        })
+    return {
+        "patch_proj": dense(keys[-4], (patch_dim, d)),
+        "cls_token": jnp.zeros((1, 1, d), dt),
+        "pos_embed": dense(keys[-3], (config.n_patches + 1, d)),
+        "layers": layers,
+        "final_norm": jnp.ones((d,), dt),
+        "head": dense(keys[-2], (d, config.embed_dim)),
+    }
+
+
+from .common import layer_norm as _norm, mha as _mha, gelu_mlp
+
+
+@functools.partial(jax.jit, static_argnames=("config",))
+def encode(params, images, config: VisionConfig):
+    """images (batch, H, W, 3) float [0,1] → dict with ``embedding``
+    (batch, embed_dim) L2-normalized and ``patch_features``
+    (batch, n_patches+1, d_model) for LLaVA-style token bridges."""
+    b = images.shape[0]
+    p = config.patch_size
+    grid = config.image_size // p
+    x = images.astype(config.dtype)
+    x = x.reshape(b, grid, p, grid, p, 3).transpose(0, 1, 3, 2, 4, 5)
+    x = x.reshape(b, grid * grid, p * p * 3)
+    x = x @ params["patch_proj"]
+    cls = jnp.broadcast_to(params["cls_token"],
+                           (b, 1, config.d_model)).astype(x.dtype)
+    x = jnp.concatenate([cls, x], axis=1) + params["pos_embed"][None]
+    for layer in params["layers"]:
+        normed = _norm(x, layer["norm1"])
+        x = x + _mha(normed, normed, layer["wqkv"], layer["wo"],
+                     config.n_heads, causal=False)
+        x = gelu_mlp(x, layer["norm2"], layer["w1"], layer["w2"])
+    x = _norm(x, params["final_norm"])
+    embedding = (x[:, 0] @ params["head"]).astype(jnp.float32)
+    embedding = embedding / jnp.maximum(
+        jnp.linalg.norm(embedding, axis=-1, keepdims=True), 1e-6)
+    return {"embedding": embedding, "patch_features": x}

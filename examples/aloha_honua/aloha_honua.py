@@ -1,0 +1,40 @@
+#!/usr/bin/env python
+"""Minimal Actor example (reference parity:
+``examples/aloha_honua/aloha_honua_0.py``).
+
+Run:  python examples/aloha_honua/aloha_honua.py
+Then, from another shell sharing a real broker (or in-process here),
+publish ``(aloha Pele)`` to the actor's ``…/in`` topic.
+"""
+
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+from aiko_services_tpu.runtime import (            # noqa: E402
+    Actor, actor_args, compose_instance, default_process,
+)
+
+
+class AlohaHonua(Actor):
+    def aloha(self, name):
+        self.logger.info("Aloha %s!", name)
+        print(f"Aloha {name}!")
+
+
+def main():
+    process = default_process()
+    actor = compose_instance(AlohaHonua, actor_args("aloha_honua"),
+                             process=process)
+    print(f"AlohaHonua listening on {actor.topic_in}")
+    thread = process.run(in_thread=True)
+    # Demo: invoke it over the wire.
+    process.message.publish(actor.topic_in, "(aloha Pele)")
+    time.sleep(0.5)
+    process.terminate()
+    thread.join(timeout=2)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,122 @@
+#!/usr/bin/env python
+"""Multitude: the distributed pipeline load harness.
+
+Reference parity: ``examples/pipeline/multitude/run_large.sh`` — N
+chained pipelines, each hop crossing process boundaries, driven at a
+target frame rate; the reference's note says ~50 Hz was the "maximum
+frame rate before falling behind" for 10 chained pipelines.
+
+This harness builds the same chain topology with N simulated processes
+over the loopback broker (one OS process, N Process instances, shared
+event engine — the in-process equivalent) and measures the maximum
+sustained end-to-end frame rate.
+
+Run:  python examples/multitude/run_multitude.py [--pipelines 10]
+      [--frames 500]
+"""
+
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+import click                                        # noqa: E402
+
+from aiko_services_tpu.pipeline import (            # noqa: E402
+    Pipeline, parse_pipeline_definition,
+)
+from aiko_services_tpu.registry import Registrar    # noqa: E402
+from aiko_services_tpu.runtime import (             # noqa: E402
+    Process, compose_instance, pipeline_args,
+)
+from aiko_services_tpu.runtime.event import EventEngine  # noqa: E402
+
+MODULE = "tests.pipeline_elements"
+
+
+def chain_definition(index: int, total: int):
+    """Pipeline i: PE_Add -> (remote hop to pipeline i+1) or sink."""
+    elements = [{
+        "name": "PE_Add",
+        "input": [{"name": "i", "type": "int"}],
+        "output": [{"name": "i", "type": "int"}],
+        "parameters": {"amount": 1},
+        "deploy": {"local": {"module": MODULE, "class_name": "PE_Add"}},
+    }]
+    if index < total - 1:
+        elements.append({
+            "name": "PE_Next",
+            "input": [{"name": "i", "type": "int"}],
+            "output": [{"name": "i", "type": "int"}],
+            "deploy": {"remote": {"service_filter":
+                                  {"name": f"mt_{index + 1}"}}},
+        })
+        graph = ["(PE_Add PE_Next)"]
+    else:
+        graph = ["(PE_Add)"]
+    return {"version": 0, "name": f"mt_{index}", "runtime": "python",
+            "graph": graph, "elements": elements}
+
+
+@click.command()
+@click.option("--pipelines", default=10)
+@click.option("--frames", default=500)
+def main(pipelines, frames):
+    engine = EventEngine()
+    broker = "multitude"
+    registrar_process = Process(namespace="mt", hostname="h", pid="0",
+                                engine=engine, broker=broker)
+    registrar = Registrar(process=registrar_process)
+    thread = engine.run_in_thread()
+    while registrar.state != "primary":
+        time.sleep(0.05)
+
+    chain = []
+    for i in range(pipelines):
+        process = Process(namespace="mt", hostname="h", pid=str(i + 1),
+                          engine=engine, broker=broker)
+        definition = parse_pipeline_definition(
+            chain_definition(i, pipelines))
+        chain.append(compose_instance(
+            Pipeline, pipeline_args(f"mt_{i}", definition=definition),
+            process=process))
+
+    # Wait for every remote hop to resolve.
+    deadline = time.time() + 15
+    while time.time() < deadline:
+        if all(all(p is not None for p in pipe.remote_proxies.values())
+               for pipe in chain):
+            break
+        time.sleep(0.05)
+
+    head = chain[0]
+    head.create_stream("load")
+    # Completion detection: count tail pipeline's processed frames
+    # (streams auto-create down the chain on first frame).
+    tail = chain[-1]
+    start_count = tail._frames_processed
+
+    warmup = min(50, frames // 5)
+    for _ in range(warmup):
+        head.post_frame("load", {"i": 0})
+    while tail._frames_processed - start_count < warmup:
+        time.sleep(0.01)
+
+    start_count = tail._frames_processed
+    started = time.perf_counter()
+    for _ in range(frames):
+        head.post_frame("load", {"i": 0})
+    while tail._frames_processed - start_count < frames:
+        time.sleep(0.01)
+    elapsed = time.perf_counter() - started
+    rate = frames / elapsed
+    print(f"multitude: {pipelines} chained pipelines, "
+          f"{frames} frames end-to-end in {elapsed:.2f}s "
+          f"= {rate:.0f} frames/sec sustained "
+          f"(reference: ~50 Hz, run_large.sh:7,20)")
+    engine.terminate()
+    thread.join(timeout=2)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,197 @@
+"""Media elements + speech/vision model tests."""
+
+import queue
+import wave
+
+import jax
+import numpy as np
+import pytest
+
+from aiko_services_tpu.pipeline import Pipeline, parse_pipeline_definition
+from aiko_services_tpu.runtime import (
+    Process, compose_instance, pipeline_args,
+)
+
+E = "aiko_services_tpu.elements"
+
+
+def element(name, cls, inputs, outputs, parameters=None, module=E):
+    return {
+        "name": name,
+        "input": [{"name": n, "type": t} for n, t in inputs],
+        "output": [{"name": n, "type": t} for n, t in outputs],
+        "parameters": parameters or {},
+        "deploy": {"local": {"module": module, "class_name": cls}},
+    }
+
+
+def make_pipeline(engine, document, pid="1", broker="media"):
+    process = Process(namespace="test", hostname="h", pid=pid,
+                      engine=engine, broker=broker)
+    definition = parse_pipeline_definition(document)
+    return compose_instance(
+        Pipeline, pipeline_args(definition.name, definition=definition),
+        process=process)
+
+
+@pytest.fixture()
+def wav_file(tmp_path):
+    path = tmp_path / "test.wav"
+    rate = 16_000
+    t = np.linspace(0, 0.2, int(rate * 0.2))
+    audio = (np.sin(2 * np.pi * 440 * t) * 0.5 * 32767).astype(np.int16)
+    with wave.open(str(path), "wb") as w:
+        w.setnchannels(1)
+        w.setsampwidth(2)
+        w.setframerate(rate)
+        w.writeframes(audio.tobytes())
+    return str(path)
+
+
+def drain_until(engine, condition, pumps=200):
+    import time
+    for _ in range(pumps):
+        engine.drain()
+        if condition():
+            return True
+        time.sleep(0.01)
+    return False
+
+
+def test_audio_pipeline_wav_resample_fft(engine, wav_file):
+    doc = {
+        "version": 0, "name": "p_audio", "runtime": "python",
+        "graph": ["(AudioReadFile AudioResampler AudioFFT)"],
+        "elements": [
+            element("AudioReadFile", "AudioReadFile",
+                    [("paths", "[str]")],
+                    [("audio", "array"), ("sample_rate", "int")],
+                    {"data_sources": f"file://{wav_file}"}),
+            element("AudioResampler", "AudioResampler",
+                    [("audio", "array"), ("sample_rate", "int")],
+                    [("audio", "array"), ("sample_rate", "int")],
+                    {"target_rate": 8000}),
+            element("AudioFFT", "AudioFFT", [("audio", "array")],
+                    [("spectrum", "array")]),
+        ],
+    }
+    pipeline = make_pipeline(engine, doc)
+    out = queue.Queue()
+    pipeline.create_stream("a", queue_response=out)
+    assert drain_until(engine, lambda: not out.empty())
+    _, _, outputs = out.get()
+    spectrum = np.asarray(outputs["spectrum"])
+    # 440 Hz tone resampled to 8 kHz over 0.2 s -> peak near bin 88.
+    assert abs(int(spectrum.argmax()) - 88) <= 2
+
+
+def test_remote_send_receive_binary_side_channel(engine):
+    """Bulk tensor crossing between two pipelines over a raw binary
+    topic (np.save+zlib), no S-expression overhead."""
+    broker = "sidechan"
+    receiver_doc = {
+        "version": 0, "name": "p_rx", "runtime": "python",
+        "graph": ["(RemoteReceive)"],
+        "elements": [
+            element("RemoteReceive", "RemoteReceive", [],
+                    [("audio", "array")],
+                    {"topic": "bulk/audio", "swag_key": "audio"}),
+        ],
+    }
+    sender_doc = {
+        "version": 0, "name": "p_tx", "runtime": "python",
+        "graph": ["(RemoteSend)"],
+        "elements": [
+            element("RemoteSend", "RemoteSend", [("audio", "array")],
+                    [("audio", "array")],
+                    {"topic": "bulk/audio", "swag_key": "audio"}),
+        ],
+    }
+    rx = make_pipeline(engine, receiver_doc, pid="1", broker=broker)
+    tx = make_pipeline(engine, sender_doc, pid="2", broker=broker)
+    out = queue.Queue()
+    rx.create_stream("r", queue_response=out)
+    tx.create_stream("t")
+    payload = np.arange(1000, dtype=np.float32)
+    tx.post_frame("t", {"audio": payload})
+    assert drain_until(engine, lambda: not out.empty())
+    _, _, outputs = out.get()
+    np.testing.assert_array_equal(np.asarray(outputs["audio"]), payload)
+
+
+def test_audio_framing_sliding_window(engine):
+    doc = {
+        "version": 0, "name": "p_frame", "runtime": "python",
+        "graph": ["(AudioFraming)"],
+        "elements": [
+            element("AudioFraming", "AudioFraming", [("audio", "array")],
+                    [("audio", "array")], {"window_count": 3}),
+        ],
+    }
+    pipeline = make_pipeline(engine, doc, broker="framing")
+    out = queue.Queue()
+    pipeline.create_stream("f", queue_response=out)
+    lengths = []
+    for i in range(5):
+        pipeline.post_frame("f", {"audio": np.ones(10, np.float32) * i})
+        engine.drain()
+        lengths.append(len(np.asarray(out.get()[2]["audio"])))
+    assert lengths == [10, 20, 30, 30, 30]   # window caps at 3 chunks
+
+
+def test_asr_model_shapes():
+    from aiko_services_tpu.models import asr
+    config = asr.CONFIGS["tiny"]
+    params = asr.init_params(config, jax.random.PRNGKey(0))
+    audio = np.random.randn(1, 16_000).astype(np.float32)
+    mel = asr.log_mel_spectrogram(audio, config.n_mels)
+    assert mel.shape[0] == 1 and mel.shape[2] == config.n_mels
+    features = asr.encode(params, mel, config)
+    assert features.shape[2] == config.d_model
+    tokens = asr.decode_greedy(params, features, config, max_tokens=8)
+    assert tokens.shape == (1, 9)
+    assert int(tokens[0, 0]) == 1          # start token
+
+
+def test_vision_model_embedding():
+    from aiko_services_tpu.models import vision
+    config = vision.CONFIGS["tiny"]
+    params = vision.init_params(config, jax.random.PRNGKey(0))
+    images = np.random.rand(2, 32, 32, 3).astype(np.float32)
+    out = vision.encode(params, images, config)
+    assert out["embedding"].shape == (2, config.embed_dim)
+    norms = np.linalg.norm(np.asarray(out["embedding"]), axis=-1)
+    np.testing.assert_allclose(norms, 1.0, rtol=1e-3)
+    assert out["patch_features"].shape == (2, config.n_patches + 1,
+                                           config.d_model)
+
+
+def test_speech_to_chat_pipeline(engine, wav_file):
+    """The speech→chat 2-stage workload: audio → ASR tokens → LLM chat
+    (tiny configs, CPU)."""
+    doc = {
+        "version": 0, "name": "p_speech_chat", "runtime": "python",
+        "graph": ["(AudioReadFile ASRElement (LlamaChatElement "
+                  "(tokens: text_tokens)))"],
+        "elements": [
+            element("AudioReadFile", "AudioReadFile",
+                    [("paths", "[str]")],
+                    [("audio", "array"), ("sample_rate", "int")],
+                    {"data_sources": f"file://{wav_file}"}),
+            element("ASRElement", "ASRElement", [("audio", "array")],
+                    [("text_tokens", "array")],
+                    {"model_config": "tiny", "max_tokens": 6}),
+            element("LlamaChatElement", "LlamaChatElement",
+                    [("tokens", "array")],
+                    [("tokens_out", "array"),
+                     ("tokens_per_second", "float")],
+                    {"model_config": "tiny", "max_new_tokens": 4}),
+        ],
+    }
+    pipeline = make_pipeline(engine, doc, broker="speechchat")
+    out = queue.Queue()
+    pipeline.create_stream("s", queue_response=out)
+    assert drain_until(engine, lambda: not out.empty(), pumps=1000)
+    _, _, outputs = out.get()
+    tokens_out = np.asarray(outputs["tokens_out"])
+    assert tokens_out.shape[1] == 7 + 4    # ASR tokens (7) + 4 generated
