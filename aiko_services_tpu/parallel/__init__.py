@@ -1,2 +1,4 @@
 from .mesh import MeshSpec, make_mesh, named_sharding, logical_axis_rules
 from .ring_attention import ring_attention, ring_attention_sharded
+from .checkpoint import (TrainCheckpointer, StreamCheckpoint,
+                         save_stream_checkpoint, load_stream_checkpoint)

@@ -1,0 +1,216 @@
+"""Checkpoint / resume for model and training state.
+
+The reference framework has **no checkpointing** (SURVEY.md §5.4: the
+closest artifacts are the sqlite ``Storage`` actor skeleton at
+``main/storage.py:49-63`` and the ``Frame`` continuation at
+``main/stream.py:66-71``).  A TPU training/serving framework needs real
+checkpointing, so this subsystem is designed fresh:
+
+* orbax-backed, async-capable saves of arbitrary pytrees (params,
+  optimizer state, step counters, RNG keys);
+* **sharding-aware restore**: state saved from one mesh topology can be
+  restored onto a *different* mesh (e.g. save on dp=2×tp=4, resume on
+  dp=4×tp=2) — orbax reads each array's saved global shape and lays it
+  out according to the target ``NamedSharding``, so resume after an
+  elastic topology change is a first-class operation;
+* retention policy (``max_to_keep``) and step bookkeeping via
+  ``orbax.CheckpointManager``;
+* a host-side ``StreamCheckpoint`` record for the pipeline engine: the
+  reference's ``Frame`` is already "an explicit continuation able to
+  resume mid-graph" — we make that durable by snapshotting stream
+  parameters + swag (non-array entries) alongside the device state.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Any, Mapping, Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding
+
+__all__ = [
+    "TrainCheckpointer",
+    "StreamCheckpoint",
+    "save_stream_checkpoint",
+    "load_stream_checkpoint",
+]
+
+
+def _abstract_like(tree, mesh: Optional[Mesh], specs):
+    """Build a pytree of ShapeDtypeStructs carrying target shardings."""
+
+    def leaf(x, spec):
+        sharding = None
+        if mesh is not None and spec is not None:
+            sharding = NamedSharding(mesh, spec)
+        shape = getattr(x, "shape", ())
+        dtype = getattr(x, "dtype", None) or np.asarray(x).dtype
+        return jax.ShapeDtypeStruct(shape, dtype, sharding=sharding)
+
+    if specs is None:
+        return jax.tree.map(lambda x: leaf(x, None), tree)
+    # PartitionSpec is a pytree leaf, so a specs tree mirroring ``tree``'s
+    # structure (dicts, lists, optax NamedTuples alike) maps one-to-one.
+    return jax.tree.map(leaf, tree, specs)
+
+
+class TrainCheckpointer:
+    """Save/restore training state with step management.
+
+    Wraps ``orbax.checkpoint.CheckpointManager``.  State is a dict of
+    named pytrees, e.g. ``{"params": ..., "opt_state": ...}``; metadata
+    (pure-Python scalars) rides along as JSON.
+    """
+
+    def __init__(self, directory: str, *, max_to_keep: int = 3,
+                 save_interval_steps: int = 1):
+        import orbax.checkpoint as ocp
+        self._ocp = ocp
+        self._directory = os.path.abspath(directory)
+        os.makedirs(self._directory, exist_ok=True)
+        options = ocp.CheckpointManagerOptions(
+            max_to_keep=max_to_keep,
+            save_interval_steps=save_interval_steps,
+            enable_async_checkpointing=False)
+        self._manager = ocp.CheckpointManager(self._directory, options=options)
+
+    # -- save ---------------------------------------------------------
+
+    _RESERVED = frozenset({"metadata", "step"})
+
+    def save(self, step: int, state: Mapping[str, Any],
+             metadata: Optional[Mapping[str, Any]] = None) -> bool:
+        ocp = self._ocp
+        bad = self._RESERVED & set(state)
+        if bad:
+            raise ValueError(f"state names {sorted(bad)} are reserved")
+        items = {name: ocp.args.StandardSave(tree)
+                 for name, tree in state.items()}
+        if metadata is not None:
+            items["metadata"] = ocp.args.JsonSave(dict(metadata))
+        saved = self._manager.save(step, args=ocp.args.Composite(**items))
+        self._manager.wait_until_finished()
+        return saved
+
+    # -- restore ------------------------------------------------------
+
+    def restore(self, templates: Mapping[str, Any], *,
+                step: Optional[int] = None,
+                mesh: Optional[Mesh] = None,
+                specs: Optional[Mapping[str, Any]] = None):
+        """Restore state at ``step`` (default: latest).
+
+        ``templates`` gives a pytree per state name matching the saved
+        structure (shapes/dtypes; values are ignored).  When ``mesh``
+        and per-name partition ``specs`` are given, arrays are restored
+        directly into that sharding — this is how a checkpoint saved on
+        one topology resumes on another.
+        """
+        ocp = self._ocp
+        bad = self._RESERVED & set(templates)
+        if bad:
+            raise ValueError(f"state names {sorted(bad)} are reserved")
+        if step is None:
+            step = self.latest_step()
+        if step is None:
+            raise FileNotFoundError(
+                f"no checkpoint found under {self._directory}")
+        items = {}
+        for name, tree in templates.items():
+            spec_tree = None if specs is None else specs.get(name)
+            abstract = _abstract_like(tree, mesh, spec_tree)
+            items[name] = ocp.args.StandardRestore(abstract)
+        items["metadata"] = ocp.args.JsonRestore()
+        try:
+            restored = self._manager.restore(
+                step, args=ocp.args.Composite(**items))
+        except (FileNotFoundError, KeyError):
+            items.pop("metadata")
+            restored = self._manager.restore(
+                step, args=ocp.args.Composite(**items))
+        out = {name: restored[name] for name in templates}
+        out["metadata"] = restored.get("metadata") if hasattr(
+            restored, "get") else None
+        out["step"] = step
+        return out
+
+    def latest_step(self) -> Optional[int]:
+        return self._manager.latest_step()
+
+    def all_steps(self):
+        return sorted(self._manager.all_steps())
+
+    def close(self):
+        self._manager.close()
+
+
+# ---------------------------------------------------------------------------
+# Host-side pipeline stream checkpoints
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class StreamCheckpoint:
+    """Durable snapshot of a pipeline stream's host-side continuation.
+
+    Mirrors the reference's ``Stream``/``Frame`` continuation fields
+    (``main/stream.py:65-109``): enough to re-create the stream and
+    resume frame numbering after a process restart.
+    """
+    stream_id: str
+    frame_id: int
+    graph_path: Optional[str]
+    parameters: dict
+    variables: dict
+    swag: dict  # JSON-serializable swag entries only
+
+    def to_json(self) -> str:
+        return json.dumps(dataclasses.asdict(self))
+
+    @classmethod
+    def from_json(cls, text: str) -> "StreamCheckpoint":
+        return cls(**json.loads(text))
+
+
+def _json_safe(mapping: Mapping[str, Any]) -> dict:
+    out = {}
+    for key, value in mapping.items():
+        try:
+            json.dumps(value)
+        except (TypeError, ValueError):
+            continue
+        out[key] = value
+    return out
+
+
+def save_stream_checkpoint(directory: str, stream,
+                           swag: Optional[Mapping[str, Any]] = None) -> str:
+    """Snapshot ``stream`` (a pipeline ``Stream``) to ``directory``.
+
+    Array-valued swag entries belong in the model checkpoint (they are
+    device state); only JSON-representable entries are kept here.
+    """
+    os.makedirs(directory, exist_ok=True)
+    record = StreamCheckpoint(
+        stream_id=str(stream.stream_id),
+        frame_id=int(stream.frame_id),
+        graph_path=getattr(stream, "graph_path", None),
+        parameters=_json_safe(getattr(stream, "parameters", {}) or {}),
+        variables=_json_safe(getattr(stream, "variables", {}) or {}),
+        swag=_json_safe(swag or {}))
+    path = os.path.join(directory, f"stream_{record.stream_id}.json")
+    tmp = path + ".tmp"
+    with open(tmp, "w") as fh:
+        fh.write(record.to_json())
+    os.replace(tmp, path)
+    return path
+
+
+def load_stream_checkpoint(directory: str,
+                           stream_id: str) -> StreamCheckpoint:
+    path = os.path.join(directory, f"stream_{stream_id}.json")
+    with open(path) as fh:
+        return StreamCheckpoint.from_json(fh.read())

@@ -16,7 +16,8 @@ from typing import List, Type
 
 from ..utils.sexpr import generate
 
-__all__ = ["get_public_methods", "make_remote_proxy", "get_actor_proxy"]
+__all__ = ["get_public_methods", "make_remote_proxy", "get_actor_proxy",
+           "ProxyAllMethods", "proxy_trace"]
 
 
 def get_public_methods(cls: Type) -> List[str]:
@@ -66,3 +67,65 @@ def get_actor_proxy(topic_path: str, cls: Type, process) -> RemoteProxy:
     topic_in = topic_path if topic_path.endswith("/in") \
         else f"{topic_path}/in"
     return make_remote_proxy(process.message.publish, topic_in, cls)
+
+
+# --------------------------------------------------------------------------- #
+# Local AOP interception (reference main/proxy.py:39-72)
+
+class ProxyAllMethods:
+    """Intercept every public method call on ``target``.
+
+    Reference parity: ``main/proxy.py:39-62`` (wrapt.ObjectProxy based).
+    Implemented with plain ``__getattr__`` delegation — no wrapt
+    dependency.  ``hook(proxy_name, target, method_name, args, kwargs,
+    call)`` decides whether/how to invoke ``call()`` (the bound method
+    with arguments applied) and returns its result.
+    """
+
+    _PROXY_SLOTS = ("_proxy_name", "_proxy_target", "_proxy_hook")
+
+    def __init__(self, proxy_name, target, hook):
+        object.__setattr__(self, "_proxy_name", proxy_name)
+        object.__setattr__(self, "_proxy_target", target)
+        object.__setattr__(self, "_proxy_hook", hook)
+
+    def __getattr__(self, name):
+        value = getattr(object.__getattribute__(self, "_proxy_target"), name)
+        if not callable(value) or name.startswith("_"):
+            return value
+        hook = object.__getattribute__(self, "_proxy_hook")
+        proxy_name = object.__getattribute__(self, "_proxy_name")
+        target = object.__getattribute__(self, "_proxy_target")
+
+        def wrapper(*args, **kwargs):
+            return hook(proxy_name, target, name, args, kwargs,
+                        lambda: value(*args, **kwargs))
+        wrapper.__name__ = name
+        return wrapper
+
+    def __setattr__(self, name, value):
+        if name in ProxyAllMethods._PROXY_SLOTS:
+            object.__setattr__(self, name, value)
+        else:
+            setattr(object.__getattribute__(self, "_proxy_target"),
+                    name, value)
+
+    def __repr__(self):
+        target = object.__getattribute__(self, "_proxy_target")
+        return f"ProxyAllMethods({target!r})"
+
+
+def proxy_trace(target, name=None, printer=None):
+    """Wrap ``target`` so every public method call prints enter/exit
+    (reference ``proxy_trace``, main/proxy.py:64-72)."""
+    printer = printer or (lambda text: print(text))
+    name = name or type(target).__name__
+
+    def hook(proxy_name, _target, method_name, args, kwargs, call):
+        printer(f"TRACE {proxy_name}.{method_name}(args={args}, "
+                f"kwargs={kwargs}) enter")
+        try:
+            return call()
+        finally:
+            printer(f"TRACE {proxy_name}.{method_name} exit")
+    return ProxyAllMethods(name, target, hook)

@@ -25,6 +25,7 @@ from typing import Optional, Tuple
 __all__ = [
     "get_namespace", "get_hostname", "get_pid",
     "get_mqtt_configuration", "get_default_transport",
+    "bootstrap_request", "BootstrapResponder", "BOOTSTRAP_PORT",
 ]
 
 DEFAULT_NAMESPACE = "aiko"
@@ -62,3 +63,83 @@ def get_mqtt_configuration() -> Tuple[str, int, bool,
     if username:
         tls = True
     return host, port, tls, username, password
+
+
+# --------------------------------------------------------------------------- #
+# UDP broadcast bootstrap (reference utilities/configuration.py:160-187)
+#
+# Devices without DNS discover the broker: a client broadcasts "boot?" on
+# UDP port 4149; any responder replies "boot {mqtt_host} {port} {namespace}".
+
+BOOTSTRAP_PORT = 4149
+_BOOTSTRAP_REQUEST = b"boot?"
+
+
+def bootstrap_request(timeout: float = 2.0, port: int = BOOTSTRAP_PORT,
+                      address: str = "255.255.255.255"):
+    """Broadcast a boot request; returns (mqtt_host, mqtt_port, namespace)
+    or None on timeout."""
+    import time as _time
+    sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    deadline = _time.monotonic() + timeout
+    try:
+        sock.setsockopt(socket.SOL_SOCKET, socket.SO_BROADCAST, 1)
+        sock.sendto(_BOOTSTRAP_REQUEST, (address, port))
+        while True:
+            remaining = deadline - _time.monotonic()
+            if remaining <= 0:
+                return None
+            sock.settimeout(remaining)
+            try:
+                data, _addr = sock.recvfrom(1024)
+            except socket.timeout:
+                return None
+            fields = data.decode("utf-8", "replace").split()
+            if len(fields) == 4 and fields[0] == "boot":
+                try:
+                    return fields[1], int(fields[2]), fields[3]
+                except ValueError:
+                    continue    # malformed port from a stray responder
+    finally:
+        sock.close()
+
+
+class BootstrapResponder:
+    """Answer "boot?" broadcasts with this site's broker coordinates.
+
+    Runs a daemon thread; ``stop()`` to shut down.  Binds ``bind_address``
+    (default all interfaces) on ``port``.
+    """
+
+    def __init__(self, mqtt_host: str, mqtt_port: int, namespace: str,
+                 port: int = BOOTSTRAP_PORT, bind_address: str = ""):
+        import threading
+        self._reply = f"boot {mqtt_host} {mqtt_port} {namespace}".encode()
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((bind_address, port))
+        self._sock.settimeout(0.25)
+        self._running = True
+        self._thread = threading.Thread(
+            target=self._serve, name="bootstrap_responder", daemon=True)
+        self._thread.start()
+        self.port = self._sock.getsockname()[1]
+
+    def _serve(self):
+        while self._running:
+            try:
+                data, addr = self._sock.recvfrom(1024)
+            except socket.timeout:
+                continue
+            except OSError:
+                break
+            if data.strip() == _BOOTSTRAP_REQUEST:
+                try:
+                    self._sock.sendto(self._reply, addr)
+                except OSError:
+                    pass
+
+    def stop(self):
+        self._running = False
+        self._thread.join(timeout=2.0)
+        self._sock.close()

@@ -1,0 +1,101 @@
+"""Aux subsystems: AOP trace proxy (reference main/proxy.py), UDP boot
+discovery (utilities/configuration.py:160-187), Category
+(main/category.py)."""
+
+from aiko_services_tpu.runtime.category import Category
+from aiko_services_tpu.runtime.proxy import ProxyAllMethods, proxy_trace
+from aiko_services_tpu.utils.config import (
+    BootstrapResponder, bootstrap_request)
+
+
+def test_proxy_trace_intercepts_public_methods():
+    class Greeter:
+        tone = "warm"
+
+        def greet(self, name):
+            return f"hello {name}"
+
+    lines = []
+    proxy = proxy_trace(Greeter(), printer=lines.append)
+    assert proxy.greet("pele") == "hello pele"
+    assert proxy.tone == "warm"          # attributes pass through
+    assert len(lines) == 2
+    assert "greet" in lines[0] and "enter" in lines[0]
+    assert "exit" in lines[1]
+
+
+def test_proxy_hook_can_veto_and_rewrite():
+    class Counter:
+        value = 0
+
+        def bump(self, by):
+            self.value += by
+            return self.value
+
+    calls = []
+
+    def hook(proxy_name, target, method, args, kwargs, call):
+        calls.append(method)
+        if method == "bump" and args[0] < 0:
+            return None        # veto: never runs the real method
+        return call()
+
+    target = Counter()
+    proxy = ProxyAllMethods("counter", target, hook)
+    assert proxy.bump(2) == 2
+    assert proxy.bump(-5) is None
+    assert target.value == 2
+    assert calls == ["bump", "bump"]
+
+
+def test_proxy_setattr_passes_through():
+    class Box:
+        def get(self):
+            return self.item
+
+    proxy = ProxyAllMethods("box", Box(), lambda *a: a[-1]())
+    proxy.item = 9
+    assert proxy.get() == 9
+
+
+def test_bootstrap_request_response_loopback():
+    responder = BootstrapResponder("broker.example", 1883, "aiko_ns", port=0)
+    try:
+        out = bootstrap_request(timeout=2.0, port=responder.port,
+                                address="127.0.0.1")
+    finally:
+        responder.stop()
+    assert out == ("broker.example", 1883, "aiko_ns")
+
+
+def test_bootstrap_request_timeout():
+    # Nobody listening on this ephemeral port.
+    out = bootstrap_request(timeout=0.3, port=45177, address="127.0.0.1")
+    assert out is None
+
+
+def test_category_membership_and_listing():
+    class FakeMessage:
+        def __init__(self):
+            self.published = []
+
+        def publish(self, topic, payload):
+            self.published.append((topic, payload))
+
+    class FakeProcess:
+        message = FakeMessage()
+
+    class Manager(Category):
+        process = FakeProcess()
+
+    manager = Manager()   # no Category.__init__ needed: lazy member store
+    manager.category_add("pe_1", {"state": "ready"})
+    manager.category_add("pe_2")
+    assert "pe_1" in manager and len(manager) == 2
+    manager.category_list("ns/h/1/0/response")
+    published = manager.process.message.published
+    assert published[0][1] == "(item_count 2)"
+    assert any("pe_1" in payload and "state=ready" in payload
+               for _t, payload in published[1:])
+    assert manager.category_remove("pe_1")["state"] == "ready"
+    assert len(manager) == 1
