@@ -12,6 +12,6 @@ from .image_io import (
 from .video_io import VideoReadFile, VideoSample, VideoWriteFile, VideoOutput
 from .audio_io import (
     AudioReadFile, AudioFraming, AudioResampler, AudioFFT,
-    RemoteSend, RemoteReceive,
+    AudioOutput, AudioWriteFile, RemoteSend, RemoteReceive,
 )
 from .ml import ASRElement, VisionEncoderElement

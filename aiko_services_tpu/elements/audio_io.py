@@ -19,10 +19,10 @@ import numpy as np
 
 from ..pipeline.element import PipelineElement
 from ..pipeline.stream import StreamEvent
-from .common_io import DataSource
+from .common_io import DataSource, DataTarget
 
 __all__ = ["AudioReadFile", "AudioFraming", "AudioResampler", "AudioFFT",
-           "RemoteSend", "RemoteReceive"]
+           "AudioOutput", "AudioWriteFile", "RemoteSend", "RemoteReceive"]
 
 
 class AudioReadFile(DataSource):
@@ -113,6 +113,44 @@ class AudioFFT(PipelineElement):
     def process_frame(self, stream, audio):
         spectrum = np.abs(np.fft.rfft(np.asarray(audio, np.float32)))
         return StreamEvent.OKAY, {"spectrum": spectrum.astype(np.float32)}
+
+
+class AudioOutput(PipelineElement):
+    """Audio sink (reference audio_io.py:76 plays via speaker; no audio
+    device in this image, so summarize to log — same tap position)."""
+
+    def process_frame(self, stream, audio):
+        audio = np.asarray(audio, np.float32)
+        peak = float(np.abs(audio).max()) if audio.size else 0.0
+        self.logger.info("%s: %d samples, peak %.3f",
+                         self.my_id(stream), audio.size, peak)
+        return StreamEvent.OKAY, {"audio": audio}
+
+
+class AudioWriteFile(DataTarget):
+    """Write ``audio`` to ``data_targets`` — ``.wav`` (16-bit PCM via
+    stdlib wave) or ``.npy``; ``{}`` in the path templates the frame id
+    (one file per frame, like the other WriteFile elements)."""
+
+    def process_frame(self, stream, audio, sample_rate=16_000):
+        frame_id = stream.frame.frame_id if stream.frame else 0
+        path = self.target_path(stream, frame_id)
+        if not path:
+            self.logger.error("%s: data_targets parameter required",
+                              self.my_id(stream))
+            return StreamEvent.ERROR, {}
+        audio = np.asarray(audio, np.float32)
+        if path.endswith(".npy"):
+            np.save(path, audio)
+        else:
+            import wave
+            pcm = (np.clip(audio, -1.0, 1.0) * 32767).astype(np.int16)
+            with wave.open(path, "wb") as w:
+                w.setnchannels(1)
+                w.setsampwidth(2)
+                w.setframerate(int(sample_rate))
+                w.writeframes(pcm.tobytes())
+        return StreamEvent.OKAY, {"audio": audio}
 
 
 def _pack(array: np.ndarray) -> bytes:

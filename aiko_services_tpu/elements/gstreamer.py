@@ -1,0 +1,197 @@
+"""GStreamer media wrappers — gated on gi/GStreamer availability.
+
+Reference parity: ``elements/gstreamer/*.py`` — VideoReader (appsink
+pull thread, video_reader.py:27), VideoFileReader, VideoCameraReader,
+VideoStreamReader (RTSP), VideoFileWriter, VideoStreamWriter, H.264
+codec helpers (utilities.py:22-44).
+
+This image has no GStreamer (``gi`` is absent), so every class gates on
+import and raises an actionable error; when ``cv2`` is present the
+file/camera readers fall back to ``cv2.VideoCapture`` with the same
+``read() -> (ok, frame)`` surface, so pipelines keep working without
+gst installed.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional, Tuple
+
+import numpy as np
+
+try:
+    import gi
+    gi.require_version("Gst", "1.0")
+    from gi.repository import Gst
+    Gst.init(None)
+    _GST = True
+except (ImportError, ValueError):
+    Gst = None
+    _GST = False
+
+try:
+    import cv2
+    _CV2 = True
+except ImportError:
+    cv2 = None
+    _CV2 = False
+
+__all__ = [
+    "gst_available", "VideoReader", "VideoFileReader",
+    "VideoCameraReader", "VideoStreamReader", "VideoFileWriter",
+    "VideoStreamWriter", "h264_decode_pipeline", "h264_encode_pipeline",
+]
+
+
+def gst_available() -> bool:
+    return _GST
+
+
+def h264_decode_pipeline(source: str) -> str:
+    """H.264 decode launch string (reference utilities.py:22-44 picks a
+    platform codec; we prefer a hardware decoder when present)."""
+    decoder = "avdec_h264"
+    if _GST and Gst.ElementFactory.find("v4l2h264dec"):
+        decoder = "v4l2h264dec"
+    return (f"{source} ! h264parse ! {decoder} ! videoconvert "
+            f"! video/x-raw,format=RGB ! appsink name=sink")
+
+
+def h264_encode_pipeline(target: str) -> str:
+    encoder = "x264enc"
+    if _GST and Gst.ElementFactory.find("v4l2h264enc"):
+        encoder = "v4l2h264enc"
+    return (f"appsrc name=src ! videoconvert ! {encoder} "
+            f"! h264parse ! {target}")
+
+
+class VideoReader:
+    """Pull RGB frames from a GStreamer appsink on a reader thread
+    (reference video_reader.py:27), or from cv2.VideoCapture fallback.
+
+    ``read()`` returns ``(ok, frame | None)``; ``release()`` stops.
+    """
+
+    def __init__(self, launch: Optional[str] = None,
+                 capture_source=None):
+        self._frames = []
+        self._lock = threading.Lock()
+        self._capture = None
+        self._pipeline = None
+        if launch is not None:
+            if not _GST:
+                raise ImportError(
+                    "GStreamer (gi) not installed; use the cv2-backed "
+                    "readers (VideoFileReader/VideoCameraReader) instead")
+            self._pipeline = Gst.parse_launch(launch)
+            sink = self._pipeline.get_by_name("sink")
+            sink.set_property("emit-signals", True)
+            sink.connect("new-sample", self._on_sample)
+            self._pipeline.set_state(Gst.State.PLAYING)
+        elif capture_source is not None:
+            if not _CV2:
+                raise ImportError("neither GStreamer nor cv2 available")
+            self._capture = cv2.VideoCapture(capture_source)
+            if not self._capture.isOpened():
+                raise IOError(f"cannot open {capture_source!r}")
+
+    def _on_sample(self, sink):        # pragma: no cover - needs gst
+        sample = sink.emit("pull-sample")
+        buffer = sample.get_buffer()
+        caps = sample.get_caps().get_structure(0)
+        h, w = caps.get_value("height"), caps.get_value("width")
+        ok, info = buffer.map(Gst.MapFlags.READ)
+        if ok:
+            frame = np.frombuffer(info.data, np.uint8).reshape(h, w, 3)
+            with self._lock:
+                self._frames.append(frame.copy())
+                del self._frames[:-8]
+            buffer.unmap(info)
+        return Gst.FlowReturn.OK
+
+    def read(self) -> Tuple[bool, Optional[np.ndarray]]:
+        if self._capture is not None:
+            ok, frame = self._capture.read()
+            if ok:
+                frame = cv2.cvtColor(frame, cv2.COLOR_BGR2RGB)
+            return ok, (frame if ok else None)
+        with self._lock:
+            if self._frames:
+                return True, self._frames.pop(0)
+        return False, None
+
+    def release(self):
+        if self._capture is not None:
+            self._capture.release()
+        if self._pipeline is not None:   # pragma: no cover - needs gst
+            self._pipeline.set_state(Gst.State.NULL)
+
+
+class VideoFileReader(VideoReader):
+    def __init__(self, path: str):
+        if _GST:                         # pragma: no cover - needs gst
+            super().__init__(
+                launch=h264_decode_pipeline(f'filesrc location="{path}"'))
+        else:
+            super().__init__(capture_source=path)
+
+
+class VideoCameraReader(VideoReader):
+    def __init__(self, device=0):
+        if _GST:                         # pragma: no cover - needs gst
+            super().__init__(
+                launch=f"v4l2src device=/dev/video{device} ! videoconvert"
+                       " ! video/x-raw,format=RGB ! appsink name=sink")
+        else:
+            super().__init__(capture_source=device)
+
+
+class VideoStreamReader(VideoReader):
+    """RTSP source (reference video_stream_reader.py)."""
+
+    def __init__(self, url: str):
+        if _GST:                         # pragma: no cover - needs gst
+            super().__init__(launch=h264_decode_pipeline(
+                f'rtspsrc location="{url}" ! rtph264depay'))
+        elif _CV2:
+            super().__init__(capture_source=url)
+        else:
+            raise ImportError("neither GStreamer nor cv2 available")
+
+
+class VideoFileWriter:
+    """Write RGB frames to a video file (cv2 fallback when no gst)."""
+
+    def __init__(self, path: str, frame_rate: float, size: Tuple[int, int]):
+        self._writer = None
+        if not _CV2:
+            raise ImportError("VideoFileWriter requires cv2 (or GStreamer)")
+        fourcc = cv2.VideoWriter_fourcc(*"mp4v")
+        self._writer = cv2.VideoWriter(path, fourcc, frame_rate, size)
+
+    def write(self, frame: np.ndarray):
+        self._writer.write(cv2.cvtColor(frame, cv2.COLOR_RGB2BGR))
+
+    def release(self):
+        self._writer.release()
+
+
+class VideoStreamWriter:                 # pragma: no cover - needs gst
+    """RTP/UDP H.264 stream writer — GStreamer only."""
+
+    def __init__(self, host: str, port: int, frame_rate: float,
+                 size: Tuple[int, int]):
+        if not _GST:
+            raise ImportError("VideoStreamWriter requires GStreamer")
+        launch = h264_encode_pipeline(
+            f"rtph264pay ! udpsink host={host} port={port}")
+        self._pipeline = Gst.parse_launch(launch)
+        self._src = self._pipeline.get_by_name("src")
+        self._pipeline.set_state(Gst.State.PLAYING)
+
+    def write(self, frame: np.ndarray):
+        buffer = Gst.Buffer.new_wrapped(frame.tobytes())
+        self._src.emit("push-buffer", buffer)
+
+    def release(self):
+        self._pipeline.set_state(Gst.State.NULL)

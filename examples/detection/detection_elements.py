@@ -1,0 +1,125 @@
+"""Detection examples: Aruco markers, faces, objects.
+
+Reference parity:
+* ``examples/aruco_marker/aruco.py`` — ArucoMarkerDetector / Overlay
+  (cv2.aruco),
+* ``examples/face/face.py`` — face detector (deepface there; here the
+  framework's own native detector model configured single-class),
+* ``examples/yolo/yolo.py`` — object detector (ultralytics there; here
+  ``DetectorElement`` from ``aiko_services_tpu.elements.ml``).
+
+Detections flow as an ``overlay`` dict ``{"rectangles": […],
+"texts": […]}`` consumed by ``ImageOverlay``
+(``aiko_services_tpu/elements/image_io.py``), matching the reference's
+overlay contract (``examples/yolo/yolo.py:75-86``).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from aiko_services_tpu.pipeline.element import PipelineElement
+from aiko_services_tpu.pipeline.stream import StreamEvent
+
+try:
+    import cv2
+    _CV2 = True
+except ImportError:          # pragma: no cover - cv2 is in the image
+    _CV2 = False
+
+__all__ = ["ArucoMarkerDetector", "ArucoMarkerOverlay", "FaceDetector"]
+
+
+class ArucoMarkerDetector(PipelineElement):
+    """``image`` (H, W, 3) uint8 → ``markers`` [{id, corners}] +
+    ``overlay`` rectangles; parameter ``aruco_dictionary`` names a
+    cv2.aruco predefined dictionary (default DICT_4X4_50)."""
+
+    def __init__(self, context, process=None):
+        super().__init__(context, process)
+        if not _CV2 or not hasattr(cv2, "aruco"):
+            raise ImportError("ArucoMarkerDetector requires cv2.aruco")
+        name, _ = self.get_parameter("aruco_dictionary", "DICT_4X4_50")
+        dictionary = cv2.aruco.getPredefinedDictionary(
+            getattr(cv2.aruco, str(name)))
+        self._detector = cv2.aruco.ArucoDetector(
+            dictionary, cv2.aruco.DetectorParameters())
+
+    def process_frame(self, stream, images):
+        markers, rectangles, texts = [], [], []
+        for image in images:
+            image = np.asarray(image)
+            gray = (cv2.cvtColor(image, cv2.COLOR_RGB2GRAY)
+                    if image.ndim == 3 else image)
+            corners, ids, _rejected = self._detector.detectMarkers(gray)
+            if ids is None:
+                continue
+            for marker_id, quad in zip(ids.flatten(), corners):
+                quad = quad.reshape(-1, 2)
+                x0, y0 = quad.min(axis=0)
+                x1, y1 = quad.max(axis=0)
+                markers.append({"id": int(marker_id),
+                                "corners": quad.tolist()})
+                rectangles.append([float(x0), float(y0),
+                                   float(x1), float(y1)])
+                texts.append(f"aruco:{int(marker_id)}")
+        overlay = {"rectangles": rectangles, "texts": texts}
+        return StreamEvent.OKAY, {"markers": markers, "overlay": overlay}
+
+
+class ArucoMarkerOverlay(PipelineElement):
+    """Draw detected markers onto the image (cv2.aruco native drawing)."""
+
+    def process_frame(self, stream, images, markers):
+        out = []
+        for image in images:
+            image = np.array(image, copy=True)   # writable for cv2 draw
+            if _CV2 and markers:
+                corners = [np.asarray(m["corners"],
+                                      np.float32).reshape(1, -1, 2)
+                           for m in markers]
+                ids = np.asarray([[m["id"]] for m in markers], np.int32)
+                cv2.aruco.drawDetectedMarkers(image, corners, ids)
+            out.append(image)
+        return StreamEvent.OKAY, {"images": out}
+
+
+class FaceDetector(PipelineElement):
+    """``image`` (H, W, 3) → face boxes via the framework's native
+    single-class detector (the reference shells out to deepface;
+    here the model is the framework's own JAX detector)."""
+
+    def __init__(self, context, process=None):
+        super().__init__(context, process)
+        import jax
+        from aiko_services_tpu.models import detector as detector_model
+        self._model = detector_model
+        name, _ = self.get_parameter("model_config", "tiny")
+        config = detector_model.CONFIGS[str(name)]
+        # single "face" class head
+        import dataclasses
+        self.config = dataclasses.replace(config, n_classes=1)
+        seed, _ = self.get_parameter("seed", 0)
+        self.params = detector_model.init_params(
+            self.config, jax.random.PRNGKey(int(seed)))
+
+    def process_frame(self, stream, images):
+        import jax.numpy as jnp
+        image = np.stack([np.asarray(i, np.float32) for i in images]) / 255.0
+        size = self.config.image_size
+        if image.shape[1:3] != (size, size):
+            import jax
+            image = jax.image.resize(
+                jnp.asarray(image),
+                (image.shape[0], size, size, image.shape[3]), "bilinear")
+        raw = self._model.forward(self.params, jnp.asarray(image),
+                                  self.config)
+        boxes, scores, classes, keep = self._model.decode_boxes(
+            raw, self.config)
+        boxes, scores, keep = (np.asarray(boxes[0]), np.asarray(scores[0]),
+                               np.asarray(keep[0]))
+        rectangles = [boxes[i].tolist() for i in range(len(keep)) if keep[i]]
+        texts = [f"face:{scores[i]:.2f}" for i in range(len(keep)) if keep[i]]
+        return StreamEvent.OKAY, {
+            "faces": rectangles,
+            "overlay": {"rectangles": rectangles, "texts": texts}}

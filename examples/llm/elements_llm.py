@@ -1,0 +1,125 @@
+"""LLM chat element with S-expression-constrained robot commanding.
+
+Reference parity: ``examples/llm/elements_llm.py`` — ``PE_LLM``
+(191-220) calls LangChain→Ollama llama3.1 over HTTP with a system
+prompt that constrains replies to S-expression robot commands
+(137-179), and receives detections via a raw MQTT side-channel topic
+(64, 197-200).
+
+Here the model is the framework's **own** Llama-3-architecture decoder
+(``aiko_services_tpu.models.llama``) running jitted prefill/decode on
+the TPU — no external process.  The same prompt contract is kept: the
+reply is parsed for a leading S-expression command and emitted as a
+structured ``command`` output alongside the raw ``text``.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from aiko_services_tpu.pipeline.element import PipelineElement
+from aiko_services_tpu.pipeline.stream import StreamEvent
+from aiko_services_tpu.utils.sexpr import parse
+
+__all__ = ["PE_LLM", "SYSTEM_PROMPT", "tokenize", "detokenize"]
+
+#: Same contract as the reference's prompt (elements_llm.py:137-179):
+#: the assistant must reply with exactly one command S-expression.
+SYSTEM_PROMPT = """You are a robot controller.
+Reply with exactly one command S-expression and nothing else.
+Commands:
+  (forward SECONDS) (backward SECONDS) (turn DEGREES)
+  (look DEGREES) (say TEXT) (sleep) (stop)
+Example: user "go ahead two seconds" -> (forward 2)
+"""
+
+
+def tokenize(text: str) -> np.ndarray:
+    """Byte-level tokens (the from-scratch model has no learned BPE)."""
+    return np.frombuffer(text.encode("utf-8"), np.uint8).astype(np.int32)
+
+
+def detokenize(tokens) -> str:
+    data = bytes(int(t) & 0xFF for t in np.asarray(tokens).reshape(-1))
+    return data.decode("utf-8", "replace")
+
+
+def extract_command(text: str) -> Optional[list]:
+    """First S-expression command in ``text``, or None."""
+    start = text.find("(")
+    if start < 0:
+        return None
+    depth = 0
+    for i, ch in enumerate(text[start:], start):
+        if ch == "(":
+            depth += 1
+        elif ch == ")":
+            depth -= 1
+            if depth == 0:
+                try:
+                    command, parameters = parse(text[start:i + 1])
+                except ValueError:
+                    return None
+                return [command, *parameters]
+    return None
+
+
+class PE_LLM(PipelineElement):
+    """``text`` (user utterance) → ``text`` (reply) + ``command``
+    (parsed S-expression list or None).
+
+    Detections arriving on the ``topic_detections`` side-channel
+    (reference elements_llm.py:64) are appended to the next prompt as
+    scene context.
+    """
+
+    def __init__(self, context, process=None):
+        super().__init__(context, process)
+        import jax
+        from aiko_services_tpu.models import llama
+        self._llama = llama
+        name, _ = self.get_parameter("model_config", "tiny")
+        self.config = llama.CONFIGS[str(name)]
+        seed, _ = self.get_parameter("seed", 0)
+        self.params = llama.init_params(self.config,
+                                        jax.random.PRNGKey(int(seed)))
+        self._detections = []
+        topic, _ = self.get_parameter("topic_detections", None)
+        if topic and process is not None:
+            process.add_message_handler(self._detections_handler,
+                                        str(topic))
+
+    def _detections_handler(self, topic, payload):
+        self._detections.append(str(payload))
+        del self._detections[:-8]          # keep a bounded scene window
+
+    def process_frame(self, stream, text):
+        import jax
+        import jax.numpy as jnp
+        llama = self._llama
+        scene = (f"Scene: {' '.join(self._detections)}\n"
+                 if self._detections else "")
+        prompt = f"{SYSTEM_PROMPT}\n{scene}user: {text}\nassistant: "
+        tokens = tokenize(prompt)[None, :]
+        max_new, _ = self.get_parameter("max_new_tokens", 24,
+                                        stream=stream)
+        max_new = int(max_new)
+        budget = self.config.max_seq_len - tokens.shape[1]
+        if budget <= 0:
+            self.logger.error("%s: prompt too long", self.my_id(stream))
+            return StreamEvent.ERROR, {}
+        max_new = min(max_new, budget)
+        prompt_len = tokens.shape[1]
+        cache = llama.init_cache(self.config, 1, prompt_len + max_new)
+        logits, cache = llama.prefill(
+            self.params, jnp.asarray(tokens), cache, self.config)
+        first = logits[:, -1].argmax(-1).astype(jnp.int32)[:, None]
+        new_tokens, _ = llama.generate_tokens(
+            self.params, first, cache, jnp.int32(prompt_len),
+            max_new - 1, self.config)
+        out = jnp.concatenate([first, new_tokens], axis=1)
+        reply = detokenize(np.asarray(out)[0])
+        return StreamEvent.OKAY, {"text": reply,
+                                  "command": extract_command(reply)}

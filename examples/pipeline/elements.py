@@ -1,0 +1,170 @@
+"""Demo pipeline elements.
+
+Reference parity: ``/root/reference/src/aiko_services/examples/pipeline/
+elements.py`` — PE_Add (49), PE_Inspect (68), PE_Metrics (133),
+PE_RandomIntegers (155), fan-out/fan-in PE_0..PE_4 (187-248), multi-path
+PE_IN/PE_TEXT/PE_OUT (262-294), PE_DataDecode/Encode (298-324).
+"""
+
+from __future__ import annotations
+
+import base64
+import io
+import random
+
+import numpy as np
+
+from aiko_services_tpu.pipeline.element import PipelineElement
+from aiko_services_tpu.pipeline.stream import StreamEvent
+
+__all__ = [
+    "PE_Add", "PE_Inspect", "PE_Metrics", "PE_RandomIntegers",
+    "PE_0", "PE_1", "PE_2", "PE_3", "PE_4",
+    "PE_IN", "PE_TEXT", "PE_OUT", "PE_DataEncode", "PE_DataDecode",
+]
+
+
+class PE_Add(PipelineElement):
+    """``i -> i + amount`` (parameter ``amount``, default 1)."""
+
+    def process_frame(self, stream, i):
+        amount, _ = self.get_parameter("amount", 1, stream=stream)
+        return StreamEvent.OKAY, {"i": int(i) + int(amount)}
+
+
+class PE_Inspect(PipelineElement):
+    """Debug tap: write selected swag names to log / file / print.
+
+    Parameters: ``inspect`` (comma-joined names or ``*``), ``target``
+    (``log`` | ``print`` | ``file:PATH``), ``enable``.
+    """
+
+    def process_frame(self, stream, **inputs):
+        enable, _ = self.get_parameter("enable", True, stream=stream)
+        if not enable or str(enable).lower() == "false":
+            return StreamEvent.OKAY, dict(inputs)
+        names, _ = self.get_parameter("inspect", "*", stream=stream)
+        selected = (inputs if names in ("*", ["*"]) else
+                    {n: inputs[n] for n in str(names).split(",")
+                     if n in inputs})
+        target, _ = self.get_parameter("target", "log", stream=stream)
+        target = str(target)
+        for name, value in selected.items():
+            text = f"PE_Inspect {self.my_id(stream)}: {name}={value}"
+            if target == "print":
+                print(text)
+            elif target.startswith("file:"):
+                with open(target[5:], "a", encoding="utf-8") as f:
+                    f.write(text + "\n")
+            else:
+                self.logger.info(text)
+        return StreamEvent.OKAY, dict(inputs)
+
+
+class PE_Metrics(PipelineElement):
+    """Report per-element latencies captured by the pipeline hot loop
+    (frame.metrics ``time_{element}`` entries)."""
+
+    def process_frame(self, stream, **inputs):
+        frame = stream.frame
+        metrics = dict(frame.metrics) if frame else {}
+        enable, _ = self.get_parameter("enable", True, stream=stream)
+        if enable and str(enable).lower() != "false":
+            for name, seconds in sorted(metrics.items()):
+                if name.startswith("time_"):
+                    self.logger.info("%s: %s = %.3f ms",
+                                     self.my_id(stream), name,
+                                     float(seconds) * 1e3)
+        return StreamEvent.OKAY, {"metrics": metrics, **inputs}
+
+
+class PE_RandomIntegers(PipelineElement):
+    """Source: emits ``list`` of ``length`` random ints per frame."""
+
+    def start_stream(self, stream, stream_id):
+        rate, _ = self.get_parameter("rate", None, stream=stream)
+        limit, _ = self.get_parameter("frame_count", 10, stream=stream)
+
+        def frame_generator(stream, frame_id):
+            if frame_id >= int(limit):
+                return StreamEvent.STOP, {"diagnostic": "frame_count"}
+            length, _ = self.get_parameter("length", 8, stream=stream)
+            integers = [random.randint(0, 99) for _ in range(int(length))]
+            return StreamEvent.OKAY, {"list": integers}
+
+        self.create_frames(stream, frame_generator,
+                           rate=float(rate) if rate else None)
+        return StreamEvent.OKAY, None
+
+    def process_frame(self, stream, list):
+        return StreamEvent.OKAY, {"list": list}
+
+
+# --------------------------------------------------------------------------- #
+# Fan-out / fan-in graph demo:  (PE_0 (PE_1 PE_3) (PE_2 PE_3) PE_4)
+
+class PE_0(PipelineElement):
+    def process_frame(self, stream, i):
+        return StreamEvent.OKAY, {"i": int(i)}
+
+
+class PE_1(PipelineElement):
+    def process_frame(self, stream, i):
+        return StreamEvent.OKAY, {"a": int(i) + 1}
+
+
+class PE_2(PipelineElement):
+    def process_frame(self, stream, i):
+        return StreamEvent.OKAY, {"b": int(i) + 2}
+
+
+class PE_3(PipelineElement):
+    """Fan-in: consumes both branch outputs."""
+
+    def process_frame(self, stream, a, b):
+        return StreamEvent.OKAY, {"i": int(a) + int(b)}
+
+
+class PE_4(PipelineElement):
+    def process_frame(self, stream, i):
+        return StreamEvent.OKAY, {"i": int(i)}
+
+
+# --------------------------------------------------------------------------- #
+# Multi-graph-path demo (select sub-graph per stream via graph_path)
+
+class PE_IN(PipelineElement):
+    def process_frame(self, stream, text):
+        return StreamEvent.OKAY, {"text": str(text)}
+
+
+class PE_TEXT(PipelineElement):
+    def process_frame(self, stream, text):
+        return StreamEvent.OKAY, {"text": str(text).upper()}
+
+
+class PE_OUT(PipelineElement):
+    def process_frame(self, stream, text):
+        return StreamEvent.OKAY, {"text": str(text)}
+
+
+# --------------------------------------------------------------------------- #
+# Binary marshalling across process boundaries (base64 + numpy save)
+
+class PE_DataEncode(PipelineElement):
+    """numpy array → base64 string (wire-safe inside S-expressions)."""
+
+    def process_frame(self, stream, data):
+        buffer = io.BytesIO()
+        np.save(buffer, np.asarray(data), allow_pickle=False)
+        encoded = base64.b64encode(buffer.getvalue()).decode("ascii")
+        return StreamEvent.OKAY, {"data": encoded}
+
+
+class PE_DataDecode(PipelineElement):
+    """base64 string → numpy array."""
+
+    def process_frame(self, stream, data):
+        raw = base64.b64decode(str(data).encode("ascii"))
+        array = np.load(io.BytesIO(raw), allow_pickle=False)
+        return StreamEvent.OKAY, {"data": array}

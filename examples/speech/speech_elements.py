@@ -1,0 +1,77 @@
+"""Speech pipeline elements: framing → ASR → chat → TTS → output.
+
+Reference parity: ``examples/speech/speech_elements.py`` —
+``PE_AudioFraming`` sliding-window concat (60-83), WhisperX ASR (109+),
+Coqui TTS.  Here the ASR model is the framework's own Whisper-class
+encoder-decoder (``aiko_services_tpu.models.asr``) and TTS is a
+self-contained DSP formant synthesizer (the reference shells out to the
+external Coqui library; this image has no TTS weights, so the element
+synthesizes a deterministic parametric voice — same pipeline contract:
+``text -> audio``).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from aiko_services_tpu.elements.audio_io import AudioFraming
+from aiko_services_tpu.pipeline.element import PipelineElement
+from aiko_services_tpu.pipeline.stream import StreamEvent
+
+__all__ = ["PE_AudioFraming", "PE_TTS", "PE_TextFromTokens"]
+
+
+class PE_AudioFraming(AudioFraming):
+    """Sliding-window concat of audio chunks (reference speech_elements
+    PE_AudioFraming) — re-exported under the example's name."""
+
+
+class PE_TextFromTokens(PipelineElement):
+    """ASR token ids → text via the byte-level detokenizer (the ASR
+    model family is trained-from-scratch here, so its vocabulary is
+    byte-level; see ``aiko_services_tpu/models/asr.py``)."""
+
+    def process_frame(self, stream, text_tokens):
+        tokens = np.asarray(text_tokens).reshape(-1)
+        chars = [chr(t) for t in tokens if 32 <= t < 127]
+        return StreamEvent.OKAY, {"text": "".join(chars)}
+
+
+# Formant targets per vowel-ish character class (F1, F2 in Hz).
+_FORMANTS = {
+    "a": (730, 1090), "e": (530, 1840), "i": (270, 2290),
+    "o": (570, 840), "u": (300, 870),
+}
+
+
+class PE_TTS(PipelineElement):
+    """``text`` → ``audio`` (float32 mono) parametric formant synthesis.
+
+    Parameters: ``sample_rate`` (default 16000), ``char_seconds``
+    (default 0.08) — each character becomes a short two-formant voiced
+    segment; consonants get a noise burst, whitespace a pause.
+    """
+
+    def process_frame(self, stream, text):
+        rate, _ = self.get_parameter("sample_rate", 16000, stream=stream)
+        char_s, _ = self.get_parameter("char_seconds", 0.08, stream=stream)
+        rate, char_s = int(rate), float(char_s)
+        n = max(1, int(rate * char_s))
+        t = np.arange(n) / rate
+        envelope = np.hanning(n).astype(np.float32)
+        rng = np.random.default_rng(0)
+        segments = []
+        for ch in str(text).lower():
+            if ch.isspace():
+                segments.append(np.zeros(n, np.float32))
+                continue
+            f1, f2 = _FORMANTS.get(ch, (440 + 13 * (ord(ch) % 23),
+                                        1500 + 29 * (ord(ch) % 17)))
+            voiced = (np.sin(2 * np.pi * f1 * t) +
+                      0.5 * np.sin(2 * np.pi * f2 * t))
+            if ch not in _FORMANTS and not ch.isdigit():
+                voiced = 0.6 * voiced + 0.4 * rng.standard_normal(n)
+            segments.append((voiced * envelope * 0.3).astype(np.float32))
+        audio = (np.concatenate(segments) if segments
+                 else np.zeros(n, np.float32))
+        return StreamEvent.OKAY, {"audio": audio}

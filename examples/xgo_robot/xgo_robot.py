@@ -1,0 +1,117 @@
+"""XGO robot actor — simulation mode.
+
+Reference parity: ``examples/xgo_robot/xgo_robot.py`` (420 LoC) — a
+real-robot Actor exposing motion/pose commands over the actor protocol,
+publishing zlib'd camera frames on a raw side-channel topic, and showing
+status on an LCD.  The reference itself simulates when the hostname is
+not in ``REAL_ROBOTS`` (xgo_robot.py:58-73); this build keeps only the
+simulation path (no XGO hardware lib in the image) with the same
+command surface, so ``robot_control``-style remote UIs and the PE_LLM
+``(forward 2)`` command stream drive it unchanged.
+"""
+
+from __future__ import annotations
+
+import math
+import zlib
+
+import numpy as np
+
+from aiko_services_tpu.runtime import Actor
+from aiko_services_tpu.utils.sexpr import generate
+
+__all__ = ["XgoRobot", "ROBOT_COMMANDS"]
+
+ROBOT_COMMANDS = ["forward", "backward", "turn", "look", "say", "sleep",
+                  "stop", "action", "arm", "pose"]
+
+
+class XgoRobot(Actor):
+    """Simulated quadruped: integrates commanded motion into a pose
+    estimate published via the EC share; camera frames are synthetic
+    gradients stamped with the pose, zlib'd onto ``topic_video``."""
+
+    def __init__(self, context, process=None):
+        super().__init__(context, process)
+        self.x = 0.0
+        self.y = 0.0
+        self.heading = 0.0          # degrees
+        self.camera_pitch = 0.0
+        self.lcd_text = "ready"
+        self.moving = False
+        self.share.update({
+            "pose": self._pose(), "lcd": self.lcd_text,
+            "simulated": True})
+        self.topic_video = f"{self.topic_path}/video"
+
+    # -- command surface (invoked remotely via "(forward 2)" etc.) ----
+
+    def _pose(self):
+        return (f"x={self.x:.2f} y={self.y:.2f} "
+                f"heading={self.heading:.1f}")
+
+    def _update_share(self):
+        if hasattr(self, "ec_producer"):
+            self.ec_producer.update("pose", self._pose())
+            self.ec_producer.update("lcd", self.lcd_text)
+
+    def forward(self, seconds):
+        self._move(float(seconds), +1)
+
+    def backward(self, seconds):
+        self._move(float(seconds), -1)
+
+    def _move(self, seconds, sign, speed=0.25):
+        self.moving = True
+        distance = sign * speed * seconds
+        self.x += distance * math.cos(math.radians(self.heading))
+        self.y += distance * math.sin(math.radians(self.heading))
+        self.moving = False
+        self._update_share()
+
+    def turn(self, degrees):
+        self.heading = (self.heading + float(degrees)) % 360.0
+        self._update_share()
+
+    def look(self, degrees):
+        self.camera_pitch = max(-90.0, min(90.0, float(degrees)))
+        self._update_share()
+
+    def say(self, *words):
+        self.lcd_text = " ".join(str(w) for w in words)
+        self._update_share()
+
+    def sleep(self):
+        self.lcd_text = "sleeping"
+        self._update_share()
+
+    def stop(self):
+        self.moving = False
+        self.lcd_text = "stopped"
+        self._update_share()
+
+    def action(self, action_id):
+        self.lcd_text = f"action {action_id}"
+        self._update_share()
+
+    def arm(self, x, z):
+        self.lcd_text = f"arm {x},{z}"
+        self._update_share()
+
+    def pose(self, response_topic):
+        """Request/response idiom: publish the pose back to the caller."""
+        self.process.message.publish(
+            str(response_topic), generate("pose", [self._pose()]))
+
+    # -- camera side-channel ------------------------------------------
+
+    def publish_frame(self, size=64):
+        """Synthetic camera frame (gradient + heading stripe), zlib'd
+        raw bytes on the video topic (reference pattern:
+        np.save+zlib on a binary side-channel)."""
+        yy, xx = np.mgrid[0:size, 0:size]
+        frame = ((xx + yy + int(self.heading)) % 256).astype(np.uint8)
+        frame = np.stack([frame] * 3, axis=-1)
+        payload = zlib.compress(frame.tobytes(), 1)
+        self.process.message.publish(self.topic_video, payload)
+        return frame

@@ -1,0 +1,182 @@
+"""Example suites as integration tests: arithmetic fan-in pipeline JSON,
+multi-graph-path selection, aruco/face detection, speech chain, PE_LLM
+command extraction, XGO robot sim actor, GStreamer cv2 fallback."""
+
+import json
+import os
+import queue
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:                      # examples import by package
+    sys.path.insert(0, REPO)
+
+from aiko_services_tpu.pipeline import (     # noqa: E402
+    Pipeline, parse_pipeline_definition)
+from aiko_services_tpu.runtime import (      # noqa: E402
+    Process, compose_instance, pipeline_args)
+
+
+def load_definition(name):
+    with open(os.path.join(REPO, name)) as f:
+        return parse_pipeline_definition(json.load(f))
+
+
+def make_pipeline(engine, definition, broker="examples"):
+    process = Process(namespace="test", hostname="h", pid="1",
+                      engine=engine, broker=broker)
+    return compose_instance(
+        Pipeline, pipeline_args(definition.name, definition=definition),
+        process=process)
+
+
+def run_one(engine, pipeline, frame, stream_id="s1", graph_path=None):
+    out = queue.Queue()
+    pipeline.create_stream(stream_id, queue_response=out,
+                           graph_path=graph_path)
+    pipeline.post_frame(stream_id, frame)
+    engine.drain()
+    results = []
+    while not out.empty():
+        results.append(out.get()[2])
+    return results
+
+
+def test_pipeline_local_fan_in(engine):
+    definition = load_definition("examples/pipeline/pipeline_local.json")
+    pipeline = make_pipeline(engine, definition)
+    results = run_one(engine, pipeline, {"i": 10})
+    # PE_3 fan-in: (10+1) + (10+2) = 23
+    assert results and results[-1]["i"] == 23
+
+
+def test_pipeline_paths_select_subgraph(engine):
+    definition = load_definition("examples/pipeline/pipeline_paths.json")
+    pipeline = make_pipeline(engine, definition)
+    upper = run_one(engine, pipeline, {"text": "hi"}, stream_id="s1")
+    assert upper[-1]["text"] == "HI"
+    plain = run_one(engine, pipeline, {"text": "hi"}, stream_id="s2",
+                    graph_path=1)
+    assert plain[-1]["text"] == "hi"
+
+
+def test_detection_pipeline_finds_marker(engine, tmp_path):
+    definition = load_definition("examples/detection/pipeline_detect.json")
+    # redirect output file into tmp
+    for element in definition.elements:
+        if element.name == "ImageWriteFile":
+            element.parameters["data_targets"] = \
+                f"file://{tmp_path}/detect_out.png"
+    pipeline = make_pipeline(engine, definition)
+    out = queue.Queue()
+    pipeline.create_stream("s1", queue_response=out)
+    engine.drain()      # DataSource start_stream posts the frame
+    results = []
+    while not out.empty():
+        results.append(out.get()[2])
+    assert results, "no frames emerged"
+    swag = results[-1]
+    assert any(m["id"] == 7 for m in swag["markers"])
+    assert os.path.exists(tmp_path / "detect_out.png")
+
+
+def test_face_detector_element(engine):
+    from examples.detection.detection_elements import FaceDetector
+    from aiko_services_tpu.runtime import actor_args
+    from aiko_services_tpu.pipeline.stream import StreamEvent
+    from aiko_services_tpu.runtime.context import pipeline_element_args
+    process = Process(namespace="test", hostname="h", pid="9",
+                      engine=engine, broker="face")
+    element = compose_instance(
+        FaceDetector, pipeline_element_args("FaceDetector"),
+        process=process)
+    image = (np.random.default_rng(0).integers(0, 255, (64, 64, 3))
+             .astype(np.uint8))
+    event, out = element.process_frame(_FakeStream(), [image])
+    assert event == StreamEvent.OKAY
+    assert "faces" in out and "overlay" in out
+
+
+class _FakeStream:
+    stream_id = "s"
+    frame = None
+    parameters = {}
+    variables = {}
+
+
+def test_speech_chat_pipeline(engine, tmp_path):
+    definition = load_definition(
+        "examples/speech/pipeline_speech_chat.json")
+    for element in definition.elements:
+        if element.name == "AudioWriteFile":
+            element.parameters["data_targets"] = \
+                f"file://{tmp_path}/speech_out.wav"
+    pipeline = make_pipeline(engine, definition)
+    out = queue.Queue()
+    pipeline.create_stream("s1", queue_response=out)
+    engine.drain()
+    results = []
+    while not out.empty():
+        results.append(out.get()[2])
+    assert results, "no frames emerged from speech chain"
+    audio = np.asarray(results[-1]["audio"])
+    assert audio.size > 0
+    assert os.path.exists(tmp_path / "speech_out.wav")
+
+
+def test_llm_command_extraction():
+    from examples.llm.elements_llm import extract_command, tokenize, \
+        detokenize
+    assert extract_command("ok (forward 2) done") == ["forward", "2"]
+    assert extract_command("(say hello world)") == \
+        ["say", "hello", "world"]
+    assert extract_command("no command here") is None
+    assert extract_command("(unclosed") is None
+    text = "robot go"
+    assert detokenize(tokenize(text)) == text
+
+
+def test_xgo_robot_sim_commands(engine):
+    from examples.xgo_robot.xgo_robot import XgoRobot
+    from aiko_services_tpu.runtime import actor_args
+    process = Process(namespace="test", hostname="h", pid="2",
+                      engine=engine, broker="xgo")
+    robot = compose_instance(XgoRobot, actor_args("xgo"), process=process)
+    # drive via the wire, as PE_LLM's (forward 2) command stream would
+    process.message.publish(robot.topic_in, "(forward 2)")
+    process.message.publish(robot.topic_in, "(turn 90)")
+    process.message.publish(robot.topic_in, "(say hello)")
+    engine.drain()
+    assert abs(robot.x - 0.5) < 1e-6
+    assert robot.heading == 90.0
+    assert robot.lcd_text == "hello"
+    # pose request/response idiom
+    replies = []
+    process.add_message_handler(lambda t, p: replies.append(p),
+                                "test/resp")
+    process.message.publish(robot.topic_in, "(pose test/resp)")
+    engine.drain()
+    assert replies and replies[0].startswith("(pose ")
+    frame = robot.publish_frame()
+    assert frame.shape == (64, 64, 3)
+
+
+def test_gstreamer_cv2_fallback(tmp_path):
+    import cv2
+    from aiko_services_tpu.elements.gstreamer import (
+        VideoFileReader, VideoFileWriter, gst_available,
+        h264_decode_pipeline)
+    assert not gst_available()           # gi absent in this image
+    assert "appsink" in h264_decode_pipeline("filesrc location=x")
+    path = str(tmp_path / "clip.mp4")
+    writer = VideoFileWriter(path, 5.0, (32, 32))
+    for i in range(3):
+        writer.write(np.full((32, 32, 3), i * 40, np.uint8))
+    writer.release()
+    reader = VideoFileReader(path)
+    ok, frame = reader.read()
+    reader.release()
+    assert ok and frame.shape == (32, 32, 3)
