@@ -9,7 +9,10 @@ from .ml import (
 from .image_io import (
     ImageReadFile, ImageResize, ImageOverlay, ImageWriteFile, ImageOutput,
 )
-from .video_io import VideoReadFile, VideoSample, VideoWriteFile, VideoOutput
+from .video_io import (
+    VideoReadFile, VideoReadWebcam, VideoSample, VideoShow,
+    VideoWriteFile, VideoOutput,
+)
 from .audio_io import (
     AudioReadFile, AudioFraming, AudioResampler, AudioFFT,
     AudioOutput, AudioWriteFile, RemoteSend, RemoteReceive,

@@ -15,6 +15,7 @@ gst installed.
 from __future__ import annotations
 
 import threading
+import time
 from typing import Optional, Tuple
 
 import numpy as np
@@ -78,6 +79,7 @@ class VideoReader:
         self._lock = threading.Lock()
         self._capture = None
         self._pipeline = None
+        self._eos = False
         if launch is not None:
             if not _GST:
                 raise ImportError(
@@ -87,6 +89,7 @@ class VideoReader:
             sink = self._pipeline.get_by_name("sink")
             sink.set_property("emit-signals", True)
             sink.connect("new-sample", self._on_sample)
+            self._bus = self._pipeline.get_bus()
             self._pipeline.set_state(Gst.State.PLAYING)
         elif capture_source is not None:
             if not _CV2:
@@ -109,16 +112,32 @@ class VideoReader:
             buffer.unmap(info)
         return Gst.FlowReturn.OK
 
-    def read(self) -> Tuple[bool, Optional[np.ndarray]]:
+    def read(self, timeout: float = 5.0) \
+            -> Tuple[bool, Optional[np.ndarray]]:
+        """cv2.VideoCapture-style contract: blocks until a frame is
+        available and returns ``(False, None)`` only at end-of-stream,
+        error, or ``timeout`` seconds without a frame — NOT merely
+        because the appsink thread hasn't delivered the first buffer
+        yet."""
         if self._capture is not None:
             ok, frame = self._capture.read()
             if ok:
                 frame = cv2.cvtColor(frame, cv2.COLOR_BGR2RGB)
             return ok, (frame if ok else None)
-        with self._lock:
-            if self._frames:
-                return True, self._frames.pop(0)
-        return False, None
+        deadline = time.monotonic() + timeout
+        while True:                      # pragma: no cover - needs gst
+            with self._lock:
+                if self._frames:
+                    return True, self._frames.pop(0)
+            if self._eos or time.monotonic() >= deadline:
+                return False, None
+            # No GLib main loop runs here: poll the bus for EOS/ERROR
+            # while waiting (10 ms slices).
+            message = self._bus.timed_pop_filtered(
+                10 * Gst.MSECOND,
+                Gst.MessageType.EOS | Gst.MessageType.ERROR)
+            if message is not None:
+                self._eos = True
 
     def release(self):
         if self._capture is not None:
@@ -130,8 +149,13 @@ class VideoReader:
 class VideoFileReader(VideoReader):
     def __init__(self, path: str):
         if _GST:                         # pragma: no cover - needs gst
+            # decodebin handles container demux (mp4/mkv/…) + codec
+            # selection; a bare h264parse would only accept raw .h264
+            # elementary streams.
             super().__init__(
-                launch=h264_decode_pipeline(f'filesrc location="{path}"'))
+                launch=f'filesrc location="{path}" ! decodebin '
+                       f'! videoconvert ! video/x-raw,format=RGB '
+                       f'! appsink name=sink')
         else:
             super().__init__(capture_source=path)
 
@@ -187,10 +211,21 @@ class VideoStreamWriter:                 # pragma: no cover - needs gst
             f"rtph264pay ! udpsink host={host} port={port}")
         self._pipeline = Gst.parse_launch(launch)
         self._src = self._pipeline.get_by_name("src")
+        # Downstream negotiation requires explicit raw-video caps, and
+        # live timestamping so x264enc sees monotonic PTS.
+        width, height = size
+        caps = Gst.Caps.from_string(
+            f"video/x-raw,format=RGB,width={width},height={height},"
+            f"framerate={int(frame_rate)}/1")
+        self._src.set_property("caps", caps)
+        self._src.set_property("format", Gst.Format.TIME)
+        self._src.set_property("is-live", True)
+        self._src.set_property("do-timestamp", True)
         self._pipeline.set_state(Gst.State.PLAYING)
 
     def write(self, frame: np.ndarray):
-        buffer = Gst.Buffer.new_wrapped(frame.tobytes())
+        buffer = Gst.Buffer.new_wrapped(
+            np.ascontiguousarray(frame).tobytes())
         self._src.emit("push-buffer", buffer)
 
     def release(self):

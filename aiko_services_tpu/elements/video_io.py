@@ -14,8 +14,8 @@ from ..pipeline.element import PipelineElement
 from ..pipeline.stream import StreamEvent
 from .common_io import DataTarget, parse_data_url
 
-__all__ = ["VideoReadFile", "VideoSample", "VideoWriteFile",
-           "VideoOutput"]
+__all__ = ["VideoReadFile", "VideoReadWebcam", "VideoSample",
+           "VideoShow", "VideoWriteFile", "VideoOutput"]
 
 
 class VideoReadFile(PipelineElement):
@@ -94,6 +94,78 @@ class VideoWriteFile(DataTarget):
         writer = stream.variables.get("video_writer")
         if writer is not None:
             writer.release()
+        return StreamEvent.OKAY, None
+
+
+class VideoReadWebcam(PipelineElement):
+    """Webcam capture source (reference ``VideoReadWebcam``,
+    elements/media/webcam_io.py:61).  ``camera_id`` parameter selects
+    the device; frames are RGB.  Errors the stream cleanly when no
+    camera hardware is present (headless hosts, CI)."""
+
+    def start_stream(self, stream, stream_id):
+        import cv2
+        camera_id, _ = self.get_parameter("camera_id", 0, stream=stream)
+        capture = cv2.VideoCapture(int(camera_id))
+        if not capture.isOpened():
+            self.logger.error("%s: cannot open webcam %s",
+                              self.my_id(stream), camera_id)
+            return StreamEvent.ERROR, None
+
+        def generator(stream_, frame_id):
+            okay, bgr = capture.read()
+            if not okay:
+                capture.release()
+                return StreamEvent.STOP, None
+            return StreamEvent.OKAY, {"images": [bgr[:, :, ::-1]]}
+
+        rate, _ = self.get_parameter("rate", 0, stream=stream)
+        stream.variables["webcam_capture"] = capture
+        self.create_frames(stream, generator, rate=float(rate) or None)
+        return StreamEvent.OKAY, None
+
+    def process_frame(self, stream, images):
+        return StreamEvent.OKAY, {"images": images}
+
+    def stop_stream(self, stream, stream_id):
+        capture = stream.variables.pop("webcam_capture", None)
+        if capture is not None:
+            capture.release()
+        return StreamEvent.OKAY, None
+
+
+class VideoShow(PipelineElement):
+    """Display frames in a GUI window (reference ``VideoShow``,
+    elements/media/video_io.py:198).  Falls back to a frame-shape print
+    when no display is available (headless hosts, CI)."""
+
+    @staticmethod
+    def _display_available():
+        # cv2.imshow on a display-less host raises SIGABRT inside the
+        # GUI toolkit (not a catchable Python exception) — gate on the
+        # display environment instead of try/except.
+        import os
+        return bool(os.environ.get("DISPLAY")
+                    or os.environ.get("WAYLAND_DISPLAY"))
+
+    def process_frame(self, stream, images):
+        title, _ = self.get_parameter("window_title", "aiko",
+                                      stream=stream)
+        if self._display_available():
+            import cv2
+            for image in images:
+                cv2.imshow(str(title),
+                           np.asarray(image, np.uint8)[:, :, ::-1])
+            cv2.waitKey(1)
+        else:
+            print(f"video show [{title}]: {len(images)} image(s), "
+                  f"shape {np.asarray(images[0]).shape if images else '-'}")
+        return StreamEvent.OKAY, {"images": images}
+
+    def stop_stream(self, stream, stream_id):
+        if self._display_available():
+            import cv2
+            cv2.destroyAllWindows()
         return StreamEvent.OKAY, None
 
 

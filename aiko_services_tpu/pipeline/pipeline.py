@@ -59,6 +59,10 @@ __all__ = ["Pipeline", "PipelineRemote", "DEFAULT_GRACE_TIME",
 DEFAULT_GRACE_TIME = 60.0   # reference pipeline.py:133
 REMOTE_RETRY_DELAY = 3.0    # reference pipeline.py:779-787
 STATS_PERIOD = 3.0          # reference pipeline.py:586
+#: Service protocol pipelines register under (reference declares
+#: "…/pipeline:0" via ServiceProtocol); discovery filters and dashboard
+#: plugins key on it.
+PIPELINE_PROTOCOL = "pipeline:0"
 
 
 class PipelineRemote:
@@ -80,6 +84,7 @@ class Pipeline(PipelineElement):
         if self.definition is None:
             raise ValueError("Pipeline requires a definition")
         context.pipeline = None   # a Pipeline is its own pipeline
+        context.protocol = context.protocol or PIPELINE_PROTOCOL
         super().__init__(context, process)
         self.pipeline = self
 
@@ -117,6 +122,7 @@ class Pipeline(PipelineElement):
             "_frame_local": self._frame_local,
             "_frame_retry": self._frame_retry,
             "_stream_stop": self._stream_stop_command,
+            "_stream_started": self._stream_started,
         })
         self.share["streams"] = 0
         self.share["frames_processed"] = 0
@@ -235,6 +241,13 @@ class Pipeline(PipelineElement):
                 self.destroy_stream(stream_id)
                 break
         self._stream_current = None
+        if stream_id in self.streams:
+            # Frames posted while elements were still starting are parked
+            # on the stream; this message serializes behind them and
+            # replays them in order.
+            from ..runtime.actor import ActorMessage, Mailbox
+            self._post_message(Mailbox.IN, ActorMessage(
+                "_stream_started", [stream_id]))
         return stream
 
     def destroy_stream(self, stream_id):
@@ -243,6 +256,7 @@ class Pipeline(PipelineElement):
         if stream is None:
             return
         self._destroyed_streams.append(stream_id)
+        stream.pending.clear()
         stream.state = StreamState.STOP
         if stream.lease:
             stream.lease.terminate()
@@ -287,7 +301,12 @@ class Pipeline(PipelineElement):
             "_stream_stop", [str(stream_id), int(event)]))
 
     def queued_frame_count(self) -> int:
-        return self.process.event.mailbox_size(self._mailbox_in)
+        # Parked pending frames (streams still starting) count too, so
+        # the generator backpressure gate can't be bypassed during a
+        # slow start (model load in a later element's start_stream).
+        parked = sum(len(stream.pending)
+                     for stream in list(self.streams.values()))
+        return self.process.event.mailbox_size(self._mailbox_in) + parked
 
     def _frame_retry(self, stream_id, swag, resume_at,
                      caller_frame_id=None):
@@ -301,7 +320,29 @@ class Pipeline(PipelineElement):
         frame.metrics["time_start"] = time.perf_counter()
         self._process_frame_common(stream, frame, resume_at=resume_at)
 
+    def _stream_started(self, stream_id):
+        stream = self.streams.get(str(stream_id))
+        if stream is None:
+            return
+        stream.started = True
+        pending, stream.pending = stream.pending, []
+        for kind, *payload in pending:
+            if kind == "frame":
+                frame_data, caller_frame_id = payload
+                self._run_frame(stream, frame_data,
+                                caller_frame_id=caller_frame_id)
+            elif kind == "stop":
+                self.destroy_stream(stream.stream_id)
+                return
+
     def _stream_stop_command(self, stream_id, event_value):
+        stream = self.streams.get(str(stream_id))
+        if stream is not None and not stream.started:
+            # Keep FIFO semantics: the stop must run after the parked
+            # frames it followed, not destroy the stream out from under
+            # them.
+            stream.pending.append(("stop", event_value))
+            return
         self.destroy_stream(stream_id)
 
     def _frame_local(self, stream_id, frame_data):
@@ -311,6 +352,9 @@ class Pipeline(PipelineElement):
             if stream_id in self._destroyed_streams:
                 return   # late frame for a dead stream: drop
             stream = self.create_stream(stream_id)
+        if not stream.started:
+            stream.pending.append(("frame", dict(frame_data), None))
+            return
         self._run_frame(stream, dict(frame_data))
 
     def _wire_process_frame(self, stream_dict, inputs_dict=None):
@@ -329,6 +373,9 @@ class Pipeline(PipelineElement):
             stream.topic_response = stream_dict["topic_response"]
         frame_data = decode_swag(inputs_dict or {})
         caller_frame_id = stream_dict.get("frame_id")
+        if not stream.started:
+            stream.pending.append(("frame", frame_data, caller_frame_id))
+            return
         self._run_frame(stream, frame_data,
                         caller_frame_id=caller_frame_id)
 

@@ -81,6 +81,15 @@ class Stream:
     queue_response: Optional[Any] = None   # local caller's response queue
     lease: Optional[Any] = None
 
+    #: False until every element's ``start_stream`` has completed.  Frame
+    #: generators start posting the moment *their* element starts, so
+    #: frames can reach the event loop while later elements are still
+    #: starting — those are parked in ``pending`` and replayed on start
+    #: completion (the reference serializes start/process with a
+    #: per-stream lock instead, reference pipeline.py:817-845, 1097-1205).
+    started: bool = False
+    pending: list = field(default_factory=list)
+
     # The frame currently being processed (set by the pipeline hot loop,
     # event-loop thread only).
     frame: Optional[Frame] = None

@@ -1,0 +1,98 @@
+"""Standalone media converters.
+
+Reference parity: ``/root/reference/src/aiko_services/elements/media/
+images_to_video.py`` (33 LoC) and ``video_to_images.py`` (42 LoC) —
+small CLI utilities that shuttle between image-file directories and
+video files.  Implemented as library functions plus a single click CLI
+(``python -m aiko_services_tpu.tools.convert``).
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+
+import click
+import numpy as np
+
+
+def images_to_video(image_glob: str, video_path: str,
+                    frame_rate: float = 30.0) -> int:
+    """Encode every image matching ``image_glob`` (sorted) into
+    ``video_path``.  Returns the number of frames written."""
+    import cv2
+    paths = sorted(glob.glob(image_glob))
+    if not paths:
+        raise FileNotFoundError(f"no images match {image_glob}")
+    first = cv2.imread(paths[0])
+    if first is None:
+        raise ValueError(f"cannot read image {paths[0]}")
+    height, width = first.shape[:2]
+    writer = cv2.VideoWriter(
+        video_path, cv2.VideoWriter_fourcc(*"mp4v"), float(frame_rate),
+        (width, height))
+    if not writer.isOpened():
+        raise ValueError(f"cannot open video writer for {video_path}")
+    count = 0
+    try:
+        for path in paths:
+            image = cv2.imread(path)
+            if image is None:
+                continue
+            if image.shape[:2] != (height, width):
+                image = cv2.resize(image, (width, height))
+            writer.write(image)
+            count += 1
+    finally:
+        writer.release()
+    return count
+
+
+def video_to_images(video_path: str, image_directory: str,
+                    image_format: str = "frame_{:06d}.png") -> int:
+    """Decode ``video_path`` into one image file per frame under
+    ``image_directory``.  Returns the number of frames written."""
+    import cv2
+    capture = cv2.VideoCapture(video_path)
+    if not capture.isOpened():
+        raise FileNotFoundError(f"cannot open video {video_path}")
+    os.makedirs(image_directory, exist_ok=True)
+    count = 0
+    try:
+        while True:
+            okay, frame = capture.read()
+            if not okay:
+                break
+            cv2.imwrite(os.path.join(image_directory,
+                                     image_format.format(count)), frame)
+            count += 1
+    finally:
+        capture.release()
+    return count
+
+
+@click.group()
+def main():
+    """Media conversion utilities."""
+
+
+@main.command("images_to_video")
+@click.argument("image_glob")
+@click.argument("video_path")
+@click.option("--frame_rate", default=30.0, type=float)
+def _images_to_video(image_glob, video_path, frame_rate):
+    count = images_to_video(image_glob, video_path, frame_rate)
+    print(f"wrote {count} frames to {video_path}")
+
+
+@main.command("video_to_images")
+@click.argument("video_path")
+@click.argument("image_directory")
+@click.option("--image_format", default="frame_{:06d}.png")
+def _video_to_images(video_path, image_directory, image_format):
+    count = video_to_images(video_path, image_directory, image_format)
+    print(f"wrote {count} images to {image_directory}")
+
+
+if __name__ == "__main__":
+    main()

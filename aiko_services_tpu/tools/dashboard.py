@@ -23,6 +23,7 @@ from ..runtime.process import default_process
 from ..runtime.service import ServiceFilter
 from ..registry.services_cache import services_cache_create_singleton
 from ..registry.share import ECConsumer
+from .dashboard_plugins import find_plugin
 
 REFRESH_SECONDS = 0.25   # 4 Hz, reference dashboard.py:224-226
 
@@ -35,6 +36,8 @@ class DashboardState:
         self.page = "services"
         self.variables: Dict = {}
         self.logs: List[str] = []
+        self.plugin = None
+        self.plugin_fields = None
         self._consumer: Optional[ECConsumer] = None
         self._log_topic: Optional[str] = None
 
@@ -54,6 +57,8 @@ class DashboardState:
         fields = services[self.selected]
         self.close_views()
         self.variables = {}
+        self.plugin = find_plugin(fields)
+        self.plugin_fields = fields
         self._consumer = ECConsumer(
             self.process, self.variables, f"{fields.topic_path}/control")
         self.page = "variables"
@@ -81,6 +86,8 @@ class DashboardState:
             self.process.remove_message_handler(self._on_log,
                                                 self._log_topic)
             self._log_topic = None
+        self.plugin = None
+        self.plugin_fields = None
         self.page = "services"
 
 
@@ -103,11 +110,20 @@ def _render(stdscr, state: DashboardState):
             stdscr.addnstr(2 + i, 0, line, width - 1, attr)
         footer = " ↑/↓ select · ENTER variables · L log · Q quit"
     elif state.page == "variables":
-        stdscr.addnstr(1, 0, "  VARIABLE = VALUE", width - 1,
-                       curses.A_BOLD)
-        items = sorted(_flatten(state.variables))[:height - 3]
-        for i, (key, value) in enumerate(items):
-            stdscr.addnstr(2 + i, 0, f"  {key} = {value}", width - 1)
+        if state.plugin is not None:
+            stdscr.addnstr(1, 0, "  PLUGIN VIEW", width - 1,
+                           curses.A_BOLD)
+            lines = state.plugin(state.plugin_fields,
+                                 state.variables)[:height - 3]
+            for i, line in enumerate(lines):
+                stdscr.addnstr(2 + i, 0, f"  {line}", width - 1)
+        else:
+            stdscr.addnstr(1, 0, "  VARIABLE = VALUE", width - 1,
+                           curses.A_BOLD)
+            items = sorted(_flatten(state.variables))[:height - 3]
+            for i, (key, value) in enumerate(items):
+                stdscr.addnstr(2 + i, 0, f"  {key} = {value}",
+                               width - 1)
         footer = " ESC back · Q quit"
     else:
         stdscr.addnstr(1, 0, "  LOG", width - 1, curses.A_BOLD)

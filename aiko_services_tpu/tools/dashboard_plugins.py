@@ -1,0 +1,99 @@
+"""Dashboard plugin frames.
+
+Reference parity: ``/root/reference/src/aiko_services/main/
+dashboard_plugins.py:7-52`` — custom dashboard pages keyed by service
+name or protocol.  A plugin renders the selected service's live EC
+variables into service-specific lines; the dashboard shows those lines
+instead of the raw ``VARIABLE = VALUE`` dump when a plugin matches.
+
+Register with::
+
+    @dashboard_plugin(protocol="pipeline")
+    def my_plugin(fields, variables) -> list[str]: ...
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional
+
+PluginFn = Callable[[object, Dict], List[str]]
+
+_PLUGINS_BY_NAME: Dict[str, PluginFn] = {}
+_PLUGINS_BY_PROTOCOL: Dict[str, PluginFn] = {}
+
+
+def dashboard_plugin(name: Optional[str] = None,
+                     protocol: Optional[str] = None):
+    """Decorator registering a plugin for a service name and/or a
+    protocol substring (reference keys plugins the same two ways)."""
+    def register(fn: PluginFn) -> PluginFn:
+        if name:
+            _PLUGINS_BY_NAME[name] = fn
+        if protocol:
+            _PLUGINS_BY_PROTOCOL[protocol] = fn
+        return fn
+    return register
+
+
+def find_plugin(fields) -> Optional[PluginFn]:
+    """Name match wins over protocol-substring match."""
+    plugin = _PLUGINS_BY_NAME.get(fields.name)
+    if plugin is not None:
+        return plugin
+    protocol = fields.protocol or ""
+    for key, fn in _PLUGINS_BY_PROTOCOL.items():
+        if key in protocol:
+            return fn
+    return None
+
+
+def _get(variables: Dict, *path, default="-"):
+    node = variables
+    for key in path:
+        if not isinstance(node, dict) or key not in node:
+            return default
+        node = node[key]
+    return node
+
+
+@dashboard_plugin(protocol="pipeline")
+def pipeline_plugin(fields, variables) -> List[str]:
+    """Streams/frames counters published by the pipeline's 3 s status
+    timer into its EC share."""
+    lines = [
+        f"Pipeline: {fields.name}",
+        f"  lifecycle: {_get(variables, 'lifecycle')}",
+        f"  streams:   {_get(variables, 'streams')}",
+        f"  frames:    {_get(variables, 'frames_processed')}",
+    ]
+    elements = _get(variables, "elements", default={})
+    if isinstance(elements, dict) and elements:
+        lines += ["", "  elements:"]
+        for name, state in sorted(elements.items()):
+            lines.append(f"    {name:24} {state}")
+    return lines
+
+
+@dashboard_plugin(protocol="lifecycle_manager")
+def lifecycle_manager_plugin(fields, variables) -> List[str]:
+    lines = [
+        f"LifeCycleManager: {fields.name}",
+        f"  lifecycle: {_get(variables, 'lifecycle')}",
+        f"  clients:   {_get(variables, 'client_count')}",
+        "",
+        "  clients:",
+    ]
+    clients = _get(variables, "clients", default={})
+    if isinstance(clients, dict):
+        for client_id, topic in sorted(clients.items()):
+            lines.append(f"    {client_id:12} {topic}")
+    return lines
+
+
+@dashboard_plugin(protocol="registrar")
+def registrar_plugin(fields, variables) -> List[str]:
+    return [
+        f"Registrar: {fields.name}",
+        f"  lifecycle:     {_get(variables, 'lifecycle')}",
+        f"  service_count: {_get(variables, 'service_count')}",
+    ]

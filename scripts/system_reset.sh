@@ -1,0 +1,39 @@
+#!/usr/bin/env bash
+# Reset distributed system state: stop services, then clear the
+# Registrar's stale retained election message so the next start runs a
+# clean primary election (the reference documents this stale-retained
+# failure mode at main/registrar.py:54-56 and clears it the same way).
+# Reference parity: /root/reference/scripts/system_reset.sh (behavior).
+set -u
+
+export AIKO_NAMESPACE=${1:-${AIKO_NAMESPACE:-aiko}}
+"$(dirname "$0")/system_stop.sh"
+
+python - <<'PY'
+import os
+import sys
+import time
+from aiko_services_tpu.transport import create_message
+
+namespace = os.environ.get("AIKO_NAMESPACE", "aiko")
+try:
+    transport = create_message("mqtt")
+except Exception as error:
+    print(f"no MQTT broker to reset ({error}); loopback state is "
+          f"per-process and needs no reset")
+    sys.exit(0)
+deadline = time.time() + 5.0
+while not transport.connected and time.time() < deadline:
+    time.sleep(0.05)
+if not transport.connected:
+    print("could not connect to the MQTT broker within 5 s; "
+          "retained election topic NOT cleared")
+    sys.exit(1)
+# Publishing a zero-length retained payload deletes the retained
+# message (MQTT semantics).
+transport.publish(f"{namespace}/service/registrar", "", retain=True,
+                  wait=True)
+transport.disconnect()
+print(f"cleared retained registrar election topic for namespace "
+      f"'{namespace}'")
+PY

@@ -1,0 +1,33 @@
+#!/usr/bin/env bash
+# Start the core aiko_services_tpu system services on this host:
+# an MQTT broker (if mosquitto is installed and the host is localhost),
+# the Registrar, and optionally the Dashboard.
+#
+# Reference parity: /root/reference/scripts/system_start.sh (behavior,
+# not code): same defaults, same AIKO_MQTT_HOST / AIKO_NAMESPACE
+# override scheme.
+#
+# Usage:  ./scripts/system_start.sh [AIKO_MQTT_HOST] [AIKO_NAMESPACE]
+set -u
+
+export AIKO_MQTT_HOST=${1:-${AIKO_MQTT_HOST:-localhost}}
+export AIKO_NAMESPACE=${2:-${AIKO_NAMESPACE:-aiko}}
+RUN_DIR=${AIKO_RUN_DIR:-/tmp/aiko_services_tpu}
+mkdir -p "$RUN_DIR"
+
+if [ "$AIKO_MQTT_HOST" = "localhost" ] && command -v mosquitto >/dev/null; then
+    if ! pgrep -x mosquitto >/dev/null; then
+        mosquitto -d -p "${AIKO_MQTT_PORT:-1883}"
+        echo "started: mosquitto (port ${AIKO_MQTT_PORT:-1883})"
+    fi
+fi
+
+python -m aiko_services_tpu.registry.registrar_cli \
+    >"$RUN_DIR/registrar.log" 2>&1 &
+echo $! > "$RUN_DIR/registrar.pid"
+echo "started: registrar (pid $(cat "$RUN_DIR/registrar.pid")," \
+     "log $RUN_DIR/registrar.log)"
+
+if [ "${AIKO_DASHBOARD:-0}" = "1" ]; then
+    python -m aiko_services_tpu.tools.dashboard
+fi

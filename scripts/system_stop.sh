@@ -1,0 +1,17 @@
+#!/usr/bin/env bash
+# Stop the system services started by system_start.sh.
+# Reference parity: /root/reference/scripts/system_stop.sh (behavior).
+set -u
+
+RUN_DIR=${AIKO_RUN_DIR:-/tmp/aiko_services_tpu}
+
+if [ -f "$RUN_DIR/registrar.pid" ]; then
+    kill "$(cat "$RUN_DIR/registrar.pid")" 2>/dev/null \
+        && echo "stopped: registrar"
+    rm -f "$RUN_DIR/registrar.pid"
+fi
+
+if [ "${AIKO_STOP_MOSQUITTO:-1}" = "1" ] && pgrep -x mosquitto >/dev/null
+then
+    pkill -x mosquitto && echo "stopped: mosquitto"
+fi

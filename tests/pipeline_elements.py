@@ -82,3 +82,21 @@ class PE_CountSource(PipelineElement):
 
     def process_frame(self, stream, i):
         return StreamEvent.OKAY, {"i": i}
+
+
+class PE_SlowStartTarget(PipelineElement):
+    """start_stream is slow; process_frame requires it to have run.
+    Regression guard: a source generator starts posting frames the moment
+    *its* start_stream returns, while later elements are still starting —
+    those frames must be parked until the whole stream has started."""
+
+    def start_stream(self, stream, stream_id):
+        import time
+        time.sleep(0.2)
+        stream.variables["slow_start_ready"] = True
+        return StreamEvent.OKAY, None
+
+    def process_frame(self, stream, i):
+        if not stream.variables.get("slow_start_ready"):
+            return StreamEvent.ERROR, {}
+        return StreamEvent.OKAY, {"i": i}

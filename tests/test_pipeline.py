@@ -282,3 +282,42 @@ def test_remote_element_retries_until_discovered(engine):
     engine.advance(REMOTE_RETRY_DELAY + 1.0)
     engine.drain()
     assert [f["i"] for f in PE_Collect.seen["PE_Collect"]] == [4]
+
+
+def test_frames_park_until_all_elements_started(engine):
+    """A generator posting frames while later elements are still starting
+    must not have those frames processed early (this lost the first
+    video frame: the writer was created by an early frame, then
+    clobbered by VideoWriteFile.start_stream)."""
+    import queue
+    import time as time_module
+
+    document = {
+        "version": 0, "name": "p_race", "runtime": "python",
+        "graph": ["(PE_CountSource PE_SlowStartTarget)"],
+        "elements": [
+            element("PE_CountSource", "PE_CountSource",
+                    [("i", "int")], [("i", "int")], {"limit": 5}),
+            element("PE_SlowStartTarget", "PE_SlowStartTarget",
+                    [("i", "int")], [("i", "int")]),
+        ],
+    }
+    pipeline, _ = make_pipeline(engine, document, broker="race")
+    thread = engine.run_in_thread()
+    out = queue.Queue()
+    pipeline.create_stream("s1", queue_response=out)
+    results = []
+    deadline = time_module.time() + 10
+    while len(results) < 5 and time_module.time() < deadline:
+        try:
+            results.append(out.get(timeout=0.5)[2])
+        except queue.Empty:
+            pass
+    assert [r["i"] for r in results] == [0, 1, 2, 3, 4]
+    # The generator's STOP (parked behind the frames) destroys the stream.
+    deadline = time_module.time() + 5
+    while pipeline.streams and time_module.time() < deadline:
+        time_module.sleep(0.02)
+    assert not pipeline.streams
+    engine.terminate()
+    thread.join(timeout=5)

@@ -1,0 +1,74 @@
+"""Tools: media converters, dashboard plugin frames, video elements."""
+
+import numpy as np
+import pytest
+
+from aiko_services_tpu.runtime.service import ServiceFields
+from aiko_services_tpu.tools.convert import images_to_video, video_to_images
+from aiko_services_tpu.tools.dashboard_plugins import find_plugin
+
+
+def fields(name="svc", protocol="…/pipeline:0"):
+    return ServiceFields(topic_path="test/h/1/1", name=name,
+                         protocol=protocol, transport="loopback",
+                         owner="t", tags=[])
+
+
+def test_images_to_video_roundtrip(tmp_path):
+    cv2 = pytest.importorskip("cv2")
+    rng = np.random.default_rng(0)
+    for i in range(5):
+        image = rng.integers(0, 255, (32, 48, 3), dtype=np.uint8)
+        cv2.imwrite(str(tmp_path / f"img_{i:03d}.png"), image)
+    video = str(tmp_path / "out.mp4")
+    assert images_to_video(str(tmp_path / "img_*.png"), video) == 5
+    out_dir = str(tmp_path / "frames")
+    assert video_to_images(video, out_dir) == 5
+
+
+def test_converters_missing_inputs(tmp_path):
+    pytest.importorskip("cv2")
+    with pytest.raises(FileNotFoundError):
+        images_to_video(str(tmp_path / "none_*.png"),
+                        str(tmp_path / "x.mp4"))
+    with pytest.raises(FileNotFoundError):
+        video_to_images(str(tmp_path / "missing.mp4"), str(tmp_path))
+
+
+def test_dashboard_plugin_matching():
+    plugin = find_plugin(fields(protocol="aiko/pipeline:0"))
+    assert plugin is not None
+    lines = plugin(fields(), {"lifecycle": "ready", "streams": 2,
+                              "elements": {"PE_0": "ready"}})
+    text = "\n".join(lines)
+    assert "ready" in text and "PE_0" in text
+    assert find_plugin(fields(protocol="aiko/registrar:2")) is not None
+    assert find_plugin(fields(protocol="aiko/other:0")) is None
+
+
+def test_dashboard_plugin_name_beats_protocol():
+    from aiko_services_tpu.tools.dashboard_plugins import dashboard_plugin
+
+    @dashboard_plugin(name="special")
+    def special_plugin(fields_, variables):
+        return ["special"]
+
+    assert find_plugin(
+        fields(name="special", protocol="aiko/pipeline:0")
+    ) is special_plugin
+
+
+def test_video_show_headless(tmp_path):
+    """VideoShow must not raise on headless hosts."""
+    from aiko_services_tpu.elements import VideoShow
+    from aiko_services_tpu.pipeline.stream import Stream, StreamEvent
+    from aiko_services_tpu.runtime.context import pipeline_element_args
+
+    from aiko_services_tpu.runtime import compose_instance
+    show = compose_instance(
+        VideoShow, pipeline_element_args("VideoShow"))
+    stream = Stream(stream_id="s")
+    image = np.zeros((8, 8, 3), np.uint8)
+    event, outputs = show.process_frame(stream, images=[image])
+    assert event == StreamEvent.OKAY
+    assert outputs["images"][0] is image
