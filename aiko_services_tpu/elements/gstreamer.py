@@ -79,6 +79,7 @@ class VideoReader:
         self._lock = threading.Lock()
         self._capture = None
         self._pipeline = None
+        self._bus = None
         self._eos = False
         if launch is not None:
             if not _GST:
@@ -124,6 +125,8 @@ class VideoReader:
             if ok:
                 frame = cv2.cvtColor(frame, cv2.COLOR_BGR2RGB)
             return ok, (frame if ok else None)
+        if self._pipeline is None:   # constructed with no source at all
+            return False, None
         deadline = time.monotonic() + timeout
         while True:                      # pragma: no cover - needs gst
             with self._lock:

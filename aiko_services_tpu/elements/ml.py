@@ -123,6 +123,11 @@ class LlamaChatElement(PipelineElement):
         seed, _ = self.get_parameter("seed", 0)
         self.params = llama_model.init_params(
             self.config, jax.random.PRNGKey(int(seed)))
+        quantize, _ = self.get_parameter("quantize", False)
+        if quantize:
+            # Int8 weight-only: ~2× decode throughput (HBM-bound) and
+            # half the parameter memory.
+            self.params = llama_model.quantize_params(self.params)
 
     def start_stream(self, stream, stream_id):
         return StreamEvent.OKAY, None

@@ -42,12 +42,14 @@ class VideoReadFile(PipelineElement):
         def generator(stream_, frame_id):
             okay, bgr = capture.read()
             if not okay:
-                capture.release()
                 return StreamEvent.STOP, None
             return StreamEvent.OKAY, {"images": [bgr[:, :, ::-1]]}
 
         rate, _ = self.get_parameter("rate", 0, stream=stream)
-        self.create_frames(stream, generator, rate=float(rate) or None)
+        # The generator thread owns the capture: releasing it anywhere
+        # else would race a blocked read() (cv2 is not thread-safe).
+        self.create_frames(stream, generator, rate=float(rate) or None,
+                           on_stop=capture.release)
         return StreamEvent.OKAY, None
 
     def process_frame(self, stream, images):
@@ -115,23 +117,19 @@ class VideoReadWebcam(PipelineElement):
         def generator(stream_, frame_id):
             okay, bgr = capture.read()
             if not okay:
-                capture.release()
                 return StreamEvent.STOP, None
             return StreamEvent.OKAY, {"images": [bgr[:, :, ::-1]]}
 
         rate, _ = self.get_parameter("rate", 0, stream=stream)
-        stream.variables["webcam_capture"] = capture
-        self.create_frames(stream, generator, rate=float(rate) or None)
+        # Generator thread owns the capture (see VideoReadFile): a
+        # stop_stream release would race a blocked capture.read() on the
+        # generator thread — cv2.VideoCapture is not thread-safe.
+        self.create_frames(stream, generator, rate=float(rate) or None,
+                           on_stop=capture.release)
         return StreamEvent.OKAY, None
 
     def process_frame(self, stream, images):
         return StreamEvent.OKAY, {"images": images}
-
-    def stop_stream(self, stream, stream_id):
-        capture = stream.variables.pop("webcam_capture", None)
-        if capture is not None:
-            capture.release()
-        return StreamEvent.OKAY, None
 
 
 class VideoShow(PipelineElement):

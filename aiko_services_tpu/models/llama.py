@@ -25,10 +25,11 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from ..ops.attention import attention_reference, flash_attention
+from ..ops.quant import int8_matmul, is_quantized, quantize_tree
 
 __all__ = ["LlamaConfig", "init_params", "forward", "init_cache",
            "decode_step", "generate_tokens", "prefill", "param_specs",
-           "CONFIGS"]
+           "quantize_params", "CONFIGS"]
 
 
 @dataclasses.dataclass(frozen=True)
@@ -126,6 +127,43 @@ def param_specs(config: LlamaConfig) -> Dict:
     }
 
 
+def quantize_params(params) -> Dict:
+    """Int8 weight-only quantization of the whole parameter tree (per-
+    output-channel scales; norm vectors stay bf16).  Halves HBM bytes
+    per decode step — the decode bottleneck — and fits 8B-class params
+    in one v5e chip's 16 GB."""
+    return quantize_tree(params)
+
+
+def quantized_param_specs(config: LlamaConfig) -> Dict:
+    """PartitionSpecs matching :func:`quantize_params` output: the int8
+    matrix keeps its dense spec; the (1, out) scales shard with the
+    output axis."""
+    def visit(spec):
+        if isinstance(spec, P) and len(spec) == 2:
+            return {"q": spec, "s": P(None, spec[1])}
+        return spec
+    return jax.tree_util.tree_map(
+        visit, param_specs(config),
+        is_leaf=lambda x: isinstance(x, P))
+
+
+def _matmul(x, w):
+    """Dense or int8-quantized matmul, transparently."""
+    if is_quantized(w):
+        return int8_matmul(x, w["q"], w["s"])
+    return x @ w
+
+
+def _embed_lookup(params, tokens, dtype):
+    embed = params["embed"]
+    if is_quantized(embed):
+        # Gather int8 rows, dequantize with the per-feature scales.
+        return (embed["q"][tokens].astype(jnp.float32)
+                * embed["s"]).astype(dtype)
+    return embed[tokens]
+
+
 # --------------------------------------------------------------------------- #
 # Building blocks
 
@@ -159,9 +197,9 @@ def _attention_block(layer, config, x, cos, sin, cache_layer=None,
     batch, seq, _ = x.shape
     h, kv, hd = config.n_heads, config.n_kv_heads, config.head_dim
     normed = rms_norm(x, layer["attn_norm"], config.norm_eps)
-    q = (normed @ layer["wq"]).reshape(batch, seq, h, hd)
-    k = (normed @ layer["wk"]).reshape(batch, seq, kv, hd)
-    v = (normed @ layer["wv"]).reshape(batch, seq, kv, hd)
+    q = _matmul(normed, layer["wq"]).reshape(batch, seq, h, hd)
+    k = _matmul(normed, layer["wk"]).reshape(batch, seq, kv, hd)
+    v = _matmul(normed, layer["wv"]).reshape(batch, seq, kv, hd)
     q = apply_rope(q, cos, sin)
     k = apply_rope(k, cos, sin)
 
@@ -200,15 +238,15 @@ def _attention_block(layer, config, x, cos, sin, cache_layer=None,
         out = attend(q_t, k_t, v_t, causal=True)
         out = out.transpose(0, 2, 1, 3)
 
-    out = out.reshape(batch, seq, h * hd) @ layer["wo"]
+    out = _matmul(out.reshape(batch, seq, h * hd), layer["wo"])
     return x + out.astype(x.dtype), new_cache
 
 
 def _mlp_block(layer, config, x):
     normed = rms_norm(x, layer["mlp_norm"], config.norm_eps)
-    gate = jax.nn.silu((normed @ layer["w_gate"]).astype(jnp.float32))
-    up = (normed @ layer["w_up"]).astype(jnp.float32)
-    return x + ((gate * up).astype(x.dtype) @ layer["w_down"])
+    gate = jax.nn.silu(_matmul(normed, layer["w_gate"]).astype(jnp.float32))
+    up = _matmul(normed, layer["w_up"]).astype(jnp.float32)
+    return x + _matmul((gate * up).astype(x.dtype), layer["w_down"])
 
 
 # --------------------------------------------------------------------------- #
@@ -221,13 +259,13 @@ def forward(params, tokens, config: LlamaConfig, use_flash: bool = True):
     batch, seq = tokens.shape
     positions = jnp.broadcast_to(jnp.arange(seq), (batch, seq))
     cos, sin = _rope_freqs(config, positions)
-    x = params["embed"][tokens]
+    x = _embed_lookup(params, tokens, config.dtype)
     for layer in params["layers"]:
         x, _ = _attention_block(layer, config, x, cos, sin,
                                 use_flash=use_flash)
         x = _mlp_block(layer, config, x)
     x = rms_norm(x, params["final_norm"], config.norm_eps)
-    return (x @ params["lm_head"]).astype(jnp.float32)
+    return _matmul(x, params["lm_head"]).astype(jnp.float32)
 
 
 def init_cache(config: LlamaConfig, batch: int,
@@ -246,15 +284,15 @@ def prefill(params, tokens, cache, config: LlamaConfig):
     batch, seq = tokens.shape
     positions = jnp.broadcast_to(jnp.arange(seq), (batch, seq))
     cos, sin = _rope_freqs(config, positions)
-    x = params["embed"][tokens]
+    x = _embed_lookup(params, tokens, config.dtype)
     new_cache = []
     for layer, cache_layer in zip(params["layers"], cache):
         k_cache = cache_layer["k"]
         normed = rms_norm(x, layer["attn_norm"], config.norm_eps)
         h, kv, hd = config.n_heads, config.n_kv_heads, config.head_dim
-        q = (normed @ layer["wq"]).reshape(batch, seq, h, hd)
-        k = (normed @ layer["wk"]).reshape(batch, seq, kv, hd)
-        v = (normed @ layer["wv"]).reshape(batch, seq, kv, hd)
+        q = _matmul(normed, layer["wq"]).reshape(batch, seq, h, hd)
+        k = _matmul(normed, layer["wk"]).reshape(batch, seq, kv, hd)
+        v = _matmul(normed, layer["wv"]).reshape(batch, seq, kv, hd)
         q, k = apply_rope(q, cos, sin), apply_rope(k, cos, sin)
         k_cache = jax.lax.dynamic_update_slice(
             cache_layer["k"], k.astype(cache_layer["k"].dtype),
@@ -269,10 +307,10 @@ def prefill(params, tokens, cache, config: LlamaConfig):
         v_t = jnp.repeat(v.transpose(0, 2, 1, 3), group, axis=1)
         out = flash_attention(q_t, k_t, v_t, causal=True)
         out = out.transpose(0, 2, 1, 3).reshape(batch, seq, h * hd)
-        x = x + (out @ layer["wo"]).astype(x.dtype)
+        x = x + _matmul(out, layer["wo"]).astype(x.dtype)
         x = _mlp_block(layer, config, x)
     x = rms_norm(x, params["final_norm"], config.norm_eps)
-    logits = (x[:, -1:] @ params["lm_head"]).astype(jnp.float32)
+    logits = _matmul(x[:, -1:], params["lm_head"]).astype(jnp.float32)
     return logits, new_cache
 
 
@@ -282,7 +320,7 @@ def _decode_core(params, token, cache, cache_index, config: LlamaConfig):
     batch = token.shape[0]
     positions = jnp.full((batch, 1), cache_index, jnp.int32)
     cos, sin = _rope_freqs(config, positions)
-    x = params["embed"][token]
+    x = _embed_lookup(params, token, config.dtype)
     new_cache = []
     for layer, cache_layer in zip(params["layers"], cache):
         x, updated = _attention_block(layer, config, x, cos, sin,
@@ -291,7 +329,7 @@ def _decode_core(params, token, cache, cache_index, config: LlamaConfig):
         new_cache.append(updated)
         x = _mlp_block(layer, config, x)
     x = rms_norm(x, params["final_norm"], config.norm_eps)
-    logits = (x @ params["lm_head"]).astype(jnp.float32)
+    logits = _matmul(x, params["lm_head"]).astype(jnp.float32)
     return logits, new_cache
 
 

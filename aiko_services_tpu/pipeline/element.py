@@ -99,47 +99,67 @@ class PipelineElement(Actor):
         self.pipeline.post_frame(stream.stream_id, frame_data)
 
     def create_frames(self, stream: Stream, frame_generator: Callable,
-                      rate: Optional[float] = None):
+                      rate: Optional[float] = None,
+                      on_stop: Optional[Callable] = None):
         """Pull ``(StreamEvent, frame_data)`` from ``frame_generator(stream,
         frame_id)`` on a paced daemon thread, posting frames with mailbox
         backpressure, until the generator reports STOP/ERROR or the stream
-        stops."""
+        stops.
+
+        ``on_stop`` runs on the generator thread when it exits (any
+        cause) — the place to release capture devices the generator owns:
+        releasing from ``stop_stream`` would race a blocked read on this
+        thread (cv2.VideoCapture is not thread-safe across read/release).
+        """
         stop = threading.Event()
         self._generator_stops[str(stream.stream_id)] = stop
         period = (1.0 / rate) if rate else 0.0
         pipeline = self.pipeline
 
         def run():
-            frame_id = 0
-            while not stop.is_set():
-                started = time.monotonic()
-                if pipeline.queued_frame_count() >= \
-                        BACKPRESSURE_QUEUED_FRAMES:
-                    time.sleep(0.005)
-                    continue
-                try:
-                    event, frame_data = frame_generator(stream, frame_id)
-                except Exception:  # noqa: BLE001
-                    self.logger.exception(
-                        "%s: frame generator failed", self.my_id())
-                    pipeline.post_stream_stop(stream.stream_id,
-                                              StreamEvent.ERROR)
-                    return
-                if event != StreamEvent.OKAY:
-                    pipeline.post_stream_stop(stream.stream_id, event)
-                    return
-                pipeline.post_frame(stream.stream_id, frame_data or {})
-                frame_id += 1
-                if period:
-                    elapsed = time.monotonic() - started
-                    if period > elapsed:
-                        time.sleep(period - elapsed)
+            try:
+                self._generator_loop(stream, frame_generator, stop,
+                                     period, pipeline)
+            finally:
+                if on_stop is not None:
+                    try:
+                        on_stop()
+                    except Exception:  # noqa: BLE001
+                        self.logger.exception(
+                            "%s: generator on_stop failed", self.my_id())
 
         thread = threading.Thread(
             target=run, daemon=True,
             name=f"frames-{self.name}-{stream.stream_id}")
         thread.start()
         return thread
+
+    def _generator_loop(self, stream, frame_generator, stop, period,
+                        pipeline):
+        frame_id = 0
+        while not stop.is_set():
+            started = time.monotonic()
+            if pipeline.queued_frame_count() >= \
+                    BACKPRESSURE_QUEUED_FRAMES:
+                time.sleep(0.005)
+                continue
+            try:
+                event, frame_data = frame_generator(stream, frame_id)
+            except Exception:  # noqa: BLE001
+                self.logger.exception(
+                    "%s: frame generator failed", self.my_id())
+                pipeline.post_stream_stop(stream.stream_id,
+                                          StreamEvent.ERROR)
+                return
+            if event != StreamEvent.OKAY:
+                pipeline.post_stream_stop(stream.stream_id, event)
+                return
+            pipeline.post_frame(stream.stream_id, frame_data or {})
+            frame_id += 1
+            if period:
+                elapsed = time.monotonic() - started
+                if period > elapsed:
+                    time.sleep(period - elapsed)
 
     def stop_frame_generator(self, stream_id):
         stop = self._generator_stops.pop(str(stream_id), None)

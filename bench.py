@@ -125,13 +125,18 @@ def bench_pipeline(n_frames=200, warmup=20, image_size=320):
 
 
 def bench_llm_decode(batch=8, prompt_len=128, new_tokens=256,
-                     config_name="small"):
+                     config_name="small", quantize=False):
     import jax
     import jax.numpy as jnp
     from aiko_services_tpu.models import llama
 
     config = llama.CONFIGS[config_name]
     params = llama.init_params(config, jax.random.PRNGKey(0))
+    if quantize:
+        # Int8 weight-only: halves HBM bytes/step (decode is
+        # bandwidth-bound) via the fused Pallas dequant-matmul kernel.
+        params = llama.quantize_params(params)
+        config_name += "+int8"
     tokens = jnp.zeros((batch, prompt_len), jnp.int32)
     cache = llama.init_cache(config, batch,
                              prompt_len + new_tokens + 8)
@@ -174,6 +179,11 @@ def main():
     except Exception as error:  # noqa: BLE001
         log(f"llm bench failed: {error!r}")
         llm_tps = None
+    try:
+        llm_int8_tps = bench_llm_decode(quantize=True)
+    except Exception as error:  # noqa: BLE001
+        log(f"llm int8 bench failed: {error!r}")
+        llm_int8_tps = None
     fps, p50 = bench_pipeline()
     result = {
         "metric": "pipeline frames/sec/chip (fused TPU detector stage; "
@@ -182,6 +192,10 @@ def main():
         "unit": "frames/sec/chip",
         "vs_baseline": round(fps / 50.0, 2),
     }
+    if llm_tps is not None:
+        result["llm_tokens_per_sec_chip"] = round(llm_tps)
+    if llm_int8_tps is not None:
+        result["llm_int8_tokens_per_sec_chip"] = round(llm_int8_tps)
     print(json.dumps(result), flush=True)
 
 

@@ -7,7 +7,9 @@
 set -u
 
 export AIKO_NAMESPACE=${1:-${AIKO_NAMESPACE:-aiko}}
-"$(dirname "$0")/system_stop.sh"
+# Stop our services but keep the broker up: the whole point of reset is
+# to clear the retained election message, which needs a live broker.
+AIKO_STOP_MOSQUITTO=0 "$(dirname "$0")/system_stop.sh"
 
 python - <<'PY'
 import os
@@ -37,3 +39,13 @@ transport.disconnect()
 print(f"cleared retained registrar election topic for namespace "
       f"'{namespace}'")
 PY
+
+# Now the retained state is clean the broker we started may stop too.
+if [ "${AIKO_STOP_MOSQUITTO:-1}" = "1" ]; then
+    RUN_DIR=${AIKO_RUN_DIR:-/tmp/aiko_services_tpu}
+    if [ -f "$RUN_DIR/mosquitto.pid" ]; then
+        kill "$(cat "$RUN_DIR/mosquitto.pid")" 2>/dev/null \
+            && echo "stopped: mosquitto"
+        rm -f "$RUN_DIR/mosquitto.pid"
+    fi
+fi

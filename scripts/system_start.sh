@@ -17,8 +17,13 @@ mkdir -p "$RUN_DIR"
 
 if [ "$AIKO_MQTT_HOST" = "localhost" ] && command -v mosquitto >/dev/null; then
     if ! pgrep -x mosquitto >/dev/null; then
-        mosquitto -d -p "${AIKO_MQTT_PORT:-1883}"
-        echo "started: mosquitto (port ${AIKO_MQTT_PORT:-1883})"
+        # Foreground + nohup (not -d) so we know the pid and stop only
+        # the broker WE started, never a pre-existing system broker.
+        nohup mosquitto -p "${AIKO_MQTT_PORT:-1883}" \
+            >"$RUN_DIR/mosquitto.log" 2>&1 &
+        echo $! > "$RUN_DIR/mosquitto.pid"
+        echo "started: mosquitto (pid $(cat "$RUN_DIR/mosquitto.pid")," \
+             "port ${AIKO_MQTT_PORT:-1883})"
     fi
 fi
 

@@ -1,5 +1,7 @@
 #!/usr/bin/env bash
-# Stop the system services started by system_start.sh.
+# Stop the system services started by system_start.sh.  Only processes
+# recorded in pid files are touched — a pre-existing system broker is
+# never killed.
 # Reference parity: /root/reference/scripts/system_stop.sh (behavior).
 set -u
 
@@ -11,7 +13,9 @@ if [ -f "$RUN_DIR/registrar.pid" ]; then
     rm -f "$RUN_DIR/registrar.pid"
 fi
 
-if [ "${AIKO_STOP_MOSQUITTO:-1}" = "1" ] && pgrep -x mosquitto >/dev/null
-then
-    pkill -x mosquitto && echo "stopped: mosquitto"
+if [ "${AIKO_STOP_MOSQUITTO:-1}" = "1" ] \
+        && [ -f "$RUN_DIR/mosquitto.pid" ]; then
+    kill "$(cat "$RUN_DIR/mosquitto.pid")" 2>/dev/null \
+        && echo "stopped: mosquitto"
+    rm -f "$RUN_DIR/mosquitto.pid"
 fi
