@@ -212,30 +212,35 @@ def _attention_block(layer, config, x, cos, sin, cache_layer=None,
             cache_layer["v"], v.astype(cache_layer["v"].dtype),
             (0, cache_index, 0, 0))
         new_cache = {"k": k_cache, "v": v_cache}
-        k_all = k_cache.transpose(0, 2, 1, 3)     # (b, kv, max_seq, hd)
-        v_all = v_cache.transpose(0, 2, 1, 3)
-        q_t = q.transpose(0, 2, 1, 3)             # (b, h, seq, hd)
+        # GQA without materializing repeated K/V: decode is bound by
+        # streaming the KV cache from HBM, so the query groups fold into
+        # an extra einsum axis instead of copying K/V group× (which
+        # would multiply cache traffic by n_heads/n_kv_heads).
         group = h // kv
-        k_all = jnp.repeat(k_all, group, axis=1)
-        v_all = jnp.repeat(v_all, group, axis=1)
-        s = jnp.einsum("bhqd,bhkd->bhqk", q_t, k_all,
+        q_g = q.reshape(batch, seq, kv, group, hd)
+        s = jnp.einsum("bqkgd,bskd->bkgqs", q_g, k_cache,
                        preferred_element_type=jnp.float32) * hd ** -0.5
         # Mask cache positions beyond the current step.
-        valid = (jnp.arange(cache_layer["k"].shape[1])[None, :]
-                 <= cache_index)
-        s = jnp.where(valid[None, None, :, :], s, -1e30)
+        valid = (jnp.arange(cache_layer["k"].shape[1])
+                 <= cache_index)                    # (max_seq,)
+        s = jnp.where(valid[None, None, None, None, :], s, -1e30)
         weights = jax.nn.softmax(s, axis=-1)
-        out = jnp.einsum("bhqk,bhkd->bhqd",
-                         weights.astype(v_all.dtype), v_all)
-        out = out.transpose(0, 2, 1, 3)
+        out = jnp.einsum("bkgqs,bskd->bqkgd",
+                         weights.astype(v_cache.dtype), v_cache)
+        out = out.reshape(batch, seq, h, hd)
     else:
         new_cache = None
-        group = h // kv
         q_t = q.transpose(0, 2, 1, 3)
-        k_t = jnp.repeat(k.transpose(0, 2, 1, 3), group, axis=1)
-        v_t = jnp.repeat(v.transpose(0, 2, 1, 3), group, axis=1)
-        attend = flash_attention if use_flash else attention_reference
-        out = attend(q_t, k_t, v_t, causal=True)
+        k_t = k.transpose(0, 2, 1, 3)
+        v_t = v.transpose(0, 2, 1, 3)
+        if use_flash:
+            # flash_attention is GQA-native (no repeated K/V in memory).
+            out = flash_attention(q_t, k_t, v_t, causal=True)
+        else:
+            group = h // kv
+            out = attention_reference(
+                q_t, jnp.repeat(k_t, group, axis=1),
+                jnp.repeat(v_t, group, axis=1), causal=True)
         out = out.transpose(0, 2, 1, 3)
 
     out = _matmul(out.reshape(batch, seq, h * hd), layer["wo"])
@@ -301,10 +306,9 @@ def prefill(params, tokens, cache, config: LlamaConfig):
             cache_layer["v"], v.astype(cache_layer["v"].dtype),
             (0, 0, 0, 0))
         new_cache.append({"k": k_cache, "v": v_cache})
-        group = h // kv
         q_t = q.transpose(0, 2, 1, 3)
-        k_t = jnp.repeat(k.transpose(0, 2, 1, 3), group, axis=1)
-        v_t = jnp.repeat(v.transpose(0, 2, 1, 3), group, axis=1)
+        k_t = k.transpose(0, 2, 1, 3)
+        v_t = v.transpose(0, 2, 1, 3)
         out = flash_attention(q_t, k_t, v_t, causal=True)
         out = out.transpose(0, 2, 1, 3).reshape(batch, seq, h * hd)
         x = x + _matmul(out, layer["wo"]).astype(x.dtype)

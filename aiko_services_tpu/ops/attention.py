@@ -111,26 +111,39 @@ def flash_attention(q, k, v, causal: bool = True,
                     block_k: int = DEFAULT_BLOCK_K,
                     interpret: bool = False):
     """Flash attention; dispatches to the Pallas kernel on TPU (or in
-    interpret mode), else the jnp reference."""
+    interpret mode), else the jnp reference.
+
+    Grouped-query attention is native: ``k``/``v`` may carry fewer heads
+    than ``q`` (``heads % kv_heads == 0``) — query-head grid steps index
+    the shared K/V head via the BlockSpec index map, so the repeated K/V
+    never exists in memory (repeating would multiply HBM traffic by the
+    group size)."""
     if sm_scale is None:
         sm_scale = q.shape[-1] ** -0.5
-    on_tpu = jax.default_backend() == "tpu"
-    if not (_PALLAS_TPU and (on_tpu or interpret)):
-        return attention_reference(q, k, v, causal=causal,
-                                   sm_scale=sm_scale)
 
     batch, heads, q_len, head_dim = q.shape
-    k_len = k.shape[2]
+    kv_heads, k_len = k.shape[1], k.shape[2]
+    assert heads % kv_heads == 0, (heads, kv_heads)
+    group = heads // kv_heads
+
+    def fallback():
+        k_full = jnp.repeat(k, group, axis=1) if group > 1 else k
+        v_full = jnp.repeat(v, group, axis=1) if group > 1 else v
+        return attention_reference(q, k_full, v_full, causal=causal,
+                                   sm_scale=sm_scale)
+
+    on_tpu = jax.default_backend() == "tpu"
+    if not (_PALLAS_TPU and (on_tpu or interpret)):
+        return fallback()
     block_q = min(block_q, q_len)
     block_k = min(block_k, k_len)
     if q_len % block_q or k_len % block_k:
-        return attention_reference(q, k, v, causal=causal,
-                                   sm_scale=sm_scale)
+        return fallback()
 
     bh = batch * heads
     q3 = q.reshape(bh, q_len, head_dim)
-    k3 = k.reshape(bh, k_len, head_dim)
-    v3 = v.reshape(bh, k_len, head_dim)
+    k3 = k.reshape(batch * kv_heads, k_len, head_dim)
+    v3 = v.reshape(batch * kv_heads, k_len, head_dim)
 
     grid = (bh, q_len // block_q, k_len // block_k)
     kernel = functools.partial(
@@ -143,10 +156,11 @@ def flash_attention(q, k, v, causal: bool = True,
         in_specs=[
             pl.BlockSpec((1, block_q, head_dim),
                          lambda b, i, j: (b, i, 0)),
+            # Query-head b uses shared K/V head b // group.
             pl.BlockSpec((1, block_k, head_dim),
-                         lambda b, i, j: (b, j, 0)),
+                         lambda b, i, j: (b // group, j, 0)),
             pl.BlockSpec((1, block_k, head_dim),
-                         lambda b, i, j: (b, j, 0)),
+                         lambda b, i, j: (b // group, j, 0)),
         ],
         out_specs=pl.BlockSpec((1, block_q, head_dim),
                                lambda b, i, j: (b, i, 0)),

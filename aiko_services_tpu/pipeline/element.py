@@ -141,7 +141,9 @@ class PipelineElement(Actor):
             started = time.monotonic()
             if pipeline.queued_frame_count() >= \
                     BACKPRESSURE_QUEUED_FRAMES:
-                time.sleep(0.005)
+                # stop.wait (not sleep): a stream destroy must interrupt
+                # pacing promptly so on_stop releases devices at once.
+                stop.wait(0.005)
                 continue
             try:
                 event, frame_data = frame_generator(stream, frame_id)
@@ -159,7 +161,7 @@ class PipelineElement(Actor):
             if period:
                 elapsed = time.monotonic() - started
                 if period > elapsed:
-                    time.sleep(period - elapsed)
+                    stop.wait(period - elapsed)
 
     def stop_frame_generator(self, stream_id):
         stop = self._generator_stops.pop(str(stream_id), None)

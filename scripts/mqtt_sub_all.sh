@@ -1,0 +1,22 @@
+#!/usr/bin/env bash
+# Watch every MQTT topic (protocol-level debugging — the S-expression
+# payloads ARE the test interface, reference scripts/mqtt_sub_all.sh).
+export AIKO_MQTT_HOST=${1:-${AIKO_MQTT_HOST:-localhost}}
+
+if command -v mosquitto_sub >/dev/null; then
+    exec mosquitto_sub -h "$AIKO_MQTT_HOST" -t '#' -v
+fi
+
+# No mosquitto clients installed: fall back to the framework's own
+# transport (works against any broker paho can reach).
+exec python - "$AIKO_MQTT_HOST" <<'PY'
+import sys
+import time
+from aiko_services_tpu.transport import create_message
+
+transport = create_message(
+    "mqtt", message_handler=lambda t, p: print(t, p, flush=True))
+transport.subscribe("#")
+while True:
+    time.sleep(1)
+PY

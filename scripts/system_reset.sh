@@ -44,8 +44,10 @@ PY
 if [ "${AIKO_STOP_MOSQUITTO:-1}" = "1" ]; then
     RUN_DIR=${AIKO_RUN_DIR:-/tmp/aiko_services_tpu}
     if [ -f "$RUN_DIR/mosquitto.pid" ]; then
-        kill "$(cat "$RUN_DIR/mosquitto.pid")" 2>/dev/null \
-            && echo "stopped: mosquitto"
+        PID=$(cat "$RUN_DIR/mosquitto.pid")
+        if [ "$(ps -o comm= -p "$PID" 2>/dev/null)" = "mosquitto" ]; then
+            kill "$PID" 2>/dev/null && echo "stopped: mosquitto"
+        fi
         rm -f "$RUN_DIR/mosquitto.pid"
     fi
 fi

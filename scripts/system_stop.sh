@@ -15,7 +15,10 @@ fi
 
 if [ "${AIKO_STOP_MOSQUITTO:-1}" = "1" ] \
         && [ -f "$RUN_DIR/mosquitto.pid" ]; then
-    kill "$(cat "$RUN_DIR/mosquitto.pid")" 2>/dev/null \
-        && echo "stopped: mosquitto"
+    PID=$(cat "$RUN_DIR/mosquitto.pid")
+    # Guard against pid recycling: only kill if it is still mosquitto.
+    if [ "$(ps -o comm= -p "$PID" 2>/dev/null)" = "mosquitto" ]; then
+        kill "$PID" 2>/dev/null && echo "stopped: mosquitto"
+    fi
     rm -f "$RUN_DIR/mosquitto.pid"
 fi

@@ -214,3 +214,20 @@ def test_llama_quantized_tp_sharded_matches(tiny):
                         use_flash=False)
     np.testing.assert_allclose(np.asarray(out), np.asarray(expected),
                                rtol=6e-2, atol=6e-2)
+
+
+def test_flash_attention_gqa_matches_reference():
+    """GQA path (kv_heads < heads) via BlockSpec index mapping must
+    equal the repeated-K/V reference."""
+    key = jax.random.PRNGKey(5)
+    ks = jax.random.split(key, 3)
+    q = jax.random.normal(ks[0], (2, 8, 128, 64), jnp.float32)
+    k = jax.random.normal(ks[1], (2, 2, 128, 64), jnp.float32)
+    v = jax.random.normal(ks[2], (2, 2, 128, 64), jnp.float32)
+    for causal in (True, False):
+        ref = attention_reference(q, jnp.repeat(k, 4, axis=1),
+                                  jnp.repeat(v, 4, axis=1),
+                                  causal=causal)
+        out = flash_attention(q, k, v, causal=causal, interpret=True,
+                              block_q=64, block_k=64)
+        assert float(jnp.max(jnp.abs(out - ref))) < 1e-5
