@@ -1,4 +1,5 @@
 from . import llama
+from . import moe
 from . import classifier
 from . import detector
 from . import asr

@@ -44,10 +44,27 @@ class LlamaConfig:
     norm_eps: float = 1e-5
     max_seq_len: int = 2048
     dtype: Any = jnp.bfloat16
+    #: > 0 switches the MLP to a mixture-of-experts (Mixtral-class);
+    #: experts shard over the "ep" mesh axis.
+    n_experts: int = 0
+    moe_top_k: int = 2
+    #: Capacity-based token dropping makes routing batch-dependent (a
+    #: dropped token depends on its neighbors — standard GShard
+    #: semantics).  cf >= n_experts/top_k guarantees no drops, which
+    #: keeps decode exactly consistent with full-sequence forward.
+    moe_capacity_factor: float = 2.0
 
     @property
     def head_dim(self) -> int:
         return self.d_model // self.n_heads
+
+    @property
+    def moe_config(self):
+        from .moe import MoEConfig
+        return MoEConfig(d_model=self.d_model, d_ff=self.d_ff,
+                         n_experts=self.n_experts, top_k=self.moe_top_k,
+                         capacity_factor=self.moe_capacity_factor,
+                         dtype=self.dtype)
 
 
 #: Named configs: tiny/small for tests+bench on one chip, the real ones
@@ -68,6 +85,16 @@ CONFIGS: Dict[str, LlamaConfig] = {
     "llama3_70b": LlamaConfig(vocab_size=128_256, d_model=8192,
                               n_layers=80, n_heads=64, n_kv_heads=8,
                               d_ff=28_672, max_seq_len=8192),
+    "moe_tiny": LlamaConfig(vocab_size=1024, d_model=128, n_layers=2,
+                            n_heads=4, n_kv_heads=2, d_ff=352,
+                            max_seq_len=512, n_experts=4),
+    # cf=4.0 = n_experts/top_k: the no-drop bound, so cached decode stays
+    # exactly consistent with full-sequence forward (see moe_capacity_factor).
+    "mixtral_8x7b": LlamaConfig(vocab_size=32_000, d_model=4096,
+                                n_layers=32, n_heads=32, n_kv_heads=8,
+                                d_ff=14_336, max_seq_len=32_768,
+                                rope_theta=1e6, n_experts=8,
+                                moe_capacity_factor=4.0),
 }
 
 
@@ -87,18 +114,25 @@ def init_params(config: LlamaConfig, key) -> Dict:
                        config.head_dim, config.d_ff)
     layers = []
     for i in range(config.n_layers):
-        lk = jax.random.split(keys[i], 7)
-        layers.append({
+        lk = jax.random.split(keys[i], 8)
+        layer = {
             "attn_norm": jnp.ones((d,), dt),
             "wq": _dense_init(lk[0], (d, h * hd), dt),
             "wk": _dense_init(lk[1], (d, kv * hd), dt),
             "wv": _dense_init(lk[2], (d, kv * hd), dt),
             "wo": _dense_init(lk[3], (h * hd, d), dt),
             "mlp_norm": jnp.ones((d,), dt),
-            "w_gate": _dense_init(lk[4], (d, f), dt),
-            "w_up": _dense_init(lk[5], (d, f), dt),
-            "w_down": _dense_init(lk[6], (f, d), dt),
-        })
+        }
+        if config.n_experts:
+            from .moe import init_moe_params
+            layer["moe"] = init_moe_params(config.moe_config, lk[7])
+        else:
+            layer.update({
+                "w_gate": _dense_init(lk[4], (d, f), dt),
+                "w_up": _dense_init(lk[5], (d, f), dt),
+                "w_down": _dense_init(lk[6], (f, d), dt),
+            })
+        layers.append(layer)
     return {
         "embed": _dense_init(keys[-3], (config.vocab_size, d), dt, 1.0),
         "layers": layers,
@@ -116,9 +150,15 @@ def param_specs(config: LlamaConfig) -> Dict:
         "wq": P(None, "tp"), "wk": P(None, "tp"), "wv": P(None, "tp"),
         "wo": P("tp", None),
         "mlp_norm": P(),
-        "w_gate": P(None, "tp"), "w_up": P(None, "tp"),
-        "w_down": P("tp", None),
     }
+    if config.n_experts:
+        from .moe import moe_param_specs
+        layer["moe"] = moe_param_specs()
+    else:
+        layer.update({
+            "w_gate": P(None, "tp"), "w_up": P(None, "tp"),
+            "w_down": P("tp", None),
+        })
     return {
         "embed": P("tp", None),
         "layers": [dict(layer) for _ in range(config.n_layers)],
@@ -249,6 +289,10 @@ def _attention_block(layer, config, x, cos, sin, cache_layer=None,
 
 def _mlp_block(layer, config, x):
     normed = rms_norm(x, layer["mlp_norm"], config.norm_eps)
+    if "moe" in layer:
+        from .moe import moe_ffn
+        return x + moe_ffn(layer["moe"], normed,
+                           config.moe_config).astype(x.dtype)
     gate = jax.nn.silu(_matmul(normed, layer["w_gate"]).astype(jnp.float32))
     up = _matmul(normed, layer["w_up"]).astype(jnp.float32)
     return x + _matmul((gate * up).astype(x.dtype), layer["w_down"])

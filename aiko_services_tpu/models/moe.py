@@ -1,0 +1,164 @@
+"""Mixture-of-Experts SwiGLU layer with expert parallelism (EP).
+
+The reference framework has no tensor math at all (SURVEY.md §2.6); EP
+completes this framework's parallelism matrix (dp/tp/pp/sp/ep).  The
+design is the standard TPU dispatch/combine formulation (GShard/Switch):
+top-k routing builds a ``(tokens, experts, capacity)`` dispatch one-hot,
+token→expert transport is two einsums (which XLA lowers to all-to-all
+when experts are sharded over the ``ep`` mesh axis), and every expert
+runs as one batched FFN — no per-token Python, fully jit/pjit-friendly,
+static shapes via the capacity bound.
+
+Tokens overflowing an expert's capacity are dropped (standard capacity-
+factor semantics): their combine weight is zero, so they pass through
+the residual unchanged.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..ops.quant import int8_matmul, is_quantized
+
+__all__ = ["MoEConfig", "init_moe_params", "moe_ffn", "moe_param_specs",
+           "top_k_gating"]
+
+
+@dataclasses.dataclass(frozen=True)
+class MoEConfig:
+    d_model: int = 128
+    d_ff: int = 256
+    n_experts: int = 8
+    top_k: int = 2
+    capacity_factor: float = 1.25
+    dtype: Any = jnp.bfloat16
+
+
+def init_moe_params(config: MoEConfig, key) -> Dict:
+    kr, kg, ku, kd = jax.random.split(key, 4)
+    d, f, e = config.d_model, config.d_ff, config.n_experts
+    dt = config.dtype
+    scale = d ** -0.5
+
+    def init(k, shape):
+        return (jax.random.normal(k, shape, jnp.float32) * scale).astype(dt)
+
+    return {
+        "router": init(kr, (d, e)),
+        "w_gate": init(kg, (e, d, f)),
+        "w_up": init(ku, (e, d, f)),
+        "w_down": (jax.random.normal(kd, (e, f, d), jnp.float32)
+                   * f ** -0.5).astype(dt),
+    }
+
+
+def moe_param_specs() -> Dict:
+    """Experts shard over the ``ep`` mesh axis; the router replicates."""
+    return {
+        "router": P(),
+        "w_gate": P("ep", None, None),
+        "w_up": P("ep", None, None),
+        "w_down": P("ep", None, None),
+    }
+
+
+def top_k_gating(logits, top_k: int, capacity: int):
+    """Router logits ``(T, E)`` → dispatch ``(T, E, C)`` one-hot and
+    combine ``(T, E, C)`` weights (f32).
+
+    Position within each expert's capacity buffer is the token's rank
+    among tokens routed to that expert (cumsum order); ranks ≥ capacity
+    are dropped.
+    """
+    tokens, n_experts = logits.shape
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    # Top-k expert ids per token, highest prob first.
+    _, expert_ids = jax.lax.top_k(probs, top_k)          # (T, k)
+    one_hot = jax.nn.one_hot(expert_ids, n_experts,
+                             dtype=jnp.float32)           # (T, k, E)
+    # Slot position: rank among all (token, choice) pairs bound for the
+    # expert, counted token-major then choice-major.
+    flat = one_hot.reshape(tokens * top_k, n_experts)
+    position = jnp.cumsum(flat, axis=0) - flat            # (T*k, E)
+    position = (position * flat).sum(-1).reshape(tokens, top_k)
+    keep = position < capacity
+    gate = jnp.take_along_axis(probs, expert_ids, axis=-1)   # (T, k)
+    # Renormalize over the chosen k (standard top-2 normalization).
+    gate = gate / jnp.maximum(gate.sum(-1, keepdims=True), 1e-9)
+    gate = jnp.where(keep, gate, 0.0)
+    position = jnp.where(keep, position, 0).astype(jnp.int32)
+    slot_hot = jax.nn.one_hot(position, capacity, dtype=jnp.float32)
+    # (T, k, E, C) → sum over choices k.
+    dispatch = jnp.einsum("tke,tkc->tec", one_hot,
+                          slot_hot * keep[..., None].astype(jnp.float32))
+    combine = jnp.einsum("tke,tkc->tec", one_hot,
+                         slot_hot * gate[..., None])
+    return dispatch, combine
+
+
+@functools.partial(jax.jit, static_argnames=("config",))
+def moe_ffn(params, x, config: MoEConfig):
+    """``x (batch, seq, d)`` → MoE SwiGLU output (same shape, residual
+    NOT included — caller adds)."""
+    batch, seq, d = x.shape
+    tokens = batch * seq
+    xt = x.reshape(tokens, d)
+    capacity = max(1, int(config.capacity_factor * tokens
+                          * config.top_k / config.n_experts))
+    router = params["router"]
+    if is_quantized(router):
+        # quantize_tree quantizes every 2-D leaf, the router included;
+        # the 3-D expert weights stay in the model dtype (weight-only
+        # int8 targets the big dense matrices, not einsum experts).
+        logits = int8_matmul(xt.astype(jnp.float32), router["q"],
+                             router["s"])
+    else:
+        logits = xt.astype(jnp.float32) @ router.astype(jnp.float32)
+    dispatch, combine = top_k_gating(logits, config.top_k, capacity)
+    # Token → expert slot transport (all-to-all under an ep-sharded mesh).
+    expert_in = jnp.einsum("tec,td->ecd",
+                           dispatch.astype(x.dtype), xt)   # (E, C, d)
+    gate = jax.nn.silu(jnp.einsum(
+        "ecd,edf->ecf", expert_in, params["w_gate"]).astype(jnp.float32))
+    up = jnp.einsum("ecd,edf->ecf", expert_in,
+                    params["w_up"]).astype(jnp.float32)
+    expert_out = jnp.einsum("ecf,efd->ecd",
+                            (gate * up).astype(x.dtype),
+                            params["w_down"])              # (E, C, d)
+    out = jnp.einsum("tec,ecd->td", combine.astype(x.dtype), expert_out)
+    return out.reshape(batch, seq, d)
+
+
+def moe_ffn_reference(params, x, config: MoEConfig):
+    """Per-token loop oracle (numpy-slow; tests only)."""
+    import numpy as np
+    batch, seq, d = x.shape
+    xt = np.asarray(x, np.float32).reshape(-1, d)
+    tokens = xt.shape[0]
+    capacity = max(1, int(config.capacity_factor * tokens
+                          * config.top_k / config.n_experts))
+    logits = xt @ np.asarray(params["router"], np.float32)
+    probs = np.asarray(jax.nn.softmax(jnp.asarray(logits), -1))
+    w_gate = np.asarray(params["w_gate"], np.float32)
+    w_up = np.asarray(params["w_up"], np.float32)
+    w_down = np.asarray(params["w_down"], np.float32)
+    counts = [0] * config.n_experts
+    out = np.zeros_like(xt)
+    for t in range(tokens):
+        ids = np.argsort(-probs[t])[:config.top_k]
+        gates = probs[t, ids]
+        gates = gates / max(gates.sum(), 1e-9)
+        for expert, g in zip(ids, gates):
+            if counts[expert] >= capacity:
+                continue
+            counts[expert] += 1
+            h = xt[t] @ w_gate[expert]
+            silu = h / (1.0 + np.exp(-h)) * (xt[t] @ w_up[expert])
+            out[t] += g * (silu @ w_down[expert])
+    return out.reshape(batch, seq, d)

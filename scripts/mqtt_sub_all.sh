@@ -8,9 +8,8 @@ if command -v mosquitto_sub >/dev/null; then
 fi
 
 # No mosquitto clients installed: fall back to the framework's own
-# transport (works against any broker paho can reach).
-exec python - "$AIKO_MQTT_HOST" <<'PY'
-import sys
+# transport (reads AIKO_MQTT_HOST from the environment).
+exec python - <<'PY'
 import time
 from aiko_services_tpu.transport import create_message
 
