@@ -418,3 +418,52 @@ def generate_tokens(params, first_token, cache, start_index, num_steps,
         body, (first_token, cache, rng_key),
         jnp.arange(num_steps, dtype=jnp.int32))
     return tokens.T, cache   # (batch, num_steps)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("config", "mesh", "n_microbatches",
+                                    "pp_axis"))
+def pipeline_forward(params, tokens, config: LlamaConfig, mesh,
+                     n_microbatches: int = 4, pp_axis: str = "pp"):
+    """Full-sequence forward with the transformer layers split into
+    GPipe pipeline stages over the ``pp_axis`` mesh axis (embed, final
+    norm and LM head stay replicated outside the pipeline; activations
+    hop stage-to-stage with ``ppermute`` over ICI).  Numerics match
+    :func:`forward` up to bf16 rounding at stage boundaries: the loop
+    carry materializes activations in the model dtype each hop, where
+    the fused single-program forward may keep excess precision.
+
+    The host-level PP story (reference remote PipelineElements with MQTT
+    frame hops) stays for cross-pod boundaries; this is the on-pod
+    equivalent inside ONE jitted program.
+    """
+    from ..parallel.pipeline_parallel import (
+        pipeline_apply_sharded, stack_stages,
+    )
+    pp = mesh.shape[pp_axis]
+    assert config.n_layers % pp == 0, (config.n_layers, pp)
+    per_stage = config.n_layers // pp
+    layers = params["layers"]
+    stages = stack_stages([
+        stack_stages(layers[s * per_stage:(s + 1) * per_stage])
+        for s in range(pp)
+    ])   # leaves stacked (pp, per_stage, ...)
+
+    batch, seq = tokens.shape
+
+    def stage_fn(stage_params, x):
+        positions = jnp.broadcast_to(jnp.arange(seq),
+                                     (x.shape[0], seq))
+        cos, sin = _rope_freqs(config, positions)
+        for j in range(per_stage):
+            layer = jax.tree.map(lambda leaf: leaf[j], stage_params)
+            x, _ = _attention_block(layer, config, x, cos, sin,
+                                    use_flash=False)
+            x = _mlp_block(layer, config, x)
+        return x
+
+    x = _embed_lookup(params, tokens, config.dtype)
+    x = pipeline_apply_sharded(stage_fn, stages, x, mesh, axis=pp_axis,
+                               n_microbatches=n_microbatches)
+    x = rms_norm(x, params["final_norm"], config.norm_eps)
+    return _matmul(x, params["lm_head"]).astype(jnp.float32)

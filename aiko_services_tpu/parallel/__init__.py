@@ -4,5 +4,8 @@ from .collective_matmul import (
     allgather_matmul, matmul_reducescatter,
     allgather_matmul_sharded, matmul_reducescatter_sharded,
 )
+from .pipeline_parallel import (
+    pipeline_apply, pipeline_apply_sharded, stack_stages,
+)
 from .checkpoint import (TrainCheckpointer, StreamCheckpoint,
                          save_stream_checkpoint, load_stream_checkpoint)

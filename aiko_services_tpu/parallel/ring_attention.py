@@ -69,9 +69,12 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = True,
     m = jnp.full((batch, heads, seq_local, 1), NEG_INF, jnp.float32)
     l = jnp.zeros((batch, heads, seq_local, 1), jnp.float32)
     acc = jnp.zeros((batch, heads, seq_local, head_dim), jnp.float32)
-    if hasattr(jax.lax, "pvary"):
-        # shard_map's varying-axis tracking: the carry becomes 'sp'-varying
-        # after the first step, so the init must be marked varying too.
+    # shard_map's varying-axis tracking: the carry becomes 'sp'-varying
+    # after the first step, so the init must be marked varying too.
+    if hasattr(jax.lax, "pcast"):          # jax >= 0.8
+        m, l, acc = (jax.lax.pcast(x, axis_name, to="varying")
+                     for x in (m, l, acc))
+    elif hasattr(jax.lax, "pvary"):        # deprecated predecessor
         m, l, acc = (jax.lax.pvary(x, axis_name) for x in (m, l, acc))
 
     perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
