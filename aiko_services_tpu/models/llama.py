@@ -437,17 +437,15 @@ def pipeline_forward(params, tokens, config: LlamaConfig, mesh,
     frame hops) stays for cross-pod boundaries; this is the on-pod
     equivalent inside ONE jitted program.
     """
-    from ..parallel.pipeline_parallel import (
-        pipeline_apply_sharded, stack_stages,
-    )
+    from ..parallel.pipeline_parallel import pipeline_apply_sharded
     pp = mesh.shape[pp_axis]
     assert config.n_layers % pp == 0, (config.n_layers, pp)
     per_stage = config.n_layers // pp
-    layers = params["layers"]
-    stages = stack_stages([
-        stack_stages(layers[s * per_stage:(s + 1) * per_stage])
-        for s in range(pp)
-    ])   # leaves stacked (pp, per_stage, ...)
+    if stages is None:
+        # Convenience path: stacks inside the compiled program (an
+        # O(model) copy per call) — for repeated calls pre-stack with
+        # :func:`stack_pipeline_params` and pass ``stages=``.
+        stages = stack_pipeline_params(params, config, pp)
 
     batch, seq = tokens.shape
 

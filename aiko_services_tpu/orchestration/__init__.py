@@ -3,3 +3,6 @@ from .lifecycle import (
     LifeCycleManager, LifeCycleClient,
     HANDSHAKE_LEASE_TIME, DELETION_LEASE_TIME,
 )
+from .serving import (
+    ModelReplica, ReplicaRouter, REPLICA_PROTOCOL, make_llama_infer,
+)

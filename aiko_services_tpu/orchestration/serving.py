@@ -1,0 +1,153 @@
+"""Data-parallel model replica serving.
+
+The reference's LifeCycleManager runs fleets of identical clients
+(SURVEY.md §2.6 maps that to data-parallel replica serving); this module
+gives that shape a concrete model-serving form, matching the
+BASELINE.md "multi-replica serving actors, DP over chips" workload:
+
+- :class:`ModelReplica` — an Actor hosting one model instance (one chip
+  / one mesh slice).  Wire protocol:
+  ``(infer request_id response_topic (payload…))`` → runs the model,
+  publishes ``(infer_response request_id (outputs…))`` to
+  ``response_topic`` — the reference's response-topic idiom
+  (main/storage.py:87-103).
+- :class:`ReplicaRouter` — an Actor that discovers replicas through the
+  ServicesCache (by protocol), load-balances requests round-robin, and
+  prunes replicas the moment the Registrar evicts them (LWT death or
+  lease expiry).  Routing is fire-and-forget pass-through: the
+  *original* response topic rides along, so the router holds no
+  per-request state and is itself replicable.
+
+Payloads are swag-codec dicts (numpy arrays travel as typed tags), so
+token tensors cross process boundaries losslessly.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional
+
+from ..pipeline.codec import decode_swag, encode_swag
+from ..registry.services_cache import services_cache_create_singleton
+from ..runtime.actor import Actor
+from ..runtime.service import ServiceFilter
+from ..utils.sexpr import generate
+
+__all__ = ["ModelReplica", "ReplicaRouter", "REPLICA_PROTOCOL",
+           "make_llama_infer"]
+
+REPLICA_PROTOCOL = "model_replica:0"
+
+
+class ModelReplica(Actor):
+    """Hosts one model instance and serves ``infer`` requests."""
+
+    def __init__(self, context, process=None,
+                 infer: Optional[Callable[[Dict], Dict]] = None):
+        context.protocol = context.protocol or REPLICA_PROTOCOL
+        super().__init__(context, process)
+        self._infer = infer or (lambda payload: payload)
+        self._command_handlers["infer"] = self._wire_infer
+        self.share["requests_served"] = 0
+
+    def _wire_infer(self, request_id, response_topic, payload=None):
+        inputs = decode_swag(payload or {})
+        try:
+            outputs = self._infer(inputs)
+        except Exception:  # noqa: BLE001 - a bad request must not kill us
+            self.logger.exception("%s: infer failed for %s", self.name,
+                                  request_id)
+            outputs = {"error": "infer_failed"}
+        self.share["requests_served"] += 1
+        if self.ec_producer is not None:
+            self.ec_producer.update("requests_served",
+                                    self.share["requests_served"])
+        self.process.message.publish(
+            response_topic,
+            generate("infer_response",
+                     [str(request_id), encode_swag(outputs)]))
+
+
+class ReplicaRouter(Actor):
+    """Discovers :class:`ModelReplica` services and round-robins
+    ``infer`` requests across the live set."""
+
+    def __init__(self, context, process=None,
+                 replica_protocol: str = REPLICA_PROTOCOL):
+        super().__init__(context, process)
+        self._replicas: List[str] = []   # replica topic paths, stable order
+        self._next = 0
+        self._command_handlers["infer"] = self.route
+        self.share["replicas"] = 0
+        self._cache = services_cache_create_singleton(self.process)
+        self._cache.add_handler(
+            ServiceFilter(protocol=replica_protocol),
+            self._replica_added, self._replica_removed)
+
+    def _replica_added(self, fields):
+        if fields.topic_path not in self._replicas:
+            self._replicas.append(fields.topic_path)
+            self._replicas.sort()
+            self._update_share()
+            self.logger.info("%s: replica up %s (%d live)", self.name,
+                             fields.topic_path, len(self._replicas))
+
+    def _replica_removed(self, fields):
+        if fields.topic_path in self._replicas:
+            self._replicas.remove(fields.topic_path)
+            self._update_share()
+            self.logger.info("%s: replica down %s (%d live)", self.name,
+                             fields.topic_path, len(self._replicas))
+
+    def _update_share(self):
+        self.share["replicas"] = len(self._replicas)
+        if self.ec_producer is not None:
+            self.ec_producer.update("replicas", len(self._replicas))
+
+    def route(self, request_id, response_topic, payload=None) -> bool:
+        """Forward one request to the next live replica.  Returns False
+        (and logs) when no replicas are live — the caller's retry is the
+        recovery path, per the fire-and-forget idiom."""
+        if not self._replicas:
+            self.logger.warning("%s: no live replicas for %s",
+                                self.name, request_id)
+            return False
+        target = self._replicas[self._next % len(self._replicas)]
+        self._next += 1
+        self.process.message.publish(
+            f"{target}/in",
+            generate("infer", [str(request_id), str(response_topic),
+                               payload or {}]))
+        return True
+
+
+def make_llama_infer(config_name: str = "tiny", quantize: bool = False,
+                     max_new_tokens: int = 16, seed: int = 0) -> Callable:
+    """Build a ModelReplica ``infer`` callable running the flagship
+    Llama-architecture model: ``{"tokens": (batch, prompt)}`` →
+    ``{"tokens_out": (batch, prompt+new)}``."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from ..models import llama
+
+    config = llama.CONFIGS[config_name]
+    params = llama.init_params(config, jax.random.PRNGKey(seed))
+    if quantize:
+        params = llama.quantize_params(params)
+
+    def infer(inputs: Dict) -> Dict:
+        tokens = jnp.asarray(np.asarray(inputs["tokens"]), jnp.int32)
+        if tokens.ndim == 1:
+            tokens = tokens[None]
+        batch, prompt_len = tokens.shape
+        new = min(max_new_tokens, config.max_seq_len - prompt_len)
+        cache = llama.init_cache(config, batch, prompt_len + new)
+        logits, cache = llama.prefill(params, tokens, cache, config)
+        first = logits[:, -1].argmax(-1).astype(jnp.int32)[:, None]
+        generated, _ = llama.generate_tokens(
+            params, first, cache, jnp.int32(prompt_len), new - 1, config)
+        return {"tokens_out": np.concatenate(
+            [np.asarray(tokens), np.asarray(first),
+             np.asarray(generated)], axis=1)}
+
+    return infer

@@ -44,15 +44,7 @@ def _ring_perm(axis_name):
     return [(i, (i + 1) % size) for i in range(size)]
 
 
-def _mark_varying(x, axis_name):
-    """shard_map varying-axis tracking: loop carries that pass through
-    ``ppermute`` become axis-varying, so their zero-init must be marked
-    varying too (same dance as ring_attention)."""
-    if hasattr(jax.lax, "pcast"):          # jax >= 0.8
-        return jax.lax.pcast(x, axis_name, to="varying")
-    if hasattr(jax.lax, "pvary"):          # deprecated predecessor
-        return jax.lax.pvary(x, axis_name)
-    return x
+from .mesh import mark_varying as _mark_varying
 
 
 def allgather_matmul(x_shard, w_shard, axis_name: str):

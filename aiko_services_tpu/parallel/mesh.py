@@ -98,3 +98,15 @@ def logical_axis_rules(mesh: Mesh) -> Dict[str, Optional[str]]:
         "vocab": "tp" if "tp" in names else None,
         "stage": "pp" if "pp" in names else None,
     }
+
+
+def mark_varying(x, axis_name):
+    """shard_map varying-axis tracking: loop carries that pass through
+    ``ppermute`` become axis-varying, so zero-inits must be marked
+    varying too.  Single home for the jax version dispatch."""
+    import jax
+    if hasattr(jax.lax, "pcast"):          # jax >= 0.8
+        return jax.lax.pcast(x, axis_name, to="varying")
+    if hasattr(jax.lax, "pvary"):          # deprecated predecessor
+        return jax.lax.pvary(x, axis_name)
+    return x

@@ -39,12 +39,7 @@ def stack_stages(per_stage_params):
                         *per_stage_params)
 
 
-def _mark_varying(x, axis_name):
-    if hasattr(jax.lax, "pcast"):          # jax >= 0.8
-        return jax.lax.pcast(x, axis_name, to="varying")
-    if hasattr(jax.lax, "pvary"):          # deprecated predecessor
-        return jax.lax.pvary(x, axis_name)
-    return x
+from .mesh import mark_varying as _mark_varying
 
 
 def pipeline_apply(stage_fn: Callable, stage_params, microbatches,

@@ -71,11 +71,8 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = True,
     acc = jnp.zeros((batch, heads, seq_local, head_dim), jnp.float32)
     # shard_map's varying-axis tracking: the carry becomes 'sp'-varying
     # after the first step, so the init must be marked varying too.
-    if hasattr(jax.lax, "pcast"):          # jax >= 0.8
-        m, l, acc = (jax.lax.pcast(x, axis_name, to="varying")
-                     for x in (m, l, acc))
-    elif hasattr(jax.lax, "pvary"):        # deprecated predecessor
-        m, l, acc = (jax.lax.pvary(x, axis_name) for x in (m, l, acc))
+    from .mesh import mark_varying
+    m, l, acc = (mark_varying(x, axis_name) for x in (m, l, acc))
 
     perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
 

@@ -1,0 +1,120 @@
+"""DP replica serving: router discovery, round-robin, failover, and the
+llama replica end-to-end (loopback broker, virtual clock)."""
+
+import numpy as np
+
+from aiko_services_tpu.orchestration.serving import (
+    ModelReplica, ReplicaRouter, make_llama_infer,
+)
+from aiko_services_tpu.pipeline.codec import decode_swag, encode_swag
+from aiko_services_tpu.registry import Registrar
+from aiko_services_tpu.runtime import (
+    Process, actor_args, compose_instance,
+)
+from aiko_services_tpu.utils.sexpr import generate, parse
+
+
+def make_process(engine, pid, broker="serve"):
+    return Process(namespace="test", hostname="h", pid=str(pid),
+                   engine=engine, broker=broker)
+
+
+def collect_responses(process, topic, into):
+    def handler(_topic, payload):
+        command, params = parse(payload)
+        if command == "infer_response":
+            into.append((params[0], decode_swag(params[1])))
+    process.add_message_handler(handler, topic)
+
+
+def test_round_robin_and_failover(engine):
+    p0 = make_process(engine, 1)
+    Registrar(process=p0)
+    engine.advance(4.0)
+
+    replica_procs, replicas = [], []
+    for i in range(3):
+        p = make_process(engine, 10 + i)
+        replica = compose_instance(
+            ModelReplica, actor_args(f"replica_{i}"), process=p,
+            infer=lambda payload: {"doubled": payload["value"] * 2})
+        replica_procs.append(p)
+        replicas.append(replica)
+
+    pr = make_process(engine, 99)
+    router = compose_instance(ReplicaRouter, actor_args("router"),
+                              process=pr)
+    engine.drain()
+    assert router.share["replicas"] == 3
+
+    responses = []
+    response_topic = "test/h/99/client/response"
+    collect_responses(pr, response_topic, responses)
+
+    for i in range(9):
+        pr.message.publish(
+            f"{router.topic_path}/in",
+            generate("infer", [f"req{i}", response_topic,
+                               encode_swag({"value": np.int64(i)})]))
+    engine.drain()
+    assert len(responses) == 9
+    assert sorted(int(v["doubled"]) for _, v in responses) == \
+        [2 * i for i in range(9)]
+    served = [r.share["requests_served"] for r in replicas]
+    assert served == [3, 3, 3]        # perfect round-robin
+
+    # Kill one replica process: LWT -> registrar eviction -> router prune.
+    replica_procs[0].kill()
+    engine.drain()
+    assert router.share["replicas"] == 2
+
+    responses.clear()
+    for i in range(4):
+        pr.message.publish(
+            f"{router.topic_path}/in",
+            generate("infer", [f"again{i}", response_topic,
+                               encode_swag({"value": np.int64(i)})]))
+    engine.drain()
+    assert len(responses) == 4        # only live replicas were used
+
+
+def test_router_reports_no_replicas(engine):
+    p0 = make_process(engine, 1, broker="empty")
+    Registrar(process=p0)
+    engine.advance(4.0)
+    pr = make_process(engine, 2, broker="empty")
+    router = compose_instance(ReplicaRouter, actor_args("router"),
+                              process=pr)
+    engine.drain()
+    assert router.route("r1", "test/topic", {}) is False
+
+
+def test_llama_replica_end_to_end(engine):
+    p0 = make_process(engine, 1, broker="llm")
+    Registrar(process=p0)
+    engine.advance(4.0)
+
+    p1 = make_process(engine, 2, broker="llm")
+    compose_instance(ModelReplica, actor_args("llm_replica"), process=p1,
+                     infer=make_llama_infer("tiny", max_new_tokens=4))
+    pr = make_process(engine, 3, broker="llm")
+    router = compose_instance(ReplicaRouter, actor_args("router"),
+                              process=pr)
+    engine.drain()
+    assert router.share["replicas"] == 1
+
+    responses = []
+    response_topic = "test/h/3/client/response"
+    collect_responses(pr, response_topic, responses)
+    prompt = np.arange(1, 9, dtype=np.int32)[None, :]
+    pr.message.publish(
+        f"{router.topic_path}/in",
+        generate("infer", ["chat1", response_topic,
+                           encode_swag({"tokens": prompt})]))
+    engine.drain()
+    assert len(responses) == 1
+    request_id, outputs = responses[0]
+    assert request_id == "chat1"
+    tokens_out = np.asarray(outputs["tokens_out"])
+    assert tokens_out.shape == (1, 12)
+    assert (tokens_out[:, :8] == prompt).all()
