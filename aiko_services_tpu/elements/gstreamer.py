@@ -217,9 +217,13 @@ class VideoStreamWriter:                 # pragma: no cover - needs gst
         # Downstream negotiation requires explicit raw-video caps, and
         # live timestamping so x264enc sees monotonic PTS.
         width, height = size
+        # Fractional rates (29.97 = 30000/1001) must survive as Gst
+        # fractions — int truncation misdeclares the stream rate.
+        from fractions import Fraction
+        rate = Fraction(frame_rate).limit_denominator(1001)
         caps = Gst.Caps.from_string(
             f"video/x-raw,format=RGB,width={width},height={height},"
-            f"framerate={int(frame_rate)}/1")
+            f"framerate={rate.numerator}/{rate.denominator}")
         self._src.set_property("caps", caps)
         self._src.set_property("format", Gst.Format.TIME)
         self._src.set_property("is-live", True)

@@ -29,7 +29,8 @@ from ..ops.quant import int8_matmul, is_quantized, quantize_tree
 
 __all__ = ["LlamaConfig", "init_params", "forward", "init_cache",
            "decode_step", "generate_tokens", "prefill", "param_specs",
-           "quantize_params", "CONFIGS"]
+           "quantize_params", "pipeline_forward", "stack_pipeline_params",
+           "CONFIGS"]
 
 
 @dataclasses.dataclass(frozen=True)
@@ -183,9 +184,16 @@ def quantized_param_specs(config: LlamaConfig) -> Dict:
         if isinstance(spec, P) and len(spec) == 2:
             return {"q": spec, "s": P(None, spec[1])}
         return spec
-    return jax.tree_util.tree_map(
+    specs = jax.tree_util.tree_map(
         visit, param_specs(config),
         is_leaf=lambda x: isinstance(x, P))
+    if config.n_experts:
+        # The 2-D MoE router also quantizes, but its spec is a bare P()
+        # (len 0) which the length-2 rule above misses; 3-D expert
+        # weights stay dense (quantize_tree only touches ndim==2).
+        for layer in specs["layers"]:
+            layer["moe"]["router"] = {"q": P(), "s": P()}
+    return specs
 
 
 def _matmul(x, w):
@@ -420,11 +428,28 @@ def generate_tokens(params, first_token, cache, start_index, num_steps,
     return tokens.T, cache   # (batch, num_steps)
 
 
+def stack_pipeline_params(params, config: LlamaConfig, pp: int):
+    """Split ``params["layers"]`` into ``pp`` contiguous stage groups and
+    stack them ``(pp, per_stage, …)`` — the layout
+    :func:`~..parallel.pipeline_parallel.pipeline_apply_sharded` shards
+    over the ``pp`` mesh axis.  Do this ONCE and pass the result as
+    ``stages=`` for repeated :func:`pipeline_forward` calls; stacking is
+    an O(model) copy."""
+    from ..parallel.pipeline_parallel import stack_stages
+    layers = params["layers"]
+    assert len(layers) % pp == 0, (len(layers), pp)
+    per_stage = len(layers) // pp
+    groups = [stack_stages(layers[s * per_stage:(s + 1) * per_stage])
+              for s in range(pp)]
+    return stack_stages(groups)
+
+
 @functools.partial(jax.jit,
                    static_argnames=("config", "mesh", "n_microbatches",
                                     "pp_axis"))
 def pipeline_forward(params, tokens, config: LlamaConfig, mesh,
-                     n_microbatches: int = 4, pp_axis: str = "pp"):
+                     n_microbatches: int = 4, pp_axis: str = "pp",
+                     stages=None):
     """Full-sequence forward with the transformer layers split into
     GPipe pipeline stages over the ``pp_axis`` mesh axis (embed, final
     norm and LM head stay replicated outside the pipeline; activations

@@ -140,6 +140,11 @@ def make_llama_infer(config_name: str = "tiny", quantize: bool = False,
         if tokens.ndim == 1:
             tokens = tokens[None]
         batch, prompt_len = tokens.shape
+        if prompt_len >= config.max_seq_len:
+            # Reject cleanly: a cache shorter than the prompt would fail
+            # deep inside prefill with an opaque trace error.
+            return {"error": f"prompt_len {prompt_len} >= max_seq_len "
+                             f"{config.max_seq_len}"}
         new = min(max_new_tokens, config.max_seq_len - prompt_len)
         cache = llama.init_cache(config, batch, prompt_len + new)
         logits, cache = llama.prefill(params, tokens, cache, config)

@@ -1,5 +1,5 @@
 #!/usr/bin/env python
-"""Benchmark harness: one JSON line on stdout.
+"""Benchmark harness: one JSON line on stdout — ALWAYS.
 
 Primary metric: **pipeline frames/sec/chip** — frames flowing through the
 full dataflow engine (event loop, mailboxes, swag) with a fused TPU
@@ -9,8 +9,17 @@ is the apples-to-apples successor of the reference's only published
 figure: ~50 Hz max sustained distributed frame rate
 (examples/pipeline/multitude/run_large.sh:7,20), used as the baseline.
 
-Secondary figures (stderr): LLM decode tokens/sec/chip on the flagship
-Llama-architecture model, and p50 end-to-end frame latency.
+Flagship figure: **llm_chat tokens/sec/chip on Llama-3-8B + int8** (the
+BASELINE.json north star, target >= 2000 tok/s/chip), with bytes-per-
+step bandwidth accounting printed to stderr.  The reference only shells
+out to Ollama for LLM work (examples/llm/elements_llm.py:191-220); here
+the model runs natively on the chip.
+
+Robustness contract (VERDICT round 1): the driver capture must never
+come back empty.  Backend init is guarded and retried; every section
+runs under a watchdog alarm and its failure is recorded, not fatal; the
+final JSON line is emitted from a ``finally`` with whatever sections
+succeeded.
 
 NOTE (axon relay): block_until_ready does not sync on this platform —
 every timed region ends with a host readback (np.asarray) to measure
@@ -19,18 +28,72 @@ real execution time.
 
 from __future__ import annotations
 
+import contextlib
 import json
+import os
 import queue
+import signal
 import statistics
 import sys
 import time
 
 import numpy as np
 
+#: Assumed HBM bandwidth for the bandwidth-bound decode accounting
+#: (v5e ≈ 819 GB/s).  Only used for reporting/derived ceilings, never
+#: for the measured numbers.
+HBM_GBPS = 819.0
+
 
 def log(message):
     print(message, file=sys.stderr, flush=True)
 
+
+class SectionTimeout(RuntimeError):
+    pass
+
+
+@contextlib.contextmanager
+def watchdog(seconds: int, label: str):
+    """SIGALRM-based best-effort timeout: a section that hangs inside a
+    device call cannot always be interrupted, but anything that yields
+    to Python gets cut off instead of eating the driver's whole budget."""
+    def handler(signum, frame):
+        raise SectionTimeout(f"{label} exceeded {seconds}s watchdog")
+    previous = signal.signal(signal.SIGALRM, handler)
+    signal.alarm(seconds)
+    try:
+        yield
+    finally:
+        signal.alarm(0)
+        signal.signal(signal.SIGALRM, previous)
+
+
+def init_backend(retries: int = 3, delay: float = 5.0):
+    """Guarded backend bring-up (round-1 failure mode: UNAVAILABLE at
+    capture time killed the whole run on line 1)."""
+    last_error = None
+    for attempt in range(1, retries + 1):
+        try:
+            # A wedged relay can make jax.devices() HANG rather than
+            # raise; the watchdog turns that into a loud failure.
+            with watchdog(120, "backend init"):
+                import jax
+                devices = jax.devices()
+            log(f"backend: {jax.default_backend()}, devices: {devices}")
+            return jax.default_backend()
+        except Exception as error:  # noqa: BLE001
+            last_error = error
+            log(f"backend init attempt {attempt}/{retries} failed: "
+                f"{error!r}")
+            if attempt < retries:
+                time.sleep(delay)
+    raise RuntimeError(f"backend unavailable after {retries} attempts: "
+                       f"{last_error!r}")
+
+
+# --------------------------------------------------------------------------- #
+# Pipeline frames/sec (primary metric)
 
 def bench_pipeline(n_frames=200, warmup=20, image_size=320):
     from aiko_services_tpu.pipeline import (
@@ -105,64 +168,34 @@ def bench_pipeline(n_frames=200, warmup=20, image_size=320):
             latencies.append(time.perf_counter() - t0)
         return latencies
 
-    log(f"pipeline warmup ({warmup} frames, incl. XLA compile)...")
-    run_throughput(warmup)
-    log(f"pipeline timed run ({n_frames} frames, "
-        f"{max_in_flight} in flight)...")
-    started = time.perf_counter()
-    run_throughput(n_frames)
-    elapsed = time.perf_counter() - started
-    fps = n_frames / elapsed
-    latencies = run_latency(30)
-    p50 = statistics.median(latencies) * 1e3
-    log(f"pipeline: {fps:.1f} frames/sec/chip, p50 e2e {p50:.2f} ms "
-        f"(p50 includes one relay round-trip)")
-
-    pipeline.destroy_stream("bench")
-    engine.terminate()
-    thread.join(timeout=5)
+    try:
+        log(f"pipeline warmup ({warmup} frames, incl. XLA compile)...")
+        run_throughput(warmup)
+        log(f"pipeline timed run ({n_frames} frames, "
+            f"{max_in_flight} in flight)...")
+        started = time.perf_counter()
+        run_throughput(n_frames)
+        elapsed = time.perf_counter() - started
+        fps = n_frames / elapsed
+        latencies = run_latency(30)
+        p50 = statistics.median(latencies) * 1e3
+        log(f"pipeline: {fps:.1f} frames/sec/chip, p50 e2e {p50:.2f} ms "
+            f"(p50 includes one relay round-trip)")
+    finally:
+        # Each cleanup step suppressed separately: a destroy_stream
+        # failure must not leave the engine thread running to compete
+        # with later sections (round-1 empty-capture failure mode).
+        with contextlib.suppress(Exception):
+            pipeline.destroy_stream("bench")
+        with contextlib.suppress(Exception):
+            engine.terminate()
+        with contextlib.suppress(Exception):
+            thread.join(timeout=5)
     return fps, p50
 
 
-def bench_llm_decode(batch=8, prompt_len=128, new_tokens=256,
-                     config_name="small", quantize=False):
-    import jax
-    import jax.numpy as jnp
-    from aiko_services_tpu.models import llama
-
-    config = llama.CONFIGS[config_name]
-    params = llama.init_params(config, jax.random.PRNGKey(0))
-    if quantize:
-        # Int8 weight-only: halves HBM bytes/step (decode is
-        # bandwidth-bound) via the fused Pallas dequant-matmul kernel.
-        params = llama.quantize_params(params)
-        config_name += "+int8"
-    tokens = jnp.zeros((batch, prompt_len), jnp.int32)
-    cache = llama.init_cache(config, batch,
-                             prompt_len + new_tokens + 8)
-    logits, cache = llama.prefill(params, tokens, cache, config)
-    token = logits[:, -1].argmax(-1).astype(jnp.int32)[:, None]
-
-    log("llm warmup (compile scan-decode, same static shape)...")
-    # Warmup MUST use the same num_steps: it is a static arg, so a
-    # different value would compile a different program and the timed
-    # run would include compilation.
-    warm, _ = llama.generate_tokens(params, token, dict_copy(cache),
-                                    jnp.int32(prompt_len), new_tokens,
-                                    config)
-    int(np.asarray(warm)[0, 0])
-    log(f"llm timed decode ({new_tokens} steps, batch {batch}, "
-        f"one compiled scan)...")
-    started = time.perf_counter()
-    generated, cache = llama.generate_tokens(
-        params, token, cache, jnp.int32(prompt_len), new_tokens, config)
-    int(np.asarray(generated)[0, -1])   # host readback = real sync
-    elapsed = time.perf_counter() - started
-    tps = new_tokens * batch / elapsed
-    log(f"llm_chat ({config_name}): {tps:.0f} tokens/sec/chip "
-        f"({elapsed / new_tokens * 1e3:.2f} ms/step)")
-    return tps
-
+# --------------------------------------------------------------------------- #
+# LLM decode tokens/sec
 
 def dict_copy(cache):
     """Fresh cache buffers (generate_tokens donates its cache arg)."""
@@ -171,32 +204,196 @@ def dict_copy(cache):
             for c in cache]
 
 
-def main():
+def random_quantized_params(config, key):
+    """Random int8-quantized Llama params built DIRECTLY in quantized
+    form — a bf16 llama3_8b (~16 GB) would not fit next to itself in one
+    chip's HBM, so the bf16 tree is never materialized.  Structure
+    matches ``llama.quantize_params(llama.init_params(...))`` exactly:
+    2-D weights → {"q": int8 (in, out), "s": f32 (1, out)}, 1-D norm
+    vectors stay bf16."""
     import jax
-    log(f"backend: {jax.default_backend()}, devices: {jax.devices()}")
-    try:
-        llm_tps = bench_llm_decode()
-    except Exception as error:  # noqa: BLE001
-        log(f"llm bench failed: {error!r}")
-        llm_tps = None
-    try:
-        llm_int8_tps = bench_llm_decode(quantize=True)
-    except Exception as error:  # noqa: BLE001
-        log(f"llm int8 bench failed: {error!r}")
-        llm_int8_tps = None
-    fps, p50 = bench_pipeline()
+    import jax.numpy as jnp
+
+    c = config
+    d, h, kv, hd, f = (c.d_model, c.n_heads, c.n_kv_heads, c.head_dim,
+                       c.d_ff)
+    counter = iter(range(10_000))
+
+    def qweight(shape):
+        k = jax.random.fold_in(key, next(counter))
+        q = jax.random.randint(k, shape, -127, 128, jnp.int8)
+        # Scales sized so dequantized weights look like fan-in-scaled
+        # gaussians — keeps activations finite through 32 layers.
+        s = jnp.full((1, shape[1]), shape[0] ** -0.5 / 127.0, jnp.float32)
+        return {"q": q, "s": s}
+
+    layers = []
+    for _ in range(c.n_layers):
+        layers.append({
+            "attn_norm": jnp.ones((d,), c.dtype),
+            "wq": qweight((d, h * hd)),
+            "wk": qweight((d, kv * hd)),
+            "wv": qweight((d, kv * hd)),
+            "wo": qweight((h * hd, d)),
+            "mlp_norm": jnp.ones((d,), c.dtype),
+            "w_gate": qweight((d, f)),
+            "w_up": qweight((d, f)),
+            "w_down": qweight((f, d)),
+        })
+    return {
+        "embed": qweight((c.vocab_size, d)),
+        "layers": layers,
+        "final_norm": jnp.ones((d,), c.dtype),
+        "lm_head": qweight((d, c.vocab_size)),
+    }
+
+
+def quantized_model_bytes(config):
+    """HBM bytes the int8 weight tree streams per decode step (every
+    weight is read once per token)."""
+    c = config
+    d, f, v = c.d_model, c.d_ff, c.vocab_size
+    per_layer = (d * d + 2 * d * c.n_kv_heads * c.head_dim + d * d
+                 + 3 * d * f)                 # int8 = 1 byte each
+    scales = 4 * (2 * d + 2 * c.n_kv_heads * c.head_dim + 3 * f)
+    norms = 2 * 2 * d
+    # lm_head is int8 (v*d bytes) + f32 scales; embed row gather ~0.
+    embed_head = v * d + 4 * v + 2 * d
+    return c.n_layers * (per_layer + scales + norms) + embed_head
+
+
+def bench_llm_decode(batch=8, prompt_len=128, new_tokens=256,
+                     config_name="small", quantize=False,
+                     random_int8=False):
+    import jax
+    import jax.numpy as jnp
+    from aiko_services_tpu.models import llama
+
+    config = llama.CONFIGS[config_name]
+    label = config_name
+    if random_int8:
+        # Flagship path: int8 params built directly (see
+        # random_quantized_params) — required for 8B-class on 16 GB HBM.
+        params = random_quantized_params(config, jax.random.PRNGKey(0))
+        label += "+int8"
+    else:
+        params = llama.init_params(config, jax.random.PRNGKey(0))
+        if quantize:
+            params = llama.quantize_params(params)
+            label += "+int8"
+    tokens = jnp.zeros((batch, prompt_len), jnp.int32)
+    cache = llama.init_cache(config, batch,
+                             prompt_len + new_tokens + 8)
+    logits, cache = llama.prefill(params, tokens, cache, config)
+    token = logits[:, -1].argmax(-1).astype(jnp.int32)[:, None]
+
+    log(f"llm[{label}] warmup (compile scan-decode, same static "
+        "shape)...")
+    # Warmup MUST use the same num_steps: it is a static arg, so a
+    # different value would compile a different program and the timed
+    # run would include compilation.
+    warm, _ = llama.generate_tokens(params, token, dict_copy(cache),
+                                    jnp.int32(prompt_len), new_tokens,
+                                    config)
+    int(np.asarray(warm)[0, 0])
+    log(f"llm[{label}] timed decode ({new_tokens} steps, batch {batch}, "
+        "one compiled scan)...")
+    started = time.perf_counter()
+    generated, cache = llama.generate_tokens(
+        params, token, cache, jnp.int32(prompt_len), new_tokens, config)
+    int(np.asarray(generated)[0, -1])   # host readback = real sync
+    elapsed = time.perf_counter() - started
+    tps = new_tokens * batch / elapsed
+    ms_step = elapsed / new_tokens * 1e3
+    log(f"llm_chat ({label}): {tps:.0f} tokens/sec/chip "
+        f"({ms_step:.2f} ms/step)")
+
+    if quantize or random_int8:
+        # Bandwidth accounting: decode is HBM-bound; every step streams
+        # the whole weight tree plus the live KV prefix.
+        weight_bytes = quantized_model_bytes(config)
+        cache_len = prompt_len + new_tokens + 8
+        kv_bytes = (2 * batch * cache_len * config.n_kv_heads
+                    * config.head_dim * 2 * config.n_layers)
+        step_bytes = weight_bytes + kv_bytes
+        ceiling = HBM_GBPS * 1e9 / step_bytes * batch
+        log(f"llm_chat ({label}) bandwidth math: weights "
+            f"{weight_bytes / 1e9:.2f} GB + KV {kv_bytes / 1e9:.2f} GB "
+            f"= {step_bytes / 1e9:.2f} GB/step -> ceiling "
+            f"{ceiling:.0f} tok/s/chip @ {HBM_GBPS:.0f} GB/s; achieved "
+            f"{tps:.0f} ({tps / ceiling * 100:.0f}% of BW ceiling)")
+    return tps
+
+
+# --------------------------------------------------------------------------- #
+
+def main():
     result = {
         "metric": "pipeline frames/sec/chip (fused TPU detector stage; "
                   "reference max sustained distributed rate = 50 Hz)",
-        "value": round(fps, 1),
+        "value": None,
         "unit": "frames/sec/chip",
-        "vs_baseline": round(fps / 50.0, 2),
+        "vs_baseline": None,
     }
-    if llm_tps is not None:
-        result["llm_tokens_per_sec_chip"] = round(llm_tps)
-    if llm_int8_tps is not None:
-        result["llm_int8_tokens_per_sec_chip"] = round(llm_int8_tps)
-    print(json.dumps(result), flush=True)
+    errors = {}
+    deadline = time.monotonic() + float(
+        os.environ.get("BENCH_DEADLINE", "2400"))
+
+    def run_section(name, seconds, fn):
+        remaining = int(deadline - time.monotonic())
+        if remaining <= 10:
+            errors[name] = "skipped: global deadline reached"
+            log(f"section {name}: SKIPPED (deadline)")
+            return None
+        budget = min(seconds, remaining)
+        try:
+            with watchdog(budget, name):
+                return fn()
+        except Exception as error:  # noqa: BLE001
+            errors[name] = repr(error)
+            log(f"section {name}: FAILED: {error!r}")
+            return None
+
+    try:
+        try:
+            init_backend()
+        except Exception as error:  # noqa: BLE001
+            errors["backend"] = repr(error)
+            log(f"FATAL backend failure (emitting empty result): "
+                f"{error!r}")
+            return
+
+        pipeline = run_section("pipeline", 600, bench_pipeline)
+        if pipeline is not None:
+            fps, p50 = pipeline
+            result["value"] = round(fps, 1)
+            result["vs_baseline"] = round(fps / 50.0, 2)
+            result["p50_e2e_ms"] = round(p50, 2)
+
+        tps = run_section("llm_small", 420, lambda: bench_llm_decode())
+        if tps is not None:
+            result["llm_tokens_per_sec_chip"] = round(tps)
+
+        tps = run_section("llm_small_int8", 420,
+                          lambda: bench_llm_decode(quantize=True))
+        if tps is not None:
+            result["llm_int8_tokens_per_sec_chip"] = round(tps)
+
+        # Flagship LAST: the heaviest section, so a wedge here cannot
+        # take the earlier captures down with it.
+        tps = run_section(
+            "llama3_8b_int8", 900,
+            lambda: bench_llm_decode(batch=8, prompt_len=128,
+                                     new_tokens=128,
+                                     config_name="llama3_8b",
+                                     random_int8=True))
+        if tps is not None:
+            result["llama3_8b_int8_tokens_per_sec_chip"] = round(tps)
+            result["llama3_8b_vs_2000_target"] = round(tps / 2000.0, 2)
+    finally:
+        if errors:
+            result["errors"] = errors
+        print(json.dumps(result), flush=True)
 
 
 if __name__ == "__main__":

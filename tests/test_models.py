@@ -363,3 +363,22 @@ def test_llama_pipeline_parallel_forward_matches(tiny):
     agree = (np.asarray(got).argmax(-1) ==
              np.asarray(expected).argmax(-1)).mean()
     assert agree > 0.99
+
+
+def test_quantized_specs_compose_with_moe():
+    """quantize_params turns the 2-D MoE router into {"q","s"}; the spec
+    tree must mirror that or any tree_map over (params, specs) raises a
+    structure mismatch (ADVICE r1)."""
+    from jax.sharding import NamedSharding
+    config = llama.CONFIGS["moe_tiny"]
+    params = llama.quantize_params(
+        llama.init_params(config, jax.random.PRNGKey(3)))
+    specs = llama.quantized_param_specs(config)
+    mesh = make_mesh(tp=2, ep=4)
+    sharded = jax.tree.map(
+        lambda leaf, spec: jax.device_put(leaf,
+                                          NamedSharding(mesh, spec)),
+        params, specs)
+    out = llama.forward(sharded, jnp.zeros((2, 8), jnp.int32), config,
+                        use_flash=False)
+    assert bool(jnp.isfinite(out).all())

@@ -118,3 +118,13 @@ def test_llama_replica_end_to_end(engine):
     tokens_out = np.asarray(outputs["tokens_out"])
     assert tokens_out.shape == (1, 12)
     assert (tokens_out[:, :8] == prompt).all()
+
+
+def test_llama_infer_rejects_overlong_prompt():
+    """A prompt >= max_seq_len must come back as a clean error payload,
+    not an opaque trace error from a too-short cache (ADVICE r1)."""
+    from aiko_services_tpu.models import llama
+    infer = make_llama_infer("tiny", max_new_tokens=4)
+    too_long = llama.CONFIGS["tiny"].max_seq_len
+    out = infer({"tokens": np.zeros((1, too_long), np.int32)})
+    assert "error" in out and "max_seq_len" in out["error"]
