@@ -81,8 +81,23 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = True,
         # The block currently held arrived from device (index - i).
         src = (axis_index - i) % axis_size
         k_offset = src * seq_local
-        m, l, acc = _block_attend(q, k_cur, v_cur, q_offset, k_offset,
-                                  sm_scale, causal, m, l, acc)
+        if causal:
+            # Causal step skipping: a K/V shard whose keys all come
+            # after this device's queries (src > axis_index) is fully
+            # masked — skip its attention math (the rotation still
+            # happens; later devices need the shard).  Halves causal
+            # ring FLOPs on average.
+            m, l, acc = jax.lax.cond(
+                src <= axis_index,
+                lambda state: _block_attend(
+                    q, k_cur, v_cur, q_offset, k_offset, sm_scale,
+                    True, *state),
+                lambda state: state,
+                (m, l, acc))
+        else:
+            m, l, acc = _block_attend(q, k_cur, v_cur, q_offset,
+                                      k_offset, sm_scale, False,
+                                      m, l, acc)
         k_next = jax.lax.ppermute(k_cur, axis_name, perm)
         v_next = jax.lax.ppermute(v_cur, axis_name, perm)
         return k_next, v_next, m, l, acc

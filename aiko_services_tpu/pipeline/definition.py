@@ -195,3 +195,18 @@ def parse_pipeline_definition(document: Dict) -> PipelineDefinition:
 def load_pipeline_definition(pathname: str) -> PipelineDefinition:
     with open(pathname, encoding="utf-8") as f:
         return parse_pipeline_definition(json.load(f))
+
+
+def apply_output_renames(renames, outputs):
+    """Map-out edge semantics (reference pipeline.py:1314-1320): pop each
+    mapped output name and write its value under every consumer-
+    namespaced target key.  The single definition both the hot loop and
+    fused TPU stages apply, so their numerics cannot diverge."""
+    if not renames:
+        return outputs
+    for from_name, targets in renames.items():
+        if from_name in outputs:
+            value = outputs.pop(from_name)
+            for target in targets:
+                outputs[target] = value
+    return outputs

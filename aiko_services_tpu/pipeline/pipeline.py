@@ -45,7 +45,8 @@ from ..registry.services_cache import services_cache_create_singleton
 from ..runtime.service import ServiceFilter
 from .codec import decode_swag, encode_swag
 from .definition import (
-    PipelineDefinition, PipelineElementDefinition, load_pipeline_definition,
+    PipelineDefinition, PipelineElementDefinition, apply_output_renames,
+    load_pipeline_definition,
 )
 from .element import PipelineElement
 from .stream import (
@@ -95,7 +96,11 @@ class Pipeline(PipelineElement):
         self.elements: Dict[str, PipelineElement] = {}
         self.remote_proxies: Dict[str, Optional[Any]] = {}
         self._remote_topics: Dict[str, str] = {}
-        self._node_mappings: Dict[str, Dict[str, str]] = {}
+        #: node -> {input name: swag key it reads} (map_in side).
+        self._input_sources: Dict[str, Dict[str, str]] = {}
+        #: node -> {output name: [namespaced swag keys written]}
+        #: (map_out side; the plain output name is popped).
+        self._output_renames: Dict[str, Dict[str, List[str]]] = {}
         self._stream_current: Optional[Stream] = None
         self._frames_processed = 0
         self._services_cache = None
@@ -111,7 +116,8 @@ class Pipeline(PipelineElement):
             for head in self.graph.head_names:
                 path = list(self.graph.get_path(head))
                 self._fused_stages.update(build_fused_stages(
-                    path, self.elements, self._node_mappings))
+                    path, self.elements, self._input_sources,
+                    self._output_renames))
             if self._fused_stages:
                 self.logger.info(
                     "%s: fused TPU stages: %s", self.name,
@@ -131,10 +137,38 @@ class Pipeline(PipelineElement):
     # -- graph build --------------------------------------------------------- #
 
     def _node_properties(self, node_name, properties, predecessor):
-        """Graph edge dicts are input name-mappings for the target node
-        (reference pipeline.py:616-625)."""
-        mapping = self._node_mappings.setdefault(node_name, {})
-        mapping.update({str(k): str(v) for k, v in properties.items()})
+        """Graph edge dicts rename the predecessor's outputs into
+        consumer-namespaced swag keys (reference map_in/map_out,
+        pipeline.py:616-625, 1292-1325): edge ``(P C (out: in))`` makes
+        P's output ``out`` travel as swag key ``"C.in"``, which C's
+        declared input ``in`` then reads.  Fan-in branches emitting the
+        same output name therefore stay distinct (the round-1 diamond
+        collision).  The plain output name is *popped* from the
+        producer's outputs, matching the reference's
+        ``frame_data_out.pop(from_name)``."""
+        if predecessor is None:
+            raise ValueError(
+                f"Graph edge properties on head node {node_name!r} have "
+                "no source edge; attach them after a successor, e.g. "
+                f"\"(P {node_name} (out: in))\"")
+        sources = self._input_sources.setdefault(node_name, {})
+        renames = self._output_renames.setdefault(predecessor, {})
+        for from_name, to_name in properties.items():
+            from_name, to_name = str(from_name), str(to_name)
+            key = f"{node_name}.{to_name}"
+            sources[to_name] = key
+            targets = renames.setdefault(from_name, [])
+            if key not in targets:
+                targets.append(key)
+
+    def _apply_map_out(self, node_name: str,
+                       outputs: Dict[str, Any]) -> Dict[str, Any]:
+        """Rename a producer's mapped outputs to their consumer-
+        namespaced keys (reference ``_process_map_out``,
+        pipeline.py:1314-1320); one output may fan out to several
+        consumers."""
+        return apply_output_renames(self._output_renames.get(node_name),
+                                    outputs)
 
     def _create_elements(self):
         for node in self.graph.nodes():
@@ -170,7 +204,7 @@ class Pipeline(PipelineElement):
             available: Dict[str, str] = {}
             for node in self.graph.get_path(head):
                 definition = self.definition.element(node.name)
-                mapping = self._node_mappings.get(node.name, {})
+                mapping = self._input_sources.get(node.name, {})
                 for io in definition.input:
                     name = mapping.get(io["name"], io["name"])
                     if name in available and \
@@ -178,8 +212,10 @@ class Pipeline(PipelineElement):
                         raise ValueError(
                             f"{node.name}.{io['name']}: type "
                             f"{io['type']} != upstream {available[name]}")
+                renames = self._output_renames.get(node.name, {})
                 for io in definition.output:
-                    available[io["name"]] = io["type"]
+                    for key in renames.get(io["name"], [io["name"]]):
+                        available[key] = io["type"]
 
     def _watch_remote(self, definition: PipelineElementDefinition):
         if self._services_cache is None:
@@ -394,8 +430,9 @@ class Pipeline(PipelineElement):
         frame = stream.frames.get(frame_id)
         if frame is None or frame.paused_pe_name is None:
             return
-        frame.swag.update(decode_swag(outputs_dict or {}))
         resume_after = frame.paused_pe_name
+        frame.swag.update(self._apply_map_out(
+            resume_after, decode_swag(outputs_dict or {})))
         frame.paused_pe_name = None
         self._process_frame_common(stream, frame, resume_after=resume_after)
 
@@ -467,7 +504,7 @@ class Pipeline(PipelineElement):
 
     def _gather_inputs(self, frame: Frame, node) -> Dict[str, Any]:
         definition = self.definition.element(node.name)
-        mapping = self._node_mappings.get(node.name, {})
+        mapping = self._input_sources.get(node.name, {})
         inputs = {}
         for io in definition.input:
             name = io["name"]
@@ -487,7 +524,8 @@ class Pipeline(PipelineElement):
             event, outputs = StreamEvent.ERROR, {}
         frame.metrics[f"time_{node.name}"] = time.perf_counter() - started
         if event == StreamEvent.OKAY:
-            frame.swag.update(outputs or {})
+            frame.swag.update(
+                self._apply_map_out(node.name, dict(outputs or {})))
             return True
         self._handle_stream_event(stream, frame, node.name, event)
         return False

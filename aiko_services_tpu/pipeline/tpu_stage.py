@@ -30,6 +30,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 import jax
 import jax.numpy as jnp
 
+from .definition import apply_output_renames
 from .element import PipelineElement
 from .stream import StreamEvent
 
@@ -74,10 +75,15 @@ class FusedStage:
     """A maximal contiguous run of TpuElements compiled as one program."""
 
     def __init__(self, nodes: Sequence, elements: List[TpuElement],
-                 mappings: Dict[str, Dict[str, str]]):
+                 input_sources: Dict[str, Dict[str, str]],
+                 output_renames: Dict[str, Dict[str, List[str]]]):
         self.node_names = [node.name for node in nodes]
         self.elements = elements
-        self.mappings = mappings        # node name -> {input: swag key}
+        # node name -> {input: swag key} / {output: [namespaced keys]}
+        # (the pipeline's map_in/map_out edge semantics, resolved at
+        # trace time so fused numerics match the unfused hot loop).
+        self.input_sources = input_sources
+        self.output_renames = output_renames
         self.name = "+".join(self.node_names)
         params = tuple(element.params for element in self.elements)
         self._params = params
@@ -87,7 +93,7 @@ class FusedStage:
         # exactly what the standalone TpuElement path accepts.
         self._consumed = set()
         for element in self.elements:
-            mapping = self.mappings.get(element.name, {})
+            mapping = self.input_sources.get(element.name, {})
             names = (element.definition.input_names()
                      if element.definition else [])
             for input_name in names:
@@ -97,7 +103,7 @@ class FusedStage:
         """Composed compute across member elements; runs under jit."""
         pool = dict(swag_arrays)
         for element, element_params in zip(self.elements, params):
-            mapping = self.mappings.get(element.name, {})
+            mapping = self.input_sources.get(element.name, {})
             names = (element.definition.input_names()
                      if element.definition else list(pool))
             inputs = {}
@@ -105,7 +111,9 @@ class FusedStage:
                 source = mapping.get(input_name, input_name)
                 if source in pool:
                     inputs[input_name] = pool[source]
-            outputs = element.compute(element_params, inputs)
+            outputs = apply_output_renames(
+                self.output_renames.get(element.name),
+                dict(element.compute(element_params, inputs)))
             pool.update(outputs)
         return pool
 
@@ -131,7 +139,8 @@ class FusedStage:
 
 
 def build_fused_stages(path_nodes: Sequence, elements: Dict[str, Any],
-                       mappings: Dict[str, Dict[str, str]]) \
+                       input_sources: Dict[str, Dict[str, str]],
+                       output_renames: Dict[str, Dict[str, List[str]]]) \
         -> Dict[str, FusedStage]:
     """Group maximal contiguous runs of TpuElements along an execution
     path.  Returns {first-node-name: FusedStage} for runs of length ≥ 2
@@ -142,9 +151,10 @@ def build_fused_stages(path_nodes: Sequence, elements: Dict[str, Any],
     def flush():
         nonlocal run
         if len(run) >= 2:
-            stage = FusedStage(run, [elements[n.name] for n in run],
-                               {n.name: mappings.get(n.name, {})
-                                for n in run})
+            stage = FusedStage(
+                run, [elements[n.name] for n in run],
+                {n.name: input_sources.get(n.name, {}) for n in run},
+                {n.name: output_renames.get(n.name, {}) for n in run})
             stages[run[0].name] = stage
         run = []
 

@@ -171,8 +171,8 @@ def test_speech_to_chat_pipeline(engine, wav_file):
     (tiny configs, CPU)."""
     doc = {
         "version": 0, "name": "p_speech_chat", "runtime": "python",
-        "graph": ["(AudioReadFile ASRElement (LlamaChatElement "
-                  "(tokens: text_tokens)))"],
+        "graph": ["(AudioReadFile (ASRElement LlamaChatElement "
+                  "(text_tokens: tokens)))"],
         "elements": [
             element("AudioReadFile", "AudioReadFile",
                     [("paths", "[str]")],

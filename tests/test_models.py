@@ -382,3 +382,37 @@ def test_quantized_specs_compose_with_moe():
     out = llama.forward(sharded, jnp.zeros((2, 8), jnp.int32), config,
                         use_flash=False)
     assert bool(jnp.isfinite(out).all())
+
+
+def test_flash_attention_causal_skip_shapes():
+    """Causal block-skipping (pl.when + clamped K/V index maps) must be
+    exact at square and rectangular shapes and across block sizes."""
+    key = jax.random.PRNGKey(11)
+    shapes = [   # (q_len, k_len, block_q, block_k)
+        (256, 256, 64, 64),
+        (256, 256, 64, 128),
+        (64, 256, 64, 64),     # short q over long k (decode-extend)
+        (128, 128, 128, 64),
+    ]
+    for q_len, k_len, block_q, block_k in shapes:
+        ks = jax.random.split(jax.random.fold_in(key, q_len * k_len), 3)
+        q = jax.random.normal(ks[0], (1, 2, q_len, 32), jnp.float32)
+        k = jax.random.normal(ks[1], (1, 2, k_len, 32), jnp.float32)
+        v = jax.random.normal(ks[2], (1, 2, k_len, 32), jnp.float32)
+        ref = attention_reference(q, k, v, causal=True)
+        out = flash_attention(q, k, v, causal=True, interpret=True,
+                              block_q=block_q, block_k=block_k)
+        assert float(jnp.max(jnp.abs(out - ref))) < 1e-5, \
+            (q_len, k_len, block_q, block_k)
+
+
+def test_ring_attention_causal_skip_matches():
+    """Ring attention with causal step-skipping stays exact (the
+    skipped steps are exactly the fully-masked ones)."""
+    mesh = make_mesh(sp=8)
+    key = jax.random.PRNGKey(12)
+    q, k, v = [jax.random.normal(s, (2, 2, 128, 16), jnp.float32)
+               for s in jax.random.split(key, 3)]
+    ref = attention_reference(q, k, v, causal=True)
+    out = ring_attention_sharded(q, k, v, mesh, axis="sp", causal=True)
+    assert float(jnp.max(jnp.abs(out - ref))) < 1e-5

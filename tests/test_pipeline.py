@@ -89,7 +89,7 @@ def test_comment_keys_stripped():
 
 FAN = {
     "version": 0, "name": "p_fan", "runtime": "python",
-    "graph": ["(PE_Emit (PE_Add PE_Sum (a: i)) (PE_Double PE_Sum (b: i)))"],
+    "graph": ["(PE_Emit (PE_Add PE_Sum (i: a)) (PE_Double PE_Sum (i: b)))"],
     "elements": [
         element("PE_Emit", "PE_Emit", [("i", "int")], [("i", "int")]),
         element("PE_Add", "PE_Add", [("i", "int")], [("i", "int")]),
@@ -100,17 +100,18 @@ FAN = {
 }
 
 
-def test_fan_out_fan_in_with_input_mapping(engine):
-    """Diamond with edge-property renames: PE_Sum(a=from Add, b=from
-    Double).  NOTE: both branches output 'i'; the rename maps whichever is
-    in swag — the final swag 'i' is the last writer's, and a/b pull from
-    'i' as mapped."""
+def test_fan_out_fan_in_with_map_out(engine):
+    """Diamond fan-in: both branches emit output 'i', but the map_out
+    edge renames (reference pipeline.py:623-625,1314-1320) pop each
+    branch's 'i' into a distinct consumer-namespaced swag key
+    (PE_Sum.a / PE_Sum.b), so the branches cannot clobber each other
+    (the round-1 collision gave 24 here)."""
     pipeline, _ = make_pipeline(engine, FAN, broker="fan")
     results = run_frames(engine, pipeline, [{"i": 5}])
-    # Path order: Emit, Add, Double, Sum. Add: i=6; Double doubles the
-    # *current* swag i (6) -> 12. Sum: a=i(12)? -- mapping pulls from swag
-    # key "i" for both: total = 12 + 12 = 24.
-    assert results == [{"total": 24}]
+    # True diamond: both branches read Emit's i=5 (Add's renamed output
+    # never lands back in plain "i").  Add: 5+1=6 -> PE_Sum.a;
+    # Double: 5*2=10 -> PE_Sum.b; Sum: 6+10=16.
+    assert results == [{"total": 16}]
 
 
 def test_stream_stop_event_destroys_stream(engine):

@@ -119,7 +119,7 @@ def test_python_element_breaks_fusion(engine):
 def test_fused_stage_respects_input_mapping(engine):
     doc = {
         "version": 0, "name": "p_map", "runtime": "tpu",
-        "graph": ["(TE_Scale (TE_Renamed (y: x)))"],
+        "graph": ["(TE_Scale (TE_Renamed (x: y)))"],
         "elements": [
             element("TE_Scale", "TE_Scale", [("x", "array")],
                     [("x", "array")], {"factor": 2.0}),
