@@ -89,6 +89,12 @@ CONFIGS: Dict[str, LlamaConfig] = {
     "moe_tiny": LlamaConfig(vocab_size=1024, d_model=128, n_layers=2,
                             n_heads=4, n_kv_heads=2, d_ff=352,
                             max_seq_len=512, n_experts=4),
+    # Single-chip MoE bench config (~0.6 B params, int8 ≈ 0.6 GB);
+    # cf=4.0 = E/k keeps decode drop-free (see moe_capacity_factor).
+    "moe_small": LlamaConfig(vocab_size=32_000, d_model=1024,
+                             n_layers=8, n_heads=16, n_kv_heads=8,
+                             d_ff=2816, max_seq_len=2048, n_experts=8,
+                             moe_capacity_factor=4.0),
     # cf=4.0 = n_experts/top_k: the no-drop bound, so cached decode stays
     # exactly consistent with full-sequence forward (see moe_capacity_factor).
     "mixtral_8x7b": LlamaConfig(vocab_size=32_000, d_model=4096,

@@ -111,6 +111,12 @@ class Pipeline(PipelineElement):
         # TPU runtime: fuse contiguous TpuElement runs into single jitted
         # stages (device-resident swag between them; see tpu_stage.py).
         self._fused_stages: Dict[str, Any] = {}
+        #: Every Nth frame additionally records time_{stage}_device
+        #: (dispatch -> device completion, via a 1-element readback
+        #: sync); 0 = off.  The plain time_{stage} stamp is dispatch
+        #: wall time only — TPU dispatch is asynchronous.
+        self._device_metrics_interval = int(
+            self.definition.parameters.get("device_metrics_interval", 0))
         if self.definition.runtime == "tpu":
             from .tpu_stage import build_fused_stages
             for head in self.graph.head_names:
@@ -485,8 +491,17 @@ class Pipeline(PipelineElement):
                                                   stage.name,
                                                   StreamEvent.ERROR)
                         return
+                    # Wall time around an ASYNC dispatch: honest label is
+                    # dispatch time, not device time.
                     frame.metrics[f"time_{stage.name}"] = \
                         time.perf_counter() - started
+                    interval = self._device_metrics_interval
+                    if interval and frame.frame_id % interval == 0:
+                        # Sampled device-true timing: sync this stage's
+                        # program and stamp dispatch -> completion.
+                        stage.sync_outputs(frame.swag)
+                        frame.metrics[f"time_{stage.name}_device"] = \
+                            time.perf_counter() - started
                     i += len(stage.node_names)
                     continue
                 element = self.elements.get(node.name)

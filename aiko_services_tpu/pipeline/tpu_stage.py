@@ -134,8 +134,24 @@ class FusedStage:
                     passthrough[key] = value
             else:
                 passthrough[key] = value
-        result = self._compiled(self._params, arrays)
+        # TraceAnnotation: free with no profiler attached; names this
+        # stage's device ops in jax.profiler / XLA traces (SURVEY §5.1's
+        # TPU equivalent of the reference's per-element wall stamps).
+        with jax.profiler.TraceAnnotation(f"stage:{self.name}"):
+            result = self._compiled(self._params, arrays)
         return {**passthrough, **result}
+
+    def sync_outputs(self, swag: Dict[str, Any]) -> None:
+        """Block until this stage's device work is COMPLETE, via a
+        1-element host readback of one output (the per-device queue is
+        FIFO, so one output syncs the whole program; readback rather
+        than block_until_ready because the axon relay does not sync on
+        the latter).  Used for sampled device-true frame metrics."""
+        import numpy as np
+        for value in swag.values():
+            if isinstance(value, jax.Array):
+                np.asarray(value.ravel()[0:1])
+                return
 
 
 def build_fused_stages(path_nodes: Sequence, elements: Dict[str, Any],

@@ -27,7 +27,9 @@ import itertools
 import threading
 from typing import Callable, Dict, List, Optional, Tuple
 
-from ..utils.config import get_hostname, get_namespace, get_pid
+from ..utils.config import (
+    get_default_transport, get_hostname, get_namespace, get_pid,
+)
 from ..utils.logger import get_logger
 from ..utils.sexpr import generate, parse
 from ..transport import create_message
@@ -88,12 +90,13 @@ class Process:
             self.message = message
             self.message.message_handler = self._on_message
         else:
+            transport = transport or get_default_transport()
             self.message = create_message(
-                transport or "loopback",
+                transport,
                 message_handler=self._on_message,
                 lwt_topic=self.topic_state,
                 lwt_payload="(absent)",
-                **({"broker": broker} if (transport or "loopback")
+                **({"broker": broker} if transport
                    in ("loopback", "memory") else {}))
         # Async transports (MQTT) report connection completion via the
         # connection_handler callback; loopback is connected immediately.

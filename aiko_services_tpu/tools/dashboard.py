@@ -51,10 +51,9 @@ class DashboardState:
         self.selected = max(0, min(index, len(services) - 1))
 
     def open_variables(self):
-        services = self.services()
-        if not services:
+        fields = self._selected_fields()
+        if fields is None:
             return
-        fields = services[self.selected]
         self.close_views()
         self.variables = {}
         self.plugin = find_plugin(fields)
@@ -64,10 +63,9 @@ class DashboardState:
         self.page = "variables"
 
     def open_log(self):
-        services = self.services()
-        if not services:
+        fields = self._selected_fields()
+        if fields is None:
             return
-        fields = services[self.selected]
         self.close_views()
         self.logs = []
         self._log_topic = f"{fields.topic_path}/log"
@@ -90,6 +88,49 @@ class DashboardState:
         self.plugin_fields = None
         self.page = "services"
 
+    # -- operator controls (reference dashboard.py:565-648) ----------------- #
+
+    def _selected_fields(self):
+        services = self.services()
+        if not services:
+            return None
+        return services[min(self.selected, len(services) - 1)]
+
+    def kill_selected(self) -> Optional[str]:
+        """Publish ``(terminate)`` to the selected service's topic_in
+        (Actors dispatch it to ``Actor.terminate``)."""
+        fields = self._selected_fields()
+        if fields is None:
+            return None
+        self.process.message.publish(f"{fields.topic_path}/in",
+                                     "(terminate)")
+        return fields.topic_path
+
+    def set_log_level(self, level: str) -> Optional[str]:
+        """Publish ``(log_level LEVEL)`` to the selected service; the
+        service echoes the new level into its EC share."""
+        fields = self._selected_fields()
+        if fields is None:
+            return None
+        self.process.message.publish(
+            f"{fields.topic_path}/in", f"(log_level {level.upper()})")
+        return fields.topic_path
+
+    def plugin_actions(self):
+        """Actions the current plugin exposes: {key: (label, fn)}."""
+        from .dashboard_plugins import find_plugin_actions
+        if self.plugin_fields is None:
+            return {}
+        return find_plugin_actions(self.plugin_fields)
+
+    def run_plugin_action(self, key: str) -> bool:
+        action = self.plugin_actions().get(key)
+        if action is None:
+            return False
+        _label, fn = action
+        fn(self.process, self.plugin_fields, self.variables)
+        return True
+
 
 def _render(stdscr, state: DashboardState):
     import curses
@@ -108,7 +149,8 @@ def _render(stdscr, state: DashboardState):
                     f"{(fields.protocol or '-'):20.20} "
                     f"{fields.topic_path:30.30}")
             stdscr.addnstr(2 + i, 0, line, width - 1, attr)
-        footer = " ↑/↓ select · ENTER variables · L log · Q quit"
+        footer = (" ↑/↓ select · ENTER variables · L log · K kill · "
+                  "D/I log-level DEBUG/INFO · Q quit")
     elif state.page == "variables":
         if state.plugin is not None:
             stdscr.addnstr(1, 0, "  PLUGIN VIEW", width - 1,
@@ -124,7 +166,10 @@ def _render(stdscr, state: DashboardState):
             for i, (key, value) in enumerate(items):
                 stdscr.addnstr(2 + i, 0, f"  {key} = {value}",
                                width - 1)
-        footer = " ESC back · Q quit"
+        actions = state.plugin_actions()
+        action_help = "".join(f" · {key.upper()} {label}"
+                              for key, (label, _) in actions.items())
+        footer = f" ESC back · Q quit{action_help}"
     else:
         stdscr.addnstr(1, 0, "  LOG", width - 1, curses.A_BOLD)
         for i, line in enumerate(state.logs[-(height - 3):]):
@@ -167,8 +212,16 @@ def run_dashboard(stdscr, process):
                     state.open_variables()
                 elif key in (ord("l"), ord("L")):
                     state.open_log()
+                elif key in (ord("k"), ord("K")):
+                    state.kill_selected()
+                elif key in (ord("d"), ord("D")):
+                    state.set_log_level("DEBUG")
+                elif key in (ord("i"), ord("I")):
+                    state.set_log_level("INFO")
             elif key == 27:   # ESC
                 state.close_views()
+            elif state.page == "variables" and 0 <= key < 256:
+                state.run_plugin_action(chr(key).lower())
             break
 
 

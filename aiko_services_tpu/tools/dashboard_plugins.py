@@ -17,20 +17,31 @@ from __future__ import annotations
 from typing import Callable, Dict, List, Optional
 
 PluginFn = Callable[[object, Dict], List[str]]
+#: Action: key char -> (label, fn(process, fields, variables)).
+ActionMap = Dict[str, tuple]
 
 _PLUGINS_BY_NAME: Dict[str, PluginFn] = {}
 _PLUGINS_BY_PROTOCOL: Dict[str, PluginFn] = {}
+#: Keyed by the plugin function itself, so a service's actions always
+#: belong to the SAME plugin whose view is rendered.
+_ACTIONS_BY_PLUGIN: Dict[PluginFn, ActionMap] = {}
 
 
 def dashboard_plugin(name: Optional[str] = None,
-                     protocol: Optional[str] = None):
+                     protocol: Optional[str] = None,
+                     actions: Optional[ActionMap] = None):
     """Decorator registering a plugin for a service name and/or a
-    protocol substring (reference keys plugins the same two ways)."""
+    protocol substring (reference keys plugins the same two ways).
+    ``actions`` maps a keystroke to ``(label, fn)``; the dashboard runs
+    ``fn(process, fields, variables)`` when the key is pressed on the
+    plugin page (reference dashboard.py:726-730 action hooks)."""
     def register(fn: PluginFn) -> PluginFn:
         if name:
             _PLUGINS_BY_NAME[name] = fn
         if protocol:
             _PLUGINS_BY_PROTOCOL[protocol] = fn
+        if actions:
+            _ACTIONS_BY_PLUGIN[fn] = dict(actions)
         return fn
     return register
 
@@ -47,6 +58,13 @@ def find_plugin(fields) -> Optional[PluginFn]:
     return None
 
 
+def find_plugin_actions(fields) -> ActionMap:
+    plugin = find_plugin(fields)
+    if plugin is None:
+        return {}
+    return _ACTIONS_BY_PLUGIN.get(plugin, {})
+
+
 def _get(variables: Dict, *path, default="-"):
     node = variables
     for key in path:
@@ -56,7 +74,14 @@ def _get(variables: Dict, *path, default="-"):
     return node
 
 
-@dashboard_plugin(protocol="pipeline")
+def _pipeline_stop_action(process, fields, variables):
+    """Operator stop: Pipeline.stop() destroys all streams and halts
+    the elements (dispatched by the actor's command path)."""
+    process.message.publish(f"{fields.topic_path}/in", "(stop)")
+
+
+@dashboard_plugin(protocol="pipeline",
+                  actions={"s": ("stop pipeline", _pipeline_stop_action)})
 def pipeline_plugin(fields, variables) -> List[str]:
     """Streams/frames counters published by the pipeline's 3 s status
     timer into its EC share."""

@@ -3,6 +3,7 @@ from .loopback import (
     LoopbackBroker, LoopbackMessage, get_broker, reset_brokers,
 )
 from .mqtt import MQTTMessage, PAHO_AVAILABLE
+from .mqtt_broker import MqttBroker
 
 
 def create_message(transport: str, **kwargs) -> Message:
@@ -10,9 +11,14 @@ def create_message(transport: str, **kwargs) -> Message:
     (reference default "mqtt", ``main/context.py:50``; ours defaults to
     "loopback" via AIKO_TRANSPORT)."""
     if transport in ("loopback", "memory"):
+        kwargs.pop("host", None)
+        kwargs.pop("port", None)
         return LoopbackMessage(**kwargs)
     if transport == "mqtt":
+        kwargs.pop("broker", None)
         return MQTTMessage(**kwargs)
     if transport in ("null", "castaway", "none"):
+        for key in ("broker", "host", "port"):
+            kwargs.pop(key, None)
         return NullMessage(**kwargs)
     raise ValueError(f"Unknown transport: {transport}")

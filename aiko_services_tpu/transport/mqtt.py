@@ -1,132 +1,337 @@
-"""MQTT transport over paho-mqtt (optional).
+"""MQTT transport: built-in pure-Python MQTT 3.1.1 client (QoS 0).
 
 Reference parity: ``/root/reference/src/aiko_services/main/message/
-mqtt.py:65-289``.  This image does not ship ``paho-mqtt``; the class is
-import-gated and raises a clear error when constructed without it.  When
-paho is present: connects with LWT, TLS/username/password from the
-environment (:func:`aiko_services_tpu.utils.config.get_mqtt_configuration`),
-subscribes with wildcard support, and delivers via ``message_handler`` on
-the paho network thread (callers queue into their event engine).
+mqtt.py:65-289`` — connect with last-will, env-driven host/port
+(:func:`aiko_services_tpu.utils.config.get_mqtt_configuration`),
+wildcard subscriptions, LWT change via a disconnect/reconnect cycle.
+Where the reference wraps paho (absent from this image), this client
+speaks the wire protocol itself (:mod:`mqtt_codec`), so it works against
+the built-in :class:`~.mqtt_broker.MqttBroker` *and* any standard broker
+(mosquitto) — and it is what makes the framework genuinely cross
+OS-process boundaries.
 
-Unlike the reference there is no busy-wait ``wait_connected``/
-``wait_published`` (``mqtt.py:255-289``): publishes before the connection
-completes are buffered and flushed from ``on_connect``.
+Deliveries arrive on the network reader thread; callers queue into their
+event engine (the process runtime does), mirroring the paho-thread
+model.  Publishes/subscribes before the connection completes are
+buffered and flushed on CONNACK (no busy-wait — the reference's
+``wait_connected`` burns up to 2000 ms, ``mqtt.py:255-289``).
 """
 
 from __future__ import annotations
 
+import socket
 import threading
+import time
 from typing import Callable, Iterable, Optional, Union
 
 from ..utils.config import get_mqtt_configuration
+from ..utils.logger import get_logger
 from .message import Message, topic_matcher
-
-try:  # pragma: no cover - exercised only when paho is installed
-    import paho.mqtt.client as paho_mqtt
-    PAHO_AVAILABLE = True
-except ImportError:
-    paho_mqtt = None
-    PAHO_AVAILABLE = False
+from .mqtt_codec import (
+    CONNACK, PINGRESP, PUBLISH, SUBACK, PacketReader, encode_connect,
+    encode_disconnect, encode_pingreq, encode_publish, encode_subscribe,
+    encode_unsubscribe,
+)
 
 __all__ = ["MQTTMessage", "PAHO_AVAILABLE"]
 
+#: Kept for backward compatibility: the built-in client replaced the
+#: paho wrapper, so MQTT no longer depends on paho at all.
+PAHO_AVAILABLE = False
 
-class MQTTMessage(Message):  # pragma: no cover - needs broker + paho
+_logger = get_logger(__name__)
+
+_CONNECT_TIMEOUT = 5.0
+_KEEPALIVE = 60
+
+_client_counter = threading.Lock()
+_client_serial = [0]
+
+
+def _next_client_id() -> str:
+    import os
+    with _client_counter:
+        _client_serial[0] += 1
+        return f"aiko-tpu-{os.getpid()}-{_client_serial[0]}"
+
+
+class MQTTMessage(Message):
     def __init__(self, message_handler: Optional[Callable] = None,
                  topics: Optional[Iterable[str]] = None,
                  lwt_topic: Optional[str] = None,
                  lwt_payload: Union[str, bytes, None] = None,
-                 lwt_retain: bool = False):
-        if not PAHO_AVAILABLE:
-            raise ImportError(
-                "paho-mqtt is not installed; use the 'loopback' transport "
-                "(AIKO_TRANSPORT=loopback) or install paho-mqtt")
+                 lwt_retain: bool = False,
+                 host: Optional[str] = None,
+                 port: Optional[int] = None):
         self.message_handler = message_handler
         self.connection_handler = None  # optional: called with (connected)
-        self._connected = threading.Event()
-        self._pending = []
-        self._subscriptions = {}
-        host, port, tls, username, password = get_mqtt_configuration()
-        self._client = paho_mqtt.Client()
+        env_host, env_port, _tls, self._username, self._password = \
+            get_mqtt_configuration()
+        self.host = host or env_host
+        self.port = int(port or env_port)
+        self._client_id = _next_client_id()
+        self._will = None
         if lwt_topic is not None:
-            self._client.will_set(lwt_topic, lwt_payload, retain=lwt_retain)
-        if username:
-            self._client.username_pw_set(username, password)
-        if tls:
-            self._client.tls_set()
-        self._client.on_connect = self._on_connect
-        self._client.on_message = self._on_message
-        self._client.connect_async(host, port)
-        self._client.loop_start()
+            self._will = (lwt_topic, _to_bytes(lwt_payload), lwt_retain)
+        self._connected = threading.Event()
+        self._closing = False
+        self._fatal = False                  # CONNACK refused: no retry
+        self._socket: Optional[socket.socket] = None
+        self._send_lock = threading.Lock()
+        self._pending = []                   # publishes before CONNACK
+        self._subscriptions = {}             # pattern -> binary flag
+        self._packet_id = 0
+        self._suback_events = {}             # packet id -> Event
+        self._ping_stop: Optional[threading.Event] = None
+        self._lock = threading.RLock()
+        self._start()
         if topics:
             self.subscribe(topics)
 
-    def _on_connect(self, client, userdata, flags, rc):
-        self._connected.set()
-        for pattern in list(self._subscriptions):
-            client.subscribe(pattern)
-        pending, self._pending = self._pending, []
-        for topic, payload, retain in pending:
-            client.publish(topic, payload, retain=retain)
-        if self.connection_handler:
-            self.connection_handler(True)
+    # -- connection ---------------------------------------------------------- #
 
-    def _on_message(self, client, userdata, message):
+    def _start(self):
+        self._reader_thread = threading.Thread(
+            target=self._run, name=f"mqtt:{self.host}:{self.port}",
+            daemon=True)
+        self._reader_thread.start()
+
+    def _run(self):
+        """Connect / read / reconnect loop.  A socket drop (broker
+        restart, TCP reset) reconnects with exponential backoff and
+        re-subscribes from CONNACK — long-lived services must not go
+        permanently dark on a transient network event."""
+        backoff = 1.0
+        first_attempt = True
+        while not self._closing and not self._fatal:
+            sock = self._connect_once()
+            if sock is None:
+                if first_attempt and self.connection_handler:
+                    self.connection_handler(False)
+                first_attempt = False
+                if self._closing:
+                    return
+                time.sleep(min(backoff, 30.0))
+                backoff = min(backoff * 2, 30.0)
+                continue
+            first_attempt = False
+            backoff = 1.0
+            self._read_loop(sock)
+            was_connected = self._connected.is_set()
+            self._connected.clear()
+            if was_connected and not self._closing \
+                    and self.connection_handler:
+                self.connection_handler(False)
+
+    def _connect_once(self) -> Optional[socket.socket]:
+        try:
+            sock = socket.create_connection(
+                (self.host, self.port), timeout=_CONNECT_TIMEOUT)
+            sock.settimeout(None)
+            with self._send_lock:
+                self._socket = sock
+            will_topic = will_payload = None
+            will_retain = False
+            if self._will:
+                will_topic, will_payload, will_retain = self._will
+            self._send_raw(encode_connect(
+                self._client_id, keepalive=_KEEPALIVE,
+                will_topic=will_topic, will_payload=will_payload or b"",
+                will_retain=will_retain, username=self._username,
+                password=self._password))
+            return sock
+        except OSError as error:
+            _logger.warning("MQTT connect to %s:%s failed: %s",
+                            self.host, self.port, error)
+            return None
+
+    def _read_loop(self, sock: socket.socket):
+        reader = PacketReader()
+        while not self._closing:
+            try:
+                data = sock.recv(65536)
+            except OSError:
+                return
+            if not data:
+                return
+            try:
+                packets = reader.feed(data)
+            except ValueError:
+                _logger.warning("MQTT stream corrupt; reconnecting")
+                return
+            for packet in packets:
+                try:
+                    self._handle(packet)
+                except Exception:  # noqa: BLE001 - a bad handler (user
+                    # message_handler included) must not kill the
+                    # transport; mirrors paho's on_message isolation.
+                    _logger.exception("MQTT handler error on %s",
+                                      packet.packet_type)
+
+    def _handle(self, packet):
+        if packet.packet_type == CONNACK:
+            if packet.return_code != 0:
+                # Auth/config refusal is not transient: surface it and
+                # stop, rather than buffering publishes forever.
+                _logger.error("MQTT connection refused: rc=%s",
+                              packet.return_code)
+                self._fatal = True
+                if self.connection_handler:
+                    self.connection_handler(False)
+                return
+            self._connected.set()
+            with self._lock:
+                patterns = list(self._subscriptions)
+                pending, self._pending = self._pending, []
+            if patterns:
+                self._send_raw(encode_subscribe(self._next_packet_id(),
+                                                patterns))
+            for topic, payload, retain in pending:
+                self._send_raw(encode_publish(topic, payload, retain))
+            self._ping_timer()
+            if self.connection_handler:
+                self.connection_handler(True)
+        elif packet.packet_type == PUBLISH:
+            self._deliver(packet.topic, packet.payload)
+        elif packet.packet_type == SUBACK:
+            with self._lock:
+                event = self._suback_events.pop(packet.packet_id, None)
+            if event is not None:
+                event.set()
+        elif packet.packet_type == PINGRESP:
+            pass
+
+    def _ping_timer(self):
+        # One live ping thread per connection: stop the previous one
+        # (reconnect / LWT cycle) before starting the next.
+        if self._ping_stop is not None:
+            self._ping_stop.set()
+        stop = threading.Event()
+        self._ping_stop = stop
+
+        def ping():
+            while self._connected.is_set() and not self._closing:
+                if stop.wait(_KEEPALIVE / 2):
+                    return
+                if not self._send_raw(encode_pingreq()):
+                    return
+        threading.Thread(target=ping, name="mqtt-ping",
+                         daemon=True).start()
+
+    def _deliver(self, topic: str, payload: bytes):
         if self.message_handler is None:
             return
-        payload = message.payload
-        # Wildcard-aware: a message arriving via a binary "+/#" pattern
-        # subscription must stay bytes (mirrors loopback._deliver).
-        binary = any(flag and topic_matcher(pattern, message.topic)
-                     for pattern, flag in self._subscriptions.items())
+        with self._lock:
+            binary = any(flag and topic_matcher(pattern, topic)
+                         for pattern, flag in self._subscriptions.items())
         if not binary:
-            try:
-                payload = payload.decode()
-            except UnicodeDecodeError:
-                pass
-        self.message_handler(message.topic, payload)
+            data = payload.decode(errors="replace")
+        else:
+            data = payload
+        self.message_handler(topic, data)
+
+    def _send_raw(self, data: bytes) -> bool:
+        try:
+            with self._send_lock:
+                if self._socket is None:
+                    return False
+                self._socket.sendall(data)
+            return True
+        except OSError:
+            return False
+
+    def _next_packet_id(self) -> int:
+        with self._lock:
+            self._packet_id = self._packet_id % 65535 + 1
+            return self._packet_id
+
+    # -- Message API ---------------------------------------------------------- #
 
     @property
     def connected(self) -> bool:
         return self._connected.is_set()
 
     def publish(self, topic, payload, retain=False, wait=False):
+        data = _to_bytes(payload)
         if not self._connected.is_set():
-            self._pending.append((topic, payload, retain))
+            with self._lock:
+                self._pending.append((topic, data, retain))
             return
-        info = self._client.publish(topic, payload, retain=retain)
-        if wait:
-            info.wait_for_publish(timeout=2.0)
+        self._send_raw(encode_publish(topic, data, retain))
 
     def subscribe(self, topic, binary=False):
+        """Blocks until the broker SUBACKs (max 2 s): subscribe-then-
+        publish sequences would otherwise race the broker's routing
+        table and silently lose QoS-0 messages.  Never blocks when
+        called from the reader thread (the SUBACK would deadlock)."""
         patterns = [topic] if isinstance(topic, str) else list(topic)
-        for pattern in patterns:
-            self._subscriptions[pattern] = binary
-            if self._connected.is_set():
-                self._client.subscribe(pattern)
+        with self._lock:
+            for pattern in patterns:
+                self._subscriptions[pattern] = binary
+        if self._connected.is_set():
+            packet_id = self._next_packet_id()
+            on_reader = threading.current_thread() is self._reader_thread
+            acked = None
+            if not on_reader:
+                acked = threading.Event()
+                with self._lock:
+                    self._suback_events[packet_id] = acked
+            self._send_raw(encode_subscribe(packet_id, patterns))
+            if acked is not None:
+                acked.wait(timeout=2.0)
 
     def unsubscribe(self, topic):
         patterns = [topic] if isinstance(topic, str) else list(topic)
-        for pattern in patterns:
-            self._subscriptions.pop(pattern, None)
-            if self._connected.is_set():
-                self._client.unsubscribe(pattern)
+        with self._lock:
+            for pattern in patterns:
+                self._subscriptions.pop(pattern, None)
+        if self._connected.is_set():
+            self._send_raw(encode_unsubscribe(self._next_packet_id(),
+                                              patterns))
 
     def set_last_will_and_testament(self, topic=None, payload=None,
                                     retain=False):
-        # paho requires a reconnect cycle for a LWT change.
-        self._client.loop_stop()
-        self._client.disconnect()
-        if topic is not None:
-            self._client.will_set(topic, payload, retain=retain)
-        else:
-            self._client.will_clear()
+        """LWT is part of CONNECT, so changing it requires a graceful
+        disconnect/reconnect cycle (same constraint as the reference,
+        mqtt.py:192-201)."""
+        self._will = None if topic is None \
+            else (topic, _to_bytes(payload), retain)
+        self.disconnect(graceful=True)
+        self._closing = False
+        self._fatal = False
         self._connected.clear()
-        self._client.reconnect()
-        self._client.loop_start()
+        self._start()
 
     def disconnect(self, graceful=True):
-        if graceful:
-            self._client.disconnect()
-        self._client.loop_stop()
+        self._closing = True
+        if self._ping_stop is not None:
+            self._ping_stop.set()
+        if graceful and self._connected.is_set():
+            self._send_raw(encode_disconnect())
         self._connected.clear()
+        with self._send_lock:
+            sock, self._socket = self._socket, None
+        if sock is not None:
+            try:
+                # shutdown() first: close() alone defers the FIN while
+                # the reader thread's blocked recv() holds the file
+                # reference — the broker would never see the drop.
+                # Without a preceding DISCONNECT packet the broker
+                # treats the drop as ungraceful and fires the will.
+                sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                sock.close()
+            except OSError:
+                pass
+        if self._reader_thread is not threading.current_thread():
+            self._reader_thread.join(timeout=2.0)
+
+
+def _to_bytes(payload) -> bytes:
+    if payload is None:
+        return b""
+    if isinstance(payload, bytes):
+        return payload
+    return str(payload).encode("utf-8")

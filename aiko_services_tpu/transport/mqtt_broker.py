@@ -1,0 +1,210 @@
+"""Built-in MQTT 3.1.1 broker (QoS 0 subset) over TCP.
+
+The cross-OS-process control plane: real sockets, real processes — the
+role mosquitto plays for the reference (its scripts/system_start.sh
+launches one; every reference protocol assumes a broker).  This broker
+implements exactly the semantics those protocols need, matching the
+in-memory :class:`~.loopback.LoopbackBroker` feature-for-feature:
+
+* QoS-0 publish/subscribe with ``+``/``#`` wildcards,
+* retained messages (replayed on subscribe; empty retained clears),
+* last-will-and-testament fired on ungraceful disconnect (socket drop
+  without DISCONNECT — the process-death ``(absent)`` liveness signal).
+
+One thread per client connection plus an accept thread; state mutations
+are lock-protected.  Standard clients (paho, mosquitto_pub/sub)
+interoperate — the wire format is plain MQTT 3.1.1.
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from .message import topic_matcher
+from .mqtt_codec import (
+    CONNECT, DISCONNECT, PINGREQ, PUBLISH, SUBSCRIBE, UNSUBSCRIBE,
+    Packet, PacketReader, encode_connack, encode_pingresp, encode_publish,
+    encode_suback, encode_unsuback,
+)
+from ..utils.logger import get_logger
+
+__all__ = ["MqttBroker"]
+
+_logger = get_logger(__name__)
+
+
+def _close_socket(connection: socket.socket):
+    """shutdown() before close(): close() alone defers the FIN while
+    another thread's blocked recv() holds the file reference, so the
+    peer would never see the drop."""
+    try:
+        connection.shutdown(socket.SHUT_RDWR)
+    except OSError:
+        pass
+    try:
+        connection.close()
+    except OSError:
+        pass
+
+
+class _ClientSession:
+    def __init__(self, connection: socket.socket, address):
+        self.connection = connection
+        self.address = address
+        self.client_id = ""
+        self.subscriptions: List[str] = []
+        self.will: Optional[Tuple[str, bytes, bool]] = None
+        self.send_lock = threading.Lock()
+        self.alive = True
+
+    def send(self, data: bytes) -> bool:
+        try:
+            with self.send_lock:
+                self.connection.sendall(data)
+            return True
+        except OSError:
+            self.alive = False
+            return False
+
+
+class MqttBroker:
+    """``MqttBroker(port=0)`` binds an ephemeral port (see ``.port``);
+    ``stop()`` closes everything.  Thread-safe."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 1883):
+        self._server = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._server.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._server.bind((host, port))
+        self._server.listen(64)
+        self.host, self.port = self._server.getsockname()[:2]
+        self._lock = threading.RLock()
+        self._sessions: List[_ClientSession] = []
+        self._retained: Dict[str, bytes] = {}
+        self._running = True
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, name=f"mqtt-broker:{self.port}",
+            daemon=True)
+        self._accept_thread.start()
+
+    # -- server loops -------------------------------------------------------- #
+
+    def _accept_loop(self):
+        while self._running:
+            try:
+                connection, address = self._server.accept()
+            except OSError:
+                return                        # server socket closed
+            session = _ClientSession(connection, address)
+            threading.Thread(target=self._client_loop, args=(session,),
+                             name=f"mqtt-client:{address}",
+                             daemon=True).start()
+
+    def _client_loop(self, session: _ClientSession):
+        reader = PacketReader()
+        graceful = False
+        try:
+            while self._running:
+                data = session.connection.recv(65536)
+                if not data:
+                    break
+                for packet in reader.feed(data):
+                    if packet.packet_type == DISCONNECT:
+                        graceful = True
+                        return
+                    self._handle(session, packet)
+        except OSError:
+            pass
+        except Exception:  # noqa: BLE001 - garbage bytes (port scans,
+            # stray HTTP) raise struct.error/IndexError/UnicodeError
+            # from the decoder; drop the client, never the broker.
+            _logger.debug("broker: dropping %s on malformed input",
+                          session.address, exc_info=True)
+        finally:
+            self._drop(session, graceful)
+
+    # -- packet handling ------------------------------------------------------ #
+
+    def _handle(self, session: _ClientSession, packet: Packet):
+        if packet.packet_type == CONNECT:
+            session.client_id = packet.client_id
+            if packet.will_topic is not None:
+                session.will = (packet.will_topic, packet.will_payload,
+                                packet.will_retain)
+            with self._lock:
+                self._sessions.append(session)
+            session.send(encode_connack())
+        elif packet.packet_type == PUBLISH:
+            self._publish(packet.topic, packet.payload, packet.retain)
+        elif packet.packet_type == SUBSCRIBE:
+            with self._lock:
+                for pattern in packet.patterns:
+                    if pattern not in session.subscriptions:
+                        session.subscriptions.append(pattern)
+                retained = [(t, p) for t, p in self._retained.items()
+                            if any(topic_matcher(pattern, t)
+                                   for pattern in packet.patterns)]
+            session.send(encode_suback(packet.packet_id,
+                                       len(packet.patterns)))
+            for topic, payload in retained:
+                session.send(encode_publish(topic, payload, retain=True))
+        elif packet.packet_type == UNSUBSCRIBE:
+            with self._lock:
+                for pattern in packet.patterns:
+                    if pattern in session.subscriptions:
+                        session.subscriptions.remove(pattern)
+            session.send(encode_unsuback(packet.packet_id))
+        elif packet.packet_type == PINGREQ:
+            session.send(encode_pingresp())
+
+    def _publish(self, topic: str, payload: bytes, retain: bool):
+        if retain:
+            with self._lock:
+                if payload:
+                    self._retained[topic] = payload
+                else:
+                    self._retained.pop(topic, None)
+        data = encode_publish(topic, payload)
+        with self._lock:
+            targets = [s for s in self._sessions
+                       if s.alive and any(topic_matcher(p, topic)
+                                          for p in s.subscriptions)]
+        for target in targets:
+            target.send(data)
+
+    def _drop(self, session: _ClientSession, graceful: bool):
+        with self._lock:
+            if session in self._sessions:
+                self._sessions.remove(session)
+            else:
+                graceful = True               # never completed CONNECT
+        session.alive = False
+        _close_socket(session.connection)
+        if not graceful and session.will is not None:
+            topic, payload, retain = session.will
+            _logger.debug("broker: firing will of %s on %s",
+                          session.client_id, topic)
+            self._publish(topic, payload, retain)
+
+    # -- admin ---------------------------------------------------------------- #
+
+    def stop(self):
+        self._running = False
+        try:
+            self._server.close()
+        except OSError:
+            pass
+        with self._lock:
+            sessions = list(self._sessions)
+            self._sessions.clear()
+        for session in sessions:
+            session.alive = False
+            _close_socket(session.connection)
+
+    def clear_retained(self, topic: Optional[str] = None):
+        with self._lock:
+            if topic is None:
+                self._retained.clear()
+            else:
+                self._retained.pop(topic, None)

@@ -1,0 +1,242 @@
+"""MQTT 3.1.1 wire codec (the subset the framework's protocols use).
+
+Implements packet encode/decode for QoS-0 MQTT 3.1.1: CONNECT/CONNACK
+(with last-will), PUBLISH (retain flag), SUBSCRIBE/SUBACK,
+UNSUBSCRIBE/UNSUBACK, PINGREQ/PINGRESP, DISCONNECT.  Shared by the
+built-in broker (:mod:`mqtt_broker`) and the built-in client
+(:mod:`mqtt`), and wire-compatible with any standard broker/client
+(mosquitto, paho) — the reference's whole control plane is MQTT
+(reference ``main/message/mqtt.py:65-289``), and this codec is what lets
+this framework speak it without external dependencies.
+
+Spec references are to the OASIS MQTT 3.1.1 standard section numbers.
+"""
+
+from __future__ import annotations
+
+import struct
+from dataclasses import dataclass, field
+from typing import List, Optional, Tuple
+
+__all__ = [
+    "CONNECT", "CONNACK", "PUBLISH", "SUBSCRIBE", "SUBACK",
+    "UNSUBSCRIBE", "UNSUBACK", "PINGREQ", "PINGRESP", "DISCONNECT",
+    "Packet", "encode_connect", "encode_connack", "encode_publish",
+    "encode_subscribe", "encode_suback", "encode_unsubscribe",
+    "encode_unsuback", "encode_pingreq", "encode_pingresp",
+    "encode_disconnect", "encode_remaining_length", "PacketReader",
+]
+
+# Packet types (spec §2.2.1).
+CONNECT, CONNACK, PUBLISH = 1, 2, 3
+SUBSCRIBE, SUBACK, UNSUBSCRIBE, UNSUBACK = 8, 9, 10, 11
+PINGREQ, PINGRESP, DISCONNECT = 12, 13, 14
+
+_PROTOCOL_NAME = b"\x00\x04MQTT"
+_PROTOCOL_LEVEL = 4          # 3.1.1
+
+
+def _utf8(value: str) -> bytes:
+    data = value.encode("utf-8")
+    return struct.pack("!H", len(data)) + data
+
+
+def _read_utf8(data: bytes, offset: int) -> Tuple[str, int]:
+    (length,) = struct.unpack_from("!H", data, offset)
+    end = offset + 2 + length
+    return data[offset + 2:end].decode("utf-8"), end
+
+
+def encode_remaining_length(length: int) -> bytes:
+    """Variable-length remaining-length field (spec §2.2.3)."""
+    out = bytearray()
+    while True:
+        byte = length % 128
+        length //= 128
+        out.append(byte | 0x80 if length else byte)
+        if not length:
+            return bytes(out)
+
+
+def _fixed(packet_type: int, flags: int, body: bytes) -> bytes:
+    return bytes([(packet_type << 4) | flags]) + \
+        encode_remaining_length(len(body)) + body
+
+
+# --------------------------------------------------------------------------- #
+# Encoders
+
+def encode_connect(client_id: str, keepalive: int = 60,
+                   will_topic: Optional[str] = None,
+                   will_payload: bytes = b"",
+                   will_retain: bool = False,
+                   username: Optional[str] = None,
+                   password: Optional[str] = None) -> bytes:
+    flags = 0x02                              # clean session
+    if will_topic is not None:
+        flags |= 0x04 | (0x20 if will_retain else 0)
+    if username is not None:
+        flags |= 0x80
+    if password is not None:
+        flags |= 0x40
+    body = _PROTOCOL_NAME + bytes([_PROTOCOL_LEVEL, flags]) + \
+        struct.pack("!H", keepalive) + _utf8(client_id)
+    if will_topic is not None:
+        body += _utf8(will_topic)
+        body += struct.pack("!H", len(will_payload)) + will_payload
+    if username is not None:
+        body += _utf8(username)
+    if password is not None:
+        body += _utf8(password)
+    return _fixed(CONNECT, 0, body)
+
+
+def encode_connack(session_present: bool = False,
+                   return_code: int = 0) -> bytes:
+    return _fixed(CONNACK, 0,
+                  bytes([1 if session_present else 0, return_code]))
+
+
+def encode_publish(topic: str, payload: bytes,
+                   retain: bool = False) -> bytes:
+    return _fixed(PUBLISH, 0x01 if retain else 0,
+                  _utf8(topic) + payload)
+
+
+def encode_subscribe(packet_id: int, patterns: List[str]) -> bytes:
+    body = struct.pack("!H", packet_id)
+    for pattern in patterns:
+        body += _utf8(pattern) + b"\x00"      # requested QoS 0
+    return _fixed(SUBSCRIBE, 0x02, body)
+
+
+def encode_suback(packet_id: int, count: int) -> bytes:
+    return _fixed(SUBACK, 0, struct.pack("!H", packet_id) + b"\x00" * count)
+
+
+def encode_unsubscribe(packet_id: int, patterns: List[str]) -> bytes:
+    body = struct.pack("!H", packet_id)
+    for pattern in patterns:
+        body += _utf8(pattern)
+    return _fixed(UNSUBSCRIBE, 0x02, body)
+
+
+def encode_unsuback(packet_id: int) -> bytes:
+    return _fixed(UNSUBACK, 0, struct.pack("!H", packet_id))
+
+
+def encode_pingreq() -> bytes:
+    return _fixed(PINGREQ, 0, b"")
+
+
+def encode_pingresp() -> bytes:
+    return _fixed(PINGRESP, 0, b"")
+
+
+def encode_disconnect() -> bytes:
+    return _fixed(DISCONNECT, 0, b"")
+
+
+# --------------------------------------------------------------------------- #
+# Decoder
+
+@dataclass
+class Packet:
+    packet_type: int
+    flags: int = 0
+    # CONNECT
+    client_id: str = ""
+    keepalive: int = 0
+    will_topic: Optional[str] = None
+    will_payload: bytes = b""
+    will_retain: bool = False
+    username: Optional[str] = None
+    password: Optional[str] = None
+    # CONNACK
+    return_code: int = 0
+    # PUBLISH
+    topic: str = ""
+    payload: bytes = b""
+    retain: bool = False
+    # SUBSCRIBE / UNSUBSCRIBE
+    packet_id: int = 0
+    patterns: List[str] = field(default_factory=list)
+
+
+def _decode_body(packet_type: int, flags: int, body: bytes) -> Packet:
+    packet = Packet(packet_type=packet_type, flags=flags)
+    if packet_type == CONNECT:
+        if body[:6] != _PROTOCOL_NAME:
+            raise ValueError("not an MQTT 3.1.1 CONNECT")
+        connect_flags = body[7]
+        packet.keepalive = struct.unpack_from("!H", body, 8)[0]
+        packet.client_id, offset = _read_utf8(body, 10)
+        if connect_flags & 0x04:              # will flag
+            packet.will_topic, offset = _read_utf8(body, offset)
+            (length,) = struct.unpack_from("!H", body, offset)
+            packet.will_payload = body[offset + 2:offset + 2 + length]
+            packet.will_retain = bool(connect_flags & 0x20)
+            offset += 2 + length
+        if connect_flags & 0x80:
+            packet.username, offset = _read_utf8(body, offset)
+        if connect_flags & 0x40:
+            packet.password, offset = _read_utf8(body, offset)
+    elif packet_type == CONNACK:
+        packet.return_code = body[1]
+    elif packet_type == PUBLISH:
+        packet.retain = bool(flags & 0x01)
+        packet.topic, offset = _read_utf8(body, 0)
+        if flags & 0x06:                      # QoS > 0: skip packet id
+            offset += 2
+        packet.payload = body[offset:]
+    elif packet_type in (SUBSCRIBE, UNSUBSCRIBE):
+        packet.packet_id = struct.unpack_from("!H", body, 0)[0]
+        offset = 2
+        while offset < len(body):
+            pattern, offset = _read_utf8(body, offset)
+            packet.patterns.append(pattern)
+            if packet_type == SUBSCRIBE:
+                offset += 1                   # requested QoS byte
+    elif packet_type in (SUBACK, UNSUBACK):
+        packet.packet_id = struct.unpack_from("!H", body, 0)[0]
+    return packet
+
+
+class PacketReader:
+    """Incremental decoder: ``feed()`` bytes, iterate complete packets.
+    Handles arbitrary TCP fragmentation/coalescing."""
+
+    def __init__(self):
+        self._buffer = bytearray()
+
+    def feed(self, data: bytes) -> List[Packet]:
+        self._buffer.extend(data)
+        packets = []
+        while True:
+            parsed = self._try_parse()
+            if parsed is None:
+                return packets
+            packets.append(parsed)
+
+    def _try_parse(self) -> Optional[Packet]:
+        buf = self._buffer
+        if len(buf) < 2:
+            return None
+        remaining, multiplier, offset = 0, 1, 1
+        while True:
+            if offset >= len(buf):
+                return None
+            byte = buf[offset]
+            remaining += (byte & 0x7F) * multiplier
+            multiplier *= 128
+            offset += 1
+            if not byte & 0x80:
+                break
+            if multiplier > 128 ** 3:
+                raise ValueError("malformed remaining length")
+        if len(buf) < offset + remaining:
+            return None
+        body = bytes(buf[offset:offset + remaining])
+        packet_type, flags = buf[0] >> 4, buf[0] & 0x0F
+        del buf[:offset + remaining]
+        return _decode_body(packet_type, flags, body)

@@ -379,6 +379,15 @@ def main():
         if tps is not None:
             result["llm_int8_tokens_per_sec_chip"] = round(tps)
 
+        tps = run_section(
+            "llm_moe_int8", 420,
+            lambda: bench_llm_decode(batch=8, prompt_len=64,
+                                     new_tokens=128,
+                                     config_name="moe_small",
+                                     quantize=True))
+        if tps is not None:
+            result["llm_moe_int8_tokens_per_sec_chip"] = round(tps)
+
         # Flagship LAST: the heaviest section, so a wedge here cannot
         # take the earlier captures down with it.
         tps = run_section(

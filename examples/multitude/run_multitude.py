@@ -6,15 +6,23 @@ chained pipelines, each hop crossing process boundaries, driven at a
 target frame rate; the reference's note says ~50 Hz was the "maximum
 frame rate before falling behind" for 10 chained pipelines.
 
-This harness builds the same chain topology with N simulated processes
-over the loopback broker (one OS process, N Process instances, shared
-event engine — the in-process equivalent) and measures the maximum
-sustained end-to-end frame rate.
+Two modes:
+
+* default — N simulated processes over the loopback broker (one OS
+  process, N Process instances, shared event engine).  Measures the
+  engine's in-process ceiling; NOT apples-to-apples with the
+  reference's number.
+* ``--cross-process`` — the honest comparison: the built-in MQTT broker
+  plus N−1 real OS child processes (one pipeline each), every hop
+  crossing a real TCP socket; the head counts ROUND-TRIP completions
+  (frame travels the whole chain and the response chains back).
 
 Run:  python examples/multitude/run_multitude.py [--pipelines 10]
-      [--frames 500]
+      [--frames 500] [--cross-process]
 """
 
+import os
+import subprocess
 import sys
 import time
 
@@ -58,10 +66,101 @@ def chain_definition(index: int, total: int):
             "graph": graph, "elements": elements}
 
 
-@click.command()
-@click.option("--pipelines", default=10)
-@click.option("--frames", default=500)
-def main(pipelines, frames):
+def make_chain_pipeline(index, total, process):
+    definition = parse_pipeline_definition(chain_definition(index, total))
+    return compose_instance(
+        Pipeline, pipeline_args(f"mt_{index}", definition=definition),
+        process=process)
+
+
+def run_child(index: int, total: int):
+    """Child mode: host pipeline mt_{index} over MQTT and serve."""
+    engine = EventEngine()
+    process = Process(engine=engine, transport="mqtt")
+    make_chain_pipeline(index, total, process)
+    print("READY", flush=True)
+    engine.loop()
+
+
+def run_cross_process(pipelines: int, frames: int):
+    import queue
+    from aiko_services_tpu.transport import MqttBroker
+
+    broker = MqttBroker(port=0)
+    namespace = f"mt{broker.port}"
+    os.environ["AIKO_MQTT_HOST"] = broker.host
+    os.environ["AIKO_MQTT_PORT"] = str(broker.port)
+    env = dict(os.environ, AIKO_NAMESPACE=namespace, JAX_PLATFORMS="cpu")
+
+    children = []
+    try:
+        engine = EventEngine()
+        process = Process(namespace=namespace, engine=engine,
+                          transport="mqtt")
+        Registrar(process=process)
+        thread = engine.run_in_thread()
+
+        script = os.path.abspath(__file__)
+        for i in range(1, pipelines):
+            child = subprocess.Popen(
+                [sys.executable, script, "--child", str(i),
+                 "--pipelines", str(pipelines)],
+                env=env, stdout=subprocess.PIPE,
+                stderr=subprocess.DEVNULL, text=True)
+            children.append(child)
+        for child in children:
+            assert child.stdout.readline().strip() == "READY"
+
+        head = make_chain_pipeline(0, pipelines, process)
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            if all(p is not None for p in head.remote_proxies.values()):
+                break
+            time.sleep(0.05)
+        else:
+            raise RuntimeError("chain never fully discovered")
+
+        out = queue.Queue()
+        head.create_stream("load", queue_response=out,
+                           grace_time=300.0)
+
+        def pump(count):
+            """Bounded in-flight round-trips through the whole chain."""
+            posted = received = 0
+            max_in_flight = 32
+            while received < count:
+                while posted < count and \
+                        posted - received < max_in_flight:
+                    head.post_frame("load", {"i": 0})
+                    posted += 1
+                out.get(timeout=60)
+                received += 1
+
+        warmup = min(50, frames // 5)
+        pump(warmup)
+        started = time.perf_counter()
+        pump(frames)
+        elapsed = time.perf_counter() - started
+        rate = frames / elapsed
+        print(f"multitude CROSS-PROCESS: {pipelines} chained pipelines "
+              f"({pipelines} OS processes, built-in MQTT broker), "
+              f"{frames} round-trip frames in {elapsed:.2f}s "
+              f"= {rate:.0f} frames/sec sustained "
+              f"(reference: ~50 Hz one-way, run_large.sh:7,20)")
+        engine.terminate()
+        thread.join(timeout=2)
+    finally:
+        for child in children:
+            child.terminate()
+        for child in children:
+            try:
+                child.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                child.kill()
+        broker.stop()
+
+
+def run_loopback(pipelines: int, frames: int):
     engine = EventEngine()
     broker = "multitude"
     registrar_process = Process(namespace="mt", hostname="h", pid="0",
@@ -75,11 +174,7 @@ def main(pipelines, frames):
     for i in range(pipelines):
         process = Process(namespace="mt", hostname="h", pid=str(i + 1),
                           engine=engine, broker=broker)
-        definition = parse_pipeline_definition(
-            chain_definition(i, pipelines))
-        chain.append(compose_instance(
-            Pipeline, pipeline_args(f"mt_{i}", definition=definition),
-            process=process))
+        chain.append(make_chain_pipeline(i, pipelines, process))
 
     # Wait for every remote hop to resolve.
     deadline = time.time() + 15
@@ -110,12 +205,27 @@ def main(pipelines, frames):
         time.sleep(0.01)
     elapsed = time.perf_counter() - started
     rate = frames / elapsed
-    print(f"multitude: {pipelines} chained pipelines, "
+    print(f"multitude IN-PROCESS (loopback broker; not apples-to-apples "
+          f"with the reference): {pipelines} chained pipelines, "
           f"{frames} frames end-to-end in {elapsed:.2f}s "
           f"= {rate:.0f} frames/sec sustained "
-          f"(reference: ~50 Hz, run_large.sh:7,20)")
+          f"(reference: ~50 Hz cross-process, run_large.sh:7,20)")
     engine.terminate()
     thread.join(timeout=2)
+
+
+@click.command()
+@click.option("--pipelines", default=10)
+@click.option("--frames", default=500)
+@click.option("--cross-process", is_flag=True, default=False)
+@click.option("--child", default=None, type=int, hidden=True)
+def main(pipelines, frames, cross_process, child):
+    if child is not None:
+        run_child(child, pipelines)
+    elif cross_process:
+        run_cross_process(pipelines, frames)
+    else:
+        run_loopback(pipelines, frames)
 
 
 if __name__ == "__main__":

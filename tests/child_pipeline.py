@@ -1,0 +1,48 @@
+"""Child process for cross-OS-process integration tests.
+
+Run as ``python -m tests.child_pipeline``: connects to the MQTT broker
+named by AIKO_MQTT_HOST/AIKO_MQTT_PORT, hosts the Registrar plus the
+callee pipeline ``p_remote`` (PE_Double), prints READY, and serves until
+killed — the role a second machine plays in the reference's multitude
+setup (reference examples/pipeline/multitude/run_large.sh drives 10 such
+processes against mosquitto)."""
+
+import sys
+
+
+def main():
+    from aiko_services_tpu.pipeline import (
+        Pipeline, parse_pipeline_definition,
+    )
+    from aiko_services_tpu.registry import Registrar
+    from aiko_services_tpu.runtime import (
+        Process, compose_instance, pipeline_args,
+    )
+    from aiko_services_tpu.runtime.event import EventEngine
+
+    definition = {
+        "version": 0, "name": "p_remote", "runtime": "python",
+        "graph": ["(PE_Double)"],
+        "elements": [{
+            "name": "PE_Double",
+            "input": [{"name": "i", "type": "int"}],
+            "output": [{"name": "i", "type": "int"}],
+            "parameters": {},
+            "deploy": {"local": {"module": "tests.pipeline_elements",
+                                 "class_name": "PE_Double"}},
+        }],
+    }
+    engine = EventEngine()
+    process = Process(engine=engine, transport="mqtt")
+    Registrar(process=process)
+    compose_instance(
+        Pipeline,
+        pipeline_args("p_remote",
+                      definition=parse_pipeline_definition(definition)),
+        process=process)
+    print("READY", flush=True)
+    engine.loop()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

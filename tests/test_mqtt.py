@@ -1,0 +1,189 @@
+"""Built-in MQTT 3.1.1 broker + client contract tests.
+
+The same semantic matrix the loopback broker passes (test_transport.py)
+run over REAL TCP sockets: pub/sub, wildcards, retained replay/clear,
+LWT on ungraceful disconnect, binary topics — plus codec round-trip
+under arbitrary fragmentation (VERDICT r1 #7: MQTT wire semantics must
+be exercised, not just written)."""
+
+import time
+
+import pytest
+
+from aiko_services_tpu.transport import MqttBroker, MQTTMessage
+from aiko_services_tpu.transport.mqtt_codec import (
+    PacketReader, encode_connect, encode_publish, encode_subscribe,
+    CONNECT, PUBLISH, SUBSCRIBE,
+)
+
+
+@pytest.fixture()
+def broker():
+    b = MqttBroker(port=0)
+    yield b
+    b.stop()
+
+
+def connect(broker, handler=None, **kwargs) -> MQTTMessage:
+    client = MQTTMessage(message_handler=handler, host=broker.host,
+                         port=broker.port, **kwargs)
+    deadline = time.time() + 5.0
+    while not client.connected and time.time() < deadline:
+        time.sleep(0.01)
+    assert client.connected, "client failed to connect"
+    return client
+
+
+def wait_for(predicate, timeout=5.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if predicate():
+            return True
+        time.sleep(0.01)
+    return False
+
+
+# -- codec ------------------------------------------------------------------- #
+
+def test_codec_roundtrip_fragmentation():
+    """Packets must decode identically regardless of TCP chunking."""
+    stream = (encode_connect("cid", will_topic="ns/h/1/0/state",
+                             will_payload=b"(absent)", will_retain=False)
+              + encode_subscribe(1, ["a/+/c", "#"])
+              + encode_publish("a/b/c", b"payload " * 40, retain=True))
+    for chunk in (1, 2, 3, 7, len(stream)):
+        reader = PacketReader()
+        packets = []
+        for i in range(0, len(stream), chunk):
+            packets.extend(reader.feed(stream[i:i + chunk]))
+        assert [p.packet_type for p in packets] == \
+            [CONNECT, SUBSCRIBE, PUBLISH]
+        assert packets[0].client_id == "cid"
+        assert packets[0].will_topic == "ns/h/1/0/state"
+        assert packets[0].will_payload == b"(absent)"
+        assert packets[1].patterns == ["a/+/c", "#"]
+        assert packets[2].topic == "a/b/c"
+        assert packets[2].retain
+        assert packets[2].payload == b"payload " * 40
+
+
+# -- broker/client semantics -------------------------------------------------- #
+
+def test_publish_subscribe_wildcards(broker):
+    got = []
+    sub = connect(broker, lambda t, p: got.append((t, p)))
+    pub = connect(broker)
+    sub.subscribe("ns/+/in")
+    pub.publish("ns/svc/in", "(hello)")
+    pub.publish("ns/svc/out", "(ignored)")
+    assert wait_for(lambda: got == [("ns/svc/in", "(hello)")])
+    time.sleep(0.05)
+    assert got == [("ns/svc/in", "(hello)")]
+    sub.disconnect()
+    pub.disconnect()
+
+
+def test_retained_replay_and_clear(broker):
+    pub = connect(broker)
+    pub.publish("ns/service/registrar", "(primary found x 2 0)",
+                retain=True)
+    got = []
+    sub = connect(broker, lambda t, p: got.append(p))
+    sub.subscribe("ns/service/registrar")
+    assert wait_for(lambda: got == ["(primary found x 2 0)"])
+    pub.publish("ns/service/registrar", "", retain=True)
+    time.sleep(0.1)
+    got2 = []
+    sub2 = connect(broker, lambda t, p: got2.append(p))
+    sub2.subscribe("ns/service/registrar")
+    time.sleep(0.2)
+    assert got2 == []
+    for c in (pub, sub, sub2):
+        c.disconnect()
+
+
+def test_lwt_fires_on_ungraceful_disconnect(broker):
+    got = []
+    watcher = connect(broker, lambda t, p: got.append((t, p)))
+    watcher.subscribe("ns/+/+/+/state")
+    client = connect(broker, lwt_topic="ns/h/1/0/state",
+                     lwt_payload="(absent)")
+    client.disconnect(graceful=False)
+    assert wait_for(lambda: got == [("ns/h/1/0/state", "(absent)")])
+    watcher.disconnect()
+
+
+def test_lwt_not_fired_on_graceful_disconnect(broker):
+    got = []
+    watcher = connect(broker, lambda t, p: got.append(p))
+    watcher.subscribe("#")
+    client = connect(broker, lwt_topic="t", lwt_payload="(absent)")
+    client.disconnect(graceful=True)
+    time.sleep(0.2)
+    assert got == []
+    watcher.disconnect()
+
+
+def test_binary_topics(broker):
+    got = []
+    sub = connect(broker, lambda t, p: got.append(p))
+    sub.subscribe("data/raw", binary=True)
+    pub = connect(broker)
+    pub.publish("data/raw", b"\x00\x01\x02")
+    assert wait_for(lambda: got == [b"\x00\x01\x02"])
+    sub.disconnect()
+    pub.disconnect()
+
+
+def test_publish_before_connack_is_buffered(broker):
+    got = []
+    sub = connect(broker, lambda t, p: got.append(p))
+    sub.subscribe("t")
+    # No wait-for-connected: publish immediately after construction.
+    pub = MQTTMessage(host=broker.host, port=broker.port)
+    pub.publish("t", "early")
+    assert wait_for(lambda: got == ["early"])
+    sub.disconnect()
+    pub.disconnect()
+
+
+def test_lwt_change_reconnect_cycle(broker):
+    """set_last_will_and_testament cycles the connection (reference
+    constraint, mqtt.py:192-201); the OLD will must not fire."""
+    got = []
+    watcher = connect(broker, lambda t, p: got.append((t, p)))
+    watcher.subscribe("wills/#")
+    client = connect(broker, lwt_topic="wills/old",
+                     lwt_payload="(absent)")
+    client.set_last_will_and_testament("wills/new", "(gone)")
+    assert wait_for(lambda: client.connected)
+    time.sleep(0.1)
+    assert got == []                 # graceful cycle: old will silent
+    client.disconnect(graceful=False)
+    assert wait_for(lambda: got == [("wills/new", "(gone)")])
+    watcher.disconnect()
+
+
+def test_client_reconnects_after_broker_restart():
+    """A socket drop must not permanently kill the transport: the
+    client reconnects with backoff and re-subscribes, and buffered
+    publishes flush."""
+    b1 = MqttBroker(port=0)
+    port = b1.port
+    got = []
+    sub = connect(b1, lambda t, p: got.append(p))
+    sub.subscribe("t")
+    b1.stop()
+    assert wait_for(lambda: not sub.connected, 10)
+    sub.publish("t", "while-down")           # buffered
+    b2 = MqttBroker(port=port)
+    try:
+        assert wait_for(lambda: sub.connected, 15)
+        assert wait_for(lambda: "while-down" in got, 10), got
+        pub = connect(b2)
+        pub.publish("t", "after-restart")
+        assert wait_for(lambda: "after-restart" in got, 10), got
+        pub.disconnect()
+        sub.disconnect()
+    finally:
+        b2.stop()

@@ -128,3 +128,39 @@ def test_llama_infer_rejects_overlong_prompt():
     too_long = llama.CONFIGS["tiny"].max_seq_len
     out = infer({"tokens": np.zeros((1, too_long), np.int32)})
     assert "error" in out and "max_seq_len" in out["error"]
+
+
+def test_moe_int8_replica_end_to_end(engine):
+    """The EP/MoE model family composes with the serving stack: an
+    int8-quantized moe_tiny replica serves a chat request through the
+    router (VERDICT r1 #10)."""
+    p0 = make_process(engine, 1, broker="moellm")
+    Registrar(process=p0)
+    engine.advance(4.0)
+
+    p1 = make_process(engine, 2, broker="moellm")
+    compose_instance(
+        ModelReplica, actor_args("moe_replica"), process=p1,
+        infer=make_llama_infer("moe_tiny", quantize=True,
+                               max_new_tokens=4))
+    pr = make_process(engine, 3, broker="moellm")
+    router = compose_instance(ReplicaRouter, actor_args("router"),
+                              process=pr)
+    engine.drain()
+    assert router.share["replicas"] == 1
+
+    responses = []
+    response_topic = "test/h/3/client/response"
+    collect_responses(pr, response_topic, responses)
+    prompt = np.arange(1, 7, dtype=np.int32)[None, :]
+    pr.message.publish(
+        f"{router.topic_path}/in",
+        generate("infer", ["moe1", response_topic,
+                           encode_swag({"tokens": prompt})]))
+    engine.drain()
+    assert len(responses) == 1
+    request_id, outputs = responses[0]
+    assert request_id == "moe1"
+    tokens_out = np.asarray(outputs["tokens_out"])
+    assert tokens_out.shape == (1, 10)
+    assert (tokens_out[:, :6] == prompt).all()

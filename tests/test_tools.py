@@ -72,3 +72,107 @@ def test_video_show_headless(tmp_path):
     event, outputs = show.process_frame(stream, images=[image])
     assert event == StreamEvent.OKAY
     assert outputs["images"][0] is image
+
+
+def _dashboard_env(engine, broker):
+    """A registrar + a live actor + a DashboardState over loopback."""
+    from aiko_services_tpu.registry import Registrar
+    from aiko_services_tpu.runtime import (
+        Process, actor_args, compose_instance,
+    )
+    from aiko_services_tpu.runtime.actor import Actor
+    from aiko_services_tpu.tools.dashboard import DashboardState
+
+    reg_process = Process(namespace="dash", hostname="h", pid="1",
+                          engine=engine, broker=broker)
+    Registrar(process=reg_process)
+    engine.advance(4.0)
+    actor_process = Process(namespace="dash", hostname="h", pid="2",
+                            engine=engine, broker=broker)
+    actor = compose_instance(Actor, actor_args("victim"),
+                             process=actor_process)
+    dash_process = Process(namespace="dash", hostname="h", pid="3",
+                           engine=engine, broker=broker)
+    state = DashboardState(dash_process)
+    engine.drain()
+    return state, actor
+
+
+def test_dashboard_kill_service_control(engine):
+    """Operator kill: the dashboard publishes (terminate) and the
+    selected service stops and is evicted (reference
+    dashboard.py:565-648)."""
+    state, actor = _dashboard_env(engine, "dashkill")
+    names = [f.name for f in state.services()]
+    assert "victim" in names
+    state.select(names.index("victim"))
+    target = state.kill_selected()
+    assert target == actor.topic_path
+    engine.drain()
+    engine.advance(1.0)
+    assert "victim" not in [f.name for f in state.services()]
+
+
+def test_dashboard_set_log_level_control(engine):
+    """Operator log level: (log_level DEBUG) round-trips into the
+    service's logger and EC share."""
+    import logging
+    state, actor = _dashboard_env(engine, "dashlog")
+    names = [f.name for f in state.services()]
+    state.select(names.index("victim"))
+    assert state.set_log_level("debug") == actor.topic_path
+    engine.drain()
+    assert actor.share["log_level"] == "DEBUG"
+    assert actor.logger.level == logging.DEBUG
+
+
+def test_dashboard_plugin_action_runs(engine):
+    """Plugin-frame actions: the pipeline plugin's stop action reaches
+    the pipeline over the wire and destroys its streams."""
+    from aiko_services_tpu.pipeline import (
+        Pipeline, parse_pipeline_definition,
+    )
+    from aiko_services_tpu.runtime import (
+        Process, compose_instance, pipeline_args,
+    )
+    from aiko_services_tpu.registry import Registrar
+    from aiko_services_tpu.tools.dashboard import DashboardState
+
+    broker = "dashact"
+    reg_process = Process(namespace="dash", hostname="h", pid="1",
+                          engine=engine, broker=broker)
+    Registrar(process=reg_process)
+    engine.advance(4.0)
+    pipe_process = Process(namespace="dash", hostname="h", pid="2",
+                           engine=engine, broker=broker)
+    doc = {
+        "version": 0, "name": "p_dash", "runtime": "python",
+        "graph": ["(PE_Emit)"],
+        "elements": [{
+            "name": "PE_Emit",
+            "input": [{"name": "i", "type": "int"}],
+            "output": [{"name": "i", "type": "int"}],
+            "parameters": {},
+            "deploy": {"local": {"module": "tests.pipeline_elements",
+                                 "class_name": "PE_Emit"}},
+        }],
+    }
+    pipeline = compose_instance(
+        Pipeline,
+        pipeline_args("p_dash", definition=parse_pipeline_definition(doc)),
+        process=pipe_process)
+    pipeline.create_stream("s1", grace_time=0)
+    dash_process = Process(namespace="dash", hostname="h", pid="3",
+                           engine=engine, broker=broker)
+    state = DashboardState(dash_process)
+    engine.drain()
+
+    names = [f.name for f in state.services()]
+    state.select(names.index("p_dash"))
+    state.open_variables()
+    actions = state.plugin_actions()
+    assert "s" in actions
+    assert pipeline.streams
+    assert state.run_plugin_action("s") is True
+    engine.drain()
+    assert not pipeline.streams
