@@ -1,0 +1,119 @@
+"""Native (C) runtime components, compiled on demand.
+
+The reference is pure Python (SURVEY.md §0: zero native files), but its
+own hot-path notes (§3.2: per-message ``parse()`` bounds message
+throughput) motivate a native control-plane codec here.  Components:
+
+* ``_sexpr_native`` — C implementation of the S-expression
+  tokenizer/tree-builder and emitter (``sexpr_module.c``), used
+  transparently by :mod:`aiko_services_tpu.utils.sexpr` when available.
+
+Build model: no pip/setuptools install step is assumed.  The extension
+is compiled ONCE into ``native/_build/`` with the system compiler the
+first time it is requested, then loaded with :mod:`importlib`.  Any
+failure (no compiler, read-only checkout, broken toolchain) degrades
+silently to the pure-Python codec — the native path is a performance
+tier, never a correctness dependency.  Set ``AIKO_NATIVE=0`` to disable.
+"""
+
+from __future__ import annotations
+
+import importlib.machinery
+import importlib.util
+import os
+import subprocess
+import sys
+import sysconfig
+import threading
+from types import ModuleType
+from typing import Optional
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_BUILD_DIR = os.path.join(_DIR, "_build")
+_LOCK = threading.Lock()
+_CACHE: dict = {}
+
+
+def _suffix() -> str:
+    return (sysconfig.get_config_var("EXT_SUFFIX") or ".so")
+
+
+def _compiler() -> Optional[str]:
+    for cc in (os.environ.get("CC"), "cc", "gcc", "g++", "clang"):
+        if not cc:
+            continue
+        try:
+            subprocess.run([cc, "--version"], capture_output=True,
+                           timeout=10, check=True)
+            return cc
+        except (OSError, subprocess.SubprocessError):
+            continue
+    return None
+
+
+def _build(name: str, source: str) -> Optional[str]:
+    """Compile ``source`` into ``_build/{name}{EXT_SUFFIX}``; returns the
+    artifact path or None.  Atomic: compiles to a pid-suffixed temp file
+    then renames, so concurrent processes can't see half-written .so."""
+    artifact = os.path.join(_BUILD_DIR, name + _suffix())
+    src_path = os.path.join(_DIR, source)
+    try:
+        if (os.path.exists(artifact) and
+                os.path.getmtime(artifact) >= os.path.getmtime(src_path)):
+            return artifact
+    except OSError:
+        return None
+    cc = _compiler()
+    if cc is None:
+        return None
+    include = sysconfig.get_paths()["include"]
+    tmp = f"{artifact}.{os.getpid()}.tmp"
+    cmd = [cc, "-O2", "-shared", "-fPIC", f"-I{include}",
+           src_path, "-o", tmp]
+    if not source.endswith((".cc", ".cpp")):
+        cmd.insert(1, "-std=c11")
+    try:
+        os.makedirs(_BUILD_DIR, exist_ok=True)
+        proc = subprocess.run(cmd, capture_output=True, timeout=120)
+        if proc.returncode != 0:
+            if os.environ.get("AIKO_NATIVE_DEBUG"):
+                sys.stderr.write(proc.stderr.decode(errors="replace"))
+            return None
+        os.replace(tmp, artifact)
+        return artifact
+    except (OSError, subprocess.SubprocessError):
+        return None
+    finally:
+        if os.path.exists(tmp):
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+
+
+def load(name: str, source: str) -> Optional[ModuleType]:
+    """Build (if needed) and import a native extension module; None on
+    any failure or when ``AIKO_NATIVE=0``."""
+    if os.environ.get("AIKO_NATIVE", "1") == "0":
+        return None
+    with _LOCK:
+        if name in _CACHE:
+            return _CACHE[name]
+        module = None
+        try:
+            artifact = _build(name, source)
+            if artifact:
+                loader = importlib.machinery.ExtensionFileLoader(
+                    name, artifact)
+                spec = importlib.util.spec_from_file_location(
+                    name, artifact, loader=loader)
+                module = importlib.util.module_from_spec(spec)
+                loader.exec_module(module)
+        except Exception:  # noqa: BLE001 — native tier must never break import
+            module = None
+        _CACHE[name] = module
+        return module
+
+
+def sexpr_native() -> Optional[ModuleType]:
+    return load("_sexpr_native", "sexpr_module.c")

@@ -1,0 +1,585 @@
+/* Native S-expression codec — the control-plane wire-format hot path.
+ *
+ * The reference parses every inbound MQTT message with a Python
+ * char-append scanner (reference utilities/parser.py:125-182), which its
+ * own call-stack notes identify as a throughput bound (SURVEY.md §3.2
+ * "Hot spots: per-message parse()").  This module implements the same
+ * tokenizer/tree-builder and emitter as aiko_services_tpu/utils/sexpr.py
+ * in C against the CPython API.  Semantics are defined by the Python
+ * module (the property tests run both implementations against each
+ * other); this file must match it byte-for-byte.
+ *
+ * Exposed functions:
+ *   parse_tree(payload: str, dictionaries: bool = True) -> object
+ *   generate_expression(expression: list|tuple) -> str
+ *   set_keyword_class(cls) -> None   (wired by the Python loader so bare
+ *       "name:" tokens come back as utils.sexpr._Keyword instances and
+ *       the pure-Python _listify_dicts / parse() layers work unchanged)
+ *
+ * Errors raise the SExprError class injected via set_error_class().
+ */
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+#include <string.h>
+
+static PyObject *keyword_class = NULL; /* utils.sexpr._Keyword */
+static PyObject *error_class = NULL;   /* utils.sexpr.SExprError */
+
+static PyObject *
+sexpr_error(const char *format, Py_ssize_t pos)
+{
+    PyObject *exc = error_class ? error_class : PyExc_ValueError;
+    PyErr_Format(exc, format, (long)pos);
+    return NULL;
+}
+
+static PyObject *
+sexpr_error_msg(const char *message)
+{
+    PyObject *exc = error_class ? error_class : PyExc_ValueError;
+    PyErr_SetString(exc, message);
+    return NULL;
+}
+
+/* ------------------------------------------------------------------ */
+/* Parsing                                                            */
+
+typedef struct {
+    const char *data;   /* UTF-8 payload */
+    Py_ssize_t len;
+    Py_ssize_t pos;
+    int dictionaries;
+} Parser;
+
+static inline int
+is_ws(char c)
+{
+    return c == ' ' || c == '\t' || c == '\r' || c == '\n';
+}
+
+/* Forward decl */
+static PyObject *read_expr(Parser *p);
+static PyObject *listify(PyObject *tree);
+
+/* Returns: 0 atom (out set), 1 '(' , 2 ')' , -1 end, -2 error */
+static int
+next_token(Parser *p, PyObject **out)
+{
+    const char *s = p->data;
+    Py_ssize_t n = p->len;
+    while (p->pos < n && is_ws(s[p->pos]))
+        p->pos++;
+    if (p->pos >= n)
+        return -1;
+    char c = s[p->pos];
+    if (c == '(') { p->pos++; return 1; }
+    if (c == ')') { p->pos++; return 2; }
+    if (c == '\'' || c == '"') {
+        const char *end = memchr(s + p->pos + 1, c, n - p->pos - 1);
+        if (!end) {
+            sexpr_error("Unterminated quoted string at %ld", p->pos);
+            return -2;
+        }
+        *out = PyUnicode_DecodeUTF8(s + p->pos + 1,
+                                    end - (s + p->pos + 1), "strict");
+        p->pos = (end - s) + 1;
+        return *out ? 0 : -2;
+    }
+    if (c >= '0' && c <= '9') {
+        /* Possible canonical length-prefixed symbol: <len>:<bytes> */
+        Py_ssize_t j = p->pos;
+        while (j < n && s[j] >= '0' && s[j] <= '9')
+            j++;
+        if (j < n && s[j] == ':') {
+            Py_ssize_t length = 0;
+            for (Py_ssize_t k = p->pos; k < j; k++) {
+                length = length * 10 + (s[k] - '0');
+                if (length > n) break;      /* overflow guard */
+            }
+            Py_ssize_t start = j + 1;
+            if (length == 0) {
+                p->pos = start;
+                *out = Py_None;
+                Py_INCREF(Py_None);
+                return 0;
+            }
+            if (start + length > n) {
+                sexpr_error("Canonical symbol overruns payload at %ld",
+                            p->pos);
+                return -2;
+            }
+            /* NOTE: length counts Python str characters in the
+             * reference implementation; payloads are parsed from str,
+             * and the Python tokenizer slices by character.  We decode
+             * the remainder then take `length` code points only when
+             * multibyte UTF-8 is present; ASCII fast path otherwise. */
+            int ascii = 1;
+            for (Py_ssize_t k = start; k < start + length; k++) {
+                if ((unsigned char)s[k] >= 0x80) { ascii = 0; break; }
+            }
+            if (ascii) {
+                *out = PyUnicode_DecodeUTF8(s + start, length, "strict");
+                p->pos = start + length;
+            } else {
+                /* Slow path: decode rest, slice by code points. */
+                PyObject *rest = PyUnicode_DecodeUTF8(
+                    s + start, n - start, "strict");
+                if (!rest) return -2;
+                if (PyUnicode_GET_LENGTH(rest) < length) {
+                    Py_DECREF(rest);
+                    sexpr_error(
+                        "Canonical symbol overruns payload at %ld",
+                        p->pos);
+                    return -2;
+                }
+                *out = PyUnicode_Substring(rest, 0, length);
+                Py_DECREF(rest);
+                if (!*out) return -2;
+                /* Re-encode the consumed slice to advance byte pos. */
+                PyObject *consumed = PyUnicode_AsUTF8String(*out);
+                if (!consumed) { Py_CLEAR(*out); return -2; }
+                p->pos = start + PyBytes_GET_SIZE(consumed);
+                Py_DECREF(consumed);
+            }
+            return *out ? 0 : -2;
+        }
+    }
+    /* Bare symbol: runs until whitespace or paren. */
+    Py_ssize_t j = p->pos;
+    while (j < n && !is_ws(s[j]) && s[j] != '(' && s[j] != ')')
+        j++;
+    Py_ssize_t toklen = j - p->pos;
+    if (toklen > 1 && s[j - 1] == ':' && keyword_class) {
+        PyObject *text = PyUnicode_DecodeUTF8(s + p->pos, toklen,
+                                              "strict");
+        if (!text) return -2;
+        *out = PyObject_CallFunctionObjArgs(keyword_class, text, NULL);
+        Py_DECREF(text);
+    } else {
+        *out = PyUnicode_DecodeUTF8(s + p->pos, toklen, "strict");
+    }
+    p->pos = j;
+    return *out ? 0 : -2;
+}
+
+static PyObject *
+read_expr(Parser *p)
+{
+    PyObject *atom = NULL;
+    int kind = next_token(p, &atom);
+    if (kind == -2)
+        return NULL;
+    if (kind == -1)
+        return sexpr_error_msg("Unexpected end of payload");
+    if (kind == 2)
+        return sexpr_error_msg("Unbalanced ')' in payload");
+    if (kind == 0)
+        return atom;
+    /* kind == 1: open paren — read items until ')' */
+    PyObject *items = PyList_New(0);
+    if (!items)
+        return NULL;
+    for (;;) {
+        Py_ssize_t save = p->pos;
+        PyObject *inner = NULL;
+        int k = next_token(p, &inner);
+        if (k == -2) { Py_DECREF(items); return NULL; }
+        if (k == -1) {
+            Py_DECREF(items);
+            return sexpr_error_msg("Unbalanced '(' in payload");
+        }
+        if (k == 2)
+            return items;
+        if (k == 1) {
+            /* Nested list: rewind one char and recurse. */
+            p->pos = save;
+            inner = read_expr(p);
+            if (!inner) { Py_DECREF(items); return NULL; }
+        }
+        if (PyList_Append(items, inner) < 0) {
+            Py_DECREF(inner);
+            Py_DECREF(items);
+            return NULL;
+        }
+        Py_DECREF(inner);
+    }
+}
+
+/* _listify_dicts: keyword-led lists become dicts, recursively. */
+static PyObject *
+listify(PyObject *tree)
+{
+    if (!PyList_Check(tree) || PyList_GET_SIZE(tree) == 0) {
+        Py_INCREF(tree);
+        return tree;
+    }
+    PyObject *head = PyList_GET_ITEM(tree, 0);
+    int head_is_kw = keyword_class &&
+        PyObject_IsInstance(head, keyword_class) == 1;
+    Py_ssize_t size = PyList_GET_SIZE(tree);
+    if (head_is_kw) {
+        if (size % 2)
+            return sexpr_error_msg(
+                "Dictionary needs keyword/value pairs");
+        PyObject *result = PyDict_New();
+        if (!result)
+            return NULL;
+        for (Py_ssize_t i = 0; i < size; i += 2) {
+            PyObject *k = PyList_GET_ITEM(tree, i);
+            if (PyObject_IsInstance(k, keyword_class) != 1) {
+                Py_DECREF(result);
+                return sexpr_error_msg("Expected keyword");
+            }
+            Py_ssize_t klen = PyUnicode_GET_LENGTH(k);
+            PyObject *key = PyUnicode_Substring(k, 0, klen - 1);
+            if (!key) { Py_DECREF(result); return NULL; }
+            PyObject *v = listify(PyList_GET_ITEM(tree, i + 1));
+            if (!v) { Py_DECREF(key); Py_DECREF(result); return NULL; }
+            int rc = PyDict_SetItem(result, key, v);
+            Py_DECREF(key);
+            Py_DECREF(v);
+            if (rc < 0) { Py_DECREF(result); return NULL; }
+        }
+        return result;
+    }
+    PyObject *result = PyList_New(size);
+    if (!result)
+        return NULL;
+    for (Py_ssize_t i = 0; i < size; i++) {
+        PyObject *v = listify(PyList_GET_ITEM(tree, i));
+        if (!v) { Py_DECREF(result); return NULL; }
+        PyList_SET_ITEM(result, i, v);
+    }
+    return result;
+}
+
+static PyObject *
+py_parse_tree(PyObject *self, PyObject *args, PyObject *kwargs)
+{
+    static char *kwlist[] = {"payload", "dictionaries", NULL};
+    const char *payload;
+    Py_ssize_t payload_len;
+    int dictionaries = 1;
+    if (!PyArg_ParseTupleAndKeywords(args, kwargs, "s#|p", kwlist,
+                                     &payload, &payload_len,
+                                     &dictionaries))
+        return NULL;
+    Parser p = {payload, payload_len, 0, dictionaries};
+    PyObject *tree = read_expr(&p);
+    if (!tree)
+        return NULL;
+    /* Trailing top-level atoms: collect into a flat list. */
+    {
+        PyObject *extra = NULL;
+        Py_ssize_t save = p.pos;
+        /* Peek: any non-ws remaining? */
+        while (p.pos < p.len && is_ws(p.data[p.pos]))
+            p.pos++;
+        if (p.pos < p.len) {
+            p.pos = save;
+            PyObject *items = PyList_New(0);
+            if (!items) { Py_DECREF(tree); return NULL; }
+            if (PyList_Append(items, tree) < 0) {
+                Py_DECREF(tree); Py_DECREF(items); return NULL;
+            }
+            Py_DECREF(tree);
+            for (;;) {
+                Py_ssize_t mark = p.pos;
+                while (p.pos < p.len && is_ws(p.data[p.pos]))
+                    p.pos++;
+                if (p.pos >= p.len)
+                    break;
+                p.pos = mark;
+                extra = read_expr(&p);
+                if (!extra) { Py_DECREF(items); return NULL; }
+                if (PyList_Append(items, extra) < 0) {
+                    Py_DECREF(extra); Py_DECREF(items); return NULL;
+                }
+                Py_DECREF(extra);
+            }
+            tree = items;
+        } else {
+            p.pos = p.len;
+        }
+    }
+    if (dictionaries) {
+        PyObject *converted = listify(tree);
+        Py_DECREF(tree);
+        return converted;
+    }
+    return tree;
+}
+
+/* ------------------------------------------------------------------ */
+/* Generation                                                          */
+
+static int emit(PyObject *element, PyObject *parts);
+
+static int
+needs_canonical(PyObject *text)
+{
+    /* ^\d+:|^['"]|[\s()]|:$  (module _NEEDS_CANONICAL) */
+    Py_ssize_t len = PyUnicode_GET_LENGTH(text);
+    if (len == 0)
+        return 0;
+    Py_UCS4 first = PyUnicode_READ_CHAR(text, 0);
+    if (first == '\'' || first == '"')
+        return 1;
+    if (PyUnicode_READ_CHAR(text, len - 1) == ':')
+        return 1;
+    if (first >= '0' && first <= '9') {
+        Py_ssize_t i = 1;
+        while (i < len) {
+            Py_UCS4 c = PyUnicode_READ_CHAR(text, i);
+            if (c == ':')
+                return 1;
+            if (c < '0' || c > '9')
+                break;
+            i++;
+        }
+    }
+    for (Py_ssize_t i = 0; i < len; i++) {
+        Py_UCS4 c = PyUnicode_READ_CHAR(text, i);
+        if (c == ' ' || c == '\t' || c == '\r' || c == '\n' ||
+            c == '(' || c == ')')
+            return 1;
+    }
+    return 0;
+}
+
+static int
+emit_str(PyObject *text, PyObject *parts)
+{
+    Py_ssize_t len = PyUnicode_GET_LENGTH(text);
+    if (len == 0) {
+        PyObject *quoted = PyUnicode_FromString("\"\"");
+        if (!quoted || PyList_Append(parts, quoted) < 0) {
+            Py_XDECREF(quoted);
+            return -1;
+        }
+        Py_DECREF(quoted);
+        return 0;
+    }
+    if (keyword_class &&
+        PyObject_IsInstance(text, keyword_class) == 1) {
+        return PyList_Append(parts, text) < 0 ? -1 : 0;
+    }
+    if (needs_canonical(text)) {
+        PyObject *formatted = PyUnicode_FromFormat("%zd:%U", len, text);
+        if (!formatted)
+            return -1;
+        int rc = PyList_Append(parts, formatted);
+        Py_DECREF(formatted);
+        return rc < 0 ? -1 : 0;
+    }
+    return PyList_Append(parts, text) < 0 ? -1 : 0;
+}
+
+static int
+emit_dict_items(PyObject *mapping, PyObject *parts)
+{
+    PyObject *key, *value;
+    Py_ssize_t pos = 0;
+    while (PyDict_Next(mapping, &pos, &key, &value)) {
+        PyObject *key_str = PyObject_Str(key);
+        if (!key_str)
+            return -1;
+        if (needs_canonical(key_str) ||
+            PyUnicode_GET_LENGTH(key_str) == 0) {
+            PyErr_Format(error_class ? error_class : PyExc_ValueError,
+                         "Dictionary keyword %R must be a simple symbol",
+                         key_str);
+            Py_DECREF(key_str);
+            return -1;
+        }
+        PyObject *kw = PyUnicode_FromFormat("%U:", key_str);
+        Py_DECREF(key_str);
+        if (!kw)
+            return -1;
+        int rc = PyList_Append(parts, kw);
+        Py_DECREF(kw);
+        if (rc < 0)
+            return -1;
+        if (emit(value, parts) < 0)
+            return -1;
+    }
+    return 0;
+}
+
+static int
+emit_expression(PyObject *seq, PyObject *parts)
+{
+    PyObject *open = PyUnicode_FromString("(");
+    if (!open || PyList_Append(parts, open) < 0) {
+        Py_XDECREF(open);
+        return -1;
+    }
+    Py_DECREF(open);
+    PyObject *iter = PyObject_GetIter(seq);
+    if (!iter)
+        return -1;
+    PyObject *item;
+    while ((item = PyIter_Next(iter))) {
+        if (emit(item, parts) < 0) {
+            Py_DECREF(item);
+            Py_DECREF(iter);
+            return -1;
+        }
+        Py_DECREF(item);
+    }
+    Py_DECREF(iter);
+    if (PyErr_Occurred())
+        return -1;
+    PyObject *close = PyUnicode_FromString(")");
+    if (!close || PyList_Append(parts, close) < 0) {
+        Py_XDECREF(close);
+        return -1;
+    }
+    Py_DECREF(close);
+    return 0;
+}
+
+static int
+emit(PyObject *element, PyObject *parts)
+{
+    if (element == Py_None) {
+        PyObject *nil = PyUnicode_FromString("0:");
+        if (!nil || PyList_Append(parts, nil) < 0) {
+            Py_XDECREF(nil);
+            return -1;
+        }
+        Py_DECREF(nil);
+        return 0;
+    }
+    if (PyDict_Check(element)) {
+        PyObject *open = PyUnicode_FromString("(");
+        if (!open || PyList_Append(parts, open) < 0) {
+            Py_XDECREF(open);
+            return -1;
+        }
+        Py_DECREF(open);
+        if (emit_dict_items(element, parts) < 0)
+            return -1;
+        PyObject *close = PyUnicode_FromString(")");
+        if (!close || PyList_Append(parts, close) < 0) {
+            Py_XDECREF(close);
+            return -1;
+        }
+        Py_DECREF(close);
+        return 0;
+    }
+    if (PyList_Check(element) || PyTuple_Check(element))
+        return emit_expression(element, parts);
+    if (PyBool_Check(element)) {
+        PyObject *text = PyUnicode_FromString(
+            element == Py_True ? "true" : "false");
+        if (!text || PyList_Append(parts, text) < 0) {
+            Py_XDECREF(text);
+            return -1;
+        }
+        Py_DECREF(text);
+        return 0;
+    }
+    if (PyUnicode_Check(element))
+        return emit_str(element, parts);
+    PyObject *text = PyObject_Str(element);
+    if (!text)
+        return -1;
+    int rc = emit_str(text, parts);
+    Py_DECREF(text);
+    return rc;
+}
+
+static PyObject *
+py_generate_expression(PyObject *self, PyObject *args)
+{
+    PyObject *expression;
+    if (!PyArg_ParseTuple(args, "O", &expression))
+        return NULL;
+    PyObject *parts = PyList_New(0);
+    if (!parts)
+        return NULL;
+    if (emit_expression(expression, parts) < 0) {
+        Py_DECREF(parts);
+        return NULL;
+    }
+    /* Join: "(" + " ".join(inner) + ")" — parts already includes the
+     * parens as separate entries; join with spaces but strip the space
+     * after "(" and before ")" by joining smartly.  Simpler: build the
+     * final string manually matching the Python emitter's output. */
+    Py_ssize_t n = PyList_GET_SIZE(parts);
+    PyObject *space = PyUnicode_FromString(" ");
+    if (!space) { Py_DECREF(parts); return NULL; }
+    PyObject *pieces = PyList_New(0);
+    if (!pieces) { Py_DECREF(space); Py_DECREF(parts); return NULL; }
+    int prev_open = 0;
+    for (Py_ssize_t i = 0; i < n; i++) {
+        PyObject *piece = PyList_GET_ITEM(parts, i);
+        const char *raw = PyUnicode_AsUTF8(piece);
+        int is_open = raw && raw[0] == '(' && raw[1] == '\0';
+        int is_close = raw && raw[0] == ')' && raw[1] == '\0';
+        if (i > 0 && !prev_open && !is_close) {
+            if (PyList_Append(pieces, space) < 0)
+                goto fail;
+        }
+        if (PyList_Append(pieces, piece) < 0)
+            goto fail;
+        prev_open = is_open;
+        continue;
+    fail:
+        Py_DECREF(space);
+        Py_DECREF(pieces);
+        Py_DECREF(parts);
+        return NULL;
+    }
+    PyObject *empty = PyUnicode_FromString("");
+    PyObject *result = empty ? PyUnicode_Join(empty, pieces) : NULL;
+    Py_XDECREF(empty);
+    Py_DECREF(space);
+    Py_DECREF(pieces);
+    Py_DECREF(parts);
+    return result;
+}
+
+/* ------------------------------------------------------------------ */
+
+static PyObject *
+py_set_keyword_class(PyObject *self, PyObject *arg)
+{
+    Py_XINCREF(arg);
+    Py_XSETREF(keyword_class, arg);
+    Py_RETURN_NONE;
+}
+
+static PyObject *
+py_set_error_class(PyObject *self, PyObject *arg)
+{
+    Py_XINCREF(arg);
+    Py_XSETREF(error_class, arg);
+    Py_RETURN_NONE;
+}
+
+static PyMethodDef methods[] = {
+    {"parse_tree", (PyCFunction)py_parse_tree,
+     METH_VARARGS | METH_KEYWORDS,
+     "Parse an S-expression payload into its tree."},
+    {"generate_expression", py_generate_expression, METH_VARARGS,
+     "Serialize a nested list into an S-expression string."},
+    {"set_keyword_class", py_set_keyword_class, METH_O,
+     "Install the _Keyword marker class."},
+    {"set_error_class", py_set_error_class, METH_O,
+     "Install the SExprError exception class."},
+    {NULL, NULL, 0, NULL}
+};
+
+static struct PyModuleDef moduledef = {
+    PyModuleDef_HEAD_INIT, "_sexpr_native",
+    "C implementation of the S-expression wire codec.", -1, methods
+};
+
+PyMODINIT_FUNC
+PyInit__sexpr_native(void)
+{
+    return PyModule_Create(&moduledef);
+}

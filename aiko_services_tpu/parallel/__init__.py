@@ -1,5 +1,6 @@
 from .mesh import MeshSpec, make_mesh, named_sharding, logical_axis_rules
 from .ring_attention import ring_attention, ring_attention_sharded
+from .ulysses import ulysses_attention, ulysses_attention_sharded
 from .collective_matmul import (
     allgather_matmul, matmul_reducescatter,
     allgather_matmul_sharded, matmul_reducescatter_sharded,

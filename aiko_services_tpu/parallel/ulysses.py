@@ -1,0 +1,95 @@
+"""Ulysses-style sequence parallelism: all-to-all head/sequence swap.
+
+The reference has no long-context machinery (SURVEY.md §5.7).  Ring
+attention (``parallel/ring_attention.py``) is one TPU-native answer;
+this module is the other standard design (DeepSpeed-Ulysses): instead of
+rotating K/V shards around a ring, two ``all_to_all`` collectives swap
+the sharded dimension around the attention op —
+
+* inputs arrive sharded on **sequence** over the ``sp`` axis
+  (``batch, heads, seq/n, head_dim``);
+* an all-to-all re-shards to **heads** (``batch, heads/n, seq,
+  head_dim``), so every device holds the *full* sequence for a subset
+  of heads and runs ordinary (flash) attention locally — no online
+  merge needed;
+* a second all-to-all restores sequence sharding for the rest of the
+  network (MLP etc. stay sequence-sharded).
+
+Trade-off vs ring: Ulysses does O(2) collectives of the whole activation
+per attention instead of ``n`` neighbor exchanges of K/V, and it needs
+``heads % n == 0`` — but the local attention is a single dense block
+(better MXU utilisation) and composes directly with the Pallas flash
+kernel.  Both are exact.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+try:
+    from jax import shard_map  # jax >= 0.8
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map
+
+from ..ops.attention import attention_reference
+
+__all__ = ["ulysses_attention", "ulysses_attention_sharded"]
+
+
+def ulysses_attention(q, k, v, axis_name: str, causal: bool = True,
+                      sm_scale: Optional[float] = None,
+                      attn_fn: Optional[Callable] = None):
+    """Inside-shard_map body.  ``q/k/v`` are local sequence shards of
+    shape ``(batch, heads, seq_local, head_dim)`` with the FULL head
+    count; returns the local output shard, same shape.
+
+    ``attn_fn(q, k, v, causal=, sm_scale=)`` runs the per-device dense
+    attention; defaults to the jnp reference (swap in
+    ``ops.attention.flash_attention`` on real TPU).
+    """
+    if attn_fn is None:
+        attn_fn = attention_reference
+    n = jax.lax.psum(1, axis_name)
+    heads = q.shape[1]
+    if heads % n:
+        raise ValueError(
+            f"Ulysses needs heads ({heads}) divisible by axis size ({n})")
+
+    # seq-sharded -> head-sharded: split the head dim across devices,
+    # concatenate the sequence shards.  all_to_all is the single XLA
+    # collective purpose-built for this swap (rides ICI all-to-all
+    # links; no host involvement).
+    def scatter_heads(x):
+        return jax.lax.all_to_all(x, axis_name, split_axis=1,
+                                  concat_axis=2, tiled=True)
+
+    def scatter_seq(x):
+        return jax.lax.all_to_all(x, axis_name, split_axis=2,
+                                  concat_axis=1, tiled=True)
+
+    q_h, k_h, v_h = scatter_heads(q), scatter_heads(k), scatter_heads(v)
+    # Full sequence is now local: plain causal masking is correct with
+    # no global-offset bookkeeping (unlike the ring).
+    o_h = attn_fn(q_h, k_h, v_h, causal=causal, sm_scale=sm_scale)
+    return scatter_seq(o_h)
+
+
+def ulysses_attention_sharded(q, k, v, mesh: Mesh, axis: str = "sp",
+                              causal: bool = True,
+                              sm_scale: Optional[float] = None,
+                              attn_fn: Optional[Callable] = None):
+    """Global entry: q/k/v are full arrays ``(batch, heads, seq,
+    head_dim)``; shard_map shards the sequence dim over ``axis`` and
+    runs the all-to-all swap around dense local attention."""
+    spec = P(None, None, axis, None)
+    fn = shard_map(
+        functools.partial(ulysses_attention, axis_name=axis,
+                          causal=causal, sm_scale=sm_scale,
+                          attn_fn=attn_fn),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+    return fn(q, k, v)

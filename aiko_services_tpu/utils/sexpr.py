@@ -48,6 +48,28 @@ class _Keyword(str):
     __slots__ = ()
 
 
+_NATIVE = None          # loaded lazily; False = tried and unavailable
+
+
+def _native():
+    """The C codec module (``native/sexpr_module.c``) or None.  Loaded
+    once; the C tokenizer emits ``_Keyword``/``SExprError`` via the
+    classes installed here, so trees from either implementation are
+    indistinguishable (property-tested in tests/test_sexpr.py)."""
+    global _NATIVE
+    if _NATIVE is None:
+        try:
+            from ..native import sexpr_native
+            module = sexpr_native()
+            if module is not None:
+                module.set_keyword_class(_Keyword)
+                module.set_error_class(SExprError)
+            _NATIVE = module if module is not None else False
+        except Exception:  # noqa: BLE001 — never break the codec
+            _NATIVE = False
+    return _NATIVE or None
+
+
 def generate(command: str, parameters: Union[Dict, List, Tuple, None] = None) -> str:
     """Serialize ``(command, parameters)`` into one S-expression payload."""
     items: List[Any] = [command]
@@ -62,6 +84,13 @@ def generate(command: str, parameters: Union[Dict, List, Tuple, None] = None) ->
 
 def generate_expression(expression: Union[List, Tuple]) -> str:
     """Serialize a (possibly nested) list into an S-expression string."""
+    native = _native()
+    if native is not None:
+        return native.generate_expression(expression)
+    return _generate_expression_py(expression)
+
+
+def _generate_expression_py(expression: Union[List, Tuple]) -> str:
     parts = []
     for element in expression:
         parts.append(_emit(element))
@@ -158,7 +187,19 @@ def _tokenize(payload: str):
 
 
 def parse_tree(payload: str, dictionaries: bool = True) -> Any:
-    """Parse a payload into its raw tree (lists / dicts / symbols)."""
+    """Parse a payload into its raw tree (lists / dicts / symbols).
+
+    Dispatches to the native C codec when available (built on first use
+    from ``native/sexpr_module.c``); the Python implementation below is
+    the semantic definition and the always-available fallback.
+    """
+    native = _native()
+    if native is not None:
+        return native.parse_tree(payload, dictionaries)
+    return _parse_tree_py(payload, dictionaries)
+
+
+def _parse_tree_py(payload: str, dictionaries: bool = True) -> Any:
     tokens = list(_tokenize(payload))
     pos = 0
 

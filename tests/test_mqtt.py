@@ -176,7 +176,17 @@ def test_client_reconnects_after_broker_restart():
     b1.stop()
     assert wait_for(lambda: not sub.connected, 10)
     sub.publish("t", "while-down")           # buffered
-    b2 = MqttBroker(port=port)
+    # Rebinding the SAME port can transiently fail while the old
+    # listener's close completes (loaded CI): retry briefly.
+    deadline = time.time() + 10.0
+    while True:
+        try:
+            b2 = MqttBroker(port=port)
+            break
+        except OSError:
+            if time.time() >= deadline:
+                raise
+            time.sleep(0.1)
     try:
         assert wait_for(lambda: sub.connected, 15)
         assert wait_for(lambda: "while-down" in got, 10), got

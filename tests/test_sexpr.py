@@ -90,3 +90,96 @@ def test_canonical_binary_roundtrip():
     weird = ["a b", "(x)", "10:prefix", "", "tab\tchar", "new\nline"]
     payload = generate("cmd", weird)
     assert parse(payload) == ("cmd", weird)
+
+
+# --------------------------------------------------------------------- #
+# Native (C) codec differential tests: the Python implementation is the
+# semantic definition; the native one must be byte-identical on parse
+# trees, emitted payloads, and error behavior.
+
+_CORPUS = [
+    "(a b c)",
+    "(add count 1)",
+    "(a (b (c (d))))",
+    "(k: 1)",
+    "(a: 1 b: 2)",
+    "(cmd (a: x b: (1 2 3)) tail)",
+    "3:a b",
+    "(3:a b 0: 'quoted str' \"double\")",
+    "atom",
+    "0:",
+    "()",
+    "(a b) (c d) e",
+    "3:a:b",
+    "(x 5:ab:cd y)",
+    "(nested (k: (j: deep)) end)",
+    "(true false 3.14 -7)",
+    "  (  spaced   out  )  ",
+    "(unicode: 5:héllo)",
+    "(empty \"\" end)",
+]
+
+_BAD = [
+    "(a b",            # unbalanced open
+    "(a))",            # trailing close is parsed as extra -> error
+    "'unterminated",
+    "99:short",
+    "",
+]
+
+
+def test_native_parse_matches_python():
+    from aiko_services_tpu.utils import sexpr
+    native = sexpr._native()
+    if native is None:
+        pytest.skip("native codec unavailable")
+    for payload in _CORPUS:
+        for dictionaries in (True, False):
+            py = sexpr._parse_tree_py(payload, dictionaries)
+            ct = native.parse_tree(payload, dictionaries)
+            assert ct == py, (payload, dictionaries)
+            # Keyword marker preserved so Python-side listify works
+            assert _tree_types(ct) == _tree_types(py), payload
+
+
+def _tree_types(tree):
+    if isinstance(tree, dict):
+        return {k: _tree_types(v) for k, v in tree.items()}
+    if isinstance(tree, list):
+        return [_tree_types(v) for v in tree]
+    return type(tree).__name__
+
+
+def test_native_generate_matches_python():
+    from aiko_services_tpu.utils import sexpr
+    native = sexpr._native()
+    if native is None:
+        pytest.skip("native codec unavailable")
+    cases = [
+        ["a", "b", "c"],
+        ["cmd", {"k": "v w", "n": 5}],
+        ["x", None, True, False, 3.5, ["nested", ["deep"]]],
+        ["sym with space", "(paren)", "", "10:prefix", "tail:"],
+        ["dict", {"a": ["1", "2"], "b": {"c": "d"}}],
+    ]
+    for expression in cases:
+        assert (native.generate_expression(expression)
+                == sexpr._generate_expression_py(expression)), expression
+
+
+def test_native_roundtrip_and_errors():
+    from aiko_services_tpu.utils import sexpr
+    native = sexpr._native()
+    if native is None:
+        pytest.skip("native codec unavailable")
+    for payload in _CORPUS:
+        tree = native.parse_tree(payload, False)
+        if isinstance(tree, list):
+            again = native.parse_tree(
+                native.generate_expression(tree), False)
+            assert again == tree, payload
+    for payload in _BAD:
+        with pytest.raises(SExprError):
+            native.parse_tree(payload)
+        with pytest.raises(SExprError):
+            sexpr._parse_tree_py(payload)
