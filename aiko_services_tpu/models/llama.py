@@ -30,7 +30,7 @@ from ..ops.quant import int8_matmul, is_quantized, quantize_tree
 __all__ = ["LlamaConfig", "init_params", "forward", "init_cache",
            "decode_step", "generate_tokens", "prefill", "param_specs",
            "quantize_params", "pipeline_forward", "stack_pipeline_params",
-           "CONFIGS"]
+           "decode_chunk_ragged", "CONFIGS"]
 
 
 @dataclasses.dataclass(frozen=True)
@@ -245,9 +245,11 @@ def apply_rope(x, cos, sin):
         [x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1).astype(x.dtype)
 
 
-def _attention_block(layer, config, x, cos, sin, cache_layer=None,
-                     cache_index=None, use_flash=True):
-    """Returns (output, new_cache_layer)."""
+def _attention_block(layer, config, x, cos, sin, use_flash=True):
+    """Full-sequence (no-cache) attention block; returns
+    (output, None).  The cached-decode path lives in
+    :func:`_attention_decode_ragged` (single implementation for both
+    shared-position and per-row-position decode)."""
     batch, seq, _ = x.shape
     h, kv, hd = config.n_heads, config.n_kv_heads, config.head_dim
     normed = rms_norm(x, layer["attn_norm"], config.norm_eps)
@@ -257,48 +259,21 @@ def _attention_block(layer, config, x, cos, sin, cache_layer=None,
     q = apply_rope(q, cos, sin)
     k = apply_rope(k, cos, sin)
 
-    if cache_layer is not None:
-        # Decode: write this step's k/v at cache_index, attend over cache.
-        k_cache = jax.lax.dynamic_update_slice(
-            cache_layer["k"], k.astype(cache_layer["k"].dtype),
-            (0, cache_index, 0, 0))
-        v_cache = jax.lax.dynamic_update_slice(
-            cache_layer["v"], v.astype(cache_layer["v"].dtype),
-            (0, cache_index, 0, 0))
-        new_cache = {"k": k_cache, "v": v_cache}
-        # GQA without materializing repeated K/V: decode is bound by
-        # streaming the KV cache from HBM, so the query groups fold into
-        # an extra einsum axis instead of copying K/V group× (which
-        # would multiply cache traffic by n_heads/n_kv_heads).
-        group = h // kv
-        q_g = q.reshape(batch, seq, kv, group, hd)
-        s = jnp.einsum("bqkgd,bskd->bkgqs", q_g, k_cache,
-                       preferred_element_type=jnp.float32) * hd ** -0.5
-        # Mask cache positions beyond the current step.
-        valid = (jnp.arange(cache_layer["k"].shape[1])
-                 <= cache_index)                    # (max_seq,)
-        s = jnp.where(valid[None, None, None, None, :], s, -1e30)
-        weights = jax.nn.softmax(s, axis=-1)
-        out = jnp.einsum("bkgqs,bskd->bqkgd",
-                         weights.astype(v_cache.dtype), v_cache)
-        out = out.reshape(batch, seq, h, hd)
+    q_t = q.transpose(0, 2, 1, 3)
+    k_t = k.transpose(0, 2, 1, 3)
+    v_t = v.transpose(0, 2, 1, 3)
+    if use_flash:
+        # flash_attention is GQA-native (no repeated K/V in memory).
+        out = flash_attention(q_t, k_t, v_t, causal=True)
     else:
-        new_cache = None
-        q_t = q.transpose(0, 2, 1, 3)
-        k_t = k.transpose(0, 2, 1, 3)
-        v_t = v.transpose(0, 2, 1, 3)
-        if use_flash:
-            # flash_attention is GQA-native (no repeated K/V in memory).
-            out = flash_attention(q_t, k_t, v_t, causal=True)
-        else:
-            group = h // kv
-            out = attention_reference(
-                q_t, jnp.repeat(k_t, group, axis=1),
-                jnp.repeat(v_t, group, axis=1), causal=True)
-        out = out.transpose(0, 2, 1, 3)
+        group = h // kv
+        out = attention_reference(
+            q_t, jnp.repeat(k_t, group, axis=1),
+            jnp.repeat(v_t, group, axis=1), causal=True)
+    out = out.transpose(0, 2, 1, 3)
 
     out = _matmul(out.reshape(batch, seq, h * hd), layer["wo"])
-    return x + out.astype(x.dtype), new_cache
+    return x + out.astype(x.dtype), None
 
 
 def _mlp_block(layer, config, x):
@@ -378,16 +353,75 @@ def prefill(params, tokens, cache, config: LlamaConfig):
 
 def _decode_core(params, token, cache, cache_index, config: LlamaConfig):
     """One autoregressive step (traceable core): token (batch, 1) +
-    cache position → (logits (batch, 1, vocab), new_cache)."""
+    shared cache position → (logits (batch, 1, vocab), new_cache).
+
+    Delegates to the ragged (per-row-position) core with a constant
+    position vector, so the plain and continuous-batching decode paths
+    are ONE implementation (their exact equivalence is what the
+    continuous-batching tests assert)."""
     batch = token.shape[0]
-    positions = jnp.full((batch, 1), cache_index, jnp.int32)
-    cos, sin = _rope_freqs(config, positions)
+    positions = jnp.full((batch,), cache_index, jnp.int32)
+    return _decode_core_ragged(params, token, cache, positions, config)
+
+
+decode_step = functools.partial(jax.jit, static_argnames=("config",),
+                                donate_argnames=("cache",))(_decode_core)
+
+
+def _attention_decode_ragged(layer, config, x, cos, sin, cache_layer,
+                             positions):
+    """Single-token decode where every batch row sits at its OWN cache
+    position (continuous batching: slots admit/finish independently).
+    ``x`` (batch, 1, d), ``positions`` (batch,) int32."""
+    batch, seq, _ = x.shape
+    h, kv, hd = config.n_heads, config.n_kv_heads, config.head_dim
+    normed = rms_norm(x, layer["attn_norm"], config.norm_eps)
+    q = _matmul(normed, layer["wq"]).reshape(batch, seq, h, hd)
+    k = _matmul(normed, layer["wk"]).reshape(batch, seq, kv, hd)
+    v = _matmul(normed, layer["wv"]).reshape(batch, seq, kv, hd)
+    q = apply_rope(q, cos, sin)
+    k = apply_rope(k, cos, sin)
+
+    # Per-row scatter write (vmapped dynamic_update_slice lowers to an
+    # in-place scatter under donation — no full-cache rewrite).
+    def write_row(cache_rows, new_row, pos):
+        return jax.lax.dynamic_update_slice(cache_rows, new_row,
+                                            (pos, 0, 0))
+
+    k_cache = jax.vmap(write_row)(
+        cache_layer["k"], k.astype(cache_layer["k"].dtype), positions)
+    v_cache = jax.vmap(write_row)(
+        cache_layer["v"], v.astype(cache_layer["v"].dtype), positions)
+    new_cache = {"k": k_cache, "v": v_cache}
+
+    group = h // kv
+    q_g = q.reshape(batch, seq, kv, group, hd)
+    s = jnp.einsum("bqkgd,bskd->bkgqs", q_g, k_cache,
+                   preferred_element_type=jnp.float32) * hd ** -0.5
+    # Each row masks beyond its own position.
+    valid = (jnp.arange(k_cache.shape[1])[None, :]
+             <= positions[:, None])               # (batch, max_seq)
+    s = jnp.where(valid[:, None, None, None, :], s, -1e30)
+    weights = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bkgqs,bskd->bqkgd",
+                     weights.astype(v_cache.dtype), v_cache)
+    out = out.reshape(batch, seq, h * hd)
+    return x + _matmul(out, layer["wo"]).astype(x.dtype), new_cache
+
+
+def _decode_core_ragged(params, token, cache, positions,
+                        config: LlamaConfig):
+    """One autoregressive step with PER-ROW cache positions: token
+    (batch, 1) + positions (batch,) → (logits (batch, 1, vocab),
+    new_cache)."""
+    positions_2d = positions[:, None]
+    cos, sin = _rope_freqs(config, positions_2d)
     x = _embed_lookup(params, token, config.dtype)
     new_cache = []
     for layer, cache_layer in zip(params["layers"], cache):
-        x, updated = _attention_block(layer, config, x, cos, sin,
-                                      cache_layer=cache_layer,
-                                      cache_index=cache_index)
+        x, updated = _attention_decode_ragged(layer, config, x, cos,
+                                              sin, cache_layer,
+                                              positions)
         new_cache.append(updated)
         x = _mlp_block(layer, config, x)
     x = rms_norm(x, params["final_norm"], config.norm_eps)
@@ -395,8 +429,38 @@ def _decode_core(params, token, cache, cache_index, config: LlamaConfig):
     return logits, new_cache
 
 
-decode_step = functools.partial(jax.jit, static_argnames=("config",),
-                                donate_argnames=("cache",))(_decode_core)
+@functools.partial(jax.jit,
+                   static_argnames=("config", "num_steps"),
+                   donate_argnames=("cache",))
+def decode_chunk_ragged(params, tokens, cache, positions, active,
+                        num_steps, config: LlamaConfig):
+    """Greedy-decode ``num_steps`` tokens for a slot batch where each
+    row has its own position and an ``active`` flag — ONE compiled scan
+    (the continuous-batching inner loop; admission happens between
+    chunks).  Inactive rows still flow through the math but their cache
+    writes land at position ``max_seq-1`` reserved as scratch and their
+    position does not advance.
+
+    Returns (tokens_out (batch, num_steps), last_token (batch, 1),
+    positions (batch,), cache).
+    """
+    max_seq = cache[0]["k"].shape[1]
+
+    def body(carry, _):
+        token, positions, cache = carry
+        # Inactive slots write into the scratch row so they cannot
+        # corrupt a live slot's KV prefix.
+        write_pos = jnp.where(active, positions, max_seq - 1)
+        logits, cache = _decode_core_ragged(params, token, cache,
+                                            write_pos, config)
+        next_token = logits[:, -1].argmax(-1).astype(jnp.int32)[:, None]
+        next_token = jnp.where(active[:, None], next_token, token)
+        positions = jnp.where(active, positions + 1, positions)
+        return (next_token, positions, cache), next_token[:, 0]
+
+    (token, positions, cache), tokens_out = jax.lax.scan(
+        body, (tokens, positions, cache), None, length=num_steps)
+    return tokens_out.T, token, positions, cache
 
 
 @functools.partial(jax.jit,

@@ -1,0 +1,297 @@
+"""Continuous batching: slot-based LLM decode serving.
+
+The static-batch :class:`~.serving.ModelReplica` decodes one request (or
+one fixed batch) at a time; modern LLM serving interleaves many requests
+in ONE resident decode batch so the weight stream (the decode
+bottleneck) is amortized over every live request and a new request never
+waits for the whole batch to finish.  The reference has nothing in this
+space (its LLM element shells out to Ollama per request,
+examples/llm/elements_llm.py:191-220).
+
+TPU-native design — static shapes throughout:
+
+* The server owns ``slots`` decode lanes and a KV cache of shape
+  ``(slots, max_seq, …)``.  A request is ONE slot for its lifetime.
+* Admission: prompts are right-padded to a power-of-2 bucket (bounded
+  compile count), prefilled at batch 1 (causal attention keeps the real
+  prefix numerics exact regardless of pad garbage), and the bucket's KV
+  rows are copied into the slot (jitted, cache donated → in-place).
+  The slot is seeded with the LAST prompt token at position
+  ``prompt_len - 1``: its KV rewrite is idempotent, and the first chunk
+  step then emits the first generated token — no separate
+  "logits-after-prefill" path exists to disagree with.
+* Decode: :func:`~..models.llama.decode_chunk_ragged` scans
+  ``chunk_steps`` greedy steps for ALL slots in one compiled program —
+  every slot at its own position (``positions`` vector), finished /
+  empty slots masked by ``active``.  Admission happens between chunks.
+* Completion: a slot retires when it hits its token budget or emits
+  ``eos_id``; the freed slot admits a queued request at the next chunk
+  boundary.
+
+Greedy decode through this path EXACTLY matches per-request
+``generate_tokens`` output regardless of admission order (tested), so
+batching is a pure throughput optimization, never a quality trade.
+
+:class:`ContinuousReplica` speaks the same ``(infer …)`` wire protocol
+as :class:`~.serving.ModelReplica` (discovery, router and failover
+compose unchanged); a delayed self-post pump (the reference's own
+retry idiom, main/actor.py:229-253) runs chunks while slots are live —
+deterministic under the VirtualClock test engine, where flatout
+handlers only run inside the blocking loop.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..runtime.actor import Actor
+from ..utils.sexpr import generate
+
+__all__ = ["ContinuousBatchingServer", "ContinuousReplica",
+           "DecodeRequest"]
+
+
+@dataclasses.dataclass
+class DecodeRequest:
+    request_id: str
+    prompt: "np.ndarray"           # (prompt_len,) int32
+    max_new_tokens: int
+    response_topic: Optional[str] = None
+    # Filled by the server:
+    tokens: Optional[List[int]] = None
+    error: Optional[str] = None
+
+
+def _bucket(n: int, minimum: int = 16) -> int:
+    size = minimum
+    while size < n:
+        size *= 2
+    return size
+
+
+class ContinuousBatchingServer:
+    """Slot-based continuous batching around a Llama-family model."""
+
+    def __init__(self, config_name: str = "tiny", slots: int = 4,
+                 max_seq: Optional[int] = None, chunk_steps: int = 8,
+                 quantize: bool = False, eos_id: Optional[int] = None,
+                 seed: int = 0):
+        import jax
+        import jax.numpy as jnp
+        from ..models import llama
+
+        self._jax = jax
+        self._jnp = jnp
+        self._llama = llama
+        self.config = llama.CONFIGS[config_name]
+        self.params = llama.init_params(self.config,
+                                        jax.random.PRNGKey(seed))
+        if quantize:
+            self.params = llama.quantize_params(self.params)
+        self.slots = slots
+        # Row max_seq-1 is the inactive-slot scratch row (see
+        # decode_chunk_ragged); a live request may use at most
+        # max_seq-2 positions.
+        self.max_seq = max_seq or self.config.max_seq_len
+        self.chunk_steps = chunk_steps
+        self.eos_id = eos_id
+        self.cache = llama.init_cache(self.config, slots, self.max_seq)
+        self.positions = jnp.zeros((slots,), jnp.int32)
+        self.active = jnp.zeros((slots,), bool)
+        self.tokens = jnp.zeros((slots, 1), jnp.int32)
+        self._requests: List[Optional[DecodeRequest]] = [None] * slots
+        self._emitted = np.zeros(slots, np.int64)  # tokens emitted so far
+        self._queue: List[DecodeRequest] = []
+        self.completed: List[DecodeRequest] = []
+
+        @functools.partial(jax.jit, donate_argnames=("cache",))
+        def insert_slot(cache, bucket_cache, slot):
+            """Copy a prefilled bucket's KV rows into ``slot`` (rows
+            past the prompt hold pad garbage; each is rewritten by the
+            decode step that first makes it attendable)."""
+            new_cache = []
+            for cache_layer, filled in zip(cache, bucket_cache):
+                new_cache.append({
+                    key: jax.lax.dynamic_update_slice(
+                        cache_layer[key],
+                        filled[key].astype(cache_layer[key].dtype),
+                        (slot, 0, 0, 0))
+                    for key in ("k", "v")})
+            return new_cache
+
+        self._insert_slot = insert_slot
+
+    # ------------------------------------------------------------- #
+
+    def submit(self, request: DecodeRequest) -> None:
+        request.tokens = []
+        prompt_len = int(np.asarray(request.prompt).shape[0])
+        if prompt_len + request.max_new_tokens > self.max_seq - 1:
+            request.error = "prompt_too_long"
+            self.completed.append(request)
+            return
+        self._queue.append(request)
+
+    @property
+    def busy(self) -> bool:
+        return bool(self._queue) or any(
+            r is not None for r in self._requests)
+
+    def _admit(self) -> None:
+        jnp = self._jnp
+        llama = self._llama
+        for slot in range(self.slots):
+            if self._requests[slot] is not None or not self._queue:
+                continue
+            request = self._queue.pop(0)
+            prompt = np.asarray(request.prompt, np.int32)[None, :]
+            prompt_len = prompt.shape[1]
+            # Clamp the bucket to the cache: a prompt near max_seq must
+            # not prefill a bucket larger than the slot rows.
+            padded = min(_bucket(prompt_len), self.max_seq)
+            prompt_padded = np.zeros((1, padded), np.int32)
+            prompt_padded[:, :prompt_len] = prompt
+            bucket_cache = llama.init_cache(self.config, 1, padded)
+            _, bucket_cache = llama.prefill(
+                self.params, jnp.asarray(prompt_padded), bucket_cache,
+                self.config)
+            self.cache = self._insert_slot(self.cache, bucket_cache,
+                                           jnp.int32(slot))
+            # Seed with the last prompt token at its own position: the
+            # next chunk's first step re-writes that KV row with the
+            # identical values and emits the first generated token.
+            self.tokens = self.tokens.at[slot, 0].set(
+                int(prompt[0, -1]))
+            self.positions = self.positions.at[slot].set(prompt_len - 1)
+            self.active = self.active.at[slot].set(True)
+            self._requests[slot] = request
+            self._emitted[slot] = 0
+
+    def _retire(self, slot: int) -> None:
+        request = self._requests[slot]
+        if request is not None:
+            self.completed.append(request)
+        self._requests[slot] = None
+        self.active = self.active.at[slot].set(False)
+
+    def step(self) -> List[DecodeRequest]:
+        """Admit pending requests, decode one chunk, retire finished
+        slots.  Returns (and clears) the completed list."""
+        self._admit()
+        if any(r is not None for r in self._requests):
+            remaining = [self._requests[s].max_new_tokens
+                         - int(self._emitted[s])
+                         for s in range(self.slots)
+                         if self._requests[s] is not None]
+            steps = int(max(1, min(self.chunk_steps, max(remaining))))
+            out, self.tokens, self.positions, self.cache = \
+                self._llama.decode_chunk_ragged(
+                    self.params, self.tokens, self.cache,
+                    self.positions, self.active, steps, self.config)
+            out_host = np.asarray(out)           # (slots, steps)
+            for slot in range(self.slots):
+                request = self._requests[slot]
+                if request is None:
+                    continue
+                for step_index in range(steps):
+                    if self._emitted[slot] >= request.max_new_tokens:
+                        break
+                    token = int(out_host[slot, step_index])
+                    request.tokens.append(token)
+                    self._emitted[slot] += 1
+                    if (self.eos_id is not None
+                            and token == self.eos_id):
+                        self._emitted[slot] = request.max_new_tokens
+                if self._emitted[slot] >= request.max_new_tokens:
+                    self._retire(slot)
+        done, self.completed = self.completed, []
+        return done
+
+    def run_until_drained(self, max_chunks: int = 10_000):
+        """Synchronous helper (tests / batch jobs): pump until every
+        queued request completes."""
+        finished, self.completed = self.completed, []
+        chunks = 0
+        while self.busy:
+            finished.extend(self.step())
+            chunks += 1
+            if chunks > max_chunks:
+                raise RuntimeError("continuous batching did not drain")
+        return finished
+
+
+class ContinuousReplica(Actor):
+    """Actor wrapper: same ``(infer …)`` protocol as
+    :class:`~.serving.ModelReplica`, but requests join the continuous
+    batch instead of running serially.  A delayed self-post pump runs
+    decode chunks between message deliveries while any slot is live."""
+
+    def __init__(self, context, process=None, server=None):
+        from .serving import REPLICA_PROTOCOL
+        context.protocol = context.protocol or REPLICA_PROTOCOL
+        super().__init__(context, process)
+        self.server = server or ContinuousBatchingServer()
+        self._command_handlers["infer"] = self._wire_infer
+        self._command_handlers["pump"] = self._pump
+        self.share["slots"] = self.server.slots
+        self.share["requests_served"] = 0
+        self._pumping = False
+
+    def _wire_infer(self, request_id, response_topic, payload=None):
+        from ..pipeline.codec import decode_swag
+        request = DecodeRequest(request_id=str(request_id), prompt=None,
+                                max_new_tokens=0, tokens=[],
+                                response_topic=str(response_topic))
+        try:
+            inputs = decode_swag(payload or {})
+            request.prompt = np.asarray(inputs["tokens"],
+                                        np.int32).reshape(-1)
+            request.max_new_tokens = int(
+                np.asarray(inputs.get("max_new_tokens", 16)))
+        except Exception:  # noqa: BLE001 - bad request must still respond
+            self.logger.exception("%s: malformed infer request %s",
+                                  self.name, request_id)
+            request.error = "infer_failed"
+            self._respond(request)
+            return
+        self.server.submit(request)
+        self._ensure_pumping()
+
+    def _ensure_pumping(self):
+        if not self._pumping:
+            self._pumping = True
+            self._schedule_pump()
+
+    def _schedule_pump(self):
+        from ..runtime.actor import ActorMessage, Mailbox
+        self._post_message(Mailbox.IN, ActorMessage("pump", []),
+                           delay=0.001)
+
+    def _pump(self):
+        for request in self.server.step():
+            self._respond(request)
+        if self.server.busy or self.server.completed:
+            self._schedule_pump()
+        else:
+            self._pumping = False
+
+    def _respond(self, request: DecodeRequest):
+        from ..pipeline.codec import encode_swag
+        self.share["requests_served"] += 1
+        if self.ec_producer is not None:
+            self.ec_producer.update("requests_served",
+                                    self.share["requests_served"])
+        if request.error is not None:
+            outputs: Dict = {"error": request.error}
+        else:
+            outputs = {"tokens_out": np.asarray(request.tokens,
+                                                np.int32)}
+        if request.response_topic:
+            self.process.message.publish(
+                request.response_topic,
+                generate("infer_response",
+                         [request.request_id, encode_swag(outputs)]))

@@ -63,7 +63,7 @@ def pipeline_apply(stage_fn: Callable, stage_params, microbatches,
         jnp.zeros((n_micro,) + microbatches.shape[1:],
                   microbatches.dtype), axis_name)
 
-    def round_body(t, carry):
+    def round_body(carry, t):
         recv, outputs = carry
         # Stage 0 feeds from the microbatch queue; others from the ring.
         feed_index = jnp.clip(t, 0, n_micro - 1)
@@ -85,10 +85,13 @@ def pipeline_apply(stage_fn: Callable, stage_params, microbatches,
         # Hand this round's activation to the next stage (the wrap-around
         # last→0 edge carries garbage; stage 0 never reads recv).
         recv = jax.lax.ppermute(out, axis_name, perm)
-        return recv, outputs
+        return (recv, outputs), None
 
-    _, outputs = jax.lax.fori_loop(0, n_micro + pp - 1, round_body,
-                                   (recv, outputs))
+    # scan (not fori_loop) so reverse-mode AD works: this makes the
+    # whole schedule differentiable and enables pipeline-parallel
+    # TRAINING (grad of ppermute = ppermute with the inverse ring).
+    (_, outputs), _ = jax.lax.scan(
+        round_body, (recv, outputs), jnp.arange(n_micro + pp - 1))
     # Only the last stage holds real outputs; make them uniform so the
     # host wrapper can return replicated results.
     return jax.lax.psum(

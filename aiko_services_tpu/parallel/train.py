@@ -18,7 +18,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..models import llama
 
-__all__ = ["make_train_step", "init_train_state", "shard_train_state"]
+__all__ = ["make_train_step", "init_train_state", "shard_train_state",
+           "make_pp_train_step", "to_pp_params"]
 
 
 def cross_entropy(logits, targets):
@@ -85,3 +86,43 @@ def shard_train_state(params, opt_state, mesh: Mesh,
                                  nu=place_like_params(item.nu))
         new_opt_state.append(item)
     return params, tuple(new_opt_state)
+
+
+def make_pp_train_step(config: llama.LlamaConfig, optimizer, mesh: Mesh,
+                       n_microbatches: int = 4, pp_axis: str = "pp"):
+    """Pipeline-parallel training step (GPipe schedule, exact grads).
+
+    Parameters live in "pp form": ``{"embed", "stages", "final_norm",
+    "lm_head"}`` where ``stages`` is the stacked per-stage layer pytree
+    (:func:`~..models.llama.stack_pipeline_params`) sharded ``P("pp",
+    …)``.  The forward streams microbatches through the stage devices
+    (``parallel/pipeline_parallel.py`` — a ``lax.scan`` schedule, so
+    reverse-mode AD runs the backward sweep through the same ring);
+    embed / final norm / LM head stay replicated.  Composes with dp on
+    the batch axis of ``tokens``.
+    """
+    def loss_fn(params, tokens):
+        logits = llama.pipeline_forward(
+            {"embed": params["embed"], "final_norm": params["final_norm"],
+             "lm_head": params["lm_head"], "layers": []},
+            tokens[:, :-1], config, mesh,
+            n_microbatches=n_microbatches, pp_axis=pp_axis,
+            stages=params["stages"])
+        return cross_entropy(logits, tokens[:, 1:])
+
+    def train_step(params, opt_state, tokens):
+        loss, grads = jax.value_and_grad(loss_fn)(params, tokens)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    return train_step
+
+
+def to_pp_params(params, config: llama.LlamaConfig, pp: int):
+    """Convert standard llama params to the "pp form" used by
+    :func:`make_pp_train_step` (stages stacked on a leading pp axis)."""
+    stages = llama.stack_pipeline_params(params, config, pp)
+    return {"embed": params["embed"], "stages": stages,
+            "final_norm": params["final_norm"],
+            "lm_head": params["lm_head"]}

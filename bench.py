@@ -216,6 +216,138 @@ def bench_pipeline(n_frames=200, warmup=20, image_size=320):
     return fps, p50
 
 
+def _run_pipeline_frames(document, stream_inputs, n_frames, warmup,
+                         broker):
+    """Shared harness: build a pipeline from ``document``, push
+    ``stream_inputs() -> dict`` frames with bounded in-flight, return
+    (fps, p50_ms)."""
+    from aiko_services_tpu.pipeline import (
+        Pipeline, parse_pipeline_definition,
+    )
+    from aiko_services_tpu.runtime import (
+        Process, compose_instance, pipeline_args,
+    )
+    from aiko_services_tpu.runtime.event import EventEngine
+
+    engine = EventEngine()
+    process = Process(namespace="bench", hostname="h", pid="1",
+                      engine=engine, broker=broker)
+    definition = parse_pipeline_definition(document)
+    pipeline = compose_instance(
+        Pipeline, pipeline_args(document["name"], definition=definition),
+        process=process)
+    thread = engine.run_in_thread()
+    out: "queue.Queue" = queue.Queue()
+    pipeline.create_stream("bench", queue_response=out,
+                           grace_time=300.0)
+    try:
+        def run(count, in_flight=16):
+            posted = received = 0
+            while received < count:
+                while posted < count and posted - received < in_flight:
+                    pipeline.post_frame("bench", stream_inputs())
+                    posted += 1
+                _, _, outputs = out.get(timeout=300)
+                received += 1
+            return outputs
+
+        last = run(warmup)
+        for value in last.values():           # sync device queue
+            np.asarray(value)
+        started = time.perf_counter()
+        last = run(n_frames)
+        for value in last.values():           # timed region ends in
+            np.asarray(value)                 # host readback (relay!)
+        elapsed = time.perf_counter() - started
+        fps = n_frames / elapsed
+        latencies = []
+        for _ in range(20):
+            t0 = time.perf_counter()
+            pipeline.post_frame("bench", stream_inputs())
+            _, _, outputs = out.get(timeout=300)
+            for value in outputs.values():
+                np.asarray(value)
+            latencies.append(time.perf_counter() - t0)
+        p50 = statistics.median(latencies) * 1e3
+        return fps, p50
+    finally:
+        with contextlib.suppress(Exception):
+            pipeline.destroy_stream("bench")
+        with contextlib.suppress(Exception):
+            engine.terminate()
+        with contextlib.suppress(Exception):
+            thread.join(timeout=5)
+
+
+def bench_text_pipeline(n_frames=300, warmup=20, seq_len=128):
+    """BASELINE config 1: single-element text pipeline, DistilBERT-class
+    classifier, batch=1 — frames/sec/chip.  Token frames are ~0.5 KB so
+    they are host-fed (transport is not the bottleneck here)."""
+    document = {
+        "version": 0, "name": "p_text", "runtime": "tpu",
+        "graph": ["(TextClassifierElement)"],
+        "elements": [
+            {"name": "TextClassifierElement",
+             "input": [{"name": "tokens", "type": "array"}],
+             "output": [{"name": "logits", "type": "array"},
+                        {"name": "label_id", "type": "array"}],
+             "parameters": {"model_config": "distilbert"},
+             "deploy": {"local": {
+                 "module": "aiko_services_tpu.elements",
+                 "class_name": "TextClassifierElement"}}},
+        ],
+    }
+    rng = np.random.default_rng(1)
+    tokens = rng.integers(0, 30_000, (1, seq_len)).astype(np.int32)
+    log(f"text pipeline (distilbert-class, batch 1, seq {seq_len})...")
+    fps, p50 = _run_pipeline_frames(
+        document, lambda: {"tokens": tokens}, n_frames, warmup,
+        broker="bench_text")
+    log(f"text pipeline: {fps:.1f} frames/sec/chip, p50 {p50:.2f} ms")
+    return fps, p50
+
+
+def bench_speech_chat(n_frames=20, warmup=3, max_new_tokens=32):
+    """BASELINE config 3: the speech→chat two-stage pipeline —
+    Whisper-class ASR feeding a Llama-class chat element (single chip;
+    the v5e-4 variant shards the chat stage over tp).  Reports chat
+    tokens/sec/chip and p50 e2e (audio in → generated tokens out)."""
+    document = {
+        "version": 0, "name": "p_speech", "runtime": "python",
+        "graph": ["(ASRElement LlamaChatElement "
+                  "(text_tokens: tokens))"],
+        "elements": [
+            {"name": "ASRElement",
+             "input": [{"name": "audio", "type": "array"}],
+             "output": [{"name": "text_tokens", "type": "array"}],
+             "parameters": {"model_config": "whisper_small",
+                            "max_tokens": 12},
+             "deploy": {"local": {
+                 "module": "aiko_services_tpu.elements",
+                 "class_name": "ASRElement"}}},
+            {"name": "LlamaChatElement",
+             "input": [{"name": "tokens", "type": "array"}],
+             "output": [{"name": "tokens_out", "type": "array"},
+                        {"name": "tokens_per_second", "type": "float"}],
+             "parameters": {"model_config": "small",
+                            "max_new_tokens": max_new_tokens},
+             "deploy": {"local": {
+                 "module": "aiko_services_tpu.elements",
+                 "class_name": "LlamaChatElement"}}},
+        ],
+    }
+    rng = np.random.default_rng(2)
+    audio = (rng.standard_normal(16_000) * 0.1).astype(np.float32)
+    log("speech->chat pipeline (whisper_small ASR -> llama small)...")
+    fps, p50 = _run_pipeline_frames(
+        document, lambda: {"audio": audio}, n_frames, warmup,
+        broker="bench_speech")
+    tokens_per_sec = fps * max_new_tokens  # new tokens per frame
+    log(f"speech->chat: {fps:.2f} frames/s = {tokens_per_sec:.0f} "
+        f"chat tokens/sec/chip, p50 e2e {p50:.2f} ms")
+    return tokens_per_sec, p50
+
+
 # --------------------------------------------------------------------------- #
 # LLM decode tokens/sec
 
@@ -403,6 +535,18 @@ def main():
             result["value"] = round(fps, 1)
             result["vs_baseline"] = round(fps / 50.0, 2)
             result["p50_e2e_ms"] = round(p50, 2)
+
+        text = run_section("text_pipeline", 300, bench_text_pipeline)
+        if text is not None:
+            fps, p50 = text
+            result["text_pipeline_fps_chip"] = round(fps, 1)
+            result["text_pipeline_p50_ms"] = round(p50, 2)
+
+        speech = run_section("speech_chat", 420, bench_speech_chat)
+        if speech is not None:
+            tps, p50 = speech
+            result["speech_chat_tokens_per_sec_chip"] = round(tps)
+            result["speech_chat_p50_e2e_ms"] = round(p50, 2)
 
         tps = run_section("llm_small", 420, lambda: bench_llm_decode())
         if tps is not None:

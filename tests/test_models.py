@@ -479,3 +479,60 @@ def test_ulysses_rejects_indivisible_heads():
                for s in jax.random.split(key, 3)]
     with pytest.raises(ValueError, match="divisible"):
         ulysses_attention_sharded(q, k, v, mesh, axis="sp")
+
+
+def test_pipeline_parallel_training_grads_match():
+    """The scan-based GPipe schedule is differentiable: loss and grads
+    through the pp=2 pipeline match the plain single-program training
+    loss/grads (same params) up to bf16 stage-boundary rounding."""
+    from aiko_services_tpu.parallel.train import (
+        make_pp_train_step, to_pp_params, cross_entropy,
+    )
+    import optax
+    config = llama.CONFIGS["tiny"]
+    params = llama.init_params(config, jax.random.PRNGKey(40))
+    tokens = jax.random.randint(jax.random.PRNGKey(41), (4, 17), 0,
+                                config.vocab_size)
+    mesh = make_mesh(pp=2, tp=4)
+
+    def plain_loss(p):
+        logits = llama.forward(p, tokens[:, :-1], config,
+                               use_flash=False)
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), -1)
+        picked = jnp.take_along_axis(logp, tokens[:, 1:][..., None], -1)
+        return -jnp.mean(picked)
+
+    plain_l, plain_g = jax.value_and_grad(plain_loss)(params)
+
+    pp_params = to_pp_params(params, config, pp=2)
+    optimizer = optax.sgd(0.0)
+    step = make_pp_train_step(config, optimizer, mesh,
+                              n_microbatches=2)
+    opt_state = optimizer.init(pp_params)
+    new_params, _, pp_l = step(pp_params, opt_state, tokens)
+    assert abs(float(pp_l) - float(plain_l)) < 2e-2, (
+        float(pp_l), float(plain_l))
+    # Compare a few grad leaves: embed and one early/late layer weight.
+    pp_l2, pp_g = jax.value_and_grad(
+        lambda p: cross_entropy(
+            llama.pipeline_forward(
+                {"embed": p["embed"], "final_norm": p["final_norm"],
+                 "lm_head": p["lm_head"], "layers": []},
+                tokens[:, :-1], config, mesh, n_microbatches=2,
+                stages=p["stages"]),
+            tokens[:, 1:]))(pp_params)
+    per_stage = config.n_layers // 2
+    for stage in (0, 1):
+        for j in range(per_stage):
+            layer_index = stage * per_stage + j
+            got = pp_g["stages"]["wq"][stage, j]
+            want = plain_g["layers"][layer_index]["wq"]
+            err = float(jnp.max(jnp.abs(got.astype(jnp.float32)
+                                        - want.astype(jnp.float32))))
+            scale = float(jnp.max(jnp.abs(want.astype(jnp.float32))))
+            assert err <= 0.15 * max(scale, 1e-3), (
+                layer_index, err, scale)
+    err_embed = float(jnp.max(jnp.abs(
+        pp_g["embed"].astype(jnp.float32)
+        - plain_g["embed"].astype(jnp.float32))))
+    assert err_embed < 0.2, err_embed
