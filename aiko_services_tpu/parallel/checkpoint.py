@@ -67,15 +67,22 @@ class TrainCheckpointer:
     """
 
     def __init__(self, directory: str, *, max_to_keep: int = 3,
-                 save_interval_steps: int = 1):
+                 save_interval_steps: int = 1, async_save: bool = False):
+        """``async_save=True`` overlaps checkpoint serialization with
+        the training step that follows: ``save()`` snapshots device
+        arrays then returns while orbax writes in a background thread
+        (the standard TPU pattern — the next step's compute hides the
+        host IO).  Call :meth:`wait` (or ``save``/``close``, which
+        barrier implicitly) before reading the files."""
         import orbax.checkpoint as ocp
         self._ocp = ocp
+        self._async = bool(async_save)
         self._directory = os.path.abspath(directory)
         os.makedirs(self._directory, exist_ok=True)
         options = ocp.CheckpointManagerOptions(
             max_to_keep=max_to_keep,
             save_interval_steps=save_interval_steps,
-            enable_async_checkpointing=False)
+            enable_async_checkpointing=self._async)
         self._manager = ocp.CheckpointManager(self._directory, options=options)
 
     # -- save ---------------------------------------------------------
@@ -93,8 +100,14 @@ class TrainCheckpointer:
         if metadata is not None:
             items["metadata"] = ocp.args.JsonSave(dict(metadata))
         saved = self._manager.save(step, args=ocp.args.Composite(**items))
-        self._manager.wait_until_finished()
+        if not self._async:
+            self._manager.wait_until_finished()
         return saved
+
+    def wait(self):
+        """Barrier for async saves: returns when every pending
+        checkpoint write has committed."""
+        self._manager.wait_until_finished()
 
     # -- restore ------------------------------------------------------
 

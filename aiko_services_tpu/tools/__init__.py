@@ -1,2 +1,3 @@
 from .recorder import Recorder
 from .storage import Storage
+from .profiler import ProfilerActor, ProfilerMixin

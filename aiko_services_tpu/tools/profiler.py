@@ -1,0 +1,119 @@
+"""Profiler actor: remote-controlled XLA/JAX trace capture.
+
+SURVEY.md §5.1's TPU answer to the reference's wall-clock frame metrics
+(reference main/pipeline.py:1278-1290): per-stage device timings come
+from the XLA profiler, not host stopwatches.  The fused pipeline stages
+already annotate their device ops (``jax.profiler.TraceAnnotation`` in
+``pipeline/tpu_stage.py``); this actor turns capture on/off over the
+standard actor wire protocol so an operator (or the dashboard) can grab
+a trace from ANY running process in the fleet without restarting it:
+
+    (profile_start /tmp/trace_dir)   → jax.profiler.start_trace
+    (profile_stop)                   → stop_trace; share lists the dir
+    (profile_status)                 → echo state to topic_out
+
+Traces are TensorBoard-loadable (``tensorboard --logdir <dir>``) and
+include per-op device time, HBM traffic, and the stage:<name>
+annotations.  A ``ProfilerMixin`` is also provided so any Actor can
+adopt the same commands.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Optional
+
+from ..runtime.actor import Actor
+
+__all__ = ["ProfilerActor", "ProfilerMixin"]
+
+
+class ProfilerMixin:
+    """Adds profile_start/profile_stop/profile_status commands to an
+    Actor subclass (call :meth:`_init_profiler` after Actor.__init__)."""
+
+    def _init_profiler(self):
+        self._command_handlers["profile_start"] = self.profile_start
+        self._command_handlers["profile_stop"] = self.profile_stop
+        self._command_handlers["profile_status"] = self.profile_status
+        self._trace_dir: Optional[str] = None
+        self._trace_started: Optional[float] = None
+        self._share_update("profiling", False)
+
+    def profile_start(self, trace_dir: str = ""):
+        """Begin an XLA trace capture into ``trace_dir``."""
+        import jax
+        if self._trace_dir is not None:
+            self.logger.warning("%s: trace already running in %s",
+                                self.name, self._trace_dir)
+            return
+        trace_dir = str(trace_dir) or os.path.join(
+            "/tmp", f"aiko_trace_{os.getpid()}_{int(time.time())}")
+        os.makedirs(trace_dir, exist_ok=True)
+        try:
+            jax.profiler.start_trace(trace_dir)
+        except Exception as error:  # noqa: BLE001 - backend may lack it
+            self.logger.error("%s: start_trace failed: %r", self.name,
+                              error)
+            # The global profiler session may be active from elsewhere
+            # (or half-started); try to clear it so a retry can work.
+            try:
+                jax.profiler.stop_trace()
+            except Exception:  # noqa: BLE001
+                pass
+            return
+        self._trace_dir = trace_dir
+        self._trace_started = time.time()
+        self._share_update("profiling", True)
+        self.logger.info("%s: tracing to %s", self.name, trace_dir)
+
+    def profile_stop(self):
+        """End the capture; the trace dir lands in the EC share so the
+        dashboard / remote callers can find it."""
+        import jax
+        if self._trace_dir is None:
+            self.logger.warning("%s: no trace running", self.name)
+            return
+        try:
+            jax.profiler.stop_trace()
+        except Exception as error:  # noqa: BLE001
+            # Keep _trace_dir so the operator can retry profile_stop —
+            # the process-global profiler session may still be open, and
+            # clearing our state here would wedge profiling forever.
+            self.logger.error("%s: stop_trace failed (retryable): %r",
+                              self.name, error)
+            return
+        duration = time.time() - (self._trace_started or time.time())
+        self._share_update("profiling", False)
+        self._share_update("last_trace_dir", self._trace_dir)
+        self._share_update("last_trace_seconds", round(duration, 3))
+        self.logger.info("%s: trace (%.1fs) written to %s", self.name,
+                         duration, self._trace_dir)
+        self._trace_dir = None
+        self._trace_started = None
+
+    def profile_status(self):
+        self.publish_out("profile_status",
+                         ["running" if self._trace_dir else "idle",
+                          self._trace_dir or
+                          self.share.get("last_trace_dir", "")])
+
+    def _share_update(self, key, value):
+        """Share write + EC broadcast (ECProducer.update already sets
+        the share dict; the direct write is only the no-producer
+        fallback)."""
+        if getattr(self, "ec_producer", None) is not None:
+            self.ec_producer.update(key, value)
+        else:
+            self.share[key] = value
+
+
+class ProfilerActor(ProfilerMixin, Actor):
+    """Standalone profiler service: run one per process to capture that
+    process's device traces on demand."""
+
+    def __init__(self, context, process=None):
+        context.protocol = context.protocol or "profiler:0"
+        super().__init__(context, process)
+        self._init_profiler()

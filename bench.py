@@ -536,18 +536,6 @@ def main():
             result["vs_baseline"] = round(fps / 50.0, 2)
             result["p50_e2e_ms"] = round(p50, 2)
 
-        text = run_section("text_pipeline", 300, bench_text_pipeline)
-        if text is not None:
-            fps, p50 = text
-            result["text_pipeline_fps_chip"] = round(fps, 1)
-            result["text_pipeline_p50_ms"] = round(p50, 2)
-
-        speech = run_section("speech_chat", 420, bench_speech_chat)
-        if speech is not None:
-            tps, p50 = speech
-            result["speech_chat_tokens_per_sec_chip"] = round(tps)
-            result["speech_chat_p50_e2e_ms"] = round(p50, 2)
-
         tps = run_section("llm_small", 420, lambda: bench_llm_decode())
         if tps is not None:
             result["llm_tokens_per_sec_chip"] = round(tps)
@@ -570,8 +558,8 @@ def main():
             result["llm_moe_int8_tokens_per_sec_chip"] = round(tps)
             result["llm_moe_int8_batch"] = 64    # r01 measured batch 8
 
-        # Flagship LAST: the heaviest section, so a wedge here cannot
-        # take the earlier captures down with it.
+        # Flagship after the established sections: the heaviest load,
+        # so a wedge here cannot take the captures above down with it.
         # Batch 64: decode is weight-bandwidth-bound, so tok/s scales
         # ~linearly with batch until KV bytes/step rival weight bytes
         # (weights 7.5 GB + KV 2.2 GB at 64 still weight-dominated).
@@ -587,6 +575,21 @@ def main():
             result["llama3_8b_int8_tokens_per_sec_chip"] = round(tps)
             result["llama3_8b_int8_batch"] = 64  # r01 measured batch 8
             result["llama3_8b_vs_2000_target"] = round(tps / 2000.0, 2)
+
+        # Newest sections LAST (the relay wedges on some heavy compiles
+        # and the watchdog cannot interrupt a device call — a wedge here
+        # must not cost the established captures above).
+        text = run_section("text_pipeline", 300, bench_text_pipeline)
+        if text is not None:
+            fps, p50 = text
+            result["text_pipeline_fps_chip"] = round(fps, 1)
+            result["text_pipeline_p50_ms"] = round(p50, 2)
+
+        speech = run_section("speech_chat", 420, bench_speech_chat)
+        if speech is not None:
+            tps, p50 = speech
+            result["speech_chat_tokens_per_sec_chip"] = round(tps)
+            result["speech_chat_p50_e2e_ms"] = round(p50, 2)
     finally:
         if errors:
             result["errors"] = errors

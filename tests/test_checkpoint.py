@@ -144,3 +144,30 @@ def test_stream_checkpoint_roundtrip(tmp_path):
     assert rec.parameters == {"rate": 10}      # non-JSON entry dropped
     assert rec.swag == {"text": "hello"}       # array dropped (device state)
     assert rec.graph_path == "main"
+
+
+def test_async_save_overlaps_and_restores(tmp_path):
+    """async_save=True returns before the write commits; wait() (or a
+    later save) barriers, and the restored tree is identical."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from aiko_services_tpu.parallel.checkpoint import TrainCheckpointer
+
+    state = {"params": {"w": jnp.arange(12.0).reshape(3, 4),
+                        "b": jnp.ones((4,))}}
+    ckpt = TrainCheckpointer(str(tmp_path / "async"), max_to_keep=2,
+                             async_save=True)
+    assert ckpt.save(1, state)
+    ckpt.wait()
+    assert ckpt.latest_step() == 1
+    restored = ckpt.restore(
+        {"params": jax.tree.map(np.zeros_like, state["params"])})
+    np.testing.assert_array_equal(np.asarray(restored["params"]["w"]),
+                                  np.asarray(state["params"]["w"]))
+    # Second async save supersedes, retention keeps both steps.
+    state2 = {"params": jax.tree.map(lambda x: x + 1, state["params"])}
+    assert ckpt.save(2, state2)
+    ckpt.wait()
+    assert ckpt.latest_step() == 2
+    ckpt.close()

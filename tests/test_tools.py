@@ -176,3 +176,40 @@ def test_dashboard_plugin_action_runs(engine):
     assert state.run_plugin_action("s") is True
     engine.drain()
     assert not pipeline.streams
+
+
+def test_profiler_actor_commands(engine, tmp_path):
+    """profile_start/stop drive jax.profiler and surface the trace dir
+    in the share; double-start and stop-without-start are safe."""
+    import os
+    from aiko_services_tpu.tools import ProfilerActor
+    from aiko_services_tpu.runtime import (
+        Process, actor_args, compose_instance,
+    )
+    from aiko_services_tpu.utils.sexpr import generate
+
+    process = Process(namespace="test", hostname="h", pid="77",
+                      engine=engine, broker="prof")
+    actor = compose_instance(ProfilerActor, actor_args("prof0"),
+                             process=process)
+    trace_dir = str(tmp_path / "trace")
+    process.message.publish(actor.topic_in,
+                            generate("profile_start", [trace_dir]))
+    engine.advance(0.1)
+    assert actor.share["profiling"] is True
+    # Double start: warns, stays on the first capture.
+    process.message.publish(actor.topic_in,
+                            generate("profile_start", ["/tmp/other"]))
+    engine.advance(0.1)
+    assert actor._trace_dir == trace_dir
+    process.message.publish(actor.topic_in, generate("profile_stop"))
+    engine.advance(0.1)
+    assert actor.share["profiling"] is False
+    assert actor.share["last_trace_dir"] == trace_dir
+    assert os.path.isdir(trace_dir)
+    # Trace content written (plugins/profile/... on CPU backends too).
+    found = any(files for _, _, files in os.walk(trace_dir))
+    assert found, "no trace files captured"
+    # Stop without start: safe no-op.
+    process.message.publish(actor.topic_in, generate("profile_stop"))
+    engine.advance(0.1)
