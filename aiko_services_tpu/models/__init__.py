@@ -4,3 +4,4 @@ from . import classifier
 from . import detector
 from . import asr
 from . import vision
+from . import speculative

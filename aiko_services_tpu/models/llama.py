@@ -30,7 +30,7 @@ from ..ops.quant import int8_matmul, is_quantized, quantize_tree
 __all__ = ["LlamaConfig", "init_params", "forward", "init_cache",
            "decode_step", "generate_tokens", "prefill", "param_specs",
            "quantize_params", "pipeline_forward", "stack_pipeline_params",
-           "decode_chunk_ragged", "CONFIGS"]
+           "decode_chunk_ragged", "prefill_chunk", "CONFIGS"]
 
 
 @dataclasses.dataclass(frozen=True)
@@ -368,6 +368,21 @@ decode_step = functools.partial(jax.jit, static_argnames=("config",),
                                 donate_argnames=("cache",))(_decode_core)
 
 
+def _cached_gqa_attention(q, k_cache, v_cache, query_positions, hd):
+    """Masked GQA attention over a KV cache — the ONE implementation
+    shared by ragged decode and chunked prefill.  ``q`` (batch, Q, kv,
+    group, hd); ``query_positions`` (batch, Q) absolute positions; key
+    row ``s`` is attended iff ``s <= position`` of the query."""
+    s = jnp.einsum("bqkgd,bskd->bkgqs", q, k_cache,
+                   preferred_element_type=jnp.float32) * hd ** -0.5
+    key_pos = jnp.arange(k_cache.shape[1])
+    mask = key_pos[None, None, :] <= query_positions[:, :, None]
+    s = jnp.where(mask[:, None, None, :, :], s, -1e30)
+    weights = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bkgqs,bskd->bqkgd",
+                      weights.astype(v_cache.dtype), v_cache)
+
+
 def _attention_decode_ragged(layer, config, x, cos, sin, cache_layer,
                              positions):
     """Single-token decode where every batch row sits at its OWN cache
@@ -396,15 +411,8 @@ def _attention_decode_ragged(layer, config, x, cos, sin, cache_layer,
 
     group = h // kv
     q_g = q.reshape(batch, seq, kv, group, hd)
-    s = jnp.einsum("bqkgd,bskd->bkgqs", q_g, k_cache,
-                   preferred_element_type=jnp.float32) * hd ** -0.5
-    # Each row masks beyond its own position.
-    valid = (jnp.arange(k_cache.shape[1])[None, :]
-             <= positions[:, None])               # (batch, max_seq)
-    s = jnp.where(valid[:, None, None, None, :], s, -1e30)
-    weights = jax.nn.softmax(s, axis=-1)
-    out = jnp.einsum("bkgqs,bskd->bqkgd",
-                     weights.astype(v_cache.dtype), v_cache)
+    out = _cached_gqa_attention(q_g, k_cache, v_cache,
+                                positions[:, None], hd)
     out = out.reshape(batch, seq, h * hd)
     return x + _matmul(out, layer["wo"]).astype(x.dtype), new_cache
 
@@ -496,6 +504,53 @@ def generate_tokens(params, first_token, cache, start_index, num_steps,
         body, (first_token, cache, rng_key),
         jnp.arange(num_steps, dtype=jnp.int32))
     return tokens.T, cache   # (batch, num_steps)
+
+
+@functools.partial(jax.jit, static_argnames=("config",),
+                   donate_argnames=("cache",))
+def prefill_chunk(params, tokens, cache, start_index,
+                  config: LlamaConfig):
+    """Chunked prefill: run ``tokens (batch, K)`` through the model at
+    absolute positions ``start_index + [0, K)``, extending an EXISTING
+    cache prefix.  Returns (logits (batch, K, vocab) — every position,
+    not just the last — and the cache).
+
+    Uses: admitting long prompts chunk-by-chunk (continuous batching),
+    and speculative-decode verification (score K draft tokens in one
+    pass).  Attention masks by ABSOLUTE position (key_pos <= query_pos),
+    so stale cache rows beyond the chunk are never attended."""
+    batch, K = tokens.shape
+    positions = start_index + jnp.arange(K)
+    positions_b = jnp.broadcast_to(positions, (batch, K))
+    cos, sin = _rope_freqs(config, positions_b)
+    x = _embed_lookup(params, tokens, config.dtype)
+    h, kv, hd = config.n_heads, config.n_kv_heads, config.head_dim
+    new_cache = []
+    for layer, cache_layer in zip(params["layers"], cache):
+        normed = rms_norm(x, layer["attn_norm"], config.norm_eps)
+        q = _matmul(normed, layer["wq"]).reshape(batch, K, h, hd)
+        k = _matmul(normed, layer["wk"]).reshape(batch, K, kv, hd)
+        v = _matmul(normed, layer["wv"]).reshape(batch, K, kv, hd)
+        q = apply_rope(q, cos, sin)
+        k = apply_rope(k, cos, sin)
+        k_cache = jax.lax.dynamic_update_slice(
+            cache_layer["k"], k.astype(cache_layer["k"].dtype),
+            (0, start_index, 0, 0))
+        v_cache = jax.lax.dynamic_update_slice(
+            cache_layer["v"], v.astype(cache_layer["v"].dtype),
+            (0, start_index, 0, 0))
+        new_cache.append({"k": k_cache, "v": v_cache})
+        # Shared masked-GQA helper, absolute-position mask.
+        group = h // kv
+        q_g = q.reshape(batch, K, kv, group, hd)
+        out = _cached_gqa_attention(q_g, k_cache, v_cache,
+                                    positions_b, hd)
+        x = x + _matmul(out.reshape(batch, K, h * hd),
+                        layer["wo"]).astype(x.dtype)
+        x = _mlp_block(layer, config, x)
+    x = rms_norm(x, params["final_norm"], config.norm_eps)
+    logits = _matmul(x, params["lm_head"]).astype(jnp.float32)
+    return logits, new_cache
 
 
 def stack_pipeline_params(params, config: LlamaConfig, pp: int):

@@ -1,0 +1,144 @@
+"""Speculative decoding: a small draft model proposes, the big model
+verifies k+1 positions per pass.
+
+Decode is HBM-bandwidth-bound: each sequential step streams the whole
+weight tree for ONE new token per row.  Speculative decoding converts
+sequential target-model steps into one :func:`~.llama.prefill_chunk`
+over k draft proposals — the chunk's extra query rows ride the same
+weight stream almost free, so every accepted draft token divides the
+target's bytes-per-token.  The reference has no decoding machinery at
+all (LLM work shells out to Ollama, examples/llm/elements_llm.py).
+
+This implementation is GREEDY speculative decoding: acceptance is exact
+argmax match, so the output sequence is IDENTICAL to target-only greedy
+decode — a speedup with a machine-checkable no-regression property
+(asserted in tests), not an approximation.
+
+Cache discipline: rejected proposals leave stale KV rows past the
+committed position.  Both the verify chunk and the decode cores mask
+attention by ABSOLUTE position (key_pos <= query_pos) and every row is
+rewritten before it first becomes attendable, so stale rows are
+unreachable — the same invariant continuous batching relies on.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from . import llama
+
+__all__ = ["speculative_generate", "SpecStats"]
+
+
+class SpecStats:
+    """Acceptance accounting for one generate call."""
+
+    def __init__(self):
+        self.target_passes = 0
+        self.drafted = 0
+        self.accepted = 0
+
+    @property
+    def acceptance_rate(self) -> float:
+        return self.accepted / self.drafted if self.drafted else 0.0
+
+    @property
+    def tokens_per_target_pass(self) -> float:
+        return ((self.accepted + self.target_passes)
+                / self.target_passes if self.target_passes else 0.0)
+
+    def __repr__(self):
+        return (f"SpecStats(passes={self.target_passes}, "
+                f"accept={self.accepted}/{self.drafted} "
+                f"= {self.acceptance_rate:.0%}, "
+                f"tok/pass={self.tokens_per_target_pass:.2f})")
+
+
+def speculative_generate(target_params, draft_params, prompt,
+                         num_new: int, target_config, draft_config,
+                         k: int = 4, max_seq: Optional[int] = None
+                         ) -> Tuple[np.ndarray, SpecStats]:
+    """Greedy speculative decode: returns (tokens (num_new,), stats).
+
+    ``prompt``: (prompt_len,) int32.  Batch 1 (speculation's win is the
+    low-batch latency regime; high-throughput batches should use
+    continuous batching instead).  Requires
+    ``target_config.vocab_size == draft_config.vocab_size``.
+    """
+    if target_config.vocab_size != draft_config.vocab_size:
+        raise ValueError("draft and target must share a vocabulary")
+    prompt = jnp.asarray(np.asarray(prompt, np.int32))[None, :]
+    prompt_len = prompt.shape[1]
+    max_seq = max_seq or min(target_config.max_seq_len,
+                             draft_config.max_seq_len)
+    if prompt_len + num_new + k + 1 > max_seq:
+        raise ValueError(
+            f"prompt {prompt_len} + {num_new} new + {k + 1} speculation "
+            f"overrun max_seq {max_seq}")
+
+    target_cache = llama.init_cache(target_config, 1, max_seq)
+    draft_cache = llama.init_cache(draft_config, 1, max_seq)
+    target_logits, target_cache = llama.prefill(
+        target_params, prompt, target_cache, target_config)
+    _, draft_cache = llama.prefill(draft_params, prompt, draft_cache,
+                                   draft_config)
+
+    stats = SpecStats()
+    committed = [int(np.asarray(target_logits)[0, -1].argmax())]
+    stats.target_passes += 1          # the prefill pass produced token 1
+    # `last` token sits at absolute position pos (0-based index in the
+    # full sequence); the next token to predict is position pos+1.
+    pos = prompt_len                  # position of committed[0]
+
+    while len(committed) < num_new:
+        last = jnp.asarray([[committed[-1]]], jnp.int32)
+        # Draft proposes k tokens sequentially (one compiled scan).
+        proposals, draft_cache = llama.generate_tokens(
+            draft_params, last, draft_cache, jnp.int32(pos), k,
+            draft_config)
+        proposals_host = [int(t) for t in np.asarray(proposals)[0]]
+        stats.drafted += k
+        # Target verifies [last, d_1..d_k] in ONE chunk: logits[j]
+        # predicts position pos+j+1.
+        chunk = jnp.asarray([[committed[-1]] + proposals_host],
+                            jnp.int32)
+        logits, target_cache = llama.prefill_chunk(
+            target_params, chunk, target_cache, jnp.int32(pos),
+            target_config)
+        stats.target_passes += 1
+        greedy = np.asarray(logits[0].argmax(-1), np.int64)  # (k+1,)
+        accepted = 0
+        while (accepted < k
+               and proposals_host[accepted] == int(greedy[accepted])):
+            accepted += 1
+        stats.accepted += accepted
+        # Commit accepted drafts + the target's own next token (the
+        # correction on mismatch; the free bonus token on full accept).
+        new_tokens = proposals_host[:accepted] + [int(greedy[accepted])]
+        committed.extend(new_tokens)
+        # Draft-cache re-sync.  The draft generation wrote KV for its
+        # INPUTS [last@pos, d_1..d_{k-1}@pos+1..pos+k-1].  Next round
+        # feeds new `last` = new_tokens[-1] at pos+len(new_tokens), so
+        # every committed token before it needs correct KV:
+        # new_tokens[:-1] spans rows pos+1..pos+len-1 — on partial
+        # accept these rewrites are idempotent; on full accept this
+        # writes d_k's row, which the draft emitted but never consumed.
+        # (Output EXACTNESS never depends on this — only target verify
+        # decides tokens; a stale draft row would only hurt acceptance.)
+        # Fixed k-length resync (pad with zeros): one compiled shape
+        # instead of up to k variants.  Pad rows land at positions the
+        # next rounds rewrite before they become attendable (the
+        # module's stale-row invariant), so they are unreachable.
+        if len(new_tokens) > 1:
+            resync_tokens = new_tokens[:-1] + [0] * (
+                k - (len(new_tokens) - 1))
+            resync = jnp.asarray([resync_tokens], jnp.int32)
+            _, draft_cache = llama.prefill_chunk(
+                draft_params, resync, draft_cache, jnp.int32(pos + 1),
+                draft_config)
+        pos += len(new_tokens)
+
+    return np.asarray(committed[:num_new], np.int64), stats

@@ -37,6 +37,7 @@ class ProfilerMixin:
         self._command_handlers["profile_start"] = self.profile_start
         self._command_handlers["profile_stop"] = self.profile_stop
         self._command_handlers["profile_status"] = self.profile_status
+        self._command_handlers["profile_reset"] = self.profile_reset
         self._trace_dir: Optional[str] = None
         self._trace_started: Optional[float] = None
         self._share_update("profiling", False)
@@ -54,14 +55,12 @@ class ProfilerMixin:
         try:
             jax.profiler.start_trace(trace_dir)
         except Exception as error:  # noqa: BLE001 - backend may lack it
+            # Do NOT stop_trace here: an "already active" failure means
+            # SOMEONE ELSE owns the process-global session and killing
+            # it would wedge their capture.  Operators can force-clear
+            # a known-orphaned session with (profile_reset).
             self.logger.error("%s: start_trace failed: %r", self.name,
                               error)
-            # The global profiler session may be active from elsewhere
-            # (or half-started); try to clear it so a retry can work.
-            try:
-                jax.profiler.stop_trace()
-            except Exception:  # noqa: BLE001
-                pass
             return
         self._trace_dir = trace_dir
         self._trace_started = time.time()
@@ -92,6 +91,19 @@ class ProfilerMixin:
                          duration, self._trace_dir)
         self._trace_dir = None
         self._trace_started = None
+
+    def profile_reset(self):
+        """Operator escape hatch: force-stop the process-global profiler
+        session (e.g. orphaned by a crashed owner) and clear state."""
+        import jax
+        try:
+            jax.profiler.stop_trace()
+        except Exception as error:  # noqa: BLE001
+            self.logger.warning("%s: reset stop_trace: %r", self.name,
+                                error)
+        self._trace_dir = None
+        self._trace_started = None
+        self._share_update("profiling", False)
 
     def profile_status(self):
         self.publish_out("profile_status",

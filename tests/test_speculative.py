@@ -1,0 +1,91 @@
+"""Chunked prefill + speculative decoding exactness."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from aiko_services_tpu.models import llama
+from aiko_services_tpu.models.speculative import speculative_generate
+
+
+@pytest.fixture(scope="module")
+def target():
+    config = llama.CONFIGS["tiny"]
+    return config, llama.init_params(config, jax.random.PRNGKey(50))
+
+
+def test_prefill_chunk_matches_whole_prefill(target):
+    """Prefill in two chunks == prefill in one: same cache rows, and the
+    chunk logits at the seam predict the same next token."""
+    config, params = target
+    tokens = jax.random.randint(jax.random.PRNGKey(51), (2, 24), 1,
+                                config.vocab_size)
+    whole = llama.init_cache(config, 2, 64)
+    logits_whole, whole = llama.prefill(params, tokens, whole, config)
+
+    split = 10
+    chunked = llama.init_cache(config, 2, 64)
+    _, chunked = llama.prefill(params, tokens[:, :split], chunked,
+                               config)
+    logits_chunk, chunked = llama.prefill_chunk(
+        params, tokens[:, split:], chunked, jnp.int32(split), config)
+    for layer_whole, layer_chunk in zip(whole, chunked):
+        for key in ("k", "v"):
+            a = np.asarray(layer_whole[key][:, :24], np.float32)
+            b = np.asarray(layer_chunk[key][:, :24], np.float32)
+            np.testing.assert_allclose(a, b, atol=2e-2)
+    # Next-token agreement at the end of the sequence.
+    assert (int(np.asarray(logits_whole)[0, -1].argmax())
+            == int(np.asarray(logits_chunk)[0, -1].argmax()))
+
+
+def greedy_oracle(params, config, prompt, num_new, max_seq=128):
+    prompt = jnp.asarray(prompt)[None, :]
+    cache = llama.init_cache(config, 1, max_seq)
+    logits, cache = llama.prefill(params, prompt, cache, config)
+    first = logits[:, -1].argmax(-1).astype(jnp.int32)[:, None]
+    tokens, _ = llama.generate_tokens(params, first, cache,
+                                      jnp.int32(prompt.shape[1]),
+                                      num_new - 1, config)
+    return [int(first[0, 0])] + [int(t) for t in np.asarray(tokens)[0]]
+
+
+def test_speculative_equals_greedy_distinct_draft(target):
+    """Draft with different weights (low acceptance): output still
+    EXACTLY the target-only greedy sequence."""
+    config, params = target
+    draft_params = llama.init_params(config, jax.random.PRNGKey(99))
+    prompt = np.asarray(
+        jax.random.randint(jax.random.PRNGKey(52), (12,), 1,
+                           config.vocab_size))
+    want = greedy_oracle(params, config, prompt, 16)
+    got, stats = speculative_generate(params, draft_params, prompt, 16,
+                                      config, config, k=4, max_seq=128)
+    assert list(got) == want, (list(got), want, stats)
+    assert stats.drafted > 0
+
+
+def test_speculative_self_draft_accepts_everything(target):
+    """Draft == target: every proposal must be accepted (k tokens per
+    pass + bonus), and the output is still exact."""
+    config, params = target
+    prompt = np.asarray(
+        jax.random.randint(jax.random.PRNGKey(53), (9,), 1,
+                           config.vocab_size))
+    want = greedy_oracle(params, config, prompt, 15)
+    got, stats = speculative_generate(params, params, prompt, 15,
+                                      config, config, k=4, max_seq=128)
+    assert list(got) == want, (list(got), want, stats)
+    assert stats.acceptance_rate == 1.0, stats
+    assert stats.tokens_per_target_pass > 2.5, stats
+
+
+def test_speculative_rejects_vocab_mismatch(target):
+    config, params = target
+    other = llama.LlamaConfig(vocab_size=2048, d_model=128, n_layers=2,
+                              n_heads=4, n_kv_heads=2, d_ff=352,
+                              max_seq_len=512)
+    with pytest.raises(ValueError, match="vocabulary"):
+        speculative_generate(params, params, np.ones(4, np.int32), 4,
+                             config, other)
