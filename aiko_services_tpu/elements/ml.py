@@ -87,9 +87,9 @@ class ASRElement(PipelineElement):
         features = asr_model.encode(self.params, mel, self.config)
         max_tokens, _ = self.get_parameter("max_tokens", 16,
                                            stream=stream)
-        tokens = asr_model.decode_greedy(self.params, features,
-                                         self.config,
-                                         max_tokens=int(max_tokens))
+        tokens = asr_model.decode_greedy_cached(
+            self.params, features, self.config,
+            max_tokens=int(max_tokens))
         return StreamEvent.OKAY, {"text_tokens": tokens}
 
 
@@ -158,15 +158,18 @@ class LlamaChatElement(PipelineElement):
                                             stream=stream)
         temperature = float(temperature)
         seed, _ = self.get_parameter("sample_seed", 0, stream=stream)
+        top_k, _ = self.get_parameter("top_k", 0, stream=stream)
+        top_p, _ = self.get_parameter("top_p", 1.0, stream=stream)
+        top_k, top_p = int(top_k), float(top_p)
         rng_key = jax.random.PRNGKey(int(seed))
         cache = llama_model.init_cache(self.config, batch, max_seq)
         logits, cache = llama_model.prefill(self.params, tokens, cache,
                                             self.config)
         if temperature > 0:
             rng_key, first_key = jax.random.split(rng_key)
-            first = jax.random.categorical(
-                first_key, logits[:, -1] / temperature) \
-                .astype(jnp.int32)[:, None]
+            first = llama_model.sample_logits(
+                logits[:, -1], first_key, temperature, top_k=top_k,
+                top_p=top_p)[:, None]
         else:
             first = logits[:, -1].argmax(-1).astype(jnp.int32)[:, None]
         import time as _time
@@ -175,7 +178,7 @@ class LlamaChatElement(PipelineElement):
         new_tokens, _ = llama_model.generate_tokens(
             self.params, first, cache, jnp.int32(prompt_len),
             max_new - 1, self.config, temperature=temperature,
-            rng_key=rng_key)
+            rng_key=rng_key, top_k=top_k, top_p=top_p)
         tokens_out = jnp.concatenate([tokens, first, new_tokens], axis=1)
         np.asarray(tokens_out)          # host readback = real completion
         elapsed = _time.perf_counter() - started

@@ -30,7 +30,8 @@ from ..ops.quant import int8_matmul, is_quantized, quantize_tree
 __all__ = ["LlamaConfig", "init_params", "forward", "init_cache",
            "decode_step", "generate_tokens", "prefill", "param_specs",
            "quantize_params", "pipeline_forward", "stack_pipeline_params",
-           "decode_chunk_ragged", "prefill_chunk", "CONFIGS"]
+           "decode_chunk_ragged", "prefill_chunk", "sample_logits",
+           "CONFIGS"]
 
 
 @dataclasses.dataclass(frozen=True)
@@ -471,12 +472,46 @@ def decode_chunk_ragged(params, tokens, cache, positions, active,
     return tokens_out.T, token, positions, cache
 
 
+def sample_logits(logits, key, temperature: float = 1.0,
+                  top_k: int = 0, top_p: float = 1.0):
+    """Sample token ids from ``logits (batch, vocab)`` with the standard
+    serving controls: temperature scaling, top-k truncation, and
+    nucleus (top-p) truncation — jit-compatible (static vocab sort, no
+    data-dependent shapes).  ``top_k`` must be static (it sizes a
+    slice); ``top_p`` may be a TRACED value (per-request nucleus without
+    recompiling), applied as a no-op when >= 1.  One shared descending
+    sort serves both truncations."""
+    logits = logits.astype(jnp.float32) / jnp.maximum(temperature, 1e-6)
+    static_top_p = isinstance(top_p, (int, float))
+    if (top_k and top_k > 0) or not static_top_p or top_p < 1.0:
+        sorted_desc = jnp.sort(logits, axis=-1)[:, ::-1]
+        if top_k and top_k > 0:
+            kth = sorted_desc[:, top_k - 1][:, None]
+            logits = jnp.where(logits < kth, -1e30, logits)
+            sorted_desc = jnp.where(
+                jnp.arange(sorted_desc.shape[-1])[None, :] < top_k,
+                sorted_desc, -1e30)
+        if not static_top_p or top_p < 1.0:
+            probs = jax.nn.softmax(sorted_desc, axis=-1)
+            cumulative = jnp.cumsum(probs, axis=-1)
+            # Keep the minimal prefix with cumulative mass >= top_p
+            # (the best token is always kept).
+            cutoff_mask = cumulative - probs >= top_p
+            # Cutoff = smallest KEPT logit (drop candidates -> +inf so
+            # the min ranges over the nucleus only).
+            cutoff = jnp.where(cutoff_mask, jnp.inf,
+                               sorted_desc).min(axis=-1, keepdims=True)
+            logits = jnp.where(logits < cutoff, -1e30, logits)
+    return jax.random.categorical(key, logits).astype(jnp.int32)
+
+
 @functools.partial(jax.jit,
-                   static_argnames=("config", "num_steps", "temperature"),
+                   static_argnames=("config", "num_steps", "temperature",
+                                    "top_k"),
                    donate_argnames=("cache",))
 def generate_tokens(params, first_token, cache, start_index, num_steps,
                     config: LlamaConfig, temperature: float = 0.0,
-                    rng_key=None):
+                    rng_key=None, top_k: int = 0, top_p: float = 1.0):
     """Greedy (or sampled) decode of ``num_steps`` tokens as ONE compiled
     program (``lax.scan`` over steps) — a single device dispatch instead
     of one per token, which matters both for dispatch overhead and for
@@ -493,8 +528,8 @@ def generate_tokens(params, first_token, cache, start_index, num_steps,
         logits = logits[:, -1]
         if temperature and temperature > 0:
             key, sample_key = jax.random.split(key)
-            next_token = jax.random.categorical(
-                sample_key, logits / temperature).astype(jnp.int32)
+            next_token = sample_logits(logits, sample_key, temperature,
+                                       top_k=top_k, top_p=top_p)
         else:
             next_token = logits.argmax(-1).astype(jnp.int32)
         next_token = next_token[:, None]

@@ -195,3 +195,23 @@ def test_speech_to_chat_pipeline(engine, wav_file):
     _, _, outputs = out.get()
     tokens_out = np.asarray(outputs["tokens_out"])
     assert tokens_out.shape[1] == 7 + 4    # ASR tokens (7) + 4 generated
+
+
+def test_asr_cached_decode_matches_uncached():
+    """KV-cached greedy decode == full-recompute decode: exactly in f32;
+    in bf16 up to rounding-tie tokens (logit gaps within bf16 noise)."""
+    import dataclasses
+    import jax.numpy as jnp
+    from aiko_services_tpu.models import asr
+
+    audio = (np.random.default_rng(3).standard_normal((2, 8000))
+             * 0.1).astype(np.float32)
+    config = dataclasses.replace(asr.CONFIGS["tiny"], dtype=jnp.float32)
+    params = asr.init_params(config, jax.random.PRNGKey(4))
+    mel = asr.log_mel_spectrogram(audio, config.n_mels)
+    feats = asr.encode(params, mel, config)
+    a = np.asarray(asr.decode_greedy(params, feats, config,
+                                     max_tokens=12))
+    b = np.asarray(asr.decode_greedy_cached(params, feats, config,
+                                            max_tokens=12))
+    np.testing.assert_array_equal(a, b)

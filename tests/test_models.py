@@ -536,3 +536,39 @@ def test_pipeline_parallel_training_grads_match():
         pp_g["embed"].astype(jnp.float32)
         - plain_g["embed"].astype(jnp.float32))))
     assert err_embed < 0.2, err_embed
+
+
+def test_sample_logits_top_k_top_p():
+    """top_k keeps only the k best ids; top_p keeps the minimal nucleus
+    (always including the best id); temperature→0 approaches argmax."""
+    from aiko_services_tpu.models.llama import sample_logits
+    logits = jnp.log(jnp.asarray([[0.5, 0.3, 0.15, 0.05]]))
+    keys = jax.random.split(jax.random.PRNGKey(0), 200)
+    # top_k=2: only ids 0/1 ever sampled.
+    samples = {int(sample_logits(logits, key, 1.0, top_k=2)[0])
+               for key in keys[:100]}
+    assert samples <= {0, 1} and 0 in samples
+    # top_p=0.6: nucleus {0.5, 0.3} -> ids 0/1.
+    samples = {int(sample_logits(logits, key, 1.0, top_p=0.6)[0])
+               for key in keys[100:]}
+    assert samples <= {0, 1} and 0 in samples
+    # Tiny temperature: effectively argmax.
+    assert int(sample_logits(logits, keys[0], 1e-4)[0]) == 0
+    # top_p very small: still returns the single best id.
+    assert int(sample_logits(logits, keys[1], 1.0, top_p=0.01)[0]) == 0
+
+
+def test_generate_tokens_sampled_with_truncation():
+    config = llama.CONFIGS["tiny"]
+    params = llama.init_params(config, jax.random.PRNGKey(60))
+    tokens = jnp.ones((2, 8), jnp.int32)
+    cache = llama.init_cache(config, 2, 32)
+    logits, cache = llama.prefill(params, tokens, cache, config)
+    first = logits[:, -1].argmax(-1).astype(jnp.int32)[:, None]
+    out, _ = llama.generate_tokens(
+        params, first, cache, jnp.int32(8), 6, config,
+        temperature=0.8, rng_key=jax.random.PRNGKey(61), top_k=40,
+        top_p=0.95)
+    assert out.shape == (2, 6)
+    assert bool((out >= 0).all()) and bool(
+        (out < config.vocab_size).all())
