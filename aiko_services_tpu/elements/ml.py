@@ -160,7 +160,10 @@ class LlamaChatElement(PipelineElement):
         seed, _ = self.get_parameter("sample_seed", 0, stream=stream)
         top_k, _ = self.get_parameter("top_k", 0, stream=stream)
         top_p, _ = self.get_parameter("top_p", 1.0, stream=stream)
-        top_k, top_p = int(top_k), float(top_p)
+        top_k = int(top_k)
+        # top_p >= 1 must stay a trace-time None (a traced 1.0 would
+        # force the nucleus sort into every decode step).
+        top_p = float(top_p) if float(top_p) < 1.0 else None
         rng_key = jax.random.PRNGKey(int(seed))
         cache = llama_model.init_cache(self.config, batch, max_seq)
         logits, cache = llama_model.prefill(self.params, tokens, cache,

@@ -473,17 +473,19 @@ def decode_chunk_ragged(params, tokens, cache, positions, active,
 
 
 def sample_logits(logits, key, temperature: float = 1.0,
-                  top_k: int = 0, top_p: float = 1.0):
+                  top_k: int = 0, top_p=None):
     """Sample token ids from ``logits (batch, vocab)`` with the standard
     serving controls: temperature scaling, top-k truncation, and
     nucleus (top-p) truncation — jit-compatible (static vocab sort, no
     data-dependent shapes).  ``top_k`` must be static (it sizes a
-    slice); ``top_p`` may be a TRACED value (per-request nucleus without
-    recompiling), applied as a no-op when >= 1.  One shared descending
-    sort serves both truncations."""
+    slice).  ``top_p=None`` (or a static value >= 1) compiles the
+    nucleus out entirely; a float < 1 or a TRACED value applies it
+    (per-request nucleus without recompiling).  One shared descending
+    sort serves both truncations; the best token is always kept."""
     logits = logits.astype(jnp.float32) / jnp.maximum(temperature, 1e-6)
-    static_top_p = isinstance(top_p, (int, float))
-    if (top_k and top_k > 0) or not static_top_p or top_p < 1.0:
+    if isinstance(top_p, (int, float)) and top_p >= 1.0:
+        top_p = None                 # trace-time no-op, not a tracer
+    if (top_k and top_k > 0) or top_p is not None:
         sorted_desc = jnp.sort(logits, axis=-1)[:, ::-1]
         if top_k and top_k > 0:
             kth = sorted_desc[:, top_k - 1][:, None]
@@ -491,12 +493,14 @@ def sample_logits(logits, key, temperature: float = 1.0,
             sorted_desc = jnp.where(
                 jnp.arange(sorted_desc.shape[-1])[None, :] < top_k,
                 sorted_desc, -1e30)
-        if not static_top_p or top_p < 1.0:
+        if top_p is not None:
             probs = jax.nn.softmax(sorted_desc, axis=-1)
             cumulative = jnp.cumsum(probs, axis=-1)
-            # Keep the minimal prefix with cumulative mass >= top_p
-            # (the best token is always kept).
-            cutoff_mask = cumulative - probs >= top_p
+            # Keep the minimal prefix with cumulative mass >= top_p;
+            # rank 0 is force-kept so top_p <= 0 degrades to argmax
+            # instead of masking every token (uniform garbage).
+            cutoff_mask = (cumulative - probs >= top_p) & (
+                jnp.arange(sorted_desc.shape[-1])[None, :] > 0)
             # Cutoff = smallest KEPT logit (drop candidates -> +inf so
             # the min ranges over the nucleus only).
             cutoff = jnp.where(cutoff_mask, jnp.inf,
@@ -511,7 +515,7 @@ def sample_logits(logits, key, temperature: float = 1.0,
                    donate_argnames=("cache",))
 def generate_tokens(params, first_token, cache, start_index, num_steps,
                     config: LlamaConfig, temperature: float = 0.0,
-                    rng_key=None, top_k: int = 0, top_p: float = 1.0):
+                    rng_key=None, top_k: int = 0, top_p=None):
     """Greedy (or sampled) decode of ``num_steps`` tokens as ONE compiled
     program (``lax.scan`` over steps) — a single device dispatch instead
     of one per token, which matters both for dispatch overhead and for

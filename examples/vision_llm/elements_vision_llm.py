@@ -1,0 +1,57 @@
+"""Vision-LLM fan-out graph elements (BASELINE.json config 5).
+
+The reference has no vision-LLM composition at all; the closest is the
+robot-command PE_LLM chain (reference examples/llm/elements_llm.py).
+Here one image fans out to TWO model branches — a CLIP-class encoder
+(global embedding) and a YOLO-class detector (boxes/scores) — and the
+branches fan IN to a prompt builder that conditions a Llama chat
+element.  On real hardware the chat stage runs llama3_70b with TP=8
+(``llama.param_specs`` over a tp mesh; see
+tests/test_models.py::test_llama3_70b_tp8_sharding_consistent); the
+example runs the tiny configs so it executes anywhere.
+
+Graph shape (fan-out + fan-in through distinct output names):
+
+    ImageNormalize ─┬─ VisionEncoderElement ── embedding ─┐
+                    └─ DetectorElement ────── scores ─────┴─ PromptBuilder ── LlamaChatElement
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from aiko_services_tpu.pipeline.element import PipelineElement
+from aiko_services_tpu.pipeline.stream import StreamEvent
+
+
+class PromptBuilder(PipelineElement):
+    """Fuses the vision branches into a token prompt.
+
+    Toy-but-honest tokenization: the embedding is vector-quantized into
+    ``n_visual_tokens`` ids and the top-scoring detection class ids are
+    appended — the standard "visual tokens + tool outputs" prompt shape,
+    without requiring a real tokenizer in the image."""
+
+    def process_frame(self, stream, embedding, scores, classes):
+        vocab, _ = self.get_parameter("vocab_size", 1024, stream=stream)
+        n_visual, _ = self.get_parameter("n_visual_tokens", 8,
+                                         stream=stream)
+        vocab, n_visual = int(vocab), int(n_visual)
+        embedding = np.asarray(embedding, np.float32)
+        if embedding.ndim > 1 and embedding.shape[0] != 1:
+            # Flattening across batch would interleave samples; the
+            # prompt contract is one image per frame.
+            self.logger.error("%s: PromptBuilder is batch-1 (got %s)",
+                              self.my_id(stream), embedding.shape)
+            return StreamEvent.ERROR, {}
+        embedding = embedding.reshape(-1)
+        # Vector-quantize: bucket each leading component into vocab ids.
+        lo, hi = embedding.min(), embedding.max()
+        span = max(float(hi - lo), 1e-6)
+        visual = ((embedding[:n_visual] - lo) / span
+                  * (vocab - 2)).astype(np.int32) + 1
+        classes = np.asarray(classes, np.int32).reshape(-1)
+        scores = np.asarray(scores, np.float32).reshape(-1)
+        top = classes[np.argsort(-scores)[:4]] % (vocab - 1) + 1
+        tokens = np.concatenate([visual, top]).astype(np.int32)[None, :]
+        return StreamEvent.OKAY, {"tokens": tokens}

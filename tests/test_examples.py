@@ -180,3 +180,20 @@ def test_gstreamer_cv2_fallback(tmp_path):
     ok, frame = reader.read()
     reader.release()
     assert ok and frame.shape == (32, 32, 3)
+
+
+def test_vision_llm_fanout_pipeline(engine):
+    """BASELINE config 5 shape: image fans out to CLIP-class encoder +
+    detector, fans in to a prompt builder conditioning the chat stage
+    (tiny configs; llama3_70b TP=8 shardings validated separately)."""
+    definition = load_definition(
+        "examples/vision_llm/pipeline_vision_llm.json")
+    pipeline = make_pipeline(engine, definition, broker="visionllm")
+    image = np.random.default_rng(0).integers(
+        0, 255, (1, 32, 32, 3)).astype(np.uint8)
+    outputs = run_one(engine, pipeline, {"image": image})
+    assert len(outputs) == 1, outputs
+    tokens_out = np.asarray(outputs[0]["tokens_out"])
+    # 8 visual tokens + 4 detection tokens + 4 generated.
+    assert tokens_out.shape == (1, 12 + 4)
+    assert (tokens_out >= 0).all()
