@@ -28,14 +28,51 @@ def cross_entropy(logits, targets):
     return -jnp.mean(picked)
 
 
-def make_train_step(config: llama.LlamaConfig, optimizer):
+def make_train_step(config: llama.LlamaConfig, optimizer,
+                    accum_steps: int = 1, remat: bool = False):
+    """Build the jittable training step.
+
+    ``accum_steps > 1``: gradient accumulation — the batch is split into
+    ``accum_steps`` microbatches scanned sequentially, grads averaged
+    before ONE optimizer update (exactly the full-batch mean-loss grads,
+    tested); peak activation memory drops by ~accum_steps at the same
+    effective batch.  ``remat=True``: rematerialize the forward under
+    autodiff (``jax.checkpoint``) — activations are recomputed in the
+    backward instead of stored, trading ~33% more FLOPs for O(layers)
+    less live memory (the standard large-model training trade on HBM).
+    """
     def loss_fn(params, tokens):
-        logits = llama.forward(params, tokens[:, :-1], config,
-                               use_flash=False)
+        forward = llama.forward
+        if remat:
+            forward = jax.checkpoint(
+                forward, static_argnums=(2, 3))
+        logits = forward(params, tokens[:, :-1], config, False)
         return cross_entropy(logits, tokens[:, 1:])
 
     def train_step(params, opt_state, tokens):
-        loss, grads = jax.value_and_grad(loss_fn)(params, tokens)
+        if accum_steps == 1:
+            loss, grads = jax.value_and_grad(loss_fn)(params, tokens)
+        else:
+            batch = tokens.shape[0]
+            assert batch % accum_steps == 0, (batch, accum_steps)
+            micro = tokens.reshape(accum_steps, batch // accum_steps,
+                                   tokens.shape[1])
+
+            def accumulate(carry, micro_tokens):
+                loss_sum, grad_sum = carry
+                loss, grads = jax.value_and_grad(loss_fn)(params,
+                                                          micro_tokens)
+                grad_sum = jax.tree.map(jnp.add, grad_sum, grads)
+                return (loss_sum + loss, grad_sum), None
+
+            zeros = jax.tree.map(
+                lambda leaf: jnp.zeros(leaf.shape, jnp.float32), params)
+            (loss_sum, grad_sum), _ = jax.lax.scan(
+                accumulate, (jnp.float32(0.0), zeros), micro)
+            loss = loss_sum / accum_steps
+            grads = jax.tree.map(
+                lambda g, p: (g / accum_steps).astype(p.dtype),
+                grad_sum, params)
         updates, opt_state = optimizer.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         return params, opt_state, loss

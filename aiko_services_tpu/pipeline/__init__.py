@@ -8,3 +8,4 @@ from .definition import (
 from .codec import encode_swag, decode_swag, encode_value, decode_value
 from .element import PipelineElement
 from .pipeline import Pipeline, PipelineRemote, DEFAULT_GRACE_TIME
+from .prefetch import DevicePrefetcher

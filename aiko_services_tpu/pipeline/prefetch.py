@@ -1,0 +1,120 @@
+"""Host→device input prefetch: overlap uploads with device compute.
+
+The reference's input pipeline is ``create_frames`` — a generator thread
+posting frames into the event mailbox with backpressure (reference
+main/pipeline.py:383-444).  Its TPU analog (SURVEY.md §2.6) adds the
+missing half: the HOST→DEVICE copy.  A training/serving step that calls
+``device_put`` inline serializes upload behind compute; this prefetcher
+keeps ``depth`` batches in flight on a background thread so the copy of
+batch N+1 rides under the compute of batch N (the classic
+double-buffering pattern; ``depth=2`` is usually enough because uploads
+are DMA, not device cycles).
+
+    for batch in DevicePrefetcher(host_batches(), depth=2):
+        params, opt_state, loss = train_step(params, opt_state, batch)
+
+Backpressure is structural: the bounded queue blocks the feeder thread,
+so an unboundedly fast generator cannot fill HBM with staged batches
+(the reference's mailbox-≥32 heuristic, made exact).
+
+``sharding`` places each batch directly into its distributed layout
+(``jax.device_put`` with a NamedSharding) — the feed path for dp-sharded
+training steps.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, Callable, Iterable, Iterator, Optional
+
+__all__ = ["DevicePrefetcher"]
+
+_END = object()
+
+
+class DevicePrefetcher:
+    """Iterate device-resident batches from a host-batch iterable."""
+
+    def __init__(self, source: Iterable, depth: int = 2,
+                 sharding: Optional[Any] = None,
+                 transfer: Optional[Callable[[Any], Any]] = None):
+        if depth < 1:
+            raise ValueError("depth must be >= 1")
+        self._queue: "queue.Queue" = queue.Queue(maxsize=depth)
+        self._error: Optional[BaseException] = None
+        self._stop = threading.Event()
+        self._done = False
+
+        if transfer is None:
+            import jax
+
+            def transfer(batch):
+                return jax.device_put(batch, sharding)
+
+        def put_with_stop(item) -> bool:
+            """Bounded put that gives up when the consumer closed —
+            otherwise a full queue strands this thread forever (and
+            pins the staged device buffer)."""
+            while not self._stop.is_set():
+                try:
+                    self._queue.put(item, timeout=0.1)
+                    return True
+                except queue.Full:
+                    continue
+            return False
+
+        def feed():
+            try:
+                for item in source:
+                    if self._stop.is_set():
+                        return
+                    staged = transfer(item)   # async dispatch; the
+                    # bounded queue (not the copy) provides backpressure
+                    if not put_with_stop(staged):
+                        return
+            except BaseException as error:  # noqa: BLE001 - reraised
+                self._error = error
+            finally:
+                put_with_stop(_END)
+
+        self._thread = threading.Thread(target=feed, daemon=True,
+                                        name="aiko-device-prefetch")
+        self._thread.start()
+
+    def __iter__(self) -> Iterator:
+        return self
+
+    def __next__(self):
+        if self._done:
+            # Repeat next() after exhaustion/close: terminal, not a
+            # forever-block on a queue no one feeds.
+            if self._error is not None:
+                raise self._error
+            raise StopIteration
+        item = self._queue.get()
+        if item is _END:
+            self._done = True
+            self._thread.join(timeout=5)
+            if self._error is not None:
+                raise self._error
+            raise StopIteration
+        return item
+
+    def close(self):
+        """Stop the feeder and drain; safe to call mid-iteration."""
+        self._stop.set()
+        self._done = True
+        while True:
+            try:
+                self._queue.get_nowait()
+            except queue.Empty:
+                break
+        self._thread.join(timeout=5)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *_exc):
+        self.close()
+        return False

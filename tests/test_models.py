@@ -605,3 +605,52 @@ def test_llama3_70b_tp8_sharding_consistent():
         lambda p, t: llama.forward(p, t, config, use_flash=False),
         params, jax.ShapeDtypeStruct((1, 32), jnp.int32))
     assert out.shape == (1, 32, config.vocab_size)
+
+
+def test_grad_accumulation_matches_full_batch():
+    """accum_steps=4 training step == full-batch step: same loss, same
+    updated params (up to f32-accumulation vs bf16 rounding)."""
+    import optax
+    from aiko_services_tpu.parallel.train import (
+        init_train_state, make_train_step,
+    )
+    config = llama.CONFIGS["tiny"]
+    optimizer = optax.sgd(1e-2)
+    params, opt_state = init_train_state(config, jax.random.PRNGKey(70),
+                                         optimizer)
+    tokens = jax.random.randint(jax.random.PRNGKey(71), (8, 24), 0,
+                                config.vocab_size)
+    full = jax.jit(make_train_step(config, optimizer))
+    accum = jax.jit(make_train_step(config, optimizer, accum_steps=4))
+    p_full, _, loss_full = full(params, opt_state, tokens)
+    p_accum, _, loss_accum = accum(params, opt_state, tokens)
+    assert abs(float(loss_full) - float(loss_accum)) < 5e-3
+    for leaf_full, leaf_accum in zip(jax.tree.leaves(p_full),
+                                     jax.tree.leaves(p_accum)):
+        err = float(jnp.max(jnp.abs(
+            leaf_full.astype(jnp.float32)
+            - leaf_accum.astype(jnp.float32))))
+        assert err < 5e-3, err
+
+
+def test_remat_train_step_matches():
+    """remat=True recomputes activations in the backward; the numbers
+    must not change."""
+    import optax
+    from aiko_services_tpu.parallel.train import (
+        init_train_state, make_train_step,
+    )
+    config = llama.CONFIGS["tiny"]
+    optimizer = optax.sgd(1e-2)
+    params, opt_state = init_train_state(config, jax.random.PRNGKey(72),
+                                         optimizer)
+    tokens = jax.random.randint(jax.random.PRNGKey(73), (4, 16), 0,
+                                config.vocab_size)
+    plain = jax.jit(make_train_step(config, optimizer))
+    remat = jax.jit(make_train_step(config, optimizer, remat=True))
+    p1, _, l1 = plain(params, opt_state, tokens)
+    p2, _, l2 = remat(params, opt_state, tokens)
+    assert abs(float(l1) - float(l2)) < 1e-5
+    for a, b in zip(jax.tree.leaves(p1), jax.tree.leaves(p2)):
+        assert float(jnp.max(jnp.abs(a.astype(jnp.float32)
+                                     - b.astype(jnp.float32)))) < 1e-4

@@ -322,3 +322,73 @@ def test_frames_park_until_all_elements_started(engine):
     assert not pipeline.streams
     engine.terminate()
     thread.join(timeout=5)
+
+
+def test_device_prefetcher_orders_backpressures_and_propagates_errors():
+    """Batches arrive in order as device arrays; the bounded queue
+    blocks a fast producer; a source error surfaces on the consumer
+    side; close() mid-iteration stops the feeder."""
+    import threading
+    import time as _time
+
+    import numpy as np
+
+    from aiko_services_tpu.pipeline.prefetch import DevicePrefetcher
+
+    produced = []
+
+    def source(n=6):
+        for i in range(n):
+            produced.append(i)
+            yield np.full((2, 2), i, np.int32)
+
+    with DevicePrefetcher(source(), depth=2) as prefetcher:
+        got = [int(np.asarray(batch)[0, 0]) for batch in prefetcher]
+    assert got == list(range(6))
+
+    # Backpressure: with depth=2 a fast producer cannot run far ahead
+    # of a slow consumer.
+    produced.clear()
+    prefetcher = DevicePrefetcher(source(50), depth=2)
+    _time.sleep(0.2)
+    assert len(produced) <= 4        # depth + in-flight transfer slack
+    prefetcher.close()
+
+    # Error propagation.
+    def bad_source():
+        yield np.zeros((1,), np.float32)
+        raise RuntimeError("boom")
+
+    prefetcher = DevicePrefetcher(bad_source(), depth=2)
+    next(prefetcher)
+    try:
+        next(prefetcher)
+        raise AssertionError("expected RuntimeError")
+    except RuntimeError as error:
+        assert "boom" in str(error)
+
+
+def test_device_prefetcher_terminal_and_depth1_close():
+    """next() after exhaustion raises StopIteration again (no hang);
+    close() with depth=1 does not strand the feeder thread."""
+    import numpy as np
+    from aiko_services_tpu.pipeline.prefetch import DevicePrefetcher
+
+    prefetcher = DevicePrefetcher(
+        (np.zeros((1,), np.int32) for _ in range(2)), depth=1)
+    assert len(list(prefetcher)) == 2
+    for _ in range(3):
+        try:
+            next(prefetcher)
+            raise AssertionError("expected StopIteration")
+        except StopIteration:
+            pass
+
+    # depth=1: feeder blocked in put when close() runs.
+    prefetcher = DevicePrefetcher(
+        (np.zeros((1,), np.int32) for _ in range(50)), depth=1)
+    import time as _time
+    _time.sleep(0.1)
+    prefetcher.close()
+    prefetcher._thread.join(timeout=2)
+    assert not prefetcher._thread.is_alive()
