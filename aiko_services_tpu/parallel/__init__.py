@@ -1,4 +1,5 @@
-from .mesh import MeshSpec, make_mesh, named_sharding, logical_axis_rules
+from .mesh import (MeshSpec, make_mesh, named_sharding,
+                   logical_axis_rules, filter_specs_for_mesh)
 from .ring_attention import ring_attention, ring_attention_sharded
 from .ulysses import ulysses_attention, ulysses_attention_sharded
 from .collective_matmul import (
@@ -10,3 +11,4 @@ from .pipeline_parallel import (
 )
 from .checkpoint import (TrainCheckpointer, StreamCheckpoint,
                          save_stream_checkpoint, load_stream_checkpoint)
+from .elastic import ElasticTrainer

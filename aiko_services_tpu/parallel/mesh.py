@@ -28,7 +28,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 __all__ = [
     "MeshSpec", "make_mesh", "named_sharding", "shard_batch_spec",
-    "logical_axis_rules", "DEFAULT_AXES",
+    "logical_axis_rules", "filter_specs_for_mesh", "DEFAULT_AXES",
 ]
 
 DEFAULT_AXES = ("dp", "tp")
@@ -98,6 +98,23 @@ def logical_axis_rules(mesh: Mesh) -> Dict[str, Optional[str]]:
         "vocab": "tp" if "tp" in names else None,
         "stage": "pp" if "pp" in names else None,
     }
+
+
+def filter_specs_for_mesh(specs, mesh: Mesh):
+    """Drop spec axes the mesh does not have (e.g. megatron "tp" specs
+    on a dp-only mesh become replicated on that dim) — the same param
+    layout tree then serves every topology."""
+    names = set(mesh.axis_names)
+
+    def fix(spec):
+        if not isinstance(spec, PartitionSpec):
+            return spec
+        return PartitionSpec(*(axis if axis in names else None
+                               for axis in spec))
+
+    return jax.tree.map(
+        fix, specs,
+        is_leaf=lambda x: isinstance(x, PartitionSpec))
 
 
 def mark_varying(x, axis_name):

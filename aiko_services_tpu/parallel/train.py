@@ -19,7 +19,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..models import llama
 
 __all__ = ["make_train_step", "init_train_state", "shard_train_state",
-           "make_pp_train_step", "to_pp_params"]
+           "train_state_specs", "make_pp_train_step", "to_pp_params"]
 
 
 def cross_entropy(logits, targets):
@@ -86,43 +86,54 @@ def init_train_state(config: llama.LlamaConfig, key, optimizer):
     return params, opt_state
 
 
+def train_state_specs(config: llama.LlamaConfig, opt_state,
+                      mesh: Mesh):
+    """(param_specs, opt_specs) for this mesh: the model's TP layout
+    filtered to the mesh's axes; adam moments mirror the param layout;
+    every other optimizer leaf (step counts etc.) replicates."""
+    from .mesh import filter_specs_for_mesh
+    param_specs = filter_specs_for_mesh(llama.param_specs(config), mesh)
+
+    def item_specs(item):
+        if hasattr(item, "_fields"):        # optax NamedTuple state
+            replaced = {}
+            for field in item._fields:
+                if field in ("mu", "nu"):
+                    replaced[field] = param_specs
+                else:
+                    replaced[field] = jax.tree.map(
+                        lambda _: P(), getattr(item, field))
+            return item._replace(**replaced)
+        return jax.tree.map(lambda _: P(), item)
+
+    return param_specs, tuple(item_specs(item) for item in opt_state)
+
+
 def shard_train_state(params, opt_state, mesh: Mesh,
-                      config: llama.LlamaConfig):
-    """Place params (and matching optimizer state leaves) with the
-    model's TP partition specs."""
-    specs = llama.param_specs(config)
+                      config: llama.LlamaConfig, specs=None):
+    """Place params + optimizer state with the model's partition specs
+    (``specs`` = precomputed ``train_state_specs`` result, else derived
+    here).  The single placement implementation — ElasticTrainer and
+    the dryrun both go through it."""
+    if specs is None:
+        specs = train_state_specs(config, opt_state, mesh)
+    param_specs, opt_specs = specs
+
+    def place_leaf(leaf, spec):
+        if hasattr(leaf, "shape"):
+            return jax.device_put(leaf, NamedSharding(mesh, spec))
+        return leaf
 
     def place(tree, tree_specs):
         return jax.tree.map(
-            lambda leaf, spec: jax.device_put(
-                leaf, NamedSharding(mesh, spec)),
-            tree, tree_specs,
-            is_leaf=lambda x: not isinstance(x, (dict, list)))
+            place_leaf, tree, tree_specs,
+            is_leaf=lambda x: isinstance(x, P))
 
-    params = place(params, specs)
-
-    # Re-place adam moments along the params structure when shapes match;
-    # scalar leaves (step counts) are left for pjit to replicate.
-    def place_like_params(opt_tree):
-        if isinstance(opt_tree, (optax.EmptyState, type(None))):
-            return opt_tree
-        try:
-            return jax.tree.map(
-                lambda leaf, spec: jax.device_put(
-                    leaf, NamedSharding(mesh, spec))
-                if hasattr(leaf, "shape") and leaf.ndim > 0 else leaf,
-                opt_tree, specs,
-                is_leaf=lambda x: not isinstance(x, (dict, list)))
-        except (ValueError, TypeError):
-            return opt_tree
-
-    new_opt_state = []
-    for item in opt_state:
-        if hasattr(item, "mu") and hasattr(item, "nu"):
-            item = item._replace(mu=place_like_params(item.mu),
-                                 nu=place_like_params(item.nu))
-        new_opt_state.append(item)
-    return params, tuple(new_opt_state)
+    params = place(params, param_specs)
+    new_opt_state = tuple(place(item, item_spec)
+                          for item, item_spec in zip(opt_state,
+                                                     opt_specs))
+    return params, new_opt_state
 
 
 def make_pp_train_step(config: llama.LlamaConfig, optimizer, mesh: Mesh,

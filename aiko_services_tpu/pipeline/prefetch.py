@@ -105,12 +105,19 @@ class DevicePrefetcher:
         """Stop the feeder and drain; safe to call mid-iteration."""
         self._stop.set()
         self._done = True
-        while True:
-            try:
-                self._queue.get_nowait()
-            except queue.Empty:
-                break
+
+        def drain():
+            while True:
+                try:
+                    self._queue.get_nowait()
+                except queue.Empty:
+                    return
+
+        drain()
         self._thread.join(timeout=5)
+        # The feeder's in-flight put may have landed AFTER the first
+        # drain; drain again so no staged device buffer stays pinned.
+        drain()
 
     def __enter__(self):
         return self

@@ -122,3 +122,39 @@ def registrar_plugin(fields, variables) -> List[str]:
         f"  lifecycle:     {_get(variables, 'lifecycle')}",
         f"  service_count: {_get(variables, 'service_count')}",
     ]
+
+
+def _replica_terminate_action(process, fields, variables):
+    """Operator kill: terminate the replica's process gracefully (its
+    LWT then prunes it from every router)."""
+    process.message.publish(f"{fields.topic_path}/in", "(terminate)")
+
+
+@dashboard_plugin(protocol="model_replica",
+                  actions={"k": ("kill replica",
+                                 _replica_terminate_action)})
+def model_replica_plugin(fields, variables) -> List[str]:
+    """Serving view: request counters for ModelReplica and (when the
+    replica is a ContinuousReplica) live slot occupancy."""
+    lines = [
+        f"ModelReplica: {fields.name}",
+        f"  lifecycle: {_get(variables, 'lifecycle')}",
+        f"  served:    {_get(variables, 'requests_served')}",
+    ]
+    slots = _get(variables, "slots", default=None)
+    if slots not in (None, "-"):
+        lines.append(f"  slots:     {slots} (continuous batching)")
+    return lines
+
+
+@dashboard_plugin(protocol="profiler")
+def profiler_plugin(fields, variables) -> List[str]:
+    lines = [
+        f"Profiler: {fields.name}",
+        f"  profiling:  {_get(variables, 'profiling')}",
+        f"  last trace: {_get(variables, 'last_trace_dir')}",
+    ]
+    seconds = _get(variables, "last_trace_seconds", default=None)
+    if seconds not in (None, "-"):
+        lines.append(f"  duration:   {seconds}s")
+    return lines

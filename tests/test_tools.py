@@ -213,3 +213,25 @@ def test_profiler_actor_commands(engine, tmp_path):
     # Stop without start: safe no-op.
     process.message.publish(actor.topic_in, generate("profile_stop"))
     engine.advance(0.1)
+
+
+def test_model_replica_and_profiler_plugins():
+    from types import SimpleNamespace
+    from aiko_services_tpu.tools.dashboard_plugins import find_plugin
+
+    fields = SimpleNamespace(name="rep0", protocol="model_replica:0",
+                             topic_path="ns/h/1/0")
+    plugin = find_plugin(fields)
+    assert plugin is not None
+    lines = plugin(fields, {"lifecycle": "ready", "requests_served": 7,
+                            "slots": 4})
+    text = "\n".join(lines)
+    assert "served:    7" in text and "slots:     4" in text
+
+    fields = SimpleNamespace(name="prof0", protocol="profiler:0",
+                             topic_path="ns/h/1/1")
+    plugin = find_plugin(fields)
+    lines = plugin(fields, {"profiling": False,
+                            "last_trace_dir": "/tmp/t",
+                            "last_trace_seconds": 1.5})
+    assert any("1.5s" in line for line in lines)
