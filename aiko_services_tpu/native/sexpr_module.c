@@ -544,6 +544,62 @@ py_generate_expression(PyObject *self, PyObject *args)
 
 /* ------------------------------------------------------------------ */
 
+/* ------------------------------------------------------------------ */
+/* MQTT topic matching: '+' one level, '#' (final) any remainder.
+ * Mirrors transport/message.py topic_matcher (per-message x
+ * per-subscription hot path in the process runtime and broker). */
+
+static PyObject *
+py_topic_matches(PyObject *self, PyObject *args)
+{
+    const char *pattern, *topic;
+    Py_ssize_t plen, tlen;
+    if (!PyArg_ParseTuple(args, "s#s#", &pattern, &plen, &topic, &tlen))
+        return NULL;
+    /* Exact-equality shortcut FIRST (mirrors the Python matcher): a
+     * literally-identical topic matches even when the pattern contains
+     * a misplaced '#'. */
+    if (plen == tlen && memcmp(pattern, topic, plen) == 0)
+        Py_RETURN_TRUE;
+    const char *p = pattern, *pe = pattern + plen;
+    const char *t = topic, *te = topic + tlen;
+    for (;;) {
+        /* Current pattern level: [p, pl) */
+        const char *pl = memchr(p, '/', pe - p);
+        if (!pl) pl = pe;
+        if (pl - p == 1 && *p == '#') {
+            /* '#' must be the final level. */
+            if (pl == pe) Py_RETURN_TRUE;
+            Py_RETURN_FALSE;
+        }
+        /* Current topic level: [t, tl) — t may be exhausted. */
+        if (t > te)
+            Py_RETURN_FALSE;
+        const char *tl = memchr(t, '/', te - t);
+        if (!tl) tl = te;
+        if (!(pl - p == 1 && *p == '+')) {
+            if ((pl - p) != (tl - t) || memcmp(p, t, pl - p) != 0)
+                Py_RETURN_FALSE;
+        }
+        /* Advance; past-the-end sentinel signals exhaustion. */
+        int p_done = (pl == pe), t_done = (tl == te);
+        if (p_done && t_done) Py_RETURN_TRUE;
+        if (p_done || t_done) {
+            /* One ended; the other has more levels -> no match unless
+             * the next pattern level is a lone '#'. */
+            if (p_done) Py_RETURN_FALSE;
+            p = pl + 1;
+            const char *pl2 = memchr(p, '/', pe - p);
+            if (!pl2) pl2 = pe;
+            if (pl2 - p == 1 && *p == '#' && pl2 == pe)
+                Py_RETURN_TRUE;
+            Py_RETURN_FALSE;
+        }
+        p = pl + 1;
+        t = tl + 1;
+    }
+}
+
 static PyObject *
 py_set_keyword_class(PyObject *self, PyObject *arg)
 {
@@ -566,6 +622,8 @@ static PyMethodDef methods[] = {
      "Parse an S-expression payload into its tree."},
     {"generate_expression", py_generate_expression, METH_VARARGS,
      "Serialize a nested list into an S-expression string."},
+    {"topic_matches", py_topic_matches, METH_VARARGS,
+     "MQTT topic match with + and # wildcards."},
     {"set_keyword_class", py_set_keyword_class, METH_O,
      "Install the _Keyword marker class."},
     {"set_error_class", py_set_error_class, METH_O,

@@ -19,9 +19,34 @@ from typing import Callable, Iterable, Optional, Union
 __all__ = ["Message", "NullMessage", "topic_matcher"]
 
 
+_NATIVE_MATCH = None     # loaded lazily; False = unavailable
+
+
 def topic_matcher(pattern: str, topic: str) -> bool:
     """MQTT topic matching with ``+`` and ``#`` wildcards
-    (reference: ``main/process.py:344-360``)."""
+    (reference: ``main/process.py:344-360``).  Dispatches to the C
+    implementation when available (per-message x per-subscription hot
+    path); ``_topic_matcher_py`` below is the semantic definition."""
+    global _NATIVE_MATCH
+    if _NATIVE_MATCH is None:
+        try:
+            from ..native import sexpr_native
+            module = sexpr_native()
+            _NATIVE_MATCH = (module.topic_matches
+                             if module is not None
+                             and hasattr(module, "topic_matches")
+                             else False)
+        except Exception:  # noqa: BLE001 - never break matching
+            _NATIVE_MATCH = False
+    if _NATIVE_MATCH:
+        try:
+            return _NATIVE_MATCH(pattern, topic)
+        except Exception:  # noqa: BLE001 - e.g. surrogates fail UTF-8
+            return _topic_matcher_py(pattern, topic)
+    return _topic_matcher_py(pattern, topic)
+
+
+def _topic_matcher_py(pattern: str, topic: str) -> bool:
     if pattern == topic:
         return True
     p_levels = pattern.split("/")

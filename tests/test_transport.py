@@ -82,3 +82,32 @@ def test_null_message_is_silent():
     null.publish("t", "x")
     null.subscribe("t")
     assert not null.connected
+
+
+def test_native_topic_matcher_differential():
+    """C topic matcher == Python matcher over the full semantic matrix
+    (wildcards, level counts, empty levels, '#' placement)."""
+    from aiko_services_tpu.transport.message import (
+        _topic_matcher_py, topic_matcher,
+    )
+    from aiko_services_tpu.native import sexpr_native
+    native = sexpr_native()
+    if native is None or not hasattr(native, "topic_matches"):
+        import pytest
+        pytest.skip("native matcher unavailable")
+    patterns = ["a/b/c", "a/+/c", "+/+/+", "a/#", "#", "a/b", "+",
+                "a//b", "a/+", "a/b/#", "x", "", "+/#", "a/#/b",
+                "#/a"]
+    topics = ["a/b/c", "a/x/c", "a/b", "a", "a/b/c/d", "x", "",
+              "a//b", "a/", "b/c", "a/#/b", "#/a", "a/#"]
+    for pattern in patterns:
+        for topic in topics:
+            assert (native.topic_matches(pattern, topic)
+                    == _topic_matcher_py(pattern, topic)), (pattern,
+                                                            topic)
+            assert (topic_matcher(pattern, topic)
+                    == _topic_matcher_py(pattern, topic))
+    # Surrogates cannot UTF-8-encode; the wrapper must fall back, not
+    # raise (the matcher is documented to never break matching).
+    assert topic_matcher("\ud800", "\ud800") is True
+    assert topic_matcher("\ud800", "x") is False
