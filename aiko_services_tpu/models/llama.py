@@ -442,34 +442,65 @@ def _decode_core_ragged(params, token, cache, positions,
                    static_argnames=("config", "num_steps"),
                    donate_argnames=("cache",))
 def decode_chunk_ragged(params, tokens, cache, positions, active,
-                        num_steps, config: LlamaConfig):
-    """Greedy-decode ``num_steps`` tokens for a slot batch where each
-    row has its own position and an ``active`` flag — ONE compiled scan
-    (the continuous-batching inner loop; admission happens between
-    chunks).  Inactive rows still flow through the math but their cache
-    writes land at position ``max_seq-1`` reserved as scratch and their
+                        num_steps, config: LlamaConfig,
+                        temperatures=None, top_ps=None, rng_key=None):
+    """Decode ``num_steps`` tokens for a slot batch where each row has
+    its own position and an ``active`` flag — ONE compiled scan (the
+    continuous-batching inner loop; admission happens between chunks).
+    Inactive rows still flow through the math but their cache writes
+    land at position ``max_seq-1`` reserved as scratch and their
     position does not advance.
+
+    Per-slot sampling: ``temperatures``/``top_ps`` are (batch,) vectors
+    — a row with temperature 0 stays EXACTLY greedy while its
+    neighbors sample (mixed batches; tested).  ``None`` (trace-time)
+    compiles the pure-greedy program with no sampling math.
 
     Returns (tokens_out (batch, num_steps), last_token (batch, 1),
     positions (batch,), cache).
     """
     max_seq = cache[0]["k"].shape[1]
+    sampled_mode = temperatures is not None
+    if rng_key is None:
+        rng_key = jax.random.PRNGKey(0)
+    if sampled_mode and top_ps is None:
+        top_ps = jnp.ones_like(temperatures)
+
+    def pick(logits, key):
+        greedy = logits.argmax(-1).astype(jnp.int32)
+        if not sampled_mode:
+            return greedy
+        sampled = _sample_logits_per_row(logits, key, temperatures,
+                                         top_ps)
+        return jnp.where(temperatures > 0, sampled, greedy)
 
     def body(carry, _):
-        token, positions, cache = carry
+        token, positions, cache, key = carry
+        key, step_key = jax.random.split(key)
         # Inactive slots write into the scratch row so they cannot
         # corrupt a live slot's KV prefix.
         write_pos = jnp.where(active, positions, max_seq - 1)
         logits, cache = _decode_core_ragged(params, token, cache,
                                             write_pos, config)
-        next_token = logits[:, -1].argmax(-1).astype(jnp.int32)[:, None]
+        next_token = pick(logits[:, -1], step_key)[:, None]
         next_token = jnp.where(active[:, None], next_token, token)
         positions = jnp.where(active, positions + 1, positions)
-        return (next_token, positions, cache), next_token[:, 0]
+        return (next_token, positions, cache, key), next_token[:, 0]
 
-    (token, positions, cache), tokens_out = jax.lax.scan(
-        body, (tokens, positions, cache), None, length=num_steps)
+    (token, positions, cache, _), tokens_out = jax.lax.scan(
+        body, (tokens, positions, cache, rng_key), None,
+        length=num_steps)
     return tokens_out.T, token, positions, cache
+
+
+def _sample_logits_per_row(logits, key, temperatures, top_ps):
+    """Per-row temperature + nucleus: :func:`sample_logits` broadcasts
+    (B, 1)-shaped controls, so the vector case is the SAME
+    implementation (``top_p >= 1`` rows are a numeric no-op; the best
+    token is always kept)."""
+    return sample_logits(logits, key,
+                         temperature=temperatures[:, None],
+                         top_p=top_ps[:, None])
 
 
 def sample_logits(logits, key, temperature: float = 1.0,

@@ -61,6 +61,9 @@ class DecodeRequest:
     prompt: "np.ndarray"           # (prompt_len,) int32
     max_new_tokens: int
     response_topic: Optional[str] = None
+    #: 0 = greedy (exact, default); > 0 samples with optional nucleus.
+    temperature: float = 0.0
+    top_p: float = 1.0
     # Filled by the server:
     tokens: Optional[List[int]] = None
     error: Optional[str] = None
@@ -103,6 +106,10 @@ class ContinuousBatchingServer:
         self.positions = jnp.zeros((slots,), jnp.int32)
         self.active = jnp.zeros((slots,), bool)
         self.tokens = jnp.zeros((slots, 1), jnp.int32)
+        self._temperatures = np.zeros(slots, np.float32)
+        self._top_ps = np.ones(slots, np.float32)
+        self._rng = jax.random.PRNGKey(seed)
+        self._any_sampled = False
         self._requests: List[Optional[DecodeRequest]] = [None] * slots
         self._emitted = np.zeros(slots, np.int64)  # tokens emitted so far
         self._queue: List[DecodeRequest] = []
@@ -168,8 +175,11 @@ class ContinuousBatchingServer:
                 int(prompt[0, -1]))
             self.positions = self.positions.at[slot].set(prompt_len - 1)
             self.active = self.active.at[slot].set(True)
+            self._temperatures[slot] = max(0.0, float(request.temperature))
+            self._top_ps[slot] = float(request.top_p)
             self._requests[slot] = request
             self._emitted[slot] = 0
+        self._any_sampled = bool((self._temperatures > 0).any())
 
     def _retire(self, slot: int) -> None:
         request = self._requests[slot]
@@ -177,6 +187,11 @@ class ContinuousBatchingServer:
             self.completed.append(request)
         self._requests[slot] = None
         self.active = self.active.at[slot].set(False)
+        # Reset sampling state so an all-greedy batch returns to the
+        # pure-greedy compiled program (no sort/softmax per step).
+        self._temperatures[slot] = 0.0
+        self._top_ps[slot] = 1.0
+        self._any_sampled = bool((self._temperatures > 0).any())
 
     def step(self) -> List[DecodeRequest]:
         """Admit pending requests, decode one chunk, retire finished
@@ -188,10 +203,20 @@ class ContinuousBatchingServer:
                          for s in range(self.slots)
                          if self._requests[s] is not None]
             steps = int(max(1, min(self.chunk_steps, max(remaining))))
+            if self._any_sampled:
+                jnp = self._jnp
+                self._rng, chunk_key = self._jax.random.split(self._rng)
+                sampling = dict(
+                    temperatures=jnp.asarray(self._temperatures),
+                    top_ps=jnp.asarray(self._top_ps),
+                    rng_key=chunk_key)
+            else:
+                sampling = {}          # pure-greedy compiled program
             out, self.tokens, self.positions, self.cache = \
                 self._llama.decode_chunk_ragged(
                     self.params, self.tokens, self.cache,
-                    self.positions, self.active, steps, self.config)
+                    self.positions, self.active, steps, self.config,
+                    **sampling)
             out_host = np.asarray(out)           # (slots, steps)
             for slot in range(self.slots):
                 request = self._requests[slot]
@@ -252,6 +277,9 @@ class ContinuousReplica(Actor):
                                         np.int32).reshape(-1)
             request.max_new_tokens = int(
                 np.asarray(inputs.get("max_new_tokens", 16)))
+            request.temperature = float(
+                np.asarray(inputs.get("temperature", 0.0)))
+            request.top_p = float(np.asarray(inputs.get("top_p", 1.0)))
         except Exception:  # noqa: BLE001 - bad request must still respond
             self.logger.exception("%s: malformed infer request %s",
                                   self.name, request_id)

@@ -133,3 +133,23 @@ def test_continuous_replica_wire_protocol(engine):
     want = reference_greedy(server, prompt, 5)
     assert list(outputs["tokens_out"]) == want
     assert not replica._pumping       # pump deregistered when drained
+
+
+def test_mixed_greedy_and_sampled_slots():
+    """A sampled request sharing the batch must not perturb a greedy
+    request's output (greedy rows stay exactly equal to the oracle)."""
+    server = ContinuousBatchingServer(config_name="tiny", slots=2,
+                                      max_seq=96, chunk_steps=4, seed=8)
+    rng = np.random.default_rng(9)
+    greedy = DecodeRequest("g", rng.integers(1, 500, 10)
+                           .astype(np.int32), 8)
+    sampled = DecodeRequest("s", rng.integers(1, 500, 7)
+                            .astype(np.int32), 8,
+                            temperature=1.0, top_p=0.9)
+    server.submit(greedy)
+    server.submit(sampled)
+    server.run_until_drained()
+    assert greedy.tokens == reference_greedy(server, greedy.prompt, 8)
+    assert len(sampled.tokens) == 8
+    assert all(0 <= t < server.config.vocab_size
+               for t in sampled.tokens)
