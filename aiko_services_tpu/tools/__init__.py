@@ -1,3 +1,4 @@
 from .recorder import Recorder
 from .storage import Storage
 from .profiler import ProfilerActor, ProfilerMixin
+from .loadgen import LoadGenerator, LoadReport

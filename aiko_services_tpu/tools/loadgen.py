@@ -1,0 +1,154 @@
+"""Serving load generator: drive replicas over the wire, report tails.
+
+The reference's only load harness is multitude (pipelines at a fixed
+frame rate, ``examples/pipeline/multitude``); the serving stack
+(ModelReplica / ContinuousReplica / ReplicaRouter) needs its own:
+open-loop request injection at a target rate with latency tails, the
+standard way to expose queueing behavior that a closed loop hides.
+
+    generator = LoadGenerator(process, target_topic="ns/h/1/0/in",
+                              payload_fn=make_payload, rate_hz=50)
+    report = generator.run(n_requests=500)
+    report.p50_ms, report.p99_ms, report.throughput_rps, report.errors
+
+Open-loop: requests are posted on schedule regardless of completions
+(late responses still count; missing ones surface as ``timeouts``).
+Works over any transport the process speaks (loopback in tests, the
+built-in MQTT broker cross-process).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import statistics
+import time
+import uuid
+from typing import Callable, Dict, List, Optional
+
+from ..pipeline.codec import encode_swag
+from ..utils.sexpr import generate, parse
+
+__all__ = ["LoadGenerator", "LoadReport"]
+
+
+@dataclasses.dataclass
+class LoadReport:
+    sent: int
+    completed: int
+    errors: int
+    timeouts: int
+    elapsed_s: float
+    latencies_ms: List[float]
+
+    @property
+    def throughput_rps(self) -> float:
+        return self.completed / self.elapsed_s if self.elapsed_s else 0.0
+
+    def _quantile(self, q: float) -> float:
+        if not self.latencies_ms:
+            return 0.0
+        ordered = sorted(self.latencies_ms)
+        index = min(len(ordered) - 1, int(q * len(ordered)))
+        return ordered[index]
+
+    @property
+    def p50_ms(self) -> float:
+        return (statistics.median(self.latencies_ms)
+                if self.latencies_ms else 0.0)
+
+    @property
+    def p99_ms(self) -> float:
+        return self._quantile(0.99)
+
+    def __repr__(self):
+        return (f"LoadReport(sent={self.sent}, done={self.completed}, "
+                f"errors={self.errors}, timeouts={self.timeouts}, "
+                f"{self.throughput_rps:.1f} req/s, "
+                f"p50={self.p50_ms:.1f} ms, p99={self.p99_ms:.1f} ms)")
+
+
+class LoadGenerator:
+    """Open-loop ``(infer …)`` load against a replica or router topic."""
+
+    def __init__(self, process, target_topic: str,
+                 payload_fn: Callable[[int], Dict], rate_hz: float = 50.0,
+                 response_topic: Optional[str] = None,
+                 clock=None, sleep=None):
+        self.process = process
+        self.target_topic = target_topic
+        self.payload_fn = payload_fn
+        self.rate_hz = rate_hz
+        self.response_topic = response_topic or (
+            f"loadgen/{uuid.uuid4().hex[:8]}/response")
+        self._clock = clock or time.perf_counter
+        self._sleep = sleep or time.sleep
+        self._sent_at: Dict[str, float] = {}
+        self._latencies: List[float] = []
+        self._errors = 0
+        self._run_index = 0
+        process.add_message_handler(self._on_response,
+                                    self.response_topic)
+
+    def close(self):
+        """Deregister the response handler (and its subscription) —
+        required in long-lived processes doing rate sweeps, or dead
+        generators keep receiving."""
+        self.process.remove_message_handler(self._on_response,
+                                            self.response_topic)
+
+    def _on_response(self, _topic: str, payload: str):
+        command, params = parse(payload)
+        if command != "infer_response" or not params:
+            return
+        request_id = str(params[0])
+        started = self._sent_at.pop(request_id, None)
+        if started is None:
+            return
+        outputs = params[1] if len(params) > 1 else {}
+        if isinstance(outputs, dict) and "error" in outputs:
+            self._errors += 1
+        else:
+            self._latencies.append((self._clock() - started) * 1e3)
+
+    def run(self, n_requests: int, drain_timeout_s: float = 30.0,
+            pump: Optional[Callable[[], None]] = None) -> LoadReport:
+        """Send ``n_requests`` at ``rate_hz``, then wait for stragglers.
+        ``pump`` (optional) is called between waits — pass
+        ``engine.drain`` when driving a VirtualClock engine in tests."""
+        # Per-run state: run() is re-runnable (rate sweeps), and ids
+        # are unique per run so a run-1 straggler cannot satisfy a
+        # run-2 request.
+        self._sent_at.clear()
+        self._latencies = []
+        self._errors = 0
+        self._run_index += 1
+        run_tag = self._run_index
+        interval = 1.0 / self.rate_hz if self.rate_hz > 0 else 0.0
+        started = self._clock()
+        for index in range(n_requests):
+            request_id = f"lg{run_tag}_{index}"
+            self._sent_at[request_id] = self._clock()
+            self.process.message.publish(
+                self.target_topic,
+                generate("infer",
+                         [request_id, self.response_topic,
+                          encode_swag(self.payload_fn(index))]))
+            if pump is not None:
+                pump()
+            if interval:
+                next_due = started + (index + 1) * interval
+                delay = next_due - self._clock()
+                if delay > 0:
+                    self._sleep(delay)
+        deadline = self._clock() + drain_timeout_s
+        while self._sent_at and self._clock() < deadline:
+            if pump is not None:
+                pump()
+            self._sleep(0.01)
+        elapsed = self._clock() - started
+        return LoadReport(sent=n_requests,
+                          completed=len(self._latencies),
+                          errors=self._errors,
+                          timeouts=len(self._sent_at),
+                          elapsed_s=elapsed,
+                          latencies_ms=list(self._latencies))

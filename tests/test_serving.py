@@ -164,3 +164,40 @@ def test_moe_int8_replica_end_to_end(engine):
     tokens_out = np.asarray(outputs["tokens_out"])
     assert tokens_out.shape == (1, 10)
     assert (tokens_out[:, :6] == prompt).all()
+
+
+def test_load_generator_against_continuous_replica(engine):
+    """Open-loop load through the wire protocol: all requests complete,
+    latencies recorded, error payloads counted separately."""
+    from aiko_services_tpu.orchestration.continuous import (
+        ContinuousBatchingServer, ContinuousReplica,
+    )
+    from aiko_services_tpu.tools import LoadGenerator
+
+    process = make_process(engine, 31, broker="load")
+    server = ContinuousBatchingServer(config_name="tiny", slots=4,
+                                      max_seq=64, chunk_steps=4)
+    replica = compose_instance(
+        ContinuousReplica, actor_args("cb_load"), process=process,
+        server=server)
+
+    clock = engine._clock
+    generator = LoadGenerator(
+        process, target_topic=replica.topic_in,
+        payload_fn=lambda i: {"tokens": np.arange(1, 6 + (i % 3),
+                                                  dtype=np.int32),
+                              "max_new_tokens": 4},
+        rate_hz=100.0, clock=clock.now, sleep=engine.advance)
+    report = generator.run(12, drain_timeout_s=60.0,
+                           pump=engine.drain)
+    assert report.completed == 12, report
+    assert report.timeouts == 0 and report.errors == 0
+    assert report.p50_ms >= 0.0 and len(report.latencies_ms) == 12
+
+    # Error payload (missing tokens) counts as error, not timeout.
+    bad = LoadGenerator(
+        process, target_topic=replica.topic_in,
+        payload_fn=lambda i: {"max_new_tokens": 4},
+        rate_hz=100.0, clock=clock.now, sleep=engine.advance)
+    bad_report = bad.run(2, drain_timeout_s=30.0, pump=engine.drain)
+    assert bad_report.errors == 2 and bad_report.timeouts == 0

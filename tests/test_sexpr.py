@@ -183,3 +183,62 @@ def test_native_roundtrip_and_errors():
             native.parse_tree(payload)
         with pytest.raises(SExprError):
             sexpr._parse_tree_py(payload)
+
+
+def test_fuzz_roundtrip_both_codecs():
+    """Property fuzz: random trees round-trip through generate->parse
+    identically on BOTH codecs, and random payload strings either parse
+    identically or raise SExprError identically."""
+    import random
+
+    from aiko_services_tpu.utils import sexpr
+
+    native = sexpr._native()
+    if native is None:
+        pytest.skip("native codec unavailable")
+    rng = random.Random(1234)
+    symbol_pool = ["a", "bc", "x1", "true", "0", "42", "3.14", "-7",
+                   "a b", "(x)", "10:p", "k:", ":", "''", '"q"',
+                   "tab\tchar", "héllo", "ns/h/1/0/in", ""]
+
+    def random_value(depth):
+        roll = rng.random()
+        if depth > 3 or roll < 0.5:
+            return rng.choice(symbol_pool)
+        if roll < 0.6:
+            return None
+        if roll < 0.8:
+            return [random_value(depth + 1)
+                    for _ in range(rng.randint(0, 4))]
+        return {f"k{i}": random_value(depth + 1)
+                for i in range(rng.randint(1, 3))}
+
+    for _ in range(300):
+        command = rng.choice(["cmd", "add", "process_frame"])
+        params = [random_value(0) for _ in range(rng.randint(0, 4))]
+        payload = sexpr.generate(command, params)
+        # Both parsers agree with each other and with the round-trip.
+        assert sexpr._parse_tree_py(payload, True) \
+            == native.parse_tree(payload, True)
+        got_command, got_params = sexpr.parse(payload)
+        assert got_command == command
+        assert got_params == params, (params, got_params)
+
+    # Random noise strings: identical accept/reject behavior.
+    alphabet = "ab(): '\"#+/0123456789\t"
+    for _ in range(500):
+        noise = "".join(rng.choice(alphabet)
+                        for _ in range(rng.randint(0, 30)))
+        try:
+            py_result = sexpr._parse_tree_py(noise, True)
+            py_error = None
+        except sexpr.SExprError:
+            py_result, py_error = None, True
+        try:
+            c_result = native.parse_tree(noise, True)
+            c_error = None
+        except sexpr.SExprError:
+            c_result, c_error = None, True
+        assert py_error == c_error, noise
+        if py_error is None:
+            assert py_result == c_result, noise
