@@ -76,12 +76,53 @@ def watchdog(seconds: int, label: str):
         signal.signal(signal.SIGALRM, previous)
 
 
+class BackendWedged(RuntimeError):
+    """Preflight timed out — the relay hang mode.  NOT retried: a wedge
+    is not transient, and each retry would eat the global deadline."""
+
+
+def _preflight_backend(timeout_s: int = 150) -> None:
+    """Probe the backend in a SUBPROCESS first.  The relay's worst
+    failure mode is a hang inside a C call (observed: jax.devices()
+    blocks uninterruptibly for hours) — SIGALRM cannot fire inside it,
+    so the in-process watchdog is not enough.  If the probe cannot run
+    a matmul within the timeout, the main process never touches jax and
+    the JSON still emits.
+
+    The parent never blocks on the child's death: a child wedged in
+    uninterruptible kernel sleep ignores even SIGKILL, so after the
+    kill attempt we ABANDON it (bounded wait) rather than ride
+    ``subprocess.run``'s unbounded ``wait()``."""
+    import subprocess
+    probe = ("import jax, numpy as np, jax.numpy as jnp;"
+             "x = jnp.ones((32, 32));"
+             "print(float(np.asarray(x @ x)[0, 0]))")
+    proc = subprocess.Popen([sys.executable, "-c", probe],
+                            stdout=subprocess.DEVNULL,
+                            stderr=subprocess.PIPE)
+    try:
+        _, stderr = proc.communicate(timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        try:
+            proc.wait(timeout=5)
+        except subprocess.TimeoutExpired:
+            pass                      # D-state child: abandon it
+        raise BackendWedged(
+            f"backend preflight hung >{timeout_s}s (wedged relay)")
+    if proc.returncode != 0:
+        tail = (stderr or b"").decode(errors="replace")[-400:]
+        raise RuntimeError(f"backend preflight failed: {tail}")
+
+
 def init_backend(retries: int = 3, delay: float = 5.0):
     """Guarded backend bring-up (round-1 failure mode: UNAVAILABLE at
-    capture time killed the whole run on line 1)."""
+    capture time killed the whole run on line 1; round-2 addition:
+    subprocess preflight against the uninterruptible-hang mode)."""
     last_error = None
     for attempt in range(1, retries + 1):
         try:
+            _preflight_backend()
             # A wedged relay can make jax.devices() HANG rather than
             # raise; the watchdog turns that into a loud failure.
             with watchdog(120, "backend init"):
@@ -89,6 +130,11 @@ def init_backend(retries: int = 3, delay: float = 5.0):
                 devices = jax.devices()
             log(f"backend: {jax.default_backend()}, devices: {devices}")
             return jax.default_backend()
+        except BackendWedged as error:
+            # A wedge is not transient; retrying burns the global
+            # deadline 150 s at a time.
+            log(f"backend wedged (no retry): {error!r}")
+            raise
         except Exception as error:  # noqa: BLE001
             last_error = error
             log(f"backend init attempt {attempt}/{retries} failed: "
