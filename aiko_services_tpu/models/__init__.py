@@ -5,3 +5,4 @@ from . import detector
 from . import asr
 from . import vision
 from . import speculative
+from . import lora

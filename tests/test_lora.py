@@ -1,0 +1,96 @@
+"""LoRA: zero-init no-op, merge==functional exactness, frozen-base
+training, TP spec shapes."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from aiko_services_tpu.models import llama
+from aiko_services_tpu.models.lora import (
+    LoRAConfig, init_lora_params, lora_forward, lora_param_specs,
+    make_lora_train_step, merge_lora,
+)
+
+
+@pytest.fixture(scope="module")
+def base():
+    config = llama.CONFIGS["tiny"]
+    return config, llama.init_params(config, jax.random.PRNGKey(80))
+
+
+def test_fresh_adapter_is_exact_noop(base):
+    config, params = base
+    lora = LoRAConfig(rank=4)
+    adapter = init_lora_params(config, lora, jax.random.PRNGKey(81))
+    tokens = jax.random.randint(jax.random.PRNGKey(82), (2, 12), 0,
+                                config.vocab_size)
+    want = llama.forward(params, tokens, config, use_flash=False)
+    got = lora_forward(params, adapter, tokens, config, lora,
+                       use_flash=False)
+    np.testing.assert_array_equal(np.asarray(want), np.asarray(got))
+
+
+def test_merge_equals_functional(base):
+    config, params = base
+    lora = LoRAConfig(rank=4, targets=("wq", "wv", "w_down"))
+    adapter = init_lora_params(config, lora, jax.random.PRNGKey(83))
+    # Give B nonzero values so the adapter actually does something.
+    adapter = jax.tree.map(
+        lambda leaf: leaf + 0.01 if leaf.ndim == 2 else leaf, adapter)
+    tokens = jax.random.randint(jax.random.PRNGKey(84), (2, 10), 0,
+                                config.vocab_size)
+    functional = lora_forward(params, adapter, tokens, config, lora,
+                              use_flash=False)
+    merged = merge_lora(params, adapter, lora)
+    baked = llama.forward(merged, tokens, config, use_flash=False)
+    np.testing.assert_allclose(np.asarray(functional),
+                               np.asarray(baked), atol=1e-4)
+    # The adapter changed the output (not a vacuous comparison).
+    plain = llama.forward(params, tokens, config, use_flash=False)
+    assert float(jnp.max(jnp.abs(functional - plain))) > 1e-3
+
+
+def test_lora_training_updates_adapter_only(base):
+    config, params = base
+    lora = LoRAConfig(rank=4)
+    adapter = init_lora_params(config, lora, jax.random.PRNGKey(85))
+    optimizer = optax.adamw(1e-2)
+    step = jax.jit(make_lora_train_step(config, lora, optimizer))
+    opt_state = optimizer.init(adapter)
+    tokens = jax.random.randint(jax.random.PRNGKey(86), (4, 16), 0,
+                                config.vocab_size)
+    losses = []
+    for _ in range(5):
+        adapter, opt_state, loss = step(adapter, opt_state, params,
+                                        tokens)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0], losses
+    # Adapter param count is a small fraction of the base.
+    adapter_count = sum(np.prod(l.shape)
+                        for l in jax.tree.leaves(adapter))
+    base_count = sum(np.prod(l.shape) for l in jax.tree.leaves(params))
+    assert adapter_count < base_count * 0.05, (adapter_count,
+                                              base_count)
+
+
+def test_lora_specs_mirror_base_and_rejects_unknown_target(base):
+    config, _ = base
+    lora = LoRAConfig(rank=4, targets=("wq", "wo"))
+    specs = lora_param_specs(config, lora)
+    layer = specs["layers"][0]
+    assert str(layer["wq"]["b"]) == str(
+        jax.sharding.PartitionSpec(None, "tp"))
+    assert str(layer["wo"]["a"]) == str(
+        jax.sharding.PartitionSpec("tp", None))
+    with pytest.raises(ValueError, match="unknown LoRA target"):
+        init_lora_params(config, LoRAConfig(targets=("nope",)),
+                         jax.random.PRNGKey(0))
+
+
+def test_lora_rejects_mlp_targets_on_moe():
+    config = llama.CONFIGS["moe_tiny"]
+    with pytest.raises(ValueError, match="MoE"):
+        init_lora_params(config, LoRAConfig(targets=("wq", "w_gate")),
+                         jax.random.PRNGKey(0))
