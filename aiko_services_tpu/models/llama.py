@@ -25,7 +25,8 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from ..ops.attention import attention_reference, flash_attention
-from ..ops.quant import int8_matmul, is_quantized, quantize_tree
+from ..ops.quant import (_unpack_int4, int4_matmul, int8_matmul,
+                         is_quantized, is_quantized_int4, quantize_tree)
 
 __all__ = ["LlamaConfig", "init_params", "forward", "init_cache",
            "decode_step", "generate_tokens", "prefill", "param_specs",
@@ -175,36 +176,54 @@ def param_specs(config: LlamaConfig) -> Dict:
     }
 
 
-def quantize_params(params) -> Dict:
-    """Int8 weight-only quantization of the whole parameter tree (per-
-    output-channel scales; norm vectors stay bf16).  Halves HBM bytes
-    per decode step — the decode bottleneck — and fits 8B-class params
-    in one v5e chip's 16 GB."""
+def quantize_params(params, bits: int = 8) -> Dict:
+    """Weight-only quantization of the whole parameter tree (norm
+    vectors stay bf16).  ``bits=8``: per-output-channel int8 — halves
+    HBM bytes per decode step and fits 8B-class params in one v5e
+    chip's 16 GB.  ``bits=4``: nibble-packed int4 with per-128-group
+    scales — halves them again (~2× the int8 decode ceiling); the
+    embedding stays int8 because its read path is a row gather, and
+    gathering packed nibble rows would split bytes."""
+    if bits == 4:
+        quantized = quantize_tree(params, bits=4)
+        quantized["embed"] = quantize_tree(params["embed"])
+        return quantized
     return quantize_tree(params)
 
 
-def quantized_param_specs(config: LlamaConfig) -> Dict:
-    """PartitionSpecs matching :func:`quantize_params` output: the int8
-    matrix keeps its dense spec; the (1, out) scales shard with the
-    output axis."""
+def quantized_param_specs(config: LlamaConfig, bits: int = 8) -> Dict:
+    """PartitionSpecs matching :func:`quantize_params` output.  int8:
+    the matrix keeps its dense spec, the (1, out) scales shard with the
+    output axis.  int4: packed rows cover contiguous original rows (two
+    per byte), so the packed matrix keeps the dense spec; the (G, out)
+    group scales shard only on the output axis (G can be smaller than a
+    row-parallel mesh axis, and replicated scales cost ~nothing)."""
     def visit(spec):
         if isinstance(spec, P) and len(spec) == 2:
-            return {"q": spec, "s": P(None, spec[1])}
+            return {"q4" if bits == 4 else "q": spec,
+                    "s": P(None, spec[1])}
         return spec
     specs = jax.tree_util.tree_map(
         visit, param_specs(config),
         is_leaf=lambda x: isinstance(x, P))
+    if bits == 4:
+        embed = param_specs(config)["embed"]
+        specs["embed"] = {"q": embed, "s": P(None, embed[1])}
     if config.n_experts:
         # The 2-D MoE router also quantizes, but its spec is a bare P()
         # (len 0) which the length-2 rule above misses; 3-D expert
         # weights stay dense (quantize_tree only touches ndim==2).
         for layer in specs["layers"]:
-            layer["moe"]["router"] = {"q": P(), "s": P()}
+            layer["moe"]["router"] = (
+                {"q4": P(), "s": P()} if bits == 4 else
+                {"q": P(), "s": P()})
     return specs
 
 
 def _matmul(x, w):
-    """Dense or int8-quantized matmul, transparently."""
+    """Dense or int8/int4-quantized matmul, transparently."""
+    if is_quantized_int4(w):
+        return int4_matmul(x, w["q4"], w["s"])
     if is_quantized(w):
         return int8_matmul(x, w["q"], w["s"])
     return x @ w
@@ -212,6 +231,14 @@ def _matmul(x, w):
 
 def _embed_lookup(params, tokens, dtype):
     embed = params["embed"]
+    if is_quantized_int4(embed):
+        # Packed rows hold vocab rows (2k, 2k+1) in (low, high) nibbles;
+        # gather the byte row, then select the token's nibble.
+        low, high = _unpack_int4(embed["q4"][tokens // 2])
+        q = jnp.where((tokens % 2 == 0)[..., None], low, high)
+        group = 2 * embed["q4"].shape[0] // embed["s"].shape[0]
+        scale = embed["s"][tokens // group]
+        return (q.astype(jnp.float32) * scale).astype(dtype)
     if is_quantized(embed):
         # Gather int8 rows, dequantize with the per-feature scales.
         return (embed["q"][tokens].astype(jnp.float32)

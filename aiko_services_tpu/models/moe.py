@@ -24,7 +24,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
-from ..ops.quant import int8_matmul, is_quantized
+from ..ops.quant import (int4_matmul, int8_matmul, is_quantized,
+                         is_quantized_int4)
 
 __all__ = ["MoEConfig", "init_moe_params", "moe_ffn", "moe_param_specs",
            "top_k_gating"]
@@ -112,10 +113,13 @@ def moe_ffn(params, x, config: MoEConfig):
     capacity = max(1, int(config.capacity_factor * tokens
                           * config.top_k / config.n_experts))
     router = params["router"]
-    if is_quantized(router):
+    if is_quantized_int4(router):
+        logits = int4_matmul(xt.astype(jnp.float32), router["q4"],
+                             router["s"])
+    elif is_quantized(router):
         # quantize_tree quantizes every 2-D leaf, the router included;
         # the 3-D expert weights stay in the model dtype (weight-only
-        # int8 targets the big dense matrices, not einsum experts).
+        # quant targets the big dense matrices, not einsum experts).
         logits = int8_matmul(xt.astype(jnp.float32), router["q"],
                              router["s"])
     else:

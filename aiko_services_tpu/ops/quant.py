@@ -32,10 +32,13 @@ except ImportError:  # pragma: no cover
     _PALLAS_TPU = False
 
 __all__ = ["quantize_int8", "dequantize", "int8_matmul",
-           "quantize_tree", "is_quantized"]
+           "quantize_int4", "dequantize_int4", "int4_matmul",
+           "quantize_tree", "is_quantized", "is_quantized_int4"]
 
 #: int8 symmetric range (−127…127; −128 unused to keep scales symmetric).
 _QMAX = 127.0
+#: int4 symmetric range (−7…7; −8 unused to keep scales symmetric).
+_QMAX4 = 7.0
 
 
 def quantize_int8(w) -> Dict:
@@ -53,7 +56,65 @@ def dequantize(qw: Dict, dtype=jnp.bfloat16):
 
 
 def is_quantized(w) -> bool:
-    return isinstance(w, dict) and "q" in w and "s" in w
+    return isinstance(w, dict) and ("q" in w or "q4" in w) and "s" in w
+
+
+def is_quantized_int4(w) -> bool:
+    return isinstance(w, dict) and "q4" in w and "s" in w
+
+
+# --------------------------------------------------------------------------- #
+# Int4 (nibble-packed, per-group scales)
+#
+# Packing layout: adjacent input rows share a byte — packed[k, n] holds
+# w[2k, n] in its low nibble and w[2k+1, n] in its high nibble.  A
+# contiguous slice of packed rows [a, b) therefore covers the contiguous
+# original rows [2a, 2b), so megatron row-parallel sharding of the packed
+# matrix along axis 0 stays correct (each TP shard's packed rows line up
+# with its activation slice), and per-group scales shard the same way.
+
+
+def quantize_int4(w, group_size: int = 128) -> Dict:
+    """Per-(input-group, output-channel) symmetric int4 quantization of a
+    2-D weight ``(in, out)`` → ``{"q4": int8 (in/2, out) nibble-packed,
+    "s": f32 (in/group, out)}``.  Grouped scales (default 128) bound the
+    quantization error per small row-block — the standard accuracy fix
+    for 4-bit weights."""
+    w32 = jnp.asarray(w, jnp.float32)
+    k, n = w32.shape
+    if k % 2:
+        raise ValueError(f"int4 packing needs an even input dim, got {k}")
+    if group_size % 2 or k % group_size:
+        group_size = k  # degenerate: one group per column
+    g = k // group_size
+    grouped = w32.reshape(g, group_size, n)
+    scale = jnp.max(jnp.abs(grouped), axis=1, keepdims=True) / _QMAX4
+    scale = jnp.where(scale == 0, 1.0, scale)
+    q = jnp.clip(jnp.round(grouped / scale), -_QMAX4, _QMAX4)
+    q = q.reshape(k, n).astype(jnp.int32)
+    packed = (q[0::2] & 0xF) | ((q[1::2] & 0xF) << 4)
+    packed = jnp.where(packed >= 128, packed - 256, packed).astype(jnp.int8)
+    return {"q4": packed, "s": scale.reshape(g, n)}
+
+
+def _unpack_int4(packed):
+    """int8 (K/2, N) → (low, high) int32 nibbles, sign-extended; low[k]
+    is original row 2k, high[k] row 2k+1."""
+    p = packed.astype(jnp.int32)
+    low = (p << 28) >> 28
+    high = p >> 4
+    return low, high
+
+
+def dequantize_int4(qw: Dict, dtype=jnp.bfloat16):
+    packed, scale = qw["q4"], qw["s"]
+    khalf, n = packed.shape
+    k = 2 * khalf
+    g = scale.shape[0]
+    low, high = _unpack_int4(packed)
+    q = jnp.stack([low, high], axis=1).reshape(k, n).astype(jnp.float32)
+    w = q.reshape(g, k // g, n) * scale[:, None, :]
+    return w.reshape(k, n).astype(dtype)
 
 
 def _kernel(x_ref, q_ref, s_ref, o_ref):
@@ -116,12 +177,106 @@ def int8_matmul(x, q, s, interpret: bool = False):
     return out.reshape(*lead, n)
 
 
-def quantize_tree(tree):
+def _int4_kernel(xe_ref, xo_ref, p_ref, s_ref, o_ref, *, gs_half: int,
+                 groups: int):
+    """Grouped fused int4 dequant-matmul: per scale group, unpack the
+    packed nibble tile in-register, run two MXU dots (even/odd original
+    rows), and apply the group's column scales into the f32 accumulator.
+    The dequantized weights never exist in HBM."""
+    m = xe_ref.shape[0]
+    acc = jnp.zeros((m, o_ref.shape[1]), jnp.float32)
+    # Static (unrolled) group loop: Mosaic has no dynamic_slice on
+    # values, and `groups` is a trace-time constant anyway (≤ ~112).
+    for g in range(groups):
+        rows = slice(g * gs_half, (g + 1) * gs_half)
+        low, high = _unpack_int4(p_ref[rows, :])
+        xe_g = xe_ref[:, rows].astype(jnp.float32)
+        xo_g = xo_ref[:, rows].astype(jnp.float32)
+        part = (jnp.dot(xe_g, low.astype(jnp.float32),
+                        preferred_element_type=jnp.float32)
+                + jnp.dot(xo_g, high.astype(jnp.float32),
+                          preferred_element_type=jnp.float32))
+        acc = acc + part * s_ref[g:g + 1, :]
+    o_ref[:] = acc.astype(o_ref.dtype)
+
+
+def _pick_block_int4(m: int, khalf: int, n: int, groups: int) -> int:
+    """Largest output-column block fitting the VMEM budget: x halves
+    (bf16, whole K), packed int8 tile, f32 scales, f32 accumulator plus
+    per-group unpack temporaries (~3 int32/f32 copies of one group)."""
+    for block in (1024, 512, 256, 128):
+        if n % block:
+            continue
+        gs_half = khalf // groups
+        working_set = (2 * 2 * m * khalf          # xe + xo bf16
+                       + khalf * block            # packed int8 tile
+                       + 4 * groups * block       # scales f32
+                       + 4 * m * block            # accumulator
+                       + 12 * gs_half * block)    # unpack temporaries
+        if working_set <= _VMEM_BUDGET:
+            return block
+    return 0
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def int4_matmul(x, q4, s, interpret: bool = False):
+    """``x (…, K) @ dequant(q4 (K/2, N) packed, s (G, N)) → (…, N)``.
+
+    Decode shapes (m ≤ 64) on TPU use the fused Pallas kernel — int4
+    halves the HBM bytes per step vs int8, so the weight-streaming
+    decode ceiling roughly doubles.  Other shapes take an XLA grouped
+    einsum that never materializes the full dequantized matrix at rest
+    (XLA fuses the unpack/scale into the contraction)."""
+    lead = x.shape[:-1]
+    khalf, n = q4.shape
+    k = 2 * khalf
+    groups = s.shape[0]
+    gs_half = khalf // groups
+    x2 = x.reshape(-1, k)
+    m = x2.shape[0]
+    block_n = _pick_block_int4(m, khalf, n, groups)
+    on_tpu = jax.default_backend() == "tpu"
+    use_kernel = (_PALLAS_TPU and (on_tpu or interpret)
+                  and block_n != 0 and m <= 64
+                  and gs_half % 32 == 0)
+    if not use_kernel:
+        low, high = _unpack_int4(q4)
+        q = jnp.stack([low, high], axis=1).reshape(k, n)
+        x3 = x2.astype(jnp.float32).reshape(m, groups, k // groups)
+        w3 = q.reshape(groups, k // groups, n).astype(jnp.float32)
+        out = jnp.einsum("mgk,gkn,gn->mn", x3, w3, s,
+                         preferred_element_type=jnp.float32)
+        return out.astype(x.dtype).reshape(*lead, n)
+    xe = x2[:, 0::2]
+    xo = x2[:, 1::2]
+    out = pl.pallas_call(
+        functools.partial(_int4_kernel, gs_half=gs_half, groups=groups),
+        grid=(n // block_n,),
+        in_specs=[
+            pl.BlockSpec((m, khalf), lambda j: (0, 0)),
+            pl.BlockSpec((m, khalf), lambda j: (0, 0)),
+            pl.BlockSpec((khalf, block_n), lambda j: (0, j)),
+            pl.BlockSpec((groups, block_n), lambda j: (0, j)),
+        ],
+        out_specs=pl.BlockSpec((m, block_n), lambda j: (0, j)),
+        out_shape=jax.ShapeDtypeStruct((m, n), x.dtype),
+        interpret=interpret,
+    )(xe, xo, q4, s)
+    return out.reshape(*lead, n)
+
+
+def quantize_tree(tree, bits: int = 8, group_size: int = 128):
     """Quantize every 2-D float leaf of a parameter pytree (norm vectors
-    and anything 1-D stay as-is)."""
+    and anything 1-D stay as-is).  ``bits`` ∈ {8, 4}; int4 uses
+    nibble-packed storage with per-group scales."""
+    if bits not in (8, 4):
+        raise ValueError(f"bits must be 8 or 4, got {bits}")
+
     def visit(leaf):
         if isinstance(leaf, jnp.ndarray) and leaf.ndim == 2 and \
                 jnp.issubdtype(leaf.dtype, jnp.floating):
+            if bits == 4:
+                return quantize_int4(leaf, group_size)
             return quantize_int8(leaf)
         return leaf
     return jax.tree_util.tree_map(

@@ -404,13 +404,15 @@ def dict_copy(cache):
             for c in cache]
 
 
-def random_quantized_params(config, key):
-    """Random int8-quantized Llama params built DIRECTLY in quantized
-    form — a bf16 llama3_8b (~16 GB) would not fit next to itself in one
+def random_quantized_params(config, key, bits=8):
+    """Random quantized Llama params built DIRECTLY in quantized form —
+    a bf16 llama3_8b (~16 GB) would not fit next to itself in one
     chip's HBM, so the bf16 tree is never materialized.  Structure
-    matches ``llama.quantize_params(llama.init_params(...))`` exactly:
-    2-D weights → {"q": int8 (in, out), "s": f32 (1, out)}, 1-D norm
-    vectors stay bf16."""
+    matches ``llama.quantize_params(llama.init_params(...), bits)``
+    exactly: int8 → {"q": int8 (in, out), "s": f32 (1, out)}; int4 →
+    {"q4": int8 (in/2, out) nibble-packed, "s": f32 (in/128, out)}
+    with the embedding kept int8 (gather path).  1-D norm vectors stay
+    bf16."""
     import jax
     import jax.numpy as jnp
 
@@ -419,13 +421,23 @@ def random_quantized_params(config, key):
                        c.d_ff)
     counter = iter(range(10_000))
 
-    def qweight(shape):
+    def q8weight(shape):
         k = jax.random.fold_in(key, next(counter))
         q = jax.random.randint(k, shape, -127, 128, jnp.int8)
         # Scales sized so dequantized weights look like fan-in-scaled
         # gaussians — keeps activations finite through 32 layers.
         s = jnp.full((1, shape[1]), shape[0] ** -0.5 / 127.0, jnp.float32)
         return {"q": q, "s": s}
+
+    def q4weight(shape):
+        kin, n = shape
+        k = jax.random.fold_in(key, next(counter))
+        packed = jax.random.randint(k, (kin // 2, n), -128, 128, jnp.int8)
+        groups = max(1, kin // 128)
+        s = jnp.full((groups, n), kin ** -0.5 / 7.0, jnp.float32)
+        return {"q4": packed, "s": s}
+
+    qweight = q4weight if bits == 4 else q8weight
 
     layers = []
     for _ in range(c.n_layers):
@@ -441,41 +453,50 @@ def random_quantized_params(config, key):
             "w_down": qweight((f, d)),
         })
     return {
-        "embed": qweight((c.vocab_size, d)),
+        # The embedding read path is a row gather, so it stays int8
+        # even at bits=4 (matches llama.quantize_params).
+        "embed": q8weight((c.vocab_size, d)),
         "layers": layers,
         "final_norm": jnp.ones((d,), c.dtype),
         "lm_head": qweight((d, c.vocab_size)),
     }
 
 
-def quantized_model_bytes(config):
-    """HBM bytes the int8 weight tree streams per decode step (every
-    weight is read once per token).
+def quantized_model_bytes(config, bits=8):
+    """HBM bytes the quantized weight tree streams per decode step
+    (every weight is read once per token).
 
-    MoE configs: quantize only touches 2-D leaves, so the 3-D expert
-    weights stay in the model dtype (bf16, 2 bytes) and replace the
-    dense MLP; the router is int8."""
+    int4: 2-D weights are nibble-packed (0.5 bytes/param) with f32
+    scales every 128 input rows.  MoE configs: quantize only touches
+    2-D leaves, so the 3-D expert weights stay in the model dtype
+    (bf16, 2 bytes) and replace the dense MLP; the router is
+    quantized."""
     c = config
     d, f, v = c.d_model, c.d_ff, c.vocab_size
-    attn = (d * d + 2 * d * c.n_kv_heads * c.head_dim + d * d)
-    attn_scales = 4 * (2 * d + 2 * c.n_kv_heads * c.head_dim)
+    wbytes = 0.5 if bits == 4 else 1          # packed nibbles vs int8
+    def scales(k, n):
+        groups = max(1, k // 128) if bits == 4 else 1
+        return 4 * groups * n
+    kvd = c.n_kv_heads * c.head_dim
+    attn = wbytes * (d * d + 2 * d * kvd + d * d)
+    attn_scales = (scales(d, d) + 2 * scales(d, kvd) + scales(d, d))
     if c.n_experts:
-        mlp = (d * c.n_experts + 4 * c.n_experts      # int8 router+scales
+        mlp = (wbytes * d * c.n_experts + scales(d, c.n_experts)
                + 3 * c.n_experts * d * f * 2)         # bf16 experts
         mlp_scales = 0
     else:
-        mlp = 3 * d * f                               # int8 = 1 byte each
-        mlp_scales = 4 * 3 * f
+        mlp = wbytes * 3 * d * f
+        mlp_scales = 2 * scales(d, f) + scales(f, d)
     norms = 2 * 2 * d
-    # lm_head is int8 (v*d bytes) + f32 scales; embed row gather ~0.
-    embed_head = v * d + 4 * v + 2 * d
-    return (c.n_layers * (attn + attn_scales + mlp + mlp_scales + norms)
-            + embed_head)
+    # lm_head streams fully each step; embed row gather ~0 (int8 rows).
+    embed_head = wbytes * v * d + scales(d, v) + 2 * d
+    return int(c.n_layers * (attn + attn_scales + mlp + mlp_scales
+                             + norms) + embed_head)
 
 
 def bench_llm_decode(batch=8, prompt_len=128, new_tokens=256,
                      config_name="small", quantize=False,
-                     random_int8=False):
+                     random_int8=False, bits=8):
     import jax
     import jax.numpy as jnp
     from aiko_services_tpu.models import llama
@@ -483,15 +504,16 @@ def bench_llm_decode(batch=8, prompt_len=128, new_tokens=256,
     config = llama.CONFIGS[config_name]
     label = config_name
     if random_int8:
-        # Flagship path: int8 params built directly (see
+        # Flagship path: quantized params built directly (see
         # random_quantized_params) — required for 8B-class on 16 GB HBM.
-        params = random_quantized_params(config, jax.random.PRNGKey(0))
-        label += "+int8"
+        params = random_quantized_params(config, jax.random.PRNGKey(0),
+                                         bits=bits)
+        label += f"+int{bits}"
     else:
         params = llama.init_params(config, jax.random.PRNGKey(0))
         if quantize:
-            params = llama.quantize_params(params)
-            label += "+int8"
+            params = llama.quantize_params(params, bits=bits)
+            label += f"+int{bits}"
     tokens = jnp.zeros((batch, prompt_len), jnp.int32)
     cache = llama.init_cache(config, batch,
                              prompt_len + new_tokens + 8)
@@ -522,7 +544,7 @@ def bench_llm_decode(batch=8, prompt_len=128, new_tokens=256,
     if quantize or random_int8:
         # Bandwidth accounting: decode is HBM-bound; every step streams
         # the whole weight tree plus the live KV prefix.
-        weight_bytes = quantized_model_bytes(config)
+        weight_bytes = quantized_model_bytes(config, bits=bits)
         cache_len = prompt_len + new_tokens + 8
         kv_bytes = (2 * batch * cache_len * config.n_kv_heads
                     * config.head_dim * 2 * config.n_layers)
@@ -621,6 +643,19 @@ def main():
             result["llama3_8b_int8_tokens_per_sec_chip"] = round(tps)
             result["llama3_8b_int8_batch"] = 64  # r01 measured batch 8
             result["llama3_8b_vs_2000_target"] = round(tps / 2000.0, 2)
+
+        # Int4 flagship variant: nibble-packed weights halve the bytes
+        # per step again (3.99 GB vs 7.51 GB weights), raising the
+        # weight-stream ceiling ~2x over int8.
+        tps = run_section(
+            "llama3_8b_int4", 600,
+            lambda: bench_llm_decode(batch=64, prompt_len=128,
+                                     new_tokens=128,
+                                     config_name="llama3_8b",
+                                     random_int8=True, bits=4))
+        if tps is not None:
+            result["llama3_8b_int4_tokens_per_sec_chip"] = round(tps)
+            result["llama3_8b_int4_batch"] = 64
 
         # Newest sections LAST (the relay wedges on some heavy compiles
         # and the watchdog cannot interrupt a device call — a wedge here

@@ -168,6 +168,121 @@ def test_llama_quantized_decode_runs(tiny):
 
 
 # --------------------------------------------------------------------------- #
+# Int4 weight-only quantization (nibble-packed, grouped scales)
+
+def test_quantize_int4_roundtrip_error_small():
+    from aiko_services_tpu.ops.quant import dequantize_int4, quantize_int4
+    rng = np.random.default_rng(7)
+    w = jnp.asarray(rng.normal(size=(256, 128)) * 0.05, jnp.float32)
+    qw = quantize_int4(w, group_size=128)
+    assert qw["q4"].shape == (128, 128) and qw["q4"].dtype == jnp.int8
+    assert qw["s"].shape == (2, 128)
+    err = np.abs(np.asarray(dequantize_int4(qw, jnp.float32))
+                 - np.asarray(w))
+    # Max error is half a bucket: group scale / 2.
+    assert err.max() <= float(np.asarray(qw["s"]).max())
+
+
+def test_int4_matmul_fallback_matches_dequantized_dense():
+    from aiko_services_tpu.ops.quant import (
+        dequantize_int4, int4_matmul, quantize_int4,
+    )
+    rng = np.random.default_rng(8)
+    w = jnp.asarray(rng.normal(size=(256, 512)), jnp.float32)
+    x = jnp.asarray(rng.normal(size=(128, 256)), jnp.float32)  # m > 64
+    qw = quantize_int4(w)
+    got = int4_matmul(x, qw["q4"], qw["s"])
+    want = x @ dequantize_int4(qw, jnp.float32)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-2, atol=2e-2)
+
+
+def test_int4_matmul_pallas_matches_fallback():
+    from aiko_services_tpu.ops.quant import (
+        dequantize_int4, int4_matmul, quantize_int4,
+    )
+    rng = np.random.default_rng(9)
+    w = jnp.asarray(rng.normal(size=(256, 512)), jnp.float32)
+    x = jnp.asarray(rng.normal(size=(8, 256)), jnp.float32)
+    qw = quantize_int4(w, group_size=128)
+    got = int4_matmul(x, qw["q4"], qw["s"], interpret=True)
+    want = x @ dequantize_int4(qw, jnp.float32)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-2, atol=2e-2)
+
+
+def test_llama_int4_forward_close(tiny):
+    """Int4-quantized forward vs the SAME dequantized weights run dense
+    — isolates the matmul paths from quantization error."""
+    from aiko_services_tpu.ops.quant import (
+        dequantize, dequantize_int4, is_quantized, is_quantized_int4,
+    )
+    config, params = tiny
+    qparams = llama.quantize_params(params, bits=4)
+
+    def deq(leaf):
+        if is_quantized_int4(leaf):
+            return dequantize_int4(leaf, config.dtype)
+        if is_quantized(leaf):
+            return dequantize(leaf, config.dtype)
+        return leaf
+    dense = jax.tree_util.tree_map(deq, qparams, is_leaf=is_quantized)
+    tokens = jnp.asarray([[1, 2, 3, 4, 5, 6, 7, 8]], jnp.int32)
+    got = llama.forward(qparams, tokens, config)
+    want = llama.forward(dense, tokens, config)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=5e-2, atol=5e-2)
+
+
+def test_llama_int4_decode_runs(tiny):
+    config, dense = tiny
+    params = llama.quantize_params(dense, bits=4)
+    tokens = jnp.zeros((2, 16), jnp.int32)
+    cache = llama.init_cache(config, 2, 64)
+    logits, cache = llama.prefill(params, tokens, cache, config)
+    token = logits[:, -1].argmax(-1).astype(jnp.int32)[:, None]
+    generated, _ = llama.generate_tokens(
+        params, token, cache, jnp.int32(16), 8, config)
+    assert generated.shape == (2, 8)
+    assert np.isfinite(np.asarray(logits)).all()
+
+
+def test_llama_int4_moe_forward_runs():
+    """bits=4 must compose with MoE configs: the 2-D router quantizes
+    to {"q4","s"} and moe_ffn must dispatch it to int4_matmul."""
+    config = llama.CONFIGS["moe_tiny"]
+    params = llama.quantize_params(
+        llama.init_params(config, jax.random.PRNGKey(0)), bits=4)
+    assert "q4" in params["layers"][0]["moe"]["router"]
+    logits = llama.forward(params, jnp.zeros((1, 8), jnp.int32), config)
+    assert np.isfinite(np.asarray(logits)).all()
+
+
+def test_llama_int4_tp_sharded_matches(tiny):
+    """Int4 params sharded megatron-style over tp must reproduce the
+    unsharded int4 forward (packed rows cover contiguous original rows,
+    so row-parallel sharding of the packed matrix stays correct)."""
+    from jax.sharding import NamedSharding
+    config, dense = tiny
+    qparams = llama.quantize_params(dense, bits=4)
+    expected = llama.forward(qparams, jnp.zeros((2, 8), jnp.int32),
+                             config, use_flash=False)
+    mesh = make_mesh(dp=2, tp=4)
+    specs = llama.quantized_param_specs(config, bits=4)
+    assert (jax.tree_util.tree_structure(specs)
+            == jax.tree_util.tree_structure(
+                jax.tree_util.tree_map(lambda _: 0, qparams)))
+    sharded = jax.tree.map(
+        lambda leaf, spec: jax.device_put(
+            leaf, NamedSharding(mesh, spec)),
+        qparams, specs)
+    out = llama.forward(sharded, jnp.zeros((2, 8), jnp.int32), config,
+                        use_flash=False)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(expected),
+                               rtol=6e-2, atol=6e-2)
+
+
+# --------------------------------------------------------------------------- #
 # Collective matmuls (latency-hiding TP primitives)
 
 def test_allgather_matmul_exact():
