@@ -335,12 +335,75 @@ def forward(params, tokens, config: LlamaConfig, use_flash: bool = True):
 
 
 def init_cache(config: LlamaConfig, batch: int,
-               max_seq: Optional[int] = None) -> list:
+               max_seq: Optional[int] = None,
+               quantize_kv: bool = False) -> list:
+    """KV cache: list (one per layer) of dicts.  ``quantize_kv`` stores
+    K/V as int8 with per-(token, kv-head) f32 scales — halves KV bytes
+    per decode step AND cache HBM footprint, which is what bounds batch
+    (and therefore throughput) at long context.  Every decode/prefill
+    path handles either layout transparently."""
     max_seq = max_seq or config.max_seq_len
     shape = (batch, max_seq, config.n_kv_heads, config.head_dim)
+    if quantize_kv:
+        sshape = shape[:-1]
+        return [{"k": jnp.zeros(shape, jnp.int8),
+                 "v": jnp.zeros(shape, jnp.int8),
+                 "ks": jnp.ones(sshape, jnp.float32),
+                 "vs": jnp.ones(sshape, jnp.float32)}
+                for _ in range(config.n_layers)]
     return [{"k": jnp.zeros(shape, config.dtype),
              "v": jnp.zeros(shape, config.dtype)}
             for _ in range(config.n_layers)]
+
+
+def _kv_quantize(rows):
+    """(…, hd) bf16 → (int8 rows, f32 scales (…,)) — symmetric absmax
+    per vector (one scale per cached token per kv head)."""
+    r32 = rows.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(r32), axis=-1)
+    scale = jnp.where(amax == 0, 1.0, amax / 127.0)
+    q = jnp.clip(jnp.round(r32 / scale[..., None]),
+                 -127, 127).astype(jnp.int8)
+    return q, scale
+
+
+def _cache_write_slab(cache_layer, k, v, start_index):
+    """Write a contiguous (batch, K, kv, hd) slab at ``start_index``
+    (prefill / chunked-prefill path), either layout."""
+    def dus(dst, src, start):
+        zeros = (0,) * (dst.ndim - 2)
+        return jax.lax.dynamic_update_slice(
+            dst, src.astype(dst.dtype), (0, start) + zeros)
+    if "ks" in cache_layer:
+        kq, ks = _kv_quantize(k)
+        vq, vs = _kv_quantize(v)
+        return {"k": dus(cache_layer["k"], kq, start_index),
+                "v": dus(cache_layer["v"], vq, start_index),
+                "ks": dus(cache_layer["ks"], ks, start_index),
+                "vs": dus(cache_layer["vs"], vs, start_index)}
+    return {"k": dus(cache_layer["k"], k, start_index),
+            "v": dus(cache_layer["v"], v, start_index)}
+
+
+def _cache_write_rows(cache_layer, k, v, positions):
+    """Write one (batch, 1, kv, hd) row per batch element at per-row
+    ``positions`` (ragged decode path), either layout.  vmapped
+    dynamic_update_slice lowers to an in-place scatter under
+    donation."""
+    def write_row(rows, new, pos):
+        zeros = (0,) * (rows.ndim - 1)
+        return jax.lax.dynamic_update_slice(
+            rows, new.astype(rows.dtype), (pos,) + zeros)
+    write = jax.vmap(write_row)
+    if "ks" in cache_layer:
+        kq, ks = _kv_quantize(k)
+        vq, vs = _kv_quantize(v)
+        return {"k": write(cache_layer["k"], kq, positions),
+                "v": write(cache_layer["v"], vq, positions),
+                "ks": write(cache_layer["ks"], ks, positions),
+                "vs": write(cache_layer["vs"], vs, positions)}
+    return {"k": write(cache_layer["k"], k, positions),
+            "v": write(cache_layer["v"], v, positions)}
 
 
 @functools.partial(jax.jit, static_argnames=("config",))
@@ -353,20 +416,13 @@ def prefill(params, tokens, cache, config: LlamaConfig):
     x = _embed_lookup(params, tokens, config.dtype)
     new_cache = []
     for layer, cache_layer in zip(params["layers"], cache):
-        k_cache = cache_layer["k"]
         normed = rms_norm(x, layer["attn_norm"], config.norm_eps)
         h, kv, hd = config.n_heads, config.n_kv_heads, config.head_dim
         q = _matmul(normed, layer["wq"]).reshape(batch, seq, h, hd)
         k = _matmul(normed, layer["wk"]).reshape(batch, seq, kv, hd)
         v = _matmul(normed, layer["wv"]).reshape(batch, seq, kv, hd)
         q, k = apply_rope(q, cos, sin), apply_rope(k, cos, sin)
-        k_cache = jax.lax.dynamic_update_slice(
-            cache_layer["k"], k.astype(cache_layer["k"].dtype),
-            (0, 0, 0, 0))
-        v_cache = jax.lax.dynamic_update_slice(
-            cache_layer["v"], v.astype(cache_layer["v"].dtype),
-            (0, 0, 0, 0))
-        new_cache.append({"k": k_cache, "v": v_cache})
+        new_cache.append(_cache_write_slab(cache_layer, k, v, 0))
         q_t = q.transpose(0, 2, 1, 3)
         k_t = k.transpose(0, 2, 1, 3)
         v_t = v.transpose(0, 2, 1, 3)
@@ -396,17 +452,36 @@ decode_step = functools.partial(jax.jit, static_argnames=("config",),
                                 donate_argnames=("cache",))(_decode_core)
 
 
-def _cached_gqa_attention(q, k_cache, v_cache, query_positions, hd):
+def _cached_gqa_attention(q, cache_layer, query_positions, hd):
     """Masked GQA attention over a KV cache — the ONE implementation
     shared by ragged decode and chunked prefill.  ``q`` (batch, Q, kv,
     group, hd); ``query_positions`` (batch, Q) absolute positions; key
-    row ``s`` is attended iff ``s <= position`` of the query."""
-    s = jnp.einsum("bqkgd,bskd->bkgqs", q, k_cache,
+    row ``s`` is attended iff ``s <= position`` of the query.
+
+    Int8 KV layout: per-(token, head) scales factor OUT of the q·k
+    contraction (over hd), so they multiply the score afterwards; on
+    the value side they factor INTO the softmax weights (contraction is
+    over tokens), so the weights are scaled per key row before the
+    weighted sum — both exact dequantizations, and the int8 cache is
+    read at 1 byte/element with the convert fused into the einsum."""
+    k_cache, v_cache = cache_layer["k"], cache_layer["v"]
+    quantized = "ks" in cache_layer
+    k_in = k_cache.astype(q.dtype) if quantized else k_cache
+    s = jnp.einsum("bqkgd,bskd->bkgqs", q, k_in,
                    preferred_element_type=jnp.float32) * hd ** -0.5
+    if quantized:
+        # ks (b, s, kv) → (b, kv, 1, 1, s)
+        s = s * cache_layer["ks"].transpose(0, 2, 1)[:, :, None, None, :]
     key_pos = jnp.arange(k_cache.shape[1])
     mask = key_pos[None, None, :] <= query_positions[:, :, None]
     s = jnp.where(mask[:, None, None, :, :], s, -1e30)
     weights = jax.nn.softmax(s, axis=-1)
+    if quantized:
+        weights = weights * cache_layer["vs"].transpose(
+            0, 2, 1)[:, :, None, None, :]
+        return jnp.einsum("bkgqs,bskd->bqkgd",
+                          weights.astype(q.dtype),
+                          v_cache.astype(q.dtype))
     return jnp.einsum("bkgqs,bskd->bqkgd",
                       weights.astype(v_cache.dtype), v_cache)
 
@@ -425,21 +500,11 @@ def _attention_decode_ragged(layer, config, x, cos, sin, cache_layer,
     q = apply_rope(q, cos, sin)
     k = apply_rope(k, cos, sin)
 
-    # Per-row scatter write (vmapped dynamic_update_slice lowers to an
-    # in-place scatter under donation — no full-cache rewrite).
-    def write_row(cache_rows, new_row, pos):
-        return jax.lax.dynamic_update_slice(cache_rows, new_row,
-                                            (pos, 0, 0))
-
-    k_cache = jax.vmap(write_row)(
-        cache_layer["k"], k.astype(cache_layer["k"].dtype), positions)
-    v_cache = jax.vmap(write_row)(
-        cache_layer["v"], v.astype(cache_layer["v"].dtype), positions)
-    new_cache = {"k": k_cache, "v": v_cache}
+    new_cache = _cache_write_rows(cache_layer, k, v, positions)
 
     group = h // kv
     q_g = q.reshape(batch, seq, kv, group, hd)
-    out = _cached_gqa_attention(q_g, k_cache, v_cache,
+    out = _cached_gqa_attention(q_g, new_cache,
                                 positions[:, None], hd)
     out = out.reshape(batch, seq, h * hd)
     return x + _matmul(out, layer["wo"]).astype(x.dtype), new_cache
@@ -630,18 +695,12 @@ def prefill_chunk(params, tokens, cache, start_index,
         v = _matmul(normed, layer["wv"]).reshape(batch, K, kv, hd)
         q = apply_rope(q, cos, sin)
         k = apply_rope(k, cos, sin)
-        k_cache = jax.lax.dynamic_update_slice(
-            cache_layer["k"], k.astype(cache_layer["k"].dtype),
-            (0, start_index, 0, 0))
-        v_cache = jax.lax.dynamic_update_slice(
-            cache_layer["v"], v.astype(cache_layer["v"].dtype),
-            (0, start_index, 0, 0))
-        new_cache.append({"k": k_cache, "v": v_cache})
+        layer_cache = _cache_write_slab(cache_layer, k, v, start_index)
+        new_cache.append(layer_cache)
         # Shared masked-GQA helper, absolute-position mask.
         group = h // kv
         q_g = q.reshape(batch, K, kv, group, hd)
-        out = _cached_gqa_attention(q_g, k_cache, v_cache,
-                                    positions_b, hd)
+        out = _cached_gqa_attention(q_g, layer_cache, positions_b, hd)
         x = x + _matmul(out.reshape(batch, K, h * hd),
                         layer["wo"]).astype(x.dtype)
         x = _mlp_block(layer, config, x)

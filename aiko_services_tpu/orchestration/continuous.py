@@ -82,7 +82,7 @@ class ContinuousBatchingServer:
     def __init__(self, config_name: str = "tiny", slots: int = 4,
                  max_seq: Optional[int] = None, chunk_steps: int = 8,
                  quantize: bool = False, eos_id: Optional[int] = None,
-                 seed: int = 0):
+                 seed: int = 0, quantize_kv: bool = False):
         import jax
         import jax.numpy as jnp
         from ..models import llama
@@ -102,7 +102,9 @@ class ContinuousBatchingServer:
         self.max_seq = max_seq or self.config.max_seq_len
         self.chunk_steps = chunk_steps
         self.eos_id = eos_id
-        self.cache = llama.init_cache(self.config, slots, self.max_seq)
+        self.quantize_kv = quantize_kv
+        self.cache = llama.init_cache(self.config, slots, self.max_seq,
+                                      quantize_kv=quantize_kv)
         self.positions = jnp.zeros((slots,), jnp.int32)
         self.active = jnp.zeros((slots,), bool)
         self.tokens = jnp.zeros((slots, 1), jnp.int32)
@@ -126,8 +128,8 @@ class ContinuousBatchingServer:
                     key: jax.lax.dynamic_update_slice(
                         cache_layer[key],
                         filled[key].astype(cache_layer[key].dtype),
-                        (slot, 0, 0, 0))
-                    for key in ("k", "v")})
+                        (slot,) + (0,) * (cache_layer[key].ndim - 1))
+                    for key in cache_layer})
             return new_cache
 
         self._insert_slot = insert_slot
@@ -162,7 +164,8 @@ class ContinuousBatchingServer:
             padded = min(_bucket(prompt_len), self.max_seq)
             prompt_padded = np.zeros((1, padded), np.int32)
             prompt_padded[:, :prompt_len] = prompt
-            bucket_cache = llama.init_cache(self.config, 1, padded)
+            bucket_cache = llama.init_cache(
+                self.config, 1, padded, quantize_kv=self.quantize_kv)
             _, bucket_cache = llama.prefill(
                 self.params, jnp.asarray(prompt_padded), bucket_cache,
                 self.config)

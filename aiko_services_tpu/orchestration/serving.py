@@ -121,7 +121,8 @@ class ReplicaRouter(Actor):
 
 
 def make_llama_infer(config_name: str = "tiny", quantize: bool = False,
-                     max_new_tokens: int = 16, seed: int = 0) -> Callable:
+                     max_new_tokens: int = 16, seed: int = 0,
+                     quantize_kv: bool = False) -> Callable:
     """Build a ModelReplica ``infer`` callable running the flagship
     Llama-architecture model: ``{"tokens": (batch, prompt)}`` →
     ``{"tokens_out": (batch, prompt+new)}``."""
@@ -146,7 +147,8 @@ def make_llama_infer(config_name: str = "tiny", quantize: bool = False,
             return {"error": f"prompt_len {prompt_len} >= max_seq_len "
                              f"{config.max_seq_len}"}
         new = min(max_new_tokens, config.max_seq_len - prompt_len)
-        cache = llama.init_cache(config, batch, prompt_len + new)
+        cache = llama.init_cache(config, batch, prompt_len + new,
+                                 quantize_kv=quantize_kv)
         logits, cache = llama.prefill(params, tokens, cache, config)
         first = logits[:, -1].argmax(-1).astype(jnp.int32)[:, None]
         generated, _ = llama.generate_tokens(

@@ -400,7 +400,7 @@ def bench_speech_chat(n_frames=20, warmup=3, max_new_tokens=32):
 def dict_copy(cache):
     """Fresh cache buffers (generate_tokens donates its cache arg)."""
     import jax.numpy as jnp
-    return [{"k": jnp.copy(c["k"]), "v": jnp.copy(c["v"])}
+    return [{name: jnp.copy(buf) for name, buf in c.items()}
             for c in cache]
 
 
@@ -494,9 +494,21 @@ def quantized_model_bytes(config, bits=8):
                              + norms) + embed_head)
 
 
+def dense_model_bytes(config):
+    """HBM bytes of the bf16 weight tree streamed per decode step."""
+    c = config
+    d, f, v = c.d_model, c.d_ff, c.vocab_size
+    kvd = c.n_kv_heads * c.head_dim
+    mlp = (d * c.n_experts + 3 * c.n_experts * d * f if c.n_experts
+           else 3 * d * f)
+    count = (c.n_layers * (2 * d * d + 2 * d * kvd + mlp + 2 * d)
+             + v * d + d + d * v)
+    return 2 * count
+
+
 def bench_llm_decode(batch=8, prompt_len=128, new_tokens=256,
                      config_name="small", quantize=False,
-                     random_int8=False, bits=8):
+                     random_int8=False, bits=8, quantize_kv=False):
     import jax
     import jax.numpy as jnp
     from aiko_services_tpu.models import llama
@@ -515,8 +527,11 @@ def bench_llm_decode(batch=8, prompt_len=128, new_tokens=256,
             params = llama.quantize_params(params, bits=bits)
             label += f"+int{bits}"
     tokens = jnp.zeros((batch, prompt_len), jnp.int32)
+    if quantize_kv:
+        label += "+kv8"
     cache = llama.init_cache(config, batch,
-                             prompt_len + new_tokens + 8)
+                             prompt_len + new_tokens + 8,
+                             quantize_kv=quantize_kv)
     logits, cache = llama.prefill(params, tokens, cache, config)
     token = logits[:, -1].argmax(-1).astype(jnp.int32)[:, None]
 
@@ -541,13 +556,19 @@ def bench_llm_decode(batch=8, prompt_len=128, new_tokens=256,
     log(f"llm_chat ({label}): {tps:.0f} tokens/sec/chip "
         f"({ms_step:.2f} ms/step)")
 
-    if quantize or random_int8:
+    if quantize or random_int8 or quantize_kv:
         # Bandwidth accounting: decode is HBM-bound; every step streams
         # the whole weight tree plus the live KV prefix.
-        weight_bytes = quantized_model_bytes(config, bits=bits)
+        weight_bytes = (quantized_model_bytes(config, bits=bits)
+                        if quantize or random_int8
+                        else dense_model_bytes(config))
         cache_len = prompt_len + new_tokens + 8
-        kv_bytes = (2 * batch * cache_len * config.n_kv_heads
-                    * config.head_dim * 2 * config.n_layers)
+        # Per KV element: 2 bytes bf16, or 1 byte int8 + one f32 scale
+        # per head_dim vector.
+        kv_elem_bytes = (1 + 4 / config.head_dim) if quantize_kv else 2
+        kv_bytes = int(2 * batch * cache_len * config.n_kv_heads
+                       * config.head_dim * kv_elem_bytes
+                       * config.n_layers)
         step_bytes = weight_bytes + kv_bytes
         ceiling = HBM_GBPS * 1e9 / step_bytes * batch
         log(f"llm_chat ({label}) bandwidth math: weights "
@@ -656,6 +677,19 @@ def main():
         if tps is not None:
             result["llama3_8b_int4_tokens_per_sec_chip"] = round(tps)
             result["llama3_8b_int4_batch"] = 64
+
+        # Int8 KV cache on top of int8 weights: halves the KV bytes per
+        # step (the second-largest stream at batch 64) and the cache
+        # footprint that bounds batch.
+        tps = run_section(
+            "llama3_8b_int8_kv8", 600,
+            lambda: bench_llm_decode(batch=64, prompt_len=128,
+                                     new_tokens=128,
+                                     config_name="llama3_8b",
+                                     random_int8=True,
+                                     quantize_kv=True))
+        if tps is not None:
+            result["llama3_8b_int8_kv8_tokens_per_sec_chip"] = round(tps)
 
         # Newest sections LAST (the relay wedges on some heavy compiles
         # and the watchdog cannot interrupt a device call — a wedge here

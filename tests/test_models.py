@@ -258,6 +258,93 @@ def test_llama_int4_moe_forward_runs():
     assert np.isfinite(np.asarray(logits)).all()
 
 
+# --------------------------------------------------------------------------- #
+# Int8 KV-cache quantization
+
+def test_llama_kv8_decode_close_to_bf16(tiny):
+    """Decode with an int8 KV cache must track the bf16-cache decode:
+    per-(token, head) absmax scales keep the dequantization error under
+    1% of the score scale, so short greedy horizons agree."""
+    config, params = tiny
+    tokens = jnp.asarray([[5, 17, 200, 3, 9, 41, 77, 8]], jnp.int32)
+
+    outs = {}
+    for quantize_kv in (False, True):
+        cache = llama.init_cache(config, 1, 64, quantize_kv=quantize_kv)
+        logits, cache = llama.prefill(params, tokens, cache, config)
+        tok = logits[:, -1].argmax(-1).astype(jnp.int32)[:, None]
+        # decode_step READS the (possibly quantized) cache — prefill
+        # logits never do (prefill attends over the fresh bf16 k/v).
+        step_logits, cache = llama.decode_step(params, tok, cache,
+                                               jnp.int32(8), config)
+        generated, _ = llama.generate_tokens(
+            params, tok, cache, jnp.int32(8), 8, config)
+        outs[quantize_kv] = (np.asarray(step_logits),
+                            np.asarray(generated))
+    ref = np.abs(outs[False][0]).max()
+    assert np.abs(outs[True][0] - outs[False][0]).max() <= 0.05 * ref
+    assert (outs[True][1] == outs[False][1]).mean() >= 0.75
+
+
+def test_llama_kv8_chunked_prefill_matches_full(tiny):
+    """The slab write (full prefill) and chunked prefill must build the
+    SAME int8 cache: decoding after either yields identical tokens."""
+    config, params = tiny
+    tokens = jnp.asarray([[5, 17, 200, 3, 9, 41, 77, 8]], jnp.int32)
+
+    cache_a = llama.init_cache(config, 1, 64, quantize_kv=True)
+    logits_a, cache_a = llama.prefill(params, tokens, cache_a, config)
+
+    cache_b = llama.init_cache(config, 1, 64, quantize_kv=True)
+    lg1, cache_b = llama.prefill_chunk(params, tokens[:, :4], cache_b,
+                                       jnp.int32(0), config)
+    lg2, cache_b = llama.prefill_chunk(params, tokens[:, 4:], cache_b,
+                                       jnp.int32(4), config)
+    np.testing.assert_allclose(np.asarray(logits_a[:, -1]),
+                               np.asarray(lg2[:, -1]),
+                               rtol=4e-2, atol=4e-2)
+    for la, lb in zip(cache_a, cache_b):
+        # bf16 k-projection rounding differs between the 8-wide and
+        # 4-wide matmuls, so codes may land one bucket apart.
+        code_diff = np.abs(np.asarray(la["k"][:, :8], np.int32)
+                           - np.asarray(lb["k"][:, :8], np.int32))
+        assert code_diff.max() <= 1
+        np.testing.assert_allclose(
+            np.asarray(la["ks"][:, :8]), np.asarray(lb["ks"][:, :8]),
+            rtol=1e-2)
+
+
+def test_continuous_batching_kv8_matches_unquantized_cache():
+    """The continuous-batching server with an int8 KV cache completes
+    the same requests with closely-tracking outputs."""
+    from aiko_services_tpu.orchestration.continuous import (
+        ContinuousBatchingServer, DecodeRequest,
+    )
+    prompts = [[5, 17, 200], [3, 9, 41, 77, 8, 12]]
+    results = {}
+    for quantize_kv in (False, True):
+        server = ContinuousBatchingServer(
+            "tiny", slots=2, max_seq=64, chunk_steps=4,
+            quantize_kv=quantize_kv)
+        for i, prompt in enumerate(prompts):
+            server.submit(DecodeRequest(request_id=str(i),
+                                        prompt=np.asarray(prompt),
+                                        max_new_tokens=8))
+        finished = server.run_until_drained()
+        results[quantize_kv] = {
+            r.request_id: r.tokens for r in finished}
+    assert set(results[True]) == set(results[False]) == {"0", "1"}
+    for rid in results[True]:
+        a = np.asarray(results[True][rid])
+        b = np.asarray(results[False][rid])
+        assert a.shape == b.shape
+        # Greedy decode: once one token differs the tails diverge, so
+        # the honest closeness metric is the agreeing PREFIX length.
+        disagree = np.nonzero(a != b)[0]
+        prefix = disagree[0] if disagree.size else a.size
+        assert prefix >= 4, (rid, a, b)
+
+
 def test_llama_int4_tp_sharded_matches(tiny):
     """Int4 params sharded megatron-style over tp must reproduce the
     unsharded int4 forward (packed rows cover contiguous original rows,
