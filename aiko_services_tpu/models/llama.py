@@ -56,6 +56,11 @@ class LlamaConfig:
     #: semantics).  cf >= n_experts/top_k guarantees no drops, which
     #: keeps decode exactly consistent with full-sequence forward.
     moe_capacity_factor: float = 2.0
+    #: Mistral-style sliding-window attention: each position attends to
+    #: at most this many most-recent positions (None = full causal).
+    #: Long-context prefill cost becomes O(seq·window) via two-sided
+    #: block skipping in the flash kernel.
+    sliding_window: Optional[int] = None
 
     @property
     def head_dim(self) -> int:
@@ -104,6 +109,16 @@ CONFIGS: Dict[str, LlamaConfig] = {
                                 d_ff=14_336, max_seq_len=32_768,
                                 rope_theta=1e6, n_experts=8,
                                 moe_capacity_factor=4.0),
+    # Mistral-7B-v0.1-class: sliding-window attention (4096) bounds
+    # long-context attention cost and KV working set.
+    "mistral_7b": LlamaConfig(vocab_size=32_000, d_model=4096,
+                              n_layers=32, n_heads=32, n_kv_heads=8,
+                              d_ff=14_336, max_seq_len=32_768,
+                              rope_theta=10_000.0, sliding_window=4096),
+    "mistral_tiny": LlamaConfig(vocab_size=1024, d_model=128,
+                                n_layers=2, n_heads=4, n_kv_heads=2,
+                                d_ff=352, max_seq_len=512,
+                                sliding_window=16),
 }
 
 
@@ -292,12 +307,14 @@ def _attention_block(layer, config, x, cos, sin, use_flash=True):
     v_t = v.transpose(0, 2, 1, 3)
     if use_flash:
         # flash_attention is GQA-native (no repeated K/V in memory).
-        out = flash_attention(q_t, k_t, v_t, causal=True)
+        out = flash_attention(q_t, k_t, v_t, causal=True,
+                              window=config.sliding_window)
     else:
         group = h // kv
         out = attention_reference(
             q_t, jnp.repeat(k_t, group, axis=1),
-            jnp.repeat(v_t, group, axis=1), causal=True)
+            jnp.repeat(v_t, group, axis=1), causal=True,
+            window=config.sliding_window)
     out = out.transpose(0, 2, 1, 3)
 
     out = _matmul(out.reshape(batch, seq, h * hd), layer["wo"])
@@ -426,7 +443,8 @@ def prefill(params, tokens, cache, config: LlamaConfig):
         q_t = q.transpose(0, 2, 1, 3)
         k_t = k.transpose(0, 2, 1, 3)
         v_t = v.transpose(0, 2, 1, 3)
-        out = flash_attention(q_t, k_t, v_t, causal=True)
+        out = flash_attention(q_t, k_t, v_t, causal=True,
+                              window=config.sliding_window)
         out = out.transpose(0, 2, 1, 3).reshape(batch, seq, h * hd)
         x = x + _matmul(out, layer["wo"]).astype(x.dtype)
         x = _mlp_block(layer, config, x)
@@ -452,11 +470,13 @@ decode_step = functools.partial(jax.jit, static_argnames=("config",),
                                 donate_argnames=("cache",))(_decode_core)
 
 
-def _cached_gqa_attention(q, cache_layer, query_positions, hd):
+def _cached_gqa_attention(q, cache_layer, query_positions, hd,
+                          window: Optional[int] = None):
     """Masked GQA attention over a KV cache — the ONE implementation
     shared by ragged decode and chunked prefill.  ``q`` (batch, Q, kv,
     group, hd); ``query_positions`` (batch, Q) absolute positions; key
-    row ``s`` is attended iff ``s <= position`` of the query.
+    row ``s`` is attended iff ``s <= position`` of the query (and
+    within ``window`` of it, when sliding-window attention is on).
 
     Int8 KV layout: per-(token, head) scales factor OUT of the q·k
     contraction (over hd), so they multiply the score afterwards; on
@@ -474,6 +494,9 @@ def _cached_gqa_attention(q, cache_layer, query_positions, hd):
         s = s * cache_layer["ks"].transpose(0, 2, 1)[:, :, None, None, :]
     key_pos = jnp.arange(k_cache.shape[1])
     mask = key_pos[None, None, :] <= query_positions[:, :, None]
+    if window is not None:
+        mask &= (key_pos[None, None, :]
+                 > query_positions[:, :, None] - window)
     s = jnp.where(mask[:, None, None, :, :], s, -1e30)
     weights = jax.nn.softmax(s, axis=-1)
     if quantized:
@@ -505,7 +528,8 @@ def _attention_decode_ragged(layer, config, x, cos, sin, cache_layer,
     group = h // kv
     q_g = q.reshape(batch, seq, kv, group, hd)
     out = _cached_gqa_attention(q_g, new_cache,
-                                positions[:, None], hd)
+                                positions[:, None], hd,
+                                window=config.sliding_window)
     out = out.reshape(batch, seq, h * hd)
     return x + _matmul(out, layer["wo"]).astype(x.dtype), new_cache
 
@@ -700,7 +724,8 @@ def prefill_chunk(params, tokens, cache, start_index,
         # Shared masked-GQA helper, absolute-position mask.
         group = h // kv
         q_g = q.reshape(batch, K, kv, group, hd)
-        out = _cached_gqa_attention(q_g, layer_cache, positions_b, hd)
+        out = _cached_gqa_attention(q_g, layer_cache, positions_b, hd,
+                                    window=config.sliding_window)
         x = x + _matmul(out.reshape(batch, K, h * hd),
                         layer["wo"]).astype(x.dtype)
         x = _mlp_block(layer, config, x)

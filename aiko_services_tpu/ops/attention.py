@@ -38,8 +38,13 @@ DEFAULT_BLOCK_K = 128
 
 
 def attention_reference(q, k, v, causal: bool = True,
-                        sm_scale: Optional[float] = None):
-    """Plain jnp attention (the numerics oracle and CPU path)."""
+                        sm_scale: Optional[float] = None,
+                        window: Optional[int] = None):
+    """Plain jnp attention (the numerics oracle and CPU path).
+
+    ``window`` (requires ``causal``): each query attends to at most the
+    ``window`` most recent positions including itself (Mistral-style
+    sliding-window attention)."""
     if sm_scale is None:
         sm_scale = q.shape[-1] ** -0.5
     logits = jnp.einsum("bhqd,bhkd->bhqk", q, k,
@@ -48,7 +53,10 @@ def attention_reference(q, k, v, causal: bool = True,
         q_len, k_len = logits.shape[-2], logits.shape[-1]
         q_ids = jnp.arange(q_len)[:, None] + (k_len - q_len)
         k_ids = jnp.arange(k_len)[None, :]
-        logits = jnp.where(k_ids <= q_ids, logits, NEG_INF)
+        visible = k_ids <= q_ids
+        if window is not None:
+            visible &= k_ids > q_ids - window
+        logits = jnp.where(visible, logits, NEG_INF)
     weights = jax.nn.softmax(logits, axis=-1)
     return jnp.einsum("bhqk,bhkd->bhqd",
                       weights.astype(v.dtype), v).astype(q.dtype)
@@ -57,7 +65,8 @@ def attention_reference(q, k, v, causal: bool = True,
 def _flash_kernel(q_ref, k_ref, v_ref, o_ref,
                   m_scratch, l_scratch, acc_scratch,
                   *, sm_scale: float, causal: bool,
-                  block_q: int, block_k: int, k_len: int, q_len: int):
+                  block_q: int, block_k: int, k_len: int, q_len: int,
+                  window: Optional[int]):
     """Grid: (batch*heads, q_blocks, k_blocks); k fastest-varying.
 
     Scratch carries the online-softmax state (running max ``m``, sum
@@ -84,6 +93,12 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref,
         # the work of the full grid sweep.
         q_last = q_block_start + block_q - 1 + (k_len - q_len)
         block_live = k_idx * block_k <= q_last
+        if window is not None:
+            # Sliding window: a k block entirely BELOW the window of
+            # this q block's first query contributes nothing either —
+            # long-context prefill cost becomes O(seq * window).
+            q_first = q_block_start + (k_len - q_len)
+            block_live &= (k_idx + 1) * block_k - 1 > q_first - window
     else:
         block_live = True
 
@@ -103,7 +118,10 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref,
                 + q_block_start + (k_len - q_len)
             k_ids = jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1) + k_idx * block_k
-            s = jnp.where(k_ids <= q_ids, s, NEG_INF)
+            visible = k_ids <= q_ids
+            if window is not None:
+                visible &= k_ids > q_ids - window
+            s = jnp.where(visible, s, NEG_INF)
 
         m_prev = m_scratch[:]                      # (bq, 1)
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
@@ -127,7 +145,8 @@ def flash_attention(q, k, v, causal: bool = True,
                     sm_scale: Optional[float] = None,
                     block_q: int = DEFAULT_BLOCK_Q,
                     block_k: int = DEFAULT_BLOCK_K,
-                    interpret: bool = False):
+                    interpret: bool = False,
+                    window: Optional[int] = None):
     """Flash attention; dispatches to the Pallas kernel on TPU (or in
     interpret mode), else the jnp reference.
 
@@ -148,7 +167,7 @@ def flash_attention(q, k, v, causal: bool = True,
         k_full = jnp.repeat(k, group, axis=1) if group > 1 else k
         v_full = jnp.repeat(v, group, axis=1) if group > 1 else v
         return attention_reference(q, k_full, v_full, causal=causal,
-                                   sm_scale=sm_scale)
+                                   sm_scale=sm_scale, window=window)
 
     on_tpu = jax.default_backend() == "tpu"
     if not (_PALLAS_TPU and (on_tpu or interpret)):
@@ -167,20 +186,30 @@ def flash_attention(q, k, v, causal: bool = True,
     k3 = k.reshape(batch * kv_heads, k_len, head_dim)
     v3 = v.reshape(batch * kv_heads, k_len, head_dim)
 
+    if window is not None and not causal:
+        return fallback()
+
     grid = (bh, q_len // block_q, k_len // block_k)
     kernel = functools.partial(
         _flash_kernel, sm_scale=sm_scale, causal=causal,
-        block_q=block_q, block_k=block_k, k_len=k_len, q_len=q_len)
+        block_q=block_q, block_k=block_k, k_len=k_len, q_len=q_len,
+        window=window)
 
     if causal:
-        # Clamp the k index for blocks past the causal diagonal: the
+        # Clamp the k index for blocks outside the live band: the
         # kernel skips their compute (pl.when), and an unchanged block
         # index means Pallas re-uses the already-resident VMEM tile
-        # instead of issuing a fresh HBM copy.
+        # instead of issuing a fresh HBM copy.  With a sliding window
+        # the band is two-sided (diagonal above, window edge below).
         def kv_index(b, i, j):
-            last_live = (i * block_q + block_q - 1 + (k_len - q_len)) \
-                // block_k
-            return (b // group, jnp.minimum(j, last_live), 0)
+            q_first = i * block_q + (k_len - q_len)
+            last_live = (q_first + block_q - 1) // block_k
+            j_clamped = jnp.minimum(j, last_live)
+            if window is not None:
+                first_live = jnp.maximum(
+                    q_first - window + 1, 0) // block_k
+                j_clamped = jnp.maximum(j_clamped, first_live)
+            return (b // group, j_clamped, 0)
     else:
         def kv_index(b, i, j):
             return (b // group, j, 0)
