@@ -109,8 +109,11 @@ CONFIGS: Dict[str, LlamaConfig] = {
                                 d_ff=14_336, max_seq_len=32_768,
                                 rope_theta=1e6, n_experts=8,
                                 moe_capacity_factor=4.0),
-    # Mistral-7B-v0.1-class: sliding-window attention (4096) bounds
-    # long-context attention cost and KV working set.
+    # Mistral-7B-v0.1-class: sliding-window attention (4096).  PREFILL
+    # cost is O(seq*window) via the flash kernel's two-sided block
+    # skipping; decode masks out-of-window keys but keeps the full
+    # cache resident (no rolling KV buffer yet), so decode memory stays
+    # O(max_seq_len).
     "mistral_7b": LlamaConfig(vocab_size=32_000, d_model=4096,
                               n_layers=32, n_heads=32, n_kv_heads=8,
                               d_ff=14_336, max_seq_len=32_768,

@@ -45,6 +45,8 @@ def attention_reference(q, k, v, causal: bool = True,
     ``window`` (requires ``causal``): each query attends to at most the
     ``window`` most recent positions including itself (Mistral-style
     sliding-window attention)."""
+    if window is not None and not causal:
+        raise ValueError("window requires causal=True")
     if sm_scale is None:
         sm_scale = q.shape[-1] ** -0.5
     logits = jnp.einsum("bhqd,bhkd->bhqk", q, k,
@@ -155,6 +157,8 @@ def flash_attention(q, k, v, causal: bool = True,
     the shared K/V head via the BlockSpec index map, so the repeated K/V
     never exists in memory (repeating would multiply HBM traffic by the
     group size)."""
+    if window is not None and not causal:
+        raise ValueError("window requires causal=True")
     if sm_scale is None:
         sm_scale = q.shape[-1] ** -0.5
 
@@ -185,9 +189,6 @@ def flash_attention(q, k, v, causal: bool = True,
     q3 = q.reshape(bh, q_len, head_dim)
     k3 = k.reshape(batch * kv_heads, k_len, head_dim)
     v3 = v.reshape(batch * kv_heads, k_len, head_dim)
-
-    if window is not None and not causal:
-        return fallback()
 
     grid = (bh, q_len // block_q, k_len // block_k)
     kernel = functools.partial(

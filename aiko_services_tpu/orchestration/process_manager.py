@@ -9,6 +9,7 @@ timer (0.2 s) detects exits and fires the exit handler;
 
 from __future__ import annotations
 
+import os
 import shutil
 import subprocess
 import sys
@@ -36,14 +37,22 @@ class ProcessManager:
         return str(id) in self.processes
 
     def create(self, id, command: str,
-               arguments: Optional[List[str]] = None) -> subprocess.Popen:
+               arguments: Optional[List[str]] = None,
+               env: Optional[dict] = None) -> subprocess.Popen:
         """Start a child.  ``command`` may be an executable on PATH, a
-        path, or a python file / ``-m module`` spec."""
+        path, or a python file / ``-m module`` spec.  ``env`` entries
+        are overlaid on this process's environment (e.g.
+        :func:`~..parallel.distributed.worker_env` for multi-host
+        workers)."""
         id = str(id)
         if id in self.processes:
             raise ValueError(f"ProcessManager already has id: {id}")
         argv = self._resolve(command) + [str(a) for a in (arguments or [])]
-        process = subprocess.Popen(argv)
+        child_env = None
+        if env is not None:
+            child_env = dict(os.environ)
+            child_env.update({k: str(v) for k, v in env.items()})
+        process = subprocess.Popen(argv, env=child_env)
         self.processes[id] = process
         self.commands[id] = argv
         if not self._polling:

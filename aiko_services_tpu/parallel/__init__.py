@@ -12,3 +12,6 @@ from .pipeline_parallel import (
 from .checkpoint import (TrainCheckpointer, StreamCheckpoint,
                          save_stream_checkpoint, load_stream_checkpoint)
 from .elastic import ElasticTrainer
+from .distributed import (MultiHostConfig, initialize_multihost,
+                          hybrid_mesh, CoordinatorAnnouncer,
+                          discover_coordinator, worker_env)

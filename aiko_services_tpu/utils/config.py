@@ -26,6 +26,7 @@ __all__ = [
     "get_namespace", "get_hostname", "get_pid",
     "get_mqtt_configuration", "get_default_transport",
     "bootstrap_request", "BootstrapResponder", "BOOTSTRAP_PORT",
+    "UdpResponder", "udp_request",
 ]
 
 DEFAULT_NAMESPACE = "aiko"
@@ -75,53 +76,28 @@ BOOTSTRAP_PORT = 4149
 _BOOTSTRAP_REQUEST = b"boot?"
 
 
-def bootstrap_request(timeout: float = 2.0, port: int = BOOTSTRAP_PORT,
-                      address: str = "255.255.255.255"):
-    """Broadcast a boot request; returns (mqtt_host, mqtt_port, namespace)
-    or None on timeout."""
-    import time as _time
-    sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
-    deadline = _time.monotonic() + timeout
-    try:
-        sock.setsockopt(socket.SOL_SOCKET, socket.SO_BROADCAST, 1)
-        sock.sendto(_BOOTSTRAP_REQUEST, (address, port))
-        while True:
-            remaining = deadline - _time.monotonic()
-            if remaining <= 0:
-                return None
-            sock.settimeout(remaining)
-            try:
-                data, _addr = sock.recvfrom(1024)
-            except socket.timeout:
-                return None
-            fields = data.decode("utf-8", "replace").split()
-            if len(fields) == 4 and fields[0] == "boot":
-                try:
-                    return fields[1], int(fields[2]), fields[3]
-                except ValueError:
-                    continue    # malformed port from a stray responder
-    finally:
-        sock.close()
+class UdpResponder:
+    """Generic one-shot UDP request/reply responder: answers datagrams
+    equal to ``request`` with ``reply`` — the reference's ``boot?``
+    bootstrap idiom, reusable for any discovery plane (broker boot,
+    multi-host coordinator, …).
 
+    Runs a daemon thread; ``stop()`` to shut down.  Binds
+    ``bind_address`` (default all interfaces) on ``port`` (0 =
+    ephemeral; the bound port is exposed as ``.port``)."""
 
-class BootstrapResponder:
-    """Answer "boot?" broadcasts with this site's broker coordinates.
-
-    Runs a daemon thread; ``stop()`` to shut down.  Binds ``bind_address``
-    (default all interfaces) on ``port``.
-    """
-
-    def __init__(self, mqtt_host: str, mqtt_port: int, namespace: str,
-                 port: int = BOOTSTRAP_PORT, bind_address: str = ""):
+    def __init__(self, request: bytes, reply: bytes, port: int,
+                 bind_address: str = "", thread_name: str = "udp_responder"):
         import threading
-        self._reply = f"boot {mqtt_host} {mqtt_port} {namespace}".encode()
+        self._request = request
+        self._reply = reply
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind((bind_address, port))
         self._sock.settimeout(0.25)
         self._running = True
         self._thread = threading.Thread(
-            target=self._serve, name="bootstrap_responder", daemon=True)
+            target=self._serve, name=thread_name, daemon=True)
         self._thread.start()
         self.port = self._sock.getsockname()[1]
 
@@ -133,7 +109,7 @@ class BootstrapResponder:
                 continue
             except OSError:
                 break
-            if data.strip() == _BOOTSTRAP_REQUEST:
+            if data.strip() == self._request:
                 try:
                     self._sock.sendto(self._reply, addr)
                 except OSError:
@@ -143,3 +119,57 @@ class BootstrapResponder:
         self._running = False
         self._thread.join(timeout=2.0)
         self._sock.close()
+
+
+def udp_request(request: bytes, parse, port: int,
+                timeout: float = 2.0,
+                address: str = "255.255.255.255"):
+    """Broadcast ``request`` and return the first reply ``parse``
+    accepts (``parse(fields) -> value or None``), or None on timeout.
+    Malformed replies from stray responders are skipped."""
+    import time as _time
+    sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    deadline = _time.monotonic() + timeout
+    try:
+        sock.setsockopt(socket.SOL_SOCKET, socket.SO_BROADCAST, 1)
+        sock.sendto(request, (address, port))
+        while True:
+            remaining = deadline - _time.monotonic()
+            if remaining <= 0:
+                return None
+            sock.settimeout(remaining)
+            try:
+                data, _addr = sock.recvfrom(1024)
+            except socket.timeout:
+                return None
+            fields = data.decode("utf-8", "replace").split()
+            try:
+                value = parse(fields)
+            except (ValueError, IndexError):
+                continue
+            if value is not None:
+                return value
+    finally:
+        sock.close()
+
+
+def bootstrap_request(timeout: float = 2.0, port: int = BOOTSTRAP_PORT,
+                      address: str = "255.255.255.255"):
+    """Broadcast a boot request; returns (mqtt_host, mqtt_port, namespace)
+    or None on timeout."""
+    def parse(fields):
+        if len(fields) == 4 and fields[0] == "boot":
+            return fields[1], int(fields[2]), fields[3]
+        return None
+    return udp_request(_BOOTSTRAP_REQUEST, parse, port, timeout, address)
+
+
+class BootstrapResponder(UdpResponder):
+    """Answer "boot?" broadcasts with this site's broker coordinates."""
+
+    def __init__(self, mqtt_host: str, mqtt_port: int, namespace: str,
+                 port: int = BOOTSTRAP_PORT, bind_address: str = ""):
+        super().__init__(
+            _BOOTSTRAP_REQUEST,
+            f"boot {mqtt_host} {mqtt_port} {namespace}".encode(),
+            port, bind_address, thread_name="bootstrap_responder")

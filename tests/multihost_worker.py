@@ -1,0 +1,68 @@
+"""Child process for the real multi-host integration test: joins the
+global world via the framework's initialize_multihost (env triplet set
+by worker_env), builds a hybrid DCN x ICI mesh, and verifies a global
+computation crosses the process boundary.
+
+Usage: python multihost_worker.py  (env: JAX_COORDINATOR_ADDRESS,
+JAX_NUM_PROCESSES, JAX_PROCESS_ID, XLA_FLAGS with device count)
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+from jax.sharding import NamedSharding, PartitionSpec as P  # noqa: E402
+
+from aiko_services_tpu.parallel import (  # noqa: E402
+    hybrid_mesh, initialize_multihost,
+)
+
+
+def main():
+    world = initialize_multihost()
+    assert world["initialized"], world
+    pid = world["process_id"]
+    nprocs = world["num_processes"]
+    assert jax.process_count() == nprocs
+
+    # dp across processes (DCN), tp within each process (ICI).
+    mesh = hybrid_mesh({"dp": nprocs}, {"tp": -1})
+    local = jax.local_device_count()
+    print(f"worker {pid}: mesh {dict(zip(mesh.axis_names, mesh.devices.shape))}",
+          flush=True)
+
+    # Global array sharded over both axes; each process contributes its
+    # addressable shard, then a jitted global sum must see ALL rows —
+    # the reduction crosses DCN (gloo on CPU fleets).
+    rows = nprocs * 2
+    cols = local * 4
+    sharding = NamedSharding(mesh, P("dp", "tp"))
+    global_shape = (rows, cols)
+    local_rows = np.arange(rows).reshape(rows, 1) * np.ones((1, cols))
+    arrays = [
+        jax.device_put(local_rows[index], device)
+        for device, index in sharding.addressable_devices_indices_map(
+            global_shape).items()
+    ]
+    x = jax.make_array_from_single_device_arrays(
+        global_shape, sharding, arrays)
+    total = jax.jit(jnp.sum, out_shardings=NamedSharding(mesh, P()))(x)
+    expected = float(local_rows.sum())
+    got = float(np.asarray(jax.device_get(total)))
+    assert got == expected, (got, expected)
+
+    # Idempotence: a second call must be a no-op reporting the world.
+    again = initialize_multihost()
+    assert again["initialized"] is False
+    assert again["num_processes"] == nprocs
+    print(f"worker {pid}: GLOBAL_SUM_OK {got}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,211 @@
+"""Multi-host bootstrap: hybrid meshes, coordinator discovery, and a
+REAL two-OS-process jax.distributed integration test over gloo."""
+import os
+import socket
+import subprocess
+import sys
+import types
+
+import numpy as np
+import pytest
+
+from aiko_services_tpu.parallel.distributed import (
+    CoordinatorAnnouncer, MultiHostConfig, discover_coordinator,
+    hybrid_mesh, initialize_multihost, worker_env,
+)
+
+
+class FakeDevice:
+    """Stands in for a jax device: id + process/slice attributes."""
+
+    def __init__(self, id, process_index=0, slice_index=None):
+        self.id = id
+        self.process_index = process_index
+        if slice_index is not None:
+            self.slice_index = slice_index
+
+    def __repr__(self):
+        return f"d{self.id}"
+
+
+# --------------------------------------------------------------------------- #
+# hybrid_mesh grouping logic (fake devices; no jax.Mesh instantiation
+# constraints on object dtype arrays)
+
+def _fake_fleet(slices, per_slice, use_slice_index=True):
+    devices = []
+    for s in range(slices):
+        for i in range(per_slice):
+            devices.append(FakeDevice(
+                id=s * per_slice + i, process_index=s,
+                slice_index=s if use_slice_index else None))
+    return devices
+
+
+def test_hybrid_mesh_dcn_ici_layout():
+    devices = _fake_fleet(2, 4)
+    mesh = hybrid_mesh({"dp": 2}, {"tp": 4}, devices=devices)
+    assert mesh.axis_names == ("dp", "tp")
+    grid = mesh.devices
+    assert grid.shape == (2, 4)
+    # Every DCN row holds exactly one slice's devices.
+    for row in range(2):
+        assert {d.process_index for d in grid[row]} == {row}
+
+
+def test_hybrid_mesh_same_slice_falls_back_to_process_grouping():
+    """CPU fleets report slice_index 0 everywhere; the process boundary
+    is the DCN there."""
+    devices = [FakeDevice(id=i, process_index=i // 2, slice_index=0)
+               for i in range(4)]
+    mesh = hybrid_mesh({"dp": 2}, {"tp": 2}, devices=devices)
+    for row in range(2):
+        assert {d.process_index for d in mesh.devices[row]} == {row}
+
+
+def test_hybrid_mesh_wildcard_and_multi_axis():
+    devices = _fake_fleet(2, 4, use_slice_index=False)  # process fallback
+    mesh = hybrid_mesh({"dp": -1}, {"tp": 2, "sp": 2}, devices=devices)
+    assert mesh.axis_names == ("dp", "tp", "sp")
+    assert mesh.devices.shape == (2, 2, 2)
+
+
+def test_hybrid_mesh_rejects_uneven_and_overlap():
+    devices = _fake_fleet(2, 4)
+    with pytest.raises(ValueError, match="uneven"):
+        hybrid_mesh({"dp": 2}, {"tp": 2}, devices=devices[:-1])
+    with pytest.raises(ValueError, match="both"):
+        hybrid_mesh({"dp": 2}, {"dp": 4}, devices=devices)
+    with pytest.raises(ValueError):
+        hybrid_mesh({"dp": 3}, {"tp": 4}, devices=devices)  # 2 slices
+
+
+# --------------------------------------------------------------------------- #
+# Coordinator discovery
+
+def test_coordinator_discovery_roundtrip():
+    announcer = CoordinatorAnnouncer("10.0.0.7:1234", 16, port=0,
+                                     bind_address="127.0.0.1")
+    try:
+        found = discover_coordinator(port=announcer.port, timeout=3.0,
+                                     address="127.0.0.1")
+        assert found == ("10.0.0.7:1234", 16)
+    finally:
+        announcer.stop()
+
+
+def test_coordinator_discovery_timeout():
+    sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    sock.bind(("127.0.0.1", 0))      # bound but silent
+    try:
+        assert discover_coordinator(port=sock.getsockname()[1],
+                                    timeout=0.3,
+                                    address="127.0.0.1") is None
+    finally:
+        sock.close()
+
+
+# --------------------------------------------------------------------------- #
+# initialize_multihost resolution logic (stubbed initialize)
+
+def test_initialize_multihost_explicit_config():
+    calls = []
+    config = MultiHostConfig("1.2.3.4:99", 4, 2)
+    result = initialize_multihost(
+        config, _initialize=lambda **kw: calls.append(kw))
+    assert result["initialized"] and result["process_id"] == 2
+    assert calls == [{"coordinator_address": "1.2.3.4:99",
+                      "num_processes": 4, "process_id": 2}]
+
+
+def test_initialize_multihost_env_triplet(monkeypatch):
+    monkeypatch.setenv("JAX_COORDINATOR_ADDRESS", "5.6.7.8:11")
+    monkeypatch.setenv("JAX_NUM_PROCESSES", "8")
+    monkeypatch.setenv("JAX_PROCESS_ID", "3")
+    calls = []
+    result = initialize_multihost(
+        _initialize=lambda **kw: calls.append(kw))
+    assert result["num_processes"] == 8
+    assert calls[0]["process_id"] == 3
+
+
+def test_initialize_multihost_discovery(monkeypatch):
+    monkeypatch.delenv("JAX_COORDINATOR_ADDRESS", raising=False)
+    announcer = CoordinatorAnnouncer("9.9.9.9:77", 2, port=0,
+                                     bind_address="127.0.0.1")
+    calls = []
+    try:
+        # Discovery provides address + world size but not the rank.
+        with pytest.raises(ValueError, match="process_id"):
+            initialize_multihost(
+                discover=True, discovery_port=announcer.port,
+                discovery_address="127.0.0.1",
+                _initialize=lambda **kw: calls.append(kw))
+        result = initialize_multihost(
+            discover=True, discovery_port=announcer.port,
+            discovery_address="127.0.0.1", process_id=1,
+            _initialize=lambda **kw: calls.append(kw))
+        assert result["coordinator_address"] == "9.9.9.9:77"
+        assert calls == [{"coordinator_address": "9.9.9.9:77",
+                          "num_processes": 2, "process_id": 1}]
+    finally:
+        announcer.stop()
+
+
+def test_initialize_multihost_no_config_errors(monkeypatch):
+    for var in ("JAX_COORDINATOR_ADDRESS", "JAX_NUM_PROCESSES",
+                "JAX_PROCESS_ID"):
+        monkeypatch.delenv(var, raising=False)
+    with pytest.raises(RuntimeError, match="no multi-host config"):
+        initialize_multihost(_initialize=lambda **kw: None)
+
+
+def test_worker_env_round_trips_config(monkeypatch):
+    env = worker_env(1, 4, "127.0.0.1:9000", local_device_count=2)
+    for key, value in env.items():
+        monkeypatch.setenv(key, value)
+    config = MultiHostConfig.from_env()
+    assert config == MultiHostConfig("127.0.0.1:9000", 4, 1)
+    assert "device_count=2" in env["XLA_FLAGS"]
+
+
+# --------------------------------------------------------------------------- #
+# REAL two-process integration over gloo (DCN stand-in)
+
+def _free_port():
+    sock = socket.socket()
+    sock.bind(("127.0.0.1", 0))
+    port = sock.getsockname()[1]
+    sock.close()
+    return port
+
+
+def test_two_process_global_mesh_integration():
+    """Spawn 2 REAL OS processes; each joins the world via
+    initialize_multihost + worker_env, builds a hybrid dp(DCN) x
+    tp(ICI) mesh over 2x2 devices, and a jitted global sum crosses the
+    process boundary (gloo collectives)."""
+    coordinator = f"127.0.0.1:{_free_port()}"
+    script = os.path.join(os.path.dirname(__file__),
+                          "multihost_worker.py")
+    procs = []
+    for pid in (0, 1):
+        env = dict(os.environ)
+        env.pop("JAX_PLATFORMS", None)
+        env.update(worker_env(pid, 2, coordinator,
+                              local_device_count=2))
+        procs.append(subprocess.Popen(
+            [sys.executable, script], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True))
+    outputs = []
+    for proc in procs:
+        try:
+            out, _ = proc.communicate(timeout=150)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            out, _ = proc.communicate()
+        outputs.append(out)
+    for proc, out in zip(procs, outputs):
+        assert proc.returncode == 0, out[-2000:]
+        assert "GLOBAL_SUM_OK" in out, out[-2000:]
