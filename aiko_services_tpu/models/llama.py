@@ -32,7 +32,8 @@ __all__ = ["LlamaConfig", "init_params", "forward", "init_cache",
            "decode_step", "generate_tokens", "prefill", "param_specs",
            "quantize_params", "pipeline_forward", "stack_pipeline_params",
            "decode_chunk_ragged", "prefill_chunk", "sample_logits",
-           "CONFIGS"]
+           "init_paged_cache", "decode_chunk_paged",
+           "paged_insert_prefix", "CONFIGS"]
 
 
 @dataclasses.dataclass(frozen=True)
@@ -456,6 +457,201 @@ def prefill(params, tokens, cache, config: LlamaConfig):
     return logits, new_cache
 
 
+# --------------------------------------------------------------------------- #
+# Paged KV cache (vLLM-style block pool)
+#
+# The contiguous cache reserves ``slots x max_seq`` rows up front; a
+# paged pool sizes HBM to the tokens actually LIVE (requests rarely all
+# run at max length), so a serving replica admits more concurrent
+# requests per GB.  Layout per layer: pool (n_blocks, block_size, kv,
+# hd); each slot owns a block table (max_blocks,) of pool indices.
+# Block 0 is reserved scratch: unallocated table entries and inactive
+# slots point there, and it is never attendable (masking is by absolute
+# position, and live positions always map to allocated blocks).
+
+def init_paged_cache(config: LlamaConfig, n_blocks: int,
+                     block_size: int = 16,
+                     quantize_kv: bool = False) -> list:
+    """Block pool, one dict per layer.  ``n_blocks`` INCLUDES the
+    reserved scratch block 0."""
+    shape = (n_blocks, block_size, config.n_kv_heads, config.head_dim)
+    if quantize_kv:
+        sshape = shape[:-1]
+        return [{"k": jnp.zeros(shape, jnp.int8),
+                 "v": jnp.zeros(shape, jnp.int8),
+                 "ks": jnp.ones(sshape, jnp.float32),
+                 "vs": jnp.ones(sshape, jnp.float32)}
+                for _ in range(config.n_layers)]
+    return [{"k": jnp.zeros(shape, config.dtype),
+             "v": jnp.zeros(shape, config.dtype)}
+            for _ in range(config.n_layers)]
+
+
+def _paged_write_rows(pool_layer, k, v, tables, positions):
+    """Scatter one (batch, 1, kv, hd) row per slot into the pool at
+    (tables[s, pos // bs], pos % bs) — a single batched scatter."""
+    block_size = pool_layer["k"].shape[1]
+    block_ids = jnp.take_along_axis(
+        tables, (positions // block_size)[:, None], axis=1)[:, 0]
+    offsets = positions % block_size
+
+    def scatter(pool, rows):
+        return pool.at[block_ids, offsets].set(rows.astype(pool.dtype))
+
+    if "ks" in pool_layer:
+        kq, ks = _kv_quantize(k[:, 0])
+        vq, vs = _kv_quantize(v[:, 0])
+        return {"k": scatter(pool_layer["k"], kq),
+                "v": scatter(pool_layer["v"], vq),
+                "ks": scatter(pool_layer["ks"], ks),
+                "vs": scatter(pool_layer["vs"], vs)}
+    return {"k": scatter(pool_layer["k"], k[:, 0]),
+            "v": scatter(pool_layer["v"], v[:, 0])}
+
+
+def _paged_gather(pool_layer, tables):
+    """Per-slot cache view: pool[tables] → (slots, max_blocks*bs, …) —
+    the same layout :func:`_cached_gqa_attention` reads, so paged and
+    contiguous attention share ONE implementation.  XLA keeps the pool
+    itself compact; the gathered view is a transient."""
+    def view(pool):
+        gathered = pool[tables]          # (slots, max_blocks, bs, ...)
+        slots, max_blocks, block_size = gathered.shape[:3]
+        return gathered.reshape((slots, max_blocks * block_size)
+                                + gathered.shape[3:])
+    return {key: view(buf) for key, buf in pool_layer.items()}
+
+
+def _attention_decode_paged(layer, config, x, cos, sin, pool_layer,
+                            tables, positions):
+    """Single-token decode against the block pool (per-row positions,
+    continuous batching)."""
+    batch, seq, _ = x.shape
+    h, kv, hd = config.n_heads, config.n_kv_heads, config.head_dim
+    normed = rms_norm(x, layer["attn_norm"], config.norm_eps)
+    q = _matmul(normed, layer["wq"]).reshape(batch, seq, h, hd)
+    k = _matmul(normed, layer["wk"]).reshape(batch, seq, kv, hd)
+    v = _matmul(normed, layer["wv"]).reshape(batch, seq, kv, hd)
+    q = apply_rope(q, cos, sin)
+    k = apply_rope(k, cos, sin)
+
+    new_pool = _paged_write_rows(pool_layer, k, v, tables, positions)
+    gathered = _paged_gather(new_pool, tables)
+    q_g = q.reshape(batch, seq, kv, h // kv, hd)
+    out = _cached_gqa_attention(q_g, gathered, positions[:, None], hd,
+                                window=config.sliding_window)
+    out = out.reshape(batch, seq, h * hd)
+    return x + _matmul(out, layer["wo"]).astype(x.dtype), new_pool
+
+
+def _decode_core_paged(params, token, pool, tables, positions,
+                       config: LlamaConfig):
+    positions_2d = positions[:, None]
+    cos, sin = _rope_freqs(config, positions_2d)
+    x = _embed_lookup(params, token, config.dtype)
+    new_pool = []
+    for layer, pool_layer in zip(params["layers"], pool):
+        x, updated = _attention_decode_paged(layer, config, x, cos, sin,
+                                             pool_layer, tables,
+                                             positions)
+        new_pool.append(updated)
+        x = _mlp_block(layer, config, x)
+    x = rms_norm(x, params["final_norm"], config.norm_eps)
+    logits = _matmul(x, params["lm_head"]).astype(jnp.float32)
+    return logits, new_pool
+
+
+def _chunk_scan(step_core, tokens, positions, cache_state, active,
+                num_steps, temperatures, top_ps, rng_key):
+    """Shared chunk-decode scaffolding for the contiguous and paged
+    layouts: per-slot greedy/sampled pick, active-mask token/position
+    advance, one ``lax.scan`` over steps.  ``step_core(token,
+    cache_state, positions) -> (logits, cache_state)`` supplies the
+    layout-specific write/read; everything else (the sampling semantics
+    the exactness tests pin down) exists ONCE here."""
+    sampled_mode = temperatures is not None
+    if rng_key is None:
+        rng_key = jax.random.PRNGKey(0)
+    if sampled_mode and top_ps is None:
+        top_ps = jnp.ones_like(temperatures)
+
+    def pick(logits, key):
+        greedy = logits.argmax(-1).astype(jnp.int32)
+        if not sampled_mode:
+            return greedy
+        sampled = _sample_logits_per_row(logits, key, temperatures,
+                                         top_ps)
+        return jnp.where(temperatures > 0, sampled, greedy)
+
+    def body(carry, _):
+        token, positions, cache_state, key = carry
+        key, step_key = jax.random.split(key)
+        logits, cache_state = step_core(token, cache_state, positions)
+        next_token = pick(logits[:, -1], step_key)[:, None]
+        next_token = jnp.where(active[:, None], next_token, token)
+        positions = jnp.where(active, positions + 1, positions)
+        return (next_token, positions, cache_state, key), \
+            next_token[:, 0]
+
+    (token, positions, cache_state, _), tokens_out = jax.lax.scan(
+        body, (tokens, positions, cache_state, rng_key), None,
+        length=num_steps)
+    return tokens_out.T, token, positions, cache_state
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("config", "num_steps"),
+                   donate_argnames=("pool",))
+def decode_chunk_paged(params, tokens, pool, tables, positions, active,
+                       num_steps, config: LlamaConfig,
+                       temperatures=None, top_ps=None, rng_key=None):
+    """Paged twin of :func:`decode_chunk_ragged`: one compiled scan of
+    ``num_steps`` steps over the block pool.  Inactive slots write into
+    scratch block 0 at their slot offset (blocked from live tables by
+    the allocator) and do not advance.
+
+    Returns (tokens_out (slots, num_steps), last_token, positions,
+    pool)."""
+    block_size = pool[0]["k"].shape[1]
+    slots = tokens.shape[0]
+    scratch_tables = jnp.zeros_like(tables)
+    scratch_positions = (jnp.arange(slots, dtype=jnp.int32)
+                         % block_size)
+
+    def step_core(token, pool, positions):
+        write_tables = jnp.where(active[:, None], tables,
+                                 scratch_tables)
+        write_pos = jnp.where(active, positions, scratch_positions)
+        return _decode_core_paged(params, token, pool, write_tables,
+                                  write_pos, config)
+
+    return _chunk_scan(step_core, tokens, positions, pool, active,
+                       num_steps, temperatures, top_ps, rng_key)
+
+
+@functools.partial(jax.jit, donate_argnames=("pool",))
+def paged_insert_prefix(pool, tables, prefix_cache, slot):
+    """Copy a contiguous prefilled cache (1, padded, kv, hd per layer;
+    same quantize_kv layout as the pool) into ``slot``'s allocated
+    blocks.  ``tables`` (slots, max_blocks); padded must be a multiple
+    of the pool block size."""
+    block_size = pool[0]["k"].shape[1]
+    new_pool = []
+    for pool_layer, prefix_layer in zip(pool, prefix_cache):
+        padded = prefix_layer["k"].shape[1]
+        n_blocks = padded // block_size
+        block_ids = jax.lax.dynamic_slice_in_dim(
+            tables[slot], 0, n_blocks, 0)
+        updated = {}
+        for key, buf in pool_layer.items():
+            src = prefix_layer[key][0]
+            blocked = src.reshape((n_blocks, block_size)
+                                  + src.shape[1:]).astype(buf.dtype)
+            updated[key] = buf.at[block_ids].set(blocked)
+        new_pool.append(updated)
+    return new_pool
+
+
 def _decode_core(params, token, cache, cache_index, config: LlamaConfig):
     """One autoregressive step (traceable core): token (batch, 1) +
     shared cache position → (logits (batch, 1, vocab), new_cache).
@@ -579,37 +775,16 @@ def decode_chunk_ragged(params, tokens, cache, positions, active,
     positions (batch,), cache).
     """
     max_seq = cache[0]["k"].shape[1]
-    sampled_mode = temperatures is not None
-    if rng_key is None:
-        rng_key = jax.random.PRNGKey(0)
-    if sampled_mode and top_ps is None:
-        top_ps = jnp.ones_like(temperatures)
 
-    def pick(logits, key):
-        greedy = logits.argmax(-1).astype(jnp.int32)
-        if not sampled_mode:
-            return greedy
-        sampled = _sample_logits_per_row(logits, key, temperatures,
-                                         top_ps)
-        return jnp.where(temperatures > 0, sampled, greedy)
-
-    def body(carry, _):
-        token, positions, cache, key = carry
-        key, step_key = jax.random.split(key)
+    def step_core(token, cache, positions):
         # Inactive slots write into the scratch row so they cannot
         # corrupt a live slot's KV prefix.
         write_pos = jnp.where(active, positions, max_seq - 1)
-        logits, cache = _decode_core_ragged(params, token, cache,
-                                            write_pos, config)
-        next_token = pick(logits[:, -1], step_key)[:, None]
-        next_token = jnp.where(active[:, None], next_token, token)
-        positions = jnp.where(active, positions + 1, positions)
-        return (next_token, positions, cache, key), next_token[:, 0]
+        return _decode_core_ragged(params, token, cache, write_pos,
+                                   config)
 
-    (token, positions, cache, _), tokens_out = jax.lax.scan(
-        body, (tokens, positions, cache, rng_key), None,
-        length=num_steps)
-    return tokens_out.T, token, positions, cache
+    return _chunk_scan(step_core, tokens, positions, cache, active,
+                       num_steps, temperatures, top_ps, rng_key)
 
 
 def _sample_logits_per_row(logits, key, temperatures, top_ps):

@@ -6,3 +6,7 @@ from .lifecycle import (
 from .serving import (
     ModelReplica, ReplicaRouter, REPLICA_PROTOCOL, make_llama_infer,
 )
+from .continuous import (
+    ContinuousBatchingServer, ContinuousReplica, DecodeRequest,
+)
+from .paged import PagedContinuousServer

@@ -103,8 +103,8 @@ class ContinuousBatchingServer:
         self.chunk_steps = chunk_steps
         self.eos_id = eos_id
         self.quantize_kv = quantize_kv
-        self.cache = llama.init_cache(self.config, slots, self.max_seq,
-                                      quantize_kv=quantize_kv)
+        self._bucket_minimum = 16
+        self._init_layout()
         self.positions = jnp.zeros((slots,), jnp.int32)
         self.active = jnp.zeros((slots,), bool)
         self.tokens = jnp.zeros((slots, 1), jnp.int32)
@@ -116,6 +116,15 @@ class ContinuousBatchingServer:
         self._emitted = np.zeros(slots, np.int64)  # tokens emitted so far
         self._queue: List[DecodeRequest] = []
         self.completed: List[DecodeRequest] = []
+
+    def _init_layout(self):
+        """Cache-layout hook (overridden by the paged server): the
+        contiguous layout reserves ``slots x max_seq`` rows."""
+        jax = self._jax
+
+        self.cache = self._llama.init_cache(
+            self.config, self.slots, self.max_seq,
+            quantize_kv=self.quantize_kv)
 
         @functools.partial(jax.jit, donate_argnames=("cache",))
         def insert_slot(cache, bucket_cache, slot):
@@ -156,12 +165,16 @@ class ContinuousBatchingServer:
         for slot in range(self.slots):
             if self._requests[slot] is not None or not self._queue:
                 continue
-            request = self._queue.pop(0)
+            request = self._queue[0]
             prompt = np.asarray(request.prompt, np.int32)[None, :]
             prompt_len = prompt.shape[1]
             # Clamp the bucket to the cache: a prompt near max_seq must
             # not prefill a bucket larger than the slot rows.
-            padded = min(_bucket(prompt_len), self.max_seq)
+            padded = min(_bucket(prompt_len, self._bucket_minimum),
+                         self.max_seq)
+            if not self._reserve_slot(slot, padded, request):
+                break      # capacity (paged pool) exhausted; next chunk
+            self._queue.pop(0)
             prompt_padded = np.zeros((1, padded), np.int32)
             prompt_padded[:, :prompt_len] = prompt
             bucket_cache = llama.init_cache(
@@ -169,8 +182,7 @@ class ContinuousBatchingServer:
             _, bucket_cache = llama.prefill(
                 self.params, jnp.asarray(prompt_padded), bucket_cache,
                 self.config)
-            self.cache = self._insert_slot(self.cache, bucket_cache,
-                                           jnp.int32(slot))
+            self._insert_prefix(slot, bucket_cache, padded)
             # Seed with the last prompt token at its own position: the
             # next chunk's first step re-writes that KV row with the
             # identical values and emits the first generated token.
@@ -184,10 +196,24 @@ class ContinuousBatchingServer:
             self._emitted[slot] = 0
         self._any_sampled = bool((self._temperatures > 0).any())
 
+    def _reserve_slot(self, slot: int, padded: int, request) -> bool:
+        """Capacity hook: claim layout resources for an admission.
+        Contiguous layout always has room (the slot IS the room)."""
+        return True
+
+    def _insert_prefix(self, slot: int, bucket_cache, padded: int):
+        """Layout hook: land a prefilled bucket in ``slot``."""
+        self.cache = self._insert_slot(self.cache, bucket_cache,
+                                       self._jnp.int32(slot))
+
+    def _release_slot(self, slot: int) -> None:
+        """Layout hook: return a retiring slot's resources."""
+
     def _retire(self, slot: int) -> None:
         request = self._requests[slot]
         if request is not None:
             self.completed.append(request)
+        self._release_slot(slot)
         self._requests[slot] = None
         self.active = self.active.at[slot].set(False)
         # Reset sampling state so an all-greedy batch returns to the
@@ -215,11 +241,7 @@ class ContinuousBatchingServer:
                     rng_key=chunk_key)
             else:
                 sampling = {}          # pure-greedy compiled program
-            out, self.tokens, self.positions, self.cache = \
-                self._llama.decode_chunk_ragged(
-                    self.params, self.tokens, self.cache,
-                    self.positions, self.active, steps, self.config,
-                    **sampling)
+            out = self._run_chunk(steps, sampling)
             out_host = np.asarray(out)           # (slots, steps)
             for slot in range(self.slots):
                 request = self._requests[slot]
@@ -238,6 +260,19 @@ class ContinuousBatchingServer:
                     self._retire(slot)
         done, self.completed = self.completed, []
         return done
+
+    def _run_chunk(self, steps: int, sampling: Dict):
+        """Decode ``steps`` tokens for all slots; returns the emitted
+        token matrix.  Cache-layout strategy hook: the paged server
+        overrides this (and the admission/release hooks) while ALL
+        bookkeeping — admission order, budgets, EOS, retirement —
+        stays in this class."""
+        out, self.tokens, self.positions, self.cache = \
+            self._llama.decode_chunk_ragged(
+                self.params, self.tokens, self.cache,
+                self.positions, self.active, steps, self.config,
+                **sampling)
+        return out
 
     def run_until_drained(self, max_chunks: int = 10_000):
         """Synchronous helper (tests / batch jobs): pump until every

@@ -1,0 +1,141 @@
+"""Paged-KV continuous batching (vLLM-style block pool on TPU).
+
+The contiguous :class:`~.continuous.ContinuousBatchingServer` reserves
+``slots × max_seq`` KV rows up front, so HBM — not demand — caps the
+slot count when ``max_seq`` is large.  The paged server backs ALL slots
+with one block pool (``n_blocks × block_size`` rows per layer) and
+per-slot block tables; a request holds only the blocks its actual
+length needs, so a 32k-capable replica admits many short requests at
+once.
+
+Static-shape TPU design (no dynamic allocation inside jit):
+
+* The pool, tables, positions, and active mask are fixed-shape arrays;
+  :func:`~..models.llama.decode_chunk_paged` scans whole chunks in one
+  compiled program, writing each slot's row at ``(table[pos//bs],
+  pos%bs)`` with a single batched scatter and reading attention via a
+  block-table gather that reuses the contiguous cache's masked-GQA
+  implementation verbatim.
+* Allocation policy: **worst-case reservation, preemption-free** — at
+  admission a request reserves blocks for ``prompt_bucket +
+  max_new_tokens`` rows and keeps them until retirement.  Admission
+  defers (stays queued) when the pool cannot cover that; nothing can
+  run out of blocks mid-flight, so decode never preempts or restarts a
+  request.  The statistical win over the contiguous layout is that the
+  reservation is the REQUEST's worst case, not ``max_seq``.
+* Block 0 is reserved scratch: unallocated table entries point at it
+  and inactive slots write there; absolute-position masking keeps it
+  unattendable.
+
+Greedy outputs exactly match the contiguous server and per-request
+``generate_tokens`` (tested) — paging changes memory shape only.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import List, Optional
+
+import numpy as np
+
+from .continuous import ContinuousBatchingServer
+
+__all__ = ["PagedContinuousServer"]
+
+
+class PagedContinuousServer(ContinuousBatchingServer):
+    """Continuous batching over a paged KV pool.
+
+    ``total_blocks`` sizes the pool (excluding the scratch block);
+    default covers half of ``slots × max_seq`` — the break-even point
+    where paging admits the same worst case in half the HBM.
+    """
+
+    def __init__(self, config_name: str = "tiny", slots: int = 4,
+                 max_seq: Optional[int] = None, chunk_steps: int = 8,
+                 quantize: bool = False, eos_id: Optional[int] = None,
+                 seed: int = 0, quantize_kv: bool = False,
+                 block_size: int = 16,
+                 total_blocks: Optional[int] = None):
+        self.block_size = block_size
+        self._requested_blocks = total_blocks
+        super().__init__(config_name=config_name, slots=slots,
+                         max_seq=max_seq, chunk_steps=chunk_steps,
+                         quantize=quantize, eos_id=eos_id, seed=seed,
+                         quantize_kv=quantize_kv)
+
+    # ------------------------------------------------------------- #
+    # Layout hooks
+
+    def _init_layout(self):
+        block_size = self.block_size
+        if self.max_seq % block_size:
+            raise ValueError(
+                f"max_seq {self.max_seq} not a multiple of block_size "
+                f"{block_size}")
+        # Prompt buckets must land on block boundaries: raise the
+        # bucket floor to one block, and require the floor to be a
+        # block multiple (buckets double from the floor, so every
+        # bucket then is too).
+        self._bucket_minimum = max(self._bucket_minimum, block_size)
+        if self._bucket_minimum % block_size:
+            raise ValueError(
+                f"block_size {block_size} must divide the prompt "
+                f"bucket floor {self._bucket_minimum}")
+        max_blocks = self.max_seq // block_size
+        if self._requested_blocks is None:
+            usable = max(max_blocks,
+                         self.slots * max_blocks // 2)
+        else:
+            usable = self._requested_blocks
+        self.pool = self._llama.init_paged_cache(
+            self.config, usable + 1, block_size,
+            quantize_kv=self.quantize_kv)            # +1: scratch
+        self.tables = np.zeros((self.slots, max_blocks), np.int32)
+        self._free: List[int] = list(range(1, usable + 1))
+        self._owned: List[List[int]] = [[] for _ in range(self.slots)]
+
+    @property
+    def free_blocks(self) -> int:
+        return len(self._free)
+
+    def _blocks_for(self, rows: int) -> int:
+        return math.ceil(rows / self.block_size)
+
+    def _reserve_slot(self, slot: int, padded: int, request) -> bool:
+        # Worst case rows this request can ever touch: the padded
+        # prompt bucket (prefill writes all its rows) or the prompt +
+        # every generated token, whichever is larger — and never more
+        # than max_seq (submit() bounds prompt+new to max_seq-1, so the
+        # bucket-rounded sum may overshoot max_seq while the rows
+        # actually touched cannot).
+        rows = min(padded + request.max_new_tokens, self.max_seq)
+        needed = self._blocks_for(rows)
+        if needed > len(self._free):
+            return False               # pool exhausted: defer
+        blocks = [self._free.pop() for _ in range(needed)]
+        self._owned[slot] = blocks
+        row = np.zeros(self.tables.shape[1], np.int32)
+        row[:needed] = blocks
+        self.tables[slot] = row
+        return True
+
+    def _insert_prefix(self, slot: int, bucket_cache, padded: int):
+        jnp = self._jnp
+        self.pool = self._llama.paged_insert_prefix(
+            self.pool, jnp.asarray(self.tables), bucket_cache,
+            jnp.int32(slot))
+
+    def _release_slot(self, slot: int) -> None:
+        self._free.extend(self._owned[slot])
+        self._owned[slot] = []
+        self.tables[slot] = 0
+
+    def _run_chunk(self, steps: int, sampling):
+        jnp = self._jnp
+        out, self.tokens, self.positions, self.pool = \
+            self._llama.decode_chunk_paged(
+                self.params, self.tokens, self.pool,
+                jnp.asarray(self.tables), self.positions, self.active,
+                steps, self.config, **sampling)
+        return out

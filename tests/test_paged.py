@@ -1,0 +1,155 @@
+"""Paged-KV continuous batching: exactness vs the per-request greedy
+oracle and the contiguous server, block accounting, and admission
+deferral under pool pressure."""
+
+import numpy as np
+
+from aiko_services_tpu.models import llama
+from aiko_services_tpu.orchestration.continuous import (
+    ContinuousBatchingServer, DecodeRequest,
+)
+from aiko_services_tpu.orchestration.paged import PagedContinuousServer
+
+from .test_continuous import reference_greedy
+
+
+def _requests(config, spec, seed=0):
+    rng = np.random.default_rng(seed)
+    out = []
+    for i, (plen, new) in enumerate(spec):
+        prompt = rng.integers(1, config.vocab_size, plen).astype(np.int32)
+        out.append(DecodeRequest(request_id=f"r{i}", prompt=prompt,
+                                 max_new_tokens=new))
+    return out
+
+
+def test_paged_matches_per_request_greedy():
+    """Requests through 2 slots with queueing + slot/block reuse: every
+    output matches the per-request greedy oracle exactly."""
+    server = PagedContinuousServer(config_name="tiny", slots=2,
+                                   max_seq=96, chunk_steps=4, seed=3,
+                                   block_size=16)
+    requests = _requests(server.config,
+                         [(5, 6), (11, 3), (3, 9), (17, 5), (24, 7)])
+    for request in requests:
+        server.submit(request)
+    finished = server.run_until_drained()
+    assert sorted(r.request_id for r in finished) == \
+        sorted(r.request_id for r in requests)
+    for request in requests:
+        want = reference_greedy(server, request.prompt,
+                                request.max_new_tokens)
+        assert request.tokens == want, (request.request_id,
+                                        request.tokens, want)
+
+
+def test_paged_matches_contiguous_server():
+    """Same request stream through both layouts → identical outputs
+    (paging changes memory shape only)."""
+    spec = [(7, 5), (13, 4), (4, 8)]
+    outs = {}
+    for cls in (ContinuousBatchingServer, PagedContinuousServer):
+        server = cls(config_name="tiny", slots=2, max_seq=64,
+                     chunk_steps=3, seed=5)
+        for request in _requests(server.config, spec, seed=9):
+            server.submit(request)
+        finished = server.run_until_drained()
+        outs[cls.__name__] = {r.request_id: r.tokens for r in finished}
+    assert outs["ContinuousBatchingServer"] == \
+        outs["PagedContinuousServer"]
+
+
+def test_paged_block_accounting_and_reuse():
+    """Blocks are reserved worst-case at admission and ALL return to
+    the pool at retirement."""
+    server = PagedContinuousServer(config_name="tiny", slots=2,
+                                   max_seq=64, chunk_steps=4,
+                                   block_size=16, total_blocks=8)
+    assert server.free_blocks == 8
+    [request] = _requests(server.config, [(10, 6)])
+    server.submit(request)
+    server.step()
+    # bucket(10)=16 rows + 6 new = 22 rows -> 2 blocks of 16.
+    assert server.free_blocks == 6
+    assert np.count_nonzero(server.tables[0]) == 2
+    server.run_until_drained()
+    assert server.free_blocks == 8
+    assert not server.tables.any()
+
+
+def test_paged_admission_defers_until_blocks_free():
+    """With a pool sized for ONE request, the second stays queued (not
+    errored) until the first retires, then completes with oracle-exact
+    output."""
+    server = PagedContinuousServer(config_name="tiny", slots=2,
+                                   max_seq=64, chunk_steps=4,
+                                   block_size=16, total_blocks=2)
+    requests = _requests(server.config, [(10, 6), (9, 5)])
+    for request in requests:
+        server.submit(request)
+    server.step()
+    # Only r0 admitted (2 blocks); r1 deferred in queue.
+    assert server.free_blocks == 0
+    assert len(server._queue) == 1
+    finished = server.run_until_drained()
+    assert sorted(r.request_id for r in finished) == ["r0", "r1"]
+    for request in requests:
+        want = reference_greedy(server, request.prompt,
+                                request.max_new_tokens)
+        assert request.tokens == want
+
+
+def test_paged_quantized_kv_composes():
+    """int8 KV pool: same requests complete; outputs match the
+    quantized contiguous server exactly (identical quantized math,
+    different memory shape)."""
+    spec = [(6, 5), (12, 4)]
+    outs = {}
+    for cls in (ContinuousBatchingServer, PagedContinuousServer):
+        server = cls(config_name="tiny", slots=2, max_seq=64,
+                     chunk_steps=3, seed=2, quantize_kv=True)
+        for request in _requests(server.config, spec, seed=4):
+            server.submit(request)
+        finished = server.run_until_drained()
+        outs[cls.__name__] = {r.request_id: r.tokens for r in finished}
+    assert outs["ContinuousBatchingServer"] == \
+        outs["PagedContinuousServer"]
+
+
+def test_paged_bucket_overshoot_still_admits():
+    """A request whose power-of-2 prompt bucket + budget overshoots
+    max_seq must still admit (reservation is capped at max_seq rows) —
+    regression: this livelocked the whole queue."""
+    server = PagedContinuousServer(config_name="tiny", slots=2,
+                                   max_seq=64, chunk_steps=4,
+                                   block_size=16)
+    [request] = _requests(server.config, [(33, 30)])  # bucket 64+30>64
+    server.submit(request)
+    finished = server.run_until_drained(max_chunks=100)
+    assert [r.request_id for r in finished] == ["r0"]
+    assert request.tokens == reference_greedy(server, request.prompt, 30)
+
+
+def test_paged_large_block_size_aligns_buckets():
+    """block_size larger than the default 16-row bucket floor raises
+    the floor so prefill buckets stay block-aligned — regression: this
+    crashed mid-admission and leaked the reserved blocks."""
+    server = PagedContinuousServer(config_name="tiny", slots=2,
+                                   max_seq=64, chunk_steps=4,
+                                   block_size=32)
+    [request] = _requests(server.config, [(5, 4)])
+    server.submit(request)
+    finished = server.run_until_drained(max_chunks=100)
+    assert finished[0].tokens == reference_greedy(server,
+                                                  request.prompt, 4)
+    assert server.free_blocks == len(server._free)
+
+
+def test_paged_pool_smaller_than_contiguous():
+    """The default pool is half the contiguous reservation (the whole
+    point); per-layer pool rows = (total_blocks+1) * block_size."""
+    server = PagedContinuousServer(config_name="tiny", slots=4,
+                                   max_seq=128, block_size=16)
+    contiguous_rows = 4 * 128
+    pool_rows = server.pool[0]["k"].shape[0] * server.block_size
+    assert pool_rows <= contiguous_rows // 2 + server.block_size
