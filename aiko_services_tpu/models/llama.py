@@ -357,14 +357,39 @@ def forward(params, tokens, config: LlamaConfig, use_flash: bool = True):
 
 def init_cache(config: LlamaConfig, batch: int,
                max_seq: Optional[int] = None,
-               quantize_kv: bool = False) -> list:
+               quantize_kv: bool = False,
+               rolling: bool = False) -> list:
     """KV cache: list (one per layer) of dicts.  ``quantize_kv`` stores
     K/V as int8 with per-(token, kv-head) f32 scales — halves KV bytes
     per decode step AND cache HBM footprint, which is what bounds batch
-    (and therefore throughput) at long context.  Every decode/prefill
-    path handles either layout transparently."""
-    max_seq = max_seq or config.max_seq_len
-    shape = (batch, max_seq, config.n_kv_heads, config.head_dim)
+    (and therefore throughput) at long context.  ``rolling`` (requires
+    ``config.sliding_window``) keeps only the last ``window`` rows in a
+    ring buffer — row ``pos % window`` — with each row's ABSOLUTE
+    position stored for masking, so sliding-window decode memory is
+    O(window) instead of O(max_seq).  The plain decode paths (prefill,
+    chunked prefill, decode_step, generate_tokens) handle any layout;
+    :func:`decode_chunk_ragged`'s slot-scratch trick is incompatible
+    with rolling and rejects it."""
+    if rolling:
+        if not config.sliding_window:
+            raise ValueError("rolling cache requires sliding_window")
+        rows = config.sliding_window
+    else:
+        rows = max_seq or config.max_seq_len
+    cache = _kv_layer_buffers(
+        config, (batch, rows, config.n_kv_heads, config.head_dim),
+        quantize_kv)
+    if rolling:
+        for layer in cache:
+            # -1 = "row never written": masked out by the position test.
+            layer["pos"] = jnp.full((batch, rows), -1, jnp.int32)
+    return cache
+
+
+def _kv_layer_buffers(config: LlamaConfig, shape, quantize_kv: bool):
+    """Per-layer KV buffer dicts — the ONE place the cache layout
+    (dtypes, scale keys) is defined; the contiguous cache and the
+    paged pool differ only in the shape they pass."""
     if quantize_kv:
         sshape = shape[:-1]
         return [{"k": jnp.zeros(shape, jnp.int8),
@@ -388,43 +413,64 @@ def _kv_quantize(rows):
     return q, scale
 
 
+def _quantize_pairs(cache_layer, k, v):
+    """(key → source) map for a write: k/v plus int8 scales when the
+    layer is quantized."""
+    if "ks" in cache_layer:
+        kq, ks = _kv_quantize(k)
+        vq, vs = _kv_quantize(v)
+        return {"k": kq, "v": vq, "ks": ks, "vs": vs}
+    return {"k": k, "v": v}
+
+
 def _cache_write_slab(cache_layer, k, v, start_index):
     """Write a contiguous (batch, K, kv, hd) slab at ``start_index``
-    (prefill / chunked-prefill path), either layout."""
+    (prefill / chunked-prefill path), any layout.  Rolling layout: only
+    the last ``window`` slab rows can survive, so just those are
+    scattered at ``pos % window`` (unique targets) and their absolute
+    positions recorded."""
+    if "pos" in cache_layer:
+        window = cache_layer["pos"].shape[1]
+        seq = k.shape[1]
+        effective = min(seq, window)
+        positions = start_index + jnp.arange(seq)[-effective:]
+        rows = positions % window
+        updated = {}
+        for key, src in _quantize_pairs(cache_layer, k[:, -effective:],
+                                        v[:, -effective:]).items():
+            buf = cache_layer[key]
+            updated[key] = buf.at[:, rows].set(src.astype(buf.dtype))
+        updated["pos"] = cache_layer["pos"].at[:, rows].set(positions)
+        return updated
+
     def dus(dst, src, start):
         zeros = (0,) * (dst.ndim - 2)
         return jax.lax.dynamic_update_slice(
             dst, src.astype(dst.dtype), (0, start) + zeros)
-    if "ks" in cache_layer:
-        kq, ks = _kv_quantize(k)
-        vq, vs = _kv_quantize(v)
-        return {"k": dus(cache_layer["k"], kq, start_index),
-                "v": dus(cache_layer["v"], vq, start_index),
-                "ks": dus(cache_layer["ks"], ks, start_index),
-                "vs": dus(cache_layer["vs"], vs, start_index)}
-    return {"k": dus(cache_layer["k"], k, start_index),
-            "v": dus(cache_layer["v"], v, start_index)}
+    return {key: dus(cache_layer[key], src, start_index)
+            for key, src in _quantize_pairs(cache_layer, k, v).items()}
 
 
 def _cache_write_rows(cache_layer, k, v, positions):
     """Write one (batch, 1, kv, hd) row per batch element at per-row
-    ``positions`` (ragged decode path), either layout.  vmapped
+    ``positions`` (ragged decode path), any layout.  vmapped
     dynamic_update_slice lowers to an in-place scatter under
     donation."""
-    def write_row(rows, new, pos):
-        zeros = (0,) * (rows.ndim - 1)
+    window = cache_layer["pos"].shape[1] if "pos" in cache_layer else None
+    rows = positions % window if window else positions
+
+    def write_row(buf_rows, new, row):
+        zeros = (0,) * (buf_rows.ndim - 1)
         return jax.lax.dynamic_update_slice(
-            rows, new.astype(rows.dtype), (pos,) + zeros)
+            buf_rows, new.astype(buf_rows.dtype), (row,) + zeros)
     write = jax.vmap(write_row)
-    if "ks" in cache_layer:
-        kq, ks = _kv_quantize(k)
-        vq, vs = _kv_quantize(v)
-        return {"k": write(cache_layer["k"], kq, positions),
-                "v": write(cache_layer["v"], vq, positions),
-                "ks": write(cache_layer["ks"], ks, positions),
-                "vs": write(cache_layer["vs"], vs, positions)}
-    return {"k": write(cache_layer["k"], k, positions),
-            "v": write(cache_layer["v"], v, positions)}
+    updated = {key: write(cache_layer[key], src, rows)
+               for key, src in _quantize_pairs(cache_layer, k, v).items()}
+    if window:
+        updated["pos"] = write(cache_layer["pos"],
+                               positions[:, None].astype(jnp.int32),
+                               rows)
+    return updated
 
 
 @functools.partial(jax.jit, static_argnames=("config",))
@@ -474,17 +520,10 @@ def init_paged_cache(config: LlamaConfig, n_blocks: int,
                      quantize_kv: bool = False) -> list:
     """Block pool, one dict per layer.  ``n_blocks`` INCLUDES the
     reserved scratch block 0."""
-    shape = (n_blocks, block_size, config.n_kv_heads, config.head_dim)
-    if quantize_kv:
-        sshape = shape[:-1]
-        return [{"k": jnp.zeros(shape, jnp.int8),
-                 "v": jnp.zeros(shape, jnp.int8),
-                 "ks": jnp.ones(sshape, jnp.float32),
-                 "vs": jnp.ones(sshape, jnp.float32)}
-                for _ in range(config.n_layers)]
-    return [{"k": jnp.zeros(shape, config.dtype),
-             "v": jnp.zeros(shape, config.dtype)}
-            for _ in range(config.n_layers)]
+    return _kv_layer_buffers(
+        config,
+        (n_blocks, block_size, config.n_kv_heads, config.head_dim),
+        quantize_kv)
 
 
 def _paged_write_rows(pool_layer, k, v, tables, positions):
@@ -498,15 +537,9 @@ def _paged_write_rows(pool_layer, k, v, tables, positions):
     def scatter(pool, rows):
         return pool.at[block_ids, offsets].set(rows.astype(pool.dtype))
 
-    if "ks" in pool_layer:
-        kq, ks = _kv_quantize(k[:, 0])
-        vq, vs = _kv_quantize(v[:, 0])
-        return {"k": scatter(pool_layer["k"], kq),
-                "v": scatter(pool_layer["v"], vq),
-                "ks": scatter(pool_layer["ks"], ks),
-                "vs": scatter(pool_layer["vs"], vs)}
-    return {"k": scatter(pool_layer["k"], k[:, 0]),
-            "v": scatter(pool_layer["v"], v[:, 0])}
+    return {key: scatter(pool_layer[key], src)
+            for key, src in _quantize_pairs(pool_layer, k[:, 0],
+                                            v[:, 0]).items()}
 
 
 def _paged_gather(pool_layer, tables):
@@ -691,11 +724,18 @@ def _cached_gqa_attention(q, cache_layer, query_positions, hd,
     if quantized:
         # ks (b, s, kv) → (b, kv, 1, 1, s)
         s = s * cache_layer["ks"].transpose(0, 2, 1)[:, :, None, None, :]
-    key_pos = jnp.arange(k_cache.shape[1])
-    mask = key_pos[None, None, :] <= query_positions[:, :, None]
+    if "pos" in cache_layer:
+        # Rolling layout: each row stores its ABSOLUTE position (-1 =
+        # never written); visibility is decided from those, so ring
+        # wraparound needs no special casing.
+        key_pos = cache_layer["pos"][:, None, :]     # (b, 1, S)
+        mask = (key_pos >= 0) & (key_pos
+                                 <= query_positions[:, :, None])
+    else:
+        key_pos = jnp.arange(k_cache.shape[1])[None, None, :]
+        mask = key_pos <= query_positions[:, :, None]
     if window is not None:
-        mask &= (key_pos[None, None, :]
-                 > query_positions[:, :, None] - window)
+        mask &= key_pos > query_positions[:, :, None] - window
     s = jnp.where(mask[:, None, None, :, :], s, -1e30)
     weights = jax.nn.softmax(s, axis=-1)
     if quantized:
@@ -771,9 +811,17 @@ def decode_chunk_ragged(params, tokens, cache, positions, active,
     neighbors sample (mixed batches; tested).  ``None`` (trace-time)
     compiles the pure-greedy program with no sampling math.
 
+    Not for ROLLING caches: the inactive-slot scratch row (max_seq-1)
+    is a live ring row there.  Rolling serves the plain decode path
+    (prefill/generate_tokens/decode_step).
+
     Returns (tokens_out (batch, num_steps), last_token (batch, 1),
     positions (batch,), cache).
     """
+    if "pos" in cache[0]:
+        raise ValueError(
+            "decode_chunk_ragged does not support rolling caches: the "
+            "inactive-slot scratch row would land on a live ring row")
     max_seq = cache[0]["k"].shape[1]
 
     def step_core(token, cache, positions):

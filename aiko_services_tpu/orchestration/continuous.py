@@ -148,11 +148,21 @@ class ContinuousBatchingServer:
     def submit(self, request: DecodeRequest) -> None:
         request.tokens = []
         prompt_len = int(np.asarray(request.prompt).shape[0])
-        if prompt_len + request.max_new_tokens > self.max_seq - 1:
-            request.error = "prompt_too_long"
+        reason = self._admission_reject(prompt_len, request)
+        if reason:
+            request.error = reason
             self.completed.append(request)
             return
         self._queue.append(request)
+
+    def _admission_reject(self, prompt_len: int,
+                          request: DecodeRequest) -> Optional[str]:
+        """Reject hook: a non-None reason fails the request at submit
+        time (never queue what can never run — a deferred-forever head
+        request would starve the whole FIFO)."""
+        if prompt_len + request.max_new_tokens > self.max_seq - 1:
+            return "prompt_too_long"
+        return None
 
     @property
     def busy(self) -> bool:

@@ -92,6 +92,7 @@ class PagedContinuousServer(ContinuousBatchingServer):
             self.config, usable + 1, block_size,
             quantize_kv=self.quantize_kv)            # +1: scratch
         self.tables = np.zeros((self.slots, max_blocks), np.int32)
+        self.total_blocks = usable
         self._free: List[int] = list(range(1, usable + 1))
         self._owned: List[List[int]] = [[] for _ in range(self.slots)]
 
@@ -101,6 +102,25 @@ class PagedContinuousServer(ContinuousBatchingServer):
 
     def _blocks_for(self, rows: int) -> int:
         return math.ceil(rows / self.block_size)
+
+    def _worst_case_blocks(self, prompt_len: int, max_new: int) -> int:
+        from .continuous import _bucket
+        padded = min(_bucket(prompt_len, self._bucket_minimum),
+                     self.max_seq)
+        return self._blocks_for(min(padded + max_new, self.max_seq))
+
+    def _admission_reject(self, prompt_len: int, request):
+        reason = super()._admission_reject(prompt_len, request)
+        if reason:
+            return reason
+        # Never queue what can never run: a head request whose worst
+        # case exceeds the WHOLE pool would defer forever and starve
+        # the FIFO behind it.
+        if self._worst_case_blocks(prompt_len,
+                                   request.max_new_tokens) \
+                > self.total_blocks:
+            return "request_exceeds_pool"
+        return None
 
     def _reserve_slot(self, slot: int, padded: int, request) -> bool:
         # Worst case rows this request can ever touch: the padded

@@ -313,6 +313,57 @@ def test_mistral_window_decode_matches_forward():
             rtol=4e-2, atol=4e-2)
 
 
+def test_rolling_cache_matches_full_cache_windowed_decode():
+    """Ring-buffer cache (rows = window) must reproduce the full-cache
+    windowed decode exactly past several wraparounds: greedy tokens
+    equal, logits match to float tolerance (row permutation only
+    changes summation order of exact-zero masked terms)."""
+    config = llama.CONFIGS["mistral_tiny"]   # window 16
+    params = llama.init_params(config, jax.random.PRNGKey(3))
+    seq = 24
+    tokens = jax.random.randint(jax.random.PRNGKey(6), (1, seq),
+                                0, config.vocab_size, jnp.int32)
+
+    outs = {}
+    for rolling in (False, True):
+        cache = llama.init_cache(config, 1, 96, rolling=rolling)
+        if rolling:
+            assert cache[0]["k"].shape[1] == config.sliding_window
+        logits, cache = llama.prefill(params, tokens, cache, config)
+        tok = logits[:, -1].argmax(-1).astype(jnp.int32)[:, None]
+        generated, _ = llama.generate_tokens(
+            params, tok, cache, jnp.int32(seq), 40, config)  # wraps 2x
+        outs[rolling] = (np.asarray(logits), np.asarray(generated))
+    np.testing.assert_allclose(outs[True][0], outs[False][0],
+                               rtol=2e-2, atol=2e-2)
+    np.testing.assert_array_equal(outs[True][1], outs[False][1])
+
+
+def test_rolling_cache_quantized_kv_composes():
+    """int8 KV + ring buffer together: decode runs and tracks the
+    full-cache quantized decode."""
+    config = llama.CONFIGS["mistral_tiny"]
+    params = llama.init_params(config, jax.random.PRNGKey(3))
+    tokens = jax.random.randint(jax.random.PRNGKey(7), (2, 20),
+                                0, config.vocab_size, jnp.int32)
+    outs = {}
+    for rolling in (False, True):
+        cache = llama.init_cache(config, 2, 80, quantize_kv=True,
+                                 rolling=rolling)
+        logits, cache = llama.prefill(params, tokens, cache, config)
+        tok = logits[:, -1].argmax(-1).astype(jnp.int32)[:, None]
+        generated, _ = llama.generate_tokens(
+            params, tok, cache, jnp.int32(20), 24, config)
+        outs[rolling] = np.asarray(generated)
+    assert (outs[True] == outs[False]).mean() >= 0.9
+
+
+def test_rolling_cache_requires_window(tiny):
+    config, _ = tiny
+    with pytest.raises(ValueError, match="sliding_window"):
+        llama.init_cache(config, 1, 64, rolling=True)
+
+
 def test_mistral_window_changes_output_vs_full_causal():
     """Sanity: with seq > window the windowed model must NOT equal the
     unwindowed one (the mask actually bites)."""

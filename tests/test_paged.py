@@ -142,7 +142,23 @@ def test_paged_large_block_size_aligns_buckets():
     finished = server.run_until_drained(max_chunks=100)
     assert finished[0].tokens == reference_greedy(server,
                                                   request.prompt, 4)
-    assert server.free_blocks == len(server._free)
+    assert server.free_blocks == server.total_blocks
+
+
+def test_paged_rejects_request_exceeding_pool():
+    """A request whose worst case can NEVER fit the pool fails at
+    submit (error response) instead of starving the queue forever."""
+    server = PagedContinuousServer(config_name="tiny", slots=2,
+                                   max_seq=64, chunk_steps=4,
+                                   block_size=16, total_blocks=2)
+    big, ok = _requests(server.config, [(33, 10), (5, 4)])
+    server.submit(big)      # bucket 64 rows -> 4 blocks > 2 total
+    server.submit(ok)
+    finished = server.run_until_drained(max_chunks=100)
+    by_id = {r.request_id: r for r in finished}
+    assert by_id["r0"].error == "request_exceeds_pool"
+    assert by_id["r1"].error is None
+    assert by_id["r1"].tokens == reference_greedy(server, ok.prompt, 4)
 
 
 def test_paged_pool_smaller_than_contiguous():
