@@ -33,7 +33,8 @@ __all__ = ["LlamaConfig", "init_params", "forward", "init_cache",
            "quantize_params", "pipeline_forward", "stack_pipeline_params",
            "decode_chunk_ragged", "prefill_chunk", "sample_logits",
            "init_paged_cache", "decode_chunk_paged",
-           "paged_insert_prefix", "CONFIGS"]
+           "paged_insert_prefix", "paged_scatter_blocks",
+           "paged_gather_blocks", "CONFIGS"]
 
 
 @dataclasses.dataclass(frozen=True)
@@ -669,20 +670,54 @@ def paged_insert_prefix(pool, tables, prefix_cache, slot):
     blocks.  ``tables`` (slots, max_blocks); padded must be a multiple
     of the pool block size."""
     block_size = pool[0]["k"].shape[1]
+    padded = prefix_cache[0]["k"].shape[1]
+    n_blocks = padded // block_size
+    block_ids = jax.lax.dynamic_slice_in_dim(
+        tables[slot], 0, n_blocks, 0)
+    return paged_scatter_blocks(pool, block_ids, prefix_cache,
+                                jnp.int32(0))
+
+
+@functools.partial(jax.jit, donate_argnames=("pool",))
+def paged_scatter_blocks(pool, block_ids, prefix_cache, start_block):
+    """Write contiguous prefilled rows into explicit pool blocks:
+    prefix rows ``[start_block*bs, (start_block+len(ids))*bs)`` land in
+    ``pool[block_ids]`` (prefix-cache tail insertion writes ONLY the
+    private tail blocks; shared prefix blocks are never touched)."""
+    block_size = pool[0]["k"].shape[1]
+    n_blocks = block_ids.shape[0]
     new_pool = []
     for pool_layer, prefix_layer in zip(pool, prefix_cache):
         padded = prefix_layer["k"].shape[1]
-        n_blocks = padded // block_size
-        block_ids = jax.lax.dynamic_slice_in_dim(
-            tables[slot], 0, n_blocks, 0)
         updated = {}
         for key, buf in pool_layer.items():
             src = prefix_layer[key][0]
-            blocked = src.reshape((n_blocks, block_size)
+            blocked = src.reshape((padded // block_size, block_size)
                                   + src.shape[1:]).astype(buf.dtype)
-            updated[key] = buf.at[block_ids].set(blocked)
+            sel = jax.lax.dynamic_slice_in_dim(blocked, start_block,
+                                               n_blocks, 0)
+            updated[key] = buf.at[block_ids].set(sel)
         new_pool.append(updated)
     return new_pool
+
+
+@functools.partial(jax.jit, donate_argnames=("bucket",))
+def paged_gather_blocks(pool, block_ids, bucket):
+    """Read ``pool[block_ids]`` into the FIRST ``len(ids)*bs`` rows of
+    a contiguous bucket cache (prefix-cache admission: materialize the
+    shared prefix so the tail's chunked prefill can attend over it)."""
+    block_size = pool[0]["k"].shape[1]
+    rows = block_ids.shape[0] * block_size
+    new_bucket = []
+    for pool_layer, bucket_layer in zip(pool, bucket):
+        updated = {}
+        for key, buf in bucket_layer.items():
+            src = pool_layer[key][block_ids]
+            flat = src.reshape((rows,) + src.shape[2:])
+            updated[key] = buf.at[:, :rows].set(
+                flat[None].astype(buf.dtype))
+        new_bucket.append(updated)
+    return new_bucket
 
 
 def _decode_core(params, token, cache, cache_index, config: LlamaConfig):

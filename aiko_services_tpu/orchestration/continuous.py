@@ -187,11 +187,8 @@ class ContinuousBatchingServer:
             self._queue.pop(0)
             prompt_padded = np.zeros((1, padded), np.int32)
             prompt_padded[:, :prompt_len] = prompt
-            bucket_cache = llama.init_cache(
-                self.config, 1, padded, quantize_kv=self.quantize_kv)
-            _, bucket_cache = llama.prefill(
-                self.params, jnp.asarray(prompt_padded), bucket_cache,
-                self.config)
+            bucket_cache = self._prefill_bucket(slot, prompt_padded,
+                                                prompt_len)
             self._insert_prefix(slot, bucket_cache, padded)
             # Seed with the last prompt token at its own position: the
             # next chunk's first step re-writes that KV row with the
@@ -210,6 +207,19 @@ class ContinuousBatchingServer:
         """Capacity hook: claim layout resources for an admission.
         Contiguous layout always has room (the slot IS the room)."""
         return True
+
+    def _prefill_bucket(self, slot: int, prompt_padded, prompt_len: int):
+        """Prefill hook: run the padded prompt into a fresh bucket
+        cache.  (The prefix-caching paged server overrides this to
+        prefill only the uncached tail.)"""
+        llama, jnp = self._llama, self._jnp
+        bucket_cache = llama.init_cache(
+            self.config, 1, prompt_padded.shape[1],
+            quantize_kv=self.quantize_kv)
+        _, bucket_cache = llama.prefill(
+            self.params, jnp.asarray(prompt_padded), bucket_cache,
+            self.config)
+        return bucket_cache
 
     def _insert_prefix(self, slot: int, bucket_cache, padded: int):
         """Layout hook: land a prefilled bucket in ``slot``."""

@@ -34,6 +34,7 @@ Greedy outputs exactly match the contiguous server and per-request
 from __future__ import annotations
 
 import math
+from collections import OrderedDict
 from typing import List, Optional
 
 import numpy as np
@@ -56,9 +57,11 @@ class PagedContinuousServer(ContinuousBatchingServer):
                  quantize: bool = False, eos_id: Optional[int] = None,
                  seed: int = 0, quantize_kv: bool = False,
                  block_size: int = 16,
-                 total_blocks: Optional[int] = None):
+                 total_blocks: Optional[int] = None,
+                 enable_prefix_cache: bool = False):
         self.block_size = block_size
         self._requested_blocks = total_blocks
+        self.enable_prefix_cache = enable_prefix_cache
         super().__init__(config_name=config_name, slots=slots,
                          max_seq=max_seq, chunk_steps=chunk_steps,
                          quantize=quantize, eos_id=eos_id, seed=seed,
@@ -95,6 +98,20 @@ class PagedContinuousServer(ContinuousBatchingServer):
         self.total_blocks = usable
         self._free: List[int] = list(range(1, usable + 1))
         self._owned: List[List[int]] = [[] for _ in range(self.slots)]
+        # Prefix cache state (content-addressed blocks):
+        #   _index: chain-key -> block_id for every cached FULL prompt
+        #     block (key = (parent_key, tokens-in-block tuple))
+        #   _block_key / _refs: reverse map + per-block reference count
+        #   _evictable: zero-ref cached blocks in LRU order
+        #   _pending: per-slot (n_shared_blocks,) staged between
+        #     _reserve_slot and _prefill_bucket
+        self._index: dict = {}
+        self._block_key: dict = {}
+        self._refs: dict = {}
+        self._evictable: "OrderedDict[object, int]" = OrderedDict()
+        self._pending_shared: List[int] = [0] * self.slots
+        self.prefix_hits = 0
+        self.prefix_blocks_reused = 0
 
     @property
     def free_blocks(self) -> int:
@@ -122,6 +139,60 @@ class PagedContinuousServer(ContinuousBatchingServer):
             return "request_exceeds_pool"
         return None
 
+    # ------------------------------------------------------------- #
+    # Prefix cache (content-addressed full prompt blocks)
+
+    def _chain_keys(self, prompt) -> List:
+        """Chained content keys, one per FULL prompt block: a block's
+        key folds in its predecessor's, so equal keys imply equal
+        whole-prefix token histories (vLLM's hashing scheme)."""
+        bs = self.block_size
+        keys: List = []
+        parent = None
+        for i in range(len(prompt) // bs):
+            key = (parent,
+                   tuple(int(t) for t in prompt[i * bs:(i + 1) * bs]))
+            keys.append(key)
+            parent = key
+        return keys
+
+    def _shareable_blocks(self, prompt_len: int) -> int:
+        """Blocks safe to SHARE: full blocks strictly before position
+        ``prompt_len - 1`` — the admission seed rewrites the last
+        prompt position's KV row, and a rewrite (bit-identical in
+        principle, batch-width rounding in practice) must never land
+        in a block other requests read."""
+        return max(0, (prompt_len - 1) // self.block_size)
+
+    def _is_descendant(self, key, ancestor) -> bool:
+        parent = key[0]
+        while parent is not None:
+            if parent == ancestor:
+                return True
+            parent = parent[0]
+        return False
+
+    def _purge_cached(self, key, block) -> None:
+        self._index.pop(key, None)
+        self._evictable.pop(key, None)
+        self._block_key.pop(block, None)
+        self._refs.pop(block, None)
+        self._free.append(block)
+
+    def _evict_until(self, needed: int) -> None:
+        """Evict zero-ref cached chains (LRU) until ``needed`` free
+        blocks exist.  Evicting a block CASCADES to its descendants —
+        a chain must stay rooted or later registrations would overwrite
+        stale descendant bindings and leak blocks.  (Descendants of a
+        zero-ref block are always zero-ref themselves: every owner of a
+        descendant owns the whole prefix path.)"""
+        while len(self._free) < needed and self._evictable:
+            key, block = self._evictable.popitem(last=False)   # LRU
+            self._purge_cached(key, block)
+            for other_key, other_block in list(self._evictable.items()):
+                if self._is_descendant(other_key, key):
+                    self._purge_cached(other_key, other_block)
+
     def _reserve_slot(self, slot: int, padded: int, request) -> bool:
         # Worst case rows this request can ever touch: the padded
         # prompt bucket (prefill writes all its rows) or the prompt +
@@ -131,24 +202,114 @@ class PagedContinuousServer(ContinuousBatchingServer):
         # actually touched cannot).
         rows = min(padded + request.max_new_tokens, self.max_seq)
         needed = self._blocks_for(rows)
-        if needed > len(self._free):
-            return False               # pool exhausted: defer
-        blocks = [self._free.pop() for _ in range(needed)]
+
+        prompt = np.asarray(request.prompt)
+        shared: List[int] = []
+        keys: List = []
+        if self.enable_prefix_cache:
+            keys = self._chain_keys(prompt)[
+                :self._shareable_blocks(len(prompt))]
+            for key in keys:
+                block = self._index.get(key)
+                if block is None:
+                    break
+                shared.append(block)
+            # Bound the compile count: the hit path's program shapes
+            # depend on the shared length, so round it DOWN to a power
+            # of two (0, 1, 2, 4, …) — log-many gather/tail shapes per
+            # prompt bucket instead of one per prefix length.
+            if shared:
+                usable_shared = 1 << (len(shared).bit_length() - 1)
+                shared = shared[:usable_shared]
+        # PIN the hits before any eviction (eviction must never free a
+        # block we are about to reference), with rollback on deferral.
+        for block in shared:
+            self._refs[block] += 1
+            self._evictable.pop(self._block_key[block], None)
+        private_needed = needed - len(shared)
+        if private_needed > len(self._free) + len(self._evictable):
+            # Cannot admit even after a full cache flush — defer
+            # WITHOUT destroying cached prefixes for zero benefit.
+            for block in shared:
+                self._refs[block] -= 1
+                if self._refs[block] == 0:
+                    self._evictable[self._block_key[block]] = block
+            return False
+        self._evict_until(private_needed)
+        private = [self._free.pop() for _ in range(private_needed)]
+        blocks = shared + private
         self._owned[slot] = blocks
+        self._pending_shared[slot] = len(shared)
         row = np.zeros(self.tables.shape[1], np.int32)
         row[:needed] = blocks
         self.tables[slot] = row
+        if shared:
+            self.prefix_hits += 1
+            self.prefix_blocks_reused += len(shared)
+        # Register this prompt's remaining shareable blocks for future
+        # requests (their contents exist once _insert_prefix runs,
+        # which happens synchronously within this admission).  Keys
+        # already indexed are SKIPPED: the pow2 truncation above can
+        # leave found-but-unpinned hits whose bindings must not be
+        # overwritten (an overwrite would strand the old block in
+        # _evictable under a reused key — a permanent leak).
+        if self.enable_prefix_cache:
+            for position in range(len(shared), len(keys)):
+                if keys[position] in self._index:
+                    continue
+                block = blocks[position]
+                self._index[keys[position]] = block
+                self._block_key[block] = keys[position]
+                self._refs[block] = 1
         return True
+
+    def _prefill_bucket(self, slot: int, prompt_padded, prompt_len: int):
+        n_shared = self._pending_shared[slot]
+        if not n_shared:
+            return super()._prefill_bucket(slot, prompt_padded,
+                                           prompt_len)
+        # Prefix hit: materialize the shared blocks into the bucket and
+        # chunk-prefill ONLY the uncached tail (the whole point — the
+        # prefill FLOPs for the shared prefix are skipped).
+        llama, jnp = self._llama, self._jnp
+        padded = prompt_padded.shape[1]
+        bucket = llama.init_cache(self.config, 1, padded,
+                                  quantize_kv=self.quantize_kv)
+        shared_ids = jnp.asarray(self._owned[slot][:n_shared],
+                                 jnp.int32)
+        bucket = llama.paged_gather_blocks(self.pool, shared_ids,
+                                           bucket)
+        start = n_shared * self.block_size
+        _, bucket = llama.prefill_chunk(
+            self.params, jnp.asarray(prompt_padded[:, start:]), bucket,
+            jnp.int32(start), self.config)
+        return bucket
 
     def _insert_prefix(self, slot: int, bucket_cache, padded: int):
         jnp = self._jnp
-        self.pool = self._llama.paged_insert_prefix(
-            self.pool, jnp.asarray(self.tables), bucket_cache,
-            jnp.int32(slot))
+        n_shared = self._pending_shared[slot]
+        self._pending_shared[slot] = 0
+        n_total = padded // self.block_size
+        # Scatter only the PRIVATE tail blocks; shared prefix blocks
+        # are read-only to this request.
+        tail_ids = self._owned[slot][n_shared:n_total]
+        self.pool = self._llama.paged_scatter_blocks(
+            self.pool, jnp.asarray(tail_ids, jnp.int32), bucket_cache,
+            jnp.int32(n_shared))
 
     def _release_slot(self, slot: int) -> None:
-        self._free.extend(self._owned[slot])
+        for block in self._owned[slot]:
+            key = self._block_key.get(block)
+            if key is None:
+                self._free.append(block)        # plain private block
+                continue
+            self._refs[block] -= 1
+            if self._refs[block] == 0:
+                # Stays cached (index keeps it findable) but becomes
+                # evictable under pool pressure, LRU order.
+                self._evictable[key] = block
         self._owned[slot] = []
+        self._pending_shared[slot] = 0
         self.tables[slot] = 0
 
     def _run_chunk(self, steps: int, sampling):

@@ -161,6 +161,157 @@ def test_paged_rejects_request_exceeding_pool():
     assert by_id["r1"].tokens == reference_greedy(server, ok.prompt, 4)
 
 
+# --------------------------------------------------------------------------- #
+# Automatic prefix caching
+
+def test_prefix_cache_exact_and_reuses_blocks():
+    """Three requests sharing a 32-token system prefix: outputs equal
+    the non-cached server exactly; the 2nd and 3rd admissions reuse
+    the cached prefix blocks and skip the prefix prefill."""
+    rng = np.random.default_rng(12)
+    system = rng.integers(1, 1024, 32).astype(np.int32)
+    prompts = [np.concatenate([system,
+                               rng.integers(1, 1024, 7).astype(np.int32)])
+               for _ in range(3)]
+
+    outs = {}
+    for enabled in (False, True):
+        server = PagedContinuousServer(
+            config_name="tiny", slots=1, max_seq=96, chunk_steps=4,
+            block_size=16, enable_prefix_cache=enabled)
+        for i, prompt in enumerate(prompts):
+            server.submit(DecodeRequest(request_id=f"r{i}",
+                                        prompt=prompt,
+                                        max_new_tokens=5))
+        finished = server.run_until_drained()
+        outs[enabled] = {r.request_id: r.tokens for r in finished}
+        if enabled:
+            # Prefix = full blocks before position len(prompt)-1 =
+            # (39-1)//16 = 2 blocks; hit by requests 2 and 3.
+            assert server.prefix_hits == 2
+            assert server.prefix_blocks_reused == 4
+    assert outs[True] == outs[False]
+
+
+def test_prefix_cache_blocks_survive_retirement_and_accounting():
+    """Cached blocks stay out of the free list after retirement
+    (evictable, still indexed); free + evictable always equals the
+    whole pool when no request is live."""
+    rng = np.random.default_rng(13)
+    prompt = rng.integers(1, 1024, 33).astype(np.int32)
+    server = PagedContinuousServer(
+        config_name="tiny", slots=1, max_seq=96, chunk_steps=4,
+        block_size=16, enable_prefix_cache=True)
+    server.submit(DecodeRequest(request_id="a", prompt=prompt,
+                                max_new_tokens=4))
+    server.run_until_drained()
+    cached = len(server._evictable)
+    assert cached == 2                      # (33-1)//16 full blocks
+    assert server.free_blocks + cached == server.total_blocks
+    # Same prompt again: hits the cache, nothing re-registered twice.
+    server.submit(DecodeRequest(request_id="b", prompt=prompt,
+                                max_new_tokens=4))
+    server.run_until_drained()
+    assert server.prefix_hits == 1
+    assert len(server._index) == 2
+    assert server.free_blocks + len(server._evictable) \
+        == server.total_blocks
+
+
+def test_prefix_cache_eviction_under_pressure():
+    """A tiny pool: cached blocks from a retired request are evicted
+    (LRU) to admit a new, different request — never deadlocks."""
+    rng = np.random.default_rng(14)
+    server = PagedContinuousServer(
+        config_name="tiny", slots=1, max_seq=64, chunk_steps=4,
+        block_size=16, total_blocks=4, enable_prefix_cache=True)
+    first = rng.integers(1, 1024, 33).astype(np.int32)
+    second = rng.integers(1, 1024, 40).astype(np.int32)
+    server.submit(DecodeRequest(request_id="a", prompt=first,
+                                max_new_tokens=8))
+    server.run_until_drained()
+    assert len(server._evictable) == 2
+    server.submit(DecodeRequest(request_id="b", prompt=second,
+                                max_new_tokens=8))
+    finished = server.run_until_drained()
+    assert finished[0].error is None
+    # The second prompt needed the whole pool: cached blocks evicted.
+    assert len(server._index) <= 2
+
+
+def test_prefix_cache_concurrent_slots_share_blocks():
+    """Two LIVE slots reading the same shared prefix blocks at once:
+    refcounts track both, outputs match the non-cached server, and one
+    retiring early does not free blocks the other still reads."""
+    rng = np.random.default_rng(16)
+    system = rng.integers(1, 1024, 32).astype(np.int32)
+    prompts = [np.concatenate([system,
+                               rng.integers(1, 1024, 6).astype(np.int32)])
+               for _ in range(2)]
+    outs = {}
+    for enabled in (False, True):
+        server = PagedContinuousServer(
+            config_name="tiny", slots=2, max_seq=96, chunk_steps=2,
+            block_size=16, total_blocks=12,
+            enable_prefix_cache=enabled)
+        # Different budgets so one slot retires chunks earlier.
+        for i, (prompt, new) in enumerate(zip(prompts, (3, 9))):
+            server.submit(DecodeRequest(request_id=f"r{i}",
+                                        prompt=prompt,
+                                        max_new_tokens=new))
+        server.step()       # both admitted in one pass; both live
+        if enabled:
+            shared = server._owned[1][:2]
+            assert server._owned[0][:2] == shared
+            assert all(server._refs[b] == 2 for b in shared)
+        finished = server.run_until_drained()
+        outs[enabled] = {r.request_id: r.tokens for r in finished}
+    assert outs[True] == outs[False]
+
+
+def test_prefix_cache_with_quantized_kv_matches():
+    """Prefix sharing composes with the int8 KV pool: cached-path
+    outputs equal the non-cached quantized server."""
+    rng = np.random.default_rng(15)
+    system = rng.integers(1, 1024, 32).astype(np.int32)
+    prompts = [np.concatenate([system,
+                               rng.integers(1, 1024, 5).astype(np.int32)])
+               for _ in range(2)]
+    outs = {}
+    for enabled in (False, True):
+        server = PagedContinuousServer(
+            config_name="tiny", slots=1, max_seq=96, chunk_steps=3,
+            block_size=16, quantize_kv=True,
+            enable_prefix_cache=enabled)
+        for i, prompt in enumerate(prompts):
+            server.submit(DecodeRequest(request_id=f"r{i}",
+                                        prompt=prompt,
+                                        max_new_tokens=4))
+        finished = server.run_until_drained()
+        outs[enabled] = {r.request_id: r.tokens for r in finished}
+    assert outs[True] == outs[False]
+
+
+def test_prefix_cache_pow2_truncation_leaks_nothing():
+    """A 3-block shareable prefix is pow2-truncated to 2 pinned hits;
+    the found-but-unpinned 3rd key must keep its original binding
+    (no overwrite-leak), and the pool stays fully accounted across
+    repeated admissions of the same prompt."""
+    rng = np.random.default_rng(17)
+    prompt = rng.integers(1, 1024, 55).astype(np.int32)  # shareable 3
+    server = PagedContinuousServer(
+        config_name="tiny", slots=1, max_seq=128, chunk_steps=4,
+        block_size=16, total_blocks=16, enable_prefix_cache=True)
+    for round_index in range(3):
+        server.submit(DecodeRequest(request_id=f"r{round_index}",
+                                    prompt=prompt, max_new_tokens=4))
+        server.run_until_drained()
+        assert (server.free_blocks + len(server._evictable)
+                == server.total_blocks), round_index
+    assert server.prefix_hits == 2
+    assert len(server._index) == 3          # k1,k2,k3 — no duplicates
+
+
 def test_paged_pool_smaller_than_contiguous():
     """The default pool is half the contiguous reservation (the whole
     point); per-layer pool rows = (total_blocks+1) * block_size."""
