@@ -10,3 +10,4 @@ from .continuous import (
     ContinuousBatchingServer, ContinuousReplica, DecodeRequest,
 )
 from .paged import PagedContinuousServer
+from .trainer import TrainerActor, TRAINER_PROTOCOL

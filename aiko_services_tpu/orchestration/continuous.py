@@ -170,8 +170,6 @@ class ContinuousBatchingServer:
             r is not None for r in self._requests)
 
     def _admit(self) -> None:
-        jnp = self._jnp
-        llama = self._llama
         for slot in range(self.slots):
             if self._requests[slot] is not None or not self._queue:
                 continue

@@ -33,6 +33,7 @@ Greedy outputs exactly match the contiguous server and per-request
 
 from __future__ import annotations
 
+import hashlib
 import math
 from collections import OrderedDict
 from typing import List, Optional
@@ -108,7 +109,11 @@ class PagedContinuousServer(ContinuousBatchingServer):
         self._index: dict = {}
         self._block_key: dict = {}
         self._refs: dict = {}
-        self._evictable: "OrderedDict[object, int]" = OrderedDict()
+        self._evictable: "OrderedDict[bytes, int]" = OrderedDict()
+        #: chain topology: child key -> parent key, and per-key count
+        #: of INDEXED children (leaf-first eviction reads this).
+        self._parent: dict = {}
+        self._children: dict = {}
         self._pending_shared: List[int] = [0] * self.slots
         self.prefix_hits = 0
         self.prefix_blocks_reused = 0
@@ -142,18 +147,20 @@ class PagedContinuousServer(ContinuousBatchingServer):
     # ------------------------------------------------------------- #
     # Prefix cache (content-addressed full prompt blocks)
 
-    def _chain_keys(self, prompt) -> List:
+    def _chain_keys(self, prompt) -> List[bytes]:
         """Chained content keys, one per FULL prompt block: a block's
-        key folds in its predecessor's, so equal keys imply equal
-        whole-prefix token histories (vLLM's hashing scheme)."""
+        key is the SHA-256 of (parent key ‖ block tokens), so equal
+        keys imply equal whole-prefix token histories (vLLM's hashing
+        scheme) at O(block) per key — no nested-tuple rehashing of the
+        whole ancestor history on every dict operation."""
         bs = self.block_size
-        keys: List = []
-        parent = None
+        keys: List[bytes] = []
+        parent = b""
         for i in range(len(prompt) // bs):
-            key = (parent,
-                   tuple(int(t) for t in prompt[i * bs:(i + 1) * bs]))
-            keys.append(key)
-            parent = key
+            block = np.ascontiguousarray(
+                prompt[i * bs:(i + 1) * bs], dtype=np.int32)
+            parent = hashlib.sha256(parent + block.tobytes()).digest()
+            keys.append(parent)
         return keys
 
     def _shareable_blocks(self, prompt_len: int) -> int:
@@ -164,34 +171,37 @@ class PagedContinuousServer(ContinuousBatchingServer):
         in a block other requests read."""
         return max(0, (prompt_len - 1) // self.block_size)
 
-    def _is_descendant(self, key, ancestor) -> bool:
-        parent = key[0]
-        while parent is not None:
-            if parent == ancestor:
-                return True
-            parent = parent[0]
-        return False
-
     def _purge_cached(self, key, block) -> None:
         self._index.pop(key, None)
         self._evictable.pop(key, None)
         self._block_key.pop(block, None)
         self._refs.pop(block, None)
+        parent = self._parent.pop(key, None)
+        if parent is not None and parent in self._children:
+            self._children[parent] -= 1
+            if self._children[parent] <= 0:
+                del self._children[parent]
+        self._children.pop(key, None)
         self._free.append(block)
 
+    def _evict_one(self) -> bool:
+        """Evict ONE zero-ref cached block: the least-recently-used
+        chain LEAF (no indexed children).  Leaf-first keeps chains
+        rooted — no stale descendant bindings — and frees exactly one
+        block per call instead of flushing a whole cached chain when a
+        single block would do.  A leaf always exists: an evictable
+        entry's indexed children are themselves evictable (owners of a
+        child own the whole prefix path)."""
+        for key, block in self._evictable.items():          # LRU order
+            if self._children.get(key, 0) == 0:
+                self._purge_cached(key, block)
+                return True
+        return False
+
     def _evict_until(self, needed: int) -> None:
-        """Evict zero-ref cached chains (LRU) until ``needed`` free
-        blocks exist.  Evicting a block CASCADES to its descendants —
-        a chain must stay rooted or later registrations would overwrite
-        stale descendant bindings and leak blocks.  (Descendants of a
-        zero-ref block are always zero-ref themselves: every owner of a
-        descendant owns the whole prefix path.)"""
-        while len(self._free) < needed and self._evictable:
-            key, block = self._evictable.popitem(last=False)   # LRU
-            self._purge_cached(key, block)
-            for other_key, other_block in list(self._evictable.items()):
-                if self._is_descendant(other_key, key):
-                    self._purge_cached(other_key, other_block)
+        while len(self._free) < needed:
+            if not self._evict_one():
+                break
 
     def _reserve_slot(self, slot: int, padded: int, request) -> bool:
         # Worst case rows this request can ever touch: the padded
@@ -255,12 +265,18 @@ class PagedContinuousServer(ContinuousBatchingServer):
         # _evictable under a reused key — a permanent leak).
         if self.enable_prefix_cache:
             for position in range(len(shared), len(keys)):
-                if keys[position] in self._index:
+                key = keys[position]
+                if key in self._index:
                     continue
                 block = blocks[position]
-                self._index[keys[position]] = block
-                self._block_key[block] = keys[position]
+                self._index[key] = block
+                self._block_key[block] = key
                 self._refs[block] = 1
+                if position > 0:
+                    parent = keys[position - 1]
+                    self._parent[key] = parent
+                    self._children[parent] = \
+                        self._children.get(parent, 0) + 1
         return True
 
     def _prefill_bucket(self, slot: int, prompt_padded, prompt_len: int):

@@ -1,0 +1,159 @@
+"""Training as a first-class service: a TPU training job that IS an
+actor on the control plane.
+
+The reference's operational story — every long-running thing is a
+Service with a topic, a share, a dashboard row, and remote controls
+(kill, log level; ``main/dashboard.py:565-648``) — applied to the one
+workload the reference never had: sharded model training.  The
+:class:`TrainerActor` wraps an :class:`~..parallel.elastic.ElasticTrainer`
+(checkpointed, cross-topology-resumable) and
+
+* pumps training steps from inside the event loop (delayed self-post,
+  the reference's own retry idiom) so control messages interleave with
+  compute instead of being starved by a blocking loop;
+* publishes live progress — step, loss, tokens/sec, state — into its
+  EC share, so ``aiko_dashboard`` and any ECConsumer watch a training
+  run exactly like any other service;
+* obeys wire controls: ``(pause)``, ``(resume)``, ``(save)``,
+  ``(stop)``, and ``(status response_topic)``.
+
+Together with LWT liveness this gives training runs the same failure
+semantics as every other service: a dead trainer process is evicted by
+the Registrar, and a new one on ANY topology resumes from the latest
+checkpoint (elastic restore).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Optional
+
+import numpy as np
+
+from ..runtime.actor import Actor, ActorMessage, Mailbox
+from ..utils.sexpr import generate
+
+__all__ = ["TrainerActor", "TRAINER_PROTOCOL"]
+
+TRAINER_PROTOCOL = "trainer:0"
+
+
+class TrainerActor(Actor):
+    """Actor wrapper around an ElasticTrainer.
+
+    ``batch_source()`` returns the next host batch of token ids (the
+    data-plane hook — a DataSource element, a tf.data-style iterator,
+    or a synthetic generator).  ``steps_per_pump`` training steps run
+    per event-loop visit; between pumps, queued control messages are
+    delivered.
+    """
+
+    def __init__(self, context, process=None, trainer=None,
+                 batch_source: Optional[Callable[[], np.ndarray]] = None,
+                 steps_per_pump: int = 1,
+                 max_steps: Optional[int] = None,
+                 auto_start: bool = True):
+        context.protocol = context.protocol or TRAINER_PROTOCOL
+        super().__init__(context, process)
+        if trainer is None:
+            raise ValueError("TrainerActor requires trainer=")
+        if batch_source is None:
+            raise ValueError("TrainerActor requires batch_source=")
+        self.trainer = trainer
+        self.batch_source = batch_source
+        self.steps_per_pump = steps_per_pump
+        self.max_steps = max_steps
+        for command in ("start", "pause", "resume", "save", "stop"):
+            self._command_handlers[command] = getattr(self, command)
+        self._command_handlers["status"] = self._wire_status
+        self._command_handlers["pump"] = self._pump
+        self._state = "ready"
+        self._pumping = False
+        self._share_progress(loss=None)
+        if auto_start:
+            self.start()
+
+    # ------------------------------------------------------------- #
+    # Wire controls
+
+    def start(self):
+        if self._state in ("running",):
+            return
+        self._state = "running"
+        self._share_progress()
+        self._ensure_pumping()
+
+    def pause(self):
+        if self._state == "running":
+            self._state = "paused"
+            self._share_progress()
+
+    def resume(self):
+        if self._state == "paused":
+            self._state = "running"
+            self._share_progress()
+            self._ensure_pumping()
+
+    def save(self):
+        self.trainer.save()
+        self.logger.info("%s: checkpoint saved at step %d", self.name,
+                         self.trainer.step)
+
+    def stop(self):
+        self._state = "stopped"
+        self.trainer.save()
+        self._share_progress()
+
+    def _wire_status(self, response_topic):
+        self.process.message.publish(
+            str(response_topic),
+            generate("status", [self._state, str(self.trainer.step),
+                                str(self.share.get("loss", ""))]))
+
+    # ------------------------------------------------------------- #
+    # Pump
+
+    def _ensure_pumping(self):
+        if not self._pumping:
+            self._pumping = True
+            self._schedule_pump()
+
+    def _schedule_pump(self):
+        self._post_message(Mailbox.IN, ActorMessage("pump", []),
+                           delay=0.001)
+
+    def _pump(self):
+        if self._state != "running":
+            self._pumping = False
+            return
+        started = time.perf_counter()
+        tokens = 0
+        losses = []
+        for _ in range(self.steps_per_pump):
+            batch = np.asarray(self.batch_source())
+            tokens += batch.size
+            losses.extend(self.trainer.run([batch]))
+            if self.max_steps and self.trainer.step >= self.max_steps:
+                self.stop()
+                break
+        elapsed = max(time.perf_counter() - started, 1e-9)
+        self._share_progress(loss=losses[-1] if losses else None,
+                             tokens_per_sec=tokens / elapsed)
+        if self._state == "running":
+            self._schedule_pump()
+        else:
+            self._pumping = False
+
+    # ------------------------------------------------------------- #
+
+    def _share_progress(self, loss=None, tokens_per_sec=None):
+        updates = {"state": self._state,
+                   "step": int(self.trainer.step)}
+        if loss is not None:
+            updates["loss"] = round(float(loss), 4)
+        if tokens_per_sec is not None:
+            updates["tokens_per_sec"] = int(tokens_per_sec)
+        self.share.update(updates)
+        if self.ec_producer is not None:
+            for key, value in updates.items():
+                self.ec_producer.update(key, value)

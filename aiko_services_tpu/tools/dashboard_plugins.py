@@ -147,6 +147,34 @@ def model_replica_plugin(fields, variables) -> List[str]:
     return lines
 
 
+def _trainer_pause_action(process, fields, variables):
+    process.message.publish(f"{fields.topic_path}/in", "(pause)")
+
+
+def _trainer_resume_action(process, fields, variables):
+    process.message.publish(f"{fields.topic_path}/in", "(resume)")
+
+
+def _trainer_save_action(process, fields, variables):
+    process.message.publish(f"{fields.topic_path}/in", "(save)")
+
+
+@dashboard_plugin(protocol="trainer",
+                  actions={"p": ("pause", _trainer_pause_action),
+                           "r": ("resume", _trainer_resume_action),
+                           "c": ("checkpoint", _trainer_save_action)})
+def trainer_plugin(fields, variables) -> List[str]:
+    """Training-job view: live step/loss/throughput from the
+    TrainerActor's EC share, with pause/resume/checkpoint controls."""
+    return [
+        f"Trainer: {fields.name}",
+        f"  state:      {_get(variables, 'state')}",
+        f"  step:       {_get(variables, 'step')}",
+        f"  loss:       {_get(variables, 'loss')}",
+        f"  tokens/sec: {_get(variables, 'tokens_per_sec')}",
+    ]
+
+
 @dashboard_plugin(protocol="profiler")
 def profiler_plugin(fields, variables) -> List[str]:
     lines = [

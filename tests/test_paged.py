@@ -292,6 +292,37 @@ def test_prefix_cache_with_quantized_kv_matches():
     assert outs[True] == outs[False]
 
 
+def test_prefix_cache_evicts_leaf_first_preserving_roots():
+    """Eviction under mild pressure frees chain LEAVES, not whole
+    chains: after losing one block, the surviving prefix root still
+    produces cache hits."""
+    rng = np.random.default_rng(18)
+    long_prompt = rng.integers(1, 1024, 65).astype(np.int32)  # 4 keys
+    server = PagedContinuousServer(
+        config_name="tiny", slots=1, max_seq=128, chunk_steps=4,
+        block_size=16, total_blocks=9, enable_prefix_cache=True)
+    server.submit(DecodeRequest(request_id="a", prompt=long_prompt,
+                                max_new_tokens=4))   # 8 blocks reserved
+    server.run_until_drained()
+    # 4 shareable blocks cached ((65-1)//16); the other 4 went free.
+    assert len(server._evictable) == 4
+    assert server.free_blocks == 5
+    # Unrelated request needing 7 blocks (bucket 32 + 66 rows): 5 free
+    # + exactly TWO leaf evictions; the chain root survives.
+    other = rng.integers(1, 1024, 30).astype(np.int32)
+    server.submit(DecodeRequest(request_id="b", prompt=other,
+                                max_new_tokens=66))
+    server.run_until_drained()
+    assert len(server._evictable) >= 2 + 1   # 2 survivors + b's 1 key
+    assert b"".join(sorted(server._index)) is not None
+    # The surviving prefix still hits (2 found, pow2 pins 2).
+    server.submit(DecodeRequest(request_id="c", prompt=long_prompt,
+                                max_new_tokens=4))
+    server.run_until_drained()
+    assert server.prefix_hits >= 1
+    assert server.prefix_blocks_reused >= 2
+
+
 def test_prefix_cache_pow2_truncation_leaks_nothing():
     """A 3-block shareable prefix is pow2-truncated to 2 pinned hits;
     the found-but-unpinned 3rd key must keep its original binding

@@ -215,6 +215,31 @@ def test_profiler_actor_commands(engine, tmp_path):
     engine.advance(0.1)
 
 
+def test_trainer_plugin_view_and_actions():
+    from types import SimpleNamespace
+    from aiko_services_tpu.tools.dashboard_plugins import (
+        find_plugin, find_plugin_actions,
+    )
+
+    fields = SimpleNamespace(name="trainer0", protocol="trainer:0",
+                             topic_path="ns/h/1/2")
+    plugin = find_plugin(fields)
+    assert plugin is not None
+    lines = plugin(fields, {"state": "running", "step": 42,
+                            "loss": 3.14, "tokens_per_sec": 1000})
+    text = "\n".join(lines)
+    assert "step:       42" in text and "loss:       3.14" in text
+    actions = find_plugin_actions(fields)
+    assert set(actions) == {"p", "r", "c"}
+
+    published = []
+    process = SimpleNamespace(message=SimpleNamespace(
+        publish=lambda topic, payload: published.append((topic,
+                                                         payload))))
+    actions["p"][1](process, fields, {})
+    assert published == [("ns/h/1/2/in", "(pause)")]
+
+
 def test_model_replica_and_profiler_plugins():
     from types import SimpleNamespace
     from aiko_services_tpu.tools.dashboard_plugins import find_plugin

@@ -1,0 +1,121 @@
+"""TrainerActor: a training job as a service — wire controls, EC-share
+progress, and elastic resume through the actor wrapper."""
+
+import time
+
+import numpy as np
+import optax
+import pytest
+
+from aiko_services_tpu.models import llama
+from aiko_services_tpu.orchestration.trainer import (
+    TRAINER_PROTOCOL, TrainerActor,
+)
+from aiko_services_tpu.parallel import ElasticTrainer, make_mesh
+from aiko_services_tpu.runtime import (
+    Process, actor_args, compose_instance,
+)
+from aiko_services_tpu.runtime.event import EventEngine
+from aiko_services_tpu.utils.sexpr import generate, parse
+
+
+@pytest.fixture
+def engine():
+    engine = EventEngine()
+    engine.run_in_thread()
+    yield engine
+    engine.terminate()
+
+
+def _make_trainer(tmp_path, mesh=None, save_every=4):
+    config = llama.CONFIGS["tiny"]
+    return ElasticTrainer(
+        config, optax.adamw(1e-3), str(tmp_path / "ckpt"),
+        mesh or make_mesh(dp=2, tp=4), save_every=save_every)
+
+
+def _batch_source(seed=0, batch=2):
+    rng = np.random.default_rng(seed)
+
+    def source():
+        return rng.integers(0, 1024, (batch, 16)).astype(np.int32)
+    return source
+
+
+def _wait(predicate, timeout=60.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(0.05)
+    return False
+
+
+def test_trainer_actor_runs_and_reports(engine, tmp_path):
+    process = Process(engine=engine, broker="trainer1")
+    trainer = _make_trainer(tmp_path)
+    actor = compose_instance(
+        TrainerActor, actor_args("trainer"), process=process,
+        trainer=trainer, batch_source=_batch_source(), max_steps=6)
+    assert actor.protocol == TRAINER_PROTOCOL
+    assert _wait(lambda: actor.share.get("state") == "stopped")
+    assert actor.share["step"] == 6
+    assert isinstance(actor.share["loss"], float)
+    assert actor.share["tokens_per_sec"] > 0
+    # stop() checkpointed; a later-step checkpoint exists.
+    assert trainer.checkpointer.latest_step() == 6
+
+
+def test_trainer_actor_wire_pause_resume_status(engine, tmp_path):
+    process = Process(engine=engine, broker="trainer2")
+    trainer = _make_trainer(tmp_path, save_every=0)
+    actor = compose_instance(
+        TrainerActor, actor_args("trainer"), process=process,
+        trainer=trainer, batch_source=_batch_source())
+    client = Process(engine=engine, broker="trainer2")
+    assert _wait(lambda: actor.share.get("step", 0) >= 1)
+
+    client.message.publish(actor.topic_in, "(pause)")
+    assert _wait(lambda: actor.share.get("state") == "paused")
+    step_at_pause = actor.share["step"]
+    time.sleep(0.3)
+    assert trainer.step <= step_at_pause + 1   # pump stopped
+
+    statuses = []
+    client.add_message_handler(
+        lambda topic, payload: statuses.append(parse(payload)),
+        "trainer/test/status")
+    client.message.publish(actor.topic_in,
+                           "(status trainer/test/status)")
+    assert _wait(lambda: statuses)
+    command, args = statuses[0]
+    assert command == "status" and args[0] == "paused"
+
+    client.message.publish(actor.topic_in, "(resume)")
+    assert _wait(
+        lambda: actor.share.get("step", 0) > step_at_pause + 1)
+    client.message.publish(actor.topic_in, "(stop)")
+    assert _wait(lambda: actor.share.get("state") == "stopped")
+
+
+def test_trainer_actor_elastic_resume_new_topology(engine, tmp_path):
+    """Stop a trainer service, rebuild it on a DIFFERENT mesh — the new
+    actor resumes from the checkpointed step (the elastic story through
+    the service wrapper)."""
+    process = Process(engine=engine, broker="trainer3")
+    trainer_a = _make_trainer(tmp_path, mesh=make_mesh(dp=8))
+    actor_a = compose_instance(
+        TrainerActor, actor_args("trainer_a"), process=process,
+        trainer=trainer_a, batch_source=_batch_source(batch=8),
+        max_steps=5)
+    assert _wait(lambda: actor_a.share.get("state") == "stopped")
+    trainer_a.close()
+
+    trainer_b = _make_trainer(tmp_path, mesh=make_mesh(dp=2, tp=4))
+    assert trainer_b.step == 5                  # restored
+    actor_b = compose_instance(
+        TrainerActor, actor_args("trainer_b"), process=process,
+        trainer=trainer_b, batch_source=_batch_source(1), max_steps=8)
+    assert _wait(lambda: actor_b.share.get("state") == "stopped")
+    assert actor_b.share["step"] == 8
+    trainer_b.close()
