@@ -33,7 +33,7 @@ from ..runtime.service import ServiceFilter
 from ..utils.sexpr import generate
 
 __all__ = ["ModelReplica", "ReplicaRouter", "REPLICA_PROTOCOL",
-           "make_llama_infer"]
+           "make_llama_infer", "make_speculative_infer"]
 
 REPLICA_PROTOCOL = "model_replica:0"
 
@@ -146,7 +146,9 @@ def make_llama_infer(config_name: str = "tiny", quantize: bool = False,
             # deep inside prefill with an opaque trace error.
             return {"error": f"prompt_len {prompt_len} >= max_seq_len "
                              f"{config.max_seq_len}"}
-        new = min(max_new_tokens, config.max_seq_len - prompt_len)
+        requested = int(np.asarray(inputs.get("max_new_tokens",
+                                              max_new_tokens)))
+        new = min(requested, config.max_seq_len - prompt_len)
         cache = llama.init_cache(config, batch, prompt_len + new,
                                  quantize_kv=quantize_kv)
         logits, cache = llama.prefill(params, tokens, cache, config)
@@ -156,5 +158,64 @@ def make_llama_infer(config_name: str = "tiny", quantize: bool = False,
         return {"tokens_out": np.concatenate(
             [np.asarray(tokens), np.asarray(first),
              np.asarray(generated)], axis=1)}
+
+    return infer
+
+
+def make_speculative_infer(target_config="small", draft_config="tiny",
+                           quantize: bool = False,
+                           max_new_tokens: int = 16, k: int = 4,
+                           seed: int = 0, draft_seed: int = 1) -> Callable:
+    """Build a ModelReplica ``infer`` callable running GREEDY
+    speculative decoding: a draft model proposes ``k`` tokens, the
+    target verifies them in one chunked-prefill pass — output is
+    IDENTICAL to target-only greedy decode (the exactness the tests
+    assert), so a router can mix speculative and plain replicas freely.
+
+    ``target_config``/``draft_config``: CONFIGS names or LlamaConfig
+    instances; they must share a vocabulary.  Batch-1 requests only
+    (speculation targets the low-batch latency regime; use
+    ContinuousReplica for throughput batching).
+    """
+    import jax
+    import numpy as np
+    from ..models import llama
+    from ..models.speculative import speculative_generate
+
+    def resolve(config):
+        return (llama.CONFIGS[config] if isinstance(config, str)
+                else config)
+    target_cfg = resolve(target_config)
+    draft_cfg = resolve(draft_config)
+    if target_cfg.vocab_size != draft_cfg.vocab_size:
+        raise ValueError("draft and target must share a vocabulary")
+    target_params = llama.init_params(target_cfg,
+                                      jax.random.PRNGKey(seed))
+    if quantize:
+        target_params = llama.quantize_params(target_params)
+    draft_params = llama.init_params(draft_cfg,
+                                     jax.random.PRNGKey(draft_seed))
+
+    def infer(inputs: Dict) -> Dict:
+        prompt = np.asarray(inputs["tokens"], np.int32).reshape(-1)
+        new = int(np.asarray(inputs.get("max_new_tokens",
+                                        max_new_tokens)))
+        # speculative_generate bounds by BOTH models' max_seq_len (the
+        # draft runs the same positions).
+        max_seq = min(target_cfg.max_seq_len, draft_cfg.max_seq_len)
+        budget = max_seq - len(prompt) - k - 1
+        if budget <= 0:
+            return {"error": f"prompt_len {len(prompt)} too long for "
+                             f"max_seq {max_seq} with k={k} "
+                             "speculation"}
+        new = min(new, budget)
+        generated, stats = speculative_generate(
+            target_params, draft_params, prompt, new, target_cfg,
+            draft_cfg, k=k)
+        return {"tokens_out": np.concatenate(
+                    [prompt, np.asarray(generated, np.int32)])[None],
+                "acceptance_rate": np.float32(stats.acceptance_rate),
+                "tokens_per_target_pass": np.float32(
+                    stats.tokens_per_target_pass)}
 
     return infer

@@ -63,8 +63,11 @@ class TrainerActor(Actor):
         self.batch_source = batch_source
         self.steps_per_pump = steps_per_pump
         self.max_steps = max_steps
-        for command in ("start", "pause", "resume", "save", "stop"):
+        for command in ("start", "pause", "resume", "save"):
             self._command_handlers[command] = getattr(self, command)
+        # The wire "(stop)" halts TRAINING; it must not shadow
+        # Actor.stop()'s lifecycle teardown (terminate() depends on it).
+        self._command_handlers["stop"] = self.halt
         self._command_handlers["status"] = self._wire_status
         self._command_handlers["pump"] = self._pump
         self._state = "ready"
@@ -77,6 +80,7 @@ class TrainerActor(Actor):
     # Wire controls
 
     def start(self):
+        """Start (or restart after ``halt``/an error state)."""
         if self._state in ("running",):
             return
         self._state = "running"
@@ -99,7 +103,9 @@ class TrainerActor(Actor):
         self.logger.info("%s: checkpoint saved at step %d", self.name,
                          self.trainer.step)
 
-    def stop(self):
+    def halt(self):
+        """Stop TRAINING (checkpointing first).  Distinct from
+        ``Actor.stop()``, which tears down the service itself."""
         self._state = "stopped"
         self.trainer.save()
         self._share_progress()
@@ -129,13 +135,25 @@ class TrainerActor(Actor):
         started = time.perf_counter()
         tokens = 0
         losses = []
-        for _ in range(self.steps_per_pump):
-            batch = np.asarray(self.batch_source())
-            tokens += batch.size
-            losses.extend(self.trainer.run([batch]))
-            if self.max_steps and self.trainer.step >= self.max_steps:
-                self.stop()
-                break
+        try:
+            for _ in range(self.steps_per_pump):
+                batch = np.asarray(self.batch_source())
+                tokens += batch.size
+                losses.extend(self.trainer.run([batch]))
+                if self.max_steps and \
+                        self.trainer.step >= self.max_steps:
+                    self.halt()
+                    break
+        except Exception:  # noqa: BLE001 - a bad batch/step must not
+            # leave _pumping latched True with the share saying
+            # "running" forever; surface the error state and let a
+            # wire (start) recover.
+            self.logger.exception("%s: training step failed at step "
+                                  "%d", self.name, self.trainer.step)
+            self._state = "error"
+            self._pumping = False
+            self._share_progress()
+            return
         elapsed = max(time.perf_counter() - started, 1e-9)
         self._share_progress(loss=losses[-1] if losses else None,
                              tokens_per_sec=tokens / elapsed)

@@ -314,7 +314,11 @@ def test_prefix_cache_evicts_leaf_first_preserving_roots():
                                 max_new_tokens=66))
     server.run_until_drained()
     assert len(server._evictable) >= 2 + 1   # 2 survivors + b's 1 key
-    assert b"".join(sorted(server._index)) is not None
+    # The surviving keys are the chain's FIRST two (leaf-first evicted
+    # from the tail) — the root was preserved.
+    chain = server._chain_keys(long_prompt)
+    assert chain[0] in server._index and chain[1] in server._index
+    assert chain[3] not in server._index
     # The surviving prefix still hits (2 found, pow2 pins 2).
     server.submit(DecodeRequest(request_id="c", prompt=long_prompt,
                                 max_new_tokens=4))

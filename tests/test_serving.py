@@ -5,6 +5,7 @@ import numpy as np
 
 from aiko_services_tpu.orchestration.serving import (
     ModelReplica, ReplicaRouter, make_llama_infer,
+    make_speculative_infer,
 )
 from aiko_services_tpu.pipeline.codec import decode_swag, encode_swag
 from aiko_services_tpu.registry import Registrar
@@ -201,3 +202,44 @@ def test_load_generator_against_continuous_replica(engine):
         rate_hz=100.0, clock=clock.now, sleep=engine.advance)
     bad_report = bad.run(2, drain_timeout_s=30.0, pump=engine.drain)
     assert bad_report.errors == 2 and bad_report.timeouts == 0
+
+
+def test_speculative_replica_matches_plain_replica(engine):
+    """A speculative replica and a plain greedy replica serve the SAME
+    prompt over the wire and return IDENTICAL tokens (greedy
+    speculative decoding is exact) — so a router can mix them freely.
+    The speculative response also carries acceptance stats."""
+    p0 = make_process(engine, 1, broker="spec")
+    Registrar(process=p0)
+    engine.advance(4.0)
+
+    p1 = make_process(engine, 2, broker="spec")
+    plain = compose_instance(
+        ModelReplica, actor_args("plain"), process=p1,
+        infer=make_llama_infer("tiny", max_new_tokens=10))
+    p2 = make_process(engine, 3, broker="spec")
+    spec = compose_instance(
+        ModelReplica, actor_args("spec"), process=p2,
+        infer=make_speculative_infer(
+            target_config="tiny", draft_config="tiny",
+            max_new_tokens=10, k=3, seed=0, draft_seed=7))
+
+    pr = make_process(engine, 99, broker="spec")
+    responses = []
+    response_topic = "test/h/99/client/response"
+    collect_responses(pr, response_topic, responses)
+    prompt = np.asarray([5, 17, 200, 3, 9], np.int32)
+    for name, replica in (("plain", plain), ("spec", spec)):
+        pr.message.publish(
+            f"{replica.topic_path}/in",
+            generate("infer", [name, response_topic,
+                               encode_swag({"tokens": prompt,
+                                            "max_new_tokens":
+                                            np.int64(10)})]))
+    engine.drain()
+    by_id = dict(responses)
+    assert set(by_id) == {"plain", "spec"}
+    np.testing.assert_array_equal(by_id["plain"]["tokens_out"],
+                                  by_id["spec"]["tokens_out"])
+    assert 0.0 <= float(by_id["spec"]["acceptance_rate"]) <= 1.0
+    assert float(by_id["spec"]["tokens_per_target_pass"]) >= 1.0

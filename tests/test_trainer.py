@@ -98,6 +98,31 @@ def test_trainer_actor_wire_pause_resume_status(engine, tmp_path):
     assert _wait(lambda: actor.share.get("state") == "stopped")
 
 
+def test_trainer_actor_pump_error_surfaces_and_recovers(engine, tmp_path):
+    """A failing batch source must flip the share to state='error' (not
+    silently stall at 'running'), and a wire (start) recovers."""
+    process = Process(engine=engine, broker="trainer4")
+    trainer = _make_trainer(tmp_path, save_every=0)
+    calls = {"n": 0}
+    good = _batch_source()
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] == 3:
+            raise RuntimeError("data glitch")
+        return good()
+
+    actor = compose_instance(
+        TrainerActor, actor_args("trainer"), process=process,
+        trainer=trainer, batch_source=flaky, max_steps=6)
+    assert _wait(lambda: actor.share.get("state") == "error")
+    step_at_error = actor.share["step"]
+    client = Process(engine=engine, broker="trainer4")
+    client.message.publish(actor.topic_in, "(start)")
+    assert _wait(lambda: actor.share.get("state") == "stopped")
+    assert actor.share["step"] == 6 > step_at_error
+
+
 def test_trainer_actor_elastic_resume_new_topology(engine, tmp_path):
     """Stop a trainer service, rebuild it on a DIFFERENT mesh — the new
     actor resumes from the checkpointed step (the elastic story through
