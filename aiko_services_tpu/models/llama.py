@@ -28,7 +28,8 @@ from ..ops.attention import attention_reference, flash_attention
 from ..ops.quant import (_unpack_int4, int4_matmul, int8_matmul,
                          is_quantized, is_quantized_int4, quantize_tree)
 
-__all__ = ["LlamaConfig", "init_params", "forward", "init_cache",
+__all__ = ["LlamaConfig", "init_params", "forward",
+           "forward_sequence_parallel", "init_cache",
            "decode_step", "generate_tokens", "prefill", "param_specs",
            "quantize_params", "pipeline_forward", "stack_pipeline_params",
            "decode_chunk_ragged", "prefill_chunk", "sample_logits",
@@ -293,11 +294,15 @@ def apply_rope(x, cos, sin):
         [x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1).astype(x.dtype)
 
 
-def _attention_block(layer, config, x, cos, sin, use_flash=True):
+def _attention_block(layer, config, x, cos, sin, use_flash=True,
+                     attention_fn=None):
     """Full-sequence (no-cache) attention block; returns
     (output, None).  The cached-decode path lives in
     :func:`_attention_decode_ragged` (single implementation for both
-    shared-position and per-row-position decode)."""
+    shared-position and per-row-position decode).  ``attention_fn``
+    overrides the attention itself (e.g. ring attention over an sp
+    mesh axis); it receives (q, k, v) in (batch, heads, seq, hd)
+    layout and must handle GQA."""
     batch, seq, _ = x.shape
     h, kv, hd = config.n_heads, config.n_kv_heads, config.head_dim
     normed = rms_norm(x, layer["attn_norm"], config.norm_eps)
@@ -310,7 +315,9 @@ def _attention_block(layer, config, x, cos, sin, use_flash=True):
     q_t = q.transpose(0, 2, 1, 3)
     k_t = k.transpose(0, 2, 1, 3)
     v_t = v.transpose(0, 2, 1, 3)
-    if use_flash:
+    if attention_fn is not None:
+        out = attention_fn(q_t, k_t, v_t)
+    elif use_flash:
         # flash_attention is GQA-native (no repeated K/V in memory).
         out = flash_attention(q_t, k_t, v_t, causal=True,
                               window=config.sliding_window)
@@ -351,6 +358,45 @@ def forward(params, tokens, config: LlamaConfig, use_flash: bool = True):
     for layer in params["layers"]:
         x, _ = _attention_block(layer, config, x, cos, sin,
                                 use_flash=use_flash)
+        x = _mlp_block(layer, config, x)
+    x = rms_norm(x, params["final_norm"], config.norm_eps)
+    return _matmul(x, params["lm_head"]).astype(jnp.float32)
+
+
+@functools.partial(jax.jit, static_argnames=("config", "mesh"))
+def forward_sequence_parallel(params, tokens, config: LlamaConfig,
+                              mesh):
+    """Full-sequence forward with attention ring-sharded over the
+    ``sp`` mesh axis — the long-context path: per-device attention
+    memory is O(seq / sp) while K/V shards rotate around the ICI ring
+    (:func:`~..parallel.ring_attention.ring_attention_sharded`), exact
+    vs :func:`forward`.  Sequence length must divide by the sp size.
+    Everything OUTSIDE attention (projections, MLP, norms) is local to
+    each sequence shard, so XLA keeps those fully parallel with no
+    collectives.
+    """
+    if config.sliding_window:
+        raise ValueError(
+            "sequence-parallel forward does not implement sliding-"
+            "window masking (the ring's causal skip is shard-wise)")
+    sp = dict(zip(mesh.axis_names, mesh.devices.shape)).get("sp", 1)
+    if tokens.shape[1] % sp:
+        raise ValueError(
+            f"sequence length {tokens.shape[1]} must divide by the sp "
+            f"mesh size {sp}")
+    from ..parallel.ring_attention import ring_attention_sharded
+
+    def ring(q_t, k_t, v_t):
+        # ring_attention is GQA-native: only the kv heads rotate.
+        return ring_attention_sharded(q_t, k_t, v_t, mesh, causal=True)
+
+    batch, seq = tokens.shape
+    positions = jnp.broadcast_to(jnp.arange(seq), (batch, seq))
+    cos, sin = _rope_freqs(config, positions)
+    x = _embed_lookup(params, tokens, config.dtype)
+    for layer in params["layers"]:
+        x, _ = _attention_block(layer, config, x, cos, sin,
+                                attention_fn=ring)
         x = _mlp_block(layer, config, x)
     x = rms_norm(x, params["final_norm"], config.norm_eps)
     return _matmul(x, params["lm_head"]).astype(jnp.float32)

@@ -35,29 +35,34 @@ __all__ = ["ring_attention", "ring_attention_sharded"]
 
 def _block_attend(q, k, v, q_offset, k_offset, sm_scale, causal,
                   m, l, acc):
-    """One blockwise-attention accumulation step (f32 state)."""
-    s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+    """One blockwise-attention accumulation step (f32 state).
+
+    GQA-native: ``q`` is (batch, kv_heads, group, q_len, head_dim) and
+    ``k``/``v`` are (batch, kv_heads, k_len, head_dim) — the rotated
+    K/V never materialize the repeated query heads."""
+    s = jnp.einsum("bkgqd,bksd->bkgqs", q, k,
                    preferred_element_type=jnp.float32) * sm_scale
     if causal:
-        q_len, k_len = q.shape[2], k.shape[2]
+        q_len, k_len = q.shape[3], k.shape[2]
         q_ids = jnp.arange(q_len)[:, None] + q_offset
         k_ids = jnp.arange(k_len)[None, :] + k_offset
-        s = jnp.where(k_ids <= q_ids, s, NEG_INF)
+        s = jnp.where((k_ids <= q_ids)[None, None, None], s, NEG_INF)
     m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
     p = jnp.exp(s - m_new)
     correction = jnp.exp(m - m_new)
     l_new = correction * l + jnp.sum(p, axis=-1, keepdims=True)
     acc_new = acc * correction + jnp.einsum(
-        "bhqk,bhkd->bhqd", p, v.astype(jnp.float32),
+        "bkgqs,bksd->bkgqd", p, v.astype(jnp.float32),
         preferred_element_type=jnp.float32)
     return m_new, l_new, acc_new
 
 
 def ring_attention(q, k, v, axis_name: str, causal: bool = True,
                    sm_scale: Optional[float] = None):
-    """Inside-shard_map body: local q/k/v shards of shape
-    ``(batch, heads, seq_local, head_dim)``; returns the local output
-    shard.  K/V rotate ``axis_size`` steps around the ring."""
+    """Inside-shard_map body: local q (batch, heads, seq_local, hd) and
+    k/v (batch, kv_heads, seq_local, hd) shards — ``kv_heads`` may be
+    smaller (GQA; only the kv heads rotate around the ring).  Returns
+    the local output shard.  K/V rotate ``axis_size`` steps."""
     if sm_scale is None:
         sm_scale = q.shape[-1] ** -0.5
     axis_size = jax.lax.psum(1, axis_name)
@@ -66,9 +71,14 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = True,
     q_offset = axis_index * seq_local
 
     batch, heads, _, head_dim = q.shape
-    m = jnp.full((batch, heads, seq_local, 1), NEG_INF, jnp.float32)
-    l = jnp.zeros((batch, heads, seq_local, 1), jnp.float32)
-    acc = jnp.zeros((batch, heads, seq_local, head_dim), jnp.float32)
+    kv_heads = k.shape[1]
+    group = heads // kv_heads
+    q = q.reshape(batch, kv_heads, group, seq_local, head_dim)
+    state_shape = (batch, kv_heads, group, seq_local, 1)
+    m = jnp.full(state_shape, NEG_INF, jnp.float32)
+    l = jnp.zeros(state_shape, jnp.float32)
+    acc = jnp.zeros((batch, kv_heads, group, seq_local, head_dim),
+                    jnp.float32)
     # shard_map's varying-axis tracking: the carry becomes 'sp'-varying
     # after the first step, so the init must be marked varying too.
     from .mesh import mark_varying
@@ -105,7 +115,8 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = True,
     _, _, m, l, acc = jax.lax.fori_loop(
         0, axis_size, step, (k, v, m, l, acc))
     denom = jnp.where(l == 0.0, 1.0, l)
-    return (acc / denom).astype(q.dtype)
+    out = (acc / denom).astype(q.dtype)
+    return out.reshape(batch, heads, seq_local, head_dim)
 
 
 def ring_attention_sharded(q, k, v, mesh: Mesh, axis: str = "sp",
