@@ -363,32 +363,61 @@ def forward(params, tokens, config: LlamaConfig, use_flash: bool = True):
     return _matmul(x, params["lm_head"]).astype(jnp.float32)
 
 
-@functools.partial(jax.jit, static_argnames=("config", "mesh"))
+@functools.partial(jax.jit,
+                   static_argnames=("config", "mesh", "attention"))
 def forward_sequence_parallel(params, tokens, config: LlamaConfig,
-                              mesh):
-    """Full-sequence forward with attention ring-sharded over the
-    ``sp`` mesh axis — the long-context path: per-device attention
-    memory is O(seq / sp) while K/V shards rotate around the ICI ring
-    (:func:`~..parallel.ring_attention.ring_attention_sharded`), exact
-    vs :func:`forward`.  Sequence length must divide by the sp size.
-    Everything OUTSIDE attention (projections, MLP, norms) is local to
-    each sequence shard, so XLA keeps those fully parallel with no
-    collectives.
-    """
+                              mesh, attention: str = "ring"):
+    """Full-sequence forward with attention sharded over the ``sp``
+    mesh axis — the long-context path, exact vs :func:`forward`.
+    Sequence length must divide by the sp size.  Everything OUTSIDE
+    attention (projections, MLP, norms) is local to each sequence
+    shard, so XLA keeps those fully parallel with no collectives.
+
+    ``attention="ring"``: K/V shards rotate around the ICI ring
+    (GQA-native — only kv heads move); per-device attention memory
+    O(seq/sp).  ``attention="ulysses"``: one all-to-all swaps the
+    shard dimension from sequence to heads and back — fewer, larger
+    collectives (MXU-friendly dense local attention) but needs
+    ``n_heads % sp == 0`` and materializes the full sequence per head
+    group (K/V repeated to the full head count first)."""
     if config.sliding_window:
         raise ValueError(
             "sequence-parallel forward does not implement sliding-"
             "window masking (the ring's causal skip is shard-wise)")
-    sp = dict(zip(mesh.axis_names, mesh.devices.shape)).get("sp", 1)
+    if "sp" not in mesh.axis_names:
+        raise ValueError(
+            f"mesh has no 'sp' axis (axes: {mesh.axis_names}) — build "
+            "it with make_mesh(sp=...)")
+    sp = mesh.shape["sp"]
     if tokens.shape[1] % sp:
         raise ValueError(
             f"sequence length {tokens.shape[1]} must divide by the sp "
             f"mesh size {sp}")
     from ..parallel.ring_attention import ring_attention_sharded
 
-    def ring(q_t, k_t, v_t):
-        # ring_attention is GQA-native: only the kv heads rotate.
-        return ring_attention_sharded(q_t, k_t, v_t, mesh, causal=True)
+    if attention == "ring":
+        def ring(q_t, k_t, v_t):
+            # ring_attention is GQA-native: only the kv heads rotate.
+            return ring_attention_sharded(q_t, k_t, v_t, mesh,
+                                          causal=True)
+        attention_fn = ring
+    elif attention == "ulysses":
+        from ..parallel.ulysses import ulysses_attention_sharded
+        if config.n_heads % sp:
+            raise ValueError(
+                f"ulysses needs n_heads ({config.n_heads}) divisible "
+                f"by the sp mesh size ({sp})")
+        group = config.n_heads // config.n_kv_heads
+
+        def ulysses(q_t, k_t, v_t):
+            if group > 1:   # head-scatter needs the full head count
+                k_t = jnp.repeat(k_t, group, axis=1)
+                v_t = jnp.repeat(v_t, group, axis=1)
+            return ulysses_attention_sharded(q_t, k_t, v_t, mesh)
+        attention_fn = ulysses
+    else:
+        raise ValueError(f"unknown attention {attention!r} "
+                         "(ring | ulysses)")
 
     batch, seq = tokens.shape
     positions = jnp.broadcast_to(jnp.arange(seq), (batch, seq))
@@ -396,7 +425,7 @@ def forward_sequence_parallel(params, tokens, config: LlamaConfig,
     x = _embed_lookup(params, tokens, config.dtype)
     for layer in params["layers"]:
         x, _ = _attention_block(layer, config, x, cos, sin,
-                                attention_fn=ring)
+                                attention_fn=attention_fn)
         x = _mlp_block(layer, config, x)
     x = rms_norm(x, params["final_norm"], config.norm_eps)
     return _matmul(x, params["lm_head"]).astype(jnp.float32)

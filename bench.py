@@ -583,6 +583,48 @@ def bench_llm_decode(batch=8, prompt_len=128, new_tokens=256,
 
 # --------------------------------------------------------------------------- #
 
+def bench_serving_continuous(slots=8, prompt_len=64, max_new=64,
+                             n_requests=24, config_name="small",
+                             chunk_steps=16):
+    """Sustained tokens/sec through the CONTINUOUS-BATCHING serving
+    stack (admission, bucketed prefill, slot bookkeeping included) —
+    the serving-stack view of the decode numbers above."""
+    import numpy as np
+    from aiko_services_tpu.orchestration.continuous import (
+        ContinuousBatchingServer, DecodeRequest, _bucket,
+    )
+
+    server = ContinuousBatchingServer(
+        config_name=config_name, slots=slots,
+        max_seq=_bucket(prompt_len) + max_new + chunk_steps,
+        chunk_steps=chunk_steps, quantize=True)
+    rng = np.random.default_rng(0)
+
+    def submit_batch(count, tag):
+        for i in range(count):
+            server.submit(DecodeRequest(
+                request_id=f"{tag}{i}",
+                prompt=rng.integers(1, server.config.vocab_size,
+                                    prompt_len).astype(np.int32),
+                max_new_tokens=max_new))
+
+    log("serving[continuous] warmup (compile prefill + chunk)...")
+    submit_batch(slots, "warm")
+    server.run_until_drained()
+    log(f"serving[continuous] timed: {n_requests} requests x "
+        f"{max_new} tokens through {slots} slots...")
+    submit_batch(n_requests, "r")
+    started = time.perf_counter()
+    finished = server.run_until_drained()
+    elapsed = time.perf_counter() - started
+    total_tokens = sum(len(r.tokens) for r in finished
+                      if r.error is None)
+    tps = total_tokens / elapsed
+    log(f"serving[continuous]: {tps:.0f} tokens/sec/chip sustained "
+        f"({n_requests} reqs, {total_tokens} tokens, {elapsed:.2f}s)")
+    return tps
+
+
 def main():
     result = {
         "metric": "pipeline frames/sec/chip (fused TPU detector stage, "
@@ -708,6 +750,13 @@ def main():
                                      quantize_kv=True))
         if tps is not None:
             result["llama3_8b_int8_kv8_tokens_per_sec_chip"] = round(tps)
+
+        # Serving-stack throughput (continuous batching end-to-end).
+        tps = run_section("serving_continuous", 420,
+                          bench_serving_continuous)
+        if tps is not None:
+            result["serving_continuous_tokens_per_sec_chip"] = \
+                round(tps)
     finally:
         if errors:
             result["errors"] = errors

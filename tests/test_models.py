@@ -296,6 +296,25 @@ def test_forward_sequence_parallel_matches_plain(tiny):
                                rtol=3e-2, atol=4e-2)
 
 
+def test_forward_sequence_parallel_ulysses_matches_plain(tiny):
+    """The Ulysses (all-to-all) variant of the sp forward must match
+    the single-device forward (tiny has 4 heads -> sp=4 mesh)."""
+    config, params = tiny
+    tokens = jax.random.randint(jax.random.PRNGKey(10), (2, 32),
+                                0, config.vocab_size, jnp.int32)
+    want = llama.forward(params, tokens, config, use_flash=False)
+    mesh = make_mesh(dp=2, sp=4)
+    got = llama.forward_sequence_parallel(params, tokens, config, mesh,
+                                          attention="ulysses")
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=3e-2, atol=4e-2)
+    with pytest.raises(ValueError, match="divisible"):
+        llama.forward_sequence_parallel(
+            params, jax.random.randint(jax.random.PRNGKey(0), (1, 64),
+                                       0, 10, jnp.int32),
+            config, make_mesh(sp=8), attention="ulysses")
+
+
 def test_forward_sequence_parallel_rejects_sliding_window():
     config = llama.CONFIGS["mistral_tiny"]
     params = llama.init_params(config, jax.random.PRNGKey(0))
