@@ -408,9 +408,14 @@ def forward_sequence_parallel(params, tokens, config: LlamaConfig,
                 f"ulysses needs n_heads ({config.n_heads}) divisible "
                 f"by the sp mesh size ({sp})")
         group = config.n_heads // config.n_kv_heads
+        kv_divides = config.n_kv_heads % sp == 0
 
         def ulysses(q_t, k_t, v_t):
-            if group > 1:   # head-scatter needs the full head count
+            if group > 1 and not kv_divides:
+                # Head-scatter needs a divisible head count; repeating
+                # BEFORE the all-to-all multiplies K/V collective
+                # bytes by `group` — only the fallback when the kv
+                # heads cannot be scattered directly.
                 k_t = jnp.repeat(k_t, group, axis=1)
                 v_t = jnp.repeat(v_t, group, axis=1)
             return ulysses_attention_sharded(q_t, k_t, v_t, mesh)
@@ -1026,6 +1031,34 @@ def generate_tokens(params, first_token, cache, start_index, num_steps,
         body, (first_token, cache, rng_key),
         jnp.arange(num_steps, dtype=jnp.int32))
     return tokens.T, cache   # (batch, num_steps)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("config", "num_steps"),
+                   donate_argnames=("cache",))
+def sample_tokens_with_logits(params, first_token, cache, start_index,
+                              num_steps, config: LlamaConfig,
+                              temperature, rng_key):
+    """Sampled decode that ALSO returns each step's logits row — the
+    speculative draft primitive: one compiled scan (no per-step host
+    round-trips), one (batch, steps, vocab) transfer for the
+    acceptance math.  Returns (tokens (batch, steps), logits (batch,
+    steps, vocab) f32, cache)."""
+    def body(carry, step):
+        token, cache, key = carry
+        logits, cache = _decode_core(params, token, cache,
+                                     start_index + step, config)
+        row = logits[:, -1].astype(jnp.float32)
+        key, step_key = jax.random.split(key)
+        scaled = row / jnp.maximum(temperature, 1e-6)
+        next_token = jax.random.categorical(
+            step_key, scaled).astype(jnp.int32)
+        return (next_token[:, None], cache, key), (next_token, row)
+
+    (_, cache, _), (tokens, rows) = jax.lax.scan(
+        body, (first_token, cache, rng_key),
+        jnp.arange(num_steps, dtype=jnp.int32))
+    return tokens.T, rows.transpose(1, 0, 2), cache
 
 
 @functools.partial(jax.jit, static_argnames=("config",),

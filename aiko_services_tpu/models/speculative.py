@@ -25,12 +25,14 @@ from __future__ import annotations
 
 from typing import Optional, Tuple
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
 from . import llama
 
-__all__ = ["speculative_generate", "SpecStats"]
+__all__ = ["speculative_generate", "speculative_generate_sampled",
+           "SpecStats"]
 
 
 class SpecStats:
@@ -57,6 +59,55 @@ class SpecStats:
                 f"tok/pass={self.tokens_per_target_pass:.2f})")
 
 
+def _setup(target_params, draft_params, prompt, num_new, target_config,
+           draft_config, k, max_seq):
+    """Shared entry checks + cache prefill for both speculative modes:
+    returns (prompt_len, max_seq, target_logits, target_cache,
+    draft_cache)."""
+    if target_config.vocab_size != draft_config.vocab_size:
+        raise ValueError("draft and target must share a vocabulary")
+    prompt = jnp.asarray(np.asarray(prompt, np.int32))[None, :]
+    prompt_len = prompt.shape[1]
+    max_seq = max_seq or min(target_config.max_seq_len,
+                             draft_config.max_seq_len)
+    if prompt_len + num_new + k + 1 > max_seq:
+        raise ValueError(
+            f"prompt {prompt_len} + {num_new} new + {k + 1} speculation "
+            f"overrun max_seq {max_seq}")
+    target_cache = llama.init_cache(target_config, 1, max_seq)
+    draft_cache = llama.init_cache(draft_config, 1, max_seq)
+    target_logits, target_cache = llama.prefill(
+        target_params, prompt, target_cache, target_config)
+    _, draft_cache = llama.prefill(draft_params, prompt, draft_cache,
+                                   draft_config)
+    return prompt_len, max_seq, target_logits, target_cache, draft_cache
+
+
+def _resync_draft(draft_params, draft_cache, new_tokens, k, pos,
+                  draft_config):
+    """Draft-cache re-sync (shared by both modes).  The draft
+    generation wrote KV for its INPUTS [last@pos,
+    d_1..d_{k-1}@pos+1..pos+k-1].  The next round feeds the new
+    ``last`` at pos+len(new_tokens), so every committed token before it
+    needs correct KV: new_tokens[:-1] spans rows pos+1..pos+len-1 — on
+    partial accept these rewrites are idempotent; on full accept this
+    writes d_k's row, which the draft emitted but never consumed.
+    (Output EXACTNESS never depends on this — only target verify
+    decides tokens; a stale draft row would only hurt acceptance.)
+    Fixed k-length resync (pad with zeros): one compiled shape instead
+    of up to k variants.  Pad rows land at positions the next rounds
+    rewrite before they become attendable (the module's stale-row
+    invariant), so they are unreachable."""
+    if len(new_tokens) <= 1:
+        return draft_cache
+    resync_tokens = new_tokens[:-1] + [0] * (k - (len(new_tokens) - 1))
+    resync = jnp.asarray([resync_tokens], jnp.int32)
+    _, draft_cache = llama.prefill_chunk(
+        draft_params, resync, draft_cache, jnp.int32(pos + 1),
+        draft_config)
+    return draft_cache
+
+
 def speculative_generate(target_params, draft_params, prompt,
                          num_new: int, target_config, draft_config,
                          k: int = 4, max_seq: Optional[int] = None
@@ -68,23 +119,9 @@ def speculative_generate(target_params, draft_params, prompt,
     continuous batching instead).  Requires
     ``target_config.vocab_size == draft_config.vocab_size``.
     """
-    if target_config.vocab_size != draft_config.vocab_size:
-        raise ValueError("draft and target must share a vocabulary")
-    prompt = jnp.asarray(np.asarray(prompt, np.int32))[None, :]
-    prompt_len = prompt.shape[1]
-    max_seq = max_seq or min(target_config.max_seq_len,
-                             draft_config.max_seq_len)
-    if prompt_len + num_new + k + 1 > max_seq:
-        raise ValueError(
-            f"prompt {prompt_len} + {num_new} new + {k + 1} speculation "
-            f"overrun max_seq {max_seq}")
-
-    target_cache = llama.init_cache(target_config, 1, max_seq)
-    draft_cache = llama.init_cache(draft_config, 1, max_seq)
-    target_logits, target_cache = llama.prefill(
-        target_params, prompt, target_cache, target_config)
-    _, draft_cache = llama.prefill(draft_params, prompt, draft_cache,
-                                   draft_config)
+    prompt_len, max_seq, target_logits, target_cache, draft_cache = \
+        _setup(target_params, draft_params, prompt, num_new,
+               target_config, draft_config, k, max_seq)
 
     stats = SpecStats()
     committed = [int(np.asarray(target_logits)[0, -1].argmax())]
@@ -119,26 +156,116 @@ def speculative_generate(target_params, draft_params, prompt,
         # correction on mismatch; the free bonus token on full accept).
         new_tokens = proposals_host[:accepted] + [int(greedy[accepted])]
         committed.extend(new_tokens)
-        # Draft-cache re-sync.  The draft generation wrote KV for its
-        # INPUTS [last@pos, d_1..d_{k-1}@pos+1..pos+k-1].  Next round
-        # feeds new `last` = new_tokens[-1] at pos+len(new_tokens), so
-        # every committed token before it needs correct KV:
-        # new_tokens[:-1] spans rows pos+1..pos+len-1 — on partial
-        # accept these rewrites are idempotent; on full accept this
-        # writes d_k's row, which the draft emitted but never consumed.
-        # (Output EXACTNESS never depends on this — only target verify
-        # decides tokens; a stale draft row would only hurt acceptance.)
-        # Fixed k-length resync (pad with zeros): one compiled shape
-        # instead of up to k variants.  Pad rows land at positions the
-        # next rounds rewrite before they become attendable (the
-        # module's stale-row invariant), so they are unreachable.
-        if len(new_tokens) > 1:
-            resync_tokens = new_tokens[:-1] + [0] * (
-                k - (len(new_tokens) - 1))
-            resync = jnp.asarray([resync_tokens], jnp.int32)
-            _, draft_cache = llama.prefill_chunk(
-                draft_params, resync, draft_cache, jnp.int32(pos + 1),
-                draft_config)
+        draft_cache = _resync_draft(draft_params, draft_cache,
+                                    new_tokens, k, pos, draft_config)
+        pos += len(new_tokens)
+
+    return np.asarray(committed[:num_new], np.int64), stats
+
+
+# --------------------------------------------------------------------------- #
+# Sampled (distribution-preserving) speculative decoding
+
+def _softmax64(logits, temperature):
+    z = np.asarray(logits, np.float64) / max(temperature, 1e-6)
+    z -= z.max()
+    e = np.exp(z)
+    return e / e.sum()
+
+
+def _speculative_step(p_probs, q_probs, proposal, rng):
+    """One modified-rejection-sampling step (Leviathan et al.): accept
+    draft ``proposal`` with prob ``min(1, p/q)``; on rejection sample
+    from the residual ``max(0, p - q)`` (renormalized).  The returned
+    token is distributed EXACTLY according to ``p_probs`` when
+    ``proposal ~ q_probs`` — the property the statistical test pins
+    down.  Returns (token, accepted)."""
+    ratio = p_probs[proposal] / max(q_probs[proposal], 1e-30)
+    if rng.random() < min(1.0, ratio):
+        return int(proposal), True
+    residual = np.maximum(p_probs - q_probs, 0.0)
+    total = residual.sum()
+    if total <= 0.0:                 # p == q: residual empty
+        return int(rng.choice(len(p_probs), p=p_probs)), False
+    return int(rng.choice(len(residual), p=residual / total)), False
+
+
+def speculative_generate_sampled(target_params, draft_params, prompt,
+                                 num_new: int, target_config,
+                                 draft_config, k: int = 4,
+                                 temperature: float = 1.0,
+                                 seed: int = 0,
+                                 max_seq: Optional[int] = None
+                                 ) -> Tuple[np.ndarray, SpecStats]:
+    """SAMPLED speculative decode at ``temperature``: each committed
+    token is distributed exactly as target-only sampling at the same
+    temperature (modified rejection sampling — acceptance keeps the
+    draft's token, rejection resamples the residual, a full-accept
+    round earns a bonus token from the target's own distribution).
+
+    ``temperature <= 0`` delegates to the exact greedy path.  Batch 1.
+    Returns (tokens (num_new,), stats)."""
+    if temperature <= 0:
+        return speculative_generate(target_params, draft_params, prompt,
+                                    num_new, target_config,
+                                    draft_config, k=k, max_seq=max_seq)
+    rng = np.random.default_rng(seed)
+    draft_key = jax.random.PRNGKey(seed)
+    prompt_len, max_seq, target_logits, target_cache, draft_cache = \
+        _setup(target_params, draft_params, prompt, num_new,
+               target_config, draft_config, k, max_seq)
+
+    stats = SpecStats()
+    first_probs = _softmax64(np.asarray(target_logits)[0, -1],
+                             temperature)
+    committed = [int(rng.choice(len(first_probs), p=first_probs))]
+    stats.target_passes += 1
+    pos = prompt_len
+
+    while len(committed) < num_new:
+        # Draft: k sampled steps in ONE compiled scan; the per-step
+        # logits come back in a single (k, vocab) transfer for the
+        # acceptance math.  (Device sampling uses f32 Gumbel; the host
+        # acceptance uses the f64 softmax of the same logits — the
+        # ~1e-7 distribution skew is far below the statistical tests'
+        # resolution and the k host round-trips it saves.)
+        draft_key, round_key = jax.random.split(draft_key)
+        last = jnp.asarray([[committed[-1]]], jnp.int32)
+        proposal_arr, draft_rows, draft_cache = \
+            llama.sample_tokens_with_logits(
+                draft_params, last, draft_cache, jnp.int32(pos), k,
+                draft_config, jnp.float32(temperature), round_key)
+        proposals = [int(t) for t in np.asarray(proposal_arr)[0]]
+        rows_host = np.asarray(draft_rows)[0]          # (k, vocab)
+        q_dists = [_softmax64(rows_host[j], temperature)
+                   for j in range(k)]
+        stats.drafted += k
+
+        chunk = jnp.asarray([[committed[-1]] + proposals], jnp.int32)
+        logits, target_cache = llama.prefill_chunk(
+            target_params, chunk, target_cache, jnp.int32(pos),
+            target_config)
+        stats.target_passes += 1
+        target_logits_host = np.asarray(logits)[0]      # (k+1, vocab)
+
+        new_tokens = []
+        for j in range(k):
+            p = _softmax64(target_logits_host[j], temperature)
+            tok, accepted = _speculative_step(p, q_dists[j],
+                                              proposals[j], rng)
+            if accepted:
+                new_tokens.append(tok)
+                stats.accepted += 1
+            else:
+                new_tokens.append(tok)   # residual sample: corrected
+                break
+        else:
+            # Full accept: bonus token from the target's OWN dist.
+            p = _softmax64(target_logits_host[k], temperature)
+            new_tokens.append(int(rng.choice(len(p), p=p)))
+        committed.extend(new_tokens)
+        draft_cache = _resync_draft(draft_params, draft_cache,
+                                    new_tokens, k, pos, draft_config)
         pos += len(new_tokens)
 
     return np.asarray(committed[:num_new], np.int64), stats

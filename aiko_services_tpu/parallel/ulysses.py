@@ -56,9 +56,12 @@ def ulysses_attention(q, k, v, axis_name: str, causal: bool = True,
         attn_fn = attention_reference
     n = jax.lax.psum(1, axis_name)
     heads = q.shape[1]
-    if heads % n:
+    kv_heads = k.shape[1]
+    if heads % n or kv_heads % n:
         raise ValueError(
-            f"Ulysses needs heads ({heads}) divisible by axis size ({n})")
+            f"Ulysses needs q heads ({heads}) and kv heads "
+            f"({kv_heads}) divisible by axis size ({n}); repeat kv "
+            "heads first when they do not divide")
 
     # seq-sharded -> head-sharded: split the head dim across devices,
     # concatenate the sequence shards.  all_to_all is the single XLA
@@ -73,6 +76,14 @@ def ulysses_attention(q, k, v, axis_name: str, causal: bool = True,
                                   concat_axis=1, tiled=True)
 
     q_h, k_h, v_h = scatter_heads(q), scatter_heads(k), scatter_heads(v)
+    # GQA: the all-to-all moved only the kv heads (group-x fewer bytes
+    # over ICI); device i's q-head slice [i*h/n, (i+1)*h/n) maps
+    # exactly onto its kv-head slice [i*kv/n, (i+1)*kv/n), so a LOCAL
+    # repeat aligns them for the dense attention.
+    if q_h.shape[1] != k_h.shape[1]:
+        group = q_h.shape[1] // k_h.shape[1]
+        k_h = jnp.repeat(k_h, group, axis=1)
+        v_h = jnp.repeat(v_h, group, axis=1)
     # Full sequence is now local: plain causal masking is correct with
     # no global-offset bookkeeping (unlike the ring).
     o_h = attn_fn(q_h, k_h, v_h, causal=causal, sm_scale=sm_scale)

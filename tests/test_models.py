@@ -315,6 +315,21 @@ def test_forward_sequence_parallel_ulysses_matches_plain(tiny):
             config, make_mesh(sp=8), attention="ulysses")
 
 
+def test_forward_sequence_parallel_ulysses_kv_native(tiny):
+    """When kv heads divide the sp size the all-to-all moves only the
+    kv heads (repeat happens locally after the scatter) — output still
+    matches the plain forward."""
+    config, params = tiny                     # 4 heads, 2 kv heads
+    tokens = jax.random.randint(jax.random.PRNGKey(11), (2, 32),
+                                0, config.vocab_size, jnp.int32)
+    want = llama.forward(params, tokens, config, use_flash=False)
+    mesh = make_mesh(dp=4, sp=2)              # kv 2 % sp 2 == 0
+    got = llama.forward_sequence_parallel(params, tokens, config, mesh,
+                                          attention="ulysses")
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=3e-2, atol=4e-2)
+
+
 def test_forward_sequence_parallel_rejects_sliding_window():
     config = llama.CONFIGS["mistral_tiny"]
     params = llama.init_params(config, jax.random.PRNGKey(0))

@@ -89,3 +89,81 @@ def test_speculative_rejects_vocab_mismatch(target):
     with pytest.raises(ValueError, match="vocabulary"):
         speculative_generate(params, params, np.ones(4, np.int32), 4,
                              config, other)
+
+
+# --------------------------------------------------------------------------- #
+# Sampled speculative decoding
+
+def test_speculative_step_preserves_target_distribution():
+    """The theorem behind sampled speculation: proposal ~ q, accept
+    with min(1, p/q), reject -> residual sample, yields a token
+    distributed EXACTLY as p.  20k trials, chi-square-style bound."""
+    from aiko_services_tpu.models.speculative import _speculative_step
+    rng = np.random.default_rng(0)
+    vocab = 8
+    p = rng.dirichlet(np.ones(vocab))
+    q = rng.dirichlet(np.ones(vocab))
+    n = 20_000
+    counts = np.zeros(vocab)
+    for _ in range(n):
+        proposal = int(rng.choice(vocab, p=q))
+        token, _ = _speculative_step(p, q, proposal, rng)
+        counts[token] += 1
+    empirical = counts / n
+    # 4-sigma bound per bucket: se = sqrt(p(1-p)/n) <= 0.0036.
+    assert np.abs(empirical - p).max() < 0.016, (empirical, p)
+
+
+def test_speculative_sampled_temperature_zero_is_greedy():
+    from aiko_services_tpu.models.speculative import (
+        speculative_generate, speculative_generate_sampled,
+    )
+    config = llama.CONFIGS["tiny"]
+    target = llama.init_params(config, jax.random.PRNGKey(0))
+    draft = llama.init_params(config, jax.random.PRNGKey(5))
+    prompt = np.asarray([5, 17, 200, 3], np.int32)
+    greedy, _ = speculative_generate(target, draft, prompt, 8, config,
+                                     config, k=3)
+    sampled, _ = speculative_generate_sampled(
+        target, draft, prompt, 8, config, config, k=3, temperature=0.0)
+    np.testing.assert_array_equal(greedy, sampled)
+
+
+def test_speculative_sampled_reproducible_and_stats():
+    from aiko_services_tpu.models.speculative import (
+        speculative_generate_sampled,
+    )
+    config = llama.CONFIGS["tiny"]
+    target = llama.init_params(config, jax.random.PRNGKey(0))
+    draft = llama.init_params(config, jax.random.PRNGKey(5))
+    prompt = np.asarray([5, 17, 200, 3], np.int32)
+    a, stats = speculative_generate_sampled(
+        target, draft, prompt, 10, config, config, k=3,
+        temperature=0.8, seed=42)
+    b, _ = speculative_generate_sampled(
+        target, draft, prompt, 10, config, config, k=3,
+        temperature=0.8, seed=42)
+    np.testing.assert_array_equal(a, b)       # deterministic per seed
+    c, _ = speculative_generate_sampled(
+        target, draft, prompt, 10, config, config, k=3,
+        temperature=0.8, seed=43)
+    assert not np.array_equal(a, c)           # seed actually samples
+    assert a.shape == (10,)
+    assert 0.0 <= stats.acceptance_rate <= 1.0
+    assert stats.tokens_per_target_pass >= 1.0
+
+
+def test_speculative_sampled_identical_models_high_acceptance():
+    """Draft == target at moderate temperature: acceptance must be
+    near-perfect (p == q, ratio 1) — the self-consistency check of the
+    acceptance math through the full pipeline."""
+    from aiko_services_tpu.models.speculative import (
+        speculative_generate_sampled,
+    )
+    config = llama.CONFIGS["tiny"]
+    params = llama.init_params(config, jax.random.PRNGKey(0))
+    prompt = np.asarray([5, 17, 200, 3], np.int32)
+    _, stats = speculative_generate_sampled(
+        params, params, prompt, 16, config, config, k=4,
+        temperature=0.7, seed=1)
+    assert stats.acceptance_rate > 0.95, stats
