@@ -177,12 +177,50 @@ def int8_matmul(x, q, s, interpret: bool = False):
     return out.reshape(*lead, n)
 
 
+def _int4_kernel_repeat(xe_ref, xo_ref, p_ref, s_ref, o_ref,
+                        *, gs_half: int):
+    """Whole-tile fused int4 dequant-matmul: unpack the packed nibble
+    tile in-register, expand the group scales along rows, scale to
+    bf16, and run TWO full-K/2 MXU dots (even/odd original rows).
+    Mosaic fuses the unpack/scale chain into the dot's operand stream,
+    so neither the dequantized weights nor the f32 intermediates
+    materialize in HBM — measured 2.6x faster than the grouped-unroll
+    kernel at K=4096 decode shapes on v5e (scripts/int4_kernel_lab.py)
+    and equal at K=14336."""
+    low, high = _unpack_int4(p_ref[:])
+    se = jnp.repeat(s_ref[:], gs_half, axis=0)
+    wl = (low.astype(jnp.float32) * se).astype(jnp.bfloat16)
+    wh = (high.astype(jnp.float32) * se).astype(jnp.bfloat16)
+    acc = (jnp.dot(xe_ref[:], wl, preferred_element_type=jnp.float32)
+           + jnp.dot(xo_ref[:], wh,
+                     preferred_element_type=jnp.float32))
+    o_ref[:] = acc.astype(o_ref.dtype)
+
+
+def _pick_block_repeat(khalf: int, n: int) -> int:
+    """Output-column block for the repeat kernel, restricted to the
+    envelope VALIDATED ON HARDWARE (the axon relay wedges on failed
+    Pallas compiles, so only shapes proven to compile are dispatched):
+    K=4096-class tiles ran at bn<=512, K=14336-class (khalf 7168) at
+    bn=128; a bn=512 tile at K=14336 failed server-side, and nothing
+    above khalf=7168 has ever been compiled — larger K falls through
+    to the VMEM-gated grouped-unroll kernel or the XLA einsum."""
+    if khalf > 7168:
+        return 0
+    preferred = 256 if khalf <= 2048 else 128
+    for block in (preferred, 128):
+        if n % block == 0:
+            return block
+    return 0
+
+
 def _int4_kernel(xe_ref, xo_ref, p_ref, s_ref, o_ref, *, gs_half: int,
                  groups: int):
-    """Grouped fused int4 dequant-matmul: per scale group, unpack the
-    packed nibble tile in-register, run two MXU dots (even/odd original
-    rows), and apply the group's column scales into the f32 accumulator.
-    The dequantized weights never exist in HBM."""
+    """Grouped fused int4 dequant-matmul (fallback for shapes outside
+    the repeat kernel's validated envelope): per scale group, unpack
+    the packed nibble tile in-register, run two MXU dots (even/odd
+    original rows), and apply the group's column scales into the f32
+    accumulator.  The dequantized weights never exist in HBM."""
     m = xe_ref.shape[0]
     acc = jnp.zeros((m, o_ref.shape[1]), jnp.float32)
     # Static (unrolled) group loop: Mosaic has no dynamic_slice on
@@ -234,12 +272,20 @@ def int4_matmul(x, q4, s, interpret: bool = False):
     gs_half = khalf // groups
     x2 = x.reshape(-1, k)
     m = x2.shape[0]
-    block_n = _pick_block_int4(m, khalf, n, groups)
     on_tpu = jax.default_backend() == "tpu"
-    use_kernel = (_PALLAS_TPU and (on_tpu or interpret)
-                  and block_n != 0 and m <= 64
-                  and gs_half % 32 == 0)
-    if not use_kernel:
+    pallas_ok = _PALLAS_TPU and (on_tpu or interpret) and m <= 64
+    repeat_block = _pick_block_repeat(khalf, n) if pallas_ok else 0
+    unroll_block = _pick_block_int4(m, khalf, n, groups) \
+        if pallas_ok else 0
+    if repeat_block and gs_half >= 1:
+        kernel = functools.partial(_int4_kernel_repeat,
+                                   gs_half=gs_half)
+        block_n = repeat_block
+    elif unroll_block and gs_half >= 32 and gs_half % 32 == 0:
+        kernel = functools.partial(_int4_kernel, gs_half=gs_half,
+                                   groups=groups)
+        block_n = unroll_block
+    else:
         low, high = _unpack_int4(q4)
         q = jnp.stack([low, high], axis=1).reshape(k, n)
         x3 = x2.astype(jnp.float32).reshape(m, groups, k // groups)
@@ -250,7 +296,7 @@ def int4_matmul(x, q4, s, interpret: bool = False):
     xe = x2[:, 0::2]
     xo = x2[:, 1::2]
     out = pl.pallas_call(
-        functools.partial(_int4_kernel, gs_half=gs_half, groups=groups),
+        kernel,
         grid=(n // block_n,),
         in_specs=[
             pl.BlockSpec((m, khalf), lambda j: (0, 0)),

@@ -225,7 +225,9 @@ def test_int4_matmul_pallas_matches_fallback():
     x = jnp.asarray(rng.normal(size=(8, 256)), jnp.float32)
     qw = quantize_int4(w, group_size=128)
     got = int4_matmul(x, qw["q4"], qw["s"], interpret=True)
-    want = x @ dequantize_int4(qw, jnp.float32)
+    # The fused kernel feeds the MXU dequantized-to-bf16 weights (full
+    # MXU rate); compare against the bf16 dequantization.
+    want = x @ dequantize_int4(qw, jnp.bfloat16).astype(jnp.float32)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                rtol=2e-2, atol=2e-2)
 
@@ -1055,3 +1057,42 @@ def test_remat_train_step_matches():
     for a, b in zip(jax.tree.leaves(p1), jax.tree.leaves(p2)):
         assert float(jnp.max(jnp.abs(a.astype(jnp.float32)
                                      - b.astype(jnp.float32)))) < 1e-4
+
+
+def test_int4_dispatch_envelope():
+    """Kernel dispatch safety: shapes beyond the hardware-validated
+    envelope must NOT reach the repeat kernel (a failed Pallas compile
+    wedges the TPU relay); the grouped-unroll fallback stays reachable
+    for large-K small-m shapes within its VMEM budget."""
+    from aiko_services_tpu.ops.quant import (
+        _pick_block_int4, _pick_block_repeat,
+    )
+    # Validated: 8B shapes.
+    assert _pick_block_repeat(2048, 14336) == 256
+    assert _pick_block_repeat(7168, 4096) == 128
+    # Unvalidated: 70B-class K=28672 -> no repeat dispatch...
+    assert _pick_block_repeat(14336, 4096) == 0
+    # ...but the VMEM-gated unroll fallback covers small-m decode...
+    assert _pick_block_int4(8, 14336, 4096, 224) > 0
+    # ...and rejects tiles whose working set cannot fit the budget.
+    assert _pick_block_int4(64, 28_672, 4096, 448) == 0
+
+
+def test_int4_matmul_large_k_fallback_correct():
+    """A 70B-shaped K (beyond the repeat envelope) still computes
+    correctly through whichever fallback the dispatch picks."""
+    from aiko_services_tpu.ops.quant import (
+        dequantize_int4, int4_matmul, quantize_int4,
+    )
+    rng = np.random.default_rng(20)
+    w = jnp.asarray(rng.normal(size=(28_672, 128)) * 0.02, jnp.float32)
+    x = jnp.asarray(rng.normal(size=(8, 28_672)), jnp.bfloat16)
+    qw = quantize_int4(w, 128)
+    got = np.asarray(int4_matmul(x, qw["q4"], qw["s"], interpret=True),
+                     np.float32)
+    want = np.asarray(
+        jnp.dot(x, dequantize_int4(qw, jnp.bfloat16),
+                preferred_element_type=jnp.float32).astype(x.dtype),
+        np.float32)
+    rel = np.abs(got - want).max() / (np.abs(want).max() + 1e-9)
+    assert rel < 0.02, rel
