@@ -1,0 +1,152 @@
+"""Grammar-constrained decoding: hard output guarantees, on device.
+
+The reference coaxes its LLM into emitting S-expression robot commands
+by PROMPTING and then filters failures by hand (the `PE_LLM` element's
+prompt forbids prose and a regexp fishes out the command).  Here the
+constraint is structural: a finite-state token automaton masks the
+logits at every step inside the compiled decode scan, so ONLY strings
+the grammar accepts can ever be produced — greedy or sampled, zero
+post-hoc filtering.
+
+TPU-native design: the automaton is two dense arrays —
+
+* ``allowed``  (n_states, vocab) bool — which tokens may follow
+* ``next_state`` (n_states, vocab) int32 — where each token leads
+
+so a decode step is a gather + a mask, fully inside ``lax.scan`` (no
+data-dependent control flow, no host round-trips).  States with no
+allowed tokens are terminal: decoding emits ``pad_token`` forever once
+accepted (callers trim).
+
+Build automata directly, or from a token-level regular grammar via
+:func:`automaton_from_rules`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Dict, Iterable, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import llama
+
+__all__ = ["TokenAutomaton", "automaton_from_rules",
+           "constrained_generate"]
+
+
+@dataclasses.dataclass(frozen=True)
+class TokenAutomaton:
+    """Dense token-level DFA.  ``allowed[s, t]`` — token ``t`` legal in
+    state ``s``; ``next_state[s, t]`` — resulting state.  State 0 is
+    the start; ``accepting`` marks states where the output may end."""
+    allowed: np.ndarray        # (n_states, vocab) bool
+    next_state: np.ndarray     # (n_states, vocab) int32
+    accepting: np.ndarray      # (n_states,) bool
+
+    @property
+    def n_states(self) -> int:
+        return self.allowed.shape[0]
+
+    @property
+    def vocab(self) -> int:
+        return self.allowed.shape[1]
+
+    def accepts(self, tokens: Sequence[int]) -> bool:
+        """Host-side check (tests / validation)."""
+        state = 0
+        for token in tokens:
+            if not self.allowed[state, token]:
+                return False
+            state = int(self.next_state[state, token])
+        return bool(self.accepting[state])
+
+
+def automaton_from_rules(vocab: int,
+                         rules: Dict[int, Iterable[Tuple[object, int]]],
+                         accepting: Iterable[int]) -> TokenAutomaton:
+    """Build a dense automaton from sparse rules: ``rules[state]`` is
+    a list of ``(tokens, next_state)`` where ``tokens`` is an iterable
+    of token ids or the string ``"*"`` (any token not otherwise
+    listed).  Later entries override earlier ones; ``"*"`` applies
+    first so specific tokens win."""
+    n_states = max(max(rules, default=0),
+                   max((dst for moves in rules.values()
+                        for _, dst in moves), default=0)) + 1
+    allowed = np.zeros((n_states, vocab), bool)
+    next_state = np.zeros((n_states, vocab), np.int32)
+    for state, moves in rules.items():
+        wildcard = [(tok, dst) for tok, dst in moves if tok == "*"]
+        for _, dst in wildcard:
+            allowed[state, :] = True
+            next_state[state, :] = dst
+        for tokens, dst in moves:
+            if tokens == "*":
+                continue
+            ids = np.asarray(list(tokens), np.int32)
+            allowed[state, ids] = True
+            next_state[state, ids] = dst
+    accept = np.zeros((n_states,), bool)
+    accept[np.asarray(list(accepting), np.int32)] = True
+    return TokenAutomaton(allowed, next_state, accept)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("config", "num_steps",
+                                    "temperature"),
+                   donate_argnames=("cache",))
+def constrained_generate(params, first_logits, cache, start_index,
+                         num_steps, config: llama.LlamaConfig,
+                         allowed, next_state, pad_token: int = 0,
+                         temperature: float = 0.0, rng_key=None):
+    """Decode ``num_steps`` tokens with the automaton masking every
+    step — one compiled scan.  ``first_logits`` (batch, vocab) are the
+    prefill logits for the first constrained position; ``allowed`` /
+    ``next_state`` are the automaton arrays (device-convertible).
+
+    A row whose state has NO legal token (terminal) emits
+    ``pad_token`` and stays terminal.  Returns (tokens (batch,
+    num_steps), final_states (batch,), cache)."""
+    if rng_key is None:
+        rng_key = jax.random.PRNGKey(0)
+    allowed = jnp.asarray(allowed, bool)
+    next_state = jnp.asarray(next_state, jnp.int32)
+    batch = first_logits.shape[0]
+
+    def pick(logits, states, key):
+        mask = allowed[states]                        # (batch, vocab)
+        terminal = ~mask.any(axis=-1)
+        masked = jnp.where(mask, logits.astype(jnp.float32),
+                           -jnp.inf)
+        if temperature and temperature > 0:
+            choice = jax.random.categorical(
+                key, masked / jnp.float32(temperature)).astype(
+                    jnp.int32)
+        else:
+            choice = masked.argmax(-1).astype(jnp.int32)
+        token = jnp.where(terminal, pad_token, choice)
+        new_states = jnp.where(
+            terminal, states,
+            next_state[states, token])
+        return token, new_states
+
+    key0, loop_key = jax.random.split(rng_key)
+    states0 = jnp.zeros((batch,), jnp.int32)
+    first_token, states = pick(first_logits, states0, key0)
+
+    def body(carry, step):
+        token, states, cache, key = carry
+        logits, cache = llama._decode_core(
+            params, token[:, None], cache, start_index + step, config)
+        key, step_key = jax.random.split(key)
+        next_token, states = pick(logits[:, -1], states, step_key)
+        return (next_token, states, cache, key), next_token
+
+    (_, states, cache, _), rest = jax.lax.scan(
+        body, (first_token, states, cache, loop_key),
+        jnp.arange(num_steps - 1, dtype=jnp.int32))
+    tokens = jnp.concatenate([first_token[None], rest], axis=0).T
+    return tokens, states, cache

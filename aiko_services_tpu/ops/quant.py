@@ -178,7 +178,7 @@ def int8_matmul(x, q, s, interpret: bool = False):
 
 
 def _int4_kernel_repeat(xe_ref, xo_ref, p_ref, s_ref, o_ref,
-                        *, gs_half: int):
+                        *, gs_half: int, compute_dtype):
     """Whole-tile fused int4 dequant-matmul: unpack the packed nibble
     tile in-register, expand the group scales along rows, scale to
     bf16, and run TWO full-K/2 MXU dots (even/odd original rows).
@@ -189,29 +189,39 @@ def _int4_kernel_repeat(xe_ref, xo_ref, p_ref, s_ref, o_ref,
     and equal at K=14336."""
     low, high = _unpack_int4(p_ref[:])
     se = jnp.repeat(s_ref[:], gs_half, axis=0)
-    wl = (low.astype(jnp.float32) * se).astype(jnp.bfloat16)
-    wh = (high.astype(jnp.float32) * se).astype(jnp.bfloat16)
-    acc = (jnp.dot(xe_ref[:], wl, preferred_element_type=jnp.float32)
-           + jnp.dot(xo_ref[:], wh,
-                     preferred_element_type=jnp.float32))
+    # bf16 weights feed the MXU at full rate on TPU; interpret mode
+    # (CPU tests) computes in f32 because the CPU dot thunk has no
+    # bf16 x bf16 path.
+    wl = (low.astype(jnp.float32) * se).astype(compute_dtype)
+    wh = (high.astype(jnp.float32) * se).astype(compute_dtype)
+    xe = xe_ref[:].astype(compute_dtype)
+    xo = xo_ref[:].astype(compute_dtype)
+    acc = (jnp.dot(xe, wl, preferred_element_type=jnp.float32)
+           + jnp.dot(xo, wh, preferred_element_type=jnp.float32))
     o_ref[:] = acc.astype(o_ref.dtype)
 
 
-def _pick_block_repeat(khalf: int, n: int) -> int:
-    """Output-column block for the repeat kernel, restricted to the
-    envelope VALIDATED ON HARDWARE (the axon relay wedges on failed
-    Pallas compiles, so only shapes proven to compile are dispatched):
-    K=4096-class tiles ran at bn<=512, K=14336-class (khalf 7168) at
-    bn=128; a bn=512 tile at K=14336 failed server-side, and nothing
-    above khalf=7168 has ever been compiled — larger K falls through
-    to the VMEM-gated grouped-unroll kernel or the XLA einsum."""
-    if khalf > 7168:
+#: khalf -> output-column block: EXACTLY the tile classes compiled and
+#: run on the v5e (scripts/int4_kernel_lab.py): K=4096 (khalf 2048) at
+#: bn<=512, K=14336 (khalf 7168) at bn=128.  A bn=512 tile at K=14336
+#: failed server-side and wedged the relay; nothing else has ever been
+#: compiled, so nothing else is dispatched on hardware.
+_REPEAT_VALIDATED = {2048: 256, 7168: 128}
+
+
+def _pick_block_repeat(khalf: int, n: int, interpret: bool) -> int:
+    """Output-column block for the repeat kernel.  On hardware the
+    dispatch is restricted to the validated classes above (a failed
+    Pallas compile wedges the axon relay); interpret mode runs no
+    Mosaic compile, so tests may exercise any tileable shape."""
+    if interpret:
+        preferred = 256 if khalf <= 2048 else 128
+        for block in (preferred, 128):
+            if n % block == 0:
+                return block
         return 0
-    preferred = 256 if khalf <= 2048 else 128
-    for block in (preferred, 128):
-        if n % block == 0:
-            return block
-    return 0
+    block = _REPEAT_VALIDATED.get(khalf, 0)
+    return block if block and n % block == 0 else 0
 
 
 def _int4_kernel(xe_ref, xo_ref, p_ref, s_ref, o_ref, *, gs_half: int,
@@ -274,12 +284,16 @@ def int4_matmul(x, q4, s, interpret: bool = False):
     m = x2.shape[0]
     on_tpu = jax.default_backend() == "tpu"
     pallas_ok = _PALLAS_TPU and (on_tpu or interpret) and m <= 64
-    repeat_block = _pick_block_repeat(khalf, n) if pallas_ok else 0
+    repeat_block = _pick_block_repeat(khalf, n, interpret) \
+        if pallas_ok else 0
     unroll_block = _pick_block_int4(m, khalf, n, groups) \
         if pallas_ok else 0
-    if repeat_block and gs_half >= 1:
-        kernel = functools.partial(_int4_kernel_repeat,
-                                   gs_half=gs_half)
+    # gs_half alignment: validation used group_size=128 (gs_half 64);
+    # 32-multiples share its int8 sublane tiling.
+    if repeat_block and gs_half >= 32 and gs_half % 32 == 0:
+        kernel = functools.partial(
+            _int4_kernel_repeat, gs_half=gs_half,
+            compute_dtype=jnp.float32 if interpret else jnp.bfloat16)
         block_n = repeat_block
     elif unroll_block and gs_half >= 32 and gs_half % 32 == 0:
         kernel = functools.partial(_int4_kernel, gs_half=gs_half,

@@ -225,9 +225,9 @@ def test_int4_matmul_pallas_matches_fallback():
     x = jnp.asarray(rng.normal(size=(8, 256)), jnp.float32)
     qw = quantize_int4(w, group_size=128)
     got = int4_matmul(x, qw["q4"], qw["s"], interpret=True)
-    # The fused kernel feeds the MXU dequantized-to-bf16 weights (full
-    # MXU rate); compare against the bf16 dequantization.
-    want = x @ dequantize_int4(qw, jnp.bfloat16).astype(jnp.float32)
+    # Interpret mode computes in f32 (CPU has no bf16 dot); on TPU the
+    # kernel feeds the MXU bf16 weights — within the same tolerance.
+    want = x @ dequantize_int4(qw, jnp.float32)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                rtol=2e-2, atol=2e-2)
 
@@ -1067,11 +1067,14 @@ def test_int4_dispatch_envelope():
     from aiko_services_tpu.ops.quant import (
         _pick_block_int4, _pick_block_repeat,
     )
-    # Validated: 8B shapes.
-    assert _pick_block_repeat(2048, 14336) == 256
-    assert _pick_block_repeat(7168, 4096) == 128
-    # Unvalidated: 70B-class K=28672 -> no repeat dispatch...
-    assert _pick_block_repeat(14336, 4096) == 0
+    # Validated: 8B shapes (hardware dispatch, interpret=False).
+    assert _pick_block_repeat(2048, 14336, False) == 256
+    assert _pick_block_repeat(7168, 4096, False) == 128
+    # Unvalidated khalf classes never dispatch on hardware...
+    assert _pick_block_repeat(14336, 4096, False) == 0
+    assert _pick_block_repeat(4096, 4096, False) == 0   # interpolated
+    # ...but interpret mode (no Mosaic compile) stays permissive.
+    assert _pick_block_repeat(4096, 4096, True) == 128
     # ...but the VMEM-gated unroll fallback covers small-m decode...
     assert _pick_block_int4(8, 14336, 4096, 224) > 0
     # ...and rejects tiles whose working set cannot fit the budget.
