@@ -201,12 +201,13 @@ def _int4_kernel_repeat(xe_ref, xo_ref, p_ref, s_ref, o_ref,
     o_ref[:] = acc.astype(o_ref.dtype)
 
 
-#: khalf -> output-column block: EXACTLY the tile classes compiled and
-#: run on the v5e (scripts/int4_kernel_lab.py): K=4096 (khalf 2048) at
-#: bn<=512, K=14336 (khalf 7168) at bn=128.  A bn=512 tile at K=14336
-#: failed server-side and wedged the relay; nothing else has ever been
-#: compiled, so nothing else is dispatched on hardware.
-_REPEAT_VALIDATED = {2048: 256, 7168: 128}
+#: khalf -> output-column blocks (preferred first): EXACTLY the tile
+#: classes compiled and run on the v5e (scripts/int4_kernel_lab.py):
+#: K=4096 (khalf 2048) ran at bn 128/256/512, K=14336 (khalf 7168) at
+#: bn=128.  A bn=512 tile at K=14336 failed server-side and wedged the
+#: relay; nothing else has ever been compiled, so nothing else is
+#: dispatched on hardware.
+_REPEAT_VALIDATED = {2048: (256, 128), 7168: (128,)}
 
 
 def _pick_block_repeat(khalf: int, n: int, interpret: bool) -> int:
@@ -215,13 +216,13 @@ def _pick_block_repeat(khalf: int, n: int, interpret: bool) -> int:
     Pallas compile wedges the axon relay); interpret mode runs no
     Mosaic compile, so tests may exercise any tileable shape."""
     if interpret:
-        preferred = 256 if khalf <= 2048 else 128
-        for block in (preferred, 128):
-            if n % block == 0:
-                return block
-        return 0
-    block = _REPEAT_VALIDATED.get(khalf, 0)
-    return block if block and n % block == 0 else 0
+        blocks = (256, 128) if khalf <= 2048 else (128,)
+    else:
+        blocks = _REPEAT_VALIDATED.get(khalf, ())
+    for block in blocks:
+        if n % block == 0:
+            return block
+    return 0
 
 
 def _int4_kernel(xe_ref, xo_ref, p_ref, s_ref, o_ref, *, gs_half: int,

@@ -33,7 +33,8 @@ from ..runtime.service import ServiceFilter
 from ..utils.sexpr import generate
 
 __all__ = ["ModelReplica", "ReplicaRouter", "REPLICA_PROTOCOL",
-           "make_llama_infer", "make_speculative_infer"]
+           "make_llama_infer", "make_speculative_infer",
+           "make_constrained_infer"]
 
 REPLICA_PROTOCOL = "model_replica:0"
 
@@ -120,6 +121,32 @@ class ReplicaRouter(Actor):
         return True
 
 
+def _coerce_request(inputs: Dict, config, default_new: int):
+    """Shared request scaffolding for the infer factories: coerce the
+    token array to (batch, prompt), clamp the generation budget to the
+    model's max_seq_len.  Returns (tokens, prompt_len, new) or an
+    error payload dict."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    tokens = jnp.asarray(np.asarray(inputs["tokens"]), jnp.int32)
+    if tokens.ndim == 1:
+        tokens = tokens[None]
+    prompt_len = tokens.shape[1]
+    if prompt_len >= config.max_seq_len:
+        # Reject cleanly: a cache shorter than the prompt would fail
+        # deep inside prefill with an opaque trace error.
+        return {"error": f"prompt_len {prompt_len} >= max_seq_len "
+                         f"{config.max_seq_len}"}
+    requested = int(np.asarray(inputs.get("max_new_tokens",
+                                          default_new)))
+    new = min(requested, config.max_seq_len - prompt_len)
+    if new <= 0:
+        return {"error": f"prompt_len {prompt_len} leaves no budget "
+                         f"under max_seq_len {config.max_seq_len}"}
+    return tokens, prompt_len, new
+
+
 def make_llama_infer(config_name: str = "tiny", quantize: bool = False,
                      max_new_tokens: int = 16, seed: int = 0,
                      quantize_kv: bool = False) -> Callable:
@@ -137,19 +164,12 @@ def make_llama_infer(config_name: str = "tiny", quantize: bool = False,
         params = llama.quantize_params(params)
 
     def infer(inputs: Dict) -> Dict:
-        tokens = jnp.asarray(np.asarray(inputs["tokens"]), jnp.int32)
-        if tokens.ndim == 1:
-            tokens = tokens[None]
-        batch, prompt_len = tokens.shape
-        if prompt_len >= config.max_seq_len:
-            # Reject cleanly: a cache shorter than the prompt would fail
-            # deep inside prefill with an opaque trace error.
-            return {"error": f"prompt_len {prompt_len} >= max_seq_len "
-                             f"{config.max_seq_len}"}
-        requested = int(np.asarray(inputs.get("max_new_tokens",
-                                              max_new_tokens)))
-        new = min(requested, config.max_seq_len - prompt_len)
-        cache = llama.init_cache(config, batch, prompt_len + new,
+        request = _coerce_request(inputs, config, max_new_tokens)
+        if isinstance(request, dict):
+            return request
+        tokens, prompt_len, new = request
+        cache = llama.init_cache(config, tokens.shape[0],
+                                 prompt_len + new,
                                  quantize_kv=quantize_kv)
         logits, cache = llama.prefill(params, tokens, cache, config)
         first = logits[:, -1].argmax(-1).astype(jnp.int32)[:, None]
@@ -217,5 +237,58 @@ def make_speculative_infer(target_config="small", draft_config="tiny",
                 "acceptance_rate": np.float32(stats.acceptance_rate),
                 "tokens_per_target_pass": np.float32(
                     stats.tokens_per_target_pass)}
+
+    return infer
+
+
+def make_constrained_infer(config_name: str = "tiny", automaton=None,
+                           quantize: bool = False,
+                           max_new_tokens: int = 16, seed: int = 0,
+                           temperature: float = 0.0) -> Callable:
+    """Build a ModelReplica ``infer`` callable whose outputs are
+    guaranteed grammatical: a token-DFA masks every decode step
+    (:mod:`~..models.constrained`), so the replica can ONLY emit
+    sequences the grammar accepts — the hard-guarantee upgrade of the
+    reference's prompt-and-regex robot commanding.  Responses carry
+    ``tokens_out`` (each row is the grammatical output followed by
+    ``pad_token`` zeros once its state went terminal — trim at the
+    grammar's end marker) and per-row ``accepted`` flags."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from ..models import llama
+    from ..models.constrained import constrained_generate
+
+    if automaton is None:
+        raise ValueError("make_constrained_infer requires automaton=")
+    config = llama.CONFIGS[config_name]
+    if automaton.vocab != config.vocab_size:
+        raise ValueError(
+            f"automaton vocab {automaton.vocab} != model vocab "
+            f"{config.vocab_size}")
+    params = llama.init_params(config, jax.random.PRNGKey(seed))
+    if quantize:
+        params = llama.quantize_params(params)
+    # Device-resident once: re-uploading (n_states, vocab) masks per
+    # request would put a host transfer on the serving hot path.
+    allowed = jnp.asarray(automaton.allowed)
+    next_state = jnp.asarray(automaton.next_state)
+
+    def infer(inputs: Dict) -> Dict:
+        request = _coerce_request(inputs, config, max_new_tokens)
+        if isinstance(request, dict):
+            return request
+        tokens, prompt_len, new = request
+        cache = llama.init_cache(config, tokens.shape[0],
+                                 prompt_len + new)
+        logits, cache = llama.prefill(params, tokens, cache, config)
+        seed_req = int(np.asarray(inputs.get("seed", 0)))
+        out, states, _ = constrained_generate(
+            params, logits[:, -1], cache, jnp.int32(prompt_len), new,
+            config, allowed, next_state, temperature=temperature,
+            rng_key=jax.random.PRNGKey(seed_req))
+        accepted = automaton.accepting[np.asarray(states)]
+        return {"tokens_out": np.asarray(out),
+                "accepted": accepted.astype(np.int32)}
 
     return infer

@@ -243,3 +243,45 @@ def test_speculative_replica_matches_plain_replica(engine):
                                   by_id["spec"]["tokens_out"])
     assert 0.0 <= float(by_id["spec"]["acceptance_rate"]) <= 1.0
     assert float(by_id["spec"]["tokens_per_target_pass"]) >= 1.0
+
+
+def test_constrained_replica_grammatical_over_wire(engine):
+    """A constrained replica serves requests whose outputs the grammar
+    MUST accept — verified by replaying every returned sequence through
+    the automaton, over the actual wire protocol."""
+    from aiko_services_tpu.models.constrained import automaton_from_rules
+    from aiko_services_tpu.orchestration.serving import (
+        make_constrained_infer,
+    )
+    LP, RP = 1, 2
+    automaton = automaton_from_rules(
+        vocab=1024,
+        rules={0: [((LP,), 1)], 1: [((3, 4, 5), 2)],
+               2: [((6, 7, 8, 9), 4), ((RP,), 3)],
+               4: [((RP,), 3)], 3: []},
+        accepting=[3])
+
+    p1 = make_process(engine, 2, broker="grammar")
+    replica = compose_instance(
+        ModelReplica, actor_args("grammar_replica"), process=p1,
+        infer=make_constrained_infer("tiny", automaton=automaton,
+                                     max_new_tokens=8,
+                                     temperature=1.0))
+    pr = make_process(engine, 3, broker="grammar")
+    responses = []
+    response_topic = "test/h/3/client/response"
+    collect_responses(pr, response_topic, responses)
+    prompt = np.asarray([[30, 40, 50, 60]], np.int32)
+    pr.message.publish(
+        f"{replica.topic_path}/in",
+        generate("infer", ["g1", response_topic,
+                           encode_swag({"tokens": prompt,
+                                        "seed": np.int64(9)})]))
+    engine.drain()
+    assert len(responses) == 1
+    _, outputs = responses[0]
+    out = np.asarray(outputs["tokens_out"])[0].tolist()
+    assert np.asarray(outputs["accepted"]).all()
+    close = out.index(RP)
+    assert automaton.accepts(out[:close + 1])
+    assert all(t == 0 for t in out[close + 1:])
