@@ -201,12 +201,13 @@ def _int4_kernel_repeat(xe_ref, xo_ref, p_ref, s_ref, o_ref,
     o_ref[:] = acc.astype(o_ref.dtype)
 
 
-#: khalf -> output-column blocks (preferred first): EXACTLY the tile
+#: khalf -> output-column blocks (preferred first), drawn from the tile
 #: classes compiled and run on the v5e (scripts/int4_kernel_lab.py):
-#: K=4096 (khalf 2048) ran at bn 128/256/512, K=14336 (khalf 7168) at
-#: bn=128.  A bn=512 tile at K=14336 failed server-side and wedged the
-#: relay; nothing else has ever been compiled, so nothing else is
-#: dispatched on hardware.
+#: K=4096 (khalf 2048) ran at bn 128/256/512 — 256 measured fastest,
+#: 512 validated but never preferred (any n divisible by 512 picks 256
+#: first anyway) — and K=14336 (khalf 7168) at bn=128.  A bn=512 tile
+#: at K=14336 failed server-side and wedged the relay; no other khalf
+#: class has ever been compiled, so no other is dispatched on hardware.
 _REPEAT_VALIDATED = {2048: (256, 128), 7168: (128,)}
 
 

@@ -165,9 +165,18 @@ class ContinuousBatchingServer:
         return None
 
     @property
+    def slots_active(self) -> int:
+        """Live decode lanes (operator telemetry)."""
+        return sum(r is not None for r in self._requests)
+
+    @property
+    def queue_depth(self) -> int:
+        """Requests awaiting a slot (operator telemetry)."""
+        return len(self._queue)
+
+    @property
     def busy(self) -> bool:
-        return bool(self._queue) or any(
-            r is not None for r in self._requests)
+        return bool(self._queue) or self.slots_active > 0
 
     def _admit(self) -> None:
         for slot in range(self.slots):
@@ -358,10 +367,27 @@ class ContinuousReplica(Actor):
     def _pump(self):
         for request in self.server.step():
             self._respond(request)
+        self._share_telemetry()
         if self.server.busy or self.server.completed:
             self._schedule_pump()
         else:
             self._pumping = False
+
+    def _share_telemetry(self):
+        """Operator view (dashboard / any ECConsumer): live slot
+        occupancy and queue depth, refreshed every pump."""
+        updates = {
+            "slots_active": int(self.server.slots_active),
+            "queue_depth": int(self.server.queue_depth),
+        }
+        changed = {key: value for key, value in updates.items()
+                   if self.share.get(key) != value}
+        if not changed:
+            return
+        self.share.update(changed)
+        if self.ec_producer is not None:
+            for key, value in changed.items():
+                self.ec_producer.update(key, value)
 
     def _respond(self, request: DecodeRequest):
         from ..pipeline.codec import encode_swag

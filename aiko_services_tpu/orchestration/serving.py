@@ -140,10 +140,10 @@ def _coerce_request(inputs: Dict, config, default_new: int):
                          f"{config.max_seq_len}"}
     requested = int(np.asarray(inputs.get("max_new_tokens",
                                           default_new)))
+    if requested <= 0:
+        return {"error": f"max_new_tokens must be positive, got "
+                         f"{requested}"}
     new = min(requested, config.max_seq_len - prompt_len)
-    if new <= 0:
-        return {"error": f"prompt_len {prompt_len} leaves no budget "
-                         f"under max_seq_len {config.max_seq_len}"}
     return tokens, prompt_len, new
 
 

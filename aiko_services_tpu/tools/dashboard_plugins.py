@@ -143,7 +143,11 @@ def model_replica_plugin(fields, variables) -> List[str]:
     ]
     slots = _get(variables, "slots", default=None)
     if slots not in (None, "-"):
-        lines.append(f"  slots:     {slots} (continuous batching)")
+        lines.append(f"  slots:     "
+                     f"{_get(variables, 'slots_active', default=0)}"
+                     f"/{slots} active (continuous batching)")
+        lines.append(f"  queued:    "
+                     f"{_get(variables, 'queue_depth', default=0)}")
     return lines
 
 

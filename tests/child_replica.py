@@ -1,0 +1,43 @@
+"""Child process for cross-OS-process SERVING tests.
+
+Run as ``python -m tests.child_replica``: connects to the MQTT broker
+named by AIKO_MQTT_HOST/AIKO_MQTT_PORT, optionally hosts the Registrar
+(CHILD_REGISTRAR=1), composes a ModelReplica serving the tiny
+Llama-architecture model, prints READY, and serves until killed — a
+one-chip serving worker as LifeCycleManager/ProcessManager would spawn
+it."""
+
+import os
+import sys
+
+
+def main():
+    # The sandbox pins JAX_PLATFORMS=axon via sitecustomize (env vars
+    # are ignored); force the CPU backend the way conftest does.
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    from aiko_services_tpu.orchestration.serving import (
+        ModelReplica, make_llama_infer,
+    )
+    from aiko_services_tpu.registry import Registrar
+    from aiko_services_tpu.runtime import (
+        Process, actor_args, compose_instance,
+    )
+    from aiko_services_tpu.runtime.event import EventEngine
+
+    engine = EventEngine()
+    process = Process(engine=engine, transport="mqtt")
+    if os.environ.get("CHILD_REGISTRAR") == "1":
+        Registrar(process=process)
+    compose_instance(
+        ModelReplica,
+        actor_args(os.environ.get("CHILD_REPLICA_NAME", "replica")),
+        process=process,
+        infer=make_llama_infer("tiny", max_new_tokens=4))
+    print("READY", flush=True)
+    engine.loop()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -153,3 +153,48 @@ def test_mixed_greedy_and_sampled_slots():
     assert len(sampled.tokens) == 8
     assert all(0 <= t < server.config.vocab_size
                for t in sampled.tokens)
+
+
+def test_continuous_replica_telemetry_in_share(engine):
+    """Slot occupancy and queue depth surface in the replica's EC share
+    while requests are live, and return to zero once drained."""
+    process = Process(namespace="test", hostname="h", pid="41",
+                      engine=engine, broker="telemetry")
+    server = ContinuousBatchingServer(config_name="tiny", slots=2,
+                                      max_seq=64, chunk_steps=2)
+    replica = compose_instance(
+        ContinuousReplica, actor_args("cb_tel"), process=process,
+        server=server)
+    client = Process(namespace="test", hostname="h", pid="42",
+                     engine=engine, broker="telemetry")
+    prompt = np.arange(1, 6, dtype=np.int32)
+    # The drain completes all pumps at once; observe the INTERMEDIATE
+    # states the EC producer echoed on the state topic (exactly what a
+    # dashboard consumer sees).
+    updates = []
+
+    def on_state(topic, payload):
+        command, args = parse(payload)
+        if command == "update":
+            updates.append((args[0], args[1]))
+
+    client.add_message_handler(on_state,
+                              f"{replica.topic_path}/state")
+    for i in range(3):
+        client.message.publish(
+            replica.topic_in,
+            generate("infer", [f"t{i}", "test/h/42/resp",
+                               encode_swag({"tokens": prompt,
+                                            "max_new_tokens":
+                                            np.int64(6)})]))
+    for _ in range(200):
+        engine.advance(0.01)   # fire the delayed pump self-post
+        engine.drain()
+        if not server.busy and not replica._pumping:
+            break
+    active = [int(v) for k, v in updates if k == "slots_active"]
+    queued = [int(v) for k, v in updates if k == "queue_depth"]
+    assert max(active) == 2, updates         # both slots were live
+    assert max(queued) >= 1, updates         # the 3rd request queued
+    assert replica.share["slots_active"] == 0
+    assert replica.share["queue_depth"] == 0

@@ -38,6 +38,23 @@ def broker():
     b.stop()
 
 
+def read_ready(child, timeout=90.0):
+    """Wait for the child's READY line with a timeout — a child that
+    dies or hangs pre-READY must fail the test loudly, not hang the
+    whole pytest run on a blocking readline."""
+    import select
+    ready, _, _ = select.select([child.stdout], [], [], timeout)
+    if not ready:
+        child.kill()
+        raise AssertionError("child produced no READY within "
+                             f"{timeout}s (hung during startup)")
+    line = child.stdout.readline().strip()
+    assert line == "READY", (
+        f"child failed to start: {line!r}; stderr: "
+        f"{(child.stderr.read() if child.stderr else '')[-1500:]}")
+    return child
+
+
 def spawn_child(broker, namespace):
     env = dict(os.environ,
                AIKO_MQTT_HOST=broker.host,
@@ -48,9 +65,7 @@ def spawn_child(broker, namespace):
         [sys.executable, "-m", "tests.child_pipeline"],
         cwd=REPO_ROOT, env=env, stdout=subprocess.PIPE,
         stderr=subprocess.DEVNULL, text=True)
-    line = child.stdout.readline().strip()
-    assert line == "READY", f"child failed to start: {line!r}"
-    return child
+    return read_ready(child)
 
 
 def test_remote_element_across_os_processes(broker, monkeypatch):
@@ -131,3 +146,80 @@ def test_child_death_fires_lwt_eviction(broker):
             lambda: any(p == "(absent)" for _, p in got), 10), got
     finally:
         watcher.disconnect()
+
+
+def test_llm_serving_across_os_processes(broker, monkeypatch):
+    """DP LLM serving across REAL process boundaries: two subprocess
+    replicas (one also hosting the Registrar), a router in this
+    process, requests and token tensors crossing the built-in MQTT
+    broker — the BASELINE 'multi-replica serving actors' shape with
+    actual OS isolation."""
+    import numpy as np
+
+    from aiko_services_tpu.orchestration.serving import ReplicaRouter
+    from aiko_services_tpu.pipeline.codec import decode_swag, encode_swag
+    from aiko_services_tpu.runtime import actor_args
+    from aiko_services_tpu.utils.sexpr import generate, parse
+
+    monkeypatch.setenv("AIKO_MQTT_HOST", broker.host)
+    monkeypatch.setenv("AIKO_MQTT_PORT", str(broker.port))
+    namespace = f"serve{broker.port}"
+    children = []
+    for index in (0, 1):
+        env = dict(os.environ,
+                   AIKO_MQTT_HOST=broker.host,
+                   AIKO_MQTT_PORT=str(broker.port),
+                   AIKO_NAMESPACE=namespace,
+                   JAX_PLATFORMS="cpu",
+                   CHILD_REGISTRAR="1" if index == 0 else "0",
+                   CHILD_REPLICA_NAME=f"replica{index}")
+        child = subprocess.Popen(
+            [sys.executable, "-m", "tests.child_replica"],
+            cwd=REPO_ROOT, env=env, stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE, text=True)
+        children.append(read_ready(child))
+
+    engine = EventEngine()
+    thread = engine.run_in_thread()
+    process = None
+    try:
+        process = Process(namespace=namespace, engine=engine,
+                          transport="mqtt")
+        assert wait_for(lambda: process.message.connected, 10)
+        router = compose_instance(
+            ReplicaRouter, actor_args("router"), process=process)
+        assert wait_for(lambda: router.share["replicas"] == 2, 30), \
+            router.share
+        responses = {}
+
+        def on_response(topic, payload):
+            command, params = parse(payload)
+            if command == "infer_response":
+                responses[str(params[0])] = decode_swag(params[1])
+
+        response_topic = f"{namespace}/client/response"
+        process.add_message_handler(on_response, response_topic)
+        prompt = np.arange(1, 7, dtype=np.int32)[None, :]
+        for i in range(4):
+            process.message.publish(
+                f"{router.topic_path}/in",
+                generate("infer", [f"x{i}", response_topic,
+                                   encode_swag({"tokens": prompt})]))
+        assert wait_for(lambda: len(responses) == 4, 60), \
+            sorted(responses)
+        for outputs in responses.values():
+            tokens_out = np.asarray(outputs["tokens_out"])
+            assert tokens_out.shape == (1, 10)
+            assert (tokens_out[:, :6] == prompt).all()
+        # Determinism across replicas: same seed & prompt -> identical
+        # completions from both children.
+        assert len({tuple(np.asarray(o["tokens_out"]).ravel())
+                    for o in responses.values()}) == 1
+    finally:
+        if process is not None:
+            process.terminate()
+        engine.terminate()
+        thread.join(timeout=5)
+        for child in children:
+            child.terminate()
+            child.wait(timeout=10)

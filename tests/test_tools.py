@@ -249,9 +249,12 @@ def test_model_replica_and_profiler_plugins():
     plugin = find_plugin(fields)
     assert plugin is not None
     lines = plugin(fields, {"lifecycle": "ready", "requests_served": 7,
-                            "slots": 4})
+                            "slots": 4, "slots_active": 3,
+                            "queue_depth": 2})
     text = "\n".join(lines)
-    assert "served:    7" in text and "slots:     4" in text
+    assert "served:    7" in text
+    assert "slots:     3/4 active" in text
+    assert "queued:    2" in text
 
     fields = SimpleNamespace(name="prof0", protocol="profiler:0",
                              topic_path="ns/h/1/1")
