@@ -216,3 +216,38 @@ def test_elastic_trainer_topology_change_matches_uninterrupted(tmp_path):
     for a, b in zip(base_losses, first_losses + second_losses):
         assert abs(a - b) < 5e-3, (base_losses,
                                    first_losses + second_losses)
+
+
+def test_quantized_param_tree_roundtrip(tmp_path):
+    """Serving deployment shape: int8 and int4 quantized weight trees
+    (int8 codes + f32 scales, nibble-packed q4) checkpoint and restore
+    bit-exactly — a replica can boot from a quantized checkpoint
+    without requantizing."""
+    import jax
+    import numpy as np
+    from aiko_services_tpu.models import llama
+    from aiko_services_tpu.parallel.checkpoint import TrainCheckpointer
+
+    config = llama.CONFIGS["tiny"]
+    dense = llama.init_params(config, jax.random.PRNGKey(0))
+    for bits in (8, 4):
+        quantized = llama.quantize_params(dense, bits=bits)
+        directory = tmp_path / f"int{bits}"
+        saver = TrainCheckpointer(str(directory))
+        saver.save(1, {"params": quantized}, metadata={"bits": bits})
+        saver.close()
+
+        loader = TrainCheckpointer(str(directory))
+        restored = loader.restore({"params": quantized})["params"]
+        loader.close()
+        flat_a = jax.tree.leaves(quantized)
+        flat_b = jax.tree.leaves(restored)
+        assert len(flat_a) == len(flat_b)
+        for a, b in zip(flat_a, flat_b):
+            assert a.dtype == b.dtype
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+        # The restored tree decodes: one greedy step runs finite.
+        logits = llama.forward(
+            restored, jax.numpy.zeros((1, 8), jax.numpy.int32), config)
+        assert np.isfinite(np.asarray(logits)).all()
