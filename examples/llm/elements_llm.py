@@ -23,7 +23,8 @@ from aiko_services_tpu.pipeline.element import PipelineElement
 from aiko_services_tpu.pipeline.stream import StreamEvent
 from aiko_services_tpu.utils.sexpr import parse
 
-__all__ = ["PE_LLM", "SYSTEM_PROMPT", "tokenize", "detokenize"]
+__all__ = ["PE_LLM", "SYSTEM_PROMPT", "tokenize", "detokenize",
+           "build_command_automaton"]
 
 #: Same contract as the reference's prompt (elements_llm.py:137-179):
 #: the assistant must reply with exactly one command S-expression.
@@ -66,6 +67,82 @@ def extract_command(text: str) -> Optional[list]:
     return None
 
 
+#: Longest string the command DFA can accept: "(say " + 24 letters +
+#: ")" = 30 bytes.  A decode budget >= this always closes the command.
+COMMAND_MAX_BYTES = 30
+
+
+def build_command_automaton(vocab: int = 1024):
+    """Byte-level token DFA accepting EXACTLY the robot-command
+    grammar the system prompt asks for — with the constrained decoder
+    (``models/constrained.py``) the model cannot emit anything else,
+    upgrading the reference's prompt-and-hope contract to a hard
+    guarantee:
+
+        "(" ("sleep" | "stop") ")"
+      | "(" ("forward"|"backward"|"turn"|"look") " " digit{1,3} ")"
+      | "(" "say" " " [a-z ]{1,24} ")"
+    """
+    from aiko_services_tpu.models.constrained import (
+        automaton_from_rules,
+    )
+    rules = {}
+    counter = iter(range(1, 10_000))
+
+    def fresh():
+        return next(counter)
+
+    def add(state, tokens, dst):
+        rules.setdefault(state, []).append((tuple(tokens), dst))
+
+    accept = fresh()
+    rules[accept] = []                       # terminal
+    after_open = fresh()
+    add(0, [ord("(")], after_open)
+
+    # Shared-prefix trie: "say"/"sleep"/"stop" all leave after_open on
+    # 's', so transitions must reuse states (a second add() for the
+    # same (state, byte) would clobber the first in the dense DFA).
+    children = {}
+
+    def spell(state, word):
+        for ch in word:
+            key = (state, ch)
+            if key not in children:
+                children[key] = fresh()
+                add(state, [ord(ch)], children[key])
+            state = children[key]
+        return state
+
+    for verb in ("sleep", "stop"):
+        end = spell(after_open, verb)
+        add(end, [ord(")")], accept)
+    digits = [ord(c) for c in "0123456789"]
+    for verb in ("forward", "backward", "turn", "look"):
+        end = spell(after_open, verb)
+        gap = fresh()
+        add(end, [ord(" ")], gap)
+        d1, d2, d3 = fresh(), fresh(), fresh()
+        add(gap, digits, d1)
+        add(d1, digits, d2)
+        add(d2, digits, d3)
+        for state in (d1, d2, d3):
+            add(state, [ord(")")], accept)
+    letters = [ord(c) for c in "abcdefghijklmnopqrstuvwxyz "]
+    end = spell(after_open, "say")
+    gap = fresh()
+    add(end, [ord(" ")], gap)
+    state = gap
+    for _ in range(24):
+        nxt = fresh()
+        add(state, letters, nxt)
+        if state is not gap:
+            add(state, [ord(")")], accept)
+        state = nxt
+    add(state, [ord(")")], accept)
+    return automaton_from_rules(vocab, rules, accepting=[accept])
+
+
 class PE_LLM(PipelineElement):
     """``text`` (user utterance) → ``text`` (reply) + ``command``
     (parsed S-expression list or None).
@@ -86,6 +163,14 @@ class PE_LLM(PipelineElement):
         self.params = llama.init_params(self.config,
                                         jax.random.PRNGKey(int(seed)))
         self._detections = []
+        constrained, _ = self.get_parameter("constrained", False)
+        self._automaton = None
+        if str(constrained).lower() in ("1", "true", "yes"):
+            import jax.numpy as jnp
+            self._automaton = build_command_automaton(
+                self.config.vocab_size)
+            self._allowed = jnp.asarray(self._automaton.allowed)
+            self._next_state = jnp.asarray(self._automaton.next_state)
         topic, _ = self.get_parameter("topic_detections", None)
         if topic and process is not None:
             process.add_message_handler(self._detections_handler,
@@ -111,15 +196,49 @@ class PE_LLM(PipelineElement):
             self.logger.error("%s: prompt too long", self.my_id(stream))
             return StreamEvent.ERROR, {}
         max_new = min(max_new, budget)
+        if self._automaton is not None:
+            # The grammar bounds commands at COMMAND_MAX_BYTES bytes; a
+            # budget of at least that always reaches the closing paren
+            # (sized BEFORE the cache so the rows exist).
+            if budget < COMMAND_MAX_BYTES:
+                self.logger.error("%s: %d-token budget below the "
+                                  "grammar's %d-byte worst case",
+                                  self.my_id(stream), budget,
+                                  COMMAND_MAX_BYTES)
+                return StreamEvent.ERROR, {}
+            max_new = max(max_new, COMMAND_MAX_BYTES)
         prompt_len = tokens.shape[1]
         cache = llama.init_cache(self.config, 1, prompt_len + max_new)
         logits, cache = llama.prefill(
             self.params, jnp.asarray(tokens), cache, self.config)
-        first = logits[:, -1].argmax(-1).astype(jnp.int32)[:, None]
-        new_tokens, _ = llama.generate_tokens(
-            self.params, first, cache, jnp.int32(prompt_len),
-            max_new - 1, self.config)
-        out = jnp.concatenate([first, new_tokens], axis=1)
-        reply = detokenize(np.asarray(out)[0])
+        if self._automaton is not None:
+            # Hard guarantee: the byte-level command DFA masks every
+            # decode step, so the reply IS a grammatical command.  The
+            # grammar bounds commands at COMMAND_MAX_BYTES, so a budget
+            # of at least that many steps ALWAYS reaches the closing
+            # paren (the DFA forces it once the say-chain is spent).
+            from aiko_services_tpu.models.constrained import (
+                constrained_generate,
+            )
+            seed, _ = self.get_parameter("seed", 0, stream=stream)
+            temperature, _ = self.get_parameter("temperature", 0.0,
+                                                stream=stream)
+            out, states, _ = constrained_generate(
+                self.params, logits[:, -1], cache,
+                jnp.int32(prompt_len), max_new, self.config,
+                self._allowed, self._next_state,
+                temperature=float(temperature),
+                rng_key=jax.random.PRNGKey(int(seed)))
+            assert bool(self._automaton.accepting[int(states[0])]), \
+                "command DFA did not reach an accepting state"
+            emitted = [int(t) for t in np.asarray(out)[0]]
+            reply = detokenize(emitted[:emitted.index(ord(")")) + 1])
+        else:
+            first = logits[:, -1].argmax(-1).astype(jnp.int32)[:, None]
+            new_tokens, _ = llama.generate_tokens(
+                self.params, first, cache, jnp.int32(prompt_len),
+                max_new - 1, self.config)
+            out = jnp.concatenate([first, new_tokens], axis=1)
+            reply = detokenize(np.asarray(out)[0])
         return StreamEvent.OKAY, {"text": reply,
                                   "command": extract_command(reply)}

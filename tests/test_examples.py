@@ -139,6 +139,58 @@ def test_llm_command_extraction():
     assert detokenize(tokenize(text)) == text
 
 
+def test_llm_constrained_always_yields_command(engine):
+    """PE_LLM with constrained=True: EVERY reply parses to a valid
+    robot command (the byte-level DFA makes the prompt contract a hard
+    guarantee — the untrained tiny model could never manage it by
+    prompting alone)."""
+    from examples.llm.elements_llm import (
+        PE_LLM, build_command_automaton,
+    )
+    from aiko_services_tpu.runtime import pipeline_element_args
+
+    automaton = build_command_automaton()
+    assert automaton.accepts([ord(c) for c in "(forward 2)"])
+    assert automaton.accepts([ord(c) for c in "(say hello world)"])
+    assert automaton.accepts([ord(c) for c in "(stop)"])
+    assert not automaton.accepts([ord(c) for c in "(fly 2)"])
+    assert not automaton.accepts([ord(c) for c in "forward 2"])
+
+    process = Process(namespace="test", hostname="h", pid="7",
+                      engine=engine, broker="cllm")
+    element = compose_instance(
+        PE_LLM,
+        pipeline_element_args("PE_LLM",
+                              parameters={"model_config": "tiny",
+                                          "constrained": True,
+                                          "max_new_tokens": 32}),
+        process=process)
+    verbs = {"forward", "backward", "turn", "look", "say", "sleep",
+             "stop"}
+    for seed_text in ("go ahead", "look left", "please stop now"):
+        event, outputs = element.process_frame(None, seed_text)
+        assert event.name == "OKAY"
+        command = outputs["command"]
+        assert command is not None, outputs["text"]
+        assert command[0] in verbs, outputs["text"]
+
+    # Regression: the DEFAULT token budget (24) is below the grammar's
+    # 30-byte worst case — constrained mode must raise it so a
+    # say-branch command still closes.
+    default_budget = compose_instance(
+        PE_LLM,
+        pipeline_element_args("PE_LLM2",
+                              parameters={"model_config": "tiny",
+                                          "constrained": True,
+                                          "seed": 5,
+                                          "temperature": 1.2}),
+        process=process)
+    for seed_text in ("talk to me", "speak"):
+        event, outputs = default_budget.process_frame(None, seed_text)
+        assert event.name == "OKAY"
+        assert outputs["command"] is not None, outputs["text"]
+
+
 def test_xgo_robot_sim_commands(engine):
     from examples.xgo_robot.xgo_robot import XgoRobot
     from aiko_services_tpu.runtime import actor_args
