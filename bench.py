@@ -56,6 +56,14 @@ def log(message):
     print(message, file=sys.stderr, flush=True)
 
 
+#: BENCH_SMOKE=1: run EVERY section end-to-end with tiny shapes on the
+#: CPU backend — a wiring check for the capture path (a section that
+#: cannot execute at all must fail here, in CI, not at the driver's
+#: one-shot TPU capture).  Numbers produced under smoke are
+#: meaningless and flagged in the JSON.
+SMOKE = os.environ.get("BENCH_SMOKE", "") not in ("", "0")
+
+
 class SectionTimeout(RuntimeError):
     pass
 
@@ -119,6 +127,11 @@ def init_backend(retries: int = 3, delay: float = 5.0):
     """Guarded backend bring-up (round-1 failure mode: UNAVAILABLE at
     capture time killed the whole run on line 1; round-2 addition:
     subprocess preflight against the uninterruptible-hang mode)."""
+    if SMOKE:
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        log(f"SMOKE mode: backend {jax.default_backend()}")
+        return jax.default_backend()
     last_error = None
     for attempt in range(1, retries + 1):
         try:
@@ -245,7 +258,7 @@ def bench_pipeline(n_frames=200, warmup=20, image_size=320):
         run_throughput(n_frames)
         elapsed = time.perf_counter() - started
         fps = n_frames / elapsed
-        latencies = run_latency(30)
+        latencies = run_latency(3 if SMOKE else 30)
         p50 = statistics.median(latencies) * 1e3
         log(f"pipeline: {fps:.1f} frames/sec/chip, p50 e2e {p50:.2f} ms "
             f"(p50 includes one relay round-trip)")
@@ -307,7 +320,7 @@ def _run_pipeline_frames(document, stream_inputs, n_frames, warmup,
         elapsed = time.perf_counter() - started
         fps = n_frames / elapsed
         latencies = []
-        for _ in range(20):
+        for _ in range(3 if SMOKE else 20):
             t0 = time.perf_counter()
             pipeline.post_frame("bench", stream_inputs())
             _, _, outputs = out.get(timeout=300)
@@ -625,6 +638,11 @@ def bench_serving_continuous(slots=8, prompt_len=64, max_new=64,
     return tps
 
 
+#: Tiny decode args for BENCH_SMOKE (wiring check, not measurement).
+_SMOKE_LLM = dict(batch=2, prompt_len=16, new_tokens=8,
+                  config_name="tiny")
+
+
 def main():
     result = {
         "metric": "pipeline frames/sec/chip (fused TPU detector stage, "
@@ -634,6 +652,8 @@ def main():
         "unit": "frames/sec/chip",
         "vs_baseline": None,
     }
+    if SMOKE:
+        result["smoke"] = True      # wiring check: numbers meaningless
     errors = {}
     deadline = time.monotonic() + float(
         os.environ.get("BENCH_DEADLINE", "2400"))
@@ -662,19 +682,27 @@ def main():
                 f"{error!r}")
             return
 
-        pipeline = run_section("pipeline", 600, bench_pipeline)
+        pipeline = run_section(
+            "pipeline", 600,
+            (lambda: bench_pipeline(n_frames=12, warmup=2,
+                                    image_size=64))
+            if SMOKE else bench_pipeline)
         if pipeline is not None:
             fps, p50 = pipeline
             result["value"] = round(fps, 1)
             result["vs_baseline"] = round(fps / 50.0, 2)
             result["p50_e2e_ms"] = round(p50, 2)
 
-        tps = run_section("llm_small", 420, lambda: bench_llm_decode())
+        tps = run_section(
+            "llm_small", 420,
+            lambda: bench_llm_decode(**(_SMOKE_LLM if SMOKE else {})))
         if tps is not None:
             result["llm_tokens_per_sec_chip"] = round(tps)
 
-        tps = run_section("llm_small_int8", 420,
-                          lambda: bench_llm_decode(quantize=True))
+        tps = run_section(
+            "llm_small_int8", 420,
+            lambda: bench_llm_decode(
+                quantize=True, **(_SMOKE_LLM if SMOKE else {})))
         if tps is not None:
             result["llm_int8_tokens_per_sec_chip"] = round(tps)
 
@@ -683,13 +711,15 @@ def main():
         # regardless, so tok/s scales with batch.
         tps = run_section(
             "llm_moe_int8", 420,
-            lambda: bench_llm_decode(batch=64, prompt_len=64,
-                                     new_tokens=128,
-                                     config_name="moe_small",
-                                     quantize=True))
+            lambda: bench_llm_decode(
+                quantize=True,
+                **(dict(_SMOKE_LLM, config_name="moe_tiny") if SMOKE
+                   else dict(batch=64, prompt_len=64, new_tokens=128,
+                             config_name="moe_small"))))
         if tps is not None:
             result["llm_moe_int8_tokens_per_sec_chip"] = round(tps)
-            result["llm_moe_int8_batch"] = 64    # r01 measured batch 8
+            result["llm_moe_int8_batch"] = \
+                _SMOKE_LLM["batch"] if SMOKE else 64
 
         # Flagship after the established sections: the heaviest load,
         # so a wedge here cannot take the captures above down with it.
@@ -700,25 +730,35 @@ def main():
         # batch 32 -> 2,517, batch 64 -> 4,031 (2.0x the 2,000 target).
         tps = run_section(
             "llama3_8b_int8", 900,
-            lambda: bench_llm_decode(batch=64, prompt_len=128,
-                                     new_tokens=128,
-                                     config_name="llama3_8b",
-                                     random_int8=True))
+            lambda: bench_llm_decode(
+                random_int8=True,
+                **(_SMOKE_LLM if SMOKE
+                   else dict(batch=64, prompt_len=128, new_tokens=128,
+                             config_name="llama3_8b"))))
         if tps is not None:
             result["llama3_8b_int8_tokens_per_sec_chip"] = round(tps)
-            result["llama3_8b_int8_batch"] = 64  # r01 measured batch 8
+            result["llama3_8b_int8_batch"] = \
+                _SMOKE_LLM["batch"] if SMOKE else 64
             result["llama3_8b_vs_2000_target"] = round(tps / 2000.0, 2)
 
         # Newest sections LAST (the relay wedges on some heavy compiles
         # and the watchdog cannot interrupt a device call — a wedge here
         # must not cost the established captures above).
-        text = run_section("text_pipeline", 300, bench_text_pipeline)
+        text = run_section(
+            "text_pipeline", 300,
+            (lambda: bench_text_pipeline(n_frames=8, warmup=2,
+                                         seq_len=16))
+            if SMOKE else bench_text_pipeline)
         if text is not None:
             fps, p50 = text
             result["text_pipeline_fps_chip"] = round(fps, 1)
             result["text_pipeline_p50_ms"] = round(p50, 2)
 
-        speech = run_section("speech_chat", 420, bench_speech_chat)
+        speech = run_section(
+            "speech_chat", 420,
+            (lambda: bench_speech_chat(n_frames=2, warmup=1,
+                                       max_new_tokens=4))
+            if SMOKE else bench_speech_chat)
         if speech is not None:
             tps, p50 = speech
             result["speech_chat_tokens_per_sec_chip"] = round(tps)
@@ -730,17 +770,21 @@ def main():
         # cache footprint that bounds batch.
         tps = run_section(
             "llama3_8b_int8_kv8", 600,
-            lambda: bench_llm_decode(batch=64, prompt_len=128,
-                                     new_tokens=128,
-                                     config_name="llama3_8b",
-                                     random_int8=True,
-                                     quantize_kv=True))
+            lambda: bench_llm_decode(
+                random_int8=True, quantize_kv=True,
+                **(_SMOKE_LLM if SMOKE
+                   else dict(batch=64, prompt_len=128, new_tokens=128,
+                             config_name="llama3_8b"))))
         if tps is not None:
             result["llama3_8b_int8_kv8_tokens_per_sec_chip"] = round(tps)
 
         # Serving-stack throughput (continuous batching end-to-end).
-        tps = run_section("serving_continuous", 420,
-                          bench_serving_continuous)
+        tps = run_section(
+            "serving_continuous", 420,
+            (lambda: bench_serving_continuous(
+                slots=2, prompt_len=16, max_new=8, n_requests=4,
+                config_name="tiny", chunk_steps=4))
+            if SMOKE else bench_serving_continuous)
         if tps is not None:
             result["serving_continuous_tokens_per_sec_chip"] = \
                 round(tps)
@@ -752,13 +796,15 @@ def main():
         # capture is banked (wedge containment).
         tps = run_section(
             "llama3_8b_int4", 600,
-            lambda: bench_llm_decode(batch=64, prompt_len=128,
-                                     new_tokens=128,
-                                     config_name="llama3_8b",
-                                     random_int8=True, bits=4))
+            lambda: bench_llm_decode(
+                random_int8=True, bits=4,
+                **(_SMOKE_LLM if SMOKE
+                   else dict(batch=64, prompt_len=128, new_tokens=128,
+                             config_name="llama3_8b"))))
         if tps is not None:
             result["llama3_8b_int4_tokens_per_sec_chip"] = round(tps)
-            result["llama3_8b_int4_batch"] = 64
+            result["llama3_8b_int4_batch"] = \
+                _SMOKE_LLM["batch"] if SMOKE else 64
     finally:
         if errors:
             result["errors"] = errors
