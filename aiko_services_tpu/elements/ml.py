@@ -121,13 +121,22 @@ class LlamaChatElement(PipelineElement):
         name, _ = self.get_parameter("model_config", "tiny")
         self.config = llama_model.CONFIGS[str(name)]
         seed, _ = self.get_parameter("seed", 0)
-        self.params = llama_model.init_params(
-            self.config, jax.random.PRNGKey(int(seed)))
-        quantize, _ = self.get_parameter("quantize", False)
-        if quantize:
-            # Int8 weight-only: ~2× decode throughput (HBM-bound) and
-            # half the parameter memory.
-            self.params = llama_model.quantize_params(self.params)
+        init_mode, _ = self.get_parameter("param_init", "init")
+        if str(init_mode) in ("random_int8", "random_int4"):
+            # 8B-class benchmarking path: quantized params built
+            # directly — the bf16 tree would not fit next to itself in
+            # one chip's HBM (llama.random_quantized_params).
+            self.params = llama_model.random_quantized_params(
+                self.config, jax.random.PRNGKey(int(seed)),
+                bits=4 if str(init_mode).endswith("int4") else 8)
+        else:
+            self.params = llama_model.init_params(
+                self.config, jax.random.PRNGKey(int(seed)))
+            quantize, _ = self.get_parameter("quantize", False)
+            if quantize:
+                # Int8 weight-only: ~2× decode throughput (HBM-bound)
+                # and half the parameter memory.
+                self.params = llama_model.quantize_params(self.params)
 
     def start_stream(self, stream, stream_id):
         return StreamEvent.OKAY, None

@@ -31,7 +31,9 @@ from ..ops.quant import (_unpack_int4, int4_matmul, int8_matmul,
 __all__ = ["LlamaConfig", "init_params", "forward",
            "forward_sequence_parallel", "init_cache",
            "decode_step", "generate_tokens", "prefill", "param_specs",
-           "quantize_params", "pipeline_forward", "stack_pipeline_params",
+           "quantize_params", "random_quantized_params",
+           "quantized_param_specs", "prefill_sequence_parallel",
+           "pipeline_forward", "stack_pipeline_params",
            "decode_chunk_ragged", "prefill_chunk", "sample_logits",
            "init_paged_cache", "decode_chunk_paged",
            "paged_insert_prefix", "paged_scatter_blocks",
@@ -241,6 +243,65 @@ def quantized_param_specs(config: LlamaConfig, bits: int = 8) -> Dict:
     return specs
 
 
+def random_quantized_params(config: LlamaConfig, key, bits: int = 8) -> Dict:
+    """Random quantized params built DIRECTLY in quantized form — a bf16
+    llama3_8b (~16 GB) would not fit next to itself in one chip's HBM,
+    so the bf16 tree is never materialized.  Structure matches
+    ``quantize_params(init_params(config, key), bits)`` exactly:
+    int8 → ``{"q": int8 (in, out), "s": f32 (1, out)}``; int4 →
+    ``{"q4": int8 (in/2, out) nibble-packed, "s": f32 (in/128, out)}``
+    with the embedding kept int8 (row-gather path).  1-D norm vectors
+    stay in the model dtype.  Scales are sized so dequantized weights
+    look like fan-in-scaled gaussians — activations stay finite through
+    all layers.  Used for benchmarking/capacity checks where real
+    checkpoint weights are unavailable."""
+    if config.n_experts:
+        raise NotImplementedError(
+            "random_quantized_params covers dense configs; MoE expert "
+            "weights are 3-D and stay bf16 under quantize_params")
+    c = config
+    d, h, kv, hd, f = (c.d_model, c.n_heads, c.n_kv_heads, c.head_dim,
+                       c.d_ff)
+    counter = iter(range(10_000))
+
+    def q8weight(shape):
+        k = jax.random.fold_in(key, next(counter))
+        q = jax.random.randint(k, shape, -127, 128, jnp.int8)
+        s = jnp.full((1, shape[1]), shape[0] ** -0.5 / 127.0, jnp.float32)
+        return {"q": q, "s": s}
+
+    def q4weight(shape):
+        kin, n = shape
+        k = jax.random.fold_in(key, next(counter))
+        packed = jax.random.randint(k, (kin // 2, n), -128, 128, jnp.int8)
+        groups = max(1, kin // 128)
+        s = jnp.full((groups, n), kin ** -0.5 / 7.0, jnp.float32)
+        return {"q4": packed, "s": s}
+
+    qweight = q4weight if bits == 4 else q8weight
+    layers = []
+    for _ in range(c.n_layers):
+        layers.append({
+            "attn_norm": jnp.ones((d,), c.dtype),
+            "wq": qweight((d, h * hd)),
+            "wk": qweight((d, kv * hd)),
+            "wv": qweight((d, kv * hd)),
+            "wo": qweight((h * hd, d)),
+            "mlp_norm": jnp.ones((d,), c.dtype),
+            "w_gate": qweight((d, f)),
+            "w_up": qweight((d, f)),
+            "w_down": qweight((f, d)),
+        })
+    return {
+        # The embedding read path is a row gather, so it stays int8
+        # even at bits=4 (matches quantize_params).
+        "embed": q8weight((c.vocab_size, d)),
+        "layers": layers,
+        "final_norm": jnp.ones((d,), c.dtype),
+        "lm_head": qweight((d, c.vocab_size)),
+    }
+
+
 def _matmul(x, w):
     """Dense or int8/int4-quantized matmul, transparently."""
     if is_quantized_int4(w):
@@ -297,7 +358,10 @@ def apply_rope(x, cos, sin):
 def _attention_block(layer, config, x, cos, sin, use_flash=True,
                      attention_fn=None):
     """Full-sequence (no-cache) attention block; returns
-    (output, None).  The cached-decode path lives in
+    (output, (k, v)) with k/v post-rope in (batch, seq, kv, hd) layout
+    — callers that don't need them (plain forward) drop the tuple and
+    XLA dead-code-eliminates it; the SP-prefill handoff writes them
+    into a decode cache.  The cached-decode path lives in
     :func:`_attention_decode_ragged` (single implementation for both
     shared-position and per-row-position decode).  ``attention_fn``
     overrides the attention itself (e.g. ring attention over an sp
@@ -330,7 +394,7 @@ def _attention_block(layer, config, x, cos, sin, use_flash=True,
     out = out.transpose(0, 2, 1, 3)
 
     out = _matmul(out.reshape(batch, seq, h * hd), layer["wo"])
-    return x + out.astype(x.dtype), None
+    return x + out.astype(x.dtype), (k, v)
 
 
 def _mlp_block(layer, config, x):
@@ -379,51 +443,15 @@ def forward_sequence_parallel(params, tokens, config: LlamaConfig,
     shard dimension from sequence to heads and back — fewer, larger
     collectives (MXU-friendly dense local attention) but needs
     ``n_heads % sp == 0`` and materializes the full sequence per head
-    group (K/V repeated to the full head count first)."""
-    if config.sliding_window:
-        raise ValueError(
-            "sequence-parallel forward does not implement sliding-"
-            "window masking (the ring's causal skip is shard-wise)")
-    if "sp" not in mesh.axis_names:
-        raise ValueError(
-            f"mesh has no 'sp' axis (axes: {mesh.axis_names}) — build "
-            "it with make_mesh(sp=...)")
-    sp = mesh.shape["sp"]
-    if tokens.shape[1] % sp:
-        raise ValueError(
-            f"sequence length {tokens.shape[1]} must divide by the sp "
-            f"mesh size {sp}")
-    from ..parallel.ring_attention import ring_attention_sharded
+    group (K/V repeated to the full head count first).
 
-    if attention == "ring":
-        def ring(q_t, k_t, v_t):
-            # ring_attention is GQA-native: only the kv heads rotate.
-            return ring_attention_sharded(q_t, k_t, v_t, mesh,
-                                          causal=True)
-        attention_fn = ring
-    elif attention == "ulysses":
-        from ..parallel.ulysses import ulysses_attention_sharded
-        if config.n_heads % sp:
-            raise ValueError(
-                f"ulysses needs n_heads ({config.n_heads}) divisible "
-                f"by the sp mesh size ({sp})")
-        group = config.n_heads // config.n_kv_heads
-        kv_divides = config.n_kv_heads % sp == 0
-
-        def ulysses(q_t, k_t, v_t):
-            if group > 1 and not kv_divides:
-                # Head-scatter needs a divisible head count; repeating
-                # BEFORE the all-to-all multiplies K/V collective
-                # bytes by `group` — only the fallback when the kv
-                # heads cannot be scattered directly.
-                k_t = jnp.repeat(k_t, group, axis=1)
-                v_t = jnp.repeat(v_t, group, axis=1)
-            return ulysses_attention_sharded(q_t, k_t, v_t, mesh)
-        attention_fn = ulysses
-    else:
-        raise ValueError(f"unknown attention {attention!r} "
-                         "(ring | ulysses)")
-
+    Sliding-window (Mistral-class) configs compose with both:  the ring
+    masks by global position and skips shards entirely below the
+    window (windowed long-context prefill cost O(seq·window/sp));
+    Ulysses holds the full sequence locally after the head scatter, so
+    plain windowed masking is globally correct."""
+    attention_fn = _sp_attention_fn(config, mesh, attention,
+                                    tokens.shape[1])
     batch, seq = tokens.shape
     positions = jnp.broadcast_to(jnp.arange(seq), (batch, seq))
     cos, sin = _rope_freqs(config, positions)
@@ -434,6 +462,102 @@ def forward_sequence_parallel(params, tokens, config: LlamaConfig,
         x = _mlp_block(layer, config, x)
     x = rms_norm(x, params["final_norm"], config.norm_eps)
     return _matmul(x, params["lm_head"]).astype(jnp.float32)
+
+
+def _sp_attention_fn(config: LlamaConfig, mesh, attention: str,
+                     seq_len: int):
+    """Validate the sp mesh/config combination and build the
+    sequence-parallel attention closure shared by
+    :func:`forward_sequence_parallel` and
+    :func:`prefill_sequence_parallel`."""
+    if "sp" not in mesh.axis_names:
+        raise ValueError(
+            f"mesh has no 'sp' axis (axes: {mesh.axis_names}) — build "
+            "it with make_mesh(sp=...)")
+    sp = mesh.shape["sp"]
+    if seq_len % sp:
+        raise ValueError(
+            f"sequence length {seq_len} must divide by the sp "
+            f"mesh size {sp}")
+    from ..parallel.ring_attention import ring_attention_sharded
+
+    if attention == "ring":
+        def ring(q_t, k_t, v_t):
+            # ring_attention is GQA-native: only the kv heads rotate.
+            return ring_attention_sharded(q_t, k_t, v_t, mesh,
+                                          causal=True,
+                                          window=config.sliding_window)
+        attention_fn = ring
+    elif attention == "ulysses":
+        from ..parallel.ulysses import ulysses_attention_sharded
+        if config.n_heads % sp:
+            raise ValueError(
+                f"ulysses needs n_heads ({config.n_heads}) divisible "
+                f"by the sp mesh size ({sp})")
+        group = config.n_heads // config.n_kv_heads
+        kv_divides = config.n_kv_heads % sp == 0
+        if group > 1 and not kv_divides:
+            # Trace-time, so it fires once per compile, not per step.
+            import warnings
+            warnings.warn(
+                f"Ulysses GQA fallback: n_kv_heads "
+                f"({config.n_kv_heads}) % sp ({sp}) != 0, so K/V are "
+                f"repeated x{group} BEFORE the all-to-all — K/V "
+                f"collective bytes multiply by {group}.  Prefer "
+                f"sp <= n_kv_heads (or ring attention) for this "
+                "config.", stacklevel=2)
+
+        def ulysses(q_t, k_t, v_t):
+            if group > 1 and not kv_divides:
+                # Head-scatter needs a divisible head count; repeating
+                # BEFORE the all-to-all multiplies K/V collective
+                # bytes by `group` — only the fallback when the kv
+                # heads cannot be scattered directly.
+                k_t = jnp.repeat(k_t, group, axis=1)
+                v_t = jnp.repeat(v_t, group, axis=1)
+            return ulysses_attention_sharded(
+                q_t, k_t, v_t, mesh, window=config.sliding_window)
+        attention_fn = ulysses
+    else:
+        raise ValueError(f"unknown attention {attention!r} "
+                         "(ring | ulysses)")
+    return attention_fn
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("config", "mesh", "attention"),
+                   donate_argnames=("cache",))
+def prefill_sequence_parallel(params, tokens, cache,
+                              config: LlamaConfig, mesh,
+                              attention: str = "ring"):
+    """SP-prefill → decode handoff: prefill a long prompt with
+    attention sharded over the ``sp`` mesh axis (ring or Ulysses, as
+    :func:`forward_sequence_parallel`), writing each layer's K/V into a
+    standard decode cache.  The cache keeps whatever sharding it was
+    created with (typically replicated / single-chip), so XLA inserts
+    the sequence all-gather at the slab write — after this returns,
+    :func:`generate_tokens` / :func:`decode_step` continue decoding
+    from ``start_index = seq`` on a single chip (or any decode
+    topology), which is how long-context serving actually runs: SP for
+    the O(seq²) prefill, plain cached decode for the O(seq) tail.
+
+    Rolling caches compose: the slab write keeps the last ``window``
+    rows.  Returns (last-position logits (batch, vocab), cache)."""
+    attention_fn = _sp_attention_fn(config, mesh, attention,
+                                    tokens.shape[1])
+    batch, seq = tokens.shape
+    positions = jnp.broadcast_to(jnp.arange(seq), (batch, seq))
+    cos, sin = _rope_freqs(config, positions)
+    x = _embed_lookup(params, tokens, config.dtype)
+    new_cache = []
+    for layer, cache_layer in zip(params["layers"], cache):
+        x, (k, v) = _attention_block(layer, config, x, cos, sin,
+                                     attention_fn=attention_fn)
+        new_cache.append(_cache_write_slab(cache_layer, k, v, 0))
+        x = _mlp_block(layer, config, x)
+    x = rms_norm(x[:, -1], params["final_norm"], config.norm_eps)
+    logits = _matmul(x, params["lm_head"]).astype(jnp.float32)
+    return logits, new_cache
 
 
 def init_cache(config: LlamaConfig, batch: int,
@@ -447,10 +571,12 @@ def init_cache(config: LlamaConfig, batch: int,
     ``config.sliding_window``) keeps only the last ``window`` rows in a
     ring buffer — row ``pos % window`` — with each row's ABSOLUTE
     position stored for masking, so sliding-window decode memory is
-    O(window) instead of O(max_seq).  The plain decode paths (prefill,
-    chunked prefill, decode_step, generate_tokens) handle any layout;
-    :func:`decode_chunk_ragged`'s slot-scratch trick is incompatible
-    with rolling and rejects it."""
+    O(window) instead of O(max_seq).  Single-token decode paths
+    (decode_step, generate_tokens) handle any layout;
+    :func:`prefill_chunk` rejects rolling caches for chunk length > 1
+    (pre-attention slab writes can evict ring rows still inside earlier
+    chunk queries' windows), and :func:`decode_chunk_ragged`'s
+    slot-scratch trick is incompatible with rolling and rejects it."""
     if rolling:
         if not config.sliding_window:
             raise ValueError("rolling cache requires sliding_window")
@@ -1073,8 +1199,21 @@ def prefill_chunk(params, tokens, cache, start_index,
     Uses: admitting long prompts chunk-by-chunk (continuous batching),
     and speculative-decode verification (score K draft tokens in one
     pass).  Attention masks by ABSOLUTE position (key_pos <= query_pos),
-    so stale cache rows beyond the chunk are never attended."""
+    so stale cache rows beyond the chunk are never attended.
+
+    Rolling (ring-buffer) caches are rejected for chunk length > 1: the
+    slab write lands all K rows BEFORE attention runs, so ring rows
+    holding positions still inside earlier chunk queries' sliding
+    windows would be overwritten (their stored position becomes future
+    → masked out) and softmax would silently normalize over missing
+    keys.  Feed rolling caches token-by-token (K=1) instead."""
     batch, K = tokens.shape
+    if cache and "pos" in cache[0] and K > 1:
+        raise ValueError(
+            "prefill_chunk does not support rolling caches with chunk "
+            "length > 1: the pre-attention slab write can evict ring "
+            "rows still inside earlier chunk queries' sliding windows "
+            "(silently wrong logits); feed K=1 chunks instead")
     positions = start_index + jnp.arange(K)
     positions_b = jnp.broadcast_to(positions, (batch, K))
     cos, sin = _rope_freqs(config, positions_b)

@@ -198,10 +198,18 @@ def speculative_generate_sampled(target_params, draft_params, prompt,
                                  max_seq: Optional[int] = None
                                  ) -> Tuple[np.ndarray, SpecStats]:
     """SAMPLED speculative decode at ``temperature``: each committed
-    token is distributed exactly as target-only sampling at the same
+    token is distributed as target-only sampling at the same
     temperature (modified rejection sampling — acceptance keeps the
     draft's token, rejection resamples the residual, a full-accept
     round earns a bonus token from the target's own distribution).
+
+    Exactness caveat: the draft SAMPLES on device via f32 Gumbel, while
+    the acceptance ratio uses a host f64 softmax of the same draft
+    logits — so ``q`` in the accept/residual math matches the actual
+    proposal distribution only to f32 rounding (~1e-7 per-token skew,
+    far below the statistical test's resolution).  For bit-exact
+    guarantees, compute acceptance from the device sampler's own
+    probabilities.
 
     ``temperature <= 0`` delegates to the exact greedy path.  Batch 1.
     Returns (tokens (num_new,), stats)."""

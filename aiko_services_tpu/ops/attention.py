@@ -32,6 +32,15 @@ except ImportError:  # pragma: no cover
 __all__ = ["flash_attention", "attention_reference", "NEG_INF"]
 
 NEG_INF = -1e30
+# NEG_INF must stay FINITE (never -inf): with sliding-window masking a
+# q-row can be fully masked inside the first LIVE k-block, making every
+# score NEG_INF → m_new == NEG_INF and p == exp(0) == 1 of bogus mass.
+# That mass is cancelled later only because the row's diagonal block is
+# guaranteed live and its rescale correction exp(NEG_INF - m_real)
+# underflows to exactly 0.0.  With -inf the same update computes
+# exp(-inf - (-inf)) = NaN.  (See the online-softmax update in
+# _flash_kernel.)
+assert NEG_INF < 0 and NEG_INF > float("-inf")
 
 DEFAULT_BLOCK_Q = 128
 DEFAULT_BLOCK_K = 128
@@ -126,6 +135,10 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref,
             s = jnp.where(visible, s, NEG_INF)
 
         m_prev = m_scratch[:]                      # (bq, 1)
+        # Fully-masked rows rely on NEG_INF being finite: s == NEG_INF
+        # everywhere gives p == 1 (bogus mass), later cancelled by the
+        # diagonal block's correction underflowing to exactly 0 — see
+        # the NEG_INF module comment before "simplifying" to -inf.
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
         p = jnp.exp(s - m_new)                     # (bq, bk)
         correction = jnp.exp(m_prev - m_new)       # (bq, 1)

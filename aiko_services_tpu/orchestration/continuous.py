@@ -82,7 +82,7 @@ class ContinuousBatchingServer:
     def __init__(self, config_name: str = "tiny", slots: int = 4,
                  max_seq: Optional[int] = None, chunk_steps: int = 8,
                  quantize: bool = False, eos_id: Optional[int] = None,
-                 seed: int = 0, quantize_kv: bool = False):
+                 seed: int = 0, quantize_kv: bool = False, mesh=None):
         import jax
         import jax.numpy as jnp
         from ..models import llama
@@ -95,6 +95,19 @@ class ContinuousBatchingServer:
                                         jax.random.PRNGKey(seed))
         if quantize:
             self.params = llama.quantize_params(self.params)
+        if mesh is not None:
+            # Multi-chip serving: megatron-TP-shard the (possibly
+            # quantized) params over the mesh's "tp" axis; the decode
+            # state (cache/positions/tokens) stays replicated and XLA
+            # inserts the activation collectives.  This is the
+            # composition a TP serving deployment runs.
+            from jax.sharding import NamedSharding
+            specs = (llama.quantized_param_specs(self.config)
+                     if quantize else llama.param_specs(self.config))
+            self.params = jax.tree.map(
+                lambda leaf, spec: jax.device_put(
+                    leaf, NamedSharding(mesh, spec)),
+                self.params, specs)
         self.slots = slots
         # Row max_seq-1 is the inactive-slot scratch row (see
         # decode_chunk_ragged); a live request may use at most

@@ -233,6 +233,12 @@ class PagedContinuousServer(ContinuousBatchingServer):
                 shared = shared[:usable_shared]
         # PIN the hits before any eviction (eviction must never free a
         # block we are about to reference), with rollback on deferral.
+        # Snapshot the LRU order first: a deferred request never ran,
+        # so rollback must restore each block's ORIGINAL _evictable
+        # position (re-appending would promote untouched blocks to MRU
+        # and distort eviction order).  Nothing else mutates
+        # _evictable between here and the rollback below.
+        evictable_snapshot = list(self._evictable.items())
         for block in shared:
             self._refs[block] += 1
             self._evictable.pop(self._block_key[block], None)
@@ -242,8 +248,10 @@ class PagedContinuousServer(ContinuousBatchingServer):
             # WITHOUT destroying cached prefixes for zero benefit.
             for block in shared:
                 self._refs[block] -= 1
-                if self._refs[block] == 0:
-                    self._evictable[self._block_key[block]] = block
+            self._evictable.clear()
+            self._evictable.update(
+                (key, block) for key, block in evictable_snapshot
+                if self._refs[block] == 0)
             return False
         self._evict_until(private_needed)
         private = [self._free.pop() for _ in range(private_needed)]

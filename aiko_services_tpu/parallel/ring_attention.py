@@ -34,19 +34,23 @@ __all__ = ["ring_attention", "ring_attention_sharded"]
 
 
 def _block_attend(q, k, v, q_offset, k_offset, sm_scale, causal,
-                  m, l, acc):
+                  m, l, acc, window=None):
     """One blockwise-attention accumulation step (f32 state).
 
     GQA-native: ``q`` is (batch, kv_heads, group, q_len, head_dim) and
     ``k``/``v`` are (batch, kv_heads, k_len, head_dim) — the rotated
-    K/V never materialize the repeated query heads."""
+    K/V never materialize the repeated query heads.  ``window``:
+    sliding-window masking by GLOBAL position (requires causal)."""
     s = jnp.einsum("bkgqd,bksd->bkgqs", q, k,
                    preferred_element_type=jnp.float32) * sm_scale
     if causal:
         q_len, k_len = q.shape[3], k.shape[2]
         q_ids = jnp.arange(q_len)[:, None] + q_offset
         k_ids = jnp.arange(k_len)[None, :] + k_offset
-        s = jnp.where((k_ids <= q_ids)[None, None, None], s, NEG_INF)
+        visible = k_ids <= q_ids
+        if window is not None:
+            visible &= k_ids > q_ids - window
+        s = jnp.where(visible[None, None, None], s, NEG_INF)
     m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
     p = jnp.exp(s - m_new)
     correction = jnp.exp(m - m_new)
@@ -58,11 +62,20 @@ def _block_attend(q, k, v, q_offset, k_offset, sm_scale, causal,
 
 
 def ring_attention(q, k, v, axis_name: str, causal: bool = True,
-                   sm_scale: Optional[float] = None):
+                   sm_scale: Optional[float] = None,
+                   window: Optional[int] = None):
     """Inside-shard_map body: local q (batch, heads, seq_local, hd) and
     k/v (batch, kv_heads, seq_local, hd) shards — ``kv_heads`` may be
     smaller (GQA; only the kv heads rotate around the ring).  Returns
-    the local output shard.  K/V rotate ``axis_size`` steps."""
+    the local output shard.  K/V rotate ``axis_size`` steps.
+
+    ``window``: sliding-window (Mistral-class) masking by global
+    position — requires ``causal``.  Shards entirely below a device's
+    window are skipped like future shards, so long-context windowed
+    prefill does O(window/shard + 1) live steps per device instead of
+    O(axis_index)."""
+    if window is not None and not causal:
+        raise ValueError("sliding window requires causal attention")
     if sm_scale is None:
         sm_scale = q.shape[-1] ** -0.5
     axis_size = jax.lax.psum(1, axis_name)
@@ -96,12 +109,19 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = True,
             # after this device's queries (src > axis_index) is fully
             # masked — skip its attention math (the rotation still
             # happens; later devices need the shard).  Halves causal
-            # ring FLOPs on average.
+            # ring FLOPs on average.  With a sliding window, a shard
+            # entirely BELOW the window of this device's first query
+            # (max key id <= min query id - window) is fully masked
+            # too — windowed long-context prefill then runs
+            # O(window/shard + 1) live steps per device.
+            live = src <= axis_index
+            if window is not None:
+                live &= (src + 1) * seq_local - 1 > q_offset - window
             m, l, acc = jax.lax.cond(
-                src <= axis_index,
+                live,
                 lambda state: _block_attend(
                     q, k_cur, v_cur, q_offset, k_offset, sm_scale,
-                    True, *state),
+                    True, *state, window=window),
                 lambda state: state,
                 (m, l, acc))
         else:
@@ -121,14 +141,16 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = True,
 
 def ring_attention_sharded(q, k, v, mesh: Mesh, axis: str = "sp",
                            causal: bool = True,
-                           sm_scale: Optional[float] = None):
+                           sm_scale: Optional[float] = None,
+                           window: Optional[int] = None):
     """Global entry: q/k/v are full arrays (batch, heads, seq, head_dim);
     shard_map shards the sequence dimension over ``axis`` and runs the
-    ring.  Heads are additionally sharded over ``tp`` when present."""
+    ring.  Heads are additionally sharded over ``tp`` when present.
+    ``window``: sliding-window masking by global position (causal)."""
     head_axis = "tp" if "tp" in mesh.axis_names else None
     spec = P(None, head_axis, axis, None)
     fn = shard_map(
         functools.partial(ring_attention, axis_name=axis, causal=causal,
-                          sm_scale=sm_scale),
+                          sm_scale=sm_scale, window=window),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
     return fn(q, k, v)

@@ -43,15 +43,21 @@ __all__ = ["ulysses_attention", "ulysses_attention_sharded"]
 
 def ulysses_attention(q, k, v, axis_name: str, causal: bool = True,
                       sm_scale: Optional[float] = None,
-                      attn_fn: Optional[Callable] = None):
+                      attn_fn: Optional[Callable] = None,
+                      window: Optional[int] = None):
     """Inside-shard_map body.  ``q/k/v`` are local sequence shards of
     shape ``(batch, heads, seq_local, head_dim)`` with the FULL head
     count; returns the local output shard, same shape.
 
-    ``attn_fn(q, k, v, causal=, sm_scale=)`` runs the per-device dense
-    attention; defaults to the jnp reference (swap in
-    ``ops.attention.flash_attention`` on real TPU).
+    ``attn_fn(q, k, v, causal=, sm_scale=, window=)`` runs the
+    per-device dense attention; defaults to the jnp reference (swap in
+    ``ops.attention.flash_attention`` on real TPU).  ``window``:
+    sliding-window masking — after the head-scatter each device holds
+    the FULL sequence for its head group, so plain local windowed
+    masking is globally correct (no offset bookkeeping).
     """
+    if window is not None and not causal:
+        raise ValueError("sliding window requires causal attention")
     if attn_fn is None:
         attn_fn = attention_reference
     n = jax.lax.psum(1, axis_name)
@@ -86,21 +92,24 @@ def ulysses_attention(q, k, v, axis_name: str, causal: bool = True,
         v_h = jnp.repeat(v_h, group, axis=1)
     # Full sequence is now local: plain causal masking is correct with
     # no global-offset bookkeeping (unlike the ring).
-    o_h = attn_fn(q_h, k_h, v_h, causal=causal, sm_scale=sm_scale)
+    o_h = attn_fn(q_h, k_h, v_h, causal=causal, sm_scale=sm_scale,
+                  window=window)
     return scatter_seq(o_h)
 
 
 def ulysses_attention_sharded(q, k, v, mesh: Mesh, axis: str = "sp",
                               causal: bool = True,
                               sm_scale: Optional[float] = None,
-                              attn_fn: Optional[Callable] = None):
+                              attn_fn: Optional[Callable] = None,
+                              window: Optional[int] = None):
     """Global entry: q/k/v are full arrays ``(batch, heads, seq,
     head_dim)``; shard_map shards the sequence dim over ``axis`` and
-    runs the all-to-all swap around dense local attention."""
+    runs the all-to-all swap around dense local attention.
+    ``window``: sliding-window masking (causal)."""
     spec = P(None, None, axis, None)
     fn = shard_map(
         functools.partial(ulysses_attention, axis_name=axis,
                           causal=causal, sm_scale=sm_scale,
-                          attn_fn=attn_fn),
+                          attn_fn=attn_fn, window=window),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
     return fn(q, k, v)

@@ -374,7 +374,9 @@ class Pipeline(PipelineElement):
                 self._run_frame(stream, frame_data,
                                 caller_frame_id=caller_frame_id)
             elif kind == "stop":
-                self.destroy_stream(stream.stream_id)
+                # Route through the drain-aware stop: frames replayed
+                # just above may already be paused at a remote element.
+                self._stream_stop_command(stream.stream_id, payload[0])
                 return
 
     def _stream_stop_command(self, stream_id, event_value):
@@ -384,6 +386,19 @@ class Pipeline(PipelineElement):
             # frames it followed, not destroy the stream out from under
             # them.
             stream.pending.append(("stop", event_value))
+            return
+        if stream is not None and stream.frames and \
+                StreamEvent(int(event_value)) != StreamEvent.ERROR:
+            # Graceful drain: the mailbox serializes the stop behind
+            # QUEUED frames, but frames already dispatched and paused
+            # at a remote element are in stream.frames awaiting their
+            # MQTT response — destroying now would discard them.  STOP
+            # state blocks new frames; the last completion destroys
+            # the stream (_complete_frame), the lease is the backstop.
+            self.logger.info("%s: stream %s draining %d in-flight "
+                             "frame(s) before stop", self.name,
+                             stream_id, len(stream.frames))
+            stream.state = StreamState.STOP
             return
         self.destroy_stream(stream_id)
 
@@ -600,6 +615,11 @@ class Pipeline(PipelineElement):
                          [{"stream_id": stream.stream_id,
                            "frame_id": str(frame.frame_id)},
                           encode_swag(outputs)]))
+        if stream.state == StreamState.STOP and not stream.frames \
+                and stream.stream_id in self.streams:
+            # Last in-flight frame of a draining (STOPped) stream has
+            # delivered its outputs: now tear the stream down for real.
+            self.destroy_stream(stream.stream_id)
 
     def _final_outputs(self, frame: Frame) -> Dict[str, Any]:
         """Outputs of the path's terminal elements (fall back to whole
@@ -624,6 +644,16 @@ class Pipeline(PipelineElement):
         if state in (StreamState.STOP, StreamState.ERROR):
             self.logger.info("%s: stream %s -> %s at %s", self.name,
                              stream.stream_id, state.name, element_name)
+            if state == StreamState.STOP and stream.frames:
+                # Graceful drain (reference destroy_stream's delayed
+                # self-message drain, main/pipeline.py:849-917): a
+                # source's STOP must not discard frames still in
+                # flight — e.g. paused at a remote element awaiting
+                # their MQTT response.  STOP state blocks new frames
+                # (_run_frame); the last completion destroys the
+                # stream (_complete_frame), the lease is the backstop.
+                stream.state = StreamState.STOP
+                return
             self.destroy_stream(stream.stream_id)
 
     # -- stats / parameters ------------------------------------------------------- #

@@ -1,8 +1,18 @@
 #!/usr/bin/env python
 """Benchmark harness: one JSON line on stdout — ALWAYS.
 
-Primary metric: **pipeline frames/sec/chip** — frames flowing through the
-full dataflow engine (event loop, mailboxes, swag) with a fused TPU
+Capture architecture (round 3, after the round-2 postmortem): every
+section runs in its OWN SUBPROCESS and appends its result to an on-disk
+partial-results file (``bench_partial.jsonl``) the parent — or a
+post-mortem — assembles.  A mid-run wedge inside an uninterruptible
+device call costs ONE section (the parent kills/abandons the child at
+its budget), never the JSON.  After any child timeout the parent
+re-probes the backend in a fresh subprocess; if the probe fails, the
+relay is wedged and the remaining sections are skipped loudly instead
+of each eating its budget against a dead backend.
+
+Primary metric: **pipeline frames/sec/chip** — frames flowing through
+the full dataflow engine (event loop, mailboxes, swag) with a fused TPU
 stage (image normalize + YOLO-class detector) doing the compute, one
 image per frame.  Input frames are PRE-STAGED ON DEVICE (the
 device-resident-swag production shape, where cameras DMA into device
@@ -18,15 +28,13 @@ and reads the result back.
 
 Flagship figure: **llm_chat tokens/sec/chip on Llama-3-8B + int8** (the
 BASELINE.json north star, target >= 2000 tok/s/chip), with bytes-per-
-step bandwidth accounting printed to stderr.  The reference only shells
-out to Ollama for LLM work (examples/llm/elements_llm.py:191-220); here
-the model runs natively on the chip.
+step bandwidth accounting printed to stderr.  Compute-bound sections
+(prefill, train step, detector) additionally report achieved model
+FLOPs/s vs the chip's bf16 peak (MFU) — bandwidth math answers "is
+decode fast", MFU answers it for everything else.
 
-Robustness contract (VERDICT round 1): the driver capture must never
-come back empty.  Backend init is guarded and retried; every section
-runs under a watchdog alarm and its failure is recorded, not fatal; the
-final JSON line is emitted from a ``finally`` with whatever sections
-succeeded.
+Section order banks the established captures first and runs the
+newest/heaviest Pallas paths last (wedge containment).
 
 NOTE (axon relay): block_until_ready does not sync on this platform —
 every timed region ends with a host readback (np.asarray) to measure
@@ -35,6 +43,7 @@ real execution time.
 
 from __future__ import annotations
 
+import argparse
 import contextlib
 import json
 import os
@@ -50,6 +59,10 @@ import numpy as np
 #: (v5e ≈ 819 GB/s).  Only used for reporting/derived ceilings, never
 #: for the measured numbers.
 HBM_GBPS = 819.0
+#: v5e bf16 peak (MXU) — denominator for the MFU accounting.  The int8
+#: paths dequantize into bf16/f32 MXU ops, so bf16 peak is the honest
+#: denominator for them too.
+PEAK_BF16_TFLOPS = 197.0
 
 
 def log(message):
@@ -63,6 +76,10 @@ def log(message):
 #: meaningless and flagged in the JSON.
 SMOKE = os.environ.get("BENCH_SMOKE", "") not in ("", "0")
 
+#: Incremental per-section results — the post-mortem artifact.  Parent
+#: truncates it at start; each section child appends exactly one line.
+PARTIAL_PATH = os.environ.get("BENCH_PARTIAL", "bench_partial.jsonl")
+
 
 class SectionTimeout(RuntimeError):
     pass
@@ -70,9 +87,10 @@ class SectionTimeout(RuntimeError):
 
 @contextlib.contextmanager
 def watchdog(seconds: int, label: str):
-    """SIGALRM-based best-effort timeout: a section that hangs inside a
-    device call cannot always be interrupted, but anything that yields
-    to Python gets cut off instead of eating the driver's whole budget."""
+    """SIGALRM-based best-effort timeout inside a section child: a hang
+    inside a device call cannot be interrupted (the parent's
+    kill-at-budget handles that), but anything that yields to Python
+    gets cut off with a recorded error."""
     def handler(signum, frame):
         raise SectionTimeout(f"{label} exceeded {seconds}s watchdog")
     previous = signal.signal(signal.SIGALRM, handler)
@@ -85,22 +103,17 @@ def watchdog(seconds: int, label: str):
 
 
 class BackendWedged(RuntimeError):
-    """Preflight timed out — the relay hang mode.  NOT retried: a wedge
-    is not transient, and each retry would eat the global deadline."""
+    """Backend probe timed out — the relay hang mode.  NOT retried: a
+    wedge is not transient, and each retry would eat the global
+    deadline."""
 
 
-def _preflight_backend(timeout_s: int = 150) -> None:
-    """Probe the backend in a SUBPROCESS first.  The relay's worst
-    failure mode is a hang inside a C call (observed: jax.devices()
-    blocks uninterruptibly for hours) — SIGALRM cannot fire inside it,
-    so the in-process watchdog is not enough.  If the probe cannot run
-    a matmul within the timeout, the main process never touches jax and
-    the JSON still emits.
-
-    The parent never blocks on the child's death: a child wedged in
-    uninterruptible kernel sleep ignores even SIGKILL, so after the
-    kill attempt we ABANDON it (bounded wait) rather than ride
-    ``subprocess.run``'s unbounded ``wait()``."""
+def _probe_backend(timeout_s: int) -> str | None:
+    """Probe the backend in a SUBPROCESS.  The relay's worst failure
+    mode is a hang inside a C call (observed: jax.devices() blocks
+    uninterruptibly for hours) — no in-process guard works, so the
+    probe child is killed at the timeout and, if it ignores SIGKILL
+    (D-state), abandoned.  Returns None if healthy, else a description."""
     import subprocess
     probe = ("import jax, numpy as np, jax.numpy as jnp;"
              "x = jnp.ones((32, 32));"
@@ -116,46 +129,11 @@ def _preflight_backend(timeout_s: int = 150) -> None:
             proc.wait(timeout=5)
         except subprocess.TimeoutExpired:
             pass                      # D-state child: abandon it
-        raise BackendWedged(
-            f"backend preflight hung >{timeout_s}s (wedged relay)")
+        return f"probe hung >{timeout_s}s (wedged relay)"
     if proc.returncode != 0:
         tail = (stderr or b"").decode(errors="replace")[-400:]
-        raise RuntimeError(f"backend preflight failed: {tail}")
-
-
-def init_backend(retries: int = 3, delay: float = 5.0):
-    """Guarded backend bring-up (round-1 failure mode: UNAVAILABLE at
-    capture time killed the whole run on line 1; round-2 addition:
-    subprocess preflight against the uninterruptible-hang mode)."""
-    if SMOKE:
-        import jax
-        jax.config.update("jax_platforms", "cpu")
-        log(f"SMOKE mode: backend {jax.default_backend()}")
-        return jax.default_backend()
-    last_error = None
-    for attempt in range(1, retries + 1):
-        try:
-            _preflight_backend()
-            # A wedged relay can make jax.devices() HANG rather than
-            # raise; the watchdog turns that into a loud failure.
-            with watchdog(120, "backend init"):
-                import jax
-                devices = jax.devices()
-            log(f"backend: {jax.default_backend()}, devices: {devices}")
-            return jax.default_backend()
-        except BackendWedged as error:
-            # A wedge is not transient; retrying burns the global
-            # deadline 150 s at a time.
-            log(f"backend wedged (no retry): {error!r}")
-            raise
-        except Exception as error:  # noqa: BLE001
-            last_error = error
-            log(f"backend init attempt {attempt}/{retries} failed: "
-                f"{error!r}")
-            if attempt < retries:
-                time.sleep(delay)
-    raise RuntimeError(f"backend unavailable after {retries} attempts: "
-                       f"{last_error!r}")
+        return f"probe failed rc={proc.returncode}: {tail}"
+    return None
 
 
 # --------------------------------------------------------------------------- #
@@ -206,11 +184,8 @@ def bench_pipeline(n_frames=200, warmup=20, image_size=320):
                          dtype=np.uint8)
     # Device-staged input ring: frames arrive as device buffers
     # (device-resident swag), the production shape where cameras DMA
-    # into device memory.  This keeps the throughput metric measuring
-    # the framework + compute, not the axon dev relay's tunnel (67 ms
-    # RTT, ~4-23 MB/s — a real TPU host's PCIe moves a 307 KB frame in
-    # ~20 us).  The host->device path is still measured: p50 e2e below
-    # feeds host numpy per frame.
+    # into device memory.  The host->device path is still measured:
+    # p50 e2e below feeds host numpy per frame.
     import jax
     device_ring = [jax.device_put(
         rng.integers(0, 255, image.shape, dtype=np.uint8))
@@ -264,22 +239,25 @@ def bench_pipeline(n_frames=200, warmup=20, image_size=320):
             f"(p50 includes one relay round-trip)")
     finally:
         # Each cleanup step suppressed separately: a destroy_stream
-        # failure must not leave the engine thread running to compete
-        # with later sections (round-1 empty-capture failure mode).
+        # failure must not leave the engine thread running.
         with contextlib.suppress(Exception):
             pipeline.destroy_stream("bench")
         with contextlib.suppress(Exception):
             engine.terminate()
         with contextlib.suppress(Exception):
             thread.join(timeout=5)
-    return fps, p50
+    return {"value": round(fps, 1),
+            "vs_baseline": round(fps / 50.0, 2),
+            "p50_e2e_ms": round(p50, 2)}
 
 
 def _run_pipeline_frames(document, stream_inputs, n_frames, warmup,
-                         broker):
+                         broker, collect=None):
     """Shared harness: build a pipeline from ``document``, push
     ``stream_inputs() -> dict`` frames with bounded in-flight, return
-    (fps, p50_ms)."""
+    (fps, p50_ms).  ``collect``: optional fn(outputs) called on every
+    completed timed/latency frame (for sections that read per-frame
+    metrics out of the swag)."""
     from aiko_services_tpu.pipeline import (
         Pipeline, parse_pipeline_definition,
     )
@@ -307,6 +285,8 @@ def _run_pipeline_frames(document, stream_inputs, n_frames, warmup,
                     pipeline.post_frame("bench", stream_inputs())
                     posted += 1
                 _, _, outputs = out.get(timeout=300)
+                if collect is not None:
+                    collect(outputs)
                 received += 1
             return outputs
 
@@ -326,6 +306,8 @@ def _run_pipeline_frames(document, stream_inputs, n_frames, warmup,
             _, _, outputs = out.get(timeout=300)
             for value in outputs.values():
                 np.asarray(value)
+            if collect is not None:
+                collect(outputs)
             latencies.append(time.perf_counter() - t0)
         p50 = statistics.median(latencies) * 1e3
         return fps, p50
@@ -363,15 +345,15 @@ def bench_text_pipeline(n_frames=300, warmup=20, seq_len=128):
         document, lambda: {"tokens": tokens}, n_frames, warmup,
         broker="bench_text")
     log(f"text pipeline: {fps:.1f} frames/sec/chip, p50 {p50:.2f} ms")
-    return fps, p50
+    return {"text_pipeline_fps_chip": round(fps, 1),
+            "text_pipeline_p50_ms": round(p50, 2)}
 
 
-def bench_speech_chat(n_frames=20, warmup=3, max_new_tokens=32):
-    """BASELINE config 3: the speech→chat two-stage pipeline —
-    Whisper-class ASR feeding a Llama-class chat element (single chip;
-    the v5e-4 variant shards the chat stage over tp).  Reports chat
-    tokens/sec/chip and p50 e2e (audio in → generated tokens out)."""
-    document = {
+def _speech_chat_document(chat_config, max_new_tokens, chat_params=None):
+    parameters = {"model_config": chat_config,
+                  "max_new_tokens": max_new_tokens}
+    parameters.update(chat_params or {})
+    return {
         "version": 0, "name": "p_speech", "runtime": "python",
         "graph": ["(ASRElement LlamaChatElement "
                   "(text_tokens: tokens))"],
@@ -388,23 +370,63 @@ def bench_speech_chat(n_frames=20, warmup=3, max_new_tokens=32):
              "input": [{"name": "tokens", "type": "array"}],
              "output": [{"name": "tokens_out", "type": "array"},
                         {"name": "tokens_per_second", "type": "float"}],
-             "parameters": {"model_config": "small",
-                            "max_new_tokens": max_new_tokens},
+             "parameters": parameters,
              "deploy": {"local": {
                  "module": "aiko_services_tpu.elements",
                  "class_name": "LlamaChatElement"}}},
         ],
     }
+
+
+def bench_speech_chat_small(n_frames=20, warmup=3, max_new_tokens=32):
+    """Speech→chat two-stage pipeline with the 0.2 B ``small`` chat
+    config — a cheap cross-round continuity figure.  The BASELINE
+    config-3 measurement (Llama-3-8B chat stage, true per-token timing)
+    is the ``speech_chat_8b`` section."""
+    document = _speech_chat_document("small", max_new_tokens)
     rng = np.random.default_rng(2)
     audio = (rng.standard_normal(16_000) * 0.1).astype(np.float32)
-    log("speech->chat pipeline (whisper_small ASR -> llama small)...")
+    log("speech->chat proxy (whisper_small ASR -> llama small)...")
     fps, p50 = _run_pipeline_frames(
         document, lambda: {"audio": audio}, n_frames, warmup,
         broker="bench_speech")
     tokens_per_sec = fps * max_new_tokens  # new tokens per frame
-    log(f"speech->chat: {fps:.2f} frames/s = {tokens_per_sec:.0f} "
-        f"chat tokens/sec/chip, p50 e2e {p50:.2f} ms")
-    return tokens_per_sec, p50
+    log(f"speech->chat (small proxy): {fps:.2f} frames/s = "
+        f"{tokens_per_sec:.0f} chat tokens/sec/chip, p50 e2e "
+        f"{p50:.2f} ms")
+    return {"speech_chat_small_tokens_per_sec_chip": round(tokens_per_sec),
+            "speech_chat_small_p50_e2e_ms": round(p50, 2)}
+
+
+def bench_speech_chat_8b(n_frames=6, warmup=1, max_new_tokens=64):
+    """BASELINE config 3 with the REAL chat model: Whisper-class ASR
+    feeding Llama-3-8B + int8 on one chip.  Chat tokens/sec is the
+    MEDIAN of the chat element's own per-token decode timing (measured
+    around the decode scan inside the element — not fps×max_new), plus
+    the honest p50 end-to-end latency (audio in → generated tokens
+    out, batch 1)."""
+    config = "tiny" if SMOKE else "llama3_8b"
+    chat_params = {} if SMOKE else {"param_init": "random_int8"}
+    document = _speech_chat_document(config, max_new_tokens, chat_params)
+    rng = np.random.default_rng(3)
+    audio = (rng.standard_normal(16_000) * 0.1).astype(np.float32)
+    decode_tps = []
+
+    def collect(outputs):
+        if "tokens_per_second" in outputs:
+            decode_tps.append(float(np.asarray(
+                outputs["tokens_per_second"])))
+
+    log(f"speech->chat 8B (whisper_small ASR -> {config}"
+        f"{'+int8' if chat_params else ''}, batch 1)...")
+    fps, p50 = _run_pipeline_frames(
+        document, lambda: {"audio": audio}, n_frames, warmup,
+        broker="bench_speech8b", collect=collect)
+    tps = statistics.median(decode_tps) if decode_tps else 0.0
+    log(f"speech->chat 8B: chat decode {tps:.1f} tokens/sec/chip "
+        f"(median per-token timing, batch 1), p50 e2e {p50:.2f} ms")
+    return {"speech_chat_8b_tokens_per_sec_chip": round(tps, 1),
+            "speech_chat_8b_p50_e2e_ms": round(p50, 2)}
 
 
 # --------------------------------------------------------------------------- #
@@ -415,64 +437,6 @@ def dict_copy(cache):
     import jax.numpy as jnp
     return [{name: jnp.copy(buf) for name, buf in c.items()}
             for c in cache]
-
-
-def random_quantized_params(config, key, bits=8):
-    """Random quantized Llama params built DIRECTLY in quantized form —
-    a bf16 llama3_8b (~16 GB) would not fit next to itself in one
-    chip's HBM, so the bf16 tree is never materialized.  Structure
-    matches ``llama.quantize_params(llama.init_params(...), bits)``
-    exactly: int8 → {"q": int8 (in, out), "s": f32 (1, out)}; int4 →
-    {"q4": int8 (in/2, out) nibble-packed, "s": f32 (in/128, out)}
-    with the embedding kept int8 (gather path).  1-D norm vectors stay
-    bf16."""
-    import jax
-    import jax.numpy as jnp
-
-    c = config
-    d, h, kv, hd, f = (c.d_model, c.n_heads, c.n_kv_heads, c.head_dim,
-                       c.d_ff)
-    counter = iter(range(10_000))
-
-    def q8weight(shape):
-        k = jax.random.fold_in(key, next(counter))
-        q = jax.random.randint(k, shape, -127, 128, jnp.int8)
-        # Scales sized so dequantized weights look like fan-in-scaled
-        # gaussians — keeps activations finite through 32 layers.
-        s = jnp.full((1, shape[1]), shape[0] ** -0.5 / 127.0, jnp.float32)
-        return {"q": q, "s": s}
-
-    def q4weight(shape):
-        kin, n = shape
-        k = jax.random.fold_in(key, next(counter))
-        packed = jax.random.randint(k, (kin // 2, n), -128, 128, jnp.int8)
-        groups = max(1, kin // 128)
-        s = jnp.full((groups, n), kin ** -0.5 / 7.0, jnp.float32)
-        return {"q4": packed, "s": s}
-
-    qweight = q4weight if bits == 4 else q8weight
-
-    layers = []
-    for _ in range(c.n_layers):
-        layers.append({
-            "attn_norm": jnp.ones((d,), c.dtype),
-            "wq": qweight((d, h * hd)),
-            "wk": qweight((d, kv * hd)),
-            "wv": qweight((d, kv * hd)),
-            "wo": qweight((h * hd, d)),
-            "mlp_norm": jnp.ones((d,), c.dtype),
-            "w_gate": qweight((d, f)),
-            "w_up": qweight((d, f)),
-            "w_down": qweight((f, d)),
-        })
-    return {
-        # The embedding read path is a row gather, so it stays int8
-        # even at bits=4 (matches llama.quantize_params).
-        "embed": q8weight((c.vocab_size, d)),
-        "layers": layers,
-        "final_norm": jnp.ones((d,), c.dtype),
-        "lm_head": qweight((d, c.vocab_size)),
-    }
 
 
 def quantized_model_bytes(config, bits=8):
@@ -532,9 +496,10 @@ def bench_llm_decode(batch=8, prompt_len=128, new_tokens=256,
     label = config_name
     if random_int8:
         # Flagship path: quantized params built directly (see
-        # random_quantized_params) — required for 8B-class on 16 GB HBM.
-        params = random_quantized_params(config, jax.random.PRNGKey(0),
-                                         bits=bits)
+        # llama.random_quantized_params) — required for 8B-class on
+        # 16 GB HBM.
+        params = llama.random_quantized_params(
+            config, jax.random.PRNGKey(0), bits=bits)
         label += f"+int{bits}"
     else:
         params = llama.init_params(config, jax.random.PRNGKey(0))
@@ -595,6 +560,7 @@ def bench_llm_decode(batch=8, prompt_len=128, new_tokens=256,
 
 
 # --------------------------------------------------------------------------- #
+# Serving stack
 
 def bench_serving_continuous(slots=8, prompt_len=64, max_new=64,
                              n_requests=24, config_name="small",
@@ -602,7 +568,6 @@ def bench_serving_continuous(slots=8, prompt_len=64, max_new=64,
     """Sustained tokens/sec through the CONTINUOUS-BATCHING serving
     stack (admission, bucketed prefill, slot bookkeeping included) —
     the serving-stack view of the decode numbers above."""
-    import numpy as np
     from aiko_services_tpu.orchestration.continuous import (
         ContinuousBatchingServer, DecodeRequest, _bucket,
     )
@@ -635,15 +600,367 @@ def bench_serving_continuous(slots=8, prompt_len=64, max_new=64,
     tps = total_tokens / elapsed
     log(f"serving[continuous]: {tps:.0f} tokens/sec/chip sustained "
         f"({n_requests} reqs, {total_tokens} tokens, {elapsed:.2f}s)")
-    return tps
+    return {"serving_continuous_tokens_per_sec_chip": round(tps)}
 
+
+# --------------------------------------------------------------------------- #
+# MFU accounting (compute-bound sections)
+
+def llama_matmul_params(config) -> int:
+    """Parameters participating in per-token matmuls (2-D weights,
+    embedding gather excluded)."""
+    c = config
+    attn = (c.d_model * c.n_heads * c.head_dim
+            + 2 * c.d_model * c.n_kv_heads * c.head_dim
+            + c.n_heads * c.head_dim * c.d_model)
+    mlp = 3 * c.d_model * c.d_ff
+    return c.n_layers * (attn + mlp) + c.d_model * c.vocab_size
+
+
+def llama_prefill_flops(config, batch, seq) -> float:
+    """Analytic model FLOPs for one causal prefill: 2·tokens·params for
+    the matmuls plus 2·b·s²·h·hd·layers for causal attention (QKᵀ and
+    AV at half density)."""
+    mm = 2.0 * batch * seq * llama_matmul_params(config)
+    attn = (2.0 * batch * seq * seq * config.n_heads * config.head_dim
+            * config.n_layers)
+    return mm + attn
+
+
+def _compile_with_flops(fn, *args):
+    """Compile ``fn`` ONCE (the expensive step on the relay) and return
+    (compiled_callable, xla_flops_or_None) — the same executable serves
+    both the timed reps and the cost analysis, so the model is never
+    compiled twice per section."""
+    import jax
+    compiled = jax.jit(fn).lower(*args).compile()
+    try:
+        analysis = compiled.cost_analysis()
+        if isinstance(analysis, (list, tuple)):
+            analysis = analysis[0]
+        flops = float(analysis.get("flops", 0.0))
+        flops = flops if flops > 0 else None
+    except Exception as error:  # noqa: BLE001
+        log(f"cost_analysis unavailable ({error!r}); "
+            "using analytic FLOPs only")
+        flops = None
+    return compiled, flops
+
+
+def _mfu_result(prefix, flops, elapsed, extra=None):
+    tflops = flops / elapsed / 1e12
+    mfu = tflops / PEAK_BF16_TFLOPS * 100.0
+    log(f"{prefix}: {tflops:.1f} TFLOP/s achieved = {mfu:.1f}% of "
+        f"{PEAK_BF16_TFLOPS:.0f} TFLOP/s bf16 peak (v5e)")
+    out = {f"{prefix}_tflops_chip": round(tflops, 1),
+           f"{prefix}_mfu_pct": round(mfu, 1)}
+    out.update(extra or {})
+    return out
+
+
+def bench_prefill_mfu():
+    """Achieved FLOPs/s for flash-attention prefill: (a) Llama-3-8B +
+    int8 (the flagship's prefill path — int8 prefill is the XLA
+    dequant-matmul fallback, measured honestly as such) and (b) the 1b
+    config in bf16 (pure MXU path)."""
+    import jax
+    import jax.numpy as jnp
+    from aiko_services_tpu.models import llama
+
+    result = {}
+
+    def measure(tag, config_name, params_fn, batch, seq, reps):
+        config = llama.CONFIGS[config_name]
+        params = params_fn(config)
+        tokens = jnp.zeros((batch, seq), jnp.int32)
+        cache = llama.init_cache(config, batch, seq + 8)
+        log(f"prefill[{tag}] compile (batch {batch}, seq {seq})...")
+        fn, xla = _compile_with_flops(
+            lambda p, t, c: llama.prefill(p, t, c, config)[0],
+            params, tokens, cache)
+        np.asarray(fn(params, tokens, cache))          # warm
+        started = time.perf_counter()
+        for _ in range(reps):
+            logits = fn(params, tokens, cache)
+        np.asarray(logits)
+        elapsed = (time.perf_counter() - started) / reps
+        flops = llama_prefill_flops(config, batch, seq)
+        if xla:
+            log(f"prefill[{tag}] XLA cost model: {xla / 1e12:.1f} TFLOP "
+                f"vs analytic {flops / 1e12:.1f} TFLOP")
+        tok_s = batch * seq / elapsed
+        result.update(_mfu_result(
+            f"prefill_{tag}", flops, elapsed,
+            {f"prefill_{tag}_tokens_per_sec_chip": round(tok_s)}))
+
+    if SMOKE:
+        measure("8b_int8", "tiny",
+                lambda c: llama.random_quantized_params(
+                    c, jax.random.PRNGKey(0)), batch=2, seq=64, reps=1)
+        measure("1b_bf16", "tiny",
+                lambda c: llama.init_params(c, jax.random.PRNGKey(0)),
+                batch=2, seq=64, reps=1)
+    else:
+        measure("8b_int8", "llama3_8b",
+                lambda c: llama.random_quantized_params(
+                    c, jax.random.PRNGKey(0)), batch=4, seq=512, reps=3)
+        measure("1b_bf16", "1b",
+                lambda c: llama.init_params(c, jax.random.PRNGKey(0)),
+                batch=8, seq=512, reps=3)
+    return result
+
+
+def bench_train_mfu():
+    """Achieved FLOPs/s for one dense training step (fwd + bwd + adamw),
+    single chip, ``small`` config — the compute-bound training view.
+    FLOPs ≈ 3× the forward (standard fwd:bwd 1:2 accounting)."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+    from aiko_services_tpu.models import llama
+    from aiko_services_tpu.parallel.train import (
+        init_train_state, make_train_step,
+    )
+
+    config_name = "tiny" if SMOKE else "small"
+    batch, seq, reps = (2, 32, 1) if SMOKE else (8, 512, 5)
+    config = llama.CONFIGS[config_name]
+    optimizer = optax.adamw(1e-3)
+    params, opt_state = init_train_state(
+        config, jax.random.PRNGKey(0), optimizer)
+    step = jax.jit(make_train_step(config, optimizer))
+    tokens = jnp.zeros((batch, seq + 1), jnp.int32)
+    log(f"train[{config_name}] compile (batch {batch}, seq {seq})...")
+    params, opt_state, loss = step(params, opt_state, tokens)
+    float(np.asarray(loss))
+    started = time.perf_counter()
+    for _ in range(reps):
+        params, opt_state, loss = step(params, opt_state, tokens)
+    float(np.asarray(loss))
+    elapsed = (time.perf_counter() - started) / reps
+    flops = 3.0 * llama_prefill_flops(config, batch, seq)
+    steps_s = 1.0 / elapsed
+    return _mfu_result("train", flops, elapsed,
+                       {"train_steps_per_sec": round(steps_s, 2)})
+
+
+def bench_detector_mfu():
+    """Achieved FLOPs/s for the detector forward (the compute inside
+    the primary pipeline metric).  Conv FLOPs come from XLA's own cost
+    model (no hand formula for the conv stack)."""
+    import jax
+    import jax.numpy as jnp
+    from aiko_services_tpu.models import detector
+
+    batch, size, reps = (1, 64, 1) if SMOKE else (8, 320, 10)
+    config = detector.CONFIGS["yolo_n"]
+    params = detector.init_params(config, jax.random.PRNGKey(0))
+    images = jnp.zeros((batch, size if SMOKE else config.image_size,
+                        size if SMOKE else config.image_size, 3),
+                       jnp.float32)
+    log(f"detector compile (batch {batch})...")
+    fn, flops = _compile_with_flops(
+        lambda p, x: detector.forward(p, x, config), params, images)
+    np.asarray(fn(params, images))
+    started = time.perf_counter()
+    for _ in range(reps):
+        out = fn(params, images)
+    np.asarray(out)
+    elapsed = (time.perf_counter() - started) / reps
+    fps = batch / elapsed
+    result = {"detector_forward_fps_chip": round(fps, 1)}
+    if flops:
+        result.update(_mfu_result("detector", flops, elapsed))
+    else:
+        log(f"detector: {fps:.1f} model-forward frames/s (no XLA cost "
+            "model available; MFU omitted)")
+    return result
+
+
+# --------------------------------------------------------------------------- #
+# Section registry — ordered: established captures first, newest /
+# heaviest Pallas paths last (wedge containment).
 
 #: Tiny decode args for BENCH_SMOKE (wiring check, not measurement).
 _SMOKE_LLM = dict(batch=2, prompt_len=16, new_tokens=8,
                   config_name="tiny")
 
 
-def main():
+def _llm_section(prefix, batch_key=False, target=None, **kwargs):
+    def run():
+        call = dict(kwargs)
+        if SMOKE:
+            # Shrink sizes/config but KEEP the section's mode flags
+            # (quantize/random_int8/bits/quantize_kv) — the smoke
+            # contract is that every section's actual code path
+            # executes, just on tiny shapes.
+            smoke = dict(_SMOKE_LLM)
+            if str(call.get("config_name", "")).startswith("moe"):
+                smoke["config_name"] = "moe_tiny"
+            call.update(smoke)
+        tps = bench_llm_decode(**call)
+        out = {f"{prefix}_tokens_per_sec_chip": round(tps)}
+        if batch_key:
+            out[f"{prefix}_batch"] = call["batch"]
+        if target:
+            out[f"{prefix}_vs_{target}_target"] = round(tps / target, 2)
+        return out
+    return run
+
+
+SECTIONS = [
+    # (name, per-section budget seconds, zero-arg fn -> result dict)
+    ("pipeline", 600,
+     (lambda: bench_pipeline(n_frames=12, warmup=2, image_size=64))
+     if SMOKE else bench_pipeline),
+    # Flagship second: bank the north-star number before anything new.
+    ("llama3_8b_int8", 900,
+     _llm_section("llama3_8b_int8", batch_key=True, target=2000,
+                  random_int8=True, batch=64, prompt_len=128,
+                  new_tokens=128, config_name="llama3_8b")),
+    ("llm_small", 420, _llm_section("llm", batch=8, prompt_len=128,
+                                    new_tokens=256,
+                                    config_name="small")),
+    ("llm_small_int8", 420,
+     _llm_section("llm_int8", quantize=True, batch=8, prompt_len=128,
+                  new_tokens=256, config_name="small")),
+    # Batch 64: like the dense configs, small-batch MoE decode is
+    # dispatch-overhead-bound; the all-expert weight stream is paid
+    # regardless, so tok/s scales with batch.
+    ("llm_moe_int8", 420,
+     _llm_section("llm_moe_int8", batch_key=True, quantize=True,
+                  batch=64, prompt_len=64, new_tokens=128,
+                  config_name="moe_small")),
+    ("text_pipeline", 300,
+     (lambda: bench_text_pipeline(n_frames=8, warmup=2, seq_len=16))
+     if SMOKE else bench_text_pipeline),
+    ("speech_chat_small", 420,
+     (lambda: bench_speech_chat_small(n_frames=2, warmup=1,
+                                      max_new_tokens=4))
+     if SMOKE else bench_speech_chat_small),
+    # BASELINE config 3 with the real 8B chat stage.
+    ("speech_chat_8b", 600,
+     (lambda: bench_speech_chat_8b(n_frames=2, warmup=1,
+                                   max_new_tokens=4))
+     if SMOKE else bench_speech_chat_8b),
+    ("llama3_8b_int8_kv8", 600,
+     _llm_section("llama3_8b_int8_kv8", random_int8=True,
+                  quantize_kv=True, batch=64, prompt_len=128,
+                  new_tokens=128, config_name="llama3_8b")),
+    ("serving_continuous", 420,
+     (lambda: bench_serving_continuous(
+         slots=2, prompt_len=16, max_new=8, n_requests=4,
+         config_name="tiny", chunk_steps=4))
+     if SMOKE else bench_serving_continuous),
+    # MFU sections: compute-bound accounting (prefill / train /
+    # detector).  All use established compile paths (flash attention,
+    # XLA int8 fallback, conv stack) — no new Pallas tiles.
+    ("prefill_mfu", 600, bench_prefill_mfu),
+    ("train_mfu", 420, bench_train_mfu),
+    ("detector_mfu", 300, bench_detector_mfu),
+    # Int4 flagship variant VERY last: the newest Pallas path (the
+    # kernel dispatches only hardware-validated tile shapes, but wedge
+    # containment still puts it after every other capture is banked).
+    ("llama3_8b_int4", 600,
+     _llm_section("llama3_8b_int4", batch_key=True, bits=4,
+                  random_int8=True, batch=64, prompt_len=128,
+                  new_tokens=128, config_name="llama3_8b")),
+]
+
+
+# --------------------------------------------------------------------------- #
+# Child mode: run ONE section, append its result line to PARTIAL_PATH.
+
+def _append_partial(record):
+    line = json.dumps(record)
+    fd = os.open(PARTIAL_PATH, os.O_WRONLY | os.O_CREAT | os.O_APPEND,
+                 0o644)
+    try:
+        os.write(fd, (line + "\n").encode())
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def child_main(section_name, budget_override=None):
+    if SMOKE:
+        # Children must come up on CPU without touching the TPU relay.
+        # The sandbox pins JAX_PLATFORMS=axon via a sitecustomize hook
+        # (plain env overrides are ignored), so force CPU through
+        # jax.config — works post-import, pre-backend-init.
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+    budget, fn = next((budget, fn) for name, budget, fn in SECTIONS
+                      if name == section_name)
+    if budget_override:
+        # The parent truncates budgets near the global deadline; the
+        # watchdog must arm with the TRUNCATED value or it could never
+        # fire before the parent's kill (which leaves no result line).
+        budget = min(budget, budget_override)
+    started = time.perf_counter()
+    try:
+        with watchdog(budget, section_name):
+            result = fn()
+    except Exception as error:  # noqa: BLE001
+        _append_partial({"section": section_name, "ok": False,
+                         "error": repr(error),
+                         "elapsed_s": round(
+                             time.perf_counter() - started, 1)})
+        log(f"section {section_name}: FAILED: {error!r}")
+        return 3
+    _append_partial({"section": section_name, "ok": True,
+                     "result": result,
+                     "elapsed_s": round(time.perf_counter() - started,
+                                        1),
+                     "ts": time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                         time.gmtime())})
+    log(f"section {section_name}: ok "
+        f"({time.perf_counter() - started:.0f}s)")
+    return 0
+
+
+# --------------------------------------------------------------------------- #
+# Parent mode: orchestrate section subprocesses, assemble, emit JSON.
+
+def _spawn_section(name, budget_s, timeout_s):
+    """Run one section child; returns (rc, timed_out)."""
+    import subprocess
+    env = dict(os.environ, BENCH_PARTIAL=PARTIAL_PATH)
+    proc = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), "--section", name,
+         "--budget", str(budget_s)],
+        stdout=subprocess.DEVNULL, env=env)   # stderr inherited
+    try:
+        proc.wait(timeout=timeout_s)
+        return proc.returncode, False
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        try:
+            proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            pass                      # D-state child: abandon it
+        return None, True
+
+
+def _read_partials():
+    records = {}
+    try:
+        with open(PARTIAL_PATH) as fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    record = json.loads(line)
+                    records[record.get("section")] = record
+                except json.JSONDecodeError:
+                    continue
+    except FileNotFoundError:
+        pass
+    return records
+
+
+def parent_main():
     result = {
         "metric": "pipeline frames/sec/chip (fused TPU detector stage, "
                   "device-staged input frames; reference max sustained "
@@ -657,158 +974,77 @@ def main():
     errors = {}
     deadline = time.monotonic() + float(
         os.environ.get("BENCH_DEADLINE", "2400"))
-
-    def run_section(name, seconds, fn):
-        remaining = int(deadline - time.monotonic())
-        if remaining <= 10:
-            errors[name] = "skipped: global deadline reached"
-            log(f"section {name}: SKIPPED (deadline)")
-            return None
-        budget = min(seconds, remaining)
-        try:
-            with watchdog(budget, name):
-                return fn()
-        except Exception as error:  # noqa: BLE001
-            errors[name] = repr(error)
-            log(f"section {name}: FAILED: {error!r}")
-            return None
+    with contextlib.suppress(FileNotFoundError):
+        os.remove(PARTIAL_PATH)
 
     try:
-        try:
-            init_backend()
-        except Exception as error:  # noqa: BLE001
-            errors["backend"] = repr(error)
-            log(f"FATAL backend failure (emitting empty result): "
-                f"{error!r}")
-            return
+        if not SMOKE:
+            log("backend preflight (subprocess probe)...")
+            failure = _probe_backend(150)
+            if failure:
+                errors["backend"] = f"BackendWedged({failure!r})"
+                log(f"FATAL backend failure (emitting empty result): "
+                    f"{failure}")
+                return
 
-        pipeline = run_section(
-            "pipeline", 600,
-            (lambda: bench_pipeline(n_frames=12, warmup=2,
-                                    image_size=64))
-            if SMOKE else bench_pipeline)
-        if pipeline is not None:
-            fps, p50 = pipeline
-            result["value"] = round(fps, 1)
-            result["vs_baseline"] = round(fps / 50.0, 2)
-            result["p50_e2e_ms"] = round(p50, 2)
-
-        tps = run_section(
-            "llm_small", 420,
-            lambda: bench_llm_decode(**(_SMOKE_LLM if SMOKE else {})))
-        if tps is not None:
-            result["llm_tokens_per_sec_chip"] = round(tps)
-
-        tps = run_section(
-            "llm_small_int8", 420,
-            lambda: bench_llm_decode(
-                quantize=True, **(_SMOKE_LLM if SMOKE else {})))
-        if tps is not None:
-            result["llm_int8_tokens_per_sec_chip"] = round(tps)
-
-        # Batch 64: like the dense configs, small-batch MoE decode is
-        # dispatch-overhead-bound; the all-expert weight stream is paid
-        # regardless, so tok/s scales with batch.
-        tps = run_section(
-            "llm_moe_int8", 420,
-            lambda: bench_llm_decode(
-                quantize=True,
-                **(dict(_SMOKE_LLM, config_name="moe_tiny") if SMOKE
-                   else dict(batch=64, prompt_len=64, new_tokens=128,
-                             config_name="moe_small"))))
-        if tps is not None:
-            result["llm_moe_int8_tokens_per_sec_chip"] = round(tps)
-            result["llm_moe_int8_batch"] = \
-                _SMOKE_LLM["batch"] if SMOKE else 64
-
-        # Flagship after the established sections: the heaviest load,
-        # so a wedge here cannot take the captures above down with it.
-        # Batch 64: decode is weight-bandwidth-bound, so tok/s scales
-        # ~linearly with batch until KV bytes/step rival weight bytes
-        # (weights 7.5 GB + KV 2.2 GB at 64 still weight-dominated).
-        # Measured on v5e: batch 8 -> 699 tok/s (83% of BW ceiling),
-        # batch 32 -> 2,517, batch 64 -> 4,031 (2.0x the 2,000 target).
-        tps = run_section(
-            "llama3_8b_int8", 900,
-            lambda: bench_llm_decode(
-                random_int8=True,
-                **(_SMOKE_LLM if SMOKE
-                   else dict(batch=64, prompt_len=128, new_tokens=128,
-                             config_name="llama3_8b"))))
-        if tps is not None:
-            result["llama3_8b_int8_tokens_per_sec_chip"] = round(tps)
-            result["llama3_8b_int8_batch"] = \
-                _SMOKE_LLM["batch"] if SMOKE else 64
-            result["llama3_8b_vs_2000_target"] = round(tps / 2000.0, 2)
-
-        # Newest sections LAST (the relay wedges on some heavy compiles
-        # and the watchdog cannot interrupt a device call — a wedge here
-        # must not cost the established captures above).
-        text = run_section(
-            "text_pipeline", 300,
-            (lambda: bench_text_pipeline(n_frames=8, warmup=2,
-                                         seq_len=16))
-            if SMOKE else bench_text_pipeline)
-        if text is not None:
-            fps, p50 = text
-            result["text_pipeline_fps_chip"] = round(fps, 1)
-            result["text_pipeline_p50_ms"] = round(p50, 2)
-
-        speech = run_section(
-            "speech_chat", 420,
-            (lambda: bench_speech_chat(n_frames=2, warmup=1,
-                                       max_new_tokens=4))
-            if SMOKE else bench_speech_chat)
-        if speech is not None:
-            tps, p50 = speech
-            result["speech_chat_tokens_per_sec_chip"] = round(tps)
-            result["speech_chat_p50_e2e_ms"] = round(p50, 2)
-
-        # Newest + heaviest compile truly last (wedge containment):
-        # int8 KV cache on top of int8 weights — halves the KV bytes
-        # per step (the second-largest stream at batch 64) and the
-        # cache footprint that bounds batch.
-        tps = run_section(
-            "llama3_8b_int8_kv8", 600,
-            lambda: bench_llm_decode(
-                random_int8=True, quantize_kv=True,
-                **(_SMOKE_LLM if SMOKE
-                   else dict(batch=64, prompt_len=128, new_tokens=128,
-                             config_name="llama3_8b"))))
-        if tps is not None:
-            result["llama3_8b_int8_kv8_tokens_per_sec_chip"] = round(tps)
-
-        # Serving-stack throughput (continuous batching end-to-end).
-        tps = run_section(
-            "serving_continuous", 420,
-            (lambda: bench_serving_continuous(
-                slots=2, prompt_len=16, max_new=8, n_requests=4,
-                config_name="tiny", chunk_steps=4))
-            if SMOKE else bench_serving_continuous)
-        if tps is not None:
-            result["serving_continuous_tokens_per_sec_chip"] = \
-                round(tps)
-
-        # Int4 flagship variant VERY last: nibble-packed weights halve
-        # the bytes per step again (3.99 GB vs 7.51 GB weights).  The
-        # fused kernel dispatches only hardware-validated tile shapes,
-        # but as the newest Pallas path it runs after every other
-        # capture is banked (wedge containment).
-        tps = run_section(
-            "llama3_8b_int4", 600,
-            lambda: bench_llm_decode(
-                random_int8=True, bits=4,
-                **(_SMOKE_LLM if SMOKE
-                   else dict(batch=64, prompt_len=128, new_tokens=128,
-                             config_name="llama3_8b"))))
-        if tps is not None:
-            result["llama3_8b_int4_tokens_per_sec_chip"] = round(tps)
-            result["llama3_8b_int4_batch"] = \
-                _SMOKE_LLM["batch"] if SMOKE else 64
+        wedged = None
+        for name, budget, _fn in SECTIONS:
+            remaining = int(deadline - time.monotonic())
+            if wedged:
+                errors[name] = f"skipped: relay wedged (after {wedged})"
+                log(f"section {name}: SKIPPED (relay wedged)")
+                continue
+            if remaining <= 30:
+                errors[name] = "skipped: global deadline reached"
+                log(f"section {name}: SKIPPED (deadline)")
+                continue
+            # +60 s grace over the child's own watchdog budget covers
+            # interpreter + jax import before the watchdog arms.
+            child_budget = min(budget, remaining)
+            timeout_s = child_budget + 60
+            log(f"=== section {name} (budget {timeout_s}s) ===")
+            rc, timed_out = _spawn_section(name, child_budget, timeout_s)
+            if timed_out:
+                errors[name] = (f"killed: exceeded {timeout_s}s "
+                                "(hang inside a device call)")
+                log(f"section {name}: KILLED after {timeout_s}s")
+            elif rc != 0 and name not in _read_partials():
+                errors[name] = f"child crashed rc={rc} (no result line)"
+                log(f"section {name}: crashed rc={rc}")
+            if timed_out or (rc not in (0, 3) and rc is not None):
+                # Timeout or hard crash: is the relay still alive?
+                if not SMOKE:
+                    log("re-probing backend after section failure...")
+                    failure = _probe_backend(60)
+                    if failure:
+                        wedged = name
+                        log(f"relay wedged after {name}: {failure}")
     finally:
+        records = _read_partials()
+        for name, _budget, _fn in SECTIONS:
+            record = records.get(name)
+            if record is None:
+                continue
+            if record.get("ok"):
+                result.update(record.get("result") or {})
+            else:
+                errors.setdefault(name, record.get("error", "failed"))
         if errors:
             result["errors"] = errors
         print(json.dumps(result), flush=True)
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--section", default=None,
+                        help="internal: run one section in-process")
+    parser.add_argument("--budget", type=int, default=None,
+                        help="internal: deadline-truncated watchdog "
+                             "budget for the section")
+    args = parser.parse_args()
+    if args.section:
+        sys.exit(child_main(args.section, budget_override=args.budget))
+    parent_main()
 
 
 if __name__ == "__main__":

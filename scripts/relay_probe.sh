@@ -1,0 +1,7 @@
+#!/bin/sh
+# Quick relay health probe: rc 0 = healthy, 1 = wedged/failed.
+timeout "${1:-120}" python -c "
+import jax, numpy as np, jax.numpy as jnp
+x = jnp.ones((32, 32))
+print('relay ok:', float(np.asarray(x @ x)[0, 0]), jax.devices())
+" 2>&1 | tail -2

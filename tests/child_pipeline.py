@@ -1,18 +1,28 @@
 """Child process for cross-OS-process integration tests.
 
-Run as ``python -m tests.child_pipeline``: connects to the MQTT broker
-named by AIKO_MQTT_HOST/AIKO_MQTT_PORT, hosts the Registrar plus the
-callee pipeline ``p_remote`` (PE_Double), prints READY, and serves until
-killed — the role a second machine plays in the reference's multitude
-setup (reference examples/pipeline/multitude/run_large.sh drives 10 such
-processes against mosquitto)."""
+Run as ``python -m tests.child_pipeline [pipeline.json]``: connects to
+the MQTT broker named by AIKO_MQTT_HOST/AIKO_MQTT_PORT, hosts the
+Registrar (unless ``CHILD_REGISTRAR=0`` — a fleet needs only one
+primary; extras become secondaries anyway) plus the callee pipeline —
+the built-in ``p_remote`` (PE_Double) by default, or any pipeline
+definition JSON given as argv[1] — prints READY, and serves until
+killed.  This is the role a second machine plays in the reference's
+multitude setup (reference examples/pipeline/multitude/run_large.sh
+drives 10 such processes against mosquitto)."""
 
+import os
 import sys
 
 
 def main():
+    # The sandbox pins JAX_PLATFORMS=axon via a sitecustomize hook
+    # (plain env overrides are ignored); any pipeline hosting a
+    # jax-backed element would hang on the relay — force CPU the way
+    # conftest does, before any backend init.
+    import jax
+    jax.config.update("jax_platforms", "cpu")
     from aiko_services_tpu.pipeline import (
-        Pipeline, parse_pipeline_definition,
+        Pipeline, load_pipeline_definition, parse_pipeline_definition,
     )
     from aiko_services_tpu.registry import Registrar
     from aiko_services_tpu.runtime import (
@@ -32,13 +42,16 @@ def main():
                                  "class_name": "PE_Double"}},
         }],
     }
+    if len(sys.argv) > 1:
+        parsed = load_pipeline_definition(sys.argv[1])
+    else:
+        parsed = parse_pipeline_definition(definition)
     engine = EventEngine()
     process = Process(engine=engine, transport="mqtt")
-    Registrar(process=process)
+    if os.environ.get("CHILD_REGISTRAR", "1") != "0":
+        Registrar(process=process)
     compose_instance(
-        Pipeline,
-        pipeline_args("p_remote",
-                      definition=parse_pipeline_definition(definition)),
+        Pipeline, pipeline_args(parsed.name, definition=parsed),
         process=process)
     print("READY", flush=True)
     engine.loop()

@@ -127,6 +127,71 @@ def test_remote_element_across_os_processes(broker, monkeypatch):
         child.wait(timeout=10)
 
 
+def test_speech_chain_across_os_processes(broker, monkeypatch, tmp_path):
+    """The reference's showcase workload as REAL processes: the speech
+    chain split like its pipeline_speech_llm_input/output.json pair —
+    audio→framing→ASR→text runs here, the chat stage runs in one
+    subprocess (p_speech_chat_svc, hosting the Registrar), TTS + audio
+    writer in another (p_speech_out), with both hops crossing the
+    built-in MQTT broker and the frame resuming mid-graph after each."""
+    monkeypatch.setenv("AIKO_MQTT_HOST", broker.host)
+    monkeypatch.setenv("AIKO_MQTT_PORT", str(broker.port))
+    namespace = f"speech{broker.port}"
+    children = []
+    for json_name, registrar in (
+            ("pipeline_speech_llm_chat.json", "1"),
+            ("pipeline_speech_llm_output.json", "0")):
+        env = dict(os.environ,
+                   AIKO_MQTT_HOST=broker.host,
+                   AIKO_MQTT_PORT=str(broker.port),
+                   AIKO_NAMESPACE=namespace,
+                   JAX_PLATFORMS="cpu",
+                   CHILD_REGISTRAR=registrar)
+        child = subprocess.Popen(
+            [sys.executable, "-m", "tests.child_pipeline",
+             os.path.join("examples", "speech", json_name)],
+            cwd=REPO_ROOT, env=env, stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE, text=True)
+        children.append(read_ready(child, timeout=120))
+
+    from aiko_services_tpu.pipeline import load_pipeline_definition
+    engine = EventEngine()
+    thread = engine.run_in_thread()
+    process = None
+    try:
+        process = Process(namespace=namespace, engine=engine,
+                          transport="mqtt")
+        assert wait_for(lambda: process.message.connected, 10)
+        definition = load_pipeline_definition(os.path.join(
+            REPO_ROOT, "examples", "speech",
+            "pipeline_speech_llm_input.json"))
+        caller = compose_instance(
+            Pipeline,
+            pipeline_args(definition.name, definition=definition),
+            process=process)
+        assert wait_for(
+            lambda: all(caller.remote_proxies.get(name) is not None
+                        for name in ("PE_RemoteChat", "PE_RemoteSpeak")),
+            60), f"remote stages never discovered: {caller.remote_proxies}"
+
+        out = queue.Queue()
+        caller.create_stream("s1", queue_response=out)
+        _, _, outputs = out.get(timeout=120)
+        import numpy as np
+        audio = np.asarray(outputs["audio"])
+        assert audio.size > 0, outputs
+        assert np.isfinite(audio).all()
+    finally:
+        if process is not None:
+            process.terminate()
+        engine.terminate()
+        thread.join(timeout=5)
+        for child in children:
+            child.terminate()
+        for child in children:
+            child.wait(timeout=10)
+
+
 def test_child_death_fires_lwt_eviction(broker):
     """Killing the child (SIGKILL, no graceful disconnect) must fire its
     LWT ``(absent)`` over the real broker — the liveness signal the

@@ -332,13 +332,93 @@ def test_forward_sequence_parallel_ulysses_kv_native(tiny):
                                rtol=3e-2, atol=4e-2)
 
 
-def test_forward_sequence_parallel_rejects_sliding_window():
-    config = llama.CONFIGS["mistral_tiny"]
-    params = llama.init_params(config, jax.random.PRNGKey(0))
-    with pytest.raises(ValueError, match="sliding-"):
-        llama.forward_sequence_parallel(
-            params, jnp.zeros((1, 32), jnp.int32), config,
-            make_mesh(sp=8))
+def test_forward_sequence_parallel_sliding_window_ring():
+    """SP × sliding window (the Mistral-class long-context composition):
+    ring attention with global-position window masking must match the
+    single-device windowed forward — at seq 64 >> window 16 the mask
+    crosses several shard boundaries of the sp=8 mesh AND whole shards
+    fall below the window (exercising the dead-shard skip)."""
+    config = llama.CONFIGS["mistral_tiny"]   # window 16
+    params = llama.init_params(config, jax.random.PRNGKey(5))
+    tokens = jax.random.randint(jax.random.PRNGKey(12), (2, 64),
+                                0, config.vocab_size, jnp.int32)
+    want = llama.forward(params, tokens, config, use_flash=False)
+    got = llama.forward_sequence_parallel(params, tokens, config,
+                                          make_mesh(sp=8))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=3e-2, atol=4e-2)
+
+
+def test_forward_sequence_parallel_sliding_window_ulysses():
+    """Ulysses variant of SP × sliding window: after the head scatter
+    the full sequence is local, so windowed masking must be globally
+    correct with no offset bookkeeping."""
+    config = llama.CONFIGS["mistral_tiny"]   # 4 heads, window 16
+    params = llama.init_params(config, jax.random.PRNGKey(5))
+    tokens = jax.random.randint(jax.random.PRNGKey(13), (2, 64),
+                                0, config.vocab_size, jnp.int32)
+    want = llama.forward(params, tokens, config, use_flash=False)
+    got = llama.forward_sequence_parallel(params, tokens, config,
+                                          make_mesh(dp=2, sp=4),
+                                          attention="ulysses")
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=3e-2, atol=4e-2)
+
+
+def test_sp_prefill_decode_handoff(tiny):
+    """SP-prefill → decode handoff: prefill sharded over sp=8 into a
+    replicated cache, then greedy-decode single-program from the
+    gathered cache — tokens must exactly match the plain prefill +
+    decode path."""
+    config, params = tiny
+    seq, new = 64, 24
+    tokens = jax.random.randint(jax.random.PRNGKey(14), (2, seq),
+                                0, config.vocab_size, jnp.int32)
+    # Oracle: plain single-program prefill + decode.
+    cache = llama.init_cache(config, 2, seq + new + 8)
+    logits, cache = llama.prefill(params, tokens, cache, config)
+    first = logits[:, -1].argmax(-1).astype(jnp.int32)[:, None]
+    want, _ = llama.generate_tokens(params, first, cache,
+                                    jnp.int32(seq), new, config)
+    # SP prefill over the mesh, then the identical decode tail.
+    mesh = make_mesh(sp=8)
+    cache_sp = llama.init_cache(config, 2, seq + new + 8)
+    logits_sp, cache_sp = llama.prefill_sequence_parallel(
+        params, tokens, cache_sp, config, mesh)
+    np.testing.assert_allclose(np.asarray(logits_sp),
+                               np.asarray(logits[:, -1]),
+                               rtol=3e-2, atol=4e-2)
+    first_sp = logits_sp.argmax(-1).astype(jnp.int32)[:, None]
+    got, _ = llama.generate_tokens(params, first_sp, cache_sp,
+                                   jnp.int32(seq), new, config)
+    assert (np.asarray(got) == np.asarray(want)).mean() >= 0.95
+
+
+def test_sp_prefill_decode_handoff_windowed_rolling():
+    """The full long-context composition: SP-windowed prefill (ring)
+    into a ROLLING (ring-buffer) cache, then windowed decode from the
+    wrapped cache — must track the full-cache windowed oracle."""
+    config = llama.CONFIGS["mistral_tiny"]   # window 16
+    params = llama.init_params(config, jax.random.PRNGKey(5))
+    seq, new = 64, 16
+    tokens = jax.random.randint(jax.random.PRNGKey(15), (1, seq),
+                                0, config.vocab_size, jnp.int32)
+    cache = llama.init_cache(config, 1, seq + new + 8)
+    logits, cache = llama.prefill(params, tokens, cache, config)
+    first = logits[:, -1].argmax(-1).astype(jnp.int32)[:, None]
+    want, _ = llama.generate_tokens(params, first, cache,
+                                    jnp.int32(seq), new, config)
+    mesh = make_mesh(sp=8)
+    rolling = llama.init_cache(config, 1, rolling=True)
+    logits_sp, rolling = llama.prefill_sequence_parallel(
+        params, tokens, rolling, config, mesh)
+    np.testing.assert_allclose(np.asarray(logits_sp),
+                               np.asarray(logits[:, -1]),
+                               rtol=3e-2, atol=4e-2)
+    first_sp = logits_sp.argmax(-1).astype(jnp.int32)[:, None]
+    got, _ = llama.generate_tokens(params, first_sp, rolling,
+                                   jnp.int32(seq), new, config)
+    assert (np.asarray(got) == np.asarray(want)).mean() >= 0.9
 
 
 # --------------------------------------------------------------------------- #
@@ -443,6 +523,37 @@ def test_rolling_cache_requires_window(tiny):
     config, _ = tiny
     with pytest.raises(ValueError, match="sliding_window"):
         llama.init_cache(config, 1, 64, rolling=True)
+
+
+def test_prefill_chunk_rejects_rolling_cache_for_wide_chunks():
+    """Chunked prefill with K > 1 on a ring-buffer cache would slab-
+    write rows still inside earlier chunk queries' windows (silently
+    wrong logits) — it must refuse loudly; K=1 stays supported and
+    matches generate_tokens' row layout."""
+    config = llama.CONFIGS["mistral_tiny"]   # window 16
+    params = llama.init_params(config, jax.random.PRNGKey(3))
+    tokens = jax.random.randint(jax.random.PRNGKey(8), (1, 8),
+                                0, config.vocab_size, jnp.int32)
+    rolling = llama.init_cache(config, 1, 64, rolling=True)
+    with pytest.raises(ValueError, match="rolling"):
+        llama.prefill_chunk(params, tokens, rolling, jnp.int32(0),
+                            config)
+    # K=1 token-by-token chunked prefill on the ring matches the
+    # full-cache chunked prefill logits.
+    full = llama.init_cache(config, 1, 64)
+    out_full = []
+    for i in range(tokens.shape[1]):
+        lg, full = llama.prefill_chunk(params, tokens[:, i:i + 1],
+                                       full, jnp.int32(i), config)
+        out_full.append(np.asarray(lg[:, -1]))
+    out_ring = []
+    for i in range(tokens.shape[1]):
+        lg, rolling = llama.prefill_chunk(params, tokens[:, i:i + 1],
+                                          rolling, jnp.int32(i), config)
+        out_ring.append(np.asarray(lg[:, -1]))
+    np.testing.assert_allclose(np.asarray(out_ring),
+                               np.asarray(out_full),
+                               rtol=2e-2, atol=2e-2)
 
 
 def test_mistral_window_changes_output_vs_full_causal():

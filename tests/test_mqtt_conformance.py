@@ -1,0 +1,275 @@
+"""Byte-level MQTT 3.1.1 conformance vectors.
+
+The built-in client (`transport/mqtt.py`) and broker
+(`transport/mqtt_broker.py`) share one codec (`transport/mqtt_codec.py`)
+and are otherwise only ever tested against each other — a shared
+misreading of the spec would pass every loop test.  These golden frames
+are HAND-ASSEMBLED from the OASIS MQTT 3.1.1 wire layout (fixed header
+§2.2, CONNECT §3.1, PUBLISH §3.3, SUBSCRIBE §3.8, …; the reference
+interoperates with this ecosystem via paho, reference
+``main/message/mqtt.py:65-289``) and asserted in BOTH directions:
+
+* encoder output must equal the golden bytes exactly, and
+* the decoder fed the golden bytes must recover the exact fields,
+
+so a bug would have to be made twice — once here in hex and once in the
+codec — to survive.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from aiko_services_tpu.transport import mqtt_codec as mc
+
+
+def golden(*parts) -> bytes:
+    """Assemble a golden frame from hex strings / raw bytes."""
+    out = bytearray()
+    for part in parts:
+        out.extend(bytes.fromhex(part.replace(" ", ""))
+                   if isinstance(part, str) else part)
+    return bytes(out)
+
+
+def decode_one(frame: bytes) -> mc.Packet:
+    packets = mc.PacketReader().feed(frame)
+    assert len(packets) == 1, packets
+    return packets[0]
+
+
+# --------------------------------------------------------------------------- #
+# Remaining-length encoding (§2.2.3 — the table's own boundary values)
+
+@pytest.mark.parametrize("length,encoded", [
+    (0, "00"),
+    (1, "01"),
+    (127, "7f"),                 # largest 1-byte value
+    (128, "80 01"),              # smallest 2-byte value
+    (321, "c1 02"),              # the spec's worked example
+    (16_383, "ff 7f"),           # largest 2-byte value
+    (16_384, "80 80 01"),        # smallest 3-byte value
+    (2_097_151, "ff ff 7f"),     # largest 3-byte value
+    (268_435_455, "ff ff ff 7f"),  # protocol maximum
+])
+def test_remaining_length_golden(length, encoded):
+    assert mc.encode_remaining_length(length) == golden(encoded)
+
+
+def test_remaining_length_overflow_rejected():
+    # Five continuation bytes exceed the §2.2.3 maximum: malformed.
+    reader = mc.PacketReader()
+    with pytest.raises(ValueError, match="remaining length"):
+        reader.feed(golden("30 ff ff ff ff 7f"))
+
+
+# --------------------------------------------------------------------------- #
+# CONNECT (§3.1) / CONNACK (§3.2)
+
+#: CONNECT, clean session, keepalive 60, client id "cid":
+#: fixed 0x10, remaining 15; variable header 00 04 "MQTT" 04, flags
+#: 0x02, keepalive 003c; payload 00 03 "cid".
+CONNECT_PLAIN = golden(
+    "10 0f",
+    "00 04", b"MQTT", "04 02 00 3c",
+    "00 03", b"cid",
+)
+
+#: CONNECT with a retained last-will — the framework's liveness idiom
+#: (will flag 0x04, will-retain 0x20, clean session 0x02 → 0x26):
+#: will topic "ns/h/1/state", will payload "(absent)".
+CONNECT_LWT = golden(
+    "10 27",
+    "00 04", b"MQTT", "04 26 00 3c",
+    "00 03", b"cid",
+    "00 0c", b"ns/h/1/state",
+    "00 08", b"(absent)",
+)
+
+
+def test_connect_golden_encode():
+    assert mc.encode_connect("cid", keepalive=60) == CONNECT_PLAIN
+    assert mc.encode_connect(
+        "cid", keepalive=60, will_topic="ns/h/1/state",
+        will_payload=b"(absent)", will_retain=True) == CONNECT_LWT
+
+
+def test_connect_golden_decode():
+    packet = decode_one(CONNECT_PLAIN)
+    assert packet.packet_type == mc.CONNECT
+    assert packet.client_id == "cid"
+    assert packet.keepalive == 60
+    assert packet.will_topic is None
+
+    packet = decode_one(CONNECT_LWT)
+    assert packet.client_id == "cid"
+    assert packet.will_topic == "ns/h/1/state"
+    assert packet.will_payload == b"(absent)"
+    assert packet.will_retain is True
+    assert packet.username is None and packet.password is None
+
+
+def test_connect_username_password_golden():
+    # username flag 0x80 + password flag 0x40 + clean 0x02 = 0xc2;
+    # payload order: client id, user "u", password "pw" (§3.1.3).
+    frame = golden(
+        "10 16",
+        "00 04", b"MQTT", "04 c2 00 3c",
+        "00 03", b"cid",
+        "00 01", b"u",
+        "00 02", b"pw",
+    )
+    assert mc.encode_connect("cid", keepalive=60, username="u",
+                             password="pw") == frame
+    packet = decode_one(frame)
+    assert packet.username == "u" and packet.password == "pw"
+
+
+def test_connect_wrong_protocol_name_rejected():
+    bad = bytearray(CONNECT_PLAIN)
+    bad[4] = ord(b"X")                       # "MXTT"
+    with pytest.raises(ValueError, match="3.1.1"):
+        decode_one(bytes(bad))
+
+
+#: CONNACK: session-present 0, return code 0 (accepted) — §3.2.
+CONNACK_OK = golden("20 02 00 00")
+
+
+def test_connack_golden():
+    assert mc.encode_connack() == CONNACK_OK
+    packet = decode_one(CONNACK_OK)
+    assert packet.packet_type == mc.CONNACK
+    assert packet.return_code == 0
+    # Refused (bad protocol version, code 1) decodes too.
+    refused = decode_one(golden("20 02 00 01"))
+    assert refused.return_code == 1
+
+
+# --------------------------------------------------------------------------- #
+# PUBLISH (§3.3) — plain, retained, empty payload (retained-clear)
+
+#: QoS-0 PUBLISH topic "a/b" payload "(hi)": fixed 0x30, remaining 9.
+PUBLISH_PLAIN = golden("30 09", "00 03", b"a/b", b"(hi)")
+#: Retain bit (fixed-header flag 0x01) set — discovery state idiom.
+PUBLISH_RETAIN = golden("31 09", "00 03", b"a/b", b"(hi)")
+#: Zero-length retained payload = "clear the retained message" (§3.3.1.3).
+PUBLISH_CLEAR = golden("31 05", "00 03", b"a/b")
+
+
+def test_publish_golden_encode():
+    assert mc.encode_publish("a/b", b"(hi)") == PUBLISH_PLAIN
+    assert mc.encode_publish("a/b", b"(hi)", retain=True) == \
+        PUBLISH_RETAIN
+    assert mc.encode_publish("a/b", b"", retain=True) == PUBLISH_CLEAR
+
+
+def test_publish_golden_decode():
+    packet = decode_one(PUBLISH_PLAIN)
+    assert (packet.packet_type, packet.topic, packet.payload,
+            packet.retain) == (mc.PUBLISH, "a/b", b"(hi)", False)
+    packet = decode_one(PUBLISH_RETAIN)
+    assert packet.retain is True and packet.payload == b"(hi)"
+    packet = decode_one(PUBLISH_CLEAR)
+    assert packet.retain is True and packet.payload == b""
+
+
+def test_publish_qos1_packet_id_skipped_on_decode():
+    # An ecosystem peer may send QoS 1 (flags 0x02): the 2-byte packet
+    # id sits between topic and payload (§3.3.2.2) and must not leak
+    # into the payload.
+    frame = golden("32 08", "00 01", b"a", "00 2a", b"(x)")
+    packet = decode_one(frame)
+    assert packet.topic == "a" and packet.payload == b"(x)"
+
+
+def test_publish_utf8_topic_golden():
+    # Non-ASCII topic: UTF-8 length is BYTES not characters (§1.5.3).
+    topic = "ns/café"
+    encoded = topic.encode("utf-8")           # 8 bytes for 7 chars
+    frame = golden("30", bytes([2 + len(encoded) + 2]),
+                   bytes([0, len(encoded)]), encoded, b"ok")
+    assert mc.encode_publish(topic, b"ok") == frame
+    assert decode_one(frame).topic == topic
+
+
+# --------------------------------------------------------------------------- #
+# SUBSCRIBE (§3.8) / SUBACK (§3.9) / UNSUBSCRIBE (§3.10) / UNSUBACK
+
+#: SUBSCRIBE packet id 1, one pattern "ns/#", requested QoS 0.
+#: Fixed header flags MUST be 0x02 (§3.8.1).
+SUBSCRIBE_ONE = golden("82 09", "00 01", "00 04", b"ns/#", "00")
+#: Two patterns in one packet: "+/state" and "a/b".
+SUBSCRIBE_TWO = golden("82 12", "00 02",
+                       "00 07", b"+/state", "00",
+                       "00 03", b"a/b", "00")
+#: SUBACK packet id 1, one granted-QoS-0 return code.
+SUBACK_ONE = golden("90 03", "00 01", "00")
+UNSUBSCRIBE_ONE = golden("a2 08", "00 03", "00 04", b"ns/#")
+UNSUBACK_ONE = golden("b0 02", "00 03")
+
+
+def test_subscribe_golden():
+    assert mc.encode_subscribe(1, ["ns/#"]) == SUBSCRIBE_ONE
+    assert mc.encode_subscribe(2, ["+/state", "a/b"]) == SUBSCRIBE_TWO
+    packet = decode_one(SUBSCRIBE_ONE)
+    assert (packet.packet_type, packet.packet_id, packet.patterns) == \
+        (mc.SUBSCRIBE, 1, ["ns/#"])
+    assert packet.flags == 0x02
+    packet = decode_one(SUBSCRIBE_TWO)
+    assert packet.patterns == ["+/state", "a/b"]
+
+
+def test_suback_unsubscribe_unsuback_golden():
+    assert mc.encode_suback(1, 1) == SUBACK_ONE
+    packet = decode_one(SUBACK_ONE)
+    assert (packet.packet_type, packet.packet_id) == (mc.SUBACK, 1)
+    assert mc.encode_unsubscribe(3, ["ns/#"]) == UNSUBSCRIBE_ONE
+    packet = decode_one(UNSUBSCRIBE_ONE)
+    assert (packet.packet_id, packet.patterns) == (3, ["ns/#"])
+    assert mc.encode_unsuback(3) == UNSUBACK_ONE
+    assert decode_one(UNSUBACK_ONE).packet_id == 3
+
+
+# --------------------------------------------------------------------------- #
+# PINGREQ / PINGRESP / DISCONNECT (§3.12-3.14) — zero-body packets
+
+def test_ping_disconnect_golden():
+    assert mc.encode_pingreq() == golden("c0 00")
+    assert mc.encode_pingresp() == golden("d0 00")
+    assert mc.encode_disconnect() == golden("e0 00")
+    assert decode_one(golden("c0 00")).packet_type == mc.PINGREQ
+    assert decode_one(golden("d0 00")).packet_type == mc.PINGRESP
+    assert decode_one(golden("e0 00")).packet_type == mc.DISCONNECT
+
+
+# --------------------------------------------------------------------------- #
+# Stream robustness against the golden frames
+
+def test_golden_stream_byte_by_byte_and_coalesced():
+    """A realistic session transcript — CONNECT, CONNACK, SUBSCRIBE,
+    retained PUBLISH, PINGREQ, DISCONNECT — must parse identically
+    whether fed one byte at a time or as one TCP segment."""
+    stream = (CONNECT_LWT + CONNACK_OK + SUBSCRIBE_ONE + SUBACK_ONE
+              + PUBLISH_RETAIN + golden("c0 00") + golden("e0 00"))
+    reader = mc.PacketReader()
+    dribbled = []
+    for i in range(len(stream)):
+        dribbled.extend(reader.feed(stream[i:i + 1]))
+    coalesced = mc.PacketReader().feed(stream)
+    types = [mc.CONNECT, mc.CONNACK, mc.SUBSCRIBE, mc.SUBACK,
+             mc.PUBLISH, mc.PINGREQ, mc.DISCONNECT]
+    assert [p.packet_type for p in dribbled] == types
+    assert [p.packet_type for p in coalesced] == types
+    assert dribbled[4].topic == "a/b" and dribbled[4].retain
+
+
+def test_multibyte_remaining_length_publish():
+    """PUBLISH with a 300-byte payload: remaining length = 2 + 3 + 300
+    = 305 = 0xb1 0x02 (two-byte varint) — the first size class the
+    1-byte field cannot express."""
+    payload = bytes(range(256)) + bytes(44)
+    frame = golden("30 b1 02", "00 03", b"a/b", payload)
+    assert mc.encode_publish("a/b", payload) == frame
+    packet = decode_one(frame)
+    assert packet.payload == payload
