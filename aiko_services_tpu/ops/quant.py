@@ -18,6 +18,7 @@ Two execution paths with identical numerics:
 from __future__ import annotations
 
 import functools
+import os
 from typing import Dict
 
 import jax
@@ -34,6 +35,14 @@ except ImportError:  # pragma: no cover
 __all__ = ["quantize_int8", "dequantize", "int8_matmul",
            "quantize_int4", "dequantize_int4", "int4_matmul",
            "quantize_tree", "is_quantized", "is_quantized_int4"]
+
+#: AIKO_INT4_XLA=1 (read at import): route int4_matmul through the XLA
+#: grouped-einsum path even on TPU, bypassing the Pallas kernel.  XLA
+#: fuses the nibble unpack + scale into the contraction itself; this
+#: switch exists so benchmarks can compare the two int4 lowerings
+#: head-to-head on hardware without any new Pallas compile (a failed
+#: Pallas compile can wedge the dev relay).
+_INT4_FORCE_XLA = os.environ.get("AIKO_INT4_XLA", "") not in ("", "0")
 
 #: int8 symmetric range (−127…127; −128 unused to keep scales symmetric).
 _QMAX = 127.0
@@ -285,7 +294,8 @@ def int4_matmul(x, q4, s, interpret: bool = False):
     x2 = x.reshape(-1, k)
     m = x2.shape[0]
     on_tpu = jax.default_backend() == "tpu"
-    pallas_ok = _PALLAS_TPU and (on_tpu or interpret) and m <= 64
+    pallas_ok = (_PALLAS_TPU and (on_tpu or interpret) and m <= 64
+                 and not _INT4_FORCE_XLA)
     repeat_block = _pick_block_repeat(khalf, n, interpret) \
         if pallas_ok else 0
     unroll_block = _pick_block_int4(m, khalf, n, groups) \

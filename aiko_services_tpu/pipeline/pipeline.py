@@ -640,7 +640,15 @@ class Pipeline(PipelineElement):
         state = STREAM_EVENT_TO_STATE.get(event, StreamState.ERROR)
         stream.frames.pop(frame.frame_id, None)
         if state == StreamState.DROP_FRAME:
-            return     # this frame dies quietly; the stream lives
+            # This frame dies quietly; the stream lives — unless it was
+            # the LAST in-flight frame of a draining (STOPped) stream,
+            # whose teardown this drop must now perform (mirrors
+            # _complete_frame; without it a drain ending in DROP_FRAME
+            # leaks the stream forever when it has no lease).
+            if stream.state == StreamState.STOP and not stream.frames \
+                    and stream.stream_id in self.streams:
+                self.destroy_stream(stream.stream_id)
+            return
         if state in (StreamState.STOP, StreamState.ERROR):
             self.logger.info("%s: stream %s -> %s at %s", self.name,
                              stream.stream_id, state.name, element_name)

@@ -102,12 +102,6 @@ def watchdog(seconds: int, label: str):
         signal.signal(signal.SIGALRM, previous)
 
 
-class BackendWedged(RuntimeError):
-    """Backend probe timed out — the relay hang mode.  NOT retried: a
-    wedge is not transient, and each retry would eat the global
-    deadline."""
-
-
 def _probe_backend(timeout_s: int) -> str | None:
     """Probe the backend in a SUBPROCESS.  The relay's worst failure
     mode is a hang inside a C call (observed: jax.devices() blocks
@@ -781,6 +775,61 @@ def bench_detector_mfu():
 # Section registry — ordered: established captures first, newest /
 # heaviest Pallas paths last (wedge containment).
 
+def bench_serving_paged(slots=8, prompt_len=64, max_new=64,
+                        n_requests=24, config_name="small",
+                        chunk_steps=16, shared_prefix=48):
+    """Sustained tokens/sec through the PAGED serving stack with the
+    prefix cache on: requests share a ``shared_prefix``-token prompt
+    head, so later admissions skip prefill work for the shared blocks
+    (the vLLM-style block-table design the contiguous server cannot
+    express)."""
+    from aiko_services_tpu.orchestration.continuous import (
+        DecodeRequest, _bucket,
+    )
+    from aiko_services_tpu.orchestration.paged import (
+        PagedContinuousServer,
+    )
+
+    block_size = 16
+    max_seq = _bucket(prompt_len) + max_new + chunk_steps
+    max_seq += -max_seq % block_size          # pool is block-granular
+    server = PagedContinuousServer(
+        config_name=config_name, slots=slots, max_seq=max_seq,
+        chunk_steps=chunk_steps, quantize=True,
+        block_size=block_size, enable_prefix_cache=True)
+    rng = np.random.default_rng(0)
+    prefix = rng.integers(1, server.config.vocab_size,
+                          shared_prefix).astype(np.int32)
+
+    def submit_batch(count, tag):
+        for i in range(count):
+            tail = rng.integers(
+                1, server.config.vocab_size,
+                prompt_len - shared_prefix).astype(np.int32)
+            server.submit(DecodeRequest(
+                request_id=f"{tag}{i}",
+                prompt=np.concatenate([prefix, tail]),
+                max_new_tokens=max_new))
+
+    log("serving[paged] warmup (compile prefill + paged chunk)...")
+    submit_batch(slots, "warm")
+    server.run_until_drained()
+    log(f"serving[paged] timed: {n_requests} requests x {max_new} "
+        f"tokens, shared {shared_prefix}-token prefix...")
+    submit_batch(n_requests, "r")
+    started = time.perf_counter()
+    finished = server.run_until_drained()
+    elapsed = time.perf_counter() - started
+    total_tokens = sum(len(r.tokens) for r in finished
+                       if r.error is None)
+    tps = total_tokens / elapsed
+    log(f"serving[paged]: {tps:.0f} tokens/sec/chip sustained "
+        f"({n_requests} reqs, prefix hits {server.prefix_hits}, "
+        f"blocks reused {server.prefix_blocks_reused})")
+    return {"serving_paged_tokens_per_sec_chip": round(tps),
+            "serving_paged_prefix_hits": int(server.prefix_hits)}
+
+
 #: Tiny decode args for BENCH_SMOKE (wiring check, not measurement).
 _SMOKE_LLM = dict(batch=2, prompt_len=16, new_tokens=8,
                   config_name="tiny")
@@ -805,6 +854,16 @@ def _llm_section(prefix, batch_key=False, target=None, **kwargs):
         if target:
             out[f"{prefix}_vs_{target}_target"] = round(tps / target, 2)
         return out
+    return run
+
+
+def _int4_xla_wrapper(section_fn):
+    """Force the int4 XLA lowering for this section's CHILD process:
+    the env var is read by ops/quant.py at import, and each section
+    imports the package fresh in its own subprocess."""
+    def run():
+        os.environ["AIKO_INT4_XLA"] = "1"
+        return section_fn()
     return run
 
 
@@ -852,15 +911,27 @@ SECTIONS = [
          slots=2, prompt_len=16, max_new=8, n_requests=4,
          config_name="tiny", chunk_steps=4))
      if SMOKE else bench_serving_continuous),
+    ("serving_paged", 420,
+     (lambda: bench_serving_paged(
+         slots=2, prompt_len=24, max_new=8, n_requests=4,
+         config_name="tiny", chunk_steps=4, shared_prefix=16))
+     if SMOKE else bench_serving_paged),
     # MFU sections: compute-bound accounting (prefill / train /
     # detector).  All use established compile paths (flash attention,
     # XLA int8 fallback, conv stack) — no new Pallas tiles.
     ("prefill_mfu", 600, bench_prefill_mfu),
     ("train_mfu", 420, bench_train_mfu),
     ("detector_mfu", 300, bench_detector_mfu),
-    # Int4 flagship variant VERY last: the newest Pallas path (the
-    # kernel dispatches only hardware-validated tile shapes, but wedge
-    # containment still puts it after every other capture is banked).
+    # Int4 flagship variants VERY last (wedge containment): first the
+    # XLA grouped-einsum lowering (no Pallas compile at all), then the
+    # Pallas whole-tile kernel (dispatches only hardware-validated
+    # tile shapes).  Capturing BOTH decides int4's fate with data: the
+    # kernel must beat int8's tok/s or be demoted (VERDICT r2 #3).
+    ("llama3_8b_int4_xla", 600,
+     _int4_xla_wrapper(_llm_section(
+         "llama3_8b_int4_xla", batch_key=True, bits=4,
+         random_int8=True, batch=64, prompt_len=128,
+         new_tokens=128, config_name="llama3_8b"))),
     ("llama3_8b_int4", 600,
      _llm_section("llama3_8b_int4", batch_key=True, bits=4,
                   random_int8=True, batch=64, prompt_len=128,
@@ -979,10 +1050,22 @@ def parent_main():
 
     try:
         if not SMOKE:
-            log("backend preflight (subprocess probe)...")
-            failure = _probe_backend(150)
+            # Preflight: a HANG means the relay is wedged (not
+            # transient — no retry, it would only eat the deadline
+            # 150 s at a time); a FAST failure (e.g. UNAVAILABLE at
+            # startup, the round-1 mode) is retried a few times.
+            failure = None
+            for attempt in range(1, 4):
+                log(f"backend preflight (subprocess probe, attempt "
+                    f"{attempt})...")
+                failure = _probe_backend(150)
+                if failure is None or "hung" in failure:
+                    break
+                log(f"preflight attempt {attempt} failed "
+                    f"(transient?): {failure}")
+                time.sleep(5)
             if failure:
-                errors["backend"] = f"BackendWedged({failure!r})"
+                errors["backend"] = f"backend unusable: {failure}"
                 log(f"FATAL backend failure (emitting empty result): "
                     f"{failure}")
                 return

@@ -285,6 +285,92 @@ def test_remote_element_retries_until_discovered(engine):
     assert [f["i"] for f in PE_Collect.seen["PE_Collect"]] == [4]
 
 
+def test_stop_drains_frames_paused_at_remote(engine):
+    """A source's STOP must not discard frames still paused at a remote
+    element: the stream enters STOP (no new frames) but stays alive
+    until the remote responses resume and complete the in-flight
+    frames — then it tears down (reference graceful drain,
+    main/pipeline.py:849-917)."""
+    broker = "drain"
+    reg_process = Process(namespace="test", hostname="h", pid="9",
+                          engine=engine, broker=broker)
+    Registrar(process=reg_process)
+    engine.advance(4.0)
+    callee, _ = make_pipeline(engine, REMOTE_CALLEE, pid="2",
+                              broker=broker)
+    doc = {
+        "version": 0, "name": "p_drain_caller", "runtime": "python",
+        "graph": ["(PE_CountSource PE_RemoteStage PE_Collect)"],
+        "elements": [
+            element("PE_CountSource", "PE_CountSource",
+                    [("i", "int")], [("i", "int")], {"limit": 2}),
+            {"name": "PE_RemoteStage",
+             "input": [{"name": "i", "type": "int"}],
+             "output": [{"name": "i", "type": "int"}],
+             "deploy": {"remote": {"service_filter":
+                                   {"name": "p_remote"}}}},
+            element("PE_Collect", "PE_Collect", [("i", "int")],
+                    [("i", "int")]),
+        ],
+    }
+    caller, _ = make_pipeline(engine, doc, pid="3", broker=broker)
+    engine.drain()
+    assert caller.remote_proxies["PE_RemoteStage"] is not None
+
+    PE_Collect.seen.clear()
+    caller.create_stream("d")
+    # The generator thread posts frames 0,1 then STOP; the frames pause
+    # at the remote hop and their responses must still come back.
+    import time
+    deadline = time.time() + 5.0
+    while time.time() < deadline and "d" in caller.streams:
+        engine.drain()
+        time.sleep(0.01)
+    assert [f["i"] for f in PE_Collect.seen["PE_Collect"]] == [0, 2]
+    assert "d" not in caller.streams      # torn down after the drain
+
+
+def test_drain_ending_in_drop_frame_still_tears_down(engine):
+    """If the LAST in-flight frame of a draining stream is DROPPED
+    downstream of the remote hop (instead of completing), the stream
+    must still tear down — a drain ending in DROP_FRAME previously
+    leaked the stream forever (no lease backstop by default)."""
+    broker = "draindrop"
+    reg_process = Process(namespace="test", hostname="h", pid="9",
+                          engine=engine, broker=broker)
+    Registrar(process=reg_process)
+    engine.advance(4.0)
+    make_pipeline(engine, REMOTE_CALLEE, pid="2", broker=broker)
+    doc = {
+        "version": 0, "name": "p_draindrop", "runtime": "python",
+        "graph": ["(PE_CountSource PE_RemoteStage PE_Add PE_DropOdd)"],
+        "elements": [
+            element("PE_CountSource", "PE_CountSource",
+                    [("i", "int")], [("i", "int")], {"limit": 1}),
+            {"name": "PE_RemoteStage",
+             "input": [{"name": "i", "type": "int"}],
+             "output": [{"name": "i", "type": "int"}],
+             "deploy": {"remote": {"service_filter":
+                                   {"name": "p_remote"}}}},
+            # 0 → doubled 0 → +1 = 1 (odd) → DROP_FRAME ends the drain.
+            element("PE_Add", "PE_Add", [("i", "int")], [("i", "int")],
+                    {"amount": 1}),
+            element("PE_DropOdd", "PE_DropOdd", [("i", "int")],
+                    [("i", "int")]),
+        ],
+    }
+    caller, _ = make_pipeline(engine, doc, pid="3", broker=broker)
+    engine.drain()
+    assert caller.remote_proxies["PE_RemoteStage"] is not None
+    caller.create_stream("dd")
+    import time
+    deadline = time.time() + 5.0
+    while time.time() < deadline and "dd" in caller.streams:
+        engine.drain()
+        time.sleep(0.01)
+    assert "dd" not in caller.streams     # dropped tail still tears down
+
+
 def test_frames_park_until_all_elements_started(engine):
     """A generator posting frames while later elements are still starting
     must not have those frames processed early (this lost the first
