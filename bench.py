@@ -830,6 +830,73 @@ def bench_serving_paged(slots=8, prompt_len=64, max_new=64,
             "serving_paged_prefix_hits": int(server.prefix_hits)}
 
 
+def bench_sexpr_codec(n_messages=20_000):
+    """Control-plane wire codec: µs per parse / generate over
+    representative protocol payloads, native C codec vs the pure-Python
+    reference implementation — the per-message cost every actor RPC,
+    registrar update and EC-share sync pays.  CPU-only (no device)."""
+    from aiko_services_tpu.utils import sexpr
+
+    payloads = [
+        "(add ns/host/123/1 pipeline_a PipelineDefinition mqtt "
+        "owner_a (a=1 b=2))",
+        "(update lifecycle ready)",
+        "(process_frame (stream_id: s1 frame_id: 41) (i: 99))",
+        "(share response/topic 300 *)",
+        "(item_count 4096)",
+    ]
+    trees = [sexpr.parse_tree(p) for p in payloads]
+
+    def time_codec(label):
+        started = time.perf_counter()
+        for i in range(n_messages):
+            sexpr.parse_tree(payloads[i % len(payloads)])
+        parse_us = (time.perf_counter() - started) / n_messages * 1e6
+        started = time.perf_counter()
+        for i in range(n_messages):
+            sexpr.generate_expression(trees[i % len(trees)])
+        gen_us = (time.perf_counter() - started) / n_messages * 1e6
+        log(f"sexpr[{label}]: parse {parse_us:.2f} us/msg, "
+            f"generate {gen_us:.2f} us/msg")
+        return parse_us, gen_us
+
+    native_available = sexpr._native() is not None
+    result = {}
+    if native_available:
+        parse_c, gen_c = time_codec("native C")
+        result["sexpr_parse_us_native"] = round(parse_c, 2)
+        result["sexpr_generate_us_native"] = round(gen_c, 2)
+    saved = sexpr._NATIVE
+    sexpr._NATIVE = False                 # force the Python codec
+    try:
+        parse_py, gen_py = time_codec("python")
+    finally:
+        sexpr._NATIVE = saved
+    result["sexpr_parse_us_python"] = round(parse_py, 2)
+    result["sexpr_generate_us_python"] = round(gen_py, 2)
+    if native_available:
+        log(f"sexpr codec speedup: parse {parse_py / parse_c:.1f}x, "
+            f"generate {gen_py / gen_c:.1f}x (C vs Python)")
+        result["sexpr_parse_speedup"] = round(parse_py / parse_c, 1)
+    return result
+
+
+def bench_multitude(pipelines=10, frames=400):
+    """The reference's own headline scenario: N chained pipelines in N
+    real OS processes over the built-in MQTT broker, measuring
+    sustained ROUND-TRIP completions through the whole chain (the
+    reference's run_large.sh reports ~50 Hz one-way as its ceiling).
+    Control-plane only — no device involved."""
+    repo_root = os.path.dirname(os.path.abspath(__file__))
+    if repo_root not in sys.path:
+        sys.path.insert(0, repo_root)
+    from examples.multitude.run_multitude import run_cross_process
+    rate = run_cross_process(pipelines, frames)
+    return {"multitude_xproc_fps": round(rate),
+            "multitude_xproc_pipelines": pipelines,
+            "multitude_vs_reference_50hz": round(rate / 50.0, 1)}
+
+
 #: Tiny decode args for BENCH_SMOKE (wiring check, not measurement).
 _SMOKE_LLM = dict(batch=2, prompt_len=16, new_tokens=8,
                   config_name="tiny")
@@ -872,6 +939,16 @@ SECTIONS = [
     ("pipeline", 600,
      (lambda: bench_pipeline(n_frames=12, warmup=2, image_size=64))
      if SMOKE else bench_pipeline),
+    # Control-plane sections (no device): the codec microbench and the
+    # reference's own multitude scenario — capturable even when the
+    # accelerator is unavailable (run them directly with
+    # ``python bench.py --section <name>``, which skips the preflight).
+    ("sexpr_codec", 120,
+     (lambda: bench_sexpr_codec(n_messages=2_000))
+     if SMOKE else bench_sexpr_codec),
+    ("multitude_xproc", 420,
+     (lambda: bench_multitude(pipelines=3, frames=30))
+     if SMOKE else bench_multitude),
     # Flagship second: bank the north-star number before anything new.
     ("llama3_8b_int8", 900,
      _llm_section("llama3_8b_int8", batch_key=True, target=2000,

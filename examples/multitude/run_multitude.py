@@ -149,6 +149,7 @@ def run_cross_process(pipelines: int, frames: int):
               f"(reference: ~50 Hz one-way, run_large.sh:7,20)")
         engine.terminate()
         thread.join(timeout=2)
+        return rate
     finally:
         for child in children:
             child.terminate()
