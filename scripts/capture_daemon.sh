@@ -1,0 +1,99 @@
+#!/bin/bash
+# Window-hunting bench capture daemon (round 4, VERDICT r3 #1).
+#
+# The axon relay FLAPS: healthy windows are minutes wide and rare, and
+# a healthy backend init itself takes ~2 min (judge data, round 3 —
+# two probes succeeded while eight one-shot bench launches over 45 min
+# all hung).  A one-shot end-of-round capture therefore keeps missing.
+# This daemon runs from the FIRST minutes of a session and loops:
+#
+#   probe (generous timeout) -> on success run bench.py immediately
+#   -> commit the JSON + stderr + per-section partials, win or lose
+#   -> stop once a full capture (non-null flagship + pipeline) lands.
+#
+# Partial captures are committed too: bench.py writes one jsonl line
+# per section as it finishes, so even a window that closes mid-run
+# banks every completed section durably.
+#
+# Commit discipline: `git commit -m ... -- <paths>` commits ONLY the
+# named artifact paths, so the daemon can never sweep up the builder's
+# concurrently staged work; retries cover transient index.lock races.
+#
+# Controls:  touch STOP_CAPTURE  -> daemon exits at next loop top.
+#            CAPTURE_DONE        -> created after a full capture.
+
+cd "$(dirname "$0")/.." || exit 1
+ROUND="${ROUND:-r04}"
+PROBE_TIMEOUT="${PROBE_TIMEOUT:-180}"    # healthy init can take ~120 s
+SLEEP_BETWEEN="${SLEEP_BETWEEN:-75}"
+LOG="scripts/capture_daemon.log"
+
+say() { echo "[$(date -u +%FT%TZ)] $*" >> "$LOG"; }
+
+commit_paths() {
+    msg="$1"; shift
+    for _ in 1 2 3 4 5; do
+        if git add -- "$@" >>"$LOG" 2>&1 \
+           && git commit -q -m "$msg" -- "$@" >>"$LOG" 2>&1; then
+            return 0
+        fi
+        sleep 7
+    done
+    # Leave nothing staged on failure: the builder's next plain
+    # `git commit` must not sweep up the daemon's artifacts.
+    git restore --staged -- "$@" >>"$LOG" 2>&1 \
+        || git reset -q -- "$@" >>"$LOG" 2>&1
+    say "commit FAILED for: $*"
+    return 1
+}
+
+full_capture_ok() {
+    python - "$1" <<'EOF'
+import json, sys
+try:
+    d = json.load(open(sys.argv[1]))
+except Exception:
+    sys.exit(1)
+ok = (d.get("value") is not None
+      and d.get("llama3_8b_int8_tokens_per_sec_chip") is not None)
+sys.exit(0 if ok else 1)
+EOF
+}
+
+say "daemon start (pid $$)"
+while :; do
+    if [ -f STOP_CAPTURE ]; then
+        say "STOP_CAPTURE present; exiting"
+        exit 0
+    fi
+    PROBE_OUT="$(mktemp)"
+    if sh scripts/relay_probe.sh "$PROBE_TIMEOUT" > "$PROBE_OUT" 2>&1; then
+        say "probe HEALTHY: $(tail -1 "$PROBE_OUT")"
+        TS="$(date -u +%Y%m%dT%H%M%SZ)"
+        JSON="BENCH_LOCAL_${ROUND}_${TS}.json"
+        ERR="BENCH_LOCAL_${ROUND}_${TS}.err"
+        PART="bench_partial_${ROUND}_${TS}.jsonl"
+        # Pre-create: bench.py only creates the partials file lazily,
+        # and a run that dies before any section would otherwise make
+        # `git add` fail on the missing pathspec, losing JSON + err.
+        : > "$PART"
+        say "window open -> running bench ($JSON)"
+        BENCH_PARTIAL="$PART" BENCH_DEADLINE="${BENCH_DEADLINE:-2400}" \
+            timeout 3000 python bench.py > "$JSON" 2> "$ERR"
+        rc=$?
+        say "bench run rc=$rc"
+        commit_paths "Bench window capture ${TS} (rc=${rc})" \
+            "$JSON" "$ERR" "$PART"
+        if full_capture_ok "$JSON"; then
+            say "FULL capture landed: $JSON — daemon done"
+            date -u +%FT%TZ > CAPTURE_DONE
+            commit_paths "Full bench capture landed (${TS})" CAPTURE_DONE
+            exit 0
+        fi
+        say "capture partial/empty; continuing to hunt"
+    else
+        say "probe failed/wedged (rc=$?)"
+    fi
+    rm -f "$PROBE_OUT"
+    sleep "$SLEEP_BETWEEN"
+done

@@ -1,7 +1,16 @@
 #!/bin/sh
-# Quick relay health probe: rc 0 = healthy, 1 = wedged/failed.
-timeout "${1:-120}" python -c "
+# Quick relay health probe: rc 0 = healthy, nonzero = wedged/failed.
+# Output goes through a temp file, NOT a pipe: under /bin/sh without
+# pipefail a `probe | tail` pipeline returns tail's status, so the
+# script would report rc 0 even when timeout killed a hung probe —
+# the one condition it exists to detect (advisor finding, round 3).
+out="$(mktemp)"
+timeout "${1:-150}" python -c "
 import jax, numpy as np, jax.numpy as jnp
 x = jnp.ones((32, 32))
 print('relay ok:', float(np.asarray(x @ x)[0, 0]), jax.devices())
-" 2>&1 | tail -2
+" > "$out" 2>&1
+rc=$?
+tail -2 "$out"
+rm -f "$out"
+exit "$rc"
