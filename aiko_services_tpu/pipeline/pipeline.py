@@ -388,7 +388,10 @@ class Pipeline(PipelineElement):
             stream.pending.append(("stop", event_value))
             return
         if stream is not None and stream.frames and \
-                StreamEvent(int(event_value)) != StreamEvent.ERROR:
+                int(event_value) != int(StreamEvent.ERROR):
+            # Plain-int compare: values above StreamEvent.USER are
+            # user-defined (stream.py:35) and would make the enum
+            # constructor raise mid-drain.
             # Graceful drain: the mailbox serializes the stop behind
             # QUEUED frames, but frames already dispatched and paused
             # at a remote element are in stream.frames awaiting their

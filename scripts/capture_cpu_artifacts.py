@@ -1,0 +1,202 @@
+#!/usr/bin/env python
+"""Capture the CPU-capturable distributed numbers into a committed
+artifact (VERDICT r3 #7): these need no TPU relay window, so they must
+never sit UNVERIFIED.
+
+Sections:
+  multitude_xproc   — the reference's headline scenario: N chained
+                      pipelines in N real OS processes over the
+                      built-in MQTT broker (reference ceiling ~50 Hz,
+                      examples/pipeline/multitude/run_large.sh:8,20).
+  speech_chain_3proc — the speech showcase split across three OS
+                      processes (input+ASR here, chat stage in one
+                      subprocess, TTS+writer in another), timing full
+                      chain round-trips over the broker.
+
+Writes one JSON document (default DISTRIBUTED_r04.json) with UTC
+timestamps and the git revision, so the numbers are auditable.
+
+Run: python scripts/capture_cpu_artifacts.py [--out FILE]
+"""
+
+import argparse
+import json
+import os
+import queue
+import statistics
+import subprocess
+import sys
+import time
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+# The sandbox pins JAX_PLATFORMS=axon via a sitecustomize hook; force
+# CPU before any backend init (conftest.py is the model).  Everything
+# here is control-plane + tiny CPU models — the relay is never touched.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+
+def utc():
+    return time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+
+
+def capture_multitude(pipelines=10, frames=400):
+    from examples.multitude.run_multitude import run_cross_process
+    started = utc()
+    t0 = time.perf_counter()
+    rate = run_cross_process(pipelines, frames)
+    return {
+        "fps": round(rate, 1),
+        "pipelines": pipelines,
+        "frames": frames,
+        "vs_reference_50hz": round(rate / 50.0, 1),
+        "started": started,
+        "elapsed_s": round(time.perf_counter() - t0, 1),
+    }
+
+
+def capture_speech_chain(round_trips=5):
+    from aiko_services_tpu.pipeline import (
+        Pipeline, load_pipeline_definition, parse_pipeline_definition,
+    )
+    from aiko_services_tpu.runtime import (
+        Process, compose_instance, pipeline_args,
+    )
+    from aiko_services_tpu.runtime.event import EventEngine
+    from aiko_services_tpu.transport import MqttBroker
+
+    started = utc()
+    broker = MqttBroker(port=0)
+    namespace = f"speech{broker.port}"
+    children = []
+    engine = None
+    process = None
+    thread = None
+    try:
+        for json_name, registrar in (
+                ("pipeline_speech_llm_chat.json", "1"),
+                ("pipeline_speech_llm_output.json", "0")):
+            env = dict(os.environ,
+                       AIKO_MQTT_HOST=broker.host,
+                       AIKO_MQTT_PORT=str(broker.port),
+                       AIKO_NAMESPACE=namespace,
+                       JAX_PLATFORMS="cpu",
+                       CHILD_REGISTRAR=registrar)
+            child = subprocess.Popen(
+                [sys.executable, "-m", "tests.child_pipeline",
+                 os.path.join("examples", "speech", json_name)],
+                cwd=REPO_ROOT, env=env, stdout=subprocess.PIPE,
+                stderr=subprocess.DEVNULL, text=True)
+            children.append(child)
+            deadline = time.time() + 120
+            while time.time() < deadline:
+                line = child.stdout.readline()
+                if line.strip() == "READY":
+                    break
+            else:
+                raise RuntimeError(f"{json_name} child never READY")
+
+        os.environ["AIKO_MQTT_HOST"] = broker.host
+        os.environ["AIKO_MQTT_PORT"] = str(broker.port)
+        engine = EventEngine()
+        thread = engine.run_in_thread()
+        process = Process(namespace=namespace, engine=engine,
+                          transport="mqtt")
+        deadline = time.time() + 10
+        while time.time() < deadline and not process.message.connected:
+            time.sleep(0.05)
+        definition = load_pipeline_definition(os.path.join(
+            REPO_ROOT, "examples", "speech",
+            "pipeline_speech_llm_input.json"))
+        caller = compose_instance(
+            Pipeline,
+            pipeline_args(definition.name, definition=definition),
+            process=process)
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            if all(caller.remote_proxies.get(name) is not None
+                   for name in ("PE_RemoteChat", "PE_RemoteSpeak")):
+                break
+            time.sleep(0.05)
+        else:
+            raise RuntimeError(
+                f"remote stages never discovered: {caller.remote_proxies}")
+
+        import numpy as np
+        latencies = []
+        for i in range(round_trips):
+            out = queue.Queue()
+            t0 = time.perf_counter()
+            caller.create_stream(f"s{i}", queue_response=out)
+            _, _, outputs = out.get(timeout=120)
+            latencies.append(time.perf_counter() - t0)
+            audio = np.asarray(outputs["audio"])
+            assert audio.size > 0 and np.isfinite(audio).all()
+        return {
+            "round_trips": round_trips,
+            "p50_chain_latency_ms": round(
+                statistics.median(latencies) * 1e3, 1),
+            "first_chain_latency_ms": round(latencies[0] * 1e3, 1),
+            "steady_chains_per_sec": round(
+                1.0 / statistics.median(latencies[1:]), 2)
+            if len(latencies) > 1 else None,
+            "processes": 3,
+            "remote_hops_per_chain": 2,
+            "started": started,
+        }
+    finally:
+        if process is not None:
+            process.terminate()
+        if engine is not None:
+            engine.terminate()
+        if thread is not None:
+            thread.join(timeout=5)
+        for child in children:
+            child.terminate()
+        for child in children:
+            try:
+                child.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                child.kill()
+        broker.stop()
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--out", default="DISTRIBUTED_r04.json")
+    parser.add_argument("--pipelines", type=int, default=10)
+    parser.add_argument("--frames", type=int, default=400)
+    parser.add_argument("--round-trips", type=int, default=5)
+    args = parser.parse_args()
+
+    rev = subprocess.run(["git", "rev-parse", "--short", "HEAD"],
+                         cwd=REPO_ROOT, capture_output=True,
+                         text=True).stdout.strip()
+    doc = {"captured": utc(), "git": rev, "backend": "cpu",
+           "note": "control-plane + tiny CPU models; no TPU involved"}
+    for name, fn, kwargs in (
+            ("multitude_xproc", capture_multitude,
+             dict(pipelines=args.pipelines, frames=args.frames)),
+            ("speech_chain_3proc", capture_speech_chain,
+             dict(round_trips=args.round_trips))):
+        print(f"=== {name} ===", flush=True)
+        try:
+            doc[name] = fn(**kwargs)
+            print(json.dumps(doc[name]), flush=True)
+        except Exception as error:  # noqa: BLE001
+            doc[name] = {"error": repr(error), "at": utc()}
+            print(f"FAILED: {error!r}", flush=True)
+    with open(os.path.join(REPO_ROOT, args.out), "w") as fh:
+        json.dump(doc, fh, indent=1)
+        fh.write("\n")
+    print(f"wrote {args.out}")
+    return 0 if all(
+        "error" not in doc.get(k, {})
+        for k in ("multitude_xproc", "speech_chain_3proc")) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

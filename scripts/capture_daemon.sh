@@ -82,15 +82,22 @@ while :; do
             timeout 3000 python bench.py > "$JSON" 2> "$ERR"
         rc=$?
         say "bench run rc=$rc"
-        commit_paths "Bench window capture ${TS} (rc=${rc})" \
-            "$JSON" "$ERR" "$PART"
-        if full_capture_ok "$JSON"; then
+        # bench.py deletes BENCH_PARTIAL at startup; a run that died
+        # before any section leaves no file and `git add` would fail
+        # on the missing pathspec, losing the JSON + err evidence.
+        [ -f "$PART" ] || : > "$PART"
+        if commit_paths "Bench window capture ${TS} (rc=${rc})" \
+                "$JSON" "$ERR" "$PART" \
+           && full_capture_ok "$JSON"; then
+            # Only declare victory once the artifacts are COMMITTED —
+            # a working-tree-only capture is not banked; keep hunting
+            # so a commit-time failure retries next window.
             say "FULL capture landed: $JSON — daemon done"
             date -u +%FT%TZ > CAPTURE_DONE
             commit_paths "Full bench capture landed (${TS})" CAPTURE_DONE
             exit 0
         fi
-        say "capture partial/empty; continuing to hunt"
+        say "capture partial/empty/uncommitted; continuing to hunt"
     else
         say "probe failed/wedged (rc=$?)"
     fi

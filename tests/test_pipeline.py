@@ -371,6 +371,31 @@ def test_drain_ending_in_drop_frame_still_tears_down(engine):
     assert "dd" not in caller.streams     # dropped tail still tears down
 
 
+def test_user_defined_event_stop_drains_in_flight_frames(engine):
+    """A stop carrying a USER-DEFINED stream event (any value above
+    StreamEvent.USER, which the enum reserves as 'first user-defined
+    event') must behave like a graceful STOP: drain in-flight frames,
+    then tear down.  Previously ``StreamEvent(int(value))`` raised
+    ValueError inside the stop handler (swallowed by the event loop),
+    so the stream was never drained or destroyed (advisor, round 3)."""
+    from aiko_services_tpu.pipeline.stream import StreamState
+    pipeline, _ = make_pipeline(engine, LINEAR, broker="userstop")
+    pipeline.create_stream("u")
+    engine.drain()
+    stream = pipeline.streams["u"]
+    custom_event = int(StreamEvent.USER) + 3
+    # In-flight frame (as if paused at a remote element): the stop must
+    # enter the draining STOP state instead of raising.
+    stream.frames["0"] = object()
+    pipeline._stream_stop_command("u", custom_event)
+    assert stream.state == StreamState.STOP
+    assert "u" in pipeline.streams
+    # Drain complete: the same custom-event stop now destroys it.
+    stream.frames.clear()
+    pipeline._stream_stop_command("u", custom_event)
+    assert "u" not in pipeline.streams
+
+
 def test_frames_park_until_all_elements_started(engine):
     """A generator posting frames while later elements are still starting
     must not have those frames processed early (this lost the first
