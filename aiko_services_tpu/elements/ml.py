@@ -73,23 +73,49 @@ class ASRElement(PipelineElement):
 
     def __init__(self, context, process=None):
         super().__init__(context, process)
-        name, _ = self.get_parameter("model_config", "tiny")
-        self.config = asr_model.CONFIGS[str(name)]
-        seed, _ = self.get_parameter("seed", 0)
-        self.params = asr_model.init_params(
-            self.config, jax.random.PRNGKey(int(seed)))
+        checkpoint, _ = self.get_parameter("checkpoint", None)
+        self._whisper_frontend = bool(checkpoint)
+        if checkpoint:
+            # Trained Whisper weights (HF-layout safetensors) — the
+            # path the reference reaches via WhisperX
+            # (reference examples/speech/speech_elements.py:109).
+            # Real weights also need the REAL feature front end
+            # (slaney mel + Whisper normalization), not the
+            # self-consistent approximation the test models use.
+            from ..tools.import_weights import import_whisper
+            self.params, self.config = import_whisper(str(checkpoint))
+        else:
+            name, _ = self.get_parameter("model_config", "tiny")
+            self.config = asr_model.CONFIGS[str(name)]
+            seed, _ = self.get_parameter("seed", 0)
+            self.params = asr_model.init_params(
+                self.config, jax.random.PRNGKey(int(seed)))
 
     def process_frame(self, stream, audio):
         audio = np.asarray(audio, np.float32)
         if audio.ndim == 1:
             audio = audio[None]
-        mel = asr_model.log_mel_spectrogram(audio, self.config.n_mels)
+        if self._whisper_frontend:
+            mel = asr_model.whisper_log_mel(audio, self.config.n_mels)
+        else:
+            mel = asr_model.log_mel_spectrogram(audio,
+                                                self.config.n_mels)
         features = asr_model.encode(self.params, mel, self.config)
         max_tokens, _ = self.get_parameter("max_tokens", 16,
                                            stream=stream)
-        tokens = asr_model.decode_greedy_cached(
-            self.params, features, self.config,
-            max_tokens=int(max_tokens))
+        if self._whisper_frontend:
+            # Real checkpoints must be conditioned with Whisper's SOT
+            # sequence and stopped on its EOT — the stand-in 1/2
+            # defaults decode garbage against trained weights.
+            tokens = asr_model.decode_greedy_cached(
+                self.params, features, self.config,
+                max_tokens=int(max_tokens),
+                end_token=asr_model.eot_token(self.config),
+                seed=asr_model.sot_sequence(self.config))
+        else:
+            tokens = asr_model.decode_greedy_cached(
+                self.params, features, self.config,
+                max_tokens=int(max_tokens))
         return StreamEvent.OKAY, {"text_tokens": tokens}
 
 

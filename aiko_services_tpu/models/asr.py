@@ -34,6 +34,9 @@ class ASRConfig:
     vocab_size: int = 51_865      # whisper tokenizer size
     n_text_ctx: int = 448
     dtype: Any = jnp.bfloat16
+    #: LayerNorm epsilon.  Randomly-initialised configs keep the
+    #: historical 1e-6; imported Whisper checkpoints use torch's 1e-5.
+    norm_eps: float = 1e-6
 
 
 CONFIGS: Dict[str, ASRConfig] = {
@@ -92,11 +95,13 @@ def init_params(config: ASRConfig, key) -> Dict:
     }
 
 
-from .common import layer_norm as _norm, mha as _mha, gelu_mlp
+from .common import layer_norm as _layer_norm, mha as _mha, gelu_mlp
 
 
-def _mlp(block, x):
-    return gelu_mlp(x, block["norm_mlp"], block["w1"], block["w2"])
+def _mlp(block, x, eps):
+    return gelu_mlp(x, block["norm_mlp"], block["w1"], block["w2"],
+                    norm_bias=block.get("norm_mlp_b"),
+                    b1=block.get("b1"), b2=block.get("b2"), eps=eps)
 
 
 def _sinusoid(length, channels):
@@ -110,58 +115,120 @@ def _sinusoid(length, channels):
     return embedding
 
 
-def _conv1d(x, w, stride):
-    # x: (b, t, c_in), w: (k, c_in, c_out)
-    return jax.lax.conv_general_dilated(
-        x, w, window_strides=(stride,), padding="SAME",
+def _conv1d(x, w, stride, bias=None):
+    # x: (b, t, c_in), w: (k, c_in, c_out).  Explicit symmetric padding
+    # (torch Conv1d padding=1 semantics): under stride 2, "SAME" pads
+    # 0-left/1-right, which shifts every window one sample against a
+    # checkpoint trained with torch's 1/1 — same output length, wrong
+    # alignment (caught by the Whisper differential test).
+    pad = (w.shape[0] - 1) // 2
+    out = jax.lax.conv_general_dilated(
+        x, w, window_strides=(stride,), padding=[(pad, pad)],
         dimension_numbers=("NWC", "WIO", "NWC"),
         preferred_element_type=jnp.float32).astype(x.dtype)
+    return out if bias is None else out + bias
+
+
+def _norm(x, weight, bias=None, eps=1e-6):
+    return _layer_norm(x, weight, eps=eps, bias=bias)
+
+
+def _self_attn(block, normed, n_heads, causal):
+    return _mha(normed, normed, block["wqkv"], block["wo"], n_heads,
+                causal=causal, b_in=block.get("b_qkv"),
+                b_o=block.get("b_o"))
+
+
+def _cross_attn(block, normed, audio_features, n_heads):
+    return _mha(normed, audio_features, block["wq_cross"],
+                block["wo_cross"], n_heads, causal=False, cross=True,
+                wkv=block["wkv_cross"], b_in=block.get("b_q_cross"),
+                b_o=block.get("b_o_cross"), b_kv=block.get("b_kv_cross"))
 
 
 @functools.partial(jax.jit, static_argnames=("config",))
 def encode(params, mel, config: ASRConfig):
     """mel (batch, frames, n_mels) → audio features
     (batch, frames//2, d_model)."""
-    x = jax.nn.gelu(_conv1d(mel.astype(config.dtype), params["conv1"], 1)
-                    .astype(jnp.float32)).astype(config.dtype)
-    x = jax.nn.gelu(_conv1d(x, params["conv2"], 2)
-                    .astype(jnp.float32)).astype(config.dtype)
-    positions = _sinusoid(x.shape[1], config.d_model)
+    eps = config.norm_eps
+    x = jax.nn.gelu(_conv1d(mel.astype(config.dtype), params["conv1"], 1,
+                            params.get("conv1_b"))
+                    .astype(jnp.float32),
+                    approximate=False).astype(config.dtype)
+    x = jax.nn.gelu(_conv1d(x, params["conv2"], 2, params.get("conv2_b"))
+                    .astype(jnp.float32),
+                    approximate=False).astype(config.dtype)
+    if "enc_pos_embed" in params:
+        # Imported checkpoints carry the encoder position table
+        # (Whisper stores sin/cos as concatenated halves, not
+        # interleaved like :func:`_sinusoid`).
+        positions = params["enc_pos_embed"][:x.shape[1]]
+    else:
+        positions = _sinusoid(x.shape[1], config.d_model)
     x = x + positions[None].astype(x.dtype)
     for block in params["encoder_layers"]:
-        normed = _norm(x, block["norm1"])
-        x = x + _mha(normed, normed, block["wqkv"], block["wo"],
-                     config.n_heads, causal=False)
-        x = _mlp(block, x)
-    return _norm(x, params["encoder_norm"])
+        normed = _norm(x, block["norm1"], block.get("norm1_b"), eps)
+        x = x + _self_attn(block, normed, config.n_heads, causal=False)
+        x = _mlp(block, x, eps)
+    return _norm(x, params["encoder_norm"],
+                 params.get("encoder_norm_b"), eps)
 
 
 def _decoder_step(params, tokens, audio_features, config: ASRConfig):
     """Full-sequence decoder (teacher-forced or re-run per step)."""
     b, t = tokens.shape
+    eps = config.norm_eps
     x = params["token_embed"][tokens] + params["pos_embed"][:t][None]
     for block in params["decoder_layers"]:
-        normed = _norm(x, block["norm1"])
-        x = x + _mha(normed, normed, block["wqkv"], block["wo"],
-                     config.n_heads, causal=True)
-        normed = _norm(x, block["norm_cross"])
-        x = x + _mha(normed, audio_features, block["wq_cross"],
-                     block["wo_cross"], config.n_heads, causal=False,
-                     cross=True, wkv=block["wkv_cross"])
-        x = _mlp(block, x)
-    x = _norm(x, params["decoder_norm"])
+        normed = _norm(x, block["norm1"], block.get("norm1_b"), eps)
+        x = x + _self_attn(block, normed, config.n_heads, causal=True)
+        normed = _norm(x, block["norm_cross"],
+                       block.get("norm_cross_b"), eps)
+        x = x + _cross_attn(block, normed, audio_features,
+                            config.n_heads)
+        x = _mlp(block, x, eps)
+    x = _norm(x, params["decoder_norm"], params.get("decoder_norm_b"),
+              eps)
     return (x @ params["token_embed"].T).astype(jnp.float32)
 
 
-@functools.partial(jax.jit, static_argnames=("config", "max_tokens"))
+def sot_sequence(config: ASRConfig) -> Tuple[int, ...]:
+    """Whisper's start-of-transcript conditioning for imported
+    checkpoints, derived from the vocab size: multilingual (51865) =
+    <|startoftranscript|><|en|><|transcribe|><|notimestamps|>;
+    English-only (51864) = <|startoftranscript|><|notimestamps|>.
+    Random-init test configs keep the plain (start_token,) seed."""
+    if config.vocab_size == 51_865:
+        return (50_258, 50_259, 50_359, 50_363)
+    if config.vocab_size == 51_864:
+        return (50_257, 50_362)
+    return ()
+
+
+def eot_token(config: ASRConfig, default: int = 2) -> int:
+    if config.vocab_size == 51_865:
+        return 50_257
+    if config.vocab_size == 51_864:
+        return 50_256
+    return default
+
+
+@functools.partial(jax.jit, static_argnames=("config", "max_tokens",
+                                             "seed"))
 def decode_greedy(params, audio_features, config: ASRConfig,
                   max_tokens: int = 32, start_token: int = 1,
-                  end_token: int = 2):
+                  end_token: int = 2, seed: Tuple[int, ...] = ()):
     """Greedy transcription as one compiled program: fixed-length scan
-    with an is-done latch (XLA-friendly static shapes)."""
+    with an is-done latch (XLA-friendly static shapes).  ``seed``
+    (static tuple) forces the first tokens — Whisper's SOT conditioning
+    sequence (:func:`sot_sequence`); empty keeps the single
+    ``start_token`` seed."""
     batch = audio_features.shape[0]
+    if seed:
+        start_token = seed[0]
     tokens = jnp.full((batch, max_tokens + 1), end_token, jnp.int32)
     tokens = tokens.at[:, 0].set(start_token)
+    forced = jnp.asarray(list(seed[1:]) + [-1], jnp.int32)
 
     def body(carry, step):
         tokens, done = carry
@@ -169,6 +236,10 @@ def decode_greedy(params, audio_features, config: ASRConfig,
                                audio_features, config)
         next_token = logits[jnp.arange(batch), step].argmax(-1) \
             .astype(jnp.int32)
+        if seed:
+            force = forced[jnp.minimum(step, len(seed) - 1)]
+            next_token = jnp.where(step < len(seed) - 1, force,
+                                   next_token)
         next_token = jnp.where(done, end_token, next_token)
         done = done | (next_token == end_token)
         tokens = tokens.at[:, step + 1].set(next_token)
@@ -180,10 +251,11 @@ def decode_greedy(params, audio_features, config: ASRConfig,
     return tokens
 
 
-@functools.partial(jax.jit, static_argnames=("config", "max_tokens"))
+@functools.partial(jax.jit, static_argnames=("config", "max_tokens",
+                                             "seed"))
 def decode_greedy_cached(params, audio_features, config: ASRConfig,
                          max_tokens: int = 32, start_token: int = 1,
-                         end_token: int = 2):
+                         end_token: int = 2, seed: Tuple[int, ...] = ()):
     """KV-cached greedy transcription: same outputs as
     :func:`decode_greedy` (tested), O(T) instead of O(T²) decoder work.
 
@@ -198,11 +270,16 @@ def decode_greedy_cached(params, audio_features, config: ASRConfig,
     scale = hd ** -0.5
     dt = config.dtype
 
+    eps = config.norm_eps
+
+    def _add(x, bias):
+        return x if bias is None else x + bias
+
     # Per-layer fixed cross K/V.
     cross_kv = []
     for block in params["decoder_layers"]:
-        kv = (audio_features @ block["wkv_cross"]).reshape(
-            batch, -1, 2, h, hd)
+        kv = _add(audio_features @ block["wkv_cross"],
+                  block.get("b_kv_cross")).reshape(batch, -1, 2, h, hd)
         cross_kv.append({"k": kv[:, :, 0], "v": kv[:, :, 1]})
     self_cache = [{"k": jnp.zeros((batch, max_tokens, h, hd), dt),
                    "v": jnp.zeros((batch, max_tokens, h, hd), dt)}
@@ -235,28 +312,40 @@ def decode_greedy_cached(params, audio_features, config: ASRConfig,
         new_caches = []
         for block, cache, fixed in zip(params["decoder_layers"], caches,
                                        cross_kv):
-            normed = _norm(x, block["norm1"])
-            qkv = (normed @ block["wqkv"]).reshape(batch, 1, 3, h, hd)
+            normed = _norm(x, block["norm1"], block.get("norm1_b"), eps)
+            qkv = _add(normed @ block["wqkv"], block.get("b_qkv")) \
+                .reshape(batch, 1, 3, h, hd)
             q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
             k_cache = jax.lax.dynamic_update_slice(
                 cache["k"], k.astype(dt), (0, step, 0, 0))
             v_cache = jax.lax.dynamic_update_slice(
                 cache["v"], v.astype(dt), (0, step, 0, 0))
             new_caches.append({"k": k_cache, "v": v_cache})
-            x = x + (attend(q, k_cache, v_cache, step)
-                     @ block["wo"]).astype(dt)
-            normed = _norm(x, block["norm_cross"])
-            qc = (normed @ block["wq_cross"]).reshape(batch, 1, h, hd)
-            x = x + (attend(qc, fixed["k"], fixed["v"])
-                     @ block["wo_cross"]).astype(dt)
-            x = _mlp(block, x)
-        x = _norm(x, params["decoder_norm"])
+            x = x + _add(attend(q, k_cache, v_cache, step)
+                         @ block["wo"], block.get("b_o")).astype(dt)
+            normed = _norm(x, block["norm_cross"],
+                           block.get("norm_cross_b"), eps)
+            qc = _add(normed @ block["wq_cross"],
+                      block.get("b_q_cross")).reshape(batch, 1, h, hd)
+            x = x + _add(attend(qc, fixed["k"], fixed["v"])
+                         @ block["wo_cross"],
+                         block.get("b_o_cross")).astype(dt)
+            x = _mlp(block, x, eps)
+        x = _norm(x, params["decoder_norm"],
+                  params.get("decoder_norm_b"), eps)
         logits = (x[:, 0] @ params["token_embed"].T).astype(jnp.float32)
         next_token = logits.argmax(-1).astype(jnp.int32)
+        if seed:
+            force = forced[jnp.minimum(step, len(seed) - 1)]
+            next_token = jnp.where(step < len(seed) - 1, force,
+                                   next_token)
         next_token = jnp.where(done, end_token, next_token)
         done = done | (next_token == end_token)
         return (next_token, done, new_caches), next_token
 
+    if seed:
+        start_token = seed[0]
+    forced = jnp.asarray(list(seed[1:]) + [-1], jnp.int32)
     start = jnp.full((batch,), start_token, jnp.int32)
     (_, _, _), generated = jax.lax.scan(
         body, (start, jnp.zeros((batch,), bool), self_cache),
@@ -264,6 +353,87 @@ def decode_greedy_cached(params, audio_features, config: ASRConfig,
     tokens = jnp.concatenate(
         [start[:, None], generated.T.astype(jnp.int32)], axis=1)
     return tokens
+
+
+def _hz_to_mel_slaney(freq):
+    """Slaney-scale mel (librosa htk=False): linear below 1 kHz, log
+    spaced above — the scale Whisper's filterbank is built with."""
+    import numpy as np
+    freq = np.asarray(freq, np.float64)
+    linear = freq / (200.0 / 3)
+    min_log_hz = 1000.0
+    min_log_mel = min_log_hz / (200.0 / 3)
+    logstep = np.log(6.4) / 27.0
+    return np.where(freq >= min_log_hz,
+                    min_log_mel + np.log(np.maximum(freq, 1e-10)
+                                         / min_log_hz) / logstep,
+                    linear)
+
+
+def _mel_to_hz_slaney(mels):
+    import numpy as np
+    mels = np.asarray(mels, np.float64)
+    freq = mels * (200.0 / 3)
+    min_log_hz = 1000.0
+    min_log_mel = min_log_hz / (200.0 / 3)
+    logstep = np.log(6.4) / 27.0
+    return np.where(mels >= min_log_mel,
+                    min_log_hz * np.exp(logstep * (mels - min_log_mel)),
+                    freq)
+
+
+@functools.lru_cache(maxsize=8)
+def mel_filterbank(n_mels: int = 80, n_fft: int = 400,
+                   sample_rate: int = 16_000):
+    """Slaney-normalized triangular mel filterbank (n_mels, n_fft//2+1)
+    — numerically the librosa/Whisper matrix."""
+    import numpy as np
+    fft_freqs = np.linspace(0, sample_rate / 2, 1 + n_fft // 2)
+    mel_points = _mel_to_hz_slaney(
+        np.linspace(_hz_to_mel_slaney(0.0),
+                    _hz_to_mel_slaney(sample_rate / 2), n_mels + 2))
+    fdiff = np.diff(mel_points)
+    ramps = mel_points[:, None] - fft_freqs[None, :]
+    lower = -ramps[:-2] / fdiff[:-1][:, None]
+    upper = ramps[2:] / fdiff[1:][:, None]
+    weights = np.maximum(0.0, np.minimum(lower, upper))
+    enorm = 2.0 / (mel_points[2:n_mels + 2] - mel_points[:n_mels])
+    weights *= enorm[:, None]
+    return weights.astype(np.float32)
+
+
+def whisper_log_mel(audio, n_mels: int = 80, hop: int = 160,
+                    n_fft: int = 400, pad_to_samples: int = 480_000):
+    """Whisper's exact feature front end: reflect-centered STFT
+    (periodic Hann), power spectrum, slaney mel, ``log10`` with an
+    8-dB dynamic-range floor, ``(x+4)/4`` scaling.  waveform
+    (batch, samples) @16 kHz → (batch, frames, n_mels); validated
+    against ``transformers.WhisperFeatureExtractor`` differentially.
+
+    Imported checkpoints must run through THIS front end —
+    :func:`log_mel_spectrogram` below is a self-consistent
+    approximation for the random-init test models only."""
+    audio = jnp.asarray(audio, jnp.float32)
+    if audio.ndim == 1:
+        audio = audio[None]
+    if pad_to_samples:
+        take = min(audio.shape[-1], pad_to_samples)
+        audio = jnp.pad(audio[:, :take],
+                        ((0, 0), (0, pad_to_samples - take)))
+    half = n_fft // 2
+    audio = jnp.pad(audio, ((0, 0), (half, half)), mode="reflect")
+    n_frames = 1 + (audio.shape[-1] - n_fft) // hop
+    idx = jnp.arange(n_fft)[None, :] + hop * jnp.arange(n_frames)[:, None]
+    window = 0.5 * (1.0 - jnp.cos(
+        2.0 * jnp.pi * jnp.arange(n_fft) / n_fft))     # periodic Hann
+    frames = audio[..., idx] * window
+    spectrum = jnp.abs(jnp.fft.rfft(frames, axis=-1)) ** 2
+    spectrum = spectrum[..., :-1, :]                   # drop last frame
+    mel = spectrum @ mel_filterbank(n_mels, n_fft).T
+    log_spec = jnp.log10(jnp.maximum(mel, 1e-10))
+    log_spec = jnp.maximum(
+        log_spec, jnp.max(log_spec, axis=(-2, -1), keepdims=True) - 8.0)
+    return (log_spec + 4.0) / 4.0
 
 
 def log_mel_spectrogram(audio, n_mels: int, hop: int = 160,

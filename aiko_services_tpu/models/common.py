@@ -18,40 +18,54 @@ __all__ = ["layer_norm", "mha", "gelu_mlp"]
 LN_EPS = 1e-6
 
 
-def layer_norm(x, weight, eps: float = LN_EPS):
+def layer_norm(x, weight, eps: float = LN_EPS, bias=None):
     x32 = x.astype(jnp.float32)
     mean = jnp.mean(x32, axis=-1, keepdims=True)
     var = jnp.var(x32, axis=-1, keepdims=True)
-    return ((x32 - mean) * jax.lax.rsqrt(var + eps)).astype(x.dtype) \
+    out = ((x32 - mean) * jax.lax.rsqrt(var + eps)).astype(x.dtype) \
         * weight
+    return out if bias is None else out + bias
+
+
+def _add(x, bias):
+    return x if bias is None else x + bias
 
 
 def mha(x_q, x_kv, w_in, wo, n_heads: int, causal: bool,
-        cross: bool = False, wkv=None):
+        cross: bool = False, wkv=None, b_in=None, b_o=None, b_kv=None):
     """Fused-projection multi-head attention.
 
     Self-attention: ``w_in`` is the (d, 3d) qkv projection and ``x_kv``
     is ignored.  Cross-attention (``cross=True``): ``w_in`` is the
     (d, d) q projection and ``wkv`` the (d_kv, 2d) kv projection over
-    ``x_kv``.
+    ``x_kv``.  Biases are optional (randomly-initialised models omit
+    them; imported checkpoints — Whisper layout — carry them).
     """
     b, q_len, d = x_q.shape
     hd = d // n_heads
     if cross:
-        q = (x_q @ w_in).reshape(b, q_len, n_heads, hd)
-        kv = (x_kv @ wkv).reshape(b, x_kv.shape[1], 2, n_heads, hd)
+        q = _add(x_q @ w_in, b_in).reshape(b, q_len, n_heads, hd)
+        kv = _add(x_kv @ wkv, b_kv).reshape(
+            b, x_kv.shape[1], 2, n_heads, hd)
         k, v = kv[:, :, 0], kv[:, :, 1]
     else:
-        qkv = (x_q @ w_in).reshape(b, q_len, 3, n_heads, hd)
+        qkv = _add(x_q @ w_in, b_in).reshape(b, q_len, 3, n_heads, hd)
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
     out = attention_reference(
         q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
         v.transpose(0, 2, 1, 3), causal=causal)
     out = out.transpose(0, 2, 1, 3).reshape(b, q_len, d)
-    return (out @ wo).astype(x_q.dtype)
+    return _add(out @ wo, b_o).astype(x_q.dtype)
 
 
-def gelu_mlp(x, norm_weight, w1, w2):
-    normed = layer_norm(x, norm_weight)
-    return x + (jax.nn.gelu((normed @ w1).astype(jnp.float32))
-                .astype(x.dtype) @ w2)
+def gelu_mlp(x, norm_weight, w1, w2, norm_bias=None, b1=None, b2=None,
+             eps: float = LN_EPS):
+    normed = layer_norm(x, norm_weight, eps=eps, bias=norm_bias)
+    # Exact (erf) GELU: what torch nn.GELU() computes — BERT-family,
+    # ViT and Whisper checkpoints are all trained with it, and the
+    # tanh approximation drifts ~3e-3 per activation, enough to break
+    # differential tests against imported weights.
+    return x + _add(
+        jax.nn.gelu(_add(normed @ w1, b1).astype(jnp.float32),
+                    approximate=False)
+        .astype(x.dtype) @ w2, b2)

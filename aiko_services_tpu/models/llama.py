@@ -37,7 +37,7 @@ __all__ = ["LlamaConfig", "init_params", "forward",
            "decode_chunk_ragged", "prefill_chunk", "sample_logits",
            "init_paged_cache", "decode_chunk_paged",
            "paged_insert_prefix", "paged_scatter_blocks",
-           "paged_gather_blocks", "CONFIGS"]
+           "paged_gather_blocks", "complete", "CONFIGS"]
 
 
 @dataclasses.dataclass(frozen=True)
@@ -1304,3 +1304,49 @@ def pipeline_forward(params, tokens, config: LlamaConfig, mesh,
                                n_microbatches=n_microbatches)
     x = rms_norm(x, params["final_norm"], config.norm_eps)
     return _matmul(x, params["lm_head"]).astype(jnp.float32)
+
+
+def complete(params, prompt_tokens, config: LlamaConfig,
+             max_new_tokens: int = 32, temperature: float = 0.0,
+             rng_key=None, top_k: int = 0, top_p=None,
+             eos_token: Optional[int] = None, quantize_kv: bool = False):
+    """Convenience end-to-end completion: prefill + one-scan decode.
+
+    ``prompt_tokens`` (batch, prompt_len) int32 → (batch, <=max_new)
+    numpy array of generated token ids (prompt excluded), truncated at
+    the first ``eos_token`` per row when given.  This is the API the
+    chat elements and the golden-completion tests use against imported
+    checkpoints; serving paths keep the explicit prefill/decode calls.
+    """
+    import numpy as np
+    tokens = jnp.asarray(prompt_tokens, jnp.int32)
+    batch, prompt_len = tokens.shape
+    cache = init_cache(config, batch, prompt_len + max_new_tokens,
+                       quantize_kv=quantize_kv)
+    logits, cache = prefill(params, tokens, cache, config)
+    last = logits[:, -1]
+    if temperature and temperature > 0:
+        if rng_key is None:
+            rng_key = jax.random.PRNGKey(0)
+        rng_key, first_key = jax.random.split(rng_key)
+        first = sample_logits(last, first_key, temperature,
+                              top_k=top_k, top_p=top_p)[:, None]
+    else:
+        first = last.argmax(-1).astype(jnp.int32)[:, None]
+    generated, _ = generate_tokens(
+        params, first, cache, jnp.int32(prompt_len),
+        max_new_tokens - 1, config, temperature=temperature,
+        rng_key=rng_key, top_k=top_k, top_p=top_p)
+    out = np.concatenate([np.asarray(first), np.asarray(generated)],
+                         axis=1)
+    if eos_token is not None:
+        rows = []
+        for row in out:
+            hits = np.nonzero(row == eos_token)[0]
+            rows.append(row[:hits[0]] if hits.size else row)
+        width = max((len(r) for r in rows), default=0)
+        padded = np.full((len(rows), width), eos_token, out.dtype)
+        for i, row in enumerate(rows):
+            padded[i, :len(row)] = row
+        return padded
+    return out

@@ -1,0 +1,289 @@
+"""Byte-level BPE tokenizer — self-contained, no network, no deps.
+
+The reference's LLM / ASR examples lean on external runtimes (Ollama,
+WhisperX) whose tokenizers arrive with the model
+(reference examples/llm/elements_llm.py:191-220,
+examples/speech/speech_elements.py:109).  Here the tokenizer is part of
+the framework: a pure-Python byte-level BPE engine that loads the two
+formats real checkpoints ship with —
+
+- **HF ``tokenizer.json``** (GPT-2, Whisper, Llama-3 style): BPE vocab
+  + merges, byte-level pre-tokenization, added/special tokens.
+- **tiktoken ``tokenizer.model``** (Meta's Llama-3 distribution):
+  ``base64(token) rank`` lines; merge ranks are implicit in the ids.
+
+Internals are bytes-first: every vocab entry is a ``bytes`` key, so
+both formats share one BPE engine; HF's printable byte-alias alphabet
+(the GPT-2 ``bytes_to_unicode`` table) is translated at load time.
+
+Correctness is enforced differentially in
+``tests/test_tokenizer.py``: encodings must match the HF ``tokenizers``
+runtime token-for-token on every fixture where that library is
+available.
+"""
+
+from __future__ import annotations
+
+import base64
+import functools
+import json
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+try:                                    # transformers dependency, in image
+    import regex as _regex
+except ImportError:                     # pragma: no cover - regex is baked in
+    _regex = None
+
+__all__ = ["Tokenizer", "GPT2_PATTERN", "LLAMA3_PATTERN"]
+
+#: GPT-2's pre-tokenization split (also Whisper's).  Requires the
+#: ``regex`` module for \p classes and lookahead.
+GPT2_PATTERN = (r"'s|'t|'re|'ve|'m|'ll|'d| ?\p{L}+| ?\p{N}+"
+                r"| ?[^\s\p{L}\p{N}]+|\s+(?!\S)|\s+")
+
+#: Llama-3's split (tiktoken cl100k-family).
+LLAMA3_PATTERN = (r"(?i:'s|'t|'re|'ve|'m|'ll|'d)"
+                  r"|[^\r\n\p{L}\p{N}]?\p{L}+|\p{N}{1,3}"
+                  r"| ?[^\s\p{L}\p{N}]+[\r\n]*|\s*[\r\n]+|\s+(?!\S)|\s+")
+
+
+@functools.lru_cache(maxsize=None)
+def _bytes_to_unicode() -> Dict[int, str]:
+    """GPT-2's printable alias for every byte value: bytes that are
+    printable-and-not-space map to themselves, the rest to U+0100+n.
+    This is the alphabet HF byte-level BPE vocab files are written in."""
+    printable = (list(range(ord("!"), ord("~") + 1))
+                 + list(range(0xA1, 0xAD)) + list(range(0xAE, 0x100)))
+    mapping = {}
+    n = 0
+    for b in range(256):
+        if b in printable:
+            mapping[b] = chr(b)
+        else:
+            mapping[b] = chr(0x100 + n)
+            n += 1
+    return mapping
+
+
+@functools.lru_cache(maxsize=None)
+def _unicode_to_bytes() -> Dict[str, int]:
+    return {c: b for b, c in _bytes_to_unicode().items()}
+
+
+def _alias_to_bytes(token: str) -> bytes:
+    """HF vocab entry (byte-alias alphabet) → raw bytes."""
+    table = _unicode_to_bytes()
+    return bytes(table[ch] for ch in token)
+
+
+class Tokenizer:
+    """Byte-level BPE encode/decode over a bytes-keyed vocab.
+
+    Parameters
+    ----------
+    vocab: ``bytes -> id`` for ordinary tokens.
+    merge_ranks: ``(left, right) -> rank`` pair priorities.  When
+        absent (tiktoken checkpoints), ranks fall back to the vocab id
+        of the concatenation — exactly tiktoken's rule.
+    special_tokens: ``str -> id``; matched verbatim before the split
+        regex, never byte-merged.
+    pattern: pre-tokenization regex (``regex`` syntax).
+    """
+
+    def __init__(self, vocab: Dict[bytes, int],
+                 merge_ranks: Optional[Dict[Tuple[bytes, bytes], int]]
+                 = None,
+                 special_tokens: Optional[Dict[str, int]] = None,
+                 pattern: str = GPT2_PATTERN):
+        if _regex is None:               # pragma: no cover
+            raise RuntimeError("the 'regex' module is required")
+        self.vocab = dict(vocab)
+        self.merge_ranks = dict(merge_ranks or {})
+        self.special_tokens = dict(special_tokens or {})
+        self.pattern = pattern
+        self._compiled = _regex.compile(pattern)
+        self._id_to_bytes: Dict[int, bytes] = {
+            i: b for b, i in self.vocab.items()}
+        self._id_to_special: Dict[int, str] = {
+            i: s for s, i in self.special_tokens.items()}
+        self._special_split = None
+        if self.special_tokens:
+            alternation = "|".join(
+                _regex.escape(s) for s in
+                sorted(self.special_tokens, key=len, reverse=True))
+            self._special_split = _regex.compile(f"({alternation})")
+
+    # ---------------------------------------------------------------- load
+
+    @classmethod
+    def from_file(cls, path: str) -> "Tokenizer":
+        """Sniff the format: HF ``tokenizer.json`` or tiktoken ranks."""
+        with open(path, "rb") as fh:
+            head = fh.read(64)
+        if head.lstrip().startswith(b"{"):
+            return cls.from_hf_json(path)
+        return cls.from_tiktoken(path)
+
+    @classmethod
+    def from_hf_json(cls, path: str) -> "Tokenizer":
+        with open(path, encoding="utf-8") as fh:
+            doc = json.load(fh)
+        model = doc.get("model", {})
+        if model.get("type") != "BPE":
+            raise ValueError(f"unsupported tokenizer model: "
+                             f"{model.get('type')!r}")
+        vocab = {_alias_to_bytes(tok): i
+                 for tok, i in model["vocab"].items()}
+        merge_ranks = {}
+        for rank, merge in enumerate(model.get("merges", [])):
+            if isinstance(merge, str):       # "left right"
+                left, right = merge.split(" ", 1)
+            else:                            # ["left", "right"]
+                left, right = merge
+            merge_ranks[(_alias_to_bytes(left),
+                         _alias_to_bytes(right))] = rank
+        special = {}
+        for added in doc.get("added_tokens", []):
+            special[added["content"]] = added["id"]
+        pattern = _extract_pattern(doc) or GPT2_PATTERN
+        return cls(vocab, merge_ranks, special, pattern)
+
+    @classmethod
+    def from_tiktoken(cls, path: str,
+                      special_tokens: Optional[Dict[str, int]] = None,
+                      pattern: str = LLAMA3_PATTERN) -> "Tokenizer":
+        """Meta Llama-3 ``tokenizer.model``: ``base64(token) rank``
+        lines; merge priority is the concatenation's vocab rank.  The
+        Llama-3 reserved specials (<|begin_of_text|> …) are appended
+        after the base vocab when none are given — their standard ids."""
+        vocab: Dict[bytes, int] = {}
+        with open(path, encoding="utf-8") as fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                token_b64, rank = line.split()
+                vocab[base64.b64decode(token_b64)] = int(rank)
+        if special_tokens is None:
+            base = len(vocab)
+            names = ["<|begin_of_text|>", "<|end_of_text|>",
+                     "<|reserved_special_token_0|>",
+                     "<|reserved_special_token_1|>",
+                     "<|finetune_right_pad_id|>",
+                     "<|step_id|>", "<|start_header_id|>",
+                     "<|end_header_id|>", "<|eom_id|>", "<|eot_id|>",
+                     "<|python_tag|>"]
+            names += [f"<|reserved_special_token_{i}|>"
+                      for i in range(2, 256 - len(names) + 2)]
+            special_tokens = {name: base + i
+                              for i, name in enumerate(names[:256])}
+        return cls(vocab, None, special_tokens, pattern)
+
+    # -------------------------------------------------------------- encode
+
+    def _pair_rank(self, left: bytes, right: bytes) -> Optional[int]:
+        if self.merge_ranks:
+            return self.merge_ranks.get((left, right))
+        return self.vocab.get(left + right)     # tiktoken rule
+
+    def _bpe(self, word: bytes) -> List[int]:
+        parts: List[bytes] = [word[i:i + 1] for i in range(len(word))]
+        while len(parts) > 1:
+            best_rank = None
+            best_i = -1
+            for i in range(len(parts) - 1):
+                rank = self._pair_rank(parts[i], parts[i + 1])
+                if rank is not None and (best_rank is None
+                                         or rank < best_rank):
+                    best_rank, best_i = rank, i
+            if best_rank is None:
+                break
+            parts[best_i:best_i + 2] = [parts[best_i]
+                                        + parts[best_i + 1]]
+        out = []
+        for part in parts:
+            token_id = self.vocab.get(part)
+            if token_id is None:
+                # Unmergeable byte with no vocab entry: byte fallback
+                # ids if present, else skip (matches HF's byte-level
+                # guarantee that single bytes are always in vocab).
+                for byte in part:
+                    byte_id = self.vocab.get(bytes([byte]))
+                    if byte_id is not None:
+                        out.append(byte_id)
+                continue
+            out.append(token_id)
+        return out
+
+    def encode_ordinary(self, text: str) -> List[int]:
+        """Encode with NO special-token recognition."""
+        ids: List[int] = []
+        for piece in self._compiled.findall(text):
+            ids.extend(self._bpe(piece.encode("utf-8")))
+        return ids
+
+    def encode(self, text: str, allow_special: bool = True) -> List[int]:
+        if not allow_special or self._special_split is None:
+            return self.encode_ordinary(text)
+        ids: List[int] = []
+        for chunk in self._special_split.split(text):
+            if not chunk:
+                continue
+            if chunk in self.special_tokens:
+                ids.append(self.special_tokens[chunk])
+            else:
+                ids.extend(self.encode_ordinary(chunk))
+        return ids
+
+    # -------------------------------------------------------------- decode
+
+    def decode(self, ids: Iterable[int],
+               skip_special: bool = False) -> str:
+        out: List[bytes] = []
+        for i in ids:
+            i = int(i)
+            if i in self._id_to_special:
+                if not skip_special:
+                    out.append(self._id_to_special[i].encode("utf-8"))
+            elif i in self._id_to_bytes:
+                out.append(self._id_to_bytes[i])
+        return b"".join(out).decode("utf-8", errors="replace")
+
+    # --------------------------------------------------------------- misc
+
+    @property
+    def vocab_size(self) -> int:
+        top = max(
+            [max(self.vocab.values(), default=-1)]
+            + [max(self.special_tokens.values(), default=-1)])
+        return top + 1
+
+    def token_id(self, special: str) -> int:
+        return self.special_tokens[special]
+
+
+def _extract_pattern(doc) -> Optional[str]:
+    """Pull the split regex out of a tokenizer.json pre_tokenizer
+    (possibly nested in a Sequence).  ByteLevel with use_regex=True
+    means the GPT-2 pattern."""
+    pre = doc.get("pre_tokenizer") or {}
+
+    def walk(node):
+        if not isinstance(node, dict):
+            return None
+        kind = node.get("type")
+        if kind == "Sequence":
+            for sub in node.get("pretokenizers", []):
+                found = walk(sub)
+                if found:
+                    return found
+        if kind == "Split":
+            pattern = node.get("pattern", {})
+            if isinstance(pattern, dict):
+                return pattern.get("Regex") or pattern.get("String")
+            return pattern
+        if kind == "ByteLevel" and node.get("use_regex", True):
+            return GPT2_PATTERN
+        return None
+
+    return walk(pre)

@@ -149,19 +149,30 @@ def _coerce_request(inputs: Dict, config, default_new: int):
 
 def make_llama_infer(config_name: str = "tiny", quantize: bool = False,
                      max_new_tokens: int = 16, seed: int = 0,
-                     quantize_kv: bool = False) -> Callable:
+                     quantize_kv: bool = False,
+                     checkpoint: str = None) -> Callable:
     """Build a ModelReplica ``infer`` callable running the flagship
     Llama-architecture model: ``{"tokens": (batch, prompt)}`` →
-    ``{"tokens_out": (batch, prompt+new)}``."""
+    ``{"tokens_out": (batch, prompt+new)}``.
+
+    ``checkpoint``: HF-layout safetensors path — serve TRAINED weights
+    (config comes from its config.json; ``quantize`` applies on the
+    fly).  Without it, random-init params under the named config (the
+    shape/perf harness mode)."""
     import jax
     import jax.numpy as jnp
     import numpy as np
     from ..models import llama
 
-    config = llama.CONFIGS[config_name]
-    params = llama.init_params(config, jax.random.PRNGKey(seed))
-    if quantize:
-        params = llama.quantize_params(params)
+    if checkpoint:
+        from ..tools.import_weights import import_llama
+        params, config = import_llama(
+            checkpoint, bits=8 if quantize else None)
+    else:
+        config = llama.CONFIGS[config_name]
+        params = llama.init_params(config, jax.random.PRNGKey(seed))
+        if quantize:
+            params = llama.quantize_params(params)
 
     def infer(inputs: Dict) -> Dict:
         request = _coerce_request(inputs, config, max_new_tokens)

@@ -157,15 +157,47 @@ class PE_LLM(PipelineElement):
         import jax
         from aiko_services_tpu.models import llama
         self._llama = llama
-        name, _ = self.get_parameter("model_config", "tiny")
-        self.config = llama.CONFIGS[str(name)]
-        seed, _ = self.get_parameter("seed", 0)
-        self.params = llama.init_params(self.config,
-                                        jax.random.PRNGKey(int(seed)))
+        self._tokenizer = None
+        checkpoint, _ = self.get_parameter("checkpoint", None)
+        if checkpoint:
+            # Trained weights: HF-layout safetensors via the importer
+            # (the reference's examples serve trained models through
+            # Ollama; here the weights load into the native pytree).
+            from aiko_services_tpu.tools.import_weights import (
+                import_llama,
+            )
+            bits, _ = self.get_parameter("quantize_bits", 8)
+            bits = int(bits)
+            self.params, self.config = import_llama(
+                str(checkpoint), bits=bits if bits in (4, 8) else None)
+        else:
+            name, _ = self.get_parameter("model_config", "tiny")
+            self.config = llama.CONFIGS[str(name)]
+            seed, _ = self.get_parameter("seed", 0)
+            self.params = llama.init_params(
+                self.config, jax.random.PRNGKey(int(seed)))
+        tokenizer_path, _ = self.get_parameter("tokenizer", None)
+        if tokenizer_path:
+            from aiko_services_tpu.models.tokenizer import Tokenizer
+            self._tokenizer = Tokenizer.from_file(str(tokenizer_path))
+            if self._tokenizer.vocab_size > self.config.vocab_size:
+                # JAX gathers clamp out-of-range ids silently; a
+                # mismatched tokenizer would produce nonsense rather
+                # than an error, so refuse loudly here.
+                raise ValueError(
+                    f"tokenizer id space ({self._tokenizer.vocab_size})"
+                    f" exceeds model vocab ({self.config.vocab_size})")
         self._detections = []
         constrained, _ = self.get_parameter("constrained", False)
         self._automaton = None
         if str(constrained).lower() in ("1", "true", "yes"):
+            if self._tokenizer is not None:
+                # The command DFA is byte-level: token id == byte value.
+                # A learned-BPE id space breaks that bijection, so the
+                # combination is refused loudly rather than mis-decoded.
+                raise ValueError(
+                    "constrained=True requires the byte-level stand-in "
+                    "tokenizer, not a learned-BPE tokenizer file")
             import jax.numpy as jnp
             self._automaton = build_command_automaton(
                 self.config.vocab_size)
@@ -187,7 +219,15 @@ class PE_LLM(PipelineElement):
         scene = (f"Scene: {' '.join(self._detections)}\n"
                  if self._detections else "")
         prompt = f"{SYSTEM_PROMPT}\n{scene}user: {text}\nassistant: "
-        tokens = tokenize(prompt)[None, :]
+        if self._tokenizer is not None:
+            # allow_special=False: user text must never inject control
+            # tokens (a literal "<|eot_id|>" in the utterance would
+            # otherwise terminate generation).
+            tokens = np.asarray(
+                self._tokenizer.encode(prompt, allow_special=False),
+                np.int32)[None, :]
+        else:
+            tokens = tokenize(prompt)[None, :]
         max_new, _ = self.get_parameter("max_new_tokens", 24,
                                         stream=stream)
         max_new = int(max_new)
@@ -239,6 +279,10 @@ class PE_LLM(PipelineElement):
                 self.params, first, cache, jnp.int32(prompt_len),
                 max_new - 1, self.config)
             out = jnp.concatenate([first, new_tokens], axis=1)
-            reply = detokenize(np.asarray(out)[0])
+            if self._tokenizer is not None:
+                reply = self._tokenizer.decode(np.asarray(out)[0],
+                                               skip_special=True)
+            else:
+                reply = detokenize(np.asarray(out)[0])
         return StreamEvent.OKAY, {"text": reply,
                                   "command": extract_command(reply)}

@@ -96,6 +96,9 @@ def capture_speech_chain(round_trips=5):
                 line = child.stdout.readline()
                 if line.strip() == "READY":
                     break
+                if line == "" and child.poll() is not None:
+                    raise RuntimeError(
+                        f"{json_name} child died rc={child.returncode}")
             else:
                 raise RuntimeError(f"{json_name} child never READY")
 

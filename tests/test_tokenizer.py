@@ -1,0 +1,161 @@
+"""Byte-level BPE tokenizer tests.
+
+The load-bearing check is DIFFERENTIAL: encodings must match the HF
+``tokenizers`` runtime (the library real checkpoints are tokenized
+with, present in the image as a transformers dependency) token-for-
+token on trained byte-level fixtures — GPT-2-style (ByteLevel regex)
+and Llama-3-style (explicit Split pattern), plus special tokens.
+"""
+
+import json
+import os
+
+import pytest
+
+from aiko_services_tpu.models.tokenizer import (
+    GPT2_PATTERN, LLAMA3_PATTERN, Tokenizer,
+)
+
+hf_tokenizers = pytest.importorskip("tokenizers")
+
+CORPUS = [
+    "The quick brown fox jumps over the lazy dog.",
+    "Pipelines stream frames; actors exchange (s expressions).",
+    "def process_frame(self, stream, **inputs):\n    return out",
+    "Числа: 12345, words mixed 67x89, and CJK 你好世界!",
+    "emoji 🙂🚀 and accents: café naïve übermäßig",
+    "   leading spaces\tand\ttabs\nand\nnewlines\r\n",
+    "aaaaaaaaaaaaaaaaaaaaaaaaaaaaaaa bbbbbbbbbbbbbbbb",
+]
+
+SAMPLES = CORPUS + [
+    "",
+    " ",
+    "don't stop — it's 100% fine, I'll wait...",
+    "x",
+    "🙂",
+    "mixed  double  spaces   triple",
+]
+
+
+def _train(tmp_path, pre_tokenizer, name):
+    tokenizer = hf_tokenizers.Tokenizer(
+        hf_tokenizers.models.BPE())
+    tokenizer.pre_tokenizer = pre_tokenizer
+    tokenizer.decoder = hf_tokenizers.decoders.ByteLevel()
+    trainer = hf_tokenizers.trainers.BpeTrainer(
+        vocab_size=400, special_tokens=["<|start|>", "<|end|>"],
+        initial_alphabet=hf_tokenizers
+        .pre_tokenizers.ByteLevel.alphabet())
+    tokenizer.train_from_iterator(CORPUS * 4, trainer)
+    path = os.path.join(tmp_path, name)
+    tokenizer.save(path)
+    return path, tokenizer
+
+
+@pytest.fixture(scope="module")
+def gpt2_style(tmp_path_factory):
+    tmp = str(tmp_path_factory.mktemp("tok"))
+    return _train(
+        tmp,
+        hf_tokenizers.pre_tokenizers.ByteLevel(add_prefix_space=False),
+        "gpt2_style.json")
+
+
+@pytest.fixture(scope="module")
+def llama3_style(tmp_path_factory):
+    tmp = str(tmp_path_factory.mktemp("tok"))
+    split = hf_tokenizers.pre_tokenizers.Split(
+        hf_tokenizers.Regex(LLAMA3_PATTERN), "isolated")
+    byte_level = hf_tokenizers.pre_tokenizers.ByteLevel(
+        add_prefix_space=False, use_regex=False)
+    return _train(
+        tmp,
+        hf_tokenizers.pre_tokenizers.Sequence([split, byte_level]),
+        "llama3_style.json")
+
+
+def test_differential_gpt2_style(gpt2_style):
+    path, oracle = gpt2_style
+    mine = Tokenizer.from_file(path)
+    for text in SAMPLES:
+        expected = oracle.encode(text).ids
+        assert mine.encode(text) == expected, text
+        assert mine.decode(expected) == oracle.decode(
+            expected, skip_special_tokens=False), text
+
+
+def test_differential_llama3_style(llama3_style):
+    path, oracle = llama3_style
+    mine = Tokenizer.from_file(path)
+    for text in SAMPLES:
+        assert mine.encode(text) == oracle.encode(text).ids, text
+
+
+def test_decode_round_trip(gpt2_style):
+    path, _ = gpt2_style
+    mine = Tokenizer.from_file(path)
+    for text in SAMPLES:
+        assert mine.decode(mine.encode(text)) == text
+
+
+def test_special_tokens_matched_verbatim(gpt2_style):
+    path, oracle = gpt2_style
+    mine = Tokenizer.from_file(path)
+    text = "<|start|>The quick brown fox<|end|> trailer"
+    ids = mine.encode(text)
+    start = mine.special_tokens["<|start|>"]
+    end = mine.special_tokens["<|end|>"]
+    assert ids[0] == start and end in ids
+    assert mine.decode(ids) == text
+    assert mine.decode(ids, skip_special=True) == \
+        "The quick brown fox trailer"
+    # allow_special=False treats the markup as plain text
+    assert start not in mine.encode(text, allow_special=False)
+
+
+def test_tiktoken_rank_rule_equals_merge_rule(tmp_path, gpt2_style):
+    """tiktoken checkpoints carry no merges: pair priority is the
+    concatenation's vocab rank.  For a byte-level BPE whose vocab ids
+    are alphabet-then-merges-in-order (how BPE vocabs are built), that
+    rule reproduces the merge-table encoding exactly."""
+    path, _ = gpt2_style
+    with open(path, encoding="utf-8") as fh:
+        doc = json.load(fh)
+    from aiko_services_tpu.models.tokenizer import _alias_to_bytes
+    merge_tok = Tokenizer.from_file(path)
+    rank_tok = Tokenizer(
+        vocab={_alias_to_bytes(t): i
+               for t, i in doc["model"]["vocab"].items()},
+        merge_ranks=None,
+        special_tokens=merge_tok.special_tokens,
+        pattern=merge_tok.pattern)
+    for text in SAMPLES:
+        assert merge_tok.encode(text) == rank_tok.encode(text), text
+
+
+def test_tiktoken_file_loading(tmp_path):
+    """Llama-3 tokenizer.model format: base64 token + rank lines."""
+    import base64 as b64
+    vocab = {bytes([b]): b for b in range(256)}
+    vocab[b"he"] = 256
+    vocab[b"ll"] = 257
+    vocab[b"hell"] = 258
+    vocab[b"hello"] = 259
+    path = os.path.join(str(tmp_path), "tokenizer.model")
+    with open(path, "w") as fh:
+        for token, rank in sorted(vocab.items(), key=lambda kv: kv[1]):
+            fh.write(f"{b64.b64encode(token).decode()} {rank}\n")
+    tok = Tokenizer.from_file(path)
+    assert tok.encode("hello", allow_special=False) == [259]
+    assert tok.decode([259]) == "hello"
+    # Llama-3 standard specials appended after the base vocab
+    assert tok.special_tokens["<|begin_of_text|>"] == 260
+    ids = tok.encode("<|begin_of_text|>hello")
+    assert ids == [260, 259]
+    assert tok.vocab_size == 260 + 256
+
+
+def test_pattern_is_gpt2_for_byte_level(gpt2_style):
+    path, _ = gpt2_style
+    assert Tokenizer.from_file(path).pattern == GPT2_PATTERN
