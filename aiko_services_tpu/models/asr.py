@@ -192,24 +192,40 @@ def _decoder_step(params, tokens, audio_features, config: ASRConfig):
     return (x @ params["token_embed"].T).astype(jnp.float32)
 
 
+#: Whisper vocab size → (SOT conditioning sequence, EOT id).
+#: 51865 = multilingual v1/v2/small/medium; 51864 = English-only;
+#: 51866 = large-v3 family (one extra language token shifts the task
+#: ids up by one).  Sequences condition for en/transcribe/no-timestamps.
+_WHISPER_SPECIALS = {
+    51_865: ((50_258, 50_259, 50_359, 50_363), 50_257),
+    51_864: ((50_257, 50_362), 50_256),
+    51_866: ((50_258, 50_259, 50_360, 50_364), 50_257),
+}
+
+
 def sot_sequence(config: ASRConfig) -> Tuple[int, ...]:
     """Whisper's start-of-transcript conditioning for imported
-    checkpoints, derived from the vocab size: multilingual (51865) =
-    <|startoftranscript|><|en|><|transcribe|><|notimestamps|>;
-    English-only (51864) = <|startoftranscript|><|notimestamps|>.
-    Random-init test configs keep the plain (start_token,) seed."""
-    if config.vocab_size == 51_865:
-        return (50_258, 50_259, 50_359, 50_363)
-    if config.vocab_size == 51_864:
-        return (50_257, 50_362)
+    checkpoints, derived from the vocab size (see _WHISPER_SPECIALS).
+    Random-init test configs (small vocabs) keep the plain
+    (start_token,) seed; an UNRECOGNIZED Whisper-scale vocab raises —
+    decoding a trained model with the stand-in tokens would produce
+    silent garbage."""
+    if config.vocab_size in _WHISPER_SPECIALS:
+        return _WHISPER_SPECIALS[config.vocab_size][0]
+    if config.vocab_size >= 40_000:
+        raise ValueError(
+            f"unknown Whisper vocab size {config.vocab_size}; add its "
+            "special-token ids to _WHISPER_SPECIALS")
     return ()
 
 
 def eot_token(config: ASRConfig, default: int = 2) -> int:
-    if config.vocab_size == 51_865:
-        return 50_257
-    if config.vocab_size == 51_864:
-        return 50_256
+    if config.vocab_size in _WHISPER_SPECIALS:
+        return _WHISPER_SPECIALS[config.vocab_size][1]
+    if config.vocab_size >= 40_000:
+        raise ValueError(
+            f"unknown Whisper vocab size {config.vocab_size}; add its "
+            "special-token ids to _WHISPER_SPECIALS")
     return default
 
 

@@ -66,6 +66,11 @@ class LlamaConfig:
     #: Long-context prefill cost becomes O(seq·window) via two-sided
     #: block skipping in the flash kernel.
     sliding_window: Optional[int] = None
+    #: Llama-3.1-style RoPE frequency rescaling as (factor,
+    #: low_freq_factor, high_freq_factor, original_max_position
+    #: embeddings) — a tuple so the config stays hashable for jit.
+    #: None = plain theta^-2k/d frequencies (Llama-3-8B and earlier).
+    rope_scaling: Optional[Tuple[float, float, float, int]] = None
 
     @property
     def head_dim(self) -> int:
@@ -264,16 +269,34 @@ def random_quantized_params(config: LlamaConfig, key, bits: int = 8) -> Dict:
                        c.d_ff)
     counter = iter(range(10_000))
 
-    def q8weight(shape):
+    # On an accelerator, generate ON DEVICE (threefry): host-side
+    # numpy would push the whole weight stream through the transfer
+    # path (minutes via the axon relay tunnel).  On the CPU backend
+    # the device IS the host, and numpy's generator is ~30x faster
+    # than threefry on one core — this is what keeps the 70B-geometry
+    # dryrun section fast enough for the driver.
+    use_numpy = jax.default_backend() == "cpu"
+    if use_numpy:
+        import numpy as np
+        seed_base = int(jax.random.randint(key, (), 0, 2**31 - 1))
+
+    def _randint8(shape, low, high):
+        if use_numpy:
+            import numpy as np
+            rng = np.random.default_rng(seed_base + next(counter))
+            return jnp.asarray(
+                rng.integers(low, high, shape, np.int8))
         k = jax.random.fold_in(key, next(counter))
-        q = jax.random.randint(k, shape, -127, 128, jnp.int8)
+        return jax.random.randint(k, shape, low, high, jnp.int8)
+
+    def q8weight(shape):
+        q = _randint8(shape, -127, 128)
         s = jnp.full((1, shape[1]), shape[0] ** -0.5 / 127.0, jnp.float32)
         return {"q": q, "s": s}
 
     def q4weight(shape):
         kin, n = shape
-        k = jax.random.fold_in(key, next(counter))
-        packed = jax.random.randint(k, (kin // 2, n), -128, 128, jnp.int8)
+        packed = _randint8((kin // 2, n), -128, 128)
         groups = max(1, kin // 128)
         s = jnp.full((groups, n), kin ** -0.5 / 7.0, jnp.float32)
         return {"q4": packed, "s": s}
@@ -342,6 +365,21 @@ def _rope_freqs(config: LlamaConfig, positions):
     dim = config.head_dim
     inv_freq = 1.0 / (config.rope_theta **
                       (jnp.arange(0, dim, 2, dtype=jnp.float32) / dim))
+    if config.rope_scaling is not None:
+        # Llama-3.1 frequency rescaling: wavelengths beyond the
+        # original context are slowed by ``factor``, in-band ones kept,
+        # with a smooth ramp between (checkpoints are TRAINED with
+        # these frequencies — skipping this garbles long-range heads).
+        factor, low_fac, high_fac, original_max = config.rope_scaling
+        wavelen = 2.0 * jnp.pi / inv_freq
+        low_wavelen = original_max / low_fac
+        high_wavelen = original_max / high_fac
+        smooth = (original_max / wavelen - low_fac) / (high_fac - low_fac)
+        smoothed = ((1.0 - smooth) * inv_freq / factor
+                    + smooth * inv_freq)
+        inv_freq = jnp.where(
+            wavelen > low_wavelen, inv_freq / factor,
+            jnp.where(wavelen < high_wavelen, inv_freq, smoothed))
     angles = positions[..., None].astype(jnp.float32) * inv_freq
     return jnp.cos(angles), jnp.sin(angles)
 

@@ -133,6 +133,21 @@ def _strip_prefix(tensors: CheckpointTensors, prefix: str):
 
 def llama_config_from_hf(cfg: dict) -> "LlamaConfig":
     from ..models.llama import LlamaConfig
+    rope_scaling = None
+    scaling_cfg = cfg.get("rope_scaling")
+    if scaling_cfg:
+        kind = scaling_cfg.get("rope_type",
+                               scaling_cfg.get("type", "default"))
+        if kind == "llama3":
+            rope_scaling = (
+                float(scaling_cfg["factor"]),
+                float(scaling_cfg["low_freq_factor"]),
+                float(scaling_cfg["high_freq_factor"]),
+                int(scaling_cfg["original_max_position_embeddings"]))
+        elif kind != "default":
+            # linear/dynamic/yarn would silently mis-position every
+            # token if dropped — refuse instead.
+            raise ValueError(f"unsupported rope_scaling type {kind!r}")
     return LlamaConfig(
         vocab_size=cfg["vocab_size"],
         d_model=cfg["hidden_size"],
@@ -145,6 +160,7 @@ def llama_config_from_hf(cfg: dict) -> "LlamaConfig":
         norm_eps=cfg.get("rms_norm_eps", 1e-5),
         max_seq_len=cfg.get("max_position_embeddings", 8192),
         sliding_window=cfg.get("sliding_window"),
+        rope_scaling=rope_scaling,
     )
 
 
@@ -152,11 +168,12 @@ def import_llama(path: str, config=None, dtype=jnp.bfloat16,
                  bits: Optional[int] = None):
     """HF-layout Llama/Mistral safetensors → (params, config).
 
-    ``bits`` quantizes on the fly (8 or 4) via
-    :func:`..models.llama.quantize_params` — the checkpoint is read
-    once, layer by layer, so peak memory stays ~one checkpoint.
+    ``bits`` quantizes on the fly (8 or 4): each layer is quantized as
+    soon as it is assembled and its bf16 tensors dropped, so peak
+    memory stays ~one checkpoint + one layer, not checkpoint + full
+    quantized copy (an 8B import fits a 16 GB host).
     """
-    from ..models.llama import quantize_params
+    from ..ops.quant import quantize_tree
 
     tensors, hf_config = load_checkpoint_tensors(path)
     if config is None:
@@ -175,7 +192,7 @@ def import_llama(path: str, config=None, dtype=jnp.bfloat16,
     layers = []
     for i in range(config.n_layers):
         base = f"{prefix}layers.{i}."
-        layers.append({
+        layer = {
             "attn_norm": vector(base + "input_layernorm.weight"),
             "wq": dense(base + "self_attn.q_proj.weight"),
             "wk": dense(base + "self_attn.k_proj.weight"),
@@ -185,12 +202,20 @@ def import_llama(path: str, config=None, dtype=jnp.bfloat16,
             "w_gate": dense(base + "mlp.gate_proj.weight"),
             "w_up": dense(base + "mlp.up_proj.weight"),
             "w_down": dense(base + "mlp.down_proj.weight"),
-        })
+        }
+        if bits is not None:
+            layer = quantize_tree(layer, bits=bits)
+        layers.append(layer)
     embed = tensors.get(prefix + "embed_tokens.weight", dtype)
     if tensors.has("lm_head.weight"):
         lm_head = dense("lm_head.weight")
     else:                           # tied embeddings (llama-3.2 class)
         lm_head = embed.T
+    if bits is not None:
+        # Embedding stays int8 even at bits=4 (row-gather path) —
+        # matches quantize_params' policy.
+        embed = quantize_tree(embed)
+        lm_head = quantize_tree(lm_head, bits=bits)
     params = {
         "embed": embed,
         "layers": layers,
@@ -198,8 +223,6 @@ def import_llama(path: str, config=None, dtype=jnp.bfloat16,
         "lm_head": lm_head,
     }
     tensors.close()
-    if bits is not None:
-        params = quantize_params(params, bits=bits)
     return params, config
 
 

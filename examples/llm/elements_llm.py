@@ -177,9 +177,21 @@ class PE_LLM(PipelineElement):
             self.params = llama.init_params(
                 self.config, jax.random.PRNGKey(int(seed)))
         tokenizer_path, _ = self.get_parameter("tokenizer", None)
+        self._eos_id = None
         if tokenizer_path:
             from aiko_services_tpu.models.tokenizer import Tokenizer
             self._tokenizer = Tokenizer.from_file(str(tokenizer_path))
+            # End-of-turn id: without it generation runs the full
+            # budget and the decoded reply keeps hallucinated
+            # next-turn text after the terminator.
+            eos_name, _ = self.get_parameter("eos_token", None)
+            candidates = ([str(eos_name)] if eos_name else
+                          ["<|eot_id|>", "<|end_of_text|>",
+                           "<|endoftext|>", "</s>"])
+            for name in candidates:
+                if name in self._tokenizer.special_tokens:
+                    self._eos_id = self._tokenizer.special_tokens[name]
+                    break
             if self._tokenizer.vocab_size > self.config.vocab_size:
                 # JAX gathers clamp out-of-range ids silently; a
                 # mismatched tokenizer would produce nonsense rather
@@ -280,8 +292,12 @@ class PE_LLM(PipelineElement):
                 max_new - 1, self.config)
             out = jnp.concatenate([first, new_tokens], axis=1)
             if self._tokenizer is not None:
-                reply = self._tokenizer.decode(np.asarray(out)[0],
-                                               skip_special=True)
+                row = np.asarray(out)[0]
+                if self._eos_id is not None:
+                    hits = np.nonzero(row == self._eos_id)[0]
+                    if hits.size:
+                        row = row[:hits[0]]   # cut AT the terminator
+                reply = self._tokenizer.decode(row, skip_special=True)
             else:
                 reply = detokenize(np.asarray(out)[0])
         return StreamEvent.OKAY, {"text": reply,

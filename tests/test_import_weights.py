@@ -97,6 +97,45 @@ def test_llama_quantize_on_import(tiny_hf_llama):
     assert np.isfinite(np.asarray(logits, np.float32)).all()
 
 
+def test_llama31_rope_scaling_differential(tmp_path):
+    """Llama-3.1-style rope_scaling must be applied, not dropped: at
+    positions where scaled and unscaled frequencies diverge, logits
+    must still match transformers (which always applies it)."""
+    tmp = str(tmp_path)
+    config = transformers.LlamaConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=176,
+        num_hidden_layers=2, num_attention_heads=4,
+        num_key_value_heads=2, max_position_embeddings=512,
+        rope_theta=10_000.0, tie_word_embeddings=False,
+        rope_scaling={"rope_type": "llama3", "factor": 8.0,
+                      "low_freq_factor": 1.0, "high_freq_factor": 4.0,
+                      "original_max_position_embeddings": 64})
+    torch.manual_seed(2)
+    model = transformers.LlamaForCausalLM(config).eval().to(torch.float32)
+    model.save_pretrained(tmp, safe_serialization=True)
+    from aiko_services_tpu.models import llama
+    params, our_config = import_llama(tmp, dtype=jnp.float32)
+    assert our_config.rope_scaling == (8.0, 1.0, 4.0, 64)
+    rng = np.random.default_rng(5)
+    # Long prompt: beyond original_max so scaled frequencies matter.
+    tokens = rng.integers(0, 256, (1, 200)).astype(np.int32)
+    ours = np.asarray(llama.forward(params, jnp.asarray(tokens),
+                                    our_config, use_flash=False))
+    with torch.no_grad():
+        theirs = model(torch.from_numpy(tokens).long()) \
+            .logits.float().numpy()
+    np.testing.assert_allclose(ours, theirs, rtol=2e-3, atol=2e-3)
+
+
+def test_unsupported_rope_scaling_refused():
+    with pytest.raises(ValueError, match="rope_scaling"):
+        llama_config_from_hf({
+            "vocab_size": 256, "hidden_size": 64,
+            "intermediate_size": 176, "num_hidden_layers": 1,
+            "num_attention_heads": 4,
+            "rope_scaling": {"rope_type": "yarn", "factor": 4.0}})
+
+
 def test_llama_tied_embeddings(tmp_path):
     """Checkpoints without lm_head.weight (tied) fall back to embedᵀ."""
     tmp = str(tmp_path)
@@ -224,7 +263,11 @@ def test_whisper_seeded_decode(tiny_hf_whisper):
     english = ASRConfig(vocab_size=51_864)
     assert sot_sequence(english) == (50_257, 50_362)
     assert eot_token(english) == 50_256
+    large_v3 = ASRConfig(vocab_size=51_866)
+    assert sot_sequence(large_v3) == (50_258, 50_259, 50_360, 50_364)
     assert sot_sequence(config) == ()       # tiny test vocab: no seed
+    with pytest.raises(ValueError, match="vocab"):
+        sot_sequence(ASRConfig(vocab_size=52_000))   # unknown: loud
 
 
 def test_whisper_log_mel_matches_feature_extractor():
