@@ -98,9 +98,11 @@ def encode_connack(session_present: bool = False,
 
 
 def encode_publish(topic: str, payload: bytes,
-                   retain: bool = False) -> bytes:
-    return _fixed(PUBLISH, 0x01 if retain else 0,
-                  _utf8(topic) + payload)
+                   retain: bool = False, dup: bool = False) -> bytes:
+    # DUP (bit 3) marks a re-delivery attempt; meaningful only at
+    # QoS > 0 (spec §3.3.1.1) but encoded faithfully for conformance.
+    flags = (0x01 if retain else 0) | (0x08 if dup else 0)
+    return _fixed(PUBLISH, flags, _utf8(topic) + payload)
 
 
 def encode_subscribe(packet_id: int, patterns: List[str]) -> bytes:
@@ -153,11 +155,13 @@ class Packet:
     username: Optional[str] = None
     password: Optional[str] = None
     # CONNACK
+    session_present: bool = False
     return_code: int = 0
     # PUBLISH
     topic: str = ""
     payload: bytes = b""
     retain: bool = False
+    dup: bool = False
     # SUBSCRIBE / UNSUBSCRIBE
     packet_id: int = 0
     patterns: List[str] = field(default_factory=list)
@@ -182,9 +186,11 @@ def _decode_body(packet_type: int, flags: int, body: bytes) -> Packet:
         if connect_flags & 0x40:
             packet.password, offset = _read_utf8(body, offset)
     elif packet_type == CONNACK:
+        packet.session_present = bool(body[0] & 0x01)
         packet.return_code = body[1]
     elif packet_type == PUBLISH:
         packet.retain = bool(flags & 0x01)
+        packet.dup = bool(flags & 0x08)
         packet.topic, offset = _read_utf8(body, 0)
         if flags & 0x06:                      # QoS > 0: skip packet id
             offset += 2

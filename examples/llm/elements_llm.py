@@ -185,13 +185,23 @@ class PE_LLM(PipelineElement):
             # budget and the decoded reply keeps hallucinated
             # next-turn text after the terminator.
             eos_name, _ = self.get_parameter("eos_token", None)
-            candidates = ([str(eos_name)] if eos_name else
-                          ["<|eot_id|>", "<|end_of_text|>",
-                           "<|endoftext|>", "</s>"])
-            for name in candidates:
-                if name in self._tokenizer.special_tokens:
-                    self._eos_id = self._tokenizer.special_tokens[name]
-                    break
+            if eos_name:
+                # An explicitly configured terminator that the
+                # tokenizer does not know is a misconfiguration — the
+                # reply would silently grow hallucinated turns.
+                if str(eos_name) not in self._tokenizer.special_tokens:
+                    raise ValueError(
+                        f"eos_token {eos_name!r} is not a special "
+                        "token of the configured tokenizer")
+                self._eos_id = self._tokenizer.special_tokens[
+                    str(eos_name)]
+            else:
+                for name in ("<|eot_id|>", "<|end_of_text|>",
+                             "<|endoftext|>", "</s>"):
+                    if name in self._tokenizer.special_tokens:
+                        self._eos_id = \
+                            self._tokenizer.special_tokens[name]
+                        break
             if self._tokenizer.vocab_size > self.config.vocab_size:
                 # JAX gathers clamp out-of-range ids silently; a
                 # mismatched tokenizer would produce nonsense rather

@@ -273,3 +273,94 @@ def test_multibyte_remaining_length_publish():
     assert mc.encode_publish("a/b", payload) == frame
     packet = decode_one(frame)
     assert packet.payload == payload
+
+
+# --------------------------------------------------------------------------- #
+# DUP flag (§3.3.1.1, bit 3) and CONNACK session-present (§3.2.2.2)
+
+def test_publish_dup_flag_golden():
+    # DUP=1, QoS=0, RETAIN=0 → first byte 0x38; topic "a/b", payload "x"
+    frame = golden("38 06", "00 03", b"a/b", b"x")
+    assert mc.encode_publish("a/b", b"x", dup=True) == frame
+    packet = decode_one(frame)
+    assert (packet.dup, packet.retain) == (True, False)
+    assert (packet.topic, packet.payload) == ("a/b", b"x")
+    # DUP=1 with RETAIN=1 → 0x39
+    frame = golden("39 06", "00 03", b"a/b", b"x")
+    assert mc.encode_publish("a/b", b"x", retain=True, dup=True) == frame
+    packet = decode_one(frame)
+    assert (packet.dup, packet.retain) == (True, True)
+    # plain publish keeps dup clear both ways
+    assert not decode_one(mc.encode_publish("a/b", b"x")).dup
+
+
+def test_connack_session_present_golden():
+    assert mc.encode_connack(session_present=True) == golden("20 02 01 00")
+    packet = decode_one(golden("20 02 01 00"))
+    assert (packet.session_present, packet.return_code) == (True, 0)
+    packet = decode_one(golden("20 02 00 00"))
+    assert packet.session_present is False
+
+
+# --------------------------------------------------------------------------- #
+# Live broker behavior over real TCP (no second implementation to
+# collude with: raw golden frames in, raw bytes out)
+
+def _read_packets(sock, reader):
+    """Read until at least one full packet; fail fast (not hang) when
+    the broker closes the connection (recv -> b'')."""
+    packets = []
+    while not packets:
+        data = sock.recv(4096)
+        assert data, "broker closed the connection"
+        packets = reader.feed(data)
+    return packets
+
+
+def _raw_connect(port, client_id):
+    import socket
+    sock = socket.create_connection(("127.0.0.1", port), timeout=5)
+    sock.sendall(mc.encode_connect(client_id))
+    reader = mc.PacketReader()
+    packets = _read_packets(sock, reader)
+    assert packets[0].packet_type == mc.CONNACK
+    # Clean-session connect MUST report session-present = 0 (§3.2.2.2)
+    assert packets[0].session_present is False
+    return sock, reader
+
+
+def test_broker_pingreq_unsubscribe_behavior():
+    from aiko_services_tpu.transport import MqttBroker
+    broker = MqttBroker(port=0)
+    try:
+        sock, reader = _raw_connect(broker.port, "conformance-sub")
+        # PINGREQ → PINGRESP (§3.12): keepalive round-trip
+        sock.sendall(mc.encode_pingreq())
+        packets = _read_packets(sock, reader)
+        assert packets[0].packet_type == mc.PINGRESP
+
+        # SUBSCRIBE → SUBACK, delivery; UNSUBSCRIBE → UNSUBACK, silence
+        sock.sendall(mc.encode_subscribe(1, ["t/#"]))
+        packets = _read_packets(sock, reader)
+        assert packets[0].packet_type == mc.SUBACK
+
+        pub, pub_reader = _raw_connect(broker.port, "conformance-pub")
+        pub.sendall(mc.encode_publish("t/x", b"one"))
+        got = _read_packets(sock, reader)
+        assert (got[0].topic, got[0].payload) == ("t/x", b"one")
+
+        sock.sendall(mc.encode_unsubscribe(2, ["t/#"]))
+        packets = _read_packets(sock, reader)
+        assert packets[0].packet_type == mc.UNSUBACK
+        assert packets[0].packet_id == 2
+
+        # After UNSUBACK nothing may be delivered: publish again, then
+        # ping — the next packet must be the PINGRESP, not the publish.
+        pub.sendall(mc.encode_publish("t/x", b"two"))
+        sock.sendall(mc.encode_pingreq())
+        packets = _read_packets(sock, reader)
+        assert [p.packet_type for p in packets] == [mc.PINGRESP]
+        pub.close()
+        sock.close()
+    finally:
+        broker.stop()
