@@ -22,10 +22,17 @@ __all__ = ["make_train_step", "init_train_state", "shard_train_state",
            "train_state_specs", "make_pp_train_step", "to_pp_params"]
 
 
-def cross_entropy(logits, targets):
+def cross_entropy(logits, targets, mask=None):
+    """Mean token NLL; ``mask`` (same shape as targets, 0/1) restricts
+    the mean to selected positions — supervised-completion training
+    (loss on the answer, not the prompt)."""
     logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
-    picked = jnp.take_along_axis(logp, targets[..., None], axis=-1)
-    return -jnp.mean(picked)
+    picked = jnp.take_along_axis(logp, targets[..., None],
+                                 axis=-1)[..., 0]
+    if mask is None:
+        return -jnp.mean(picked)
+    mask = mask.astype(jnp.float32)
+    return -(picked * mask).sum() / jnp.maximum(mask.sum(), 1.0)
 
 
 def make_train_step(config: llama.LlamaConfig, optimizer,
@@ -41,18 +48,22 @@ def make_train_step(config: llama.LlamaConfig, optimizer,
     backward instead of stored, trading ~33% more FLOPs for O(layers)
     less live memory (the standard large-model training trade on HBM).
     """
-    def loss_fn(params, tokens):
+    def loss_fn(params, tokens, loss_mask=None):
         forward = llama.forward
         if remat:
             forward = jax.checkpoint(
                 forward, static_argnums=(2, 3))
         logits = forward(params, tokens[:, :-1], config, False)
-        return cross_entropy(logits, tokens[:, 1:])
+        mask = None if loss_mask is None else loss_mask[:, 1:]
+        return cross_entropy(logits, tokens[:, 1:], mask)
 
-    def train_step(params, opt_state, tokens):
+    def train_step(params, opt_state, tokens, loss_mask=None):
         if accum_steps == 1:
-            loss, grads = jax.value_and_grad(loss_fn)(params, tokens)
+            loss, grads = jax.value_and_grad(loss_fn)(params, tokens,
+                                                      loss_mask)
         else:
+            assert loss_mask is None, \
+                "loss_mask requires accum_steps == 1"
             batch = tokens.shape[0]
             assert batch % accum_steps == 0, (batch, accum_steps)
             micro = tokens.reshape(accum_steps, batch // accum_steps,

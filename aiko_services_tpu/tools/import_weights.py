@@ -40,8 +40,8 @@ import jax.numpy as jnp
 
 __all__ = [
     "load_checkpoint_tensors", "llama_config_from_hf",
-    "import_llama", "export_llama", "asr_config_from_hf",
-    "import_whisper",
+    "import_llama", "export_llama", "export_llama_checkpoint",
+    "asr_config_from_hf", "import_whisper",
 ]
 
 
@@ -265,6 +265,43 @@ def export_llama(params: Dict, path: str):
     put("model.norm.weight", params["final_norm"], False)
     put("lm_head.weight", params["lm_head"], True)
     save_file(out, path)
+
+
+def export_llama_checkpoint(params: Dict, config, path: str):
+    """Write a COMPLETE HF-layout checkpoint directory —
+    ``model.safetensors`` + ``config.json`` — loadable by
+    :func:`import_llama` (and by ``transformers``).  This is how
+    natively-trained models become servable artifacts
+    (``PE_LLM(checkpoint=...)``, ``make_llama_infer(checkpoint=...)``)."""
+    os.makedirs(path, exist_ok=True)
+    export_llama(params, os.path.join(path, "model.safetensors"))
+    hf_config = {
+        "architectures": ["LlamaForCausalLM"],
+        "model_type": "llama",
+        "vocab_size": config.vocab_size,
+        "hidden_size": config.d_model,
+        "num_hidden_layers": config.n_layers,
+        "num_attention_heads": config.n_heads,
+        "num_key_value_heads": config.n_kv_heads,
+        "intermediate_size": config.d_ff,
+        "rope_theta": config.rope_theta,
+        "rms_norm_eps": config.norm_eps,
+        "max_position_embeddings": config.max_seq_len,
+        "tie_word_embeddings": False,
+        "torch_dtype": "float32",
+    }
+    if config.sliding_window is not None:
+        hf_config["sliding_window"] = config.sliding_window
+    if config.rope_scaling is not None:
+        factor, low, high, original = config.rope_scaling
+        hf_config["rope_scaling"] = {
+            "rope_type": "llama3", "factor": factor,
+            "low_freq_factor": low, "high_freq_factor": high,
+            "original_max_position_embeddings": original,
+        }
+    with open(os.path.join(path, "config.json"), "w",
+              encoding="utf-8") as fh:
+        json.dump(hf_config, fh, indent=1)
 
 
 # --------------------------------------------------------------------------- #

@@ -240,7 +240,13 @@ class PE_LLM(PipelineElement):
         llama = self._llama
         scene = (f"Scene: {' '.join(self._detections)}\n"
                  if self._detections else "")
-        prompt = f"{SYSTEM_PROMPT}\n{scene}user: {text}\nassistant: "
+        # Configurable system prompt (reference's is prompt-engineered
+        # per deployment, elements_llm.py:137-179); "" trains/serves
+        # the bare chat format — what the tiny trained checkpoint uses.
+        system, _ = self.get_parameter("system_prompt", SYSTEM_PROMPT,
+                                       stream=stream)
+        head = f"{system}\n" if system else ""
+        prompt = f"{head}{scene}user: {text}\nassistant: "
         if self._tokenizer is not None:
             # allow_special=False: user text must never inject control
             # tokens (a literal "<|eot_id|>" in the utterance would
