@@ -60,6 +60,38 @@ sys.exit(0 if ok else 1)
 EOF
 }
 
+# After (and ONLY after) a committed full capture: extend the int4
+# tile envelope toward the 70B classes, ONE shape per run with a probe
+# and a commit between shapes — a server-side Mosaic failure wedges
+# the relay, so each run may risk only itself, riskiest (largest
+# khalf) LAST.  bn=512 at large khalf is the known wedge trigger
+# (round 2) and is never attempted.
+int4_envelope_lab() {
+    TS="$1"
+    LAB="INT4LAB_${ROUND}_${TS}.log"
+    for shape in \
+        "repeat 8192 1024 128" \
+        "repeat 8192 1024 256" \
+        "batched 8192 1024 128" \
+        "repeat 28672 1024 128"; do
+        if [ -f STOP_CAPTURE ]; then
+            say "int4 lab: STOP_CAPTURE present; stopping"
+            break
+        fi
+        if ! sh scripts/relay_probe.sh "$PROBE_TIMEOUT" \
+                >/dev/null 2>&1; then
+            say "int4 lab: relay gone before [$shape]; stopping"
+            break
+        fi
+        say "int4 lab: $shape"
+        # shellcheck disable=SC2086
+        timeout 420 python scripts/int4_kernel_lab.py --one $shape \
+            >> "$LAB" 2>&1
+        echo "rc=$? shape=$shape" >> "$LAB"
+        commit_paths "int4 envelope lab ${TS} [$shape]" "$LAB"
+    done
+}
+
 say "daemon start (pid $$)"
 while :; do
     if [ -f STOP_CAPTURE ]; then
@@ -95,6 +127,7 @@ while :; do
             say "FULL capture landed: $JSON — daemon done"
             date -u +%FT%TZ > CAPTURE_DONE
             commit_paths "Full bench capture landed (${TS})" CAPTURE_DONE
+            int4_envelope_lab "$TS"
             exit 0
         fi
         say "capture partial/empty/uncommitted; continuing to hunt"

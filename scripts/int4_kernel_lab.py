@@ -15,6 +15,10 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
+#: INT4LAB_INTERPRET=1 runs the kernels in interpret mode (CPU
+#: sanity of the --one path; timings meaningless there).
+_INTERPRET = os.environ.get("INT4LAB_INTERPRET", "") not in ("", "0")
+
 from aiko_services_tpu.ops.quant import (
     quantize_int4, quantize_int8, int4_matmul, int8_matmul,
 )
@@ -55,6 +59,7 @@ def matmul_repeat(x, q4, s, block_n):
         ],
         out_specs=pl.BlockSpec((m, block_n), lambda j: (0, j)),
         out_shape=jax.ShapeDtypeStruct((m, n), x.dtype),
+        interpret=_INTERPRET,
     )(xe, xo, q4, s)
 
 
@@ -100,6 +105,7 @@ def matmul_batched(x, q4, s, block_n):
         ],
         out_specs=pl.BlockSpec((m, block_n), lambda j: (0, j)),
         out_shape=jax.ShapeDtypeStruct((m, n), x.dtype),
+        interpret=_INTERPRET,
     )(x3, p3, s3)
     return out
 
@@ -151,7 +157,61 @@ def race(kk, nn, m=64):
                       f"int4 batched bn={bn}")
 
 
+def race_one(variant, kk, nn, bn, m=64):
+    """Validate + time EXACTLY ONE kernel variant/tile — the unit the
+    capture daemon runs post-capture, riskiest shape last, committing
+    between shapes (a server-side Mosaic failure wedges the relay, so
+    each run must risk only itself; see docs/RELAY.md)."""
+    rng = np.random.default_rng(0)
+    w = jnp.asarray(rng.normal(size=(kk, nn)) * 0.02, jnp.float32)
+    x = jnp.asarray(rng.normal(size=(m, kk)), jnp.bfloat16)
+    q4 = quantize_int4(w, 128)
+    # Reference in NUMPY, not int4_matmul: at unvalidated khalf classes
+    # the dispatcher would fall back to the UNROLLED Pallas kernel —
+    # an uncontrolled never-before-compiled Mosaic kernel on hardware,
+    # exactly the one-risk-per-run rule this harness exists to keep.
+    packed = np.asarray(q4["q4"]).astype(np.int32)
+    low = (packed << 28) >> 28
+    high = packed >> 4
+    scales = np.asarray(q4["s"], np.float32)
+    gs_half = packed.shape[0] // scales.shape[0]
+    expanded = np.repeat(scales, gs_half, axis=0)
+    x_np = np.asarray(x, np.float32)
+    want = (x_np[:, 0::2] @ (low * expanded)
+            + x_np[:, 1::2] @ (high * expanded))
+    fn = {"repeat": matmul_repeat, "batched": matmul_batched}[variant]
+    got = np.asarray(fn(x, q4["q4"], q4["s"], bn), np.float32)
+    err = np.abs(got - want).max() / np.abs(want).max()
+    assert err < 0.05, f"wrong numerics: {err}"
+
+    @jax.jit
+    def loop(x):
+        def body(c, _):
+            y = fn(x + c, q4["q4"], q4["s"], bn)
+            return c + y[0, 0].astype(jnp.bfloat16) * 0, y[0, 0]
+        return jax.lax.scan(body, jnp.bfloat16(0), None, length=50)[1]
+
+    np.asarray(loop(x))
+    t0 = time.perf_counter()
+    np.asarray(loop(x))
+    dt = (time.perf_counter() - t0) / 50
+    gbs = kk * nn / 2 / dt / 1e9
+    print(f"OK {variant} K={kk} N={nn} bn={bn} khalf={kk // 2}: "
+          f"{dt * 1e6:.0f} us  {gbs:.0f} GB/s(int4)")
+
+
 if __name__ == "__main__":
-    race(4096, 14336)
-    race(14336, 4096)
-    race(4096, 4096)
+    import argparse
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--one", nargs=4,
+                        metavar=("VARIANT", "K", "N", "BN"),
+                        help="validate+time one variant/tile, e.g. "
+                             "--one repeat 8192 1024 128")
+    args = parser.parse_args()
+    if args.one:
+        race_one(args.one[0], int(args.one[1]), int(args.one[2]),
+                 int(args.one[3]))
+    else:
+        race(4096, 14336)
+        race(14336, 4096)
+        race(4096, 4096)
