@@ -55,6 +55,11 @@ class _ClientSession:
         self.address = address
         self.client_id = ""
         self.subscriptions: List[str] = []
+        # Routing index mirroring `subscriptions`: exact topics hit a
+        # set lookup, only wildcard patterns scan (routing runs per
+        # session per published message — the broker's hottest path).
+        self.exact_topics: set = set()
+        self.wildcards: List[str] = []
         self.will: Optional[Tuple[str, bytes, bool]] = None
         self.send_lock = threading.Lock()
         self.alive = True
@@ -142,6 +147,10 @@ class MqttBroker:
                 for pattern in packet.patterns:
                     if pattern not in session.subscriptions:
                         session.subscriptions.append(pattern)
+                        if "+" in pattern or "#" in pattern:
+                            session.wildcards.append(pattern)
+                        else:
+                            session.exact_topics.add(pattern)
                 retained = [(t, p) for t, p in self._retained.items()
                             if any(topic_matcher(pattern, t)
                                    for pattern in packet.patterns)]
@@ -154,6 +163,9 @@ class MqttBroker:
                 for pattern in packet.patterns:
                     if pattern in session.subscriptions:
                         session.subscriptions.remove(pattern)
+                        session.exact_topics.discard(pattern)
+                        if pattern in session.wildcards:
+                            session.wildcards.remove(pattern)
             session.send(encode_unsuback(packet.packet_id))
         elif packet.packet_type == PINGREQ:
             session.send(encode_pingresp())
@@ -168,8 +180,9 @@ class MqttBroker:
         data = encode_publish(topic, payload)
         with self._lock:
             targets = [s for s in self._sessions
-                       if s.alive and any(topic_matcher(p, topic)
-                                          for p in s.subscriptions)]
+                       if s.alive and (topic in s.exact_topics
+                                       or any(topic_matcher(p, topic)
+                                              for p in s.wildcards))]
         for target in targets:
             target.send(data)
 

@@ -110,6 +110,26 @@ def matmul_batched(x, q4, s, block_n):
     return out
 
 
+
+def _time_kernel(fn, x, kk, nn, label=None):
+    """Shared scan-loop timing harness: the c + y[0,0]*0 carry keeps a
+    data dependency between iterations so XLA cannot hoist the kernel
+    out of the scan; dt is per-iteration over 50."""
+    @jax.jit
+    def loop(x):
+        def body(c, _):
+            y = fn(x + c)
+            return c + y[0, 0].astype(jnp.bfloat16) * 0, y[0, 0]
+        return jax.lax.scan(body, jnp.bfloat16(0), None, length=50)[1]
+
+    np.asarray(loop(x))
+    t0 = time.perf_counter()
+    np.asarray(loop(x))
+    dt = (time.perf_counter() - t0) / 50
+    gbs = kk * nn / 2 / dt / 1e9
+    return dt, gbs
+
+
 def race(kk, nn, m=64):
     rng = np.random.default_rng(0)
     w = jnp.asarray(rng.normal(size=(kk, nn)) * 0.02, jnp.float32)
@@ -119,23 +139,12 @@ def race(kk, nn, m=64):
     want = np.asarray(int4_matmul(x, q4["q4"], q4["s"]), np.float32)
 
     def scan_time(fn, label, check=True):
-        @jax.jit
-        def loop(x):
-            def body(c, _):
-                y = fn(x + c)
-                return c + y[0, 0].astype(jnp.bfloat16) * 0, y[0, 0]
-            return jax.lax.scan(body, jnp.bfloat16(0), None,
-                                length=50)[1]
         try:
             if check:
                 got = np.asarray(fn(x), np.float32)
                 err = np.abs(got - want).max() / (np.abs(want).max())
                 assert err < 0.05, f"{label} wrong: {err}"
-            np.asarray(loop(x))
-            t0 = time.perf_counter()
-            np.asarray(loop(x))
-            dt = (time.perf_counter() - t0) / 50
-            gbs = kk * nn / 2 / dt / 1e9
+            dt, gbs = _time_kernel(fn, x, kk, nn)
             print(f"  {label:28s} {dt*1e6:7.0f} us  {gbs:6.0f} GB/s(int4)")
         except Exception as e:  # noqa: BLE001
             print(f"  {label:28s} FAILED: {type(e).__name__}: {e}")
@@ -184,18 +193,8 @@ def race_one(variant, kk, nn, bn, m=64):
     err = np.abs(got - want).max() / np.abs(want).max()
     assert err < 0.05, f"wrong numerics: {err}"
 
-    @jax.jit
-    def loop(x):
-        def body(c, _):
-            y = fn(x + c, q4["q4"], q4["s"], bn)
-            return c + y[0, 0].astype(jnp.bfloat16) * 0, y[0, 0]
-        return jax.lax.scan(body, jnp.bfloat16(0), None, length=50)[1]
-
-    np.asarray(loop(x))
-    t0 = time.perf_counter()
-    np.asarray(loop(x))
-    dt = (time.perf_counter() - t0) / 50
-    gbs = kk * nn / 2 / dt / 1e9
+    dt, gbs = _time_kernel(
+        lambda xx: fn(xx, q4["q4"], q4["s"], bn), x, kk, nn)
     print(f"OK {variant} K={kk} N={nn} bn={bn} khalf={kk // 2}: "
           f"{dt * 1e6:.0f} us  {gbs:.0f} GB/s(int4)")
 
