@@ -259,6 +259,27 @@ def parse(payload: str, dictionaries: bool = True) -> Tuple[str, Any]:
     parameter list (or dict when keyword pairs are used).  A bare atom
     parses to ``(atom, [])``.
     """
+    native = _native()
+    if dictionaries and native is not None:
+        # Fast path: the C codec applies dict-ification while parsing.
+        # Listify only converts KEYWORD-headed lists, so for the
+        # ordinary command shape — a non-keyword head symbol — the
+        # result is the slow path's (head, listified tail), EXCEPT the
+        # inline-dict form ``(cmd k: v …)`` where the slow path
+        # listifies the tail AS ITS OWN list (keyword head → dict);
+        # that one tail-level pass happens here in Python (inner
+        # levels are already dict-ified by C).  Anything exotic
+        # (keyword head, nested-list head, bare atom) falls through to
+        # the reference implementation below.
+        tree = native.parse_tree(payload, True)
+        if (isinstance(tree, list) and tree
+                and isinstance(tree[0], str)
+                and not tree[0].endswith(":")):
+            rest = tree[1:]
+            if rest and isinstance(rest[0], str) \
+                    and rest[0].endswith(":"):
+                rest = _listify_dicts(rest)
+            return tree[0], rest
     tree = parse_tree(payload, dictionaries=False)
     if isinstance(tree, str) or tree is None:
         command, rest = tree or "", []

@@ -242,3 +242,42 @@ def test_fuzz_roundtrip_both_codecs():
         assert py_error == c_error, noise
         if py_error is None:
             assert py_result == c_result, noise
+
+
+def test_parse_fast_path_matches_reference_composition():
+    """parse()'s C fast path (native dict-ification + head split) must
+    equal the reference composition — parse_tree(dicts=False) + head
+    extraction + _listify_dicts on the tail — on ordinary AND exotic
+    shapes (keyword heads, nested-list heads, bare atoms fall through
+    to the slow path)."""
+    from aiko_services_tpu.utils.sexpr import (
+        _listify_dicts, parse, parse_tree,
+    )
+    corpus = [
+        "(process_frame (stream_id load) (frame_id 42) (swag ((i 7))))",
+        "(add topic.path 17)",
+        "(share resp/topic 300 *)",
+        "atom",
+        "3:a b",
+        "(foo: 1)",                    # keyword head -> slow path
+        "((a: 1))",                    # nested-list head
+        "(cmd (a: 1 b: 2) tail)",      # dict parameter
+        "(a b: 1 c: 2)",               # INLINE dict tail (generate's
+                                       # form for dict parameters)
+        "(a b: (x: 1))",               # inline dict w/ nested dict
+        "(cmd)",
+        "()",
+    ]
+    for payload in corpus:
+        tree = parse_tree(payload, dictionaries=False)
+        if isinstance(tree, str) or tree is None:
+            want = (tree or "", [])
+        elif not tree:
+            want = ("", [])
+        elif isinstance(tree[0], str):
+            want = (tree[0], _listify_dicts(tree[1:]))
+        else:
+            inner = tree[0]
+            want = (inner[0] if inner else "",
+                    _listify_dicts(inner[1:] if inner else []))
+        assert parse(payload) == want, payload
