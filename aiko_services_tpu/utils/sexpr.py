@@ -270,8 +270,15 @@ def parse(payload: str, dictionaries: bool = True) -> Tuple[str, Any]:
         # that one tail-level pass happens here in Python (inner
         # levels are already dict-ified by C).  Anything exotic
         # (keyword head, nested-list head, bare atom) falls through to
-        # the reference implementation below.
-        tree = native.parse_tree(payload, True)
+        # the reference implementation below — INCLUDING payloads whose
+        # whole-tree dict-ification raises (odd-arity keyword lists):
+        # the slow path never dict-ifies those positions, so an
+        # unguarded raise here would make parse() behave differently
+        # depending on whether the native codec loaded.
+        try:
+            tree = native.parse_tree(payload, True)
+        except SExprError:
+            tree = None
         if (isinstance(tree, list) and tree
                 and isinstance(tree[0], str)
                 and not tree[0].endswith(":")):

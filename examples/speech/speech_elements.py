@@ -44,34 +44,43 @@ _FORMANTS = {
 }
 
 
+def formant_synthesize(text: str, rate: int = 16_000,
+                       char_seconds: float = 0.08) -> np.ndarray:
+    """Parametric formant synthesis: each character becomes a short
+    two-formant voiced segment; consonants get a noise burst,
+    whitespace a pause.  Deterministic — the same text always yields
+    the same waveform (the trained speech-loop ASR relies on the
+    per-character spectral signatures being stable)."""
+    n = max(1, int(rate * char_seconds))
+    t = np.arange(n) / rate
+    envelope = np.hanning(n).astype(np.float32)
+    rng = np.random.default_rng(0)
+    segments = []
+    for ch in str(text).lower():
+        if ch.isspace():
+            segments.append(np.zeros(n, np.float32))
+            continue
+        f1, f2 = _FORMANTS.get(ch, (440 + 13 * (ord(ch) % 23),
+                                    1500 + 29 * (ord(ch) % 17)))
+        voiced = (np.sin(2 * np.pi * f1 * t) +
+                  0.5 * np.sin(2 * np.pi * f2 * t))
+        if ch not in _FORMANTS and not ch.isdigit():
+            voiced = 0.6 * voiced + 0.4 * rng.standard_normal(n)
+        segments.append((voiced * envelope * 0.3).astype(np.float32))
+    return (np.concatenate(segments) if segments
+            else np.zeros(n, np.float32))
+
+
 class PE_TTS(PipelineElement):
-    """``text`` → ``audio`` (float32 mono) parametric formant synthesis.
+    """``text`` → ``audio`` (float32 mono) via
+    :func:`formant_synthesize`.
 
     Parameters: ``sample_rate`` (default 16000), ``char_seconds``
-    (default 0.08) — each character becomes a short two-formant voiced
-    segment; consonants get a noise burst, whitespace a pause.
+    (default 0.08).
     """
 
     def process_frame(self, stream, text):
         rate, _ = self.get_parameter("sample_rate", 16000, stream=stream)
         char_s, _ = self.get_parameter("char_seconds", 0.08, stream=stream)
-        rate, char_s = int(rate), float(char_s)
-        n = max(1, int(rate * char_s))
-        t = np.arange(n) / rate
-        envelope = np.hanning(n).astype(np.float32)
-        rng = np.random.default_rng(0)
-        segments = []
-        for ch in str(text).lower():
-            if ch.isspace():
-                segments.append(np.zeros(n, np.float32))
-                continue
-            f1, f2 = _FORMANTS.get(ch, (440 + 13 * (ord(ch) % 23),
-                                        1500 + 29 * (ord(ch) % 17)))
-            voiced = (np.sin(2 * np.pi * f1 * t) +
-                      0.5 * np.sin(2 * np.pi * f2 * t))
-            if ch not in _FORMANTS and not ch.isdigit():
-                voiced = 0.6 * voiced + 0.4 * rng.standard_normal(n)
-            segments.append((voiced * envelope * 0.3).astype(np.float32))
-        audio = (np.concatenate(segments) if segments
-                 else np.zeros(n, np.float32))
+        audio = formant_synthesize(str(text), int(rate), float(char_s))
         return StreamEvent.OKAY, {"audio": audio}

@@ -1,0 +1,80 @@
+"""Shared ASR training/transcription harness for the trained-from-
+scratch speech examples (tone language, closed TTS↔ASR loop).
+
+Each example supplies only its acoustic task — a ``synth_batch(rng,
+batch) -> (audio, tokens)`` function and its token alphabet; the
+teacher-forced loss, jitted train step, mel pipeline and KV-cached
+greedy transcription live here once.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def train_asr(synth_batch, steps, batch=16, seed=0,
+              learning_rate=2e-3, cosine=False, log_every=0,
+              progress=print):
+    """Train the ``tiny`` Whisper-architecture config on an acoustic
+    task.  Returns (params, config).
+
+    f32 end-to-end: adamw's updates are f32, so bf16 params would be
+    silently promoted after the first step (dtype mismatch at conv2).
+    ``cosine=True`` anneals the LR over ``steps`` — needed when the
+    task only converges to exactness late (the 26-way speech loop).
+    """
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+    import optax
+    from aiko_services_tpu.models import asr
+    from aiko_services_tpu.parallel.train import cross_entropy
+
+    config = dataclasses.replace(asr.CONFIGS["tiny"],
+                                 dtype=jnp.float32)
+    params = asr.init_params(config, jax.random.PRNGKey(seed))
+    schedule = (optax.cosine_decay_schedule(learning_rate, steps)
+                if cosine else learning_rate)
+    optimizer = optax.adamw(schedule, weight_decay=0.01)
+    opt_state = optimizer.init(params)
+
+    def loss_fn(params, mel, tokens):
+        features = asr.encode(params, mel, config)
+        # Teacher forcing: predict tokens[1:] from tokens[:-1].
+        logits = asr._decoder_step(params, tokens[:, :-1], features,
+                                   config)
+        return cross_entropy(logits, tokens[:, 1:])
+
+    @jax.jit
+    def step_fn(params, opt_state, mel, tokens):
+        loss, grads = jax.value_and_grad(loss_fn)(params, mel, tokens)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    rng = np.random.default_rng(seed)
+    for step in range(steps):
+        audio, tokens = synth_batch(rng, batch)
+        mel = asr.log_mel_spectrogram(jnp.asarray(audio),
+                                      config.n_mels)
+        params, opt_state, loss = step_fn(
+            params, opt_state, mel, jnp.asarray(tokens))
+        if log_every and (step + 1) % log_every == 0:
+            progress(f"step {step + 1}/{steps} "
+                     f"loss {float(np.asarray(loss)):.4f}")
+    return params, config
+
+
+def transcribe_tokens(params, config, audio, max_tokens,
+                      start_token, end_token):
+    """waveform (batch, samples) → decoded token rows (numpy), via
+    mel → encoder → KV-cached greedy decode.  Callers map token ids
+    back to their alphabet (digits, characters…)."""
+    import jax.numpy as jnp
+    from aiko_services_tpu.models import asr
+    mel = asr.log_mel_spectrogram(jnp.asarray(audio), config.n_mels)
+    features = asr.encode(params, mel, config)
+    return np.asarray(asr.decode_greedy_cached(
+        params, features, config, max_tokens=max_tokens,
+        start_token=start_token, end_token=end_token))

@@ -9,6 +9,9 @@ whole chain mel → conv subsampling → encoder → cross-attention →
 autoregressive decoder; after a few hundred CPU steps it transcribes
 HELD-OUT tone sequences exactly (``tests/test_train_tone_asr.py``).
 
+Training/transcription harness shared with the speech-loop example:
+:mod:`.asr_trainer`.
+
 Run standalone:  python examples/training/train_tone_asr.py
 """
 
@@ -23,6 +26,8 @@ if REPO_ROOT not in sys.path:
     sys.path.insert(0, REPO_ROOT)
 
 import numpy as np
+
+from examples.training.asr_trainer import train_asr, transcribe_tokens
 
 SAMPLE_RATE = 16_000
 TONE_SECONDS = 0.12
@@ -66,58 +71,16 @@ def train(steps: int = 300, batch: int = 16, seed: int = 0,
           learning_rate: float = 2e-3, log_every: int = 50,
           progress=print):
     """Returns (params, config) trained on the tone language."""
-    import dataclasses
-
-    import jax
-    import jax.numpy as jnp
-    import optax
-    from aiko_services_tpu.models import asr
-    from aiko_services_tpu.parallel.train import cross_entropy
-
-    # f32 end-to-end: adamw's updates are f32, so bf16 params would be
-    # silently promoted after the first step (dtype-mismatch at conv2).
-    config = dataclasses.replace(asr.CONFIGS["tiny"],
-                                 dtype=jnp.float32)
-    params = asr.init_params(config, jax.random.PRNGKey(seed))
-    optimizer = optax.adamw(learning_rate, weight_decay=0.01)
-    opt_state = optimizer.init(params)
-
-    def loss_fn(params, mel, tokens):
-        features = asr.encode(params, mel, config)
-        # Teacher forcing: predict tokens[1:] from tokens[:-1].
-        logits = asr._decoder_step(params, tokens[:, :-1], features,
-                                   config)
-        return cross_entropy(logits, tokens[:, 1:])
-
-    @jax.jit
-    def step_fn(params, opt_state, mel, tokens):
-        loss, grads = jax.value_and_grad(loss_fn)(params, mel, tokens)
-        updates, opt_state = optimizer.update(grads, opt_state, params)
-        params = optax.apply_updates(params, updates)
-        return params, opt_state, loss
-
-    rng = np.random.default_rng(seed)
-    for step in range(steps):
-        audio, tokens = synth_batch(rng, batch)
-        mel = asr.log_mel_spectrogram(jnp.asarray(audio),
-                                      config.n_mels)
-        params, opt_state, loss = step_fn(
-            params, opt_state, mel, jnp.asarray(tokens))
-        if log_every and (step + 1) % log_every == 0:
-            progress(f"step {step + 1}/{steps} "
-                     f"loss {float(np.asarray(loss)):.4f}")
-    return params, config
+    return train_asr(synth_batch, steps, batch=batch, seed=seed,
+                     learning_rate=learning_rate, log_every=log_every,
+                     progress=progress)
 
 
 def transcribe(params, config, audio):
     """waveform (batch, samples) → digit lists (greedy, KV-cached)."""
-    import jax.numpy as jnp
-    from aiko_services_tpu.models import asr
-    mel = asr.log_mel_spectrogram(jnp.asarray(audio), config.n_mels)
-    features = asr.encode(params, mel, config)
-    tokens = np.asarray(asr.decode_greedy_cached(
-        params, features, config, max_tokens=N_DIGITS + 2,
-        start_token=START, end_token=END))
+    tokens = transcribe_tokens(params, config, audio,
+                               max_tokens=N_DIGITS + 2,
+                               start_token=START, end_token=END)
     out = []
     for row in tokens:
         digits = []

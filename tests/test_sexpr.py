@@ -265,6 +265,11 @@ def test_parse_fast_path_matches_reference_composition():
         "(a b: 1 c: 2)",               # INLINE dict tail (generate's
                                        # form for dict parameters)
         "(a b: (x: 1))",               # inline dict w/ nested dict
+        "(foo: 1 bar)",                # odd-arity keyword head: the C
+                                       # whole-tree pass raises, but
+                                       # parse() must fall through and
+                                       # return like pure Python
+        "((a: 1 b) cmd)",              # nested malformed-dict head
         "(cmd)",
         "()",
     ]
@@ -281,3 +286,8 @@ def test_parse_fast_path_matches_reference_composition():
             want = (inner[0] if inner else "",
                     _listify_dicts(inner[1:] if inner else []))
         assert parse(payload) == want, payload
+    # Malformed inline dict: BOTH paths must raise identically.
+    import pytest as _pytest
+    from aiko_services_tpu.utils.sexpr import SExprError
+    with _pytest.raises(SExprError):
+        parse("(cmd a: 1 b)")

@@ -1,0 +1,30 @@
+"""The closed native speech loop: text → the framework's own formant
+TTS → trained Whisper-architecture ASR → text, identity on held-out
+strings.  Both ends are in-framework (the reference couples pretrained
+Coqui TTS to WhisperX for the same chain,
+reference examples/speech/speech_elements.py:109).
+"""
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.slow     # ~2.5 min: 2500 CPU training steps
+
+
+def test_text_survives_tts_asr_round_trip():
+    from examples.training.train_speech_loop import (
+        random_text, synth, train, transcribe,
+    )
+
+    params, config = train(steps=2500, log_every=0)
+
+    rng = np.random.default_rng(777)       # disjoint from training seed
+    total = 25
+    texts, batch = [], []
+    for _ in range(total):
+        text = random_text(rng)
+        texts.append(text)
+        batch.append(synth(text))
+    heard = transcribe(params, config, np.stack(batch))
+    exact = sum(t == g for t, g in zip(texts, heard))
+    assert exact >= total - 3, list(zip(texts, heard))[:8]
