@@ -70,7 +70,11 @@ class Process:
         self.services: Dict[int, object] = {}       # sid -> Service
         self._service_counter = itertools.count(1)
         self._message_handlers: Dict[str, List[Callable]] = {}
-        self._binary_topics: set = set()
+        # Dispatch index over _message_handlers: exact topics vs
+        # wildcard patterns (see add_message_handler).  Values alias
+        # the same handler lists.
+        self._exact_handlers: Dict[str, List[Callable]] = {}
+        self._wildcard_handlers: Dict[str, List[Callable]] = {}
         self.registrar: Optional[dict] = None       # {topic_path, version}
         self._lock = threading.RLock()
 
@@ -153,9 +157,21 @@ class Process:
         with self._lock:
             first = topic not in self._message_handlers
             self._message_handlers.setdefault(topic, []).append(handler)
-            if binary:
-                self._binary_topics.add(topic)
+            # Dispatch index: exact topics hit a dict lookup; only
+            # wildcard patterns scan.  Dispatch runs once per inbound
+            # message, and a process hosting thousands of services
+            # (reference scale goal, main/process.py:45-48) registers
+            # thousands of exact topics — a linear matcher scan made
+            # RPC dispatch O(services) per message.
+            if "+" in topic or "#" in topic:
+                self._wildcard_handlers[topic] = \
+                    self._message_handlers[topic]
+            else:
+                self._exact_handlers[topic] = \
+                    self._message_handlers[topic]
         if first:
+            # The transport owns binary-vs-text delivery per
+            # subscription; no process-side bookkeeping needed.
             self.message.subscribe(topic, binary=binary)
 
     def remove_message_handler(self, handler: Callable, topic: str):
@@ -165,6 +181,8 @@ class Process:
                 handlers.remove(handler)
             if not handlers:
                 self._message_handlers.pop(topic, None)
+                self._exact_handlers.pop(topic, None)
+                self._wildcard_handlers.pop(topic, None)
                 self.message.unsubscribe(topic)
 
     def _on_message(self, topic: str, payload):
@@ -174,10 +192,10 @@ class Process:
     def _message_queue_handler(self, item: Tuple[str, object]):
         topic, payload = item
         with self._lock:
-            matches = [h for pattern, handlers in
-                       self._message_handlers.items()
-                       if topic_matcher(pattern, topic)
-                       for h in handlers]
+            matches = list(self._exact_handlers.get(topic, ()))
+            for pattern, handlers in self._wildcard_handlers.items():
+                if topic_matcher(pattern, topic):
+                    matches.extend(handlers)
         for handler in matches:
             try:
                 handler(topic, payload)

@@ -28,7 +28,7 @@ from typing import Callable, Dict, List, Optional
 from ..pipeline.codec import encode_swag
 from ..utils.sexpr import generate, parse
 
-__all__ = ["LoadGenerator", "LoadReport"]
+__all__ = ["LoadGenerator", "LoadReport", "service_scale_sweep"]
 
 
 @dataclasses.dataclass
@@ -152,3 +152,76 @@ class LoadGenerator:
                           timeouts=len(self._sent_at),
                           elapsed_s=elapsed,
                           latencies_ms=list(self._latencies))
+
+
+def service_scale_sweep(services: int, broker: str = "scale-sweep",
+                        namespace: str = "scale",
+                        create_timeout_s: float = 120.0,
+                        rpc_timeout_s: float = 120.0) -> dict:
+    """Demonstrate the reference's aspirational service density
+    (1,000-10,000 services/process, reference main/process.py:45-48,
+    an untested TODO there): N actors in ONE process, all discovered
+    by a registrar, one RPC each through the full parse→mailbox→
+    dispatch path.  Raises AssertionError if discovery or any RPC is
+    incomplete within its own (separate) timeout budget.
+
+    Shared by ``tests/test_scale.py`` and the distributed-artifact
+    capture (``scripts/capture_cpu_artifacts.py``)."""
+    import time as time_module
+
+    from ..registry import Registrar
+    from ..runtime import Process, actor_args, compose_instance
+    from ..runtime.actor import Actor
+    from ..runtime.event import EventEngine
+
+    class Echo(Actor):
+        def echo(self, value):
+            self.share["last"] = value
+
+    engine = EventEngine()
+    thread = engine.run_in_thread()
+    process = Process(namespace=namespace, hostname="h", pid="1",
+                      engine=engine, broker=broker)
+    registrar = Registrar(process=process)
+    deadline = time_module.time() + 15
+    while registrar.state != "primary" \
+            and time_module.time() < deadline:
+        time_module.sleep(0.02)
+    try:
+        t0 = time_module.perf_counter()
+        actors = [compose_instance(Echo, actor_args(f"svc{i}"),
+                                   process=process)
+                  for i in range(services)]
+        create_dt = time_module.perf_counter() - t0
+        deadline = time_module.time() + create_timeout_s
+        while len(registrar.services) < services + 1 \
+                and time_module.time() < deadline:
+            time_module.sleep(0.05)
+        discovered = len(registrar.services) - 1
+        assert discovered == services, \
+            f"registrar discovered {discovered}/{services}"
+
+        # RPC sweep gets its OWN budget — slow discovery must not
+        # starve it into a flaky delivery failure.
+        t0 = time_module.perf_counter()
+        for i, actor in enumerate(actors):
+            process.message.publish(actor.topic_in, f"(echo {i})")
+        deadline = time_module.time() + rpc_timeout_s
+        while any("last" not in a.share for a in actors) \
+                and time_module.time() < deadline:
+            time_module.sleep(0.05)
+        rpc_dt = time_module.perf_counter() - t0
+        assert all(a.share.get("last") == str(i)
+                   for i, a in enumerate(actors)), "RPCs missing"
+        return {
+            "services": services,
+            "create_per_sec": round(services / create_dt),
+            "registrar_discovered": discovered,
+            "rpc_sweep_per_sec": round(services / rpc_dt),
+            "exact_indexed_topics": len(process._exact_handlers),
+            "wildcard_patterns": len(process._wildcard_handlers),
+        }
+    finally:
+        process.terminate()
+        engine.terminate()
+        thread.join(timeout=5)

@@ -167,12 +167,30 @@ def capture_speech_chain(round_trips=5):
         broker.stop()
 
 
+def capture_service_scale(services=10_000):
+    """The reference's ASPIRATIONAL scale goal — 1,000-10,000 services
+    per process (reference main/process.py:45-48, an untested TODO
+    there) — demonstrated via the shared sweep
+    (``tools/loadgen.service_scale_sweep``; tests/test_scale.py runs
+    the same code at a smaller N)."""
+    from aiko_services_tpu.tools.loadgen import service_scale_sweep
+
+    started = utc()
+    report = service_scale_sweep(services, broker="scale-capture")
+    report["started"] = started
+    report["note"] = ("reference main/process.py:45-48 lists "
+                      "1,000-10,000 services/process as an untested "
+                      "TODO")
+    return report
+
+
 def main():
     parser = argparse.ArgumentParser()
     parser.add_argument("--out", default="DISTRIBUTED_r04.json")
     parser.add_argument("--pipelines", type=int, default=10)
     parser.add_argument("--frames", type=int, default=400)
     parser.add_argument("--round-trips", type=int, default=5)
+    parser.add_argument("--services", type=int, default=10_000)
     args = parser.parse_args()
 
     rev = subprocess.run(["git", "rev-parse", "--short", "HEAD"],
@@ -184,7 +202,9 @@ def main():
             ("multitude_xproc", capture_multitude,
              dict(pipelines=args.pipelines, frames=args.frames)),
             ("speech_chain_3proc", capture_speech_chain,
-             dict(round_trips=args.round_trips))):
+             dict(round_trips=args.round_trips)),
+            ("service_scale", capture_service_scale,
+             dict(services=args.services))):
         print(f"=== {name} ===", flush=True)
         try:
             doc[name] = fn(**kwargs)
@@ -198,7 +218,8 @@ def main():
     print(f"wrote {args.out}")
     return 0 if all(
         "error" not in doc.get(k, {})
-        for k in ("multitude_xproc", "speech_chain_3proc")) else 1
+        for k in ("multitude_xproc", "speech_chain_3proc",
+                  "service_scale")) else 1
 
 
 if __name__ == "__main__":
