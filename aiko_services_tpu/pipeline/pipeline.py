@@ -29,12 +29,12 @@ Differences by design:
 
 from __future__ import annotations
 
-import importlib
 import time
 from collections import deque
 from typing import Any, Dict, List, Optional, Tuple
 
 from ..utils.graph import Graph
+from ..utils.importer import load_module
 from ..utils.sexpr import generate
 from ..runtime.context import (
     PipelineContext, pipeline_element_args, compose_instance,
@@ -193,7 +193,7 @@ class Pipeline(PipelineElement):
 
     def _instantiate(self, definition: PipelineElementDefinition):
         deploy = definition.deploy_local
-        module = importlib.import_module(deploy.module)
+        module = load_module(deploy.module)
         cls = getattr(module, deploy.class_name)
         return compose_instance(
             cls,

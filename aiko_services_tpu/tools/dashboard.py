@@ -230,7 +230,13 @@ def run_dashboard(stdscr, process):
               help="Print one directory snapshot and exit")
 @click.option("--wait", default=3.0, type=float,
               help="Seconds to wait for the directory in headless mode")
-def main(headless, wait):
+@click.option("--plugin", "plugins", multiple=True,
+              help="Plugin module to load: dotted path or path/to/file.py "
+                   "(registers @dashboard_plugin pages; reference "
+                   "dashboard.py:744)")
+def main(headless, wait, plugins):
+    from ..utils.importer import load_modules
+    load_modules(list(plugins))
     process = default_process()
     thread = process.run(in_thread=True)
     if headless:

@@ -3,6 +3,7 @@ from .sexpr import (
     parse_int, parse_float, parse_number,
 )
 from .graph import Graph, Node
+from .importer import load_module, load_modules
 from .lru_cache import LRUCache
 from .state_machine import StateMachine, StateMachineError
 from .logger import get_logger, get_log_level, TopicLogHandler

@@ -503,3 +503,42 @@ def test_device_prefetcher_terminal_and_depth1_close():
     prefetcher.close()
     prefetcher._thread.join(timeout=2)
     assert not prefetcher._thread.is_alive()
+
+
+def test_file_path_module_descriptor(engine, tmp_path):
+    """Elements deploy from a source-file descriptor, not just a dotted
+    module path (reference importer.py:28-47 via pipeline.py:939); the
+    module is loaded once and cached across elements."""
+    src = tmp_path / "custom_elements.py"
+    src.write_text(
+        "from aiko_services_tpu.pipeline import PipelineElement, StreamEvent\n"
+        "CALLS = []\n"
+        "class PE_Neg(PipelineElement):\n"
+        "    def process_frame(self, stream, i):\n"
+        "        CALLS.append(self.name)\n"
+        "        return StreamEvent.OKAY, {'i': -i}\n")
+    doc = {
+        "version": 0, "name": "p_file", "runtime": "python",
+        "graph": ["(PE_Neg PE_Neg2)"],
+        "elements": [
+            {"name": "PE_Neg",
+             "input": [{"name": "i", "type": "int"}],
+             "output": [{"name": "i", "type": "int"}],
+             "parameters": {},
+             "deploy": {"local": {"module": str(src),
+                                  "class_name": "PE_Neg"}}},
+            {"name": "PE_Neg2",
+             "input": [{"name": "i", "type": "int"}],
+             "output": [{"name": "i", "type": "int"}],
+             "parameters": {},
+             "deploy": {"local": {"module": str(src),
+                                  "class_name": "PE_Neg"}}},
+        ],
+    }
+    pipeline, _ = make_pipeline(engine, doc)
+    results = run_frames(engine, pipeline, [{"i": 5}])
+    assert results == [{"i": 5}]    # negated twice
+    from aiko_services_tpu.utils.importer import load_module
+    module = load_module(str(src))
+    assert module is load_module(str(src))    # cached, one instance
+    assert module.CALLS == ["PE_Neg", "PE_Neg2"]
