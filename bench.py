@@ -1001,7 +1001,10 @@ SECTIONS = [
                                       max_new_tokens=4))
      if SMOKE else bench_speech_chat_small),
     # BASELINE config 3 with the real 8B chat stage.
-    ("speech_chat_8b", 600,
+    # 960 s: two cold compiles (whisper encoder-decoder + 8B int8
+    # prefill/decode) through the relay overran the old 600 s watchdog
+    # in the r04 full capture.
+    ("speech_chat_8b", 960,
      (lambda: bench_speech_chat_8b(n_frames=2, warmup=1,
                                    max_new_tokens=4))
      if SMOKE else bench_speech_chat_8b),
