@@ -44,6 +44,12 @@ __all__ = ["quantize_int8", "dequantize", "int8_matmul",
 #: Pallas compile can wedge the dev relay).
 _INT4_FORCE_XLA = os.environ.get("AIKO_INT4_XLA", "") not in ("", "0")
 
+#: AIKO_INT8_XLA=1 (read at import): route int8_matmul through XLA's
+#: fused convert+dot even at kernel-eligible decode shapes (m <= 64).
+#: Same rationale as the int4 switch: lets the bench capture both int8
+#: lowerings head-to-head with zero new Pallas compiles.
+_INT8_FORCE_XLA = os.environ.get("AIKO_INT8_XLA", "") not in ("", "0")
+
 #: int8 symmetric range (−127…127; −128 unused to keep scales symmetric).
 _QMAX = 127.0
 #: int4 symmetric range (−7…7; −8 unused to keep scales symmetric).
@@ -167,7 +173,7 @@ def int8_matmul(x, q, s, interpret: bool = False):
     # (prefill/training) shapes are compute-bound and XLA's own int8
     # convert+dot fusion handles them without VMEM pressure.
     if not (_PALLAS_TPU and (on_tpu or interpret)) or block_n == 0 \
-            or k % 32 or m > 64:
+            or k % 32 or m > 64 or (_INT8_FORCE_XLA and not interpret):
         out = jnp.dot(x2, q.astype(x.dtype),
                       preferred_element_type=jnp.float32) * s
         return out.astype(x.dtype).reshape(*lead, n)

@@ -960,6 +960,14 @@ def _int4_xla_wrapper(section_fn):
     return run
 
 
+def _int8_xla_wrapper(section_fn):
+    """Force the int8 XLA lowering (same mechanism as int4's)."""
+    def run():
+        os.environ["AIKO_INT8_XLA"] = "1"
+        return section_fn()
+    return run
+
+
 SECTIONS = [
     # (name, per-section budget seconds, zero-arg fn -> result dict)
     ("pipeline", 600,
@@ -979,6 +987,21 @@ SECTIONS = [
     ("llama3_8b_int8", 900,
      _llm_section("llama3_8b_int8", batch_key=True, target=2000,
                   random_int8=True, batch=64, prompt_len=128,
+                  new_tokens=128, config_name="llama3_8b")),
+    # Flagship variants, both zero-Pallas-risk: the XLA int8 lowering
+    # head-to-head at the same batch, and batch 128 (m > 64 takes the
+    # XLA fallback path in ops/quant.int8_matmul, so no new kernel
+    # tiles) — decode is weight-stream-bound, so doubling the batch
+    # nearly doubles the BW ceiling (5,389 -> 8,817 tok/s at r04
+    # geometry).
+    ("llama3_8b_int8_xla", 600,
+     _int8_xla_wrapper(_llm_section(
+         "llama3_8b_int8_xla", batch_key=True, random_int8=True,
+         batch=64, prompt_len=128, new_tokens=128,
+         config_name="llama3_8b"))),
+    ("llama3_8b_int8_b128", 600,
+     _llm_section("llama3_8b_int8_b128", batch_key=True,
+                  random_int8=True, batch=128, prompt_len=128,
                   new_tokens=128, config_name="llama3_8b")),
     ("llm_small", 420, _llm_section("llm", batch=8, prompt_len=128,
                                     new_tokens=256,

@@ -121,11 +121,12 @@ while :; do
         # `git add` fail on the missing pathspec, losing JSON + err.
         : > "$PART"
         say "window open -> running bench ($JSON)"
-        # 3000 s deadline: speech_chat_8b's watchdog grew 600->960 s
-        # after the r04 capture; without headroom the extra 360 s
-        # would deadline-starve the MFU/int4 tail sections.
-        BENCH_PARTIAL="$PART" BENCH_DEADLINE="${BENCH_DEADLINE:-3000}" \
-            timeout 3600 python bench.py > "$JSON" 2> "$ERR"
+        # 3600 s deadline: r04 consumed 2200 s; speech_chat_8b's
+        # watchdog grew 600->960 s and two int8 flagship variants
+        # (~250-300 s each) joined mid-list — without this headroom
+        # the MFU/int4 tail sections get deadline-starved.
+        BENCH_PARTIAL="$PART" BENCH_DEADLINE="${BENCH_DEADLINE:-3600}" \
+            timeout 4200 python bench.py > "$JSON" 2> "$ERR"
         rc=$?
         say "bench run rc=$rc"
         # bench.py deletes BENCH_PARTIAL at startup; a run that died

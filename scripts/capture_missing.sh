@@ -1,88 +1,9 @@
 #!/bin/bash
-# Follow-up capture: hunt relay windows for the sections the full r04
-# capture could not land (deadline truncation + the int4_xla wedge):
-#
-#   speech_chat_8b     — safe paths, just needs a >600 s budget
-#   llama3_8b_int4_xla — XLA grouped-einsum int4 lowering (no Pallas)
-#   llama3_8b_int4     — Pallas int4 kernel (riskiest; LAST)
-#
-# One section per healthy window, probe before each, commit after each
-# (win or lose), riskiest last — a wedge costs only the section that
-# caused it.  Controls: touch STOP_CAPTURE to exit.
-
-cd "$(dirname "$0")/.." || exit 1
-ROUND="${ROUND:-r04}"
-PROBE_TIMEOUT="${PROBE_TIMEOUT:-180}"
-SLEEP_BETWEEN="${SLEEP_BETWEEN:-75}"
-LOG="scripts/capture_missing.log"
-PART="BENCH_SECTIONS_${ROUND}.jsonl"
-
-say() { echo "[$(date -u +%FT%TZ)] $*" >> "$LOG"; }
-
-commit_paths() {
-    msg="$1"; shift
-    for _ in 1 2 3 4 5; do
-        if git add -- "$@" >>"$LOG" 2>&1 \
-           && git commit -q -m "$msg" -- "$@" >>"$LOG" 2>&1; then
-            return 0
-        fi
-        sleep 7
-    done
-    git restore --staged -- "$@" >>"$LOG" 2>&1 \
-        || git reset -q -- "$@" >>"$LOG" 2>&1
-    say "commit FAILED for: $*"
-    return 1
-}
-
-have_section() {
-    python - "$PART" "$1" <<'EOF'
-import json, sys
-try:
-    lines = open(sys.argv[1]).read().splitlines()
-except Exception:
-    sys.exit(1)
-for line in lines:
-    try:
-        d = json.loads(line)
-    except Exception:
-        continue
-    if d.get("section") == sys.argv[2] and d.get("ok"):
-        sys.exit(0)
-sys.exit(1)
-EOF
-}
-
-say "missing-section hunter start (pid $$)"
-# Budgets here must be >= the SECTIONS budget in bench.py (the child
-# arms its watchdog at min(section_budget, --budget), so a smaller
-# value silently re-caps the watchdog below the section's own need).
-for spec in "speech_chat_8b 1000" \
-            "llama3_8b_int4_xla 700" \
-            "llama3_8b_int4 700"; do
-    set -- $spec
-    SECTION="$1"; BUDGET="$2"
-    if have_section "$SECTION"; then
-        say "$SECTION: already captured; skipping"
-        continue
-    fi
-    while :; do
-        if [ -f STOP_CAPTURE ]; then
-            say "STOP_CAPTURE present; exiting"
-            exit 0
-        fi
-        if sh scripts/relay_probe.sh "$PROBE_TIMEOUT" >/dev/null 2>&1; then
-            say "window open -> section $SECTION (budget $BUDGET)"
-            BENCH_PARTIAL="$PART" timeout $((BUDGET + 120)) \
-                python bench.py --section "$SECTION" --budget "$BUDGET" \
-                >> "scripts/capture_missing_${SECTION}.out" 2>&1
-            rc=$?
-            say "$SECTION rc=$rc"
-            [ -f "$PART" ] || : > "$PART"
-            commit_paths "Section capture ${SECTION} (rc=${rc})" "$PART"
-            break
-        fi
-        say "probe failed/wedged; sleeping"
-        sleep "$SLEEP_BETWEEN"
-    done
-done
-say "all missing sections attempted — hunter done"
+# The sections the r04 full capture could not land, in wedge-risk
+# order (riskiest LAST) — a thin wrapper over the generalized hunter.
+# speech_chat_8b needs its full 960 s watchdog; the int4 pair decides
+# the int4-vs-int8 rule (ops/quant.py) head-to-head.
+exec bash "$(dirname "$0")/capture_sections.sh" \
+    "speech_chat_8b 1000" \
+    "llama3_8b_int4_xla 700" \
+    "llama3_8b_int4 700"

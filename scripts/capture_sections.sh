@@ -1,0 +1,95 @@
+#!/bin/bash
+# Generalized window-hunting capture for NAMED bench sections.
+#
+#   scripts/capture_sections.sh "<section> <budget>" ["<section> <budget>" ...]
+#
+# For each "<section> <budget>" argument (in order — put the riskiest
+# LAST): skip it if BENCH_SECTIONS_${ROUND}.jsonl already has an ok
+# result (restart-safe), otherwise hunt for a healthy relay window
+# (probe with a generous timeout), run exactly that bench section in a
+# child process, commit the appended result line, move on.  A section
+# that wedges the relay costs only itself; the next section waits for
+# the next window.
+#
+# Budgets must be >= the SECTIONS budget in bench.py: the child arms
+# its watchdog at min(section_budget, --budget), so a smaller value
+# silently re-caps the watchdog below the section's own need.
+#
+# Controls: touch STOP_CAPTURE to exit at the next loop top.
+
+cd "$(dirname "$0")/.." || exit 1
+ROUND="${ROUND:-r04}"
+PROBE_TIMEOUT="${PROBE_TIMEOUT:-180}"
+SLEEP_BETWEEN="${SLEEP_BETWEEN:-75}"
+LOG="scripts/capture_sections.log"
+PART="BENCH_SECTIONS_${ROUND}.jsonl"
+
+say() { echo "[$(date -u +%FT%TZ)] $*" >> "$LOG"; }
+
+commit_paths() {
+    msg="$1"; shift
+    if git diff --quiet HEAD -- "$@" 2>/dev/null \
+            && ! git status --porcelain -- "$@" 2>/dev/null | grep -q .; then
+        say "nothing new to commit for: $*"
+        return 0
+    fi
+    for _ in 1 2 3 4 5; do
+        if git add -- "$@" >>"$LOG" 2>&1 \
+           && git commit -q -m "$msg" -- "$@" >>"$LOG" 2>&1; then
+            return 0
+        fi
+        sleep 7
+    done
+    git restore --staged -- "$@" >>"$LOG" 2>&1 \
+        || git reset -q -- "$@" >>"$LOG" 2>&1
+    say "commit FAILED for: $*"
+    return 1
+}
+
+have_section() {
+    python - "$PART" "$1" <<'EOF'
+import json, sys
+try:
+    lines = open(sys.argv[1]).read().splitlines()
+except Exception:
+    sys.exit(1)
+for line in lines:
+    try:
+        d = json.loads(line)
+    except Exception:
+        continue
+    if d.get("section") == sys.argv[2] and d.get("ok"):
+        sys.exit(0)
+sys.exit(1)
+EOF
+}
+
+say "section hunter start (pid $$): $*"
+for spec in "$@"; do
+    set -- $spec
+    SECTION="$1"; BUDGET="$2"
+    if have_section "$SECTION"; then
+        say "$SECTION: already captured; skipping"
+        continue
+    fi
+    while :; do
+        if [ -f STOP_CAPTURE ]; then
+            say "STOP_CAPTURE present; exiting"
+            exit 0
+        fi
+        if sh scripts/relay_probe.sh "$PROBE_TIMEOUT" >/dev/null 2>&1; then
+            say "window open -> section $SECTION (budget $BUDGET)"
+            BENCH_PARTIAL="$PART" timeout $((BUDGET + 120)) \
+                python bench.py --section "$SECTION" --budget "$BUDGET" \
+                >> "scripts/capture_${SECTION}.out" 2>&1
+            rc=$?
+            say "$SECTION rc=$rc"
+            [ -f "$PART" ] || : > "$PART"
+            commit_paths "Section capture ${SECTION} (rc=${rc})" "$PART"
+            break
+        fi
+        say "probe failed/wedged; sleeping"
+        sleep "$SLEEP_BETWEEN"
+    done
+done
+say "section hunter done"
