@@ -12,10 +12,12 @@ TPU-native design — static shapes throughout:
 
 * The server owns ``slots`` decode lanes and a KV cache of shape
   ``(slots, max_seq, …)``.  A request is ONE slot for its lifetime.
-* Admission: prompts are right-padded to a power-of-2 bucket (bounded
-  compile count), prefilled at batch 1 (causal attention keeps the real
-  prefix numerics exact regardless of pad garbage), and the bucket's KV
-  rows are copied into the slot (jitted, cache donated → in-place).
+* Admission: prompts are right-padded to a power-of-2 bucket, and each
+  admission wave prefills per-bucket groups in power-of-2 sub-batches
+  (causal attention keeps every row's numerics exact regardless of pad
+  garbage or batch-mates; pow2 everywhere keeps the compile-shape
+  count bounded), landing each sub-batch's KV rows in its slots with
+  one jitted batched scatter (cache donated → in-place).
   The slot is seeded with the LAST prompt token at position
   ``prompt_len - 1``: its KV rewrite is idempotent, and the first chunk
   step then emits the first generated token — no separate
@@ -118,9 +120,18 @@ class ContinuousBatchingServer:
         self.quantize_kv = quantize_kv
         self._bucket_minimum = 16
         self._init_layout()
-        self.positions = jnp.zeros((slots,), jnp.int32)
-        self.active = jnp.zeros((slots,), bool)
-        self.tokens = jnp.zeros((slots, 1), jnp.int32)
+        # Bookkeeping state lives HOST-side (numpy): admissions and
+        # retirements mutate it for free, and it rides into the chunk
+        # dispatch as three tiny h2d transfers.  The device-returned
+        # copies are never fetched — the host mirror advances by the
+        # same deterministic rule the compiled chunk applies
+        # (positions += steps for chunk-active slots, next seed token
+        # = last emitted).  Before this, every admission cost ~4
+        # separate device scatters; over the relay those round-trips
+        # dominated the serving sections.
+        self.positions = np.zeros((slots,), np.int32)
+        self.active = np.zeros((slots,), bool)
+        self.tokens = np.zeros((slots, 1), np.int32)
         self._temperatures = np.zeros(slots, np.float32)
         self._top_ps = np.ones(slots, np.float32)
         self._rng = jax.random.PRNGKey(seed)
@@ -139,22 +150,25 @@ class ContinuousBatchingServer:
             self.config, self.slots, self.max_seq,
             quantize_kv=self.quantize_kv)
 
-        @functools.partial(jax.jit, donate_argnames=("cache",))
-        def insert_slot(cache, bucket_cache, slot):
-            """Copy a prefilled bucket's KV rows into ``slot`` (rows
-            past the prompt hold pad garbage; each is rewritten by the
-            decode step that first makes it attendable)."""
+        @functools.partial(jax.jit, donate_argnames=("cache",),
+                           static_argnames=("padded",))
+        def insert_slots(cache, bucket_cache, slot_rows, padded):
+            """Land a (k, padded, …) prefilled bucket batch in the k
+            rows named by ``slot_rows`` (rows past each prompt hold
+            pad garbage; each is rewritten by the decode step that
+            first makes it attendable) — ONE dispatch per admission
+            sub-batch instead of one per admission."""
             new_cache = []
             for cache_layer, filled in zip(cache, bucket_cache):
-                new_cache.append({
-                    key: jax.lax.dynamic_update_slice(
-                        cache_layer[key],
-                        filled[key].astype(cache_layer[key].dtype),
-                        (slot,) + (0,) * (cache_layer[key].ndim - 1))
-                    for key in cache_layer})
+                layer = {}
+                for key in cache_layer:
+                    dst = cache_layer[key]
+                    layer[key] = dst.at[slot_rows, :padded].set(
+                        filled[key].astype(dst.dtype))
+                new_cache.append(layer)
             return new_cache
 
-        self._insert_slot = insert_slot
+        self._insert_slots = insert_slots
 
     # ------------------------------------------------------------- #
 
@@ -192,6 +206,7 @@ class ContinuousBatchingServer:
         return bool(self._queue) or self.slots_active > 0
 
     def _admit(self) -> None:
+        admissions = []
         for slot in range(self.slots):
             if self._requests[slot] is not None or not self._queue:
                 continue
@@ -207,21 +222,58 @@ class ContinuousBatchingServer:
             self._queue.pop(0)
             prompt_padded = np.zeros((1, padded), np.int32)
             prompt_padded[:, :prompt_len] = prompt
-            bucket_cache = self._prefill_bucket(slot, prompt_padded,
-                                                prompt_len)
-            self._insert_prefix(slot, bucket_cache, padded)
+            admissions.append((slot, request, prompt_padded, prompt_len))
+        if not admissions:
+            return
+        self._prefill_and_insert(admissions)
+        for slot, request, prompt_padded, prompt_len in admissions:
             # Seed with the last prompt token at its own position: the
             # next chunk's first step re-writes that KV row with the
             # identical values and emits the first generated token.
-            self.tokens = self.tokens.at[slot, 0].set(
-                int(prompt[0, -1]))
-            self.positions = self.positions.at[slot].set(prompt_len - 1)
-            self.active = self.active.at[slot].set(True)
+            self.tokens[slot, 0] = prompt_padded[0, prompt_len - 1]
+            self.positions[slot] = prompt_len - 1
+            self.active[slot] = True
             self._temperatures[slot] = max(0.0, float(request.temperature))
             self._top_ps[slot] = float(request.top_p)
             self._requests[slot] = request
             self._emitted[slot] = 0
         self._any_sampled = bool((self._temperatures > 0).any())
+
+    def _prefill_and_insert(self, admissions) -> None:
+        """Admission-group hook.  Contiguous layout: group admissions
+        by bucket size, prefill each group batched (causal attention
+        keeps every row's numerics independent of its batch-mates),
+        and land each batch with ONE batched scatter — dispatch count
+        per admission wave drops from 2 × admissions to ~2 × distinct
+        bucket sizes.  Groups split into power-of-2 sub-batches so the
+        compile-shape count stays bounded at log2(slots) × n_buckets
+        (every compile is a relay risk; same pow2 discipline as the
+        prompt buckets themselves).  (The paged server overrides this
+        with its per-slot prefix-cache walk.)"""
+        jnp = self._jnp
+        groups: Dict[int, List] = {}
+        for slot, request, prompt_padded, prompt_len in admissions:
+            groups.setdefault(prompt_padded.shape[1], []).append(
+                (slot, prompt_padded, prompt_len))
+        for padded, group in groups.items():
+            start = 0
+            while start < len(group):
+                # Largest power of two <= the remaining group.
+                size = 1 << ((len(group) - start).bit_length() - 1)
+                sub = group[start:start + size]
+                start += size
+                slots = [slot for slot, _, _ in sub]
+                prompts = np.concatenate([p for _, p, _ in sub],
+                                         axis=0)
+                bucket_cache = self._llama.init_cache(
+                    self.config, len(sub), padded,
+                    quantize_kv=self.quantize_kv)
+                _, bucket_cache = self._llama.prefill(
+                    self.params, jnp.asarray(prompts), bucket_cache,
+                    self.config)
+                self.cache = self._insert_slots(
+                    self.cache, bucket_cache,
+                    jnp.asarray(np.asarray(slots, np.int32)), padded)
 
     def _reserve_slot(self, slot: int, padded: int, request) -> bool:
         """Capacity hook: claim layout resources for an admission.
@@ -229,9 +281,10 @@ class ContinuousBatchingServer:
         return True
 
     def _prefill_bucket(self, slot: int, prompt_padded, prompt_len: int):
-        """Prefill hook: run the padded prompt into a fresh bucket
-        cache.  (The prefix-caching paged server overrides this to
-        prefill only the uncached tail.)"""
+        """Prefill hook: run the padded prompt into a fresh batch-1
+        bucket cache.  Used by the PAGED server's cache-miss path (its
+        prefix-cache walk is per-slot); the contiguous layout itself
+        admits through the batched ``_prefill_and_insert``."""
         llama, jnp = self._llama, self._jnp
         bucket_cache = llama.init_cache(
             self.config, 1, prompt_padded.shape[1],
@@ -240,11 +293,6 @@ class ContinuousBatchingServer:
             self.params, jnp.asarray(prompt_padded), bucket_cache,
             self.config)
         return bucket_cache
-
-    def _insert_prefix(self, slot: int, bucket_cache, padded: int):
-        """Layout hook: land a prefilled bucket in ``slot``."""
-        self.cache = self._insert_slot(self.cache, bucket_cache,
-                                       self._jnp.int32(slot))
 
     def _release_slot(self, slot: int) -> None:
         """Layout hook: return a retiring slot's resources."""
@@ -255,7 +303,7 @@ class ContinuousBatchingServer:
             self.completed.append(request)
         self._release_slot(slot)
         self._requests[slot] = None
-        self.active = self.active.at[slot].set(False)
+        self.active[slot] = False
         # Reset sampling state so an all-greedy batch returns to the
         # pure-greedy compiled program (no sort/softmax per step).
         self._temperatures[slot] = 0.0
@@ -281,8 +329,17 @@ class ContinuousBatchingServer:
                     rng_key=chunk_key)
             else:
                 sampling = {}          # pure-greedy compiled program
+            chunk_active = self.active.copy()
             out = self._run_chunk(steps, sampling)
             out_host = np.asarray(out)           # (slots, steps)
+            # Advance the host bookkeeping mirror by the same rule the
+            # compiled chunk applied on device: active rows moved
+            # ``steps`` positions and their next seed token is the
+            # last one emitted.  (Slots that retire below are simply
+            # overwritten at their next admission.)
+            self.positions[chunk_active] += steps
+            self.tokens[chunk_active, 0] = out_host[chunk_active,
+                                                    steps - 1]
             for slot in range(self.slots):
                 request = self._requests[slot]
                 if request is None:
@@ -306,12 +363,14 @@ class ContinuousBatchingServer:
         token matrix.  Cache-layout strategy hook: the paged server
         overrides this (and the admission/release hooks) while ALL
         bookkeeping — admission order, budgets, EOS, retirement —
-        stays in this class."""
-        out, self.tokens, self.positions, self.cache = \
+        stays in this class.  The device-side token/position returns
+        are dropped: ``step()`` advances the host mirror instead."""
+        jnp = self._jnp
+        out, _, _, self.cache = \
             self._llama.decode_chunk_ragged(
-                self.params, self.tokens, self.cache,
-                self.positions, self.active, steps, self.config,
-                **sampling)
+                self.params, jnp.asarray(self.tokens), self.cache,
+                jnp.asarray(self.positions), jnp.asarray(self.active),
+                steps, self.config, **sampling)
         return out
 
     def run_until_drained(self, max_chunks: int = 10_000):

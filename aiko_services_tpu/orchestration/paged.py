@@ -265,8 +265,13 @@ class PagedContinuousServer(ContinuousBatchingServer):
             self.prefix_hits += 1
             self.prefix_blocks_reused += len(shared)
         # Register this prompt's remaining shareable blocks for future
-        # requests (their contents exist once _insert_prefix runs,
-        # which happens synchronously within this admission).  Keys
+        # requests.  ORDER DEPENDENCE: within one admission wave every
+        # _reserve_slot runs before any prefill/insert, so a later
+        # request in the wave may pin keys registered here while the
+        # blocks still hold garbage — safe ONLY because
+        # _prefill_and_insert walks the wave in the same admission
+        # order, scattering this request's contents before a later
+        # request's gather.  Keys
         # already indexed are SKIPPED: the pow2 truncation above can
         # leave found-but-unpinned hits whose bindings must not be
         # overwritten (an overwrite would strand the old block in
@@ -286,6 +291,24 @@ class PagedContinuousServer(ContinuousBatchingServer):
                     self._children[parent] = \
                         self._children.get(parent, 0) + 1
         return True
+
+    def _prefill_and_insert(self, admissions) -> None:
+        """Paged admissions stay per-slot: each request's prefix-cache
+        walk (shared blocks gathered, only the uncached tail
+        prefilled) is its own gather/prefill/scatter chain, so there
+        is no common batched shape to group into.
+
+        MUST iterate in admission order: _reserve_slot already
+        registered each request's shareable block keys, and a later
+        request in this wave may have pinned an earlier one's blocks —
+        the earlier scatter has to land before the later gather reads
+        those blocks (see the ORDER DEPENDENCE note in
+        _reserve_slot)."""
+        for slot, request, prompt_padded, prompt_len in admissions:
+            bucket_cache = self._prefill_bucket(slot, prompt_padded,
+                                                prompt_len)
+            self._insert_prefix(slot, bucket_cache,
+                                prompt_padded.shape[1])
 
     def _prefill_bucket(self, slot: int, prompt_padded, prompt_len: int):
         n_shared = self._pending_shared[slot]
@@ -338,9 +361,10 @@ class PagedContinuousServer(ContinuousBatchingServer):
 
     def _run_chunk(self, steps: int, sampling):
         jnp = self._jnp
-        out, self.tokens, self.positions, self.pool = \
+        out, _, _, self.pool = \
             self._llama.decode_chunk_paged(
-                self.params, self.tokens, self.pool,
-                jnp.asarray(self.tables), self.positions, self.active,
-                steps, self.config, **sampling)
+                self.params, jnp.asarray(self.tokens), self.pool,
+                jnp.asarray(self.tables), jnp.asarray(self.positions),
+                jnp.asarray(self.active), steps, self.config,
+                **sampling)
         return out

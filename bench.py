@@ -950,20 +950,13 @@ def _llm_section(prefix, batch_key=False, target=None, **kwargs):
     return run
 
 
-def _int4_xla_wrapper(section_fn):
-    """Force the int4 XLA lowering for this section's CHILD process:
-    the env var is read by ops/quant.py at import, and each section
-    imports the package fresh in its own subprocess."""
+def _force_xla_wrapper(env_var, section_fn):
+    """Force a quantized-matmul XLA lowering (AIKO_INT4_XLA /
+    AIKO_INT8_XLA) for this section's CHILD process: the env var is
+    read by ops/quant.py at import, and each section imports the
+    package fresh in its own subprocess."""
     def run():
-        os.environ["AIKO_INT4_XLA"] = "1"
-        return section_fn()
-    return run
-
-
-def _int8_xla_wrapper(section_fn):
-    """Force the int8 XLA lowering (same mechanism as int4's)."""
-    def run():
-        os.environ["AIKO_INT8_XLA"] = "1"
+        os.environ[env_var] = "1"
         return section_fn()
     return run
 
@@ -995,7 +988,7 @@ SECTIONS = [
     # nearly doubles the BW ceiling (5,389 -> 8,817 tok/s at r04
     # geometry).
     ("llama3_8b_int8_xla", 600,
-     _int8_xla_wrapper(_llm_section(
+     _force_xla_wrapper("AIKO_INT8_XLA", _llm_section(
          "llama3_8b_int8_xla", batch_key=True, random_int8=True,
          batch=64, prompt_len=128, new_tokens=128,
          config_name="llama3_8b"))),
@@ -1057,7 +1050,7 @@ SECTIONS = [
     # tile shapes).  Capturing BOTH decides int4's fate with data: the
     # kernel must beat int8's tok/s or be demoted (VERDICT r2 #3).
     ("llama3_8b_int4_xla", 600,
-     _int4_xla_wrapper(_llm_section(
+     _force_xla_wrapper("AIKO_INT4_XLA", _llm_section(
          "llama3_8b_int4_xla", batch_key=True, bits=4,
          random_int8=True, batch=64, prompt_len=128,
          new_tokens=128, config_name="llama3_8b"))),
