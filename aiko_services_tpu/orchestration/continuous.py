@@ -187,6 +187,11 @@ class ContinuousBatchingServer:
         """Reject hook: a non-None reason fails the request at submit
         time (never queue what can never run — a deferred-forever head
         request would starve the whole FIFO)."""
+        if prompt_len == 0:
+            # There is no last prompt token to seed the slot with; an
+            # empty prompt would decode an all-pad bucket into
+            # plausible-looking garbage.
+            return "empty_prompt"
         if prompt_len + request.max_new_tokens > self.max_seq - 1:
             return "prompt_too_long"
         return None

@@ -303,7 +303,10 @@ class PagedContinuousServer(ContinuousBatchingServer):
         request in this wave may have pinned an earlier one's blocks —
         the earlier scatter has to land before the later gather reads
         those blocks (see the ORDER DEPENDENCE note in
-        _reserve_slot)."""
+        _reserve_slot).  The invariant is regression-locked by
+        test_prefix_cache_concurrent_slots_share_blocks (same-wave
+        share, exact-output assertion): reordering this walk makes
+        that test read garbage KV and fail."""
         for slot, request, prompt_padded, prompt_len in admissions:
             bucket_cache = self._prefill_bucket(slot, prompt_padded,
                                                 prompt_len)

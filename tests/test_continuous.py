@@ -99,6 +99,18 @@ def test_overlong_prompt_rejected_cleanly():
     assert finished[0].tokens == []
 
 
+def test_empty_prompt_rejected_cleanly():
+    """An empty prompt has no seed token; it must fail at submit, not
+    decode an all-pad bucket into plausible-looking garbage."""
+    server = ContinuousBatchingServer(config_name="tiny", slots=1,
+                                      max_seq=32, chunk_steps=2)
+    request = DecodeRequest("x", np.zeros(0, np.int32), 8)
+    server.submit(request)
+    finished = server.run_until_drained()
+    assert finished[0].error == "empty_prompt"
+    assert finished[0].tokens == []
+
+
 def test_continuous_replica_wire_protocol(engine):
     """(infer …) over the loopback broker → infer_response with the
     greedy tokens; flatout pump retires itself when drained."""
