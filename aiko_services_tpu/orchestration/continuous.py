@@ -84,7 +84,8 @@ class ContinuousBatchingServer:
     def __init__(self, config_name: str = "tiny", slots: int = 4,
                  max_seq: Optional[int] = None, chunk_steps: int = 8,
                  quantize: bool = False, eos_id: Optional[int] = None,
-                 seed: int = 0, quantize_kv: bool = False, mesh=None):
+                 seed: int = 0, quantize_kv: bool = False, mesh=None,
+                 lookahead: int = 1):
         import jax
         import jax.numpy as jnp
         from ..models import llama
@@ -116,6 +117,20 @@ class ContinuousBatchingServer:
         # max_seq-2 positions.
         self.max_seq = max_seq or self.config.max_seq_len
         self.chunk_steps = chunk_steps
+        # Multi-step scheduling: dispatch up to ``lookahead`` chunks
+        # back-to-back with the device-returned tokens/positions chained
+        # chunk-to-chunk, then sync to host ONCE for the whole run.
+        # Bookkeeping (EOS, budgets, admission) lags by the run length,
+        # but the device never idles waiting on a host round trip —
+        # over the relay (~100 ms/dispatch) that round trip, not
+        # compute, dominates the serving sections.  1 = sync every
+        # chunk (the exact original behavior).  GREEDY outputs are
+        # identical for every value (slot isolation is exact, tested);
+        # SAMPLED outputs are identical while the chunk-vs-admission
+        # timeline is unchanged (tested) but may legitimately differ
+        # when a mid-run EOS shifts a queued request's admission chunk
+        # — the request then draws different RNG chunk keys.
+        self.lookahead = max(1, int(lookahead))
         self.eos_id = eos_id
         self.quantize_kv = quantize_kv
         self._bucket_minimum = 16
@@ -316,8 +331,8 @@ class ContinuousBatchingServer:
         self._any_sampled = bool((self._temperatures > 0).any())
 
     def step(self) -> List[DecodeRequest]:
-        """Admit pending requests, decode one chunk, retire finished
-        slots.  Returns (and clears) the completed list."""
+        """Admit pending requests, decode one chunk run, retire
+        finished slots.  Returns (and clears) the completed list."""
         self._admit()
         if any(r is not None for r in self._requests):
             remaining = [self._requests[s].max_new_tokens
@@ -325,31 +340,60 @@ class ContinuousBatchingServer:
                          for s in range(self.slots)
                          if self._requests[s] is not None]
             steps = int(max(1, min(self.chunk_steps, max(remaining))))
-            if self._any_sampled:
-                jnp = self._jnp
-                self._rng, chunk_key = self._jax.random.split(self._rng)
-                sampling = dict(
-                    temperatures=jnp.asarray(self._temperatures),
-                    top_ps=jnp.asarray(self._top_ps),
-                    rng_key=chunk_key)
-            else:
-                sampling = {}          # pure-greedy compiled program
+            # How many chunks may run before bookkeeping MUST happen:
+            # the earliest budget retirement (so a freed slot is not
+            # held past its readmission point).  An EOS retirement
+            # inside the run costs that slot at most lookahead-1
+            # chunks of FULL decode (active_d is frozen for the run,
+            # so the slot keeps computing and writing KV rows at
+            # advancing positions) — its post-EOS tokens are dropped
+            # on the host, never delivered, and the stale rows are
+            # rewritten at the slot's next admission.
+            budget_chunks = max(1, -(-min(remaining) // steps))
+            n_chunks = min(self.lookahead, budget_chunks)
             chunk_active = self.active.copy()
-            out = self._run_chunk(steps, sampling)
-            out_host = np.asarray(out)           # (slots, steps)
+            jnp = self._jnp
+            tokens_d = jnp.asarray(self.tokens)
+            positions_d = jnp.asarray(self.positions)
+            active_d = jnp.asarray(self.active)
+            # Per-run-constant uploads stay OUT of the chunk loop
+            # (only the RNG key varies chunk-to-chunk).
+            if self._any_sampled:
+                temperatures_d = jnp.asarray(self._temperatures)
+                top_ps_d = jnp.asarray(self._top_ps)
+            self._begin_run()
+            outs = []
+            for _ in range(n_chunks):
+                if self._any_sampled:
+                    self._rng, chunk_key = \
+                        self._jax.random.split(self._rng)
+                    sampling = dict(temperatures=temperatures_d,
+                                    top_ps=top_ps_d,
+                                    rng_key=chunk_key)
+                else:
+                    sampling = {}      # pure-greedy compiled program
+                out, tokens_d, positions_d = self._run_chunk(
+                    tokens_d, positions_d, active_d, steps, sampling)
+                outs.append(out)
+            # ONE host sync for the whole run (each fetch is ~KB; all
+            # chunks are already enqueued, so later ones compute while
+            # earlier ones transfer).
+            out_host = np.concatenate(
+                [np.asarray(out) for out in outs], axis=1)
+            total = steps * n_chunks
             # Advance the host bookkeeping mirror by the same rule the
-            # compiled chunk applied on device: active rows moved
-            # ``steps`` positions and their next seed token is the
+            # compiled chunks applied on device: active rows moved
+            # ``total`` positions and their next seed token is the
             # last one emitted.  (Slots that retire below are simply
             # overwritten at their next admission.)
-            self.positions[chunk_active] += steps
+            self.positions[chunk_active] += total
             self.tokens[chunk_active, 0] = out_host[chunk_active,
-                                                    steps - 1]
+                                                    total - 1]
             for slot in range(self.slots):
                 request = self._requests[slot]
                 if request is None:
                     continue
-                for step_index in range(steps):
+                for step_index in range(total):
                     if self._emitted[slot] >= request.max_new_tokens:
                         break
                     token = int(out_host[slot, step_index])
@@ -363,20 +407,25 @@ class ContinuousBatchingServer:
         done, self.completed = self.completed, []
         return done
 
-    def _run_chunk(self, steps: int, sampling: Dict):
-        """Decode ``steps`` tokens for all slots; returns the emitted
-        token matrix.  Cache-layout strategy hook: the paged server
-        overrides this (and the admission/release hooks) while ALL
-        bookkeeping — admission order, budgets, EOS, retirement —
-        stays in this class.  The device-side token/position returns
-        are dropped: ``step()`` advances the host mirror instead."""
-        jnp = self._jnp
-        out, _, _, self.cache = \
+    def _begin_run(self) -> None:
+        """Layout hook called once before a chunk run: stage any
+        layout state that is constant for the whole run (the paged
+        server uploads its block tables here, once, instead of once
+        per chunk)."""
+
+    def _run_chunk(self, tokens_d, positions_d, active_d, steps: int,
+                   sampling: Dict):
+        """Decode ``steps`` tokens for all slots from device-resident
+        decode state; returns ``(out, tokens_d, positions_d)`` so a
+        lookahead run can chain chunks without a host sync.  Cache-
+        layout strategy hook: the paged server overrides this (and the
+        admission/release hooks) while ALL bookkeeping — admission
+        order, budgets, EOS, retirement — stays in this class."""
+        out, tokens_d, positions_d, self.cache = \
             self._llama.decode_chunk_ragged(
-                self.params, jnp.asarray(self.tokens), self.cache,
-                jnp.asarray(self.positions), jnp.asarray(self.active),
-                steps, self.config, **sampling)
-        return out
+                self.params, tokens_d, self.cache,
+                positions_d, active_d, steps, self.config, **sampling)
+        return out, tokens_d, positions_d
 
     def run_until_drained(self, max_chunks: int = 10_000):
         """Synchronous helper (tests / batch jobs): pump until every

@@ -59,14 +59,15 @@ class PagedContinuousServer(ContinuousBatchingServer):
                  seed: int = 0, quantize_kv: bool = False,
                  block_size: int = 16,
                  total_blocks: Optional[int] = None,
-                 enable_prefix_cache: bool = False):
+                 enable_prefix_cache: bool = False,
+                 lookahead: int = 1):
         self.block_size = block_size
         self._requested_blocks = total_blocks
         self.enable_prefix_cache = enable_prefix_cache
         super().__init__(config_name=config_name, slots=slots,
                          max_seq=max_seq, chunk_steps=chunk_steps,
                          quantize=quantize, eos_id=eos_id, seed=seed,
-                         quantize_kv=quantize_kv)
+                         quantize_kv=quantize_kv, lookahead=lookahead)
 
     # ------------------------------------------------------------- #
     # Layout hooks
@@ -362,12 +363,17 @@ class PagedContinuousServer(ContinuousBatchingServer):
         self._pending_shared[slot] = 0
         self.tables[slot] = 0
 
-    def _run_chunk(self, steps: int, sampling):
-        jnp = self._jnp
-        out, _, _, self.pool = \
+    def _begin_run(self) -> None:
+        # Block tables cannot change mid-run (admission/retirement
+        # happen only at run boundaries): upload once per run.
+        self._tables_d = self._jnp.asarray(self.tables)
+
+    def _run_chunk(self, tokens_d, positions_d, active_d, steps: int,
+                   sampling):
+        out, tokens_d, positions_d, self.pool = \
             self._llama.decode_chunk_paged(
-                self.params, jnp.asarray(self.tokens), self.pool,
-                jnp.asarray(self.tables), jnp.asarray(self.positions),
-                jnp.asarray(self.active), steps, self.config,
+                self.params, tokens_d, self.pool,
+                self._tables_d, positions_d,
+                active_d, steps, self.config,
                 **sampling)
-        return out
+        return out, tokens_d, positions_d

@@ -558,10 +558,13 @@ def bench_llm_decode(batch=8, prompt_len=128, new_tokens=256,
 
 def bench_serving_continuous(slots=8, prompt_len=64, max_new=64,
                              n_requests=24, config_name="small",
-                             chunk_steps=16):
+                             chunk_steps=16, lookahead=4):
     """Sustained tokens/sec through the CONTINUOUS-BATCHING serving
     stack (admission, bucketed prefill, slot bookkeeping included) —
-    the serving-stack view of the decode numbers above."""
+    the serving-stack view of the decode numbers above.  ``lookahead``
+    chains that many decode chunks device-side per host sync
+    (multi-step scheduling — over the relay, the per-chunk host round
+    trip dominates this section; greedy outputs identical, tested)."""
     from aiko_services_tpu.orchestration.continuous import (
         ContinuousBatchingServer, DecodeRequest, _bucket,
     )
@@ -569,7 +572,7 @@ def bench_serving_continuous(slots=8, prompt_len=64, max_new=64,
     server = ContinuousBatchingServer(
         config_name=config_name, slots=slots,
         max_seq=_bucket(prompt_len) + max_new + chunk_steps,
-        chunk_steps=chunk_steps, quantize=True)
+        chunk_steps=chunk_steps, quantize=True, lookahead=lookahead)
     rng = np.random.default_rng(0)
 
     def submit_batch(count, tag):
@@ -803,7 +806,8 @@ def bench_detector_mfu():
 
 def bench_serving_paged(slots=8, prompt_len=64, max_new=64,
                         n_requests=24, config_name="small",
-                        chunk_steps=16, shared_prefix=48):
+                        chunk_steps=16, shared_prefix=48,
+                        lookahead=4):
     """Sustained tokens/sec through the PAGED serving stack with the
     prefix cache on: requests share a ``shared_prefix``-token prompt
     head, so later admissions skip prefill work for the shared blocks
@@ -822,7 +826,8 @@ def bench_serving_paged(slots=8, prompt_len=64, max_new=64,
     server = PagedContinuousServer(
         config_name=config_name, slots=slots, max_seq=max_seq,
         chunk_steps=chunk_steps, quantize=True,
-        block_size=block_size, enable_prefix_cache=True)
+        block_size=block_size, enable_prefix_cache=True,
+        lookahead=lookahead)
     rng = np.random.default_rng(0)
     prefix = rng.integers(1, server.config.vocab_size,
                           shared_prefix).astype(np.int32)
@@ -985,17 +990,21 @@ SECTIONS = [
     # head-to-head at the same batch, and batch 128 (m > 64 takes the
     # XLA fallback path in ops/quant.int8_matmul, so no new kernel
     # tiles) — decode is weight-stream-bound, so doubling the batch
-    # nearly doubles the BW ceiling (5,389 -> 8,817 tok/s at r04
-    # geometry).
+    # nearly doubles the BW ceiling.  Batch 128 needs the int8-KV
+    # composition: with bf16 KV the resident set exceeds the 16 GB
+    # HBM (hardware-observed RESOURCE_EXHAUSTED, r04), so the b128 and
+    # b256 variants form a batch-scaling sweep at int8 weights +
+    # int8 KV.
     ("llama3_8b_int8_xla", 600,
      _force_xla_wrapper("AIKO_INT8_XLA", _llm_section(
          "llama3_8b_int8_xla", batch_key=True, random_int8=True,
          batch=64, prompt_len=128, new_tokens=128,
          config_name="llama3_8b"))),
-    ("llama3_8b_int8_b128", 600,
-     _llm_section("llama3_8b_int8_b128", batch_key=True,
-                  random_int8=True, batch=128, prompt_len=128,
-                  new_tokens=128, config_name="llama3_8b")),
+    ("llama3_8b_int8_b128_kv8", 600,
+     _llm_section("llama3_8b_int8_b128_kv8", batch_key=True,
+                  random_int8=True, quantize_kv=True, batch=128,
+                  prompt_len=128, new_tokens=128,
+                  config_name="llama3_8b")),
     # Batch 256 fits the 16 GB HBM only through the quantization
     # COMPOSITION (int8 weights 7.5 GB + int8 KV 4.6 GB); BW ceiling
     # ~17.4k tok/s.  XLA paths throughout (m=256 bypasses the Pallas

@@ -167,6 +167,77 @@ def test_mixed_greedy_and_sampled_slots():
                for t in sampled.tokens)
 
 
+def test_lookahead_outputs_identical():
+    """Multi-step scheduling (lookahead > 1: several chunks chained
+    device-side per host sync) is a pure latency-hiding change: outputs
+    are token-identical to the sync-every-chunk server AND the
+    per-request oracle, through forced queueing and slot reuse."""
+    specs = [(5, 6), (11, 3), (3, 9), (17, 5), (8, 1), (24, 7)]
+    outs = {}
+    for lookahead in (1, 4):
+        server = ContinuousBatchingServer(
+            config_name="tiny", slots=2, max_seq=96, chunk_steps=4,
+            seed=3, lookahead=lookahead)
+        rng = np.random.default_rng(0)
+        requests = []
+        for i, (plen, new) in enumerate(specs):
+            prompt = rng.integers(1, server.config.vocab_size,
+                                  plen).astype(np.int32)
+            requests.append(DecodeRequest(
+                request_id=f"r{i}", prompt=prompt, max_new_tokens=new))
+        for request in requests:
+            server.submit(request)
+        server.run_until_drained()
+        outs[lookahead] = {r.request_id: list(r.tokens)
+                           for r in requests}
+        if lookahead == 1:
+            oracle_server = server
+    assert outs[1] == outs[4]
+    # Oracle check on one representative request (full oracle sweep is
+    # test_continuous_matches_per_request_greedy's job).
+    rng = np.random.default_rng(0)
+    prompt0 = rng.integers(1, oracle_server.config.vocab_size,
+                           specs[0][0]).astype(np.int32)
+    assert outs[4]["r0"] == reference_greedy(oracle_server, prompt0,
+                                             specs[0][1])
+
+
+def test_lookahead_eos_still_truncates():
+    """EOS inside a lookahead run: the slot's post-EOS tokens are
+    decoded speculatively on device but never delivered."""
+    for lookahead in (1, 3):
+        server = ContinuousBatchingServer(
+            config_name="tiny", slots=1, max_seq=96, chunk_steps=4,
+            seed=5, lookahead=lookahead)
+        prompt = np.arange(1, 8, dtype=np.int32)
+        want = reference_greedy(server, prompt, 12)
+        server.eos_id = want[2]
+        request = DecodeRequest("e", prompt, 12)
+        server.submit(request)
+        server.run_until_drained()
+        assert request.tokens == want[:3], lookahead
+
+
+def test_lookahead_sampled_identical():
+    """The RNG key schedule is one split per chunk; while the
+    chunk-vs-admission timeline is unchanged (no mid-run EOS shifting
+    a queued admission, as here) SAMPLED outputs are bitwise identical
+    across lookahead settings."""
+    outs = {}
+    for lookahead in (1, 2):
+        server = ContinuousBatchingServer(
+            config_name="tiny", slots=2, max_seq=96, chunk_steps=4,
+            seed=8, lookahead=lookahead)
+        rng = np.random.default_rng(9)
+        sampled = DecodeRequest("s", rng.integers(1, 500, 7)
+                                .astype(np.int32), 8,
+                                temperature=1.0, top_p=0.9)
+        server.submit(sampled)
+        server.run_until_drained()
+        outs[lookahead] = list(sampled.tokens)
+    assert outs[1] == outs[2]
+
+
 def test_continuous_replica_telemetry_in_share(engine):
     """Slot occupancy and queue depth surface in the replica's EC share
     while requests are live, and return to zero once drained."""

@@ -59,6 +59,23 @@ def test_paged_matches_contiguous_server():
         outs["PagedContinuousServer"]
 
 
+def test_paged_lookahead_outputs_identical():
+    """Lookahead chains decode_chunk_paged calls device-side (pool and
+    block tables unchanged between chunks); outputs stay identical to
+    the sync-every-chunk paged server."""
+    spec = [(7, 5), (13, 4), (4, 8), (19, 6)]
+    outs = {}
+    for lookahead in (1, 3):
+        server = PagedContinuousServer(
+            config_name="tiny", slots=2, max_seq=64, chunk_steps=3,
+            seed=5, lookahead=lookahead)
+        for request in _requests(server.config, spec, seed=9):
+            server.submit(request)
+        finished = server.run_until_drained()
+        outs[lookahead] = {r.request_id: r.tokens for r in finished}
+    assert outs[1] == outs[3]
+
+
 def test_paged_block_accounting_and_reuse():
     """Blocks are reserved worst-case at admission and ALL return to
     the pool at retirement."""
