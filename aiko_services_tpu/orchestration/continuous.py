@@ -66,6 +66,12 @@ class DecodeRequest:
     #: 0 = greedy (exact, default); > 0 samples with optional nucleus.
     temperature: float = 0.0
     top_p: float = 1.0
+    #: Deliver ``(infer_partial …)`` token increments as decode chunks
+    #: complete (the final ``(infer_response …)`` still carries the
+    #: full sequence).  The reference's LLM element blocks on the whole
+    #: completion (examples/llm/elements_llm.py:185); streaming falls
+    #: out of continuous batching for free.
+    stream: bool = False
     # Filled by the server:
     tokens: Optional[List[int]] = None
     error: Optional[str] = None
@@ -211,10 +217,15 @@ class ContinuousBatchingServer:
             return "prompt_too_long"
         return None
 
+    def live_requests(self) -> List[DecodeRequest]:
+        """Requests currently holding a decode slot (streaming
+        delivery and operator introspection)."""
+        return [r for r in self._requests if r is not None]
+
     @property
     def slots_active(self) -> int:
         """Live decode lanes (operator telemetry)."""
-        return sum(r is not None for r in self._requests)
+        return len(self.live_requests())
 
     @property
     def queue_depth(self) -> int:
@@ -456,6 +467,8 @@ class ContinuousReplica(Actor):
         self.share["slots"] = self.server.slots
         self.share["requests_served"] = 0
         self._pumping = False
+        #: request_id -> tokens already delivered via infer_partial.
+        self._stream_sent: Dict[str, int] = {}
 
     def _wire_infer(self, request_id, response_topic, payload=None):
         from ..pipeline.codec import decode_swag
@@ -471,6 +484,8 @@ class ContinuousReplica(Actor):
             request.temperature = float(
                 np.asarray(inputs.get("temperature", 0.0)))
             request.top_p = float(np.asarray(inputs.get("top_p", 1.0)))
+            request.stream = bool(
+                int(np.asarray(inputs.get("stream", 0))))
         except Exception:  # noqa: BLE001 - bad request must still respond
             self.logger.exception("%s: malformed infer request %s",
                                   self.name, request_id)
@@ -491,7 +506,9 @@ class ContinuousReplica(Actor):
                            delay=0.001)
 
     def _pump(self):
-        for request in self.server.step():
+        finished = self.server.step()
+        self._stream_partials()
+        for request in finished:
             self._respond(request)
         self._share_telemetry()
         if self.server.busy or self.server.completed:
@@ -515,8 +532,35 @@ class ContinuousReplica(Actor):
             for key, value in changed.items():
                 self.ec_producer.update(key, value)
 
+    def _stream_partials(self):
+        """Deliver newly decoded tokens for every live streaming
+        request — one ``(infer_partial request_id swag)`` per pump
+        with the increment since the last delivery."""
+        for request in self.server.live_requests():
+            self._emit_partial(request)
+
+    def _emit_partial(self, request: DecodeRequest):
+        if not (request.stream and request.response_topic
+                and request.tokens):
+            return
+        sent = self._stream_sent.get(request.request_id, 0)
+        if len(request.tokens) <= sent:
+            return
+        from ..pipeline.codec import encode_swag
+        increment = np.asarray(request.tokens[sent:], np.int32)
+        self._stream_sent[request.request_id] = len(request.tokens)
+        self.process.message.publish(
+            request.response_topic,
+            generate("infer_partial",
+                     [request.request_id,
+                      encode_swag({"tokens_out": increment})]))
+
     def _respond(self, request: DecodeRequest):
         from ..pipeline.codec import encode_swag
+        # Flush the final streaming increment first: concatenated
+        # partials always equal the final sequence.
+        self._emit_partial(request)
+        self._stream_sent.pop(request.request_id, None)
         self.share["requests_served"] += 1
         if self.ec_producer is not None:
             self.ec_producer.update("requests_served",

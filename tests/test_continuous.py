@@ -167,6 +167,51 @@ def test_mixed_greedy_and_sampled_slots():
                for t in sampled.tokens)
 
 
+def test_streaming_partials_over_wire(engine):
+    """(infer … (stream: 1)) delivers infer_partial increments as
+    chunks complete; their concatenation equals the final
+    infer_response tokens, which equal the greedy oracle."""
+    process = Process(namespace="test", hostname="h", pid="77",
+                      engine=engine, broker="stream")
+    server = ContinuousBatchingServer(config_name="tiny", slots=2,
+                                      max_seq=96, chunk_steps=3,
+                                      seed=6)
+    replica = compose_instance(
+        ContinuousReplica, actor_args("cbs"), process=process,
+        server=server)
+    partials, finals = [], []
+
+    def handler(_topic, payload):
+        command, params = parse(payload)
+        if command == "infer_partial":
+            partials.append((params[0],
+                             list(decode_swag(params[1])["tokens_out"])))
+        elif command == "infer_response":
+            finals.append((params[0], decode_swag(params[1])))
+
+    process.add_message_handler(handler, "test/stream_resp")
+    prompt = np.arange(1, 12, dtype=np.int32)
+    process.message.publish(
+        replica.topic_in,
+        generate("infer", ["s1", "test/stream_resp",
+                           encode_swag({"tokens": prompt,
+                                        "max_new_tokens": 9,
+                                        "stream": 1})]))
+    for _ in range(5000):
+        engine.advance(0.001)
+        if finals:
+            break
+    assert finals, "no final infer_response"
+    request_id, outputs = finals[0]
+    assert request_id == "s1"
+    want = reference_greedy(server, prompt, 9)
+    assert list(outputs["tokens_out"]) == want
+    assert len(partials) >= 2, partials          # actually incremental
+    joined = [t for _, increment in partials for t in increment]
+    assert joined == want                        # partials ≡ final
+    assert replica._stream_sent == {}            # state cleaned up
+
+
 def test_lookahead_outputs_identical():
     """Multi-step scheduling (lookahead > 1: several chunks chained
     device-side per host sync) is a pure latency-hiding change: outputs
