@@ -334,6 +334,42 @@ def _matmul(x, w):
     return x @ w
 
 
+# --------------------------------------------------------------------------- #
+# Batched multi-adapter LoRA (SLoRA/punica-style serving)
+#
+# A serving batch where every row may run a DIFFERENT fine-tuned
+# adapter: per-layer factors are stacked over a leading adapter axis
+# (n_adapters, d_in, r) / (n_adapters, r, d_out) with index 0 reserved
+# as the all-zero identity (a base-model row), and each batch row
+# gathers its own pair.  The base weight stream — the decode
+# bottleneck — is paid ONCE for the whole mixed batch; the rank-r
+# delta adds O(r·(d_in+d_out)) per row.  The reference serves exactly
+# one model binary per process (its LLM element shells out to one
+# Ollama model, examples/llm/elements_llm.py:185-191).
+
+def _lora_delta(x, factors, ids, scale):
+    """Per-row low-rank delta: ``x`` (batch, q, d_in) through row
+    ``i``'s own (A, B) = (factors["a"][ids[i]], factors["b"][ids[i]]).
+    Computed in f32 (rank-r intermediates are tiny) and cast back."""
+    a = factors["a"][ids].astype(jnp.float32)     # (batch, d_in, r)
+    b = factors["b"][ids].astype(jnp.float32)     # (batch, r, d_out)
+    delta = jnp.einsum("bqd,bdr,bro->bqo", x.astype(jnp.float32),
+                       a, b)
+    return (scale * delta).astype(x.dtype)
+
+
+def _lora_matmul(x, w, lora_layer, target, lora):
+    """Base matmul plus the row-gathered adapter delta when ``target``
+    is adapted; exactly ``_matmul`` otherwise (and for lora=None the
+    call sites skip this entirely — the compiled program is
+    unchanged)."""
+    out = _matmul(x, w)
+    factors = lora_layer.get(target) if lora_layer else None
+    if factors is not None:
+        out = out + _lora_delta(x, factors, lora["ids"], lora["scale"])
+    return out
+
+
 def _embed_lookup(params, tokens, dtype):
     embed = params["embed"]
     if is_quantized_int4(embed):
@@ -720,25 +756,33 @@ def _cache_write_rows(cache_layer, k, v, positions):
 
 @functools.partial(jax.jit, static_argnames=("config",),
                    donate_argnames=("cache",))
-def prefill(params, tokens, cache, config: LlamaConfig):
+def prefill(params, tokens, cache, config: LlamaConfig, lora=None):
     """Run the prompt through the model filling the KV cache; returns
     (logits_last, cache).  The input cache is DONATED (every caller
     rebinds it): without aliasing, the empty input cache and the
     filled output cache are simultaneously resident, doubling KV
     footprint exactly when prefill peaks — hardware-observed
     RESOURCE_EXHAUSTED for 8B int8 + int8-KV at batch 256 (r04),
-    which fits comfortably once donated."""
+    which fits comfortably once donated.  ``lora``: optional batched
+    per-row adapters (see :func:`_decode_core_ragged`) — admission
+    prefill must apply the SAME adapter the decode chunks will, or
+    the prompt KV would be base-model state."""
     batch, seq = tokens.shape
     positions = jnp.broadcast_to(jnp.arange(seq), (batch, seq))
     cos, sin = _rope_freqs(config, positions)
     x = _embed_lookup(params, tokens, config.dtype)
     new_cache = []
-    for layer, cache_layer in zip(params["layers"], cache):
+    lora_layers = lora["layers"] if lora else [None] * len(cache)
+    for layer, cache_layer, lora_layer in zip(params["layers"], cache,
+                                              lora_layers):
         normed = rms_norm(x, layer["attn_norm"], config.norm_eps)
         h, kv, hd = config.n_heads, config.n_kv_heads, config.head_dim
-        q = _matmul(normed, layer["wq"]).reshape(batch, seq, h, hd)
-        k = _matmul(normed, layer["wk"]).reshape(batch, seq, kv, hd)
-        v = _matmul(normed, layer["wv"]).reshape(batch, seq, kv, hd)
+        q = _lora_matmul(normed, layer["wq"], lora_layer, "wq",
+                         lora).reshape(batch, seq, h, hd)
+        k = _lora_matmul(normed, layer["wk"], lora_layer, "wk",
+                         lora).reshape(batch, seq, kv, hd)
+        v = _lora_matmul(normed, layer["wv"], lora_layer, "wv",
+                         lora).reshape(batch, seq, kv, hd)
         q, k = apply_rope(q, cos, sin), apply_rope(k, cos, sin)
         new_cache.append(_cache_write_slab(cache_layer, k, v, 0))
         q_t = q.transpose(0, 2, 1, 3)
@@ -747,7 +791,8 @@ def prefill(params, tokens, cache, config: LlamaConfig):
         out = flash_attention(q_t, k_t, v_t, causal=True,
                               window=config.sliding_window)
         out = out.transpose(0, 2, 1, 3).reshape(batch, seq, h * hd)
-        x = x + _matmul(out, layer["wo"]).astype(x.dtype)
+        x = x + _lora_matmul(out, layer["wo"], lora_layer, "wo",
+                             lora).astype(x.dtype)
         x = _mlp_block(layer, config, x)
     x = rms_norm(x, params["final_norm"], config.norm_eps)
     logits = _matmul(x[:, -1:], params["lm_head"]).astype(jnp.float32)
@@ -1034,16 +1079,20 @@ def _cached_gqa_attention(q, cache_layer, query_positions, hd,
 
 
 def _attention_decode_ragged(layer, config, x, cos, sin, cache_layer,
-                             positions):
+                             positions, lora=None, lora_layer=None):
     """Single-token decode where every batch row sits at its OWN cache
     position (continuous batching: slots admit/finish independently).
-    ``x`` (batch, 1, d), ``positions`` (batch,) int32."""
+    ``x`` (batch, 1, d), ``positions`` (batch,) int32.  ``lora``:
+    optional per-row batched adapters (see :func:`_lora_delta`)."""
     batch, seq, _ = x.shape
     h, kv, hd = config.n_heads, config.n_kv_heads, config.head_dim
     normed = rms_norm(x, layer["attn_norm"], config.norm_eps)
-    q = _matmul(normed, layer["wq"]).reshape(batch, seq, h, hd)
-    k = _matmul(normed, layer["wk"]).reshape(batch, seq, kv, hd)
-    v = _matmul(normed, layer["wv"]).reshape(batch, seq, kv, hd)
+    q = _lora_matmul(normed, layer["wq"], lora_layer, "wq",
+                     lora).reshape(batch, seq, h, hd)
+    k = _lora_matmul(normed, layer["wk"], lora_layer, "wk",
+                     lora).reshape(batch, seq, kv, hd)
+    v = _lora_matmul(normed, layer["wv"], lora_layer, "wv",
+                     lora).reshape(batch, seq, kv, hd)
     q = apply_rope(q, cos, sin)
     k = apply_rope(k, cos, sin)
 
@@ -1055,22 +1104,28 @@ def _attention_decode_ragged(layer, config, x, cos, sin, cache_layer,
                                 positions[:, None], hd,
                                 window=config.sliding_window)
     out = out.reshape(batch, seq, h * hd)
-    return x + _matmul(out, layer["wo"]).astype(x.dtype), new_cache
+    return x + _lora_matmul(out, layer["wo"], lora_layer, "wo",
+                            lora).astype(x.dtype), new_cache
 
 
 def _decode_core_ragged(params, token, cache, positions,
-                        config: LlamaConfig):
+                        config: LlamaConfig, lora=None):
     """One autoregressive step with PER-ROW cache positions: token
     (batch, 1) + positions (batch,) → (logits (batch, 1, vocab),
-    new_cache)."""
+    new_cache).  ``lora``: optional batched per-row adapters —
+    ``{"ids": (batch,), "scale": float, "layers": [per-layer
+    {target: {"a": (n, d_in, r), "b": (n, r, d_out)}}]}``."""
     positions_2d = positions[:, None]
     cos, sin = _rope_freqs(config, positions_2d)
     x = _embed_lookup(params, token, config.dtype)
     new_cache = []
-    for layer, cache_layer in zip(params["layers"], cache):
+    lora_layers = lora["layers"] if lora else [None] * len(cache)
+    for layer, cache_layer, lora_layer in zip(params["layers"], cache,
+                                              lora_layers):
         x, updated = _attention_decode_ragged(layer, config, x, cos,
                                               sin, cache_layer,
-                                              positions)
+                                              positions, lora,
+                                              lora_layer)
         new_cache.append(updated)
         x = _mlp_block(layer, config, x)
     x = rms_norm(x, params["final_norm"], config.norm_eps)
@@ -1083,7 +1138,8 @@ def _decode_core_ragged(params, token, cache, positions,
                    donate_argnames=("cache",))
 def decode_chunk_ragged(params, tokens, cache, positions, active,
                         num_steps, config: LlamaConfig,
-                        temperatures=None, top_ps=None, rng_key=None):
+                        temperatures=None, top_ps=None, rng_key=None,
+                        lora=None):
     """Decode ``num_steps`` tokens for a slot batch where each row has
     its own position and an ``active`` flag — ONE compiled scan (the
     continuous-batching inner loop; admission happens between chunks).
@@ -1114,7 +1170,7 @@ def decode_chunk_ragged(params, tokens, cache, positions, active,
         # corrupt a live slot's KV prefix.
         write_pos = jnp.where(active, positions, max_seq - 1)
         return _decode_core_ragged(params, token, cache, write_pos,
-                                   config)
+                                   config, lora=lora)
 
     return _chunk_scan(step_core, tokens, positions, cache, active,
                        num_steps, temperatures, top_ps, rng_key)

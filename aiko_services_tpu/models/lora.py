@@ -30,7 +30,13 @@ import jax.numpy as jnp
 from . import llama
 
 __all__ = ["LoRAConfig", "init_lora_params", "merge_lora",
-           "lora_forward", "make_lora_train_step", "lora_param_specs"]
+           "lora_forward", "make_lora_train_step", "lora_param_specs",
+           "stack_adapters", "SERVING_TARGETS"]
+
+#: Targets the batched multi-adapter SERVING path supports (the
+#: attention projections — llama._lora_matmul hooks).  MLP targets
+#: train and merge fine but cannot yet run per-row batched.
+SERVING_TARGETS = frozenset({"wq", "wk", "wv", "wo"})
 
 #: Default adaptation targets (attention projections — the standard
 #: LoRA recipe; extend with mlp names for higher capacity).
@@ -128,6 +134,37 @@ def merge_lora(base, lora_params, lora: LoRAConfig) -> Dict:
     return _adapted_params(base, lora_params, lora)
 
 
+def stack_adapters(config: llama.LlamaConfig, lora: LoRAConfig,
+                   adapters: Sequence[Dict]) -> Dict:
+    """Stack N trained adapters for batched multi-adapter serving
+    (SLoRA-style): per layer and target, factors become
+    ``a: (N+1, d_in, r)``, ``b: (N+1, r, d_out)`` with index 0 the
+    ALL-ZERO identity adapter (a base-model row gathers an exact
+    no-op).  The result is the ``lora`` argument of
+    :func:`..llama.prefill` / :func:`..llama.decode_chunk_ragged`
+    minus the per-row ``ids`` — serving supplies those per batch.
+
+    All adapters must share ``lora`` (rank/scale/targets), and targets
+    must be within :data:`SERVING_TARGETS`."""
+    unsupported = set(lora.targets) - SERVING_TARGETS
+    if unsupported:
+        raise ValueError(
+            f"multi-adapter serving supports attention targets only; "
+            f"got {sorted(unsupported)}")
+    layers = []
+    for i in range(config.n_layers):
+        layer = {}
+        for target in lora.targets:
+            a_stack = [a["layers"][i][target]["a"] for a in adapters]
+            b_stack = [a["layers"][i][target]["b"] for a in adapters]
+            layer[target] = {
+                "a": jnp.stack([jnp.zeros_like(a_stack[0])] + a_stack),
+                "b": jnp.stack([jnp.zeros_like(b_stack[0])] + b_stack),
+            }
+        layers.append(layer)
+    return {"scale": lora.scale, "layers": layers}
+
+
 def make_lora_train_step(config: llama.LlamaConfig, lora: LoRAConfig,
                          optimizer):
     """Training step over ADAPTER params only: optimizer state is
@@ -136,14 +173,18 @@ def make_lora_train_step(config: llama.LlamaConfig, lora: LoRAConfig,
 
     from ..parallel.train import cross_entropy
 
-    def loss_fn(lora_params, base, tokens):
+    def loss_fn(lora_params, base, tokens, mask):
         logits = lora_forward(base, lora_params, tokens[:, :-1],
                               config, lora, use_flash=False)
-        return cross_entropy(logits, tokens[:, 1:])
+        return cross_entropy(logits, tokens[:, 1:],
+                             None if mask is None else mask[:, 1:])
 
-    def train_step(lora_params, opt_state, base, tokens):
+    def train_step(lora_params, opt_state, base, tokens, mask=None):
+        """``mask``: optional (batch, seq) 0/1 completion mask — loss
+        on the answer bytes only, same contract as
+        ``parallel.train.make_train_step``."""
         loss, grads = jax.value_and_grad(loss_fn)(lora_params, base,
-                                                  tokens)
+                                                  tokens, mask)
         updates, opt_state = optimizer.update(grads, opt_state,
                                               lora_params)
         lora_params = optax.apply_updates(lora_params, updates)

@@ -72,6 +72,11 @@ class DecodeRequest:
     #: completion (examples/llm/elements_llm.py:185); streaming falls
     #: out of continuous batching for free.
     stream: bool = False
+    #: Named LoRA adapter this request runs under (None = base model).
+    #: Requests with different adapters share ONE decode batch — the
+    #: base weight stream is paid once for all of them (SLoRA-style;
+    #: server must be constructed with ``adapters=``).
+    adapter: Optional[str] = None
     # Filled by the server:
     tokens: Optional[List[int]] = None
     error: Optional[str] = None
@@ -91,7 +96,8 @@ class ContinuousBatchingServer:
                  max_seq: Optional[int] = None, chunk_steps: int = 8,
                  quantize: bool = False, eos_id: Optional[int] = None,
                  seed: int = 0, quantize_kv: bool = False, mesh=None,
-                 lookahead: int = 1):
+                 lookahead: int = 1, adapters: Optional[Dict] = None,
+                 lora_config=None):
         import jax
         import jax.numpy as jnp
         from ..models import llama
@@ -150,6 +156,24 @@ class ContinuousBatchingServer:
         # = last emitted).  Before this, every admission cost ~4
         # separate device scatters; over the relay those round-trips
         # dominated the serving sections.
+        # Multi-adapter LoRA serving (SLoRA-style): stack the named
+        # adapters once (index 0 = all-zero identity = base model);
+        # each slot carries the index of ITS adapter and prefill +
+        # decode gather per-row factors — mixed-adapter batches pay
+        # the base weight stream once.
+        self._adapter_index: Dict[str, int] = {}
+        self._lora_shared = None
+        if adapters:
+            from ..models import lora as lora_mod
+            if lora_config is None:
+                raise ValueError("adapters= requires lora_config=")
+            names = list(adapters)
+            self._adapter_index = {name: i + 1
+                                   for i, name in enumerate(names)}
+            self._lora_shared = lora_mod.stack_adapters(
+                self.config, lora_config,
+                [adapters[name] for name in names])
+        self._adapter_ids = np.zeros((slots,), np.int32)
         self.positions = np.zeros((slots,), np.int32)
         self.active = np.zeros((slots,), bool)
         self.tokens = np.zeros((slots, 1), np.int32)
@@ -215,6 +239,9 @@ class ContinuousBatchingServer:
             return "empty_prompt"
         if prompt_len + request.max_new_tokens > self.max_seq - 1:
             return "prompt_too_long"
+        if request.adapter is not None \
+                and request.adapter not in self._adapter_index:
+            return "unknown_adapter"
         return None
 
     def live_requests(self) -> List[DecodeRequest]:
@@ -264,6 +291,8 @@ class ContinuousBatchingServer:
             self.tokens[slot, 0] = prompt_padded[0, prompt_len - 1]
             self.positions[slot] = prompt_len - 1
             self.active[slot] = True
+            self._adapter_ids[slot] = self._adapter_index.get(
+                request.adapter, 0)
             self._temperatures[slot] = max(0.0, float(request.temperature))
             self._top_ps[slot] = float(request.top_p)
             self._requests[slot] = request
@@ -284,8 +313,9 @@ class ContinuousBatchingServer:
         jnp = self._jnp
         groups: Dict[int, List] = {}
         for slot, request, prompt_padded, prompt_len in admissions:
+            adapter_id = self._adapter_index.get(request.adapter, 0)
             groups.setdefault(prompt_padded.shape[1], []).append(
-                (slot, prompt_padded, prompt_len))
+                (slot, prompt_padded, adapter_id))
         for padded, group in groups.items():
             start = 0
             while start < len(group):
@@ -296,12 +326,20 @@ class ContinuousBatchingServer:
                 slots = [slot for slot, _, _ in sub]
                 prompts = np.concatenate([p for _, p, _ in sub],
                                          axis=0)
+                lora = None
+                if self._lora_shared is not None:
+                    # The prompt KV must be built under the SAME
+                    # adapter the decode chunks will run.
+                    ids = np.asarray([aid for _, _, aid in sub],
+                                     np.int32)
+                    lora = dict(ids=jnp.asarray(ids),
+                                **self._lora_shared)
                 bucket_cache = self._llama.init_cache(
                     self.config, len(sub), padded,
                     quantize_kv=self.quantize_kv)
                 _, bucket_cache = self._llama.prefill(
                     self.params, jnp.asarray(prompts), bucket_cache,
-                    self.config)
+                    self.config, lora=lora)
                 self.cache = self._insert_slots(
                     self.cache, bucket_cache,
                     jnp.asarray(np.asarray(slots, np.int32)), padded)
@@ -335,6 +373,7 @@ class ContinuousBatchingServer:
         self._release_slot(slot)
         self._requests[slot] = None
         self.active[slot] = False
+        self._adapter_ids[slot] = 0
         # Reset sampling state so an all-greedy batch returns to the
         # pure-greedy compiled program (no sort/softmax per step).
         self._temperatures[slot] = 0.0
@@ -372,6 +411,10 @@ class ContinuousBatchingServer:
             if self._any_sampled:
                 temperatures_d = jnp.asarray(self._temperatures)
                 top_ps_d = jnp.asarray(self._top_ps)
+            lora = None
+            if self._lora_shared is not None:
+                lora = dict(ids=jnp.asarray(self._adapter_ids),
+                            **self._lora_shared)
             self._begin_run()
             outs = []
             for _ in range(n_chunks):
@@ -384,7 +427,8 @@ class ContinuousBatchingServer:
                 else:
                     sampling = {}      # pure-greedy compiled program
                 out, tokens_d, positions_d = self._run_chunk(
-                    tokens_d, positions_d, active_d, steps, sampling)
+                    tokens_d, positions_d, active_d, steps, sampling,
+                    lora)
                 outs.append(out)
             # ONE host sync for the whole run (each fetch is ~KB; all
             # chunks are already enqueued, so later ones compute while
@@ -425,7 +469,7 @@ class ContinuousBatchingServer:
         per chunk)."""
 
     def _run_chunk(self, tokens_d, positions_d, active_d, steps: int,
-                   sampling: Dict):
+                   sampling: Dict, lora=None):
         """Decode ``steps`` tokens for all slots from device-resident
         decode state; returns ``(out, tokens_d, positions_d)`` so a
         lookahead run can chain chunks without a host sync.  Cache-
@@ -435,7 +479,8 @@ class ContinuousBatchingServer:
         out, tokens_d, positions_d, self.cache = \
             self._llama.decode_chunk_ragged(
                 self.params, tokens_d, self.cache,
-                positions_d, active_d, steps, self.config, **sampling)
+                positions_d, active_d, steps, self.config,
+                lora=lora, **sampling)
         return out, tokens_d, positions_d
 
     def run_until_drained(self, max_chunks: int = 10_000):
@@ -467,8 +512,10 @@ class ContinuousReplica(Actor):
         self.share["slots"] = self.server.slots
         self.share["requests_served"] = 0
         self._pumping = False
-        #: request_id -> tokens already delivered via infer_partial.
-        self._stream_sent: Dict[str, int] = {}
+        #: id(request) -> tokens already delivered via infer_partial.
+        #: Keyed by object identity, not request_id: the client owns
+        #: that string and may reuse it across concurrent requests.
+        self._stream_sent: Dict[int, int] = {}
 
     def _wire_infer(self, request_id, response_topic, payload=None):
         from ..pipeline.codec import decode_swag
@@ -486,6 +533,8 @@ class ContinuousReplica(Actor):
             request.top_p = float(np.asarray(inputs.get("top_p", 1.0)))
             request.stream = bool(
                 int(np.asarray(inputs.get("stream", 0))))
+            adapter = inputs.get("adapter")
+            request.adapter = str(adapter) if adapter else None
         except Exception:  # noqa: BLE001 - bad request must still respond
             self.logger.exception("%s: malformed infer request %s",
                                   self.name, request_id)
@@ -543,12 +592,12 @@ class ContinuousReplica(Actor):
         if not (request.stream and request.response_topic
                 and request.tokens):
             return
-        sent = self._stream_sent.get(request.request_id, 0)
+        sent = self._stream_sent.get(id(request), 0)
         if len(request.tokens) <= sent:
             return
         from ..pipeline.codec import encode_swag
         increment = np.asarray(request.tokens[sent:], np.int32)
-        self._stream_sent[request.request_id] = len(request.tokens)
+        self._stream_sent[id(request)] = len(request.tokens)
         self.process.message.publish(
             request.response_topic,
             generate("infer_partial",
@@ -560,7 +609,7 @@ class ContinuousReplica(Actor):
         # Flush the final streaming increment first: concatenated
         # partials always equal the final sequence.
         self._emit_partial(request)
-        self._stream_sent.pop(request.request_id, None)
+        self._stream_sent.pop(id(request), None)
         self.share["requests_served"] += 1
         if self.ec_producer is not None:
             self.ec_producer.update("requests_served",

@@ -64,6 +64,11 @@ class PagedContinuousServer(ContinuousBatchingServer):
         self.block_size = block_size
         self._requested_blocks = total_blocks
         self.enable_prefix_cache = enable_prefix_cache
+        # Multi-adapter serving is contiguous-only for now: the
+        # prefix cache's content-addressed block keys would have to
+        # fold in the adapter identity (same tokens, different adapter
+        # => different KV), and the per-slot prefix-walk prefill does
+        # not yet thread per-row lora.
         super().__init__(config_name=config_name, slots=slots,
                          max_seq=max_seq, chunk_steps=chunk_steps,
                          quantize=quantize, eos_id=eos_id, seed=seed,
@@ -369,7 +374,10 @@ class PagedContinuousServer(ContinuousBatchingServer):
         self._tables_d = self._jnp.asarray(self.tables)
 
     def _run_chunk(self, tokens_d, positions_d, active_d, steps: int,
-                   sampling):
+                   sampling, lora=None):
+        if lora is not None:       # pragma: no cover - guarded in init
+            raise NotImplementedError(
+                "paged multi-adapter serving is not supported")
         out, tokens_d, positions_d, self.pool = \
             self._llama.decode_chunk_paged(
                 self.params, tokens_d, self.pool,

@@ -410,12 +410,31 @@ def bench_speech_chat_8b(n_frames=6, warmup=1, max_new_tokens=64):
         if "tokens_per_second" in outputs:
             decode_tps.append(float(np.asarray(
                 outputs["tokens_per_second"])))
+            log(f"speech8b: chat frame {len(decode_tps)} done "
+                f"({decode_tps[-1]:.1f} tok/s)")
 
     log(f"speech->chat 8B (whisper_small ASR -> {config}"
         f"{'+int8' if chat_params else ''}, batch 1)...")
-    fps, p50 = _run_pipeline_frames(
-        document, lambda: {"audio": audio}, n_frames, warmup,
-        broker="bench_speech8b", collect=collect)
+    # Liveness ticker: this section stalled silently past two capture
+    # watchdogs (r04) — a periodic elapsed line distinguishes "slow
+    # compile" from "wedged relay" in the section log.
+    import threading
+    stop_ticker = threading.Event()
+    section_start = time.perf_counter()
+
+    def ticker():
+        while not stop_ticker.wait(60):
+            log(f"speech8b: still running "
+                f"({time.perf_counter() - section_start:.0f}s elapsed, "
+                f"{len(decode_tps)} chat frames seen)")
+
+    threading.Thread(target=ticker, daemon=True).start()
+    try:
+        fps, p50 = _run_pipeline_frames(
+            document, lambda: {"audio": audio}, n_frames, warmup,
+            broker="bench_speech8b", collect=collect)
+    finally:
+        stop_ticker.set()
     tps = statistics.median(decode_tps) if decode_tps else 0.0
     log(f"speech->chat 8B: chat decode {tps:.1f} tokens/sec/chip "
         f"(median per-token timing, batch 1), p50 e2e {p50:.2f} ms")
@@ -762,6 +781,70 @@ def bench_train_mfu():
                        {"train_steps_per_sec": round(steps_s, 2)})
 
 
+def bench_long_context(seq=16_384, new_tokens=64,
+                       config_name="llama3_8b"):
+    """Single-stream LONG-CONTEXT measurement (SURVEY §5.7 on real
+    hardware): a seq-16k causal prefill in ONE compiled program
+    through the block-skipping flash kernel, then a decode
+    continuation attending to the full 16k context — Llama-3-8B,
+    int8 weights + int8 KV (the composition that keeps the 16k cache
+    at ~1.1 GB).  The reference has no attention code at all; its
+    speech example windows audio by LRU concat precisely because its
+    models cannot hold long context
+    (reference examples/speech/speech_elements.py:60-83)."""
+    import jax
+    import jax.numpy as jnp
+    from aiko_services_tpu.models import llama
+
+    config = llama.CONFIGS[config_name]
+    params = llama.random_quantized_params(config,
+                                           jax.random.PRNGKey(0))
+    max_seq = seq + new_tokens + 8
+    tokens = jnp.zeros((1, seq), jnp.int32)
+    log(f"long_context[{config_name}+int8+kv8] seq {seq}: compiling "
+        "prefill (one program, block-skipping flash)...")
+    # prefill DONATES its cache: warm and timed runs each get their
+    # own buffers, allocated outside the timed region.
+    warm_cache = llama.init_cache(config, 1, max_seq, quantize_kv=True)
+    timed_cache = llama.init_cache(config, 1, max_seq,
+                                   quantize_kv=True)
+    logits, _ = llama.prefill(params, tokens, warm_cache, config)
+    np.asarray(logits)                                   # warm + sync
+    started = time.perf_counter()
+    logits, cache = llama.prefill(params, tokens, timed_cache, config)
+    np.asarray(logits)
+    prefill_s = time.perf_counter() - started
+    prefill_tps = seq / prefill_s
+    flops = llama_prefill_flops(config, 1, seq)
+    tflops = flops / prefill_s / 1e12
+    log(f"long_context prefill: {prefill_tps:.0f} tok/s "
+        f"({prefill_s * 1e3:.0f} ms for {seq}), {tflops:.1f} TFLOP/s "
+        f"= {tflops / PEAK_BF16_TFLOPS * 100:.1f}% MFU")
+
+    token = logits[:, -1].argmax(-1).astype(jnp.int32)[:, None]
+    log(f"long_context decode: {new_tokens} steps attending to the "
+        "full context (compile + timed)...")
+    warm, _ = llama.generate_tokens(params, token, dict_copy(cache),
+                                    jnp.int32(seq), new_tokens, config)
+    int(np.asarray(warm)[0, 0])
+    started = time.perf_counter()
+    generated, cache = llama.generate_tokens(
+        params, token, cache, jnp.int32(seq), new_tokens, config)
+    int(np.asarray(generated)[0, -1])
+    decode_s = time.perf_counter() - started
+    decode_tps = new_tokens / decode_s
+    log(f"long_context decode@{seq}: {decode_tps:.1f} tok/s "
+        f"({decode_s / new_tokens * 1e3:.1f} ms/step, batch 1)")
+    return {"long_context_seq": seq,
+            "long_context_prefill_tokens_per_sec_chip":
+                round(prefill_tps),
+            "long_context_prefill_tflops_chip": round(tflops, 1),
+            "long_context_prefill_mfu_pct":
+                round(tflops / PEAK_BF16_TFLOPS * 100, 1),
+            "long_context_decode_tokens_per_sec_chip":
+                round(decode_tps, 1)}
+
+
 def bench_detector_mfu():
     """Achieved FLOPs/s for the detector forward (the compute inside
     the primary pipeline metric).  Conv FLOPs come from XLA's own cost
@@ -1062,6 +1145,14 @@ SECTIONS = [
     ("prefill_mfu", 600, bench_prefill_mfu),
     ("train_mfu", 420, bench_train_mfu),
     ("detector_mfu", 300, bench_detector_mfu),
+    # First-time-on-hardware compile (16k flash grid) — window risk,
+    # so it sits after every established section; still before the
+    # int4 pair, the only sections that have actually wedged the
+    # relay.
+    ("long_context", 700,
+     (lambda: bench_long_context(seq=256, new_tokens=8,
+                                 config_name="tiny"))
+     if SMOKE else bench_long_context),
     # Int4 flagship variants VERY last (wedge containment): first the
     # XLA grouped-einsum lowering (no Pallas compile at all), then the
     # Pallas whole-tile kernel (dispatches only hardware-validated

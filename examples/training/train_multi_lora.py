@@ -1,0 +1,181 @@
+"""Multi-tenant fine-tuned serving, trained in-framework.
+
+One BASE command model (the English command set of
+``train_command_llm``) plus TWO LoRA adapters trained on dialects the
+base was never taught:
+
+  * ``german``  — German utterances ("geh {n} sekunden vor") →
+    the same robot-command S-expressions
+  * ``terse``   — single-letter operator codes ("f {n}", "t {d}") →
+    the same commands
+
+All three then serve from ONE ``ContinuousBatchingServer``: requests
+name their adapter on the wire and share a single decode batch — the
+base weight stream is paid once while every row follows its own
+fine-tune (SLoRA-style).  The reference would run three separate
+Ollama model binaries for this
+(reference examples/llm/elements_llm.py:185-191).
+
+``tests/test_multi_lora_trained.py`` asserts held-out accuracy per
+tenant *inside one mixed batch*, and that the base model genuinely
+cannot do the dialect tasks (the adapters carry the skill).
+
+Run standalone:  python examples/training/train_multi_lora.py
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+if REPO_ROOT not in sys.path:
+    sys.path.insert(0, REPO_ROOT)
+
+import numpy as np
+
+from examples.training.train_command_llm import (
+    DEGREES, PROMPT, SECONDS, encode_example, train as train_base,
+)
+
+GERMAN_TEMPLATES = [
+    ("geh {n} sekunden vor", "(forward {n})"),
+    ("fahre {n} vorwärts", "(forward {n})"),
+    ("geh {n} sekunden zurück", "(backward {n})"),
+    ("fahre rückwärts {n}", "(backward {n})"),
+    ("drehe dich {d} grad", "(turn {d})"),
+    ("um {d} grad drehen", "(turn {d})"),
+    ("schau {d} grad nach oben", "(look {d})"),
+    ("geh schlafen", "(sleep)"),
+    ("ruhe dich aus", "(sleep)"),
+    ("anhalten", "(stop)"),
+    ("stehen bleiben", "(stop)"),
+]
+
+TERSE_TEMPLATES = [
+    ("f {n}", "(forward {n})"),
+    ("b {n}", "(backward {n})"),
+    ("t {d}", "(turn {d})"),
+    ("l {d}", "(look {d})"),
+    ("z", "(sleep)"),
+    ("x", "(stop)"),
+]
+
+
+def synth_dialect(rng: np.random.Generator, templates, count: int):
+    pairs = []
+    for _ in range(count):
+        template, command = templates[rng.integers(len(templates))]
+        n = SECONDS[rng.integers(len(SECONDS))]
+        d = DEGREES[rng.integers(len(DEGREES))]
+        pairs.append((template.format(n=n, d=d),
+                      command.format(n=n, d=d)))
+    return pairs
+
+
+def train_adapter(base_params, config, templates, steps: int = 300,
+                  batch: int = 16, seq_len: int = 64, seed: int = 1,
+                  learning_rate: float = 1e-2, log_every: int = 50,
+                  progress=print):
+    """LoRA-train one dialect over the frozen base; returns
+    (lora_params, lora_config)."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+    from aiko_services_tpu.models.lora import (
+        LoRAConfig, init_lora_params, make_lora_train_step,
+    )
+
+    lora = LoRAConfig(rank=8, alpha=16.0, targets=("wq", "wv"))
+    lora_params = init_lora_params(config, lora,
+                                   jax.random.PRNGKey(seed))
+    optimizer = optax.adamw(learning_rate)
+    opt_state = optimizer.init(lora_params)
+    step_fn = jax.jit(make_lora_train_step(config, lora, optimizer))
+
+    rng = np.random.default_rng(seed)
+    for step in range(steps):
+        tokens = np.zeros((batch, seq_len), np.int32)
+        mask = np.zeros((batch, seq_len), np.int32)
+        for row, (utterance, command) in enumerate(
+                synth_dialect(rng, templates, batch)):
+            tokens[row], mask[row] = encode_example(
+                utterance, command, seq_len)
+        lora_params, opt_state, loss = step_fn(
+            lora_params, opt_state, base_params,
+            jnp.asarray(tokens), jnp.asarray(mask))
+        if log_every and (step + 1) % log_every == 0:
+            progress(f"  lora step {step + 1}/{steps} "
+                     f"loss {float(np.asarray(loss)):.4f}")
+    return lora_params, lora
+
+
+def build_tenants(base_steps: int = 400, adapter_steps: int = 300,
+                  progress=print):
+    """Train base + both adapters; returns
+    (base_params, config, lora_config, {name: lora_params})."""
+    progress("training base (English command set)...")
+    base_params, config = train_base(steps=base_steps,
+                                     progress=progress)
+    progress("training adapter 'german'...")
+    german, lora = train_adapter(base_params, config, GERMAN_TEMPLATES,
+                                 steps=adapter_steps, seed=11,
+                                 progress=progress)
+    progress("training adapter 'terse'...")
+    terse, _ = train_adapter(base_params, config, TERSE_TEMPLATES,
+                             steps=adapter_steps, seed=22,
+                             progress=progress)
+    return base_params, config, lora, {"german": german,
+                                       "terse": terse}
+
+
+def serve_probe(base_params, lora_config, adapters,
+                probes, max_new: int = 24):
+    """Serve base+adapters from one ContinuousBatchingServer; probes
+    are (tenant_or_None, utterance) pairs answered in ONE mixed
+    stream.  Returns the decoded reply strings in probe order."""
+    from aiko_services_tpu.orchestration.continuous import (
+        ContinuousBatchingServer, DecodeRequest,
+    )
+
+    server = ContinuousBatchingServer(
+        config_name="tiny", slots=4, max_seq=128,
+        chunk_steps=8, eos_id=ord("\n"),
+        adapters=adapters, lora_config=lora_config)
+    server.params = base_params
+    requests = []
+    for i, (tenant, utterance) in enumerate(probes):
+        prompt = np.frombuffer(
+            PROMPT.format(utterance=utterance).encode(),
+            np.uint8).astype(np.int32)
+        requests.append(DecodeRequest(
+            request_id=f"p{i}", prompt=prompt,
+            max_new_tokens=max_new, adapter=tenant))
+    for request in requests:
+        server.submit(request)
+    server.run_until_drained()
+    replies = []
+    for request in requests:
+        data = bytes(t for t in request.tokens
+                     if 0 < t < 256 and t != ord("\n"))
+        replies.append(data.decode(errors="replace").strip())
+    return replies
+
+
+def main():
+    base_params, config, lora_config, adapters = build_tenants()
+    probes = [
+        (None, "go ahead 3 seconds"),
+        ("german", "drehe dich 90 grad"),
+        ("terse", "f 5"),
+        ("german", "anhalten"),
+        ("terse", "t 45"),
+    ]
+    replies = serve_probe(base_params, lora_config, adapters, probes)
+    for (tenant, utterance), reply in zip(probes, replies):
+        print(f"[{tenant or 'base':6s}] {utterance!r} -> {reply!r}")
+
+
+if __name__ == "__main__":
+    main()

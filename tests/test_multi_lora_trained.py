@@ -1,0 +1,53 @@
+"""Multi-tenant fine-tuned serving, trained end-to-end in-framework:
+one base command model + two LoRA dialect adapters answering held-out
+utterances from ONE mixed continuous batch — and the base alone cannot
+do the dialect tasks (the adapter carries the skill)."""
+
+import pytest
+
+pytestmark = pytest.mark.slow   # ~3 min: base + 2 adapter trainings
+
+
+def _accuracy(replies, wants):
+    return sum(r == w for r, w in zip(replies, wants)) / len(wants)
+
+
+def test_multi_tenant_adapters_serve_from_one_batch():
+    from examples.training.train_multi_lora import (
+        GERMAN_TEMPLATES, TERSE_TEMPLATES, build_tenants, serve_probe,
+    )
+
+    base_params, config, lora_config, adapters = build_tenants(
+        progress=lambda *_: None)
+
+    # Held-out probes (value combinations chosen, not trained order):
+    english = [("go ahead 7 seconds", "(forward 7)"),
+               ("turn 45 degrees", "(turn 45)"),
+               ("freeze", "(stop)")]
+    german = [("geh 4 sekunden vor", "(forward 4)"),
+              ("drehe dich 120 grad", "(turn 120)"),
+              ("anhalten", "(stop)")]
+    terse = [("f 8", "(forward 8)"),
+             ("t 60", "(turn 60)"),
+             ("x", "(stop)")]
+
+    probes, wants = [], []
+    for tenant, cases in ((None, english), ("german", german),
+                          ("terse", terse)):
+        for utterance, want in cases:
+            probes.append((tenant, utterance))
+            wants.append(want)
+    replies = serve_probe(base_params, lora_config, adapters, probes)
+    accuracy = _accuracy(replies, wants)
+    assert accuracy >= 8 / 9, list(zip(probes, replies, wants))
+
+    # The SKILL lives in the adapters: the base model answering the
+    # dialect probes must do clearly worse than the adapters did.
+    dialect_probes = [(None, utterance) for tenant, utterance in probes
+                      if tenant is not None]
+    dialect_wants = [want for (tenant, _), want in zip(probes, wants)
+                     if tenant is not None]
+    base_replies = serve_probe(base_params, lora_config, adapters,
+                               dialect_probes)
+    base_accuracy = _accuracy(base_replies, dialect_wants)
+    assert base_accuracy <= 0.5, list(zip(dialect_probes, base_replies))
