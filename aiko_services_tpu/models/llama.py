@@ -852,15 +852,19 @@ def _paged_gather(pool_layer, tables):
 
 
 def _attention_decode_paged(layer, config, x, cos, sin, pool_layer,
-                            tables, positions):
+                            tables, positions, lora=None,
+                            lora_layer=None):
     """Single-token decode against the block pool (per-row positions,
     continuous batching)."""
     batch, seq, _ = x.shape
     h, kv, hd = config.n_heads, config.n_kv_heads, config.head_dim
     normed = rms_norm(x, layer["attn_norm"], config.norm_eps)
-    q = _matmul(normed, layer["wq"]).reshape(batch, seq, h, hd)
-    k = _matmul(normed, layer["wk"]).reshape(batch, seq, kv, hd)
-    v = _matmul(normed, layer["wv"]).reshape(batch, seq, kv, hd)
+    q = _lora_matmul(normed, layer["wq"], lora_layer, "wq",
+                     lora).reshape(batch, seq, h, hd)
+    k = _lora_matmul(normed, layer["wk"], lora_layer, "wk",
+                     lora).reshape(batch, seq, kv, hd)
+    v = _lora_matmul(normed, layer["wv"], lora_layer, "wv",
+                     lora).reshape(batch, seq, kv, hd)
     q = apply_rope(q, cos, sin)
     k = apply_rope(k, cos, sin)
 
@@ -870,19 +874,23 @@ def _attention_decode_paged(layer, config, x, cos, sin, pool_layer,
     out = _cached_gqa_attention(q_g, gathered, positions[:, None], hd,
                                 window=config.sliding_window)
     out = out.reshape(batch, seq, h * hd)
-    return x + _matmul(out, layer["wo"]).astype(x.dtype), new_pool
+    return x + _lora_matmul(out, layer["wo"], lora_layer, "wo",
+                            lora).astype(x.dtype), new_pool
 
 
 def _decode_core_paged(params, token, pool, tables, positions,
-                       config: LlamaConfig):
+                       config: LlamaConfig, lora=None):
     positions_2d = positions[:, None]
     cos, sin = _rope_freqs(config, positions_2d)
     x = _embed_lookup(params, token, config.dtype)
     new_pool = []
-    for layer, pool_layer in zip(params["layers"], pool):
+    lora_layers = lora["layers"] if lora else [None] * len(pool)
+    for layer, pool_layer, lora_layer in zip(params["layers"], pool,
+                                             lora_layers):
         x, updated = _attention_decode_paged(layer, config, x, cos, sin,
                                              pool_layer, tables,
-                                             positions)
+                                             positions, lora,
+                                             lora_layer)
         new_pool.append(updated)
         x = _mlp_block(layer, config, x)
     x = rms_norm(x, params["final_norm"], config.norm_eps)
@@ -933,7 +941,8 @@ def _chunk_scan(step_core, tokens, positions, cache_state, active,
                    donate_argnames=("pool",))
 def decode_chunk_paged(params, tokens, pool, tables, positions, active,
                        num_steps, config: LlamaConfig,
-                       temperatures=None, top_ps=None, rng_key=None):
+                       temperatures=None, top_ps=None, rng_key=None,
+                       lora=None):
     """Paged twin of :func:`decode_chunk_ragged`: one compiled scan of
     ``num_steps`` steps over the block pool.  Inactive slots write into
     scratch block 0 at their slot offset (blocked from live tables by
@@ -952,7 +961,7 @@ def decode_chunk_paged(params, tokens, pool, tables, positions, active,
                                  scratch_tables)
         write_pos = jnp.where(active, positions, scratch_positions)
         return _decode_core_paged(params, token, pool, write_tables,
-                                  write_pos, config)
+                                  write_pos, config, lora=lora)
 
     return _chunk_scan(step_core, tokens, positions, pool, active,
                        num_steps, temperatures, top_ps, rng_key)
@@ -1290,7 +1299,7 @@ def sample_tokens_with_logits(params, first_token, cache, start_index,
 @functools.partial(jax.jit, static_argnames=("config",),
                    donate_argnames=("cache",))
 def prefill_chunk(params, tokens, cache, start_index,
-                  config: LlamaConfig):
+                  config: LlamaConfig, lora=None):
     """Chunked prefill: run ``tokens (batch, K)`` through the model at
     absolute positions ``start_index + [0, K)``, extending an EXISTING
     cache prefix.  Returns (logits (batch, K, vocab) — every position,
@@ -1320,11 +1329,16 @@ def prefill_chunk(params, tokens, cache, start_index,
     x = _embed_lookup(params, tokens, config.dtype)
     h, kv, hd = config.n_heads, config.n_kv_heads, config.head_dim
     new_cache = []
-    for layer, cache_layer in zip(params["layers"], cache):
+    lora_layers = lora["layers"] if lora else [None] * len(cache)
+    for layer, cache_layer, lora_layer in zip(params["layers"], cache,
+                                              lora_layers):
         normed = rms_norm(x, layer["attn_norm"], config.norm_eps)
-        q = _matmul(normed, layer["wq"]).reshape(batch, K, h, hd)
-        k = _matmul(normed, layer["wk"]).reshape(batch, K, kv, hd)
-        v = _matmul(normed, layer["wv"]).reshape(batch, K, kv, hd)
+        q = _lora_matmul(normed, layer["wq"], lora_layer, "wq",
+                         lora).reshape(batch, K, h, hd)
+        k = _lora_matmul(normed, layer["wk"], lora_layer, "wk",
+                         lora).reshape(batch, K, kv, hd)
+        v = _lora_matmul(normed, layer["wv"], lora_layer, "wv",
+                         lora).reshape(batch, K, kv, hd)
         q = apply_rope(q, cos, sin)
         k = apply_rope(k, cos, sin)
         layer_cache = _cache_write_slab(cache_layer, k, v, start_index)
@@ -1334,8 +1348,9 @@ def prefill_chunk(params, tokens, cache, start_index,
         q_g = q.reshape(batch, K, kv, group, hd)
         out = _cached_gqa_attention(q_g, layer_cache, positions_b, hd,
                                     window=config.sliding_window)
-        x = x + _matmul(out.reshape(batch, K, h * hd),
-                        layer["wo"]).astype(x.dtype)
+        x = x + _lora_matmul(out.reshape(batch, K, h * hd),
+                             layer["wo"], lora_layer, "wo",
+                             lora).astype(x.dtype)
         x = _mlp_block(layer, config, x)
     x = rms_norm(x, params["final_norm"], config.norm_eps)
     logits = _matmul(x, params["lm_head"]).astype(jnp.float32)

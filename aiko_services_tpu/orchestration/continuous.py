@@ -326,14 +326,9 @@ class ContinuousBatchingServer:
                 slots = [slot for slot, _, _ in sub]
                 prompts = np.concatenate([p for _, p, _ in sub],
                                          axis=0)
-                lora = None
-                if self._lora_shared is not None:
-                    # The prompt KV must be built under the SAME
-                    # adapter the decode chunks will run.
-                    ids = np.asarray([aid for _, _, aid in sub],
-                                     np.int32)
-                    lora = dict(ids=jnp.asarray(ids),
-                                **self._lora_shared)
+                # The prompt KV must be built under the SAME adapter
+                # the decode chunks will run (None for all-base).
+                lora = self._make_lora([aid for _, _, aid in sub])
                 bucket_cache = self._llama.init_cache(
                     self.config, len(sub), padded,
                     quantize_kv=self.quantize_kv)
@@ -349,7 +344,25 @@ class ContinuousBatchingServer:
         Contiguous layout always has room (the slot IS the room)."""
         return True
 
-    def _prefill_bucket(self, slot: int, prompt_padded, prompt_len: int):
+    def _make_lora(self, ids):
+        """Assemble the batched lora argument for per-row adapter
+        ``ids`` — or None when no row actually runs an adapter, so
+        all-base traffic keeps the adapter-free compiled program (no
+        gather/einsum work; the same discipline ``_any_sampled``
+        applies to sampling math)."""
+        ids = np.asarray(ids, np.int32)
+        if self._lora_shared is None or not ids.any():
+            return None
+        return dict(ids=self._jnp.asarray(ids), **self._lora_shared)
+
+    def _request_lora(self, request):
+        """Batch-1 lora argument for a single request's prefill (the
+        paged per-slot admission path)."""
+        return self._make_lora(
+            [self._adapter_index.get(request.adapter, 0)])
+
+    def _prefill_bucket(self, slot: int, prompt_padded,
+                        prompt_len: int, lora=None):
         """Prefill hook: run the padded prompt into a fresh batch-1
         bucket cache.  Used by the PAGED server's cache-miss path (its
         prefix-cache walk is per-slot); the contiguous layout itself
@@ -360,7 +373,7 @@ class ContinuousBatchingServer:
             quantize_kv=self.quantize_kv)
         _, bucket_cache = llama.prefill(
             self.params, jnp.asarray(prompt_padded), bucket_cache,
-            self.config)
+            self.config, lora=lora)
         return bucket_cache
 
     def _release_slot(self, slot: int) -> None:
@@ -411,10 +424,7 @@ class ContinuousBatchingServer:
             if self._any_sampled:
                 temperatures_d = jnp.asarray(self._temperatures)
                 top_ps_d = jnp.asarray(self._top_ps)
-            lora = None
-            if self._lora_shared is not None:
-                lora = dict(ids=jnp.asarray(self._adapter_ids),
-                            **self._lora_shared)
+            lora = self._make_lora(self._adapter_ids)
             self._begin_run()
             outs = []
             for _ in range(n_chunks):

@@ -60,19 +60,15 @@ class PagedContinuousServer(ContinuousBatchingServer):
                  block_size: int = 16,
                  total_blocks: Optional[int] = None,
                  enable_prefix_cache: bool = False,
-                 lookahead: int = 1):
+                 lookahead: int = 1, adapters=None, lora_config=None):
         self.block_size = block_size
         self._requested_blocks = total_blocks
         self.enable_prefix_cache = enable_prefix_cache
-        # Multi-adapter serving is contiguous-only for now: the
-        # prefix cache's content-addressed block keys would have to
-        # fold in the adapter identity (same tokens, different adapter
-        # => different KV), and the per-slot prefix-walk prefill does
-        # not yet thread per-row lora.
         super().__init__(config_name=config_name, slots=slots,
                          max_seq=max_seq, chunk_steps=chunk_steps,
                          quantize=quantize, eos_id=eos_id, seed=seed,
-                         quantize_kv=quantize_kv, lookahead=lookahead)
+                         quantize_kv=quantize_kv, lookahead=lookahead,
+                         adapters=adapters, lora_config=lora_config)
 
     # ------------------------------------------------------------- #
     # Layout hooks
@@ -153,15 +149,19 @@ class PagedContinuousServer(ContinuousBatchingServer):
     # ------------------------------------------------------------- #
     # Prefix cache (content-addressed full prompt blocks)
 
-    def _chain_keys(self, prompt) -> List[bytes]:
+    def _chain_keys(self, prompt, adapter_id: int = 0) -> List[bytes]:
         """Chained content keys, one per FULL prompt block: a block's
         key is the SHA-256 of (parent key ‖ block tokens), so equal
         keys imply equal whole-prefix token histories (vLLM's hashing
         scheme) at O(block) per key — no nested-tuple rehashing of the
-        whole ancestor history on every dict operation."""
+        whole ancestor history on every dict operation.
+
+        The chain is SEEDED with the adapter id: the same tokens
+        prefilled under different LoRA adapters produce different KV,
+        so cached blocks may only be shared within one adapter."""
         bs = self.block_size
         keys: List[bytes] = []
-        parent = b""
+        parent = int(adapter_id).to_bytes(4, "little")
         for i in range(len(prompt) // bs):
             block = np.ascontiguousarray(
                 prompt[i * bs:(i + 1) * bs], dtype=np.int32)
@@ -223,7 +223,9 @@ class PagedContinuousServer(ContinuousBatchingServer):
         shared: List[int] = []
         keys: List = []
         if self.enable_prefix_cache:
-            keys = self._chain_keys(prompt)[
+            keys = self._chain_keys(
+                prompt,
+                self._adapter_index.get(request.adapter, 0))[
                 :self._shareable_blocks(len(prompt))]
             for key in keys:
                 block = self._index.get(key)
@@ -314,19 +316,23 @@ class PagedContinuousServer(ContinuousBatchingServer):
         share, exact-output assertion): reordering this walk makes
         that test read garbage KV and fail."""
         for slot, request, prompt_padded, prompt_len in admissions:
-            bucket_cache = self._prefill_bucket(slot, prompt_padded,
-                                                prompt_len)
+            bucket_cache = self._prefill_bucket(
+                slot, prompt_padded, prompt_len,
+                lora=self._request_lora(request))
             self._insert_prefix(slot, bucket_cache,
                                 prompt_padded.shape[1])
 
-    def _prefill_bucket(self, slot: int, prompt_padded, prompt_len: int):
+    def _prefill_bucket(self, slot: int, prompt_padded,
+                        prompt_len: int, lora=None):
         n_shared = self._pending_shared[slot]
         if not n_shared:
             return super()._prefill_bucket(slot, prompt_padded,
-                                           prompt_len)
+                                           prompt_len, lora=lora)
         # Prefix hit: materialize the shared blocks into the bucket and
         # chunk-prefill ONLY the uncached tail (the whole point — the
-        # prefill FLOPs for the shared prefix are skipped).
+        # prefill FLOPs for the shared prefix are skipped).  The
+        # shared blocks were built under the SAME adapter (chain keys
+        # are adapter-seeded), and the tail runs it too.
         llama, jnp = self._llama, self._jnp
         padded = prompt_padded.shape[1]
         bucket = llama.init_cache(self.config, 1, padded,
@@ -338,7 +344,7 @@ class PagedContinuousServer(ContinuousBatchingServer):
         start = n_shared * self.block_size
         _, bucket = llama.prefill_chunk(
             self.params, jnp.asarray(prompt_padded[:, start:]), bucket,
-            jnp.int32(start), self.config)
+            jnp.int32(start), self.config, lora=lora)
         return bucket
 
     def _insert_prefix(self, slot: int, bucket_cache, padded: int):
@@ -375,13 +381,10 @@ class PagedContinuousServer(ContinuousBatchingServer):
 
     def _run_chunk(self, tokens_d, positions_d, active_d, steps: int,
                    sampling, lora=None):
-        if lora is not None:       # pragma: no cover - guarded in init
-            raise NotImplementedError(
-                "paged multi-adapter serving is not supported")
         out, tokens_d, positions_d, self.pool = \
             self._llama.decode_chunk_paged(
                 self.params, tokens_d, self.pool,
                 self._tables_d, positions_d,
                 active_d, steps, self.config,
-                **sampling)
+                lora=lora, **sampling)
         return out, tokens_d, positions_d
