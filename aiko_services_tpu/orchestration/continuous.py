@@ -291,8 +291,7 @@ class ContinuousBatchingServer:
             self.tokens[slot, 0] = prompt_padded[0, prompt_len - 1]
             self.positions[slot] = prompt_len - 1
             self.active[slot] = True
-            self._adapter_ids[slot] = self._adapter_index.get(
-                request.adapter, 0)
+            self._adapter_ids[slot] = self._adapter_id(request)
             self._temperatures[slot] = max(0.0, float(request.temperature))
             self._top_ps[slot] = float(request.top_p)
             self._requests[slot] = request
@@ -313,7 +312,7 @@ class ContinuousBatchingServer:
         jnp = self._jnp
         groups: Dict[int, List] = {}
         for slot, request, prompt_padded, prompt_len in admissions:
-            adapter_id = self._adapter_index.get(request.adapter, 0)
+            adapter_id = self._adapter_id(request)
             groups.setdefault(prompt_padded.shape[1], []).append(
                 (slot, prompt_padded, adapter_id))
         for padded, group in groups.items():
@@ -344,6 +343,11 @@ class ContinuousBatchingServer:
         Contiguous layout always has room (the slot IS the room)."""
         return True
 
+    def _adapter_id(self, request) -> int:
+        """Stacked-factor index for a request (0 = base identity;
+        unknown names are rejected at submit)."""
+        return self._adapter_index.get(request.adapter, 0)
+
     def _make_lora(self, ids):
         """Assemble the batched lora argument for per-row adapter
         ``ids`` — or None when no row actually runs an adapter, so
@@ -358,8 +362,7 @@ class ContinuousBatchingServer:
     def _request_lora(self, request):
         """Batch-1 lora argument for a single request's prefill (the
         paged per-slot admission path)."""
-        return self._make_lora(
-            [self._adapter_index.get(request.adapter, 0)])
+        return self._make_lora([self._adapter_id(request)])
 
     def _prefill_bucket(self, slot: int, prompt_padded,
                         prompt_len: int, lora=None):

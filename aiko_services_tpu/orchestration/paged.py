@@ -224,8 +224,7 @@ class PagedContinuousServer(ContinuousBatchingServer):
         keys: List = []
         if self.enable_prefix_cache:
             keys = self._chain_keys(
-                prompt,
-                self._adapter_index.get(request.adapter, 0))[
+                prompt, self._adapter_id(request))[
                 :self._shareable_blocks(len(prompt))]
             for key in keys:
                 block = self._index.get(key)
