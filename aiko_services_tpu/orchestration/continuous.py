@@ -97,7 +97,7 @@ class ContinuousBatchingServer:
                  quantize: bool = False, eos_id: Optional[int] = None,
                  seed: int = 0, quantize_kv: bool = False, mesh=None,
                  lookahead: int = 1, adapters: Optional[Dict] = None,
-                 lora_config=None):
+                 lora_config=None, chunk_prefill_tokens: int = 0):
         import jax
         import jax.numpy as jnp
         from ..models import llama
@@ -143,6 +143,23 @@ class ContinuousBatchingServer:
         # when a mid-run EOS shifts a queued request's admission chunk
         # — the request then draws different RNG chunk keys.
         self.lookahead = max(1, int(lookahead))
+        # Chunked-prefill admission: prompts longer than this prefill
+        # ``chunk_prefill_tokens`` tokens per step, INTERLEAVED with
+        # the running slots' decode chunks — a long prompt no longer
+        # stalls every live request for its whole prefill (the
+        # decode-latency/SLO half of vLLM-style chunked prefill).
+        # 0 = off (whole-bucket admission).  Power of two so every
+        # chunk program has the same shape (bucket sizes are pow2).
+        self.chunk_prefill_tokens = int(chunk_prefill_tokens)
+        if self.chunk_prefill_tokens:
+            if self.chunk_prefill_tokens < 16 or \
+                    self.chunk_prefill_tokens & \
+                    (self.chunk_prefill_tokens - 1):
+                raise ValueError(
+                    "chunk_prefill_tokens must be a power of two >= "
+                    f"16, got {self.chunk_prefill_tokens}")
+        #: slot -> in-progress chunked admission state.
+        self._prefilling: Dict[int, Dict] = {}
         self.eos_id = eos_id
         self.quantize_kv = quantize_kv
         self._bucket_minimum = 16
@@ -261,6 +278,8 @@ class ContinuousBatchingServer:
 
     @property
     def busy(self) -> bool:
+        # Prefilling slots hold their request in _requests, so
+        # slots_active covers chunked admissions too.
         return bool(self._queue) or self.slots_active > 0
 
     def _admit(self) -> None:
@@ -280,23 +299,77 @@ class ContinuousBatchingServer:
             self._queue.pop(0)
             prompt_padded = np.zeros((1, padded), np.int32)
             prompt_padded[:, :prompt_len] = prompt
+            if self.chunk_prefill_tokens \
+                    and prompt_len > self.chunk_prefill_tokens:
+                # Chunked admission: the slot is OCCUPIED (queued
+                # requests cannot take it) but not yet active —
+                # _advance_prefills feeds one chunk per step between
+                # the running slots' decode runs.
+                self._requests[slot] = request
+                self._prefilling[slot] = dict(
+                    request=request, prompt_padded=prompt_padded,
+                    prompt_len=prompt_len, start=0,
+                    lora=self._request_lora(request),
+                    bucket=self._llama.init_cache(
+                        self.config, 1, padded,
+                        quantize_kv=self.quantize_kv))
+                continue
             admissions.append((slot, request, prompt_padded, prompt_len))
         if not admissions:
             return
         self._prefill_and_insert(admissions)
         for slot, request, prompt_padded, prompt_len in admissions:
-            # Seed with the last prompt token at its own position: the
-            # next chunk's first step re-writes that KV row with the
-            # identical values and emits the first generated token.
-            self.tokens[slot, 0] = prompt_padded[0, prompt_len - 1]
-            self.positions[slot] = prompt_len - 1
-            self.active[slot] = True
-            self._adapter_ids[slot] = self._adapter_id(request)
-            self._temperatures[slot] = max(0.0, float(request.temperature))
-            self._top_ps[slot] = float(request.top_p)
-            self._requests[slot] = request
-            self._emitted[slot] = 0
+            self._activate_slot(slot, request, prompt_padded,
+                                prompt_len)
+
+    def _activate_slot(self, slot: int, request, prompt_padded,
+                       prompt_len: int) -> None:
+        """Seed a prefilled slot for decode — with the LAST prompt
+        token at its own position: the next chunk's first step
+        re-writes that KV row with identical values and emits the
+        first generated token.  The ONE activation path for both
+        whole-bucket and chunked admission."""
+        self.tokens[slot, 0] = prompt_padded[0, prompt_len - 1]
+        self.positions[slot] = prompt_len - 1
+        self.active[slot] = True
+        self._adapter_ids[slot] = self._adapter_id(request)
+        self._temperatures[slot] = max(0.0, float(request.temperature))
+        self._top_ps[slot] = float(request.top_p)
+        self._requests[slot] = request
+        self._emitted[slot] = 0
         self._any_sampled = bool((self._temperatures > 0).any())
+
+    def _advance_prefills(self) -> None:
+        """Run ONE prefill chunk for every in-progress chunked
+        admission; a slot whose chunks now cover its whole prompt is
+        sealed into the main cache and becomes decode-active."""
+        jnp = self._jnp
+        for slot in list(self._prefilling):
+            state = self._prefilling[slot]
+            start = state["start"]
+            size = min(self.chunk_prefill_tokens,
+                       state["prompt_padded"].shape[1] - start)
+            chunk = state["prompt_padded"][:, start:start + size]
+            _, state["bucket"] = self._llama.prefill_chunk(
+                self.params, jnp.asarray(chunk), state["bucket"],
+                jnp.int32(start), self.config, lora=state["lora"])
+            state["start"] = start + size
+            if state["start"] >= state["prompt_len"]:
+                # Rows past prompt_len stay zero-initialized — exactly
+                # as unattendable as the whole-prefill path's
+                # pad-garbage rows (absolute-position masking).
+                self._finish_prefill(slot, state)
+
+    def _finish_prefill(self, slot: int, state: Dict) -> None:
+        jnp = self._jnp
+        self.cache = self._insert_slots(
+            self.cache, state["bucket"],
+            jnp.asarray(np.asarray([slot], np.int32)),
+            state["prompt_padded"].shape[1])
+        del self._prefilling[slot]
+        self._activate_slot(slot, state["request"],
+                            state["prompt_padded"],
+                            state["prompt_len"])
 
     def _prefill_and_insert(self, admissions) -> None:
         """Admission-group hook.  Contiguous layout: group admissions
@@ -400,11 +473,15 @@ class ContinuousBatchingServer:
         """Admit pending requests, decode one chunk run, retire
         finished slots.  Returns (and clears) the completed list."""
         self._admit()
-        if any(r is not None for r in self._requests):
+        self._advance_prefills()
+        if self.active.any():
+            # Prefilling slots are occupied but not decode-active:
+            # they are excluded from run sizing and from bookkeeping.
             remaining = [self._requests[s].max_new_tokens
                          - int(self._emitted[s])
                          for s in range(self.slots)
-                         if self._requests[s] is not None]
+                         if self._requests[s] is not None
+                         and self.active[s]]
             steps = int(max(1, min(self.chunk_steps, max(remaining))))
             # How many chunks may run before bookkeeping MUST happen:
             # the earliest budget retirement (so a freed slot is not
@@ -459,7 +536,7 @@ class ContinuousBatchingServer:
                                                     total - 1]
             for slot in range(self.slots):
                 request = self._requests[slot]
-                if request is None:
+                if request is None or not chunk_active[slot]:
                     continue
                 for step_index in range(total):
                     if self._emitted[slot] >= request.max_new_tokens:
