@@ -148,8 +148,10 @@ class ContinuousBatchingServer:
         # the running slots' decode chunks — a long prompt no longer
         # stalls every live request for its whole prefill (the
         # decode-latency/SLO half of vLLM-style chunked prefill).
-        # 0 = off (whole-bucket admission).  Power of two so every
-        # chunk program has the same shape (bucket sizes are pow2).
+        # 0 = off (whole-bucket admission).  Power of two so chunk
+        # programs share one shape per bucket size — plus at most one
+        # tail-chunk shape when ``max_seq`` clamps a bucket to a
+        # non-multiple of the chunk width.
         self.chunk_prefill_tokens = int(chunk_prefill_tokens)
         if self.chunk_prefill_tokens:
             if self.chunk_prefill_tokens < 16 or \
@@ -180,6 +182,8 @@ class ContinuousBatchingServer:
         # the base weight stream once.
         self._adapter_index: Dict[str, int] = {}
         self._lora_shared = None
+        self._lora_config = lora_config
+        self._free_adapter_ids: List[int] = []
         if adapters:
             from ..models import lora as lora_mod
             if lora_config is None:
@@ -421,6 +425,119 @@ class ContinuousBatchingServer:
         unknown names are rejected at submit)."""
         return self._adapter_index.get(request.adapter, 0)
 
+    @property
+    def adapters_loaded(self) -> List[str]:
+        """Names currently servable (operator telemetry)."""
+        return sorted(self._adapter_index)
+
+    def _adapter_users(self, name: str) -> int:
+        """Requests pinning adapter ``name`` — by NAME, not stacked
+        index: a chunk-prefilling slot holds its request before
+        ``_activate_slot`` assigns the id, and queued requests have no
+        slot at all, yet both will decode under the name."""
+        live = sum(1 for r in self._requests
+                   if r is not None and r.adapter == name)
+        return live + sum(1 for r in self._queue if r.adapter == name)
+
+    def load_adapter(self, name: str, lora_params,
+                     lora_config=None) -> None:
+        """Register (or replace) a LoRA adapter at RUNTIME — deploy a
+        new fine-tune without restarting the replica.  The first load
+        on an adapter-less server defines the shared LoRAConfig; later
+        loads must match it (one stacked shape per server).  Replacing
+        a name requires no live request on it (``adapter_busy``)."""
+        from ..models import lora as lora_mod
+        jnp = self._jnp
+
+        if self._lora_config is None:
+            if lora_config is None:
+                raise ValueError("first load_adapter needs lora_config")
+            self._lora_config = lora_config
+        elif lora_config is not None and (
+                lora_config.rank != self._lora_config.rank
+                or set(lora_config.targets)
+                != set(self._lora_config.targets)
+                or lora_config.alpha != self._lora_config.alpha):
+            # Targets compare as SETS: PEFT serializes target_modules
+            # from a set, so order varies while the stacked layout
+            # (keyed by target name) is unaffected.
+            # The stacked scale (= alpha/rank) is shared server-wide;
+            # a mismatched adapter would serve at the wrong scale.
+            raise ValueError(
+                f"adapter {name!r} config (rank {lora_config.rank}, "
+                f"alpha {lora_config.alpha}, targets "
+                f"{lora_config.targets}) does not match the server's "
+                f"(rank {self._lora_config.rank}, alpha "
+                f"{self._lora_config.alpha}, targets "
+                f"{self._lora_config.targets})")
+        stacked_one = lora_mod.stack_adapters(
+            self.config, self._lora_config, [lora_params])
+        if self._lora_shared is None:
+            self._lora_shared = stacked_one
+            self._adapter_index[name] = 1
+            return
+        existing = self._adapter_index.get(name)
+        if existing is not None:
+            if self._adapter_users(name):
+                raise ValueError(f"adapter_busy: {name!r} has live "
+                                 "requests")
+            index = existing
+        elif self._free_adapter_ids:
+            index = self._free_adapter_ids.pop()
+        else:
+            index = None           # append (stack widens; recompile)
+        new_layers = []
+        for layer, one in zip(self._lora_shared["layers"],
+                              stacked_one["layers"]):
+            merged = {}
+            for target, factors in layer.items():
+                fresh = one[target]
+                if index is None:
+                    merged[target] = {
+                        "a": jnp.concatenate(
+                            [factors["a"], fresh["a"][1:]]),
+                        "b": jnp.concatenate(
+                            [factors["b"], fresh["b"][1:]]),
+                    }
+                else:
+                    merged[target] = {
+                        "a": factors["a"].at[index].set(fresh["a"][1]),
+                        "b": factors["b"].at[index].set(fresh["b"][1]),
+                    }
+            new_layers.append(merged)
+        self._lora_shared = {"scale": self._lora_shared["scale"],
+                             "layers": new_layers}
+        if index is None:
+            index = self._lora_shared["layers"][0][
+                next(iter(new_layers[0]))]["a"].shape[0] - 1
+        self._adapter_index[name] = index
+
+    def unload_adapter(self, name: str) -> None:
+        """Remove a served adapter; its stacked index is zeroed and
+        recycled (no recompile).  Requires no live request on it."""
+        jnp = self._jnp
+        index = self._adapter_index.get(name)
+        if index is None:
+            raise KeyError(name)
+        if self._adapter_users(name):
+            raise ValueError(f"adapter_busy: {name!r} has live "
+                             "requests")
+        new_layers = []
+        for layer in self._lora_shared["layers"]:
+            merged = {}
+            for target, factors in layer.items():
+                merged[target] = {
+                    "a": factors["a"].at[index].set(
+                        jnp.zeros_like(factors["a"][index])),
+                    "b": factors["b"].at[index].set(
+                        jnp.zeros_like(factors["b"][index])),
+                }
+            new_layers.append(merged)
+        self._lora_shared = {"scale": self._lora_shared["scale"],
+                             "layers": new_layers}
+        del self._adapter_index[name]
+        self._free_adapter_ids.append(index)
+
     def _make_lora(self, ids):
         """Assemble the batched lora argument for per-row adapter
         ``ids`` — or None when no row actually runs an adapter, so
@@ -599,6 +716,9 @@ class ContinuousReplica(Actor):
         self.server = server or ContinuousBatchingServer()
         self._command_handlers["infer"] = self._wire_infer
         self._command_handlers["pump"] = self._pump
+        self._command_handlers["adapter_load"] = self._wire_adapter_load
+        self._command_handlers["adapter_unload"] = \
+            self._wire_adapter_unload
         self.share["slots"] = self.server.slots
         self.share["requests_served"] = 0
         self._pumping = False
@@ -670,6 +790,60 @@ class ContinuousReplica(Actor):
         if self.ec_producer is not None:
             for key, value in changed.items():
                 self.ec_producer.update(key, value)
+
+    def _wire_adapter_load(self, request_id, response_topic,
+                           payload=None):
+        """``(adapter_load id resp (name: n) (path: dir))``: import a
+        PEFT-layout adapter directory and make it servable — deploy a
+        fine-tune to a RUNNING replica.  Responds
+        ``(adapter_response id ok|error …)``."""
+        def action(inputs):
+            from ..tools.import_weights import import_lora
+            name = str(inputs["name"])
+            lora_params, lora_config = import_lora(
+                str(inputs["path"]), self.server.config)
+            self.server.load_adapter(name, lora_params, lora_config)
+            return name
+
+        self._adapter_action("adapter_load", action, request_id,
+                             response_topic, payload)
+
+    def _wire_adapter_unload(self, request_id, response_topic,
+                             payload=None):
+        def action(inputs):
+            name = str(inputs["name"])
+            self.server.unload_adapter(name)
+            return name
+
+        self._adapter_action("adapter_unload", action, request_id,
+                             response_topic, payload)
+
+    def _adapter_action(self, what, action, request_id, response_topic,
+                        payload):
+        from ..pipeline.codec import decode_swag, encode_swag
+        try:
+            name = action(decode_swag(payload or {}))
+            outputs = {"ok": name,
+                       "adapters": " ".join(
+                           self.server.adapters_loaded)}
+        except Exception as error:  # noqa: BLE001 - must respond
+            self.logger.warning("%s: %s failed: %s", self.name, what,
+                                error)
+            outputs = {"error": str(error)}
+        self._share_adapters()
+        if response_topic:
+            self.process.message.publish(
+                str(response_topic),
+                generate("adapter_response",
+                         [request_id, encode_swag(outputs)]))
+
+    def _share_adapters(self):
+        loaded = " ".join(self.server.adapters_loaded)
+        if self.share.get("adapters") == loaded:
+            return
+        self.share["adapters"] = loaded
+        if self.ec_producer is not None:
+            self.ec_producer.update("adapters", loaded)
 
     def _stream_partials(self):
         """Deliver newly decoded tokens for every live streaming

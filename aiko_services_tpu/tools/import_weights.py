@@ -305,6 +305,105 @@ def export_llama_checkpoint(params: Dict, config, path: str):
 
 
 # --------------------------------------------------------------------------- #
+# LoRA adapters (PEFT layout)
+
+#: our target name -> the HF module path PEFT keys carry.
+_PEFT_TARGETS = {
+    "wq": "self_attn.q_proj", "wk": "self_attn.k_proj",
+    "wv": "self_attn.v_proj", "wo": "self_attn.o_proj",
+    "w_gate": "mlp.gate_proj", "w_up": "mlp.up_proj",
+    "w_down": "mlp.down_proj",
+}
+_PEFT_MODULES = {module.split(".")[-1]: target
+                 for target, module in _PEFT_TARGETS.items()}
+
+
+def import_lora(path: str, config, dtype=jnp.bfloat16):
+    """PEFT-layout LoRA adapter (``adapter_model.safetensors`` +
+    ``adapter_config.json``) → ``(lora_params, LoRAConfig)`` matching
+    :mod:`..models.lora` — rank/alpha/targets from the adapter config,
+    factors transposed from torch (out, in) to our (in, r)/(r, out).
+
+    This is how an externally fine-tuned adapter (PEFT/`peft` trainer
+    output) becomes servable through the multi-adapter batch
+    (``ContinuousBatchingServer(adapters={name: lora_params})``)."""
+    from ..models.lora import LoRAConfig
+
+    adapter_config = None
+    if os.path.isdir(path):
+        cfg_path = os.path.join(path, "adapter_config.json")
+        if os.path.exists(cfg_path):
+            with open(cfg_path, encoding="utf-8") as fh:
+                adapter_config = json.load(fh)
+    if adapter_config is None:
+        raise FileNotFoundError(
+            f"no adapter_config.json under {path} (PEFT layout)")
+    modules = adapter_config.get("target_modules") or []
+    try:
+        targets = tuple(_PEFT_MODULES[m] for m in modules)
+    except KeyError as error:
+        raise ValueError(f"unsupported PEFT target module {error}; "
+                         f"known: {sorted(_PEFT_MODULES)}")
+    lora_config = LoRAConfig(
+        rank=int(adapter_config["r"]),
+        alpha=float(adapter_config.get("lora_alpha",
+                                       adapter_config["r"])),
+        targets=targets)
+
+    tensors, _ = load_checkpoint_tensors(path)
+    sample = next(name for name in tensors.names
+                  if "model.layers." in name)
+    prefix = sample.split("model.layers.")[0] + "model.layers."
+    layers = []
+    for i in range(config.n_layers):
+        layer = {}
+        for target in targets:
+            base = f"{prefix}{i}.{_PEFT_TARGETS[target]}."
+            # torch lora_A (r, in) -> a (in, r); lora_B (out, r) ->
+            # b (r, out).
+            layer[target] = {
+                "a": tensors.get(base + "lora_A.weight", dtype).T,
+                "b": tensors.get(base + "lora_B.weight", dtype).T,
+            }
+        layers.append(layer)
+    tensors.close()
+    return {"layers": layers}, lora_config
+
+
+def export_lora_checkpoint(lora_params: Dict, lora_config, config,
+                           path: str):
+    """Framework LoRA tree → a PEFT-layout adapter directory
+    (``adapter_model.safetensors`` + ``adapter_config.json``) —
+    the inverse of :func:`import_lora` (round-trip tested), and
+    loadable by the ``peft`` library against the matching HF base."""
+    import numpy as np
+    from safetensors.numpy import save_file
+
+    os.makedirs(path, exist_ok=True)
+    out = {}
+    for i, layer in enumerate(lora_params["layers"]):
+        for target, factors in layer.items():
+            base = (f"base_model.model.model.layers.{i}."
+                    f"{_PEFT_TARGETS[target]}.")
+            a = np.asarray(jnp.asarray(factors["a"], jnp.float32))
+            b = np.asarray(jnp.asarray(factors["b"], jnp.float32))
+            out[base + "lora_A.weight"] = np.ascontiguousarray(a.T)
+            out[base + "lora_B.weight"] = np.ascontiguousarray(b.T)
+    save_file(out, os.path.join(path, "adapter_model.safetensors"))
+    adapter_config = {
+        "peft_type": "LORA",
+        "r": lora_config.rank,
+        "lora_alpha": lora_config.alpha,
+        "target_modules": [_PEFT_TARGETS[t].split(".")[-1]
+                           for t in lora_config.targets],
+        "task_type": "CAUSAL_LM",
+    }
+    with open(os.path.join(path, "adapter_config.json"), "w",
+              encoding="utf-8") as fh:
+        json.dump(adapter_config, fh, indent=1)
+
+
+# --------------------------------------------------------------------------- #
 # Whisper
 
 def asr_config_from_hf(cfg: dict, dtype=jnp.bfloat16) -> "ASRConfig":

@@ -256,6 +256,190 @@ def test_mlp_targets_rejected_for_serving():
                                          jax.random.PRNGKey(0))])
 
 
+def test_peft_checkpoint_round_trip(tmp_path):
+    """export_lora_checkpoint (PEFT layout) → import_lora → identical
+    factors and config (f32 storage represents bf16 exactly)."""
+    from aiko_services_tpu.tools.import_weights import (
+        export_lora_checkpoint, import_lora,
+    )
+
+    config = llama.CONFIGS["tiny"]
+    adapter = _noisy_adapter(config, jax.random.PRNGKey(12))
+    out = str(tmp_path / "adapter")
+    export_lora_checkpoint(adapter, LORA, config, out)
+    back, back_config = import_lora(out, config)
+    assert back_config.rank == LORA.rank
+    assert back_config.alpha == LORA.alpha
+    assert back_config.targets == LORA.targets
+    for layer, layer_back in zip(adapter["layers"], back["layers"]):
+        for target in layer:
+            for factor in ("a", "b"):
+                np.testing.assert_array_equal(
+                    np.asarray(layer[target][factor], np.float32),
+                    np.asarray(layer_back[target][factor],
+                               np.float32))
+
+
+def test_hot_load_unload_adapter():
+    """load_adapter on a RUNNING adapter-less server makes the name
+    servable (output identical to a construction-time-adapters
+    server); unload frees it; busy replacement is refused."""
+    config = llama.CONFIGS["tiny"]
+    adapter = _noisy_adapter(config, jax.random.PRNGKey(14))
+    rng = np.random.default_rng(43)
+    prompt = rng.integers(1, config.vocab_size, 11).astype(np.int32)
+
+    static = ContinuousBatchingServer(
+        config_name="tiny", slots=2, max_seq=96, chunk_steps=4, seed=8,
+        adapters={"ft": adapter}, lora_config=LORA)
+    want = DecodeRequest("w", prompt.copy(), 6, adapter="ft")
+    static.submit(want)
+    static.run_until_drained()
+
+    hot = ContinuousBatchingServer(
+        config_name="tiny", slots=2, max_seq=96, chunk_steps=4, seed=8)
+    hot.load_adapter("ft", adapter, LORA)
+    assert hot.adapters_loaded == ["ft"]
+    got = DecodeRequest("g", prompt.copy(), 6, adapter="ft")
+    hot.submit(got)
+    hot.run_until_drained()
+    assert got.tokens == want.tokens
+
+    # Busy replacement refused: a live request pins the name.
+    live = DecodeRequest("l", prompt.copy(), 12, adapter="ft")
+    hot.submit(live)
+    hot.step()
+    with pytest.raises(ValueError, match="adapter_busy"):
+        hot.load_adapter("ft", adapter)
+    hot.run_until_drained()
+
+    # Second adapter recycles state; unload frees the first.
+    other = _noisy_adapter(config, jax.random.PRNGKey(15))
+    hot.load_adapter("ft2", other)
+    assert hot.adapters_loaded == ["ft", "ft2"]
+    hot.unload_adapter("ft")
+    assert hot.adapters_loaded == ["ft2"]
+    rejected = DecodeRequest("r", prompt.copy(), 4, adapter="ft")
+    hot.submit(rejected)
+    hot.run_until_drained()
+    assert rejected.error == "unknown_adapter"
+    # The recycled index serves the NEW adapter's weights.
+    reloaded = ContinuousBatchingServer(
+        config_name="tiny", slots=2, max_seq=96, chunk_steps=4, seed=8,
+        adapters={"ft2": other}, lora_config=LORA)
+    want2 = DecodeRequest("w2", prompt.copy(), 6, adapter="ft2")
+    reloaded.submit(want2)
+    reloaded.run_until_drained()
+    got2 = DecodeRequest("g2", prompt.copy(), 6, adapter="ft2")
+    hot.submit(got2)
+    hot.run_until_drained()
+    assert got2.tokens == want2.tokens
+
+
+def test_unload_refused_while_prefilling_or_queued():
+    """The busy check counts requests by NAME: a chunk-prefilling slot
+    (no adapter id assigned yet) and a queued request both pin the
+    adapter — unloading mid-admission would silently decode the prompt
+    KV under one model and the continuation under another."""
+    config = llama.CONFIGS["tiny"]
+    adapter = _noisy_adapter(config, jax.random.PRNGKey(17))
+    server = ContinuousBatchingServer(
+        config_name="tiny", slots=1, max_seq=128, chunk_steps=2,
+        seed=9, chunk_prefill_tokens=16,
+        adapters={"ft": adapter}, lora_config=LORA)
+    rng = np.random.default_rng(53)
+    long_prompt = rng.integers(1, config.vocab_size,
+                               60).astype(np.int32)
+    prefilling = DecodeRequest("p", long_prompt, 4, adapter="ft")
+    queued = DecodeRequest("q", long_prompt.copy(), 4, adapter="ft")
+    server.submit(prefilling)
+    server.submit(queued)
+    server.step()                    # admission starts chunk-prefill
+    assert server._prefilling        # still mid-admission
+    with pytest.raises(ValueError, match="adapter_busy"):
+        server.unload_adapter("ft")
+    server.run_until_drained()       # both complete under the adapter
+    server.unload_adapter("ft")      # now legal
+    assert server.adapters_loaded == []
+
+
+def test_adapter_load_unload_over_wire(engine, tmp_path):
+    """(adapter_load …) deploys a PEFT checkpoint directory to a
+    running replica; requests can use it immediately;
+    (adapter_unload …) removes it — all over the wire, with the
+    loaded-adapter list in the EC share."""
+    from aiko_services_tpu.tools.import_weights import (
+        export_lora_checkpoint,
+    )
+
+    config = llama.CONFIGS["tiny"]
+    adapter = _noisy_adapter(config, jax.random.PRNGKey(16))
+    adapter_dir = str(tmp_path / "ft_ckpt")
+    export_lora_checkpoint(adapter, LORA, config, adapter_dir)
+
+    process = Process(namespace="test", hostname="h", pid="91",
+                      engine=engine, broker="hotlora")
+    server = ContinuousBatchingServer(
+        config_name="tiny", slots=2, max_seq=64, chunk_steps=4, seed=6)
+    replica = compose_instance(
+        ContinuousReplica, actor_args("hot0"), process=process,
+        server=server)
+    admin, infers = [], {}
+
+    def handler(_topic, payload):
+        command, params = parse(payload)
+        if command == "adapter_response":
+            admin.append((params[0], decode_swag(params[1])))
+        elif command == "infer_response":
+            infers[params[0]] = decode_swag(params[1])
+
+    process.add_message_handler(handler, "test/hot_resp")
+
+    def pump(check):
+        for _ in range(5000):
+            engine.advance(0.001)
+            if check():
+                return True
+        return False
+
+    process.message.publish(
+        replica.topic_in,
+        generate("adapter_load", ["a1", "test/hot_resp",
+                                  encode_swag({"name": "ft",
+                                               "path": adapter_dir})]))
+    assert pump(lambda: admin)
+    assert admin[0][1].get("ok") == "ft", admin
+    assert replica.share["adapters"] == "ft"
+
+    prompt = np.arange(1, 10, dtype=np.int32)
+    for rid, extra in (("base", {}), ("ft", {"adapter": "ft"})):
+        process.message.publish(
+            replica.topic_in,
+            generate("infer", [rid, "test/hot_resp",
+                               encode_swag({"tokens": prompt,
+                                            "max_new_tokens": 6,
+                                            **extra})]))
+    assert pump(lambda: len(infers) == 2)
+    assert list(infers["base"]["tokens_out"]) != \
+        list(infers["ft"]["tokens_out"])
+
+    process.message.publish(
+        replica.topic_in,
+        generate("adapter_unload", ["a2", "test/hot_resp",
+                                    encode_swag({"name": "ft"})]))
+    assert pump(lambda: len(admin) == 2)
+    assert admin[1][1].get("ok") == "ft", admin
+    assert replica.share["adapters"] == ""
+    process.message.publish(
+        replica.topic_in,
+        generate("infer", ["gone", "test/hot_resp",
+                           encode_swag({"tokens": prompt,
+                                        "max_new_tokens": 4,
+                                        "adapter": "ft"})]))
+    assert pump(lambda: "gone" in infers)
+    assert infers["gone"].get("error") == "unknown_adapter"
+
+
 def test_adapter_over_wire_protocol(engine):
     """(infer … (adapter: name)) routes the request through its
     adapter; base requests in the same replica are untouched."""
