@@ -46,6 +46,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import time
 from typing import Dict, List, Optional
 
 import numpy as np
@@ -80,6 +81,13 @@ class DecodeRequest:
     # Filled by the server:
     tokens: Optional[List[int]] = None
     error: Optional[str] = None
+    #: Latency telemetry (monotonic seconds, host-observed): TTFT is
+    #: measured at the host sync that DELIVERS the first token — the
+    #: number a client actually experiences under lookahead/chunked
+    #: admission, not the device-internal emission time.
+    submitted_ts: Optional[float] = None
+    first_token_ts: Optional[float] = None
+    finished_ts: Optional[float] = None
 
 
 def _bucket(n: int, minimum: int = 16) -> int:
@@ -240,6 +248,7 @@ class ContinuousBatchingServer:
 
     def submit(self, request: DecodeRequest) -> None:
         request.tokens = []
+        request.submitted_ts = time.monotonic()
         prompt_len = int(np.asarray(request.prompt).shape[0])
         reason = self._admission_reject(prompt_len, request)
         if reason:
@@ -452,7 +461,9 @@ class ContinuousBatchingServer:
         if self._lora_config is None:
             if lora_config is None:
                 raise ValueError("first load_adapter needs lora_config")
-            self._lora_config = lora_config
+            # Committed only after stack_adapters validates it below —
+            # a failed first load must not wedge the server with a
+            # config that never actually loaded.
         elif lora_config is not None and (
                 lora_config.rank != self._lora_config.rank
                 or set(lora_config.targets)
@@ -470,8 +481,10 @@ class ContinuousBatchingServer:
                 f"(rank {self._lora_config.rank}, alpha "
                 f"{self._lora_config.alpha}, targets "
                 f"{self._lora_config.targets})")
+        candidate_config = self._lora_config or lora_config
         stacked_one = lora_mod.stack_adapters(
-            self.config, self._lora_config, [lora_params])
+            self.config, candidate_config, [lora_params])
+        self._lora_config = candidate_config
         if self._lora_shared is None:
             self._lora_shared = stacked_one
             self._adapter_index[name] = 1
@@ -482,6 +495,10 @@ class ContinuousBatchingServer:
                 raise ValueError(f"adapter_busy: {name!r} has live "
                                  "requests")
             index = existing
+            # New weights under an old id: cached prompt KV built with
+            # the previous weights must not be served (paged prefix
+            # cache keys carry the numeric id).
+            self._invalidate_adapter_cache(index)
         elif self._free_adapter_ids:
             index = self._free_adapter_ids.pop()
         else:
@@ -536,7 +553,15 @@ class ContinuousBatchingServer:
         self._lora_shared = {"scale": self._lora_shared["scale"],
                              "layers": new_layers}
         del self._adapter_index[name]
+        # The id will be recycled: stale cached KV under it must go
+        # before a future adapter can collide with its chain keys.
+        self._invalidate_adapter_cache(index)
         self._free_adapter_ids.append(index)
+
+    def _invalidate_adapter_cache(self, index: int) -> None:
+        """Layout hook: drop any cached state keyed by this stacked
+        adapter id (the paged prefix cache overrides this; the
+        contiguous layout caches nothing across requests)."""
 
     def _make_lora(self, ids):
         """Assemble the batched lora argument for per-row adapter
@@ -575,6 +600,7 @@ class ContinuousBatchingServer:
     def _retire(self, slot: int) -> None:
         request = self._requests[slot]
         if request is not None:
+            request.finished_ts = time.monotonic()
             self.completed.append(request)
         self._release_slot(slot)
         self._requests[slot] = None
@@ -585,6 +611,29 @@ class ContinuousBatchingServer:
         self._temperatures[slot] = 0.0
         self._top_ps[slot] = 1.0
         self._any_sampled = bool((self._temperatures > 0).any())
+
+    def cancel(self, request_id: str) -> bool:
+        """Cancel by id, wherever the request currently lives: queued
+        (dropped), chunk-prefilling (admission aborted, slot freed), or
+        decoding (retired early, partial tokens kept).  The request
+        completes with ``error="cancelled"`` and flows out through the
+        normal completion path.  Returns False for an unknown id."""
+        for i, request in enumerate(self._queue):
+            if request.request_id == request_id:
+                self._queue.pop(i)
+                request.error = "cancelled"
+                request.finished_ts = time.monotonic()
+                self.completed.append(request)
+                return True
+        for slot in range(self.slots):
+            request = self._requests[slot]
+            if request is None or request.request_id != request_id:
+                continue
+            request.error = "cancelled"
+            self._prefilling.pop(slot, None)
+            self._retire(slot)
+            return True
+        return False
 
     def step(self) -> List[DecodeRequest]:
         """Admit pending requests, decode one chunk run, retire
@@ -651,10 +700,13 @@ class ContinuousBatchingServer:
             self.positions[chunk_active] += total
             self.tokens[chunk_active, 0] = out_host[chunk_active,
                                                     total - 1]
+            now = time.monotonic()
             for slot in range(self.slots):
                 request = self._requests[slot]
                 if request is None or not chunk_active[slot]:
                     continue
+                if request.first_token_ts is None:
+                    request.first_token_ts = now
                 for step_index in range(total):
                     if self._emitted[slot] >= request.max_new_tokens:
                         break
@@ -719,6 +771,7 @@ class ContinuousReplica(Actor):
         self._command_handlers["adapter_load"] = self._wire_adapter_load
         self._command_handlers["adapter_unload"] = \
             self._wire_adapter_unload
+        self._command_handlers["infer_cancel"] = self._wire_cancel
         self.share["slots"] = self.server.slots
         self.share["requests_served"] = 0
         self._pumping = False
@@ -790,6 +843,17 @@ class ContinuousReplica(Actor):
         if self.ec_producer is not None:
             for key, value in changed.items():
                 self.ec_producer.update(key, value)
+
+    def _wire_cancel(self, request_id):
+        """``(infer_cancel request_id)``: the cancelled request's
+        normal ``infer_response`` (error ``cancelled``, any partial
+        tokens) is the acknowledgement; an unknown id is logged only —
+        its response may already be in flight."""
+        if self.server.cancel(str(request_id)):
+            self._ensure_pumping()
+        else:
+            self.logger.info("%s: infer_cancel for unknown id %s",
+                             self.name, request_id)
 
     def _wire_adapter_load(self, request_id, response_topic,
                            payload=None):
@@ -880,9 +944,22 @@ class ContinuousReplica(Actor):
                                     self.share["requests_served"])
         if request.error is not None:
             outputs: Dict = {"error": request.error}
+            if request.error == "cancelled" and request.tokens:
+                # Partial tokens are real work the client may keep.
+                outputs["tokens_out"] = np.asarray(request.tokens,
+                                                   np.int32)
         else:
             outputs = {"tokens_out": np.asarray(request.tokens,
                                                 np.int32)}
+        if request.submitted_ts is not None:
+            if request.first_token_ts is not None:
+                outputs["ttft_ms"] = round(
+                    (request.first_token_ts - request.submitted_ts)
+                    * 1e3, 2)
+            if request.finished_ts is not None:
+                outputs["total_ms"] = round(
+                    (request.finished_ts - request.submitted_ts)
+                    * 1e3, 2)
         if request.response_topic:
             self.process.message.publish(
                 request.response_topic,

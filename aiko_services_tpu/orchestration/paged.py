@@ -111,6 +111,9 @@ class PagedContinuousServer(ContinuousBatchingServer):
         self._index: dict = {}
         self._block_key: dict = {}
         self._refs: dict = {}
+        #: chain-key -> adapter id that seeded it (hot unload/replace
+        #: must purge exactly that adapter's cached blocks).
+        self._key_seed: dict = {}
         self._evictable: "OrderedDict[bytes, int]" = OrderedDict()
         #: chain topology: child key -> parent key, and per-key count
         #: of INDEXED children (leaf-first eviction reads this).
@@ -182,6 +185,7 @@ class PagedContinuousServer(ContinuousBatchingServer):
         self._evictable.pop(key, None)
         self._block_key.pop(block, None)
         self._refs.pop(block, None)
+        self._key_seed.pop(key, None)
         parent = self._parent.pop(key, None)
         if parent is not None and parent in self._children:
             self._children[parent] -= 1
@@ -222,9 +226,10 @@ class PagedContinuousServer(ContinuousBatchingServer):
         prompt = np.asarray(request.prompt)
         shared: List[int] = []
         keys: List = []
+        adapter_id = self._adapter_id(request)
         if self.enable_prefix_cache:
             keys = self._chain_keys(
-                prompt, self._adapter_id(request))[
+                prompt, adapter_id)[
                 :self._shareable_blocks(len(prompt))]
             for key in keys:
                 block = self._index.get(key)
@@ -292,12 +297,27 @@ class PagedContinuousServer(ContinuousBatchingServer):
                 self._index[key] = block
                 self._block_key[block] = key
                 self._refs[block] = 1
+                self._key_seed[key] = adapter_id
                 if position > 0:
                     parent = keys[position - 1]
                     self._parent[key] = parent
                     self._children[parent] = \
                         self._children.get(parent, 0) + 1
         return True
+
+    def _invalidate_adapter_cache(self, index: int) -> None:
+        """Hot unload/replace: purge every cached chain seeded by this
+        stacked adapter id — its KV was computed with weights that no
+        longer correspond to the id, and the id may be recycled.  The
+        busy check already guarantees no live request pins these
+        blocks (adapter-scoped keys ⇒ only that adapter's requests
+        could), so each is zero-ref; the refs guard is defensive."""
+        stale = [key for key, seed in self._key_seed.items()
+                 if seed == index]
+        for key in stale:
+            block = self._index.get(key)
+            if block is not None and not self._refs.get(block, 0):
+                self._purge_cached(key, block)
 
     def _prefill_and_insert(self, admissions) -> None:
         """Paged admissions stay per-slot: each request's prefix-cache

@@ -41,6 +41,7 @@ import jax.numpy as jnp
 __all__ = [
     "load_checkpoint_tensors", "llama_config_from_hf",
     "import_llama", "export_llama", "export_llama_checkpoint",
+    "import_lora", "export_lora_checkpoint",
     "asr_config_from_hf", "import_whisper",
 ]
 
@@ -351,22 +352,46 @@ def import_lora(path: str, config, dtype=jnp.bfloat16):
         targets=targets)
 
     tensors, _ = load_checkpoint_tensors(path)
-    sample = next(name for name in tensors.names
-                  if "model.layers." in name)
-    prefix = sample.split("model.layers.")[0] + "model.layers."
-    layers = []
-    for i in range(config.n_layers):
-        layer = {}
-        for target in targets:
-            base = f"{prefix}{i}.{_PEFT_TARGETS[target]}."
-            # torch lora_A (r, in) -> a (in, r); lora_B (out, r) ->
-            # b (r, out).
-            layer[target] = {
-                "a": tensors.get(base + "lora_A.weight", dtype).T,
-                "b": tensors.get(base + "lora_B.weight", dtype).T,
-            }
-        layers.append(layer)
-    tensors.close()
+    try:
+        sample = next(name for name in tensors.names
+                      if "model.layers." in name)
+        prefix = sample.split("model.layers.")[0] + "model.layers."
+        in_dims = {"wq": config.d_model, "wk": config.d_model,
+                   "wv": config.d_model,
+                   "wo": config.n_heads * config.head_dim,
+                   "w_gate": config.d_model, "w_up": config.d_model,
+                   "w_down": config.d_ff}
+        out_dims = {"wq": config.n_heads * config.head_dim,
+                    "wk": config.n_kv_heads * config.head_dim,
+                    "wv": config.n_kv_heads * config.head_dim,
+                    "wo": config.d_model, "w_gate": config.d_ff,
+                    "w_up": config.d_ff, "w_down": config.d_model}
+        layers = []
+        for i in range(config.n_layers):
+            layer = {}
+            for target in targets:
+                base = f"{prefix}{i}.{_PEFT_TARGETS[target]}."
+                if tensors.has(base + "lora_A.weight"):
+                    # torch lora_A (r, in) -> a (in, r);
+                    # lora_B (out, r) -> b (r, out).
+                    layer[target] = {
+                        "a": tensors.get(base + "lora_A.weight",
+                                         dtype).T,
+                        "b": tensors.get(base + "lora_B.weight",
+                                         dtype).T,
+                    }
+                else:
+                    # PEFT ``layers_to_transform`` leaves untouched
+                    # layers without factors: an exact identity.
+                    layer[target] = {
+                        "a": jnp.zeros((in_dims[target],
+                                        lora_config.rank), dtype),
+                        "b": jnp.zeros((lora_config.rank,
+                                        out_dims[target]), dtype),
+                    }
+            layers.append(layer)
+    finally:
+        tensors.close()
     return {"layers": layers}, lora_config
 
 

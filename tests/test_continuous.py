@@ -383,6 +383,88 @@ def test_chunked_prefill_with_adapter_exact():
     assert outs[0] == outs[16]
 
 
+def test_cancel_queued_prefilling_and_decoding():
+    """cancel() reaches a request wherever it lives: queued (dropped),
+    chunk-prefilling (admission aborted, slot freed), decoding
+    (retired early, partial tokens kept) — and the survivors'
+    outputs are untouched."""
+    server = ContinuousBatchingServer(
+        config_name="tiny", slots=2, max_seq=128, chunk_steps=2,
+        seed=7, chunk_prefill_tokens=16)
+    rng = np.random.default_rng(61)
+    decoding = DecodeRequest(
+        "d", rng.integers(1, 500, 8).astype(np.int32), 10)
+    prefilling = DecodeRequest(
+        "p", rng.integers(1, 500, 60).astype(np.int32), 6)
+    queued = DecodeRequest(
+        "q", rng.integers(1, 500, 9).astype(np.int32), 6)
+    survivor = DecodeRequest(
+        "s", rng.integers(1, 500, 7).astype(np.int32), 6)
+    for request in (decoding, prefilling, queued, survivor):
+        server.submit(request)
+    server.step()                       # d decodes, p starts chunks
+    assert server._prefilling
+    assert not server.cancel("nope")
+    assert server.cancel("q")
+    assert server.cancel("p")
+    assert not server._prefilling       # admission aborted
+    assert server.cancel("d")
+    finished = server.run_until_drained()
+    by_id = {r.request_id: r for r in finished}
+    assert by_id["q"].error == "cancelled" and by_id["q"].tokens == []
+    assert by_id["p"].error == "cancelled"
+    assert by_id["d"].error == "cancelled"
+    assert 0 < len(by_id["d"].tokens) < 10        # partial kept
+    assert by_id["d"].tokens == reference_greedy(
+        server, decoding.prompt, 10)[:len(by_id["d"].tokens)]
+    assert by_id["s"].error is None
+    assert by_id["s"].tokens == reference_greedy(server,
+                                                 survivor.prompt, 6)
+
+
+def test_cancel_and_latency_over_wire(engine):
+    """(infer_cancel id) completes the request with error=cancelled
+    over the wire; completed responses carry ttft_ms/total_ms."""
+    process = Process(namespace="test", hostname="h", pid="93",
+                      engine=engine, broker="cancel")
+    server = ContinuousBatchingServer(config_name="tiny", slots=1,
+                                      max_seq=64, chunk_steps=2,
+                                      seed=6)
+    replica = compose_instance(
+        ContinuousReplica, actor_args("cx0"), process=process,
+        server=server)
+    responses = {}
+
+    def handler(_topic, payload):
+        command, params = parse(payload)
+        if command == "infer_response":
+            responses[params[0]] = decode_swag(params[1])
+
+    process.add_message_handler(handler, "test/cx_resp")
+    prompt = np.arange(1, 8, dtype=np.int32)
+    # One running request and one queued-behind-it; cancel the queued.
+    for rid in ("run", "cancel_me"):
+        process.message.publish(
+            replica.topic_in,
+            generate("infer", [rid, "test/cx_resp",
+                               encode_swag({"tokens": prompt,
+                                            "max_new_tokens": 8})]))
+    process.message.publish(replica.topic_in,
+                            generate("infer_cancel", ["cancel_me"]))
+    for _ in range(5000):
+        engine.advance(0.001)
+        if len(responses) == 2:
+            break
+    assert len(responses) == 2, sorted(responses)
+    assert responses["cancel_me"].get("error") == "cancelled"
+    done = responses["run"]
+    assert list(done["tokens_out"]) == reference_greedy(server,
+                                                        prompt, 8)
+    assert float(np.asarray(done["ttft_ms"])) >= 0
+    assert float(np.asarray(done["total_ms"])) >= \
+        float(np.asarray(done["ttft_ms"]))
+
+
 def test_continuous_replica_telemetry_in_share(engine):
     """Slot occupancy and queue depth surface in the replica's EC share
     while requests are live, and return to zero once drained."""

@@ -336,6 +336,93 @@ def test_hot_load_unload_adapter():
     assert got2.tokens == want2.tokens
 
 
+def test_hot_replace_invalidates_prefix_cache():
+    """Replacing an adapter's weights (or recycling its id) must purge
+    its cached prompt blocks — otherwise a prefix hit would serve KV
+    computed under the OLD weights."""
+    from aiko_services_tpu.orchestration.paged import (
+        PagedContinuousServer,
+    )
+
+    config = llama.CONFIGS["tiny"]
+    old = _noisy_adapter(config, jax.random.PRNGKey(18))
+    new = _noisy_adapter(config, jax.random.PRNGKey(19))
+    server = PagedContinuousServer(
+        config_name="tiny", slots=1, max_seq=96, chunk_steps=4,
+        seed=10, block_size=16, enable_prefix_cache=True,
+        adapters={"ft": old}, lora_config=LORA)
+    rng = np.random.default_rng(57)
+    prompt = rng.integers(1, config.vocab_size, 40).astype(np.int32)
+
+    def run(rid):
+        request = DecodeRequest(rid, prompt.copy(), 5, adapter="ft")
+        server.submit(request)
+        server.run_until_drained()
+        return request
+
+    run("warm")                            # caches the prompt blocks
+    server.load_adapter("ft", new)         # same name, NEW weights
+    refreshed = run("after")
+    assert server.prefix_hits == 0         # stale blocks were purged
+    # Oracle: a fresh server constructed with the new weights.
+    oracle_server = PagedContinuousServer(
+        config_name="tiny", slots=1, max_seq=96, chunk_steps=4,
+        seed=10, block_size=16, enable_prefix_cache=True,
+        adapters={"ft": new}, lora_config=LORA)
+    want = DecodeRequest("w", prompt.copy(), 5, adapter="ft")
+    oracle_server.submit(want)
+    oracle_server.run_until_drained()
+    assert refreshed.tokens == want.tokens
+
+
+def test_failed_first_load_does_not_wedge_config():
+    """A rejected first load (MLP targets) must not stick as the
+    server-wide LoRAConfig; a valid load afterwards succeeds."""
+    config = llama.CONFIGS["tiny"]
+    server = ContinuousBatchingServer(config_name="tiny", slots=1,
+                                      max_seq=64, chunk_steps=2)
+    bad_config = LoRAConfig(rank=4, targets=("wq", "w_gate"))
+    bad = init_lora_params(config, bad_config, jax.random.PRNGKey(0))
+    with pytest.raises(ValueError, match="attention targets"):
+        server.load_adapter("bad", bad, bad_config)
+    good = _noisy_adapter(config, jax.random.PRNGKey(1))
+    server.load_adapter("good", good, LORA)      # must not mismatch
+    assert server.adapters_loaded == ["good"]
+
+
+def test_import_lora_partial_layers(tmp_path):
+    """A PEFT adapter covering only some layers (layers_to_transform)
+    imports with exact-identity factors for the untouched layers."""
+    import safetensors.numpy
+
+    from aiko_services_tpu.tools.import_weights import import_lora
+
+    config = llama.CONFIGS["tiny"]          # 2 layers
+    rng = np.random.default_rng(3)
+    out = {}
+    base = "base_model.model.model.layers.0.self_attn.q_proj."
+    out[base + "lora_A.weight"] = rng.standard_normal(
+        (4, config.d_model)).astype(np.float32)
+    out[base + "lora_B.weight"] = rng.standard_normal(
+        (config.n_heads * config.head_dim, 4)).astype(np.float32)
+    ckpt = tmp_path / "partial"
+    ckpt.mkdir()
+    safetensors.numpy.save_file(
+        out, str(ckpt / "adapter_model.safetensors"))
+    (ckpt / "adapter_config.json").write_text(
+        '{"peft_type": "LORA", "r": 4, "lora_alpha": 8,'
+        ' "target_modules": ["q_proj"]}')
+    lora_params, lora_config = import_lora(str(ckpt), config)
+    assert lora_config.rank == 4
+    layer1 = lora_params["layers"][1]["wq"]     # untouched layer
+    assert not np.asarray(layer1["a"], np.float32).any()
+    assert not np.asarray(layer1["b"], np.float32).any()
+    layer0 = lora_params["layers"][0]["wq"]
+    np.testing.assert_allclose(
+        np.asarray(layer0["a"], np.float32),
+        out[base + "lora_A.weight"].T, rtol=1e-2, atol=1e-2)
+
+
 def test_unload_refused_while_prefilling_or_queued():
     """The busy check counts requests by NAME: a chunk-prefilling slot
     (no adapter id assigned yet) and a queued request both pin the
