@@ -1142,6 +1142,89 @@ def _decode_core_ragged(params, token, cache, positions,
     return logits, new_cache
 
 
+def _cache_write_ragged_slab(cache_layer, k, v, starts):
+    """Write a (batch, K, kv, hd) slab at PER-ROW start positions
+    (speculative verify inside continuous batching: each slot scores
+    its drafted tokens at its OWN absolute position).  Contiguous
+    layouts only (rolling is rejected by the caller)."""
+    def write(buf_rows, new, start):
+        zeros = (0,) * (buf_rows.ndim - 1)
+        return jax.lax.dynamic_update_slice(
+            buf_rows, new.astype(buf_rows.dtype), (start,) + zeros)
+
+    write = jax.vmap(write)
+    return {key: write(cache_layer[key], src, starts)
+            for key, src in _quantize_pairs(cache_layer, k, v).items()}
+
+
+@functools.partial(jax.jit, static_argnames=("config",),
+                   donate_argnames=("cache",))
+def verify_chunk_ragged(params, tokens, cache, positions, active,
+                        config: LlamaConfig, lora=None):
+    """Teacher-forced scoring of K given tokens per slot, every row at
+    its OWN absolute start position — the speculative-verification
+    twin of :func:`prefill_chunk` for the continuous-batching slot
+    layout.  ``tokens`` (batch, K) int32, ``positions`` (batch,)
+    absolute position of tokens[:, 0].  Returns (logits (batch, K,
+    vocab) — ``logits[:, j]`` predicts position ``positions + j + 1``
+    — and the cache with the K rows written per slot).
+
+    Inactive slots write their slab at row 0 of their OWN slot rows —
+    slot isolation makes those rows garbage-tolerant, and admission's
+    bucket prefill rewrites ``[0, padded)`` before the slot ever
+    decodes (callers keep K ≤ the bucket floor).  Stale rows past a
+    rejected proposal are unattendable by the absolute-position mask
+    until rewritten (the module-wide invariant)."""
+    if cache and "pos" in cache[0]:
+        raise ValueError(
+            "verify_chunk_ragged does not support rolling caches")
+    starts = jnp.where(active, positions, 0)
+    positions_b = starts[:, None] + jnp.arange(tokens.shape[1])[None]
+    return _chunk_forward(
+        params, tokens, cache, positions_b,
+        lambda cache_layer, k, v: _cache_write_ragged_slab(
+            cache_layer, k, v, starts),
+        config, lora)
+
+
+def _chunk_forward(params, tokens, cache, positions_b, cache_write,
+                   config: LlamaConfig, lora):
+    """The ONE transformer stack for chunked forwards over an existing
+    cache — :func:`prefill_chunk` (scalar start) and
+    :func:`verify_chunk_ragged` (per-row starts) differ only in how
+    positions are built and how the K new rows are written
+    (``cache_write(cache_layer, k, v) -> layer_cache``)."""
+    batch, K = tokens.shape
+    cos, sin = _rope_freqs(config, positions_b)
+    x = _embed_lookup(params, tokens, config.dtype)
+    h, kv, hd = config.n_heads, config.n_kv_heads, config.head_dim
+    new_cache = []
+    lora_layers = lora["layers"] if lora else [None] * len(cache)
+    for layer, cache_layer, lora_layer in zip(params["layers"], cache,
+                                              lora_layers):
+        normed = rms_norm(x, layer["attn_norm"], config.norm_eps)
+        q = _lora_matmul(normed, layer["wq"], lora_layer, "wq",
+                         lora).reshape(batch, K, h, hd)
+        k = _lora_matmul(normed, layer["wk"], lora_layer, "wk",
+                         lora).reshape(batch, K, kv, hd)
+        v = _lora_matmul(normed, layer["wv"], lora_layer, "wv",
+                         lora).reshape(batch, K, kv, hd)
+        q = apply_rope(q, cos, sin)
+        k = apply_rope(k, cos, sin)
+        layer_cache = cache_write(cache_layer, k, v)
+        new_cache.append(layer_cache)
+        q_g = q.reshape(batch, K, kv, h // kv, hd)
+        out = _cached_gqa_attention(q_g, layer_cache, positions_b, hd,
+                                    window=config.sliding_window)
+        x = x + _lora_matmul(out.reshape(batch, K, h * hd),
+                             layer["wo"], lora_layer, "wo",
+                             lora).astype(x.dtype)
+        x = _mlp_block(layer, config, x)
+    x = rms_norm(x, params["final_norm"], config.norm_eps)
+    logits = _matmul(x, params["lm_head"]).astype(jnp.float32)
+    return logits, new_cache
+
+
 @functools.partial(jax.jit,
                    static_argnames=("config", "num_steps"),
                    donate_argnames=("cache",))
@@ -1325,36 +1408,11 @@ def prefill_chunk(params, tokens, cache, start_index,
             "(silently wrong logits); feed K=1 chunks instead")
     positions = start_index + jnp.arange(K)
     positions_b = jnp.broadcast_to(positions, (batch, K))
-    cos, sin = _rope_freqs(config, positions_b)
-    x = _embed_lookup(params, tokens, config.dtype)
-    h, kv, hd = config.n_heads, config.n_kv_heads, config.head_dim
-    new_cache = []
-    lora_layers = lora["layers"] if lora else [None] * len(cache)
-    for layer, cache_layer, lora_layer in zip(params["layers"], cache,
-                                              lora_layers):
-        normed = rms_norm(x, layer["attn_norm"], config.norm_eps)
-        q = _lora_matmul(normed, layer["wq"], lora_layer, "wq",
-                         lora).reshape(batch, K, h, hd)
-        k = _lora_matmul(normed, layer["wk"], lora_layer, "wk",
-                         lora).reshape(batch, K, kv, hd)
-        v = _lora_matmul(normed, layer["wv"], lora_layer, "wv",
-                         lora).reshape(batch, K, kv, hd)
-        q = apply_rope(q, cos, sin)
-        k = apply_rope(k, cos, sin)
-        layer_cache = _cache_write_slab(cache_layer, k, v, start_index)
-        new_cache.append(layer_cache)
-        # Shared masked-GQA helper, absolute-position mask.
-        group = h // kv
-        q_g = q.reshape(batch, K, kv, group, hd)
-        out = _cached_gqa_attention(q_g, layer_cache, positions_b, hd,
-                                    window=config.sliding_window)
-        x = x + _lora_matmul(out.reshape(batch, K, h * hd),
-                             layer["wo"], lora_layer, "wo",
-                             lora).astype(x.dtype)
-        x = _mlp_block(layer, config, x)
-    x = rms_norm(x, params["final_norm"], config.norm_eps)
-    logits = _matmul(x, params["lm_head"]).astype(jnp.float32)
-    return logits, new_cache
+    return _chunk_forward(
+        params, tokens, cache, positions_b,
+        lambda cache_layer, k, v: _cache_write_slab(cache_layer, k, v,
+                                                    start_index),
+        config, lora)
 
 
 def stack_pipeline_params(params, config: LlamaConfig, pp: int):

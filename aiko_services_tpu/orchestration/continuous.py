@@ -105,7 +105,10 @@ class ContinuousBatchingServer:
                  quantize: bool = False, eos_id: Optional[int] = None,
                  seed: int = 0, quantize_kv: bool = False, mesh=None,
                  lookahead: int = 1, adapters: Optional[Dict] = None,
-                 lora_config=None, chunk_prefill_tokens: int = 0):
+                 lora_config=None, chunk_prefill_tokens: int = 0,
+                 draft_config_name: Optional[str] = None,
+                 draft_params=None, spec_k: int = 4,
+                 draft_quantize: bool = False):
         import jax
         import jax.numpy as jnp
         from ..models import llama
@@ -170,6 +173,40 @@ class ContinuousBatchingServer:
                     f"16, got {self.chunk_prefill_tokens}")
         #: slot -> in-progress chunked admission state.
         self._prefilling: Dict[int, Dict] = {}
+        # Per-slot SPECULATIVE decoding: a small draft model proposes
+        # spec_k tokens for every live slot in one ragged chunk; ONE
+        # target verify pass (llama.verify_chunk_ragged) scores all
+        # proposals, and each slot commits its own accepted prefix
+        # plus the target's correction/bonus token — greedy outputs
+        # stay EXACTLY equal to the plain server (tested).  The draft
+        # keeps its own (slots, max_seq) contiguous cache, prefilled
+        # at admission alongside the target's.
+        self._draft = None
+        if draft_config_name is not None:
+            if self.chunk_prefill_tokens:
+                raise ValueError("speculative serving does not compose "
+                                 "with chunked-prefill admission yet")
+            if spec_k + 1 > 16:        # the prompt bucket floor
+                raise ValueError(
+                    f"spec_k {spec_k} too large: k+1 must be <= the "
+                    "prompt bucket floor (16) so admission prefill "
+                    "rewrites inactive-slot verify rows")
+            draft_config = llama.CONFIGS[draft_config_name]
+            if draft_config.vocab_size != self.config.vocab_size:
+                raise ValueError("draft and target must share a "
+                                 "vocabulary")
+            if draft_params is None:
+                draft_params = llama.init_params(
+                    draft_config, jax.random.PRNGKey(seed + 1))
+                if draft_quantize:
+                    draft_params = llama.quantize_params(draft_params)
+            self._draft = dict(
+                config=draft_config, params=draft_params,
+                k=int(spec_k),
+                cache=llama.init_cache(draft_config, slots,
+                                       self.max_seq))
+            self.spec_stats = {"target_passes": 0, "drafted": 0,
+                               "accepted": 0}
         self.eos_id = eos_id
         self.quantize_kv = quantize_kv
         self._bucket_minimum = 16
@@ -272,6 +309,17 @@ class ContinuousBatchingServer:
         if request.adapter is not None \
                 and request.adapter not in self._adapter_index:
             return "unknown_adapter"
+        if self._draft is not None:
+            if request.temperature > 0:
+                # Greedy acceptance is exact only for greedy requests;
+                # per-slot sampled speculation is not implemented.
+                return "sampled_unsupported_with_draft"
+            if prompt_len + request.max_new_tokens \
+                    + self._draft["k"] + 1 > self.max_seq:
+                # Speculation writes k rows past the live position;
+                # without this headroom the verify slab's clamped
+                # write would corrupt committed rows.
+                return "prompt_too_long"
         return None
 
     def live_requests(self) -> List[DecodeRequest]:
@@ -420,9 +468,21 @@ class ContinuousBatchingServer:
                 _, bucket_cache = self._llama.prefill(
                     self.params, jnp.asarray(prompts), bucket_cache,
                     self.config, lora=lora)
+                slot_rows = jnp.asarray(np.asarray(slots, np.int32))
                 self.cache = self._insert_slots(
-                    self.cache, bucket_cache,
-                    jnp.asarray(np.asarray(slots, np.int32)), padded)
+                    self.cache, bucket_cache, slot_rows, padded)
+                if self._draft is not None:
+                    # The draft needs the SAME committed history: its
+                    # prompt KV lands in its own slot cache alongside.
+                    draft = self._draft
+                    draft_bucket = self._llama.init_cache(
+                        draft["config"], len(sub), padded)
+                    _, draft_bucket = self._llama.prefill(
+                        draft["params"], jnp.asarray(prompts),
+                        draft_bucket, draft["config"])
+                    draft["cache"] = self._insert_slots(
+                        draft["cache"], draft_bucket, slot_rows,
+                        padded)
 
     def _reserve_slot(self, slot: int, padded: int, request) -> bool:
         """Capacity hook: claim layout resources for an admission.
@@ -640,7 +700,9 @@ class ContinuousBatchingServer:
         finished slots.  Returns (and clears) the completed list."""
         self._admit()
         self._advance_prefills()
-        if self.active.any():
+        if self.active.any() and self._draft is not None:
+            self._spec_round()
+        elif self.active.any():
             # Prefilling slots are occupied but not decode-active:
             # they are excluded from run sizing and from bookkeeping.
             remaining = [self._requests[s].max_new_tokens
@@ -720,6 +782,73 @@ class ContinuousBatchingServer:
                     self._retire(slot)
         done, self.completed = self.completed, []
         return done
+
+    def _spec_round(self) -> None:
+        """ONE per-slot speculative round: draft proposes ``k`` tokens
+        for every live slot (ragged chunk over its own cache), the
+        target scores ``[seed, d_1..d_k]`` in ONE
+        :func:`~..models.llama.verify_chunk_ragged` pass, and each
+        slot commits its accepted prefix plus the target's
+        correction/bonus token — so a round advances a slot by 1 to
+        k+1 tokens at ONE target weight-stream.  Greedy outputs are
+        exactly the plain server's (acceptance is argmax equality)."""
+        jnp, llama, draft = self._jnp, self._llama, self._draft
+        k = draft["k"]
+        chunk_active = self.active.copy()
+        tokens_d = jnp.asarray(self.tokens)
+        positions_d = jnp.asarray(self.positions)
+        active_d = jnp.asarray(self.active)
+        lora = self._make_lora(self._adapter_ids)
+        # Draft proposes (no adapters: the draft is a base model —
+        # acceptance may drop for adapter slots, exactness cannot).
+        proposals, _, _, draft["cache"] = llama.decode_chunk_ragged(
+            draft["params"], tokens_d, draft["cache"], positions_d,
+            active_d, k, draft["config"])
+        chunk = jnp.concatenate([tokens_d, proposals], axis=1)
+        logits, self.cache = llama.verify_chunk_ragged(
+            self.params, chunk, self.cache, positions_d, active_d,
+            self.config, lora=lora)
+        greedy = np.asarray(jnp.argmax(logits, axis=-1))  # (slots,k+1)
+        proposals_host = np.asarray(proposals)
+        self.spec_stats["target_passes"] += 1
+        now = time.monotonic()
+        resync = np.zeros((self.slots, k), np.int32)
+        for slot in range(self.slots):
+            request = self._requests[slot]
+            if request is None or not chunk_active[slot]:
+                continue
+            if request.first_token_ts is None:
+                request.first_token_ts = now
+            accepted = 0
+            while accepted < k and proposals_host[slot, accepted] \
+                    == greedy[slot, accepted]:
+                accepted += 1
+            self.spec_stats["drafted"] += k
+            self.spec_stats["accepted"] += accepted
+            new_tokens = [int(t) for t in
+                          proposals_host[slot, :accepted]]
+            new_tokens.append(int(greedy[slot, accepted]))
+            for token in new_tokens:
+                if self._emitted[slot] >= request.max_new_tokens:
+                    break
+                request.tokens.append(token)
+                self._emitted[slot] += 1
+                if self.eos_id is not None and token == self.eos_id:
+                    self._emitted[slot] = request.max_new_tokens
+            # Host mirrors advance by the FULL committed list — the
+            # device wrote those rows regardless of budget/EOS caps.
+            resync[slot, :len(new_tokens) - 1] = new_tokens[:-1]
+            self.tokens[slot, 0] = new_tokens[-1]
+            self.positions[slot] += len(new_tokens)
+            if self._emitted[slot] >= request.max_new_tokens:
+                self._retire(slot)
+        # Draft-cache resync: committed[:-1] spans positions+1 onward
+        # (fixed k width, zero-padded; idempotent rewrites, stale pad
+        # rows rewritten before they become attendable — the same
+        # policy as models.speculative._resync_draft).
+        _, draft["cache"] = llama.verify_chunk_ragged(
+            draft["params"], jnp.asarray(resync), draft["cache"],
+            positions_d + 1, active_d, draft["config"])
 
     def _begin_run(self) -> None:
         """Layout hook called once before a chunk run: stage any

@@ -148,6 +148,9 @@ def model_replica_plugin(fields, variables) -> List[str]:
                      f"/{slots} active (continuous batching)")
         lines.append(f"  queued:    "
                      f"{_get(variables, 'queue_depth', default=0)}")
+    adapters = _get(variables, "adapters", default=None)
+    if adapters not in (None, "-", ""):
+        lines.append(f"  adapters:  {adapters}")
     return lines
 
 

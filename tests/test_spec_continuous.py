@@ -1,0 +1,176 @@
+"""Per-slot speculative decoding inside continuous batching: a draft
+model proposes for every live slot, one ragged verify pass scores all
+proposals, each slot commits its own accepted prefix — and greedy
+outputs are EXACTLY the plain server's (speculation is a latency
+optimization, never an approximation)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from aiko_services_tpu.models import llama
+from aiko_services_tpu.orchestration.continuous import (
+    ContinuousBatchingServer, DecodeRequest,
+)
+
+from .test_continuous import reference_greedy
+
+
+def _requests(config, spec, seed=0):
+    rng = np.random.default_rng(seed)
+    out = []
+    for i, (plen, new) in enumerate(spec):
+        prompt = rng.integers(1, config.vocab_size,
+                              plen).astype(np.int32)
+        out.append(DecodeRequest(f"r{i}", prompt, new))
+    return out
+
+
+def _spec_server(**kwargs):
+    kwargs.setdefault("config_name", "tiny")
+    kwargs.setdefault("draft_config_name", "tiny")
+    kwargs.setdefault("spec_k", 3)
+    return ContinuousBatchingServer(**kwargs)
+
+
+def test_verify_chunk_ragged_matches_prefill_chunk():
+    """The ragged verify primitive at per-row positions produces the
+    same logits as per-request prefill_chunk at the same positions."""
+    config = llama.CONFIGS["tiny"]
+    params = llama.init_params(config, jax.random.PRNGKey(0))
+    rng = np.random.default_rng(1)
+    lens = [6, 11]
+    caches, chunks = [], []
+    K = 4
+    for i, plen in enumerate(lens):
+        prompt = jnp.asarray(
+            rng.integers(1, config.vocab_size, (1, plen)), jnp.int32)
+        cache = llama.init_cache(config, 1, 64)
+        _, cache = llama.prefill(params, prompt, cache, config)
+        caches.append(cache)
+        chunks.append(rng.integers(1, config.vocab_size,
+                                   (1, K)).astype(np.int32))
+    # Merge the two per-request caches into slot rows BEFORE the
+    # oracle calls below donate (invalidate) them.
+    merged = []
+    for layer_a, layer_b in zip(*caches):
+        merged.append({key: jnp.concatenate(
+            [layer_a[key], layer_b[key]]) for key in layer_a})
+    want = []
+    for i, plen in enumerate(lens):
+        logits, _ = llama.prefill_chunk(
+            params, jnp.asarray(chunks[i]), caches[i],
+            jnp.int32(plen - 1), config)
+        want.append(np.asarray(logits)[0])
+    tokens = jnp.asarray(np.concatenate(chunks, axis=0))
+    positions = jnp.asarray([lens[0] - 1, lens[1] - 1], jnp.int32)
+    active = jnp.ones((2,), bool)
+    logits, _ = llama.verify_chunk_ragged(
+        params, tokens, merged, positions, active, config)
+    got = np.asarray(logits)
+    for i in range(2):
+        np.testing.assert_allclose(got[i], want[i], rtol=2e-2,
+                                   atol=2e-2)
+        assert (got[i].argmax(-1) == want[i].argmax(-1)).all()
+
+
+def test_spec_continuous_matches_plain_server_exactly():
+    """Mixed lengths/budgets through 2 slots with queueing and slot
+    reuse: the speculative server's outputs are token-identical to the
+    plain server AND the per-request oracle."""
+    spec = [(5, 6), (11, 3), (3, 9), (17, 5), (8, 1), (24, 7)]
+    plain = ContinuousBatchingServer(config_name="tiny", slots=2,
+                                     max_seq=96, chunk_steps=4, seed=3)
+    fast = _spec_server(slots=2, max_seq=96, chunk_steps=4, seed=3)
+    outs = {}
+    for tag, server in (("plain", plain), ("spec", fast)):
+        requests = _requests(server.config, spec, seed=0)
+        for request in requests:
+            server.submit(request)
+        server.run_until_drained()
+        outs[tag] = {r.request_id: r.tokens for r in requests}
+    assert outs["plain"] == outs["spec"]
+    stats = fast.spec_stats
+    assert stats["target_passes"] > 0 and stats["drafted"] > 0
+
+
+def test_spec_acceptance_with_identical_draft():
+    """Draft == target (same params): acceptance is high — not 100%,
+    because the draft's single-token decode and the k+1-wide verify
+    are different compiled programs whose bf16 accumulation order can
+    flip near-tie argmaxes — and outputs stay EXACT regardless (the
+    verify pass alone decides every committed token)."""
+    server = _spec_server(slots=2, max_seq=96, chunk_steps=4, seed=5)
+    server._draft["params"] = server.params
+    server._draft["config"] = server.config
+    requests = _requests(server.config, [(7, 12), (12, 12)], seed=2)
+    for request in requests:
+        server.submit(request)
+    server.run_until_drained()
+    for request in requests:
+        assert request.tokens == reference_greedy(
+            server, request.prompt, request.max_new_tokens)
+    stats = server.spec_stats
+    assert stats["accepted"] / stats["drafted"] >= 0.5, stats
+    # Speculation actually paid: fewer target passes than tokens.
+    total = sum(len(r.tokens) for r in requests) // len(requests)
+    assert stats["target_passes"] < total
+
+
+def test_spec_eos_and_headroom():
+    """EOS retirement inside a speculative round truncates exactly;
+    requests without k+1 cache headroom are rejected at submit."""
+    server = _spec_server(slots=1, max_seq=64, chunk_steps=4, seed=7)
+    prompt = np.arange(1, 9, dtype=np.int32)
+    want = reference_greedy(server, prompt, 12)
+    server.eos_id = want[2]
+    request = DecodeRequest("e", prompt, 12)
+    server.submit(request)
+    server.run_until_drained()
+    assert request.tokens == want[:3]
+
+    # Headroom: prompt + new + k + 1 must fit max_seq.
+    too_long = DecodeRequest("h", np.ones(40, np.int32),
+                             64 - 40 - 1)   # fits the PLAIN bound
+    server.submit(too_long)
+    server.run_until_drained()
+    assert too_long.error == "prompt_too_long"
+
+
+def test_spec_rejects_sampled():
+    server = _spec_server(slots=1, max_seq=64)
+    request = DecodeRequest("s", np.arange(1, 6, dtype=np.int32), 4,
+                            temperature=1.0)
+    server.submit(request)
+    server.run_until_drained()
+    assert request.error == "sampled_unsupported_with_draft"
+
+
+def test_spec_with_adapters_exact():
+    """Adapter slots verify under their adapter (draft stays base):
+    outputs equal the plain adapter server's."""
+    from aiko_services_tpu.models.lora import LoRAConfig
+
+    from .test_multi_lora import LORA, _noisy_adapter
+
+    config = llama.CONFIGS["tiny"]
+    adapter = _noisy_adapter(config, jax.random.PRNGKey(9))
+    rng = np.random.default_rng(11)
+    prompt = rng.integers(1, config.vocab_size, 10).astype(np.int32)
+    outs = {}
+    for tag, extra in (("plain", {}),
+                       ("spec", dict(draft_config_name="tiny",
+                                     spec_k=3))):
+        server = ContinuousBatchingServer(
+            config_name="tiny", slots=2, max_seq=96, chunk_steps=4,
+            seed=6, adapters={"ft": adapter}, lora_config=LORA,
+            **extra)
+        a = DecodeRequest("a", prompt.copy(), 7, adapter="ft")
+        b = DecodeRequest("b", prompt.copy(), 7)
+        server.submit(a)
+        server.submit(b)
+        server.run_until_drained()
+        outs[tag] = (list(a.tokens), list(b.tokens))
+    assert outs["plain"] == outs["spec"]
+    assert outs["spec"][0] != outs["spec"][1]   # adapter applied
