@@ -10,4 +10,5 @@ from .continuous import (
     ContinuousBatchingServer, ContinuousReplica, DecodeRequest,
 )
 from .paged import PagedContinuousServer
+from .client import InferClient, InferFuture
 from .trainer import TrainerActor, TRAINER_PROTOCOL

@@ -1,0 +1,143 @@
+"""Client side of the serving wire protocol.
+
+Every replica kind (:class:`~.serving.ModelReplica`,
+:class:`~.continuous.ContinuousReplica`, a
+:class:`~.serving.ReplicaRouter` front) speaks the same idiom:
+``(infer request_id response_topic swag)`` in, ``(infer_response …)``
+out, with optional ``(infer_partial …)`` streaming increments and
+``(infer_cancel id)``.  :class:`InferClient` packages that idiom so an
+application never hand-rolls S-expressions — the serving analog of the
+reference's ``get_actor_mqtt`` reflection proxies
+(reference main/transport/transport_mqtt.py:122-141; those are
+fire-and-forget, while inference needs a response/streaming channel,
+hence a dedicated client).
+
+Futures, not blocking waits: the event engine may be driven by a
+VirtualClock in tests or run in a thread in an application, so
+``submit`` returns an :class:`InferFuture` that fills as messages
+arrive; ``wait`` polls it for real engines.
+"""
+
+from __future__ import annotations
+
+import itertools
+import uuid
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+from ..pipeline.codec import decode_swag, encode_swag
+from ..utils.sexpr import generate, parse
+
+__all__ = ["InferClient", "InferFuture"]
+
+
+class InferFuture:
+    """Fills as the replica responds; readable at any time."""
+
+    def __init__(self, request_id: str):
+        self.request_id = request_id
+        #: tokens streamed so far (partials; equals the final sequence
+        #: once done when the request streamed).
+        self.partial_tokens: List[int] = []
+        self.outputs: Optional[Dict] = None      # full response swag
+        self.error: Optional[str] = None
+        self.done = False
+        self.on_partial: Optional[Callable[[List[int]], None]] = None
+
+    @property
+    def tokens(self) -> List[int]:
+        """Final tokens when done, streamed prefix otherwise."""
+        if self.outputs is not None and "tokens_out" in self.outputs:
+            return [int(t) for t in
+                    np.asarray(self.outputs["tokens_out"])]
+        return list(self.partial_tokens)
+
+
+class InferClient:
+    """Submit inference requests to a replica (or router) topic and
+    collect responses on a private reply topic."""
+
+    def __init__(self, process, topic_in: str):
+        self.process = process
+        self.topic_in = topic_in
+        self._futures: Dict[str, InferFuture] = {}
+        # Globally unique client id: request ids must not collide
+        # across OS processes sharing one replica, or a cancel from
+        # one client could retire another's request.
+        self._uid = uuid.uuid4().hex[:10]
+        self._counter = itertools.count()
+        self.response_topic = (f"{process.topic_path_process}"
+                               f"/infer_client/{self._uid}")
+        process.add_message_handler(self._on_message,
+                                    self.response_topic)
+
+    # ------------------------------------------------------------- #
+
+    def submit(self, tokens, max_new_tokens: int = 16,
+               stream: bool = False, adapter: Optional[str] = None,
+               temperature: float = 0.0, top_p: float = 1.0,
+               on_partial=None,
+               request_id: Optional[str] = None) -> InferFuture:
+        """Send one ``(infer …)``; returns the future immediately."""
+        request_id = request_id or f"c{self._uid}_{next(self._counter)}"
+        future = InferFuture(request_id)
+        future.on_partial = on_partial
+        self._futures[request_id] = future
+        swag: Dict = {"tokens": np.asarray(tokens, np.int32),
+                      "max_new_tokens": int(max_new_tokens)}
+        if stream:
+            swag["stream"] = 1
+        if adapter:
+            swag["adapter"] = adapter
+        if temperature:
+            swag["temperature"] = float(temperature)
+            swag["top_p"] = float(top_p)
+        self.process.message.publish(
+            self.topic_in,
+            generate("infer", [request_id, self.response_topic,
+                               encode_swag(swag)]))
+        return future
+
+    def cancel(self, future: InferFuture) -> None:
+        """``(infer_cancel …)`` — the cancelled response resolves the
+        future with ``error="cancelled"`` and any partial tokens."""
+        self.process.message.publish(
+            self.topic_in,
+            generate("infer_cancel", [future.request_id]))
+
+    def wait(self, future: InferFuture, timeout: float = 30.0,
+             poll: float = 0.005) -> InferFuture:
+        """Block until done — for REAL engines (an engine thread is
+        pumping); under a VirtualClock drive the engine instead."""
+        import time
+        deadline = time.monotonic() + timeout
+        while not future.done:
+            if time.monotonic() > deadline:
+                raise TimeoutError(future.request_id)
+            time.sleep(poll)
+        return future
+
+    # ------------------------------------------------------------- #
+
+    def _on_message(self, _topic, payload):
+        command, params = parse(payload)
+        if command not in ("infer_response", "infer_partial") \
+                or len(params) < 2:
+            return
+        future = self._futures.get(str(params[0]))
+        if future is None:
+            return
+        outputs = decode_swag(params[1])
+        if command == "infer_partial":
+            increment = [int(t) for t in
+                         np.asarray(outputs["tokens_out"])]
+            future.partial_tokens.extend(increment)
+            if future.on_partial is not None:
+                future.on_partial(increment)
+            return
+        future.outputs = outputs
+        error = outputs.get("error")
+        future.error = str(error) if error is not None else None
+        future.done = True
+        del self._futures[future.request_id]

@@ -198,15 +198,15 @@ class ContinuousBatchingServer:
             if draft_params is None:
                 draft_params = llama.init_params(
                     draft_config, jax.random.PRNGKey(seed + 1))
-                if draft_quantize:
-                    draft_params = llama.quantize_params(draft_params)
+            if draft_quantize:
+                draft_params = llama.quantize_params(draft_params)
             self._draft = dict(
                 config=draft_config, params=draft_params,
                 k=int(spec_k),
                 cache=llama.init_cache(draft_config, slots,
                                        self.max_seq))
-            self.spec_stats = {"target_passes": 0, "drafted": 0,
-                               "accepted": 0}
+            from ..models.speculative import SpecStats
+            self.spec_stats = SpecStats()
         self.eos_id = eos_id
         self.quantize_kv = quantize_kv
         self._bucket_minimum = 16
@@ -810,7 +810,7 @@ class ContinuousBatchingServer:
             self.config, lora=lora)
         greedy = np.asarray(jnp.argmax(logits, axis=-1))  # (slots,k+1)
         proposals_host = np.asarray(proposals)
-        self.spec_stats["target_passes"] += 1
+        self.spec_stats.target_passes += 1
         now = time.monotonic()
         resync = np.zeros((self.slots, k), np.int32)
         for slot in range(self.slots):
@@ -823,8 +823,8 @@ class ContinuousBatchingServer:
             while accepted < k and proposals_host[slot, accepted] \
                     == greedy[slot, accepted]:
                 accepted += 1
-            self.spec_stats["drafted"] += k
-            self.spec_stats["accepted"] += accepted
+            self.spec_stats.drafted += k
+            self.spec_stats.accepted += accepted
             new_tokens = [int(t) for t in
                           proposals_host[slot, :accepted]]
             new_tokens.append(int(greedy[slot, accepted]))

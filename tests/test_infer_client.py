@@ -1,0 +1,94 @@
+"""InferClient: the packaged client side of the serving wire protocol
+— futures, streaming callbacks, adapters, cancellation — against a
+live ContinuousReplica over the loopback broker."""
+
+import numpy as np
+
+from aiko_services_tpu.orchestration.client import InferClient
+from aiko_services_tpu.orchestration.continuous import (
+    ContinuousBatchingServer, ContinuousReplica,
+)
+from aiko_services_tpu.runtime import (
+    Process, actor_args, compose_instance,
+)
+
+from .test_continuous import reference_greedy
+
+
+def _rig(engine, broker, **server_kwargs):
+    server_kwargs.setdefault("config_name", "tiny")
+    server_kwargs.setdefault("slots", 2)
+    server_kwargs.setdefault("max_seq", 64)
+    server_kwargs.setdefault("chunk_steps", 3)
+    process = Process(namespace="test", hostname="h", pid="95",
+                      engine=engine, broker=broker)
+    server = ContinuousBatchingServer(**server_kwargs)
+    replica = compose_instance(
+        ContinuousReplica, actor_args("cli0"), process=process,
+        server=server)
+    client_process = Process(namespace="test", hostname="h", pid="96",
+                            engine=engine, broker=broker)
+    client = InferClient(client_process, replica.topic_in)
+    return engine, server, client
+
+
+def _pump(engine, check, n=20000):
+    for _ in range(n):
+        engine.advance(0.001)
+        if check():
+            return True
+    return False
+
+
+def test_client_generate_and_stream(engine):
+    engine, server, client = _rig(engine, "cli1")
+    prompt = np.arange(1, 10, dtype=np.int32)
+    increments = []
+    streamed = client.submit(prompt, max_new_tokens=7, stream=True,
+                             on_partial=increments.append)
+    plain = client.submit(prompt, max_new_tokens=5)
+    assert _pump(engine, lambda: streamed.done and plain.done)
+    want7 = reference_greedy(server, prompt, 7)
+    assert streamed.tokens == want7
+    assert [t for inc in increments for t in inc] == want7
+    assert len(increments) >= 2               # actually incremental
+    assert plain.tokens == reference_greedy(server, prompt, 5)
+    assert plain.error is None
+    assert float(np.asarray(plain.outputs["total_ms"])) >= 0
+    assert client._futures == {}              # resolved state cleaned
+
+
+def test_client_cancel_and_partial_reads(engine):
+    engine, server, client = _rig(engine, "cli2", slots=1)
+    prompt = np.arange(1, 8, dtype=np.int32)
+    victim = client.submit(prompt, max_new_tokens=40, stream=True)
+    # Let at least one chunk stream, then cancel mid-decode.
+    assert _pump(engine, lambda: victim.partial_tokens)
+    mid_read = victim.tokens                  # readable before done
+    assert mid_read == victim.partial_tokens
+    client.cancel(victim)
+    assert _pump(engine, lambda: victim.done)
+    assert victim.error == "cancelled"
+    assert 0 < len(victim.tokens) < 40        # partial kept
+
+
+def test_client_adapter_requests(engine):
+    import jax
+
+    from aiko_services_tpu.models import llama
+    from .test_multi_lora import LORA, _noisy_adapter
+
+    adapter = _noisy_adapter(llama.CONFIGS["tiny"],
+                             jax.random.PRNGKey(30))
+    engine, server, client = _rig(engine, "cli3",
+                                  adapters={"ft": adapter},
+                                  lora_config=LORA)
+    prompt = np.arange(2, 11, dtype=np.int32)
+    base = client.submit(prompt, max_new_tokens=6)
+    tuned = client.submit(prompt, max_new_tokens=6, adapter="ft")
+    missing = client.submit(prompt, max_new_tokens=6, adapter="nope")
+    assert _pump(engine,
+                 lambda: base.done and tuned.done and missing.done)
+    assert base.tokens == reference_greedy(server, prompt, 6)
+    assert tuned.tokens != base.tokens
+    assert missing.error == "unknown_adapter"

@@ -92,7 +92,7 @@ def test_spec_continuous_matches_plain_server_exactly():
         outs[tag] = {r.request_id: r.tokens for r in requests}
     assert outs["plain"] == outs["spec"]
     stats = fast.spec_stats
-    assert stats["target_passes"] > 0 and stats["drafted"] > 0
+    assert stats.target_passes > 0 and stats.drafted > 0
 
 
 def test_spec_acceptance_with_identical_draft():
@@ -112,10 +112,10 @@ def test_spec_acceptance_with_identical_draft():
         assert request.tokens == reference_greedy(
             server, request.prompt, request.max_new_tokens)
     stats = server.spec_stats
-    assert stats["accepted"] / stats["drafted"] >= 0.5, stats
+    assert stats.acceptance_rate >= 0.5, stats
     # Speculation actually paid: fewer target passes than tokens.
     total = sum(len(r.tokens) for r in requests) // len(requests)
-    assert stats["target_passes"] < total
+    assert stats.target_passes < total
 
 
 def test_spec_eos_and_headroom():
