@@ -80,10 +80,6 @@ class InferClient:
                on_partial=None,
                request_id: Optional[str] = None) -> InferFuture:
         """Send one ``(infer …)``; returns the future immediately."""
-        request_id = request_id or f"c{self._uid}_{next(self._counter)}"
-        future = InferFuture(request_id)
-        future.on_partial = on_partial
-        self._futures[request_id] = future
         swag: Dict = {"tokens": np.asarray(tokens, np.int32),
                       "max_new_tokens": int(max_new_tokens)}
         if stream:
@@ -93,9 +89,35 @@ class InferClient:
         if temperature:
             swag["temperature"] = float(temperature)
             swag["top_p"] = float(top_p)
+        return self._send("infer", swag, on_partial=on_partial,
+                          request_id=request_id)
+
+    def load_adapter(self, name: str, path: str) -> InferFuture:
+        """Hot-deploy a PEFT-layout adapter checkpoint directory to
+        the replica; the future resolves with the ack (``ok``/
+        ``error`` and the loaded-adapter list).  ContinuousReplica
+        only — other replica kinds ack with ``unsupported_command``."""
+        return self._send("adapter_load", {"name": name,
+                                           "path": path}, prefix="a")
+
+    def unload_adapter(self, name: str) -> InferFuture:
+        return self._send("adapter_unload", {"name": name},
+                          prefix="a")
+
+    def _send(self, command: str, swag: Dict, on_partial=None,
+              request_id: Optional[str] = None,
+              prefix: str = "c") -> InferFuture:
+        """Register a future and publish ONE wire command carrying
+        (request_id, reply topic, swag) — the shared tail of every
+        request kind."""
+        request_id = request_id or \
+            f"{prefix}{self._uid}_{next(self._counter)}"
+        future = InferFuture(request_id)
+        future.on_partial = on_partial
+        self._futures[request_id] = future
         self.process.message.publish(
             self.topic_in,
-            generate("infer", [request_id, self.response_topic,
+            generate(command, [request_id, self.response_topic,
                                encode_swag(swag)]))
         return future
 
@@ -114,6 +136,9 @@ class InferClient:
         deadline = time.monotonic() + timeout
         while not future.done:
             if time.monotonic() > deadline:
+                # Forget the orphan: a target that never replies (or a
+                # reply after the deadline) must not leak the entry.
+                self._futures.pop(future.request_id, None)
                 raise TimeoutError(future.request_id)
             time.sleep(poll)
         return future
@@ -122,8 +147,8 @@ class InferClient:
 
     def _on_message(self, _topic, payload):
         command, params = parse(payload)
-        if command not in ("infer_response", "infer_partial") \
-                or len(params) < 2:
+        if command not in ("infer_response", "infer_partial",
+                           "adapter_response") or len(params) < 2:
             return
         future = self._futures.get(str(params[0]))
         if future is None:

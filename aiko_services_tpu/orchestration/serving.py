@@ -39,6 +39,21 @@ __all__ = ["ModelReplica", "ReplicaRouter", "REPLICA_PROTOCOL",
 REPLICA_PROTOCOL = "model_replica:0"
 
 
+def _register_unsupported_adapter_commands(actor) -> None:
+    """Adapter hot-deploy is a ContinuousReplica capability; other
+    protocol speakers ACK with an error instead of silently dropping
+    the command (a client future must always resolve)."""
+    def unsupported(request_id, response_topic, payload=None):
+        actor.process.message.publish(
+            str(response_topic),
+            generate("adapter_response",
+                     [str(request_id),
+                      encode_swag({"error": "unsupported_command"})]))
+
+    actor._command_handlers["adapter_load"] = unsupported
+    actor._command_handlers["adapter_unload"] = unsupported
+
+
 class ModelReplica(Actor):
     """Hosts one model instance and serves ``infer`` requests."""
 
@@ -48,6 +63,7 @@ class ModelReplica(Actor):
         super().__init__(context, process)
         self._infer = infer or (lambda payload: payload)
         self._command_handlers["infer"] = self._wire_infer
+        _register_unsupported_adapter_commands(self)
         self.share["requests_served"] = 0
 
     def _wire_infer(self, request_id, response_topic, payload=None):
@@ -78,6 +94,7 @@ class ReplicaRouter(Actor):
         self._replicas: List[str] = []   # replica topic paths, stable order
         self._next = 0
         self._command_handlers["infer"] = self.route
+        _register_unsupported_adapter_commands(self)
         self.share["replicas"] = 0
         self._cache = services_cache_create_singleton(self.process)
         self._cache.add_handler(

@@ -72,6 +72,58 @@ def test_client_cancel_and_partial_reads(engine):
     assert 0 < len(victim.tokens) < 40        # partial kept
 
 
+def test_client_adapter_admin(engine, tmp_path):
+    """client.load_adapter / unload_adapter deploy and retire a PEFT
+    checkpoint over the wire; acks resolve as futures."""
+    import jax
+
+    from aiko_services_tpu.models import llama
+    from aiko_services_tpu.tools.import_weights import (
+        export_lora_checkpoint,
+    )
+    from .test_multi_lora import LORA, _noisy_adapter
+
+    adapter = _noisy_adapter(llama.CONFIGS["tiny"],
+                             jax.random.PRNGKey(31))
+    checkpoint = str(tmp_path / "adapter")
+    export_lora_checkpoint(adapter, LORA, llama.CONFIGS["tiny"],
+                           checkpoint)
+    engine, server, client = _rig(engine, "cli4")
+    loaded = client.load_adapter("ft", checkpoint)
+    assert _pump(engine, lambda: loaded.done)
+    assert loaded.error is None and server.adapters_loaded == ["ft"]
+    prompt = np.arange(1, 9, dtype=np.int32)
+    tuned = client.submit(prompt, max_new_tokens=5, adapter="ft")
+    assert _pump(engine, lambda: tuned.done)
+    assert tuned.error is None
+    gone = client.unload_adapter("ft")
+    assert _pump(engine, lambda: gone.done)
+    assert gone.error is None and server.adapters_loaded == []
+    missing = client.unload_adapter("nope")
+    assert _pump(engine, lambda: missing.done)
+    assert missing.error is not None
+
+
+def test_serving_ops_demo_runs():
+    """The executable ops demo (examples/llm/serving_ops_demo.py)
+    completes its full lifecycle: stream, hot-deploy, mixed batch,
+    cancel, telemetry."""
+    import os
+
+    os.environ["SERVING_DEMO_CPU"] = ""      # conftest already on CPU
+    from examples.llm.serving_ops_demo import run_demo
+
+    lines = []
+    results = run_demo(out=lines.append)
+    assert results["base"].tokens != results["tuned"].tokens
+    # Cancel legitimately races completion; both outcomes are valid
+    # (the deterministic cancel guarantees live in test_continuous).
+    assert results["victim"].done
+    assert results["victim"].error in ("cancelled", None)
+    assert results["server"].adapters_loaded == ["support"]
+    assert any("telemetry" in line for line in lines)
+
+
 def test_client_adapter_requests(engine):
     import jax
 
