@@ -899,13 +899,18 @@ def _decode_core_paged(params, token, pool, tables, positions,
 
 
 def _chunk_scan(step_core, tokens, positions, cache_state, active,
-                num_steps, temperatures, top_ps, rng_key):
+                num_steps, temperatures, top_ps, rng_key,
+                collect_logits: bool = False):
     """Shared chunk-decode scaffolding for the contiguous and paged
     layouts: per-slot greedy/sampled pick, active-mask token/position
     advance, one ``lax.scan`` over steps.  ``step_core(token,
     cache_state, positions) -> (logits, cache_state)`` supplies the
     layout-specific write/read; everything else (the sampling semantics
-    the exactness tests pin down) exists ONCE here."""
+    the exactness tests pin down) exists ONCE here.
+
+    ``collect_logits``: also stack each step's next-token logits —
+    speculative DRAFT runs need them so acceptance can reconstruct the
+    exact proposal distribution (``sampling_probs``)."""
     sampled_mode = temperatures is not None
     if rng_key is None:
         rng_key = jax.random.PRNGKey(0)
@@ -927,13 +932,19 @@ def _chunk_scan(step_core, tokens, positions, cache_state, active,
         next_token = pick(logits[:, -1], step_key)[:, None]
         next_token = jnp.where(active[:, None], next_token, token)
         positions = jnp.where(active, positions + 1, positions)
-        return (next_token, positions, cache_state, key), \
-            next_token[:, 0]
+        ys = (next_token[:, 0], logits[:, -1]) if collect_logits \
+            else next_token[:, 0]
+        return (next_token, positions, cache_state, key), ys
 
-    (token, positions, cache_state, _), tokens_out = jax.lax.scan(
+    (token, positions, cache_state, _), ys = jax.lax.scan(
         body, (tokens, positions, cache_state, rng_key), None,
         length=num_steps)
-    return tokens_out.T, token, positions, cache_state
+    if collect_logits:
+        tokens_out, step_logits = ys
+        # (steps, slots, vocab) -> (slots, steps, vocab)
+        return (tokens_out.T, step_logits.transpose(1, 0, 2), token,
+                positions, cache_state)
+    return ys.T, token, positions, cache_state
 
 
 @functools.partial(jax.jit,
@@ -1226,12 +1237,13 @@ def _chunk_forward(params, tokens, cache, positions_b, cache_write,
 
 
 @functools.partial(jax.jit,
-                   static_argnames=("config", "num_steps"),
+                   static_argnames=("config", "num_steps",
+                                    "return_logits"),
                    donate_argnames=("cache",))
 def decode_chunk_ragged(params, tokens, cache, positions, active,
                         num_steps, config: LlamaConfig,
                         temperatures=None, top_ps=None, rng_key=None,
-                        lora=None):
+                        lora=None, return_logits: bool = False):
     """Decode ``num_steps`` tokens for a slot batch where each row has
     its own position and an ``active`` flag — ONE compiled scan (the
     continuous-batching inner loop; admission happens between chunks).
@@ -1249,7 +1261,10 @@ def decode_chunk_ragged(params, tokens, cache, positions, active,
     (prefill/generate_tokens/decode_step).
 
     Returns (tokens_out (batch, num_steps), last_token (batch, 1),
-    positions (batch,), cache).
+    positions (batch,), cache) — with ``return_logits=True``, the
+    per-step next-token logits (batch, num_steps, vocab) are inserted
+    after ``tokens_out`` (speculative draft runs: acceptance
+    reconstructs the exact proposal distribution from them).
     """
     if "pos" in cache[0]:
         raise ValueError(
@@ -1265,7 +1280,8 @@ def decode_chunk_ragged(params, tokens, cache, positions, active,
                                    config, lora=lora)
 
     return _chunk_scan(step_core, tokens, positions, cache, active,
-                       num_steps, temperatures, top_ps, rng_key)
+                       num_steps, temperatures, top_ps, rng_key,
+                       collect_logits=return_logits)
 
 
 def _sample_logits_per_row(logits, key, temperatures, top_ps):
@@ -1278,16 +1294,13 @@ def _sample_logits_per_row(logits, key, temperatures, top_ps):
                          top_p=top_ps[:, None])
 
 
-def sample_logits(logits, key, temperature: float = 1.0,
-                  top_k: int = 0, top_p=None):
-    """Sample token ids from ``logits (batch, vocab)`` with the standard
-    serving controls: temperature scaling, top-k truncation, and
-    nucleus (top-p) truncation — jit-compatible (static vocab sort, no
-    data-dependent shapes).  ``top_k`` must be static (it sizes a
-    slice).  ``top_p=None`` (or a static value >= 1) compiles the
-    nucleus out entirely; a float < 1 or a TRACED value applies it
-    (per-request nucleus without recompiling).  One shared descending
-    sort serves both truncations; the best token is always kept."""
+def _mask_logits(logits, temperature: float = 1.0, top_k: int = 0,
+                 top_p=None):
+    """Temperature-scale + top-k/top-p mask ``logits (batch, vocab)``
+    — THE truncation implementation: sampling draws from it
+    (:func:`sample_logits`) and speculative acceptance computes the
+    matching distributions from it (:func:`sampling_probs`), so the
+    two can never disagree."""
     logits = logits.astype(jnp.float32) / jnp.maximum(temperature, 1e-6)
     if isinstance(top_p, (int, float)) and top_p >= 1.0:
         top_p = None                 # trace-time no-op, not a tracer
@@ -1312,7 +1325,30 @@ def sample_logits(logits, key, temperature: float = 1.0,
             cutoff = jnp.where(cutoff_mask, jnp.inf,
                                sorted_desc).min(axis=-1, keepdims=True)
             logits = jnp.where(logits < cutoff, -1e30, logits)
-    return jax.random.categorical(key, logits).astype(jnp.int32)
+    return logits
+
+
+def sample_logits(logits, key, temperature: float = 1.0,
+                  top_k: int = 0, top_p=None):
+    """Sample token ids from ``logits (batch, vocab)`` with the standard
+    serving controls: temperature scaling, top-k truncation, and
+    nucleus (top-p) truncation — jit-compatible (static vocab sort, no
+    data-dependent shapes).  ``top_k`` must be static (it sizes a
+    slice).  ``top_p=None`` (or a static value >= 1) compiles the
+    nucleus out entirely; a float < 1 or a TRACED value applies it
+    (per-request nucleus without recompiling).  One shared descending
+    sort serves both truncations; the best token is always kept."""
+    return jax.random.categorical(
+        key, _mask_logits(logits, temperature, top_k,
+                          top_p)).astype(jnp.int32)
+
+
+def sampling_probs(logits, temperature: float = 1.0, top_p=None):
+    """The EXACT distribution :func:`sample_logits` draws from at
+    these controls (batch-shaped temperature/top_p broadcast like the
+    per-row sampler): softmax of the same masked, scaled logits."""
+    return jax.nn.softmax(_mask_logits(logits, temperature,
+                                       top_k=0, top_p=top_p), axis=-1)
 
 
 @functools.partial(jax.jit,

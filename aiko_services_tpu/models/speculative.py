@@ -32,7 +32,7 @@ import numpy as np
 from . import llama
 
 __all__ = ["speculative_generate", "speculative_generate_sampled",
-           "SpecStats"]
+           "SpecStats", "mrs_accept_batch"]
 
 
 class SpecStats:
@@ -57,6 +57,92 @@ class SpecStats:
                 f"accept={self.accepted}/{self.drafted} "
                 f"= {self.acceptance_rate:.0%}, "
                 f"tok/pass={self.tokens_per_target_pass:.2f})")
+
+
+@jax.jit
+def mrs_accept_batch(target_logits, draft_logits, proposals,
+                     temperatures, top_ps, key):
+    """Vectorized modified rejection sampling (Leviathan et al.) for a
+    SLOT BATCH, entirely on device — the acceptance kernel of sampled
+    speculative continuous batching.
+
+    Inputs: ``target_logits (slots, k+1, vocab)`` (position j predicts
+    token j of the window), ``draft_logits (slots, k, vocab)`` (the
+    draft's next-token logits when it proposed token j), ``proposals
+    (slots, k)``, per-slot ``temperatures``/``top_ps``.  Rows with
+    temperature 0 use exact GREEDY acceptance (argmax-prefix match +
+    the target's correction/bonus) — one kernel serves mixed batches.
+
+    Returns ``(tokens (slots, k+1), counts (slots,))``: the first
+    ``counts[i]`` entries of row i are that slot's committed tokens
+    (accepted prefix + MRS-corrected/bonus final token); later entries
+    are garbage.  Each committed token is distributed EXACTLY as
+    target-only sampling at the row's controls given its prefix
+    (statistically tested against the distribution directly)."""
+    slots, k = proposals.shape
+    temps = temperatures[:, None]
+    tops = top_ps[:, None]
+    # Distributions the samplers actually draw from (shared masking
+    # implementation — llama.sampling_probs == what sample_logits
+    # samples).  Flatten the window axis through the batch-shaped
+    # helper.
+    p_dist = llama.sampling_probs(
+        target_logits.reshape(slots * (k + 1), -1),
+        jnp.repeat(temps, k + 1, axis=0),
+        jnp.repeat(tops, k + 1, axis=0)).reshape(
+            slots, k + 1, -1)
+    q_dist = llama.sampling_probs(
+        draft_logits.reshape(slots * k, -1),
+        jnp.repeat(temps, k, axis=0),
+        jnp.repeat(tops, k, axis=0)).reshape(slots, k, -1)
+    p_prop = jnp.take_along_axis(p_dist[:, :k], proposals[..., None],
+                                 axis=-1)[..., 0]
+    q_prop = jnp.take_along_axis(q_dist, proposals[..., None],
+                                 axis=-1)[..., 0]
+    accept_key, final_key = jax.random.split(key)
+    u = jax.random.uniform(accept_key, (slots, k))
+    ratio = p_prop / jnp.maximum(q_prop, 1e-30)
+    sampled_accept = u < jnp.minimum(1.0, ratio)
+    # Greedy rows: exact argmax-prefix acceptance.
+    target_greedy = target_logits.argmax(-1).astype(jnp.int32)
+    greedy_accept = proposals == target_greedy[:, :k]
+    sampled_row = temperatures > 0
+    accept = jnp.where(sampled_row[:, None], sampled_accept,
+                       greedy_accept)
+    prefix = jnp.cumprod(accept.astype(jnp.int32), axis=-1)
+    counts = prefix.sum(-1)                       # accepted proposals
+    # Final token at window position ``counts``: MRS residual on
+    # rejection, the target's own distribution on full accept.
+    p_sel = jnp.take_along_axis(p_dist, counts[:, None, None],
+                                axis=1)[:, 0]            # (slots, V)
+    q_index = jnp.minimum(counts, k - 1)
+    q_sel = jnp.take_along_axis(q_dist, q_index[:, None, None],
+                                axis=1)[:, 0]
+    residual = jnp.maximum(p_sel - q_sel, 0.0)
+    residual_mass = residual.sum(-1, keepdims=True)
+    # p == q (empty residual) degrades to sampling from p itself.
+    rejected_dist = jnp.where(residual_mass > 0,
+                              residual / jnp.maximum(residual_mass,
+                                                     1e-30),
+                              p_sel)
+    final_dist = jnp.where((counts == k)[:, None], p_sel,
+                           rejected_dist)
+    sampled_final = jax.random.categorical(
+        final_key, jnp.log(jnp.maximum(final_dist, 1e-30))
+    ).astype(jnp.int32)
+    greedy_final = jnp.take_along_axis(
+        target_greedy, counts[:, None], axis=1)[:, 0]
+    final_token = jnp.where(sampled_row, sampled_final, greedy_final)
+    # Assemble: accepted proposals then the final token at position
+    # ``counts`` (later columns are garbage; callers read counts+1).
+    window = jnp.arange(k + 1)[None, :]
+    tokens = jnp.where(jnp.arange(k)[None, :] < counts[:, None],
+                       proposals, 0)
+    tokens = jnp.concatenate(
+        [tokens, jnp.zeros((slots, 1), jnp.int32)], axis=1)
+    tokens = jnp.where(window == counts[:, None],
+                       final_token[:, None], tokens)
+    return tokens, counts + 1
 
 
 def _setup(target_params, draft_params, prompt, num_new, target_config,

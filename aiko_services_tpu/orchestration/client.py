@@ -136,12 +136,18 @@ class InferClient:
         deadline = time.monotonic() + timeout
         while not future.done:
             if time.monotonic() > deadline:
-                # Forget the orphan: a target that never replies (or a
-                # reply after the deadline) must not leak the entry.
-                self._futures.pop(future.request_id, None)
+                # The future STAYS registered: a slow reply can still
+                # resolve it and a retried wait() then succeeds.  Call
+                # forget() to drop a request you are abandoning.
                 raise TimeoutError(future.request_id)
             time.sleep(poll)
         return future
+
+    def forget(self, future: InferFuture) -> None:
+        """Abandon a request: late replies/partials for it are dropped
+        (the entry for a target that never responds otherwise lives as
+        long as the client)."""
+        self._futures.pop(future.request_id, None)
 
     # ------------------------------------------------------------- #
 

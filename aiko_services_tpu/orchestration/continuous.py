@@ -310,10 +310,6 @@ class ContinuousBatchingServer:
                 and request.adapter not in self._adapter_index:
             return "unknown_adapter"
         if self._draft is not None:
-            if request.temperature > 0:
-                # Greedy acceptance is exact only for greedy requests;
-                # per-slot sampled speculation is not implemented.
-                return "sampled_unsupported_with_draft"
             if prompt_len + request.max_new_tokens \
                     + self._draft["k"] + 1 > self.max_seq:
                 # Speculation writes k rows past the live position;
@@ -791,7 +787,10 @@ class ContinuousBatchingServer:
         slot commits its accepted prefix plus the target's
         correction/bonus token — so a round advances a slot by 1 to
         k+1 tokens at ONE target weight-stream.  Greedy outputs are
-        exactly the plain server's (acceptance is argmax equality)."""
+        exactly the plain server's (acceptance is argmax equality);
+        sampled slots run device-side modified rejection sampling
+        (``mrs_accept_batch``) — every committed token distributed
+        exactly as target-only sampling at the slot's controls."""
         jnp, llama, draft = self._jnp, self._llama, self._draft
         k = draft["k"]
         chunk_active = self.active.copy()
@@ -799,16 +798,41 @@ class ContinuousBatchingServer:
         positions_d = jnp.asarray(self.positions)
         active_d = jnp.asarray(self.active)
         lora = self._make_lora(self._adapter_ids)
-        # Draft proposes (no adapters: the draft is a base model —
-        # acceptance may drop for adapter slots, exactness cannot).
-        proposals, _, _, draft["cache"] = llama.decode_chunk_ragged(
-            draft["params"], tokens_d, draft["cache"], positions_d,
-            active_d, k, draft["config"])
+        if self._any_sampled:
+            # Sampled round: the draft SAMPLES proposals at each
+            # slot's controls (returning its per-step logits), and the
+            # on-device MRS kernel decides acceptance — every
+            # committed token distributed exactly as target-only
+            # sampling; greedy rows use exact argmax acceptance
+            # inside the same kernel (tested).
+            self._rng, draft_key, accept_key = \
+                self._jax.random.split(self._rng, 3)
+            temps_d = jnp.asarray(self._temperatures)
+            tops_d = jnp.asarray(self._top_ps)
+            proposals, draft_logits, _, _, draft["cache"] = \
+                llama.decode_chunk_ragged(
+                    draft["params"], tokens_d, draft["cache"],
+                    positions_d, active_d, k, draft["config"],
+                    temperatures=temps_d, top_ps=tops_d,
+                    rng_key=draft_key, return_logits=True)
+        else:
+            proposals, _, _, draft["cache"] = llama.decode_chunk_ragged(
+                draft["params"], tokens_d, draft["cache"], positions_d,
+                active_d, k, draft["config"])
         chunk = jnp.concatenate([tokens_d, proposals], axis=1)
         logits, self.cache = llama.verify_chunk_ragged(
             self.params, chunk, self.cache, positions_d, active_d,
             self.config, lora=lora)
-        greedy = np.asarray(jnp.argmax(logits, axis=-1))  # (slots,k+1)
+        if self._any_sampled:
+            from ..models.speculative import mrs_accept_batch
+            tokens_dev, counts_dev = mrs_accept_batch(
+                logits, draft_logits, proposals, temps_d, tops_d,
+                accept_key)
+            committed_host = np.asarray(tokens_dev)
+            counts_host = np.asarray(counts_dev)
+        else:
+            greedy = np.asarray(jnp.argmax(logits, axis=-1))
+            committed_host = counts_host = None
         proposals_host = np.asarray(proposals)
         self.spec_stats.target_passes += 1
         now = time.monotonic()
@@ -819,15 +843,21 @@ class ContinuousBatchingServer:
                 continue
             if request.first_token_ts is None:
                 request.first_token_ts = now
-            accepted = 0
-            while accepted < k and proposals_host[slot, accepted] \
-                    == greedy[slot, accepted]:
-                accepted += 1
+            if committed_host is not None:
+                count = int(counts_host[slot])
+                new_tokens = [int(t) for t in
+                              committed_host[slot, :count]]
+                accepted = count - 1
+            else:
+                accepted = 0
+                while accepted < k and proposals_host[slot, accepted] \
+                        == greedy[slot, accepted]:
+                    accepted += 1
+                new_tokens = [int(t) for t in
+                              proposals_host[slot, :accepted]]
+                new_tokens.append(int(greedy[slot, accepted]))
             self.spec_stats.drafted += k
             self.spec_stats.accepted += accepted
-            new_tokens = [int(t) for t in
-                          proposals_host[slot, :accepted]]
-            new_tokens.append(int(greedy[slot, accepted]))
             for token in new_tokens:
                 if self._emitted[slot] >= request.max_new_tokens:
                     break

@@ -33,7 +33,6 @@ def run_demo(out=print):
         jax.config.update("jax_platforms", "cpu")
     import jax  # noqa: E402
     import jax.numpy as jnp  # noqa: E402
-    from aiko_services_tpu.models import llama  # noqa: E402
     from aiko_services_tpu.models.lora import (  # noqa: E402
         LoRAConfig, init_lora_params,
     )

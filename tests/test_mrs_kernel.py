@@ -1,0 +1,112 @@
+"""The device-side modified-rejection-sampling kernel
+(`mrs_accept_batch`): each committed token must be distributed EXACTLY
+as target-only sampling — verified statistically against the
+distribution itself with 200k independent rows in one call — and
+greedy rows must reproduce argmax-prefix acceptance exactly."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from aiko_services_tpu.models import llama
+from aiko_services_tpu.models.speculative import mrs_accept_batch
+
+pytestmark = pytest.mark.slow    # 200k-row statistical verification
+
+
+def _theorem_case(top_p, temperature, seed):
+    """First-committed-token marginal == the target sampling dist at
+    the row's controls, for ANY draft distribution."""
+    vocab, k, rows = 6, 3, 200_000
+    rng = np.random.default_rng(seed)
+    target_row = rng.standard_normal((k + 1, vocab)).astype(np.float32)
+    draft_row = rng.standard_normal((k, vocab)).astype(np.float32)
+    target_logits = jnp.broadcast_to(target_row,
+                                     (rows, k + 1, vocab))
+    draft_logits = jnp.broadcast_to(draft_row, (rows, k, vocab))
+    temperatures = jnp.full((rows,), temperature, jnp.float32)
+    top_ps = jnp.full((rows,), top_p, jnp.float32)
+    # Proposals sampled from the draft's ACTUAL distribution per row.
+    q0 = llama.sampling_probs(jnp.asarray(draft_row),
+                              jnp.full((k, 1), temperature),
+                              jnp.full((k, 1), top_p))
+    key = jax.random.PRNGKey(seed)
+    prop_key, accept_key = jax.random.split(key)
+    proposals = jax.vmap(
+        lambda kk: jax.random.categorical(
+            kk, jnp.log(jnp.maximum(q0, 1e-30))).astype(jnp.int32)
+    )(jax.random.split(prop_key, rows))
+    tokens, counts = mrs_accept_batch(
+        target_logits, draft_logits, proposals, temperatures, top_ps,
+        accept_key)
+    first = np.asarray(tokens[:, 0])
+    want = np.asarray(llama.sampling_probs(
+        jnp.asarray(target_row[:1]),
+        jnp.full((1, 1), temperature),
+        jnp.full((1, 1), top_p)))[0]
+    got = np.bincount(first, minlength=vocab) / rows
+    np.testing.assert_allclose(got, want, atol=0.01), (got, want)
+    assert counts.min() >= 1 and counts.max() <= k + 1
+
+
+def test_committed_token_distribution_matches_target():
+    _theorem_case(top_p=1.0, temperature=1.0, seed=0)
+
+
+def test_committed_token_distribution_with_nucleus():
+    """top_p < 1: both sampler and acceptance truncate identically (a
+    mismatch would shift mass outside the nucleus or skew within)."""
+    _theorem_case(top_p=0.7, temperature=0.8, seed=1)
+
+
+def test_greedy_rows_exact_argmax_acceptance():
+    """temperature-0 rows through the SAME kernel: committed tokens
+    are the argmax prefix + correction/bonus, deterministically."""
+    vocab, k = 8, 3
+    rng = np.random.default_rng(3)
+    target_logits = jnp.asarray(
+        rng.standard_normal((4, k + 1, vocab)), jnp.float32)
+    greedy = np.asarray(target_logits.argmax(-1))
+    # Proposals: rows 0 matches fully, row 1 diverges at 0, row 2 at
+    # 1, row 3 at 2.
+    proposals = greedy[:, :k].copy()
+    for row, miss in ((1, 0), (2, 1), (3, 2)):
+        proposals[row, miss] = (proposals[row, miss] + 1) % vocab
+    draft_logits = jnp.asarray(
+        rng.standard_normal((4, k, vocab)), jnp.float32)
+    tokens, counts = mrs_accept_batch(
+        target_logits, jnp.asarray(draft_logits),
+        jnp.asarray(proposals), jnp.zeros((4,), jnp.float32),
+        jnp.ones((4,), jnp.float32), jax.random.PRNGKey(0))
+    tokens, counts = np.asarray(tokens), np.asarray(counts)
+    assert list(counts) == [k + 1, 1, 2, 3]
+    for row in range(4):
+        n = counts[row]
+        want = list(proposals[row][:n - 1]) + [greedy[row, n - 1]]
+        assert list(tokens[row][:n]) == want, (row, tokens[row], want)
+
+
+def test_mixed_greedy_and_sampled_rows_one_call():
+    """Greedy and sampled rows share one kernel call without
+    cross-contamination: the greedy row is deterministic across keys
+    while sampled rows vary."""
+    vocab, k = 6, 2
+    rng = np.random.default_rng(5)
+    target_logits = jnp.asarray(
+        rng.standard_normal((2, k + 1, vocab)), jnp.float32)
+    draft_logits = jnp.asarray(
+        rng.standard_normal((2, k, vocab)), jnp.float32)
+    proposals = jnp.asarray(rng.integers(0, vocab, (2, k)), jnp.int32)
+    temperatures = jnp.asarray([0.0, 1.0], jnp.float32)
+    top_ps = jnp.ones((2,), jnp.float32)
+    outs = []
+    for seed in range(8):
+        tokens, counts = mrs_accept_batch(
+            target_logits, draft_logits, proposals, temperatures,
+            top_ps, jax.random.PRNGKey(seed))
+        outs.append((np.asarray(tokens), np.asarray(counts)))
+    greedy_rows = {(tuple(t[0][:c[0]]), c[0]) for t, c in outs}
+    assert len(greedy_rows) == 1                    # deterministic
+    sampled_rows = {tuple(t[1][:c[1]]) for t, c in outs}
+    assert len(sampled_rows) > 1                    # actually samples

@@ -138,13 +138,44 @@ def test_spec_eos_and_headroom():
     assert too_long.error == "prompt_too_long"
 
 
-def test_spec_rejects_sampled():
-    server = _spec_server(slots=1, max_seq=64)
-    request = DecodeRequest("s", np.arange(1, 6, dtype=np.int32), 4,
-                            temperature=1.0)
-    server.submit(request)
-    server.run_until_drained()
-    assert request.error == "sampled_unsupported_with_draft"
+def test_spec_sampled_mixed_batch():
+    """A sampled request joins the speculative batch: the MRS kernel
+    path runs, sampled tokens are valid and seed-deterministic, and
+    the greedy neighbor stays EXACTLY the oracle stream."""
+    outs = []
+    for _ in range(2):          # identical servers ⇒ identical rng
+        server = _spec_server(slots=2, max_seq=96, chunk_steps=4,
+                              seed=13)
+        rng = np.random.default_rng(21)
+        greedy = DecodeRequest(
+            "g", rng.integers(1, 500, 9).astype(np.int32), 8)
+        sampled = DecodeRequest(
+            "s", rng.integers(1, 500, 7).astype(np.int32), 8,
+            temperature=1.0, top_p=0.9)
+        server.submit(greedy)
+        server.submit(sampled)
+        server.run_until_drained()
+        assert greedy.tokens == reference_greedy(server,
+                                                 greedy.prompt, 8)
+        assert len(sampled.tokens) == 8
+        assert all(0 <= t < server.config.vocab_size
+                   for t in sampled.tokens)
+        outs.append(list(sampled.tokens))
+    assert outs[0] == outs[1]       # same seeds ⇒ same sampled stream
+
+
+def test_spec_sampled_varies_across_seeds():
+    tokens = set()
+    for seed in (31, 32, 33):
+        server = _spec_server(slots=1, max_seq=96, chunk_steps=4,
+                              seed=seed)
+        request = DecodeRequest(
+            "s", np.arange(1, 10, dtype=np.int32), 10,
+            temperature=1.0)
+        server.submit(request)
+        server.run_until_drained()
+        tokens.add(tuple(request.tokens))
+    assert len(tokens) > 1          # sampling actually samples
 
 
 def test_spec_with_adapters_exact():
