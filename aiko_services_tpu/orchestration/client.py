@@ -171,4 +171,7 @@ class InferClient:
         error = outputs.get("error")
         future.error = str(error) if error is not None else None
         future.done = True
-        del self._futures[future.request_id]
+        # pop, not del: a concurrent forget() may have removed the
+        # entry between the get() above and here (documented usage
+        # after a wait() timeout).
+        self._futures.pop(future.request_id, None)

@@ -833,7 +833,9 @@ class ContinuousBatchingServer:
         else:
             greedy = np.asarray(jnp.argmax(logits, axis=-1))
             committed_host = counts_host = None
-        proposals_host = np.asarray(proposals)
+            # Only the greedy acceptance loop reads the proposals on
+            # host; sampled rounds commit from the kernel's output.
+            proposals_host = np.asarray(proposals)
         self.spec_stats.target_passes += 1
         now = time.monotonic()
         resync = np.zeros((self.slots, k), np.int32)

@@ -46,7 +46,8 @@ def _theorem_case(top_p, temperature, seed):
         jnp.full((1, 1), temperature),
         jnp.full((1, 1), top_p)))[0]
     got = np.bincount(first, minlength=vocab) / rows
-    np.testing.assert_allclose(got, want, atol=0.01), (got, want)
+    np.testing.assert_allclose(got, want, atol=0.01,
+                               err_msg=f"{got} vs {want}")
     assert counts.min() >= 1 and counts.max() <= k + 1
 
 

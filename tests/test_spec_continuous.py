@@ -178,6 +178,26 @@ def test_spec_sampled_varies_across_seeds():
     assert len(tokens) > 1          # sampling actually samples
 
 
+def test_spec_moe_target_exact():
+    """A MoE target under speculation (dense tiny draft): greedy
+    outputs exactly equal the plain MoE server — the verify chunk
+    routes experts identically to the decode path."""
+    spec = [(5, 6), (9, 5), (4, 7)]
+    outs = {}
+    for tag, extra in (("plain", {}),
+                       ("spec", dict(draft_config_name="tiny",
+                                     spec_k=3))):
+        server = ContinuousBatchingServer(
+            config_name="moe_tiny", slots=2, max_seq=64,
+            chunk_steps=4, seed=3, **extra)
+        requests = _requests(server.config, spec, seed=5)
+        for request in requests:
+            server.submit(request)
+        server.run_until_drained()
+        outs[tag] = [r.tokens for r in requests]
+    assert outs["plain"] == outs["spec"]
+
+
 def test_spec_with_adapters_exact():
     """Adapter slots verify under their adapter (draft stays base):
     outputs equal the plain adapter server's."""
