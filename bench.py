@@ -605,18 +605,36 @@ def bench_serving_continuous(slots=8, prompt_len=64, max_new=64,
     log("serving[continuous] warmup (compile prefill + chunk)...")
     submit_batch(slots, "warm")
     server.run_until_drained()
-    log(f"serving[continuous] timed: {n_requests} requests x "
+
+    def timed(tag):
+        submit_batch(n_requests, tag)
+        started = time.perf_counter()
+        finished = server.run_until_drained()
+        elapsed = time.perf_counter() - started
+        total_tokens = sum(len(r.tokens) for r in finished
+                           if r.error is None)
+        return total_tokens / elapsed, total_tokens, elapsed
+
+    # Head-to-head on the SAME compiled programs (lookahead chaining
+    # is host-side scheduling, not a new program): sync-every-chunk
+    # first, then multi-step scheduling — the delta is the host round
+    # trips the lookahead hides.
+    server.lookahead = 1
+    log(f"serving[continuous] timed lookahead=1: {n_requests} reqs x "
         f"{max_new} tokens through {slots} slots...")
-    submit_batch(n_requests, "r")
-    started = time.perf_counter()
-    finished = server.run_until_drained()
-    elapsed = time.perf_counter() - started
-    total_tokens = sum(len(r.tokens) for r in finished
-                      if r.error is None)
-    tps = total_tokens / elapsed
+    tps_la1, total_tokens, elapsed = timed("s")
+    log(f"serving[continuous] lookahead=1: {tps_la1:.0f} tok/s/chip "
+        f"({total_tokens} tokens, {elapsed:.2f}s)")
+    server.lookahead = lookahead
+    log(f"serving[continuous] timed lookahead={lookahead}...")
+    tps, total_tokens, elapsed = timed("r")
     log(f"serving[continuous]: {tps:.0f} tokens/sec/chip sustained "
-        f"({n_requests} reqs, {total_tokens} tokens, {elapsed:.2f}s)")
-    return {"serving_continuous_tokens_per_sec_chip": round(tps)}
+        f"({n_requests} reqs, {total_tokens} tokens, {elapsed:.2f}s; "
+        f"multi-step scheduling {tps / max(tps_la1, 1e-9):.2f}x the "
+        "sync-every-chunk run)")
+    return {"serving_continuous_tokens_per_sec_chip": round(tps),
+            "serving_continuous_lookahead1_tokens_per_sec_chip":
+                round(tps_la1)}
 
 
 # --------------------------------------------------------------------------- #
@@ -929,7 +947,8 @@ def bench_serving_paged(slots=8, prompt_len=64, max_new=64,
     submit_batch(slots, "warm")
     server.run_until_drained()
     log(f"serving[paged] timed: {n_requests} requests x {max_new} "
-        f"tokens, shared {shared_prefix}-token prefix...")
+        f"tokens, shared {shared_prefix}-token prefix, "
+        f"lookahead={lookahead}...")
     submit_batch(n_requests, "r")
     started = time.perf_counter()
     finished = server.run_until_drained()
