@@ -15,8 +15,11 @@ BASELINE.md "multi-replica serving actors, DP over chips" workload:
   ServicesCache (by protocol), load-balances requests round-robin, and
   prunes replicas the moment the Registrar evicts them (LWT death or
   lease expiry).  Routing is fire-and-forget pass-through: the
-  *original* response topic rides along, so the router holds no
-  per-request state and is itself replicable.
+  *original* response topic rides along.  The only per-request state
+  is the bounded id→replica affinity ring that lets ``infer_cancel``
+  follow its request — so REPLICATED routers serve fine, but a cancel
+  must reach the router that routed the request (sticky clients, or
+  send cancels to every router instance).
 
 Payloads are swag-codec dicts (numpy arrays travel as typed tags), so
 token tensors cross process boundaries losslessly.
@@ -24,6 +27,7 @@ token tensors cross process boundaries losslessly.
 
 from __future__ import annotations
 
+from collections import OrderedDict
 from typing import Callable, Dict, List, Optional
 
 from ..pipeline.codec import decode_swag, encode_swag
@@ -94,7 +98,16 @@ class ReplicaRouter(Actor):
         self._replicas: List[str] = []   # replica topic paths, stable order
         self._next = 0
         self._command_handlers["infer"] = self.route
+        self._command_handlers["infer_cancel"] = self._route_cancel
         _register_unsupported_adapter_commands(self)
+        #: request_id -> replica topic path, so infer_cancel follows
+        #: its request to the SAME replica.  Bounded ring evicting the
+        #: OLDEST ROUTED id (liveness is invisible to a pass-through
+        #: router): a cancel for an aged-out id is dropped with a log,
+        #: so size the ring well above the maximum in-flight window
+        #: (entries are two short strings each).
+        self._routed: "OrderedDict[str, str]" = OrderedDict()
+        self._routed_limit = 65536
         self.share["replicas"] = 0
         self._cache = services_cache_create_singleton(self.process)
         self._cache.add_handler(
@@ -131,11 +144,28 @@ class ReplicaRouter(Actor):
             return False
         target = self._replicas[self._next % len(self._replicas)]
         self._next += 1
+        self._routed[str(request_id)] = target
+        while len(self._routed) > self._routed_limit:
+            self._routed.popitem(last=False)
         self.process.message.publish(
             f"{target}/in",
             generate("infer", [str(request_id), str(response_topic),
                                payload or {}]))
         return True
+
+    def _route_cancel(self, request_id) -> None:
+        """Forward ``(infer_cancel id)`` to the replica that holds the
+        request (affinity recorded at route time); unknown or aged-out
+        ids are logged only — their response may already be in
+        flight."""
+        target = self._routed.get(str(request_id))
+        if target is None:
+            self.logger.info("%s: infer_cancel for unrouted id %s",
+                             self.name, request_id)
+            return
+        self.process.message.publish(
+            f"{target}/in",
+            generate("infer_cancel", [str(request_id)]))
 
 
 def _coerce_request(inputs: Dict, config, default_new: int):

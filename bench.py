@@ -1148,7 +1148,10 @@ SECTIONS = [
      _llm_section("llama3_8b_int8_kv8", random_int8=True,
                   quantize_kv=True, batch=64, prompt_len=128,
                   new_tokens=128, config_name="llama3_8b")),
-    ("serving_continuous", 420,
+    # Two timed passes since the lookahead head-to-head (the
+    # lookahead=1 pass is the slow one over the relay) — budget sized
+    # for both plus compiles.
+    ("serving_continuous", 700,
      (lambda: bench_serving_continuous(
          slots=2, prompt_len=16, max_new=8, n_requests=4,
          config_name="tiny", chunk_steps=4))

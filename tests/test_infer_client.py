@@ -124,6 +124,42 @@ def test_serving_ops_demo_runs():
     assert any("telemetry" in line for line in lines)
 
 
+def test_cancel_through_router(engine):
+    """infer_cancel sent to a ReplicaRouter follows the request to the
+    replica that holds it (route-time affinity): the cancelled
+    response flows back to the client unchanged."""
+    from aiko_services_tpu.orchestration.serving import ReplicaRouter
+    from aiko_services_tpu.registry import Registrar
+
+    process = Process(namespace="test", hostname="h", pid="97",
+                      engine=engine, broker="rcancel")
+    Registrar(process=process)
+    engine.advance(4.0)
+    server = ContinuousBatchingServer(config_name="tiny", slots=1,
+                                      max_seq=64, chunk_steps=2,
+                                      seed=6)
+    compose_instance(ContinuousReplica, actor_args("rc0"),
+                     process=process, server=server)
+    router = compose_instance(ReplicaRouter, actor_args("rr0"),
+                              process=process)
+    engine.drain()
+    for _ in range(2000):
+        engine.advance(0.001)
+        if router.share["replicas"] == 1:
+            break
+    assert router.share["replicas"] == 1
+    client = InferClient(process, f"{router.topic_path}/in")
+    prompt = np.arange(1, 8, dtype=np.int32)
+    victim = client.submit(prompt, max_new_tokens=40, stream=True)
+    keeper = client.submit(prompt, max_new_tokens=4)
+    assert _pump(engine, lambda: victim.partial_tokens)
+    client.cancel(victim)
+    assert _pump(engine, lambda: victim.done and keeper.done)
+    assert victim.error == "cancelled"
+    assert 0 < len(victim.tokens) < 40
+    assert keeper.tokens == reference_greedy(server, prompt, 4)
+
+
 def test_client_adapter_requests(engine):
     import jax
 
