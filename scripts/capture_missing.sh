@@ -1,9 +1,19 @@
 #!/bin/bash
-# The sections the r04 full capture could not land, in wedge-risk
-# order (riskiest LAST) — a thin wrapper over the generalized hunter.
-# speech_chat_8b needs its full 960 s watchdog; the int4 pair decides
-# the int4-vs-int8 rule (ops/quant.py) head-to-head.
+# The bench sections with no committed hardware capture yet, in
+# wedge-risk order (riskiest LAST) — a thin wrapper over the
+# generalized hunter.  b128/b256 need the prefill-donation fix (in
+# tree) to fit HBM; serving sections re-capture the post-lookahead
+# stack (serving_continuous runs a lookahead=1-vs-4 head-to-head, so
+# its budget covers two timed passes); speech_chat_8b needs its full
+# watchdog; long_context is a first-time 16k flash compile; the int4
+# pair decides the int4-vs-int8 rule (ops/quant.py) and has wedged
+# the relay before.
 exec bash "$(dirname "$0")/capture_sections.sh" \
-    "speech_chat_8b 1000" \
+    "llama3_8b_int8_b128_kv8 700" \
+    "llama3_8b_int8_b256_kv8 700" \
+    "serving_continuous 800" \
+    "serving_paged 500" \
+    "speech_chat_8b 1100" \
+    "long_context 700" \
     "llama3_8b_int4_xla 700" \
     "llama3_8b_int4 700"
