@@ -34,10 +34,26 @@ Greedy decode through this path EXACTLY matches per-request
 ``generate_tokens`` output regardless of admission order (tested), so
 batching is a pure throughput optimization, never a quality trade.
 
+Layered on the same slot machinery (each independently tested, all
+composable — see docs/SERVING.md):
+
+* **lookahead** — multi-step scheduling: chunks chained device-side,
+  one host sync per run;
+* **chunk_prefill_tokens** — chunked-prefill admission: long prompts
+  prefill between decode runs instead of stalling them;
+* **adapters=** — multi-adapter LoRA serving (SLoRA-style stacked
+  factors, PEFT hot-deploy over the wire, id 0 = base);
+* **draft_config_name=** — per-slot SPECULATIVE decoding: one ragged
+  verify pass per round; greedy exact, sampled slots via the
+  device-side MRS kernel (distribution-preserving);
+* token streaming (``stream: 1``), ``(infer_cancel id)``, and
+  TTFT/total latency on every response.
+
 :class:`ContinuousReplica` speaks the same ``(infer …)`` wire protocol
 as :class:`~.serving.ModelReplica` (discovery, router and failover
-compose unchanged); a delayed self-post pump (the reference's own
-retry idiom, main/actor.py:229-253) runs chunks while slots are live —
+compose unchanged; :class:`~.client.InferClient` packages the client
+side); a delayed self-post pump (the reference's own retry idiom,
+main/actor.py:229-253) runs chunks while slots are live —
 deterministic under the VirtualClock test engine, where flatout
 handlers only run inside the blocking loop.
 """
