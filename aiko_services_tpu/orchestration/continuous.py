@@ -956,6 +956,11 @@ class ContinuousReplica(Actor):
         #: Keyed by object identity, not request_id: the client owns
         #: that string and may reuse it across concurrent requests.
         self._stream_sent: Dict[int, int] = {}
+        #: rolling window of completed-request latencies (seconds);
+        #: p50s surface in the EC share for the dashboard.
+        from collections import deque
+        self._ttft_window = deque(maxlen=64)
+        self._total_window = deque(maxlen=64)
 
     def _wire_infer(self, request_id, response_topic, payload=None):
         from ..pipeline.codec import decode_swag
@@ -1007,11 +1012,19 @@ class ContinuousReplica(Actor):
 
     def _share_telemetry(self):
         """Operator view (dashboard / any ECConsumer): live slot
-        occupancy and queue depth, refreshed every pump."""
+        occupancy, queue depth, and rolling p50 latencies, refreshed
+        every pump."""
+        import statistics
         updates = {
             "slots_active": int(self.server.slots_active),
             "queue_depth": int(self.server.queue_depth),
         }
+        if self._ttft_window:
+            updates["ttft_p50_ms"] = round(
+                statistics.median(self._ttft_window) * 1e3, 1)
+        if self._total_window:
+            updates["total_p50_ms"] = round(
+                statistics.median(self._total_window) * 1e3, 1)
         changed = {key: value for key, value in updates.items()
                    if self.share.get(key) != value}
         if not changed:
@@ -1128,15 +1141,21 @@ class ContinuousReplica(Actor):
         else:
             outputs = {"tokens_out": np.asarray(request.tokens,
                                                 np.int32)}
+        served = request.error is None
         if request.submitted_ts is not None:
             if request.first_token_ts is not None:
-                outputs["ttft_ms"] = round(
-                    (request.first_token_ts - request.submitted_ts)
-                    * 1e3, 2)
+                ttft = request.first_token_ts - request.submitted_ts
+                outputs["ttft_ms"] = round(ttft * 1e3, 2)
+                if served:
+                    # Aggregates track SERVED requests only: a burst
+                    # of queued-then-cancelled requests must not drag
+                    # the dashboard's p50 toward zero.
+                    self._ttft_window.append(ttft)
             if request.finished_ts is not None:
-                outputs["total_ms"] = round(
-                    (request.finished_ts - request.submitted_ts)
-                    * 1e3, 2)
+                total = request.finished_ts - request.submitted_ts
+                outputs["total_ms"] = round(total * 1e3, 2)
+                if served:
+                    self._total_window.append(total)
         if request.response_topic:
             self.process.message.publish(
                 request.response_topic,

@@ -151,6 +151,11 @@ def model_replica_plugin(fields, variables) -> List[str]:
     adapters = _get(variables, "adapters", default=None)
     if adapters not in (None, "-", ""):
         lines.append(f"  adapters:  {adapters}")
+    ttft = _get(variables, "ttft_p50_ms", default=None)
+    total = _get(variables, "total_p50_ms", default=None)
+    if any(value not in (None, "-", "") for value in (ttft, total)):
+        lines.append(f"  latency:   p50 ttft {ttft or '?'} ms, "
+                     f"total {total or '?'} ms")
     return lines
 
 

@@ -463,6 +463,12 @@ def test_cancel_and_latency_over_wire(engine):
     assert float(np.asarray(done["ttft_ms"])) >= 0
     assert float(np.asarray(done["total_ms"])) >= \
         float(np.asarray(done["ttft_ms"]))
+    # Rolling aggregates surface in the replica share for the
+    # dashboard — SERVED requests only, so the cancelled request's
+    # near-zero total does not drag the p50 toward zero.
+    assert float(replica.share["ttft_p50_ms"]) >= 0
+    assert float(replica.share["total_p50_ms"]) >= \
+        float(replica.share["ttft_p50_ms"])
 
 
 def test_continuous_replica_telemetry_in_share(engine):
