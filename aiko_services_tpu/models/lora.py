@@ -29,9 +29,9 @@ import jax.numpy as jnp
 
 from . import llama
 
-__all__ = ["LoRAConfig", "init_lora_params", "merge_lora",
-           "lora_forward", "make_lora_train_step", "lora_param_specs",
-           "stack_adapters", "SERVING_TARGETS"]
+__all__ = ["LoRAConfig", "factor_dims", "init_lora_params",
+           "merge_lora", "lora_forward", "make_lora_train_step",
+           "lora_param_specs", "stack_adapters", "SERVING_TARGETS"]
 
 #: Targets the batched multi-adapter SERVING path supports (the
 #: attention projections — llama._lora_matmul hooks).  MLP targets
@@ -54,11 +54,11 @@ class LoRAConfig:
         return self.alpha / self.rank
 
 
-def init_lora_params(config: llama.LlamaConfig, lora: LoRAConfig,
-                     key) -> Dict:
-    """A ~ N(0, 1/d) (gaussian), B = 0 — so a fresh adapter is an exact
-    no-op (tested)."""
-    layers = []
+def factor_dims(config: llama.LlamaConfig):
+    """``(in_dims, out_dims)`` per LoRA target: factor ``a`` is
+    ``(in_dims[t], rank)``, ``b`` is ``(rank, out_dims[t])`` — the
+    single source of truth for adapter factor shapes (init, stacking
+    validation, checkpoint import)."""
     d = config.d_model
     h, kv, hd = config.n_heads, config.n_kv_heads, config.head_dim
     out_dims = {"wq": h * hd, "wk": kv * hd, "wv": kv * hd,
@@ -66,6 +66,15 @@ def init_lora_params(config: llama.LlamaConfig, lora: LoRAConfig,
                 "w_down": d}
     in_dims = {"wq": d, "wk": d, "wv": d, "wo": h * hd,
                "w_gate": d, "w_up": d, "w_down": config.d_ff}
+    return in_dims, out_dims
+
+
+def init_lora_params(config: llama.LlamaConfig, lora: LoRAConfig,
+                     key) -> Dict:
+    """A ~ N(0, 1/d) (gaussian), B = 0 — so a fresh adapter is an exact
+    no-op (tested)."""
+    layers = []
+    in_dims, out_dims = factor_dims(config)
     if config.n_experts:
         # MoE layers replace the dense MLP with an expert subtree.
         for target in lora.targets:
@@ -145,12 +154,57 @@ def stack_adapters(config: llama.LlamaConfig, lora: LoRAConfig,
     minus the per-row ``ids`` — serving supplies those per batch.
 
     All adapters must share ``lora`` (rank/scale/targets), and targets
-    must be within :data:`SERVING_TARGETS`."""
+    must be within :data:`SERVING_TARGETS`.  Every adapter's factor
+    shapes are verified against ``config``/``lora`` BEFORE stacking —
+    a wrong-rank, wrong-base, or differently-targeted adapter fails
+    here by name, never as an opaque shape error inside the jitted
+    decode (alpha is not recoverable from weights: an adapter trained
+    at a different alpha but matching shapes is the caller's contract
+    to reject)."""
     unsupported = set(lora.targets) - SERVING_TARGETS
     if unsupported:
         raise ValueError(
             f"multi-adapter serving supports attention targets only; "
             f"got {sorted(unsupported)}")
+    in_dims, out_dims = factor_dims(config)
+    for index, adapter in enumerate(adapters):
+        try:
+            adapter_layers = list(adapter["layers"])
+        except (KeyError, TypeError):
+            raise ValueError(
+                f"adapter {index} params lack the per-layer target "
+                f"layout")
+        if len(adapter_layers) != config.n_layers:
+            # A wrong-depth adapter (different base variant) would
+            # otherwise truncate silently or die with a raw IndexError
+            # in the stacking loop.
+            raise ValueError(
+                f"adapter {index} has {len(adapter_layers)} layers != "
+                f"config.n_layers {config.n_layers}")
+        for i, layer in enumerate(adapter_layers):
+            if set(layer) != set(lora.targets):
+                # Extra trained targets would otherwise be SILENTLY
+                # DROPPED (the stack iterates lora.targets only) —
+                # checked per layer, not just layer 0.
+                raise ValueError(
+                    f"adapter {index} layer {i} targets "
+                    f"{sorted(layer)} != expected targets "
+                    f"{sorted(lora.targets)}")
+            for target in lora.targets:
+                want_a = (in_dims[target], lora.rank)
+                want_b = (lora.rank, out_dims[target])
+                try:
+                    got_a = tuple(layer[target]["a"].shape)
+                    got_b = tuple(layer[target]["b"].shape)
+                except (KeyError, TypeError, AttributeError):
+                    raise ValueError(
+                        f"adapter {index} layer {i} target {target!r} "
+                        f"lacks array 'a'/'b' factors")
+                if got_a != want_a or got_b != want_b:
+                    raise ValueError(
+                        f"adapter {index} layer {i} target {target!r} "
+                        f"factor shapes a{got_a}/b{got_b} != expected "
+                        f"a{want_a}/b{want_b} (rank {lora.rank})")
     layers = []
     for i in range(config.n_layers):
         layer = {}

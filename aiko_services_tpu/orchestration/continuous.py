@@ -553,6 +553,12 @@ class ContinuousBatchingServer:
                 f"(rank {self._lora_config.rank}, alpha "
                 f"{self._lora_config.alpha}, targets "
                 f"{self._lora_config.targets})")
+        # Direct-API callers may omit the config (the wire path always
+        # supplies one); stack_adapters below shape-verifies every
+        # factor against the server's config — but alpha is NOT
+        # recoverable from the weights, so an adapter trained at a
+        # different alpha with matching shapes MUST pass its config to
+        # be rejected; omitting it asserts the server's scale.
         candidate_config = self._lora_config or lora_config
         stacked_one = lora_mod.stack_adapters(
             self.config, candidate_config, [lora_params])

@@ -157,7 +157,13 @@ class ReplicaRouter(Actor):
         """Forward ``(infer_cancel id)`` to the replica that holds the
         request (affinity recorded at route time); unknown or aged-out
         ids are logged only — their response may already be in
-        flight."""
+        flight.  The entry is KEPT after forwarding so a cancel lost in
+        transit can be retried (the fire-and-forget idiom's recovery
+        path); the router cannot see completions, so request ids must
+        be unique per client (``InferClient`` guarantees this) — a
+        hand-rolled client reusing an id would route its cancel to
+        whatever replica last held that id until the affinity ring
+        evicts it."""
         target = self._routed.get(str(request_id))
         if target is None:
             self.logger.info("%s: infer_cancel for unrouted id %s",

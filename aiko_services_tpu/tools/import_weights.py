@@ -328,7 +328,7 @@ def import_lora(path: str, config, dtype=jnp.bfloat16):
     This is how an externally fine-tuned adapter (PEFT/`peft` trainer
     output) becomes servable through the multi-adapter batch
     (``ContinuousBatchingServer(adapters={name: lora_params})``)."""
-    from ..models.lora import LoRAConfig
+    from ..models.lora import LoRAConfig, factor_dims
 
     adapter_config = None
     if os.path.isdir(path):
@@ -339,6 +339,21 @@ def import_lora(path: str, config, dtype=jnp.bfloat16):
     if adapter_config is None:
         raise FileNotFoundError(
             f"no adapter_config.json under {path} (PEFT layout)")
+    # PEFT options that change the EFFECTIVE weights must fail loudly:
+    # ignoring them loads without error but serves at the wrong scale
+    # (use_rslora: alpha/sqrt(r) vs our alpha/r; use_dora: magnitude-
+    # vector recomposition; rank_pattern/alpha_pattern: per-module
+    # overrides) or drops weights entirely (modules_to_save:
+    # full-weight module copies).
+    unsupported = [
+        option for option in ("use_rslora", "use_dora", "rank_pattern",
+                              "alpha_pattern", "modules_to_save")
+        if adapter_config.get(option)]
+    if unsupported:
+        raise ValueError(
+            f"PEFT adapter options {unsupported} are not supported by "
+            f"import_lora; the adapter would serve at the wrong scale "
+            f"or with missing weights")
     modules = adapter_config.get("target_modules") or []
     try:
         targets = tuple(_PEFT_MODULES[m] for m in modules)
@@ -356,16 +371,7 @@ def import_lora(path: str, config, dtype=jnp.bfloat16):
         sample = next(name for name in tensors.names
                       if "model.layers." in name)
         prefix = sample.split("model.layers.")[0] + "model.layers."
-        in_dims = {"wq": config.d_model, "wk": config.d_model,
-                   "wv": config.d_model,
-                   "wo": config.n_heads * config.head_dim,
-                   "w_gate": config.d_model, "w_up": config.d_model,
-                   "w_down": config.d_ff}
-        out_dims = {"wq": config.n_heads * config.head_dim,
-                    "wk": config.n_kv_heads * config.head_dim,
-                    "wv": config.n_kv_heads * config.head_dim,
-                    "wo": config.d_model, "w_gate": config.d_ff,
-                    "w_up": config.d_ff, "w_down": config.d_model}
+        in_dims, out_dims = factor_dims(config)
         layers = []
         for i in range(config.n_layers):
             layer = {}

@@ -158,6 +158,12 @@ def test_cancel_through_router(engine):
     assert victim.error == "cancelled"
     assert 0 < len(victim.tokens) < 40
     assert keeper.tokens == reference_greedy(server, prompt, 4)
+    # The route-affinity entry survives the forward: a cancel lost in
+    # transit stays retryable (fire-and-forget recovery path) — ids
+    # are unique per client, so the kept entry cannot go stale.
+    assert victim.request_id in router._routed
+    client.cancel(victim)                    # retry is still routable
+    engine.drain()
 
 
 def test_client_adapter_requests(engine):

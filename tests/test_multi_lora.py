@@ -564,3 +564,95 @@ def test_adapter_over_wire_protocol(engine):
     want_base = reference_greedy(server, prompt, 6)
     assert list(responses["base"]["tokens_out"]) == want_base
     assert list(responses["ft"]["tokens_out"]) != want_base
+
+
+def test_import_lora_rejects_unsupported_peft_options(tmp_path):
+    """PEFT options that change the effective weights (use_rslora,
+    rank_pattern/alpha_pattern, modules_to_save) fail the import
+    loudly — silently ignoring them would serve at the wrong scale or
+    with missing weights (advisor r4)."""
+    import json
+    import os
+
+    from aiko_services_tpu.tools.import_weights import (
+        export_lora_checkpoint, import_lora,
+    )
+
+    config = llama.CONFIGS["tiny"]
+    adapter = _noisy_adapter(config, jax.random.PRNGKey(21))
+    out = str(tmp_path / "adapter")
+    export_lora_checkpoint(adapter, LORA, config, out)
+    cfg_path = os.path.join(out, "adapter_config.json")
+    for option, value in (("use_rslora", True),
+                          ("use_dora", True),
+                          ("rank_pattern", {"q_proj": 8}),
+                          ("alpha_pattern", {"q_proj": 16.0}),
+                          ("modules_to_save", ["lm_head"])):
+        with open(cfg_path, encoding="utf-8") as fh:
+            peft_config = json.load(fh)
+        peft_config[option] = value
+        with open(cfg_path, "w", encoding="utf-8") as fh:
+            json.dump(peft_config, fh)
+        with pytest.raises(ValueError, match=option):
+            import_lora(out, config)
+        del peft_config[option]
+        with open(cfg_path, "w", encoding="utf-8") as fh:
+            json.dump(peft_config, fh)
+    # Falsy values of the same options are fine (PEFT writes them):
+    # the guard is a truthiness check, not key membership.
+    peft_config.update({"use_rslora": False, "use_dora": False,
+                        "rank_pattern": {}, "alpha_pattern": {},
+                        "modules_to_save": None})
+    with open(cfg_path, "w", encoding="utf-8") as fh:
+        json.dump(peft_config, fh)
+    import_lora(out, config)
+
+
+def test_load_adapter_no_config_shape_verified():
+    """load_adapter WITHOUT lora_config on a configured server
+    shape-verifies the factors: a wrong-rank adapter and one missing a
+    server target are rejected by name instead of corrupting the
+    stacked layout (advisor r4).  A matching adapter still loads."""
+    config = llama.CONFIGS["tiny"]
+    server = ContinuousBatchingServer(
+        config_name="tiny", slots=2, max_seq=48, chunk_steps=2, seed=7,
+        adapters={"ok": _noisy_adapter(config, jax.random.PRNGKey(22))},
+        lora_config=LORA)
+    wrong_rank = init_lora_params(
+        config, dataclasses.replace(LORA, rank=LORA.rank * 2),
+        jax.random.PRNGKey(23))
+    with pytest.raises(ValueError, match="rank"):
+        server.load_adapter("bad_rank", wrong_rank)
+    missing_target = init_lora_params(
+        config, dataclasses.replace(LORA, targets=("wq",)),
+        jax.random.PRNGKey(24))
+    with pytest.raises(ValueError, match="targets"):
+        server.load_adapter("bad_targets", missing_target)
+    # Extra trained targets would be silently dropped by the stack —
+    # rejected too.
+    extra_target = init_lora_params(
+        config, dataclasses.replace(LORA,
+                                    targets=("wq", "wk", "wv", "wo")),
+        jax.random.PRNGKey(26))
+    with pytest.raises(ValueError, match="targets"):
+        server.load_adapter("bad_extra", extra_target)
+    # b-factor (output-dim) mismatch — an adapter for a GQA variant of
+    # the base: a shapes match (d_model, rank), b does not.
+    gqa_variant = dataclasses.replace(config, n_kv_heads=1)
+    wrong_b = init_lora_params(gqa_variant, LORA, jax.random.PRNGKey(27))
+    with pytest.raises(ValueError, match="factor shapes"):
+        server.load_adapter("bad_b", wrong_b)
+    # The same verification guards the config-SUPPLIED path too: a
+    # matching config with wrong-shaped params must not stack.
+    with pytest.raises(ValueError, match="factor shapes"):
+        server.load_adapter("bad_cfg", wrong_b, LORA)
+    # Wrong-depth adapter (same width, different base depth).
+    shallow = init_lora_params(
+        dataclasses.replace(config, n_layers=config.n_layers - 1),
+        LORA, jax.random.PRNGKey(28))
+    with pytest.raises(ValueError, match="layers"):
+        server.load_adapter("bad_depth", shallow)
+    assert server.adapters_loaded == ["ok"]
+    fine = _noisy_adapter(config, jax.random.PRNGKey(25))
+    server.load_adapter("fine", fine)
+    assert server.adapters_loaded == ["fine", "ok"]
