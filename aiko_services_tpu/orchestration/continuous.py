@@ -124,7 +124,7 @@ class ContinuousBatchingServer:
                  lora_config=None, chunk_prefill_tokens: int = 0,
                  draft_config_name: Optional[str] = None,
                  draft_params=None, spec_k: int = 4,
-                 draft_quantize: bool = False):
+                 draft_quantize: bool = False, params=None):
         import jax
         import jax.numpy as jnp
         from ..models import llama
@@ -133,10 +133,19 @@ class ContinuousBatchingServer:
         self._jnp = jnp
         self._llama = llama
         self.config = llama.CONFIGS[config_name]
-        self.params = llama.init_params(self.config,
-                                        jax.random.PRNGKey(seed))
-        if quantize:
-            self.params = llama.quantize_params(self.params)
+        if params is not None:
+            # Caller-built weights (trained, imported, or
+            # random_quantized_params) — an 8B-class server on a
+            # 16 GB chip cannot afford the bf16 init below just to
+            # requantize it.  ``quantize=`` then only DECLARES the
+            # tree's layout (for the TP spec choice); no
+            # re-quantization happens.
+            self.params = params
+        else:
+            self.params = llama.init_params(self.config,
+                                            jax.random.PRNGKey(seed))
+            if quantize:
+                self.params = llama.quantize_params(self.params)
         if mesh is not None:
             # Multi-chip serving: megatron-TP-shard the (possibly
             # quantized) params over the mesh's "tp" axis; the decode

@@ -60,7 +60,8 @@ class PagedContinuousServer(ContinuousBatchingServer):
                  block_size: int = 16,
                  total_blocks: Optional[int] = None,
                  enable_prefix_cache: bool = False,
-                 lookahead: int = 1, adapters=None, lora_config=None):
+                 lookahead: int = 1, adapters=None, lora_config=None,
+                 params=None):
         self.block_size = block_size
         self._requested_blocks = total_blocks
         self.enable_prefix_cache = enable_prefix_cache
@@ -68,7 +69,8 @@ class PagedContinuousServer(ContinuousBatchingServer):
                          max_seq=max_seq, chunk_steps=chunk_steps,
                          quantize=quantize, eos_id=eos_id, seed=seed,
                          quantize_kv=quantize_kv, lookahead=lookahead,
-                         adapters=adapters, lora_config=lora_config)
+                         adapters=adapters, lora_config=lora_config,
+                         params=params)
 
     # ------------------------------------------------------------- #
     # Layout hooks

@@ -1,0 +1,55 @@
+"""The driver bench artifact must never be evidence-free: when the
+relay is wedged, bench.py embeds the newest COMMITTED local capture as
+a clearly-labeled cache block next to the (honest) null live value
+(VERDICT r4 #2 — four consecutive null BENCH_r*.json while committed
+captures existed)."""
+
+import importlib.util
+import json
+import os
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def bench():
+    spec = importlib.util.spec_from_file_location(
+        "bench_under_test", os.path.join(REPO, "bench.py"))
+    module = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(module)
+    return module
+
+
+def test_cached_last_committed_structure(bench):
+    """The repo carries committed BENCH_LOCAL_*.json captures; the
+    cache block must surface the newest one with provenance."""
+    block = bench._cached_last_committed()
+    assert block is not None
+    assert "NOT a live measurement" in block["note"]
+    assert block["artifact"].startswith("BENCH_LOCAL_")
+    assert block["capture"]["value"] is not None
+    # Committed artifact → git provenance present.
+    assert len(block.get("git_commit", "")) == 40
+    assert block.get("committed_at")
+
+
+def test_wedged_backend_still_emits_cache(bench, monkeypatch, capsys,
+                                          tmp_path):
+    """parent_main with an unusable backend: value stays null (never
+    fake a live number) but cached_last_committed is embedded."""
+    monkeypatch.setattr(bench, "SMOKE", False)
+    monkeypatch.setattr(
+        bench, "_probe_backend",
+        lambda timeout_s: "probe hung >1s (wedged relay)")
+    monkeypatch.setattr(bench, "PARTIAL_PATH",
+                        str(tmp_path / "partial.jsonl"))
+    monkeypatch.setenv("BENCH_DEADLINE", "5")
+    bench.parent_main()
+    artifact = json.loads(capsys.readouterr().out.strip())
+    assert artifact["value"] is None
+    assert "backend" in artifact["errors"]
+    cached = artifact["cached_last_committed"]
+    assert cached["capture"]["value"] is not None
+    assert "NOT a live measurement" in cached["note"]
