@@ -1379,31 +1379,40 @@ def _cached_last_committed():
     here = os.path.dirname(os.path.abspath(__file__))
     candidates = sorted(glob.glob(os.path.join(here, "BENCH_LOCAL_*.json")))
     for path in reversed(candidates):
-        try:
-            with open(path) as fh:
-                capture = json.load(fh)
-        except (OSError, json.JSONDecodeError):
-            continue
-        if capture.get("value") is None:
-            continue
+        relname = os.path.relpath(path, here)
         try:
             show = subprocess.run(
                 ["git", "-C", here, "log", "-1",
-                 "--format=%H %cI", "--", path],
+                 "--format=%H %cI", "--", relname],
                 capture_output=True, text=True, timeout=15)
         except (OSError, subprocess.SubprocessError):
             continue
         if show.returncode != 0 or not show.stdout.strip():
-            # UNCOMMITTED capture (e.g. the daemon wrote it but its
-            # commit failed): skip — "committed" is the provenance
-            # claim this block exists to carry.
+            # NEVER-COMMITTED capture (e.g. the daemon wrote it but
+            # its commit failed): skip — "committed" is the
+            # provenance claim this block exists to carry.
             continue
         commit_hash, _, committed_at = \
             show.stdout.strip().partition(" ")
+        # Read the content FROM THE COMMIT, not the working tree: an
+        # uncommitted rewrite of a previously-committed capture must
+        # not be presented under the old commit's hash.
+        try:
+            blob = subprocess.run(
+                ["git", "-C", here, "show",
+                 f"{commit_hash}:{relname}"],
+                capture_output=True, text=True, timeout=15)
+            capture = json.loads(blob.stdout) \
+                if blob.returncode == 0 else None
+        except (OSError, subprocess.SubprocessError,
+                json.JSONDecodeError):
+            continue
+        if capture is None or capture.get("value") is None:
+            continue
         return {
             "note": ("CACHED capture from a previous healthy relay "
                      "window — NOT a live measurement from this run"),
-            "artifact": os.path.basename(path),
+            "artifact": relname,
             "capture": capture,
             "git_commit": commit_hash,
             "committed_at": committed_at,
