@@ -16,7 +16,7 @@ import jax
 import jax.numpy as jnp
 
 __all__ = ["DetectorConfig", "init_params", "forward", "decode_boxes",
-           "CONFIGS"]
+           "CONFIGS", "save_checkpoint", "load_checkpoint"]
 
 
 @dataclasses.dataclass(frozen=True)
@@ -105,3 +105,57 @@ def decode_boxes(raw, config: DetectorConfig,
     keep = scores >= score_threshold
     return (boxes.reshape(batch, -1, 4), scores.reshape(batch, -1),
             classes.reshape(batch, -1), keep.reshape(batch, -1))
+
+
+def save_checkpoint(params, config: DetectorConfig, path: str) -> None:
+    """Single-file ``.npz`` checkpoint: flattened param tree + the
+    config fields needed to rebuild it (a trained detector travels to
+    pipeline elements as one artifact — ``FaceDetector(checkpoint=)``,
+    matching the reference's file-path model deployment idiom,
+    reference examples/face/face.py / examples/yolo/yolo.py:46)."""
+    import json
+
+    import numpy as np
+
+    arrays = {"head.w": np.asarray(params["head"]["w"], np.float32),
+              "head.b": np.asarray(params["head"]["b"], np.float32)}
+    for i, layer in enumerate(params["layers"]):
+        arrays[f"layers.{i}.w"] = np.asarray(layer["w"], np.float32)
+        arrays[f"layers.{i}.b"] = np.asarray(layer["b"], np.float32)
+    arrays["config_json"] = np.frombuffer(json.dumps({
+        "image_size": config.image_size,
+        "n_classes": config.n_classes,
+        "widths": list(config.widths),
+        "dtype": jnp.dtype(config.dtype).name,
+    }).encode(), dtype=np.uint8)
+    if not str(path).endswith(".npz"):
+        path = f"{path}.npz"        # np.savez appends it silently;
+    np.savez(path, **arrays)        # keep save/load paths agreeing
+
+
+def load_checkpoint(path: str):
+    """→ ``(params, DetectorConfig)`` from :func:`save_checkpoint`
+    (weights cast back to the config dtype)."""
+    import json
+
+    import numpy as np
+
+    if not str(path).endswith(".npz"):
+        path = f"{path}.npz"
+    with np.load(path) as arrays:
+        meta = json.loads(arrays["config_json"].tobytes().decode())
+        config = DetectorConfig(
+            image_size=int(meta["image_size"]),
+            n_classes=int(meta["n_classes"]),
+            widths=tuple(int(w) for w in meta["widths"]),
+            dtype=jnp.dtype(meta["dtype"]))
+        dt = config.dtype
+        params = {
+            "layers": [
+                {"w": jnp.asarray(arrays[f"layers.{i}.w"], dt),
+                 "b": jnp.asarray(arrays[f"layers.{i}.b"], dt)}
+                for i in range(len(config.widths))],
+            "head": {"w": jnp.asarray(arrays["head.w"], dt),
+                     "b": jnp.asarray(arrays["head.b"], dt)},
+        }
+    return params, config

@@ -86,22 +86,37 @@ class ArucoMarkerOverlay(PipelineElement):
 
 class FaceDetector(PipelineElement):
     """``image`` (H, W, 3) → face boxes via the framework's native
-    single-class detector (the reference shells out to deepface;
-    here the model is the framework's own JAX detector)."""
+    single-class detector (the reference shells out to deepface; here
+    the model is the framework's own JAX detector).  Parameter
+    ``checkpoint`` boots TRAINED weights from
+    ``detector.save_checkpoint`` (``examples/training/
+    train_face_detector.py`` produces one whose held-out IoU is
+    asserted in ``tests/test_train_face_detector.py``); without it the
+    element runs seed-initialized weights — shape-correct but
+    semantically blank."""
 
     def __init__(self, context, process=None):
         super().__init__(context, process)
         import jax
         from aiko_services_tpu.models import detector as detector_model
         self._model = detector_model
-        name, _ = self.get_parameter("model_config", "tiny")
-        config = detector_model.CONFIGS[str(name)]
-        # single "face" class head
-        import dataclasses
-        self.config = dataclasses.replace(config, n_classes=1)
-        seed, _ = self.get_parameter("seed", 0)
-        self.params = detector_model.init_params(
-            self.config, jax.random.PRNGKey(int(seed)))
+        checkpoint, _ = self.get_parameter("checkpoint", None)
+        if checkpoint:
+            self.params, self.config = detector_model.load_checkpoint(
+                str(checkpoint))
+            if self.config.n_classes != 1:
+                raise ValueError(
+                    f"FaceDetector needs a single-class checkpoint, "
+                    f"got n_classes={self.config.n_classes}")
+        else:
+            name, _ = self.get_parameter("model_config", "tiny")
+            config = detector_model.CONFIGS[str(name)]
+            # single "face" class head
+            import dataclasses
+            self.config = dataclasses.replace(config, n_classes=1)
+            seed, _ = self.get_parameter("seed", 0)
+            self.params = detector_model.init_params(
+                self.config, jax.random.PRNGKey(int(seed)))
 
     def process_frame(self, stream, images):
         import jax.numpy as jnp

