@@ -51,9 +51,19 @@ class TextClassifierElement(TpuElement):
 
 
 class DetectorElement(TpuElement):
-    """``image`` (batch, H, W, 3) → raw grid + decoded boxes/scores."""
+    """``image`` (batch, H, W, 3) → raw grid + decoded boxes/scores.
+    Parameter ``checkpoint`` boots TRAINED weights from
+    ``detector.save_checkpoint`` (e.g. the shape-detector demo,
+    ``examples/training/train_shape_detector.py``) — the reference
+    deploys ultralytics weights the same by-file way (reference
+    examples/yolo/yolo.py:46)."""
 
     def init_params(self, key):
+        checkpoint, _ = self.get_parameter("checkpoint", None)
+        if checkpoint:
+            params, self.config = detector_model.load_checkpoint(
+                str(checkpoint))
+            return params
         name, _ = self.get_parameter("model_config", "tiny")
         self.config = detector_model.CONFIGS[str(name)]
         return detector_model.init_params(self.config, key)

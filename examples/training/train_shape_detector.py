@@ -145,12 +145,18 @@ def iou(a, b):
 
 
 def main():
+    from aiko_services_tpu.models import detector
+
     params, config = train()
+    out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "shape_detector.npz")
+    detector.save_checkpoint(params, config, out)
     rng = np.random.default_rng(321)
     image, box, cls = synth_scene(rng, config.image_size)
     size = config.image_size
     gt = tuple(v / size for v in box)
     pred_box, pred_cls = detect_top(params, config, image[None])
+    print(f"checkpoint -> {out}")
     print(f"gt {gt} cls {cls} -> pred {pred_box[0]} cls {pred_cls[0]} "
           f"IoU {iou(gt, pred_box[0]):.2f}")
 
