@@ -36,6 +36,7 @@ __all__ = ["LlamaConfig", "init_params", "forward",
            "pipeline_forward", "stack_pipeline_params",
            "decode_chunk_ragged", "prefill_chunk", "sample_logits",
            "init_paged_cache", "decode_chunk_paged",
+           "serve_chunk_ragged", "serve_chunk_paged",
            "paged_insert_prefix", "paged_scatter_blocks",
            "paged_gather_blocks", "complete", "CONFIGS"]
 
@@ -1017,20 +1018,28 @@ def paged_scatter_blocks(pool, block_ids, prefix_cache, start_block):
 
 
 @functools.partial(jax.jit, donate_argnames=("bucket",))
-def paged_gather_blocks(pool, block_ids, bucket):
-    """Read ``pool[block_ids]`` into the FIRST ``len(ids)*bs`` rows of
-    a contiguous bucket cache (prefix-cache admission: materialize the
-    shared prefix so the tail's chunked prefill can attend over it)."""
+def paged_gather_blocks(pool, block_ids, bucket, start_block=None):
+    """Read ``pool[block_ids]`` into ``len(ids)*bs`` contiguous rows of
+    a bucket cache starting at block ``start_block`` (prefix-cache
+    admission: materialize the shared prefix so the tail's chunked
+    prefill can attend over it).  ``start_block`` is TRACED (default 0)
+    so a long shared prefix can be gathered in a handful of
+    power-of-two sub-gathers without compiling one program per prefix
+    length."""
     block_size = pool[0]["k"].shape[1]
     rows = block_ids.shape[0] * block_size
+    start_row = (jnp.int32(0) if start_block is None
+                 else start_block.astype(jnp.int32) * block_size)
     new_bucket = []
     for pool_layer, bucket_layer in zip(pool, bucket):
         updated = {}
         for key, buf in bucket_layer.items():
             src = pool_layer[key][block_ids]
             flat = src.reshape((rows,) + src.shape[2:])
-            updated[key] = buf.at[:, :rows].set(
-                flat[None].astype(buf.dtype))
+            starts = (jnp.int32(0), start_row) + (jnp.int32(0),) * (
+                buf.ndim - 2)
+            updated[key] = jax.lax.dynamic_update_slice(
+                buf, flat[None].astype(buf.dtype), starts)
         new_bucket.append(updated)
     return new_bucket
 
@@ -1282,6 +1291,136 @@ def decode_chunk_ragged(params, tokens, cache, positions, active,
     return _chunk_scan(step_core, tokens, positions, cache, active,
                        num_steps, temperatures, top_ps, rng_key,
                        collect_logits=return_logits)
+
+
+def _serve_scan(step_core, state, cache_state, num_steps, eos_id,
+                sampled, rng_key):
+    """Device-resident serving scan: like :func:`_chunk_scan` but the
+    per-slot state (token/positions/active/remaining) lives in a device
+    ``state`` dict and EOS/budget retirement happens IN-JIT, so the
+    host never uploads decode state or downloads logits on the steady
+    path.  Emit-then-deactivate: the EOS token itself is emitted (the
+    host loop's semantics), then the lane goes inactive for the rest of
+    the chunk — inactive lanes write scratch and freeze.
+
+    ``step_core(token, cache_state, positions, active)`` supplies the
+    layout-specific read/write.  Returns ``(tokens_out (slots, steps),
+    counts (slots,), new_state, cache_state)`` where ``counts[s]`` is
+    the number of leading entries of ``tokens_out[s]`` actually emitted
+    (active only transitions True→False inside a chunk, so emissions
+    are a prefix)."""
+    if rng_key is None:
+        rng_key = jax.random.PRNGKey(0)
+    temps, tops = state["temps"], state["tops"]
+
+    def pick(logits, key):
+        greedy = logits.argmax(-1).astype(jnp.int32)
+        if not sampled:
+            return greedy
+        drawn = _sample_logits_per_row(logits, key, temps, tops)
+        return jnp.where(temps > 0, drawn, greedy)
+
+    def body(carry, _):
+        token, positions, active, remaining, cache_state, key = carry
+        key, step_key = jax.random.split(key)
+        logits, cache_state = step_core(token, cache_state, positions,
+                                        active)
+        next_token = pick(logits[:, -1], step_key)[:, None]
+        next_token = jnp.where(active[:, None], next_token, token)
+        emitted = active
+        positions = jnp.where(active, positions + 1, positions)
+        remaining = jnp.where(active, remaining - 1, remaining)
+        if eos_id >= 0:
+            hit_eos = next_token[:, 0] == eos_id
+        else:
+            hit_eos = jnp.zeros_like(active)
+        active = active & ~(hit_eos | (remaining <= 0))
+        return ((next_token, positions, active, remaining, cache_state,
+                 key), (next_token[:, 0], emitted))
+
+    carry = (state["token"], state["positions"], state["active"],
+             state["remaining"], cache_state, rng_key)
+    (token, positions, active, remaining, cache_state, _), \
+        (tokens_out, emits) = jax.lax.scan(body, carry, None,
+                                           length=num_steps)
+    counts = emits.astype(jnp.int32).sum(axis=0)
+    new_state = dict(state, token=token, positions=positions,
+                     active=active, remaining=remaining)
+    return tokens_out.T, counts, new_state, cache_state
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("config", "num_steps", "eos_id",
+                                    "sampled"),
+                   donate_argnames=("cache",))
+def serve_chunk_ragged(params, state, cache, num_steps,
+                       config: LlamaConfig, eos_id: int = -1,
+                       sampled: bool = False, rng_key=None,
+                       lora_shared=None):
+    """Device-resident twin of :func:`decode_chunk_ragged` for the
+    serving loop: all per-slot decode state (token tail, positions,
+    active mask, remaining budget, sampling controls, adapter ids)
+    arrives in the device ``state`` dict, EOS/budget retirement runs
+    in-jit, and only the tiny ``(tokens_out, counts, state)`` result
+    ever needs to cross back to the host.
+
+    ``eos_id`` is STATIC (-1 disables EOS detection); ``sampled``
+    statically selects the pure-greedy program when False so greedy
+    traffic never pays sampling math.  ``lora_shared`` is the stacked
+    adapter factors WITHOUT per-row ids — ids come from
+    ``state["adapter_ids"]``, so adapter routing rides the resident
+    state instead of a per-chunk upload.
+
+    Only ``cache`` is donated: the state dict stays a small immutable
+    chain the host may hold references into (the in-flight ring)."""
+    if "pos" in cache[0]:
+        raise ValueError(
+            "serve_chunk_ragged does not support rolling caches: the "
+            "inactive-slot scratch row would land on a live ring row")
+    max_seq = cache[0]["k"].shape[1]
+    lora = (dict(lora_shared, ids=state["adapter_ids"])
+            if lora_shared is not None else None)
+
+    def step_core(token, cache, positions, active):
+        write_pos = jnp.where(active, positions, max_seq - 1)
+        return _decode_core_ragged(params, token, cache, write_pos,
+                                   config, lora=lora)
+
+    return _serve_scan(step_core, state, cache, num_steps, eos_id,
+                       sampled, rng_key)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("config", "num_steps", "eos_id",
+                                    "sampled"),
+                   donate_argnames=("pool",))
+def serve_chunk_paged(params, state, pool, num_steps,
+                      config: LlamaConfig, eos_id: int = -1,
+                      sampled: bool = False, rng_key=None,
+                      lora_shared=None):
+    """Paged twin of :func:`serve_chunk_ragged`: block tables are part
+    of the resident ``state`` (``state["tables"]``), so table updates
+    on admission merge in with the rest of the dirty rows instead of a
+    per-run upload.  Inactive lanes write scratch block 0 at their slot
+    offset, exactly like :func:`decode_chunk_paged`."""
+    block_size = pool[0]["k"].shape[1]
+    tables = state["tables"]
+    slots = tables.shape[0]
+    scratch_tables = jnp.zeros_like(tables)
+    scratch_positions = (jnp.arange(slots, dtype=jnp.int32)
+                         % block_size)
+    lora = (dict(lora_shared, ids=state["adapter_ids"])
+            if lora_shared is not None else None)
+
+    def step_core(token, pool, positions, active):
+        write_tables = jnp.where(active[:, None], tables,
+                                 scratch_tables)
+        write_pos = jnp.where(active, positions, scratch_positions)
+        return _decode_core_paged(params, token, pool, write_tables,
+                                  write_pos, config, lora=lora)
+
+    return _serve_scan(step_core, state, pool, num_steps, eos_id,
+                       sampled, rng_key)
 
 
 def _sample_logits_per_row(logits, key, temperatures, top_ps):

@@ -23,6 +23,7 @@ unreachable — the same invariant continuous batching relies on.
 
 from __future__ import annotations
 
+import functools
 from typing import Optional, Tuple
 
 import jax
@@ -32,7 +33,8 @@ import numpy as np
 from . import llama
 
 __all__ = ["speculative_generate", "speculative_generate_sampled",
-           "SpecStats", "mrs_accept_batch"]
+           "SpecStats", "mrs_accept_batch", "greedy_accept_batch",
+           "spec_commit"]
 
 
 class SpecStats:
@@ -143,6 +145,90 @@ def mrs_accept_batch(target_logits, draft_logits, proposals,
     tokens = jnp.where(window == counts[:, None],
                        final_token[:, None], tokens)
     return tokens, counts + 1
+
+
+@jax.jit
+def greedy_accept_batch(target_logits, proposals):
+    """Greedy twin of :func:`mrs_accept_batch`, entirely on device: the
+    accepted prefix is the longest argmax-match between proposals and
+    the verify pass, the final token is the target's own argmax at the
+    first divergence (or the bonus token on full accept).  This is
+    exactly the host-side prefix-match loop the continuous server used
+    to run on fetched logits — moved in-jit so speculative serving
+    never downloads a logit.
+
+    Returns ``(tokens (slots, k+1), counts (slots,))`` with the same
+    read-``counts``-entries contract as :func:`mrs_accept_batch`."""
+    slots, k = proposals.shape
+    target_greedy = target_logits.argmax(-1).astype(jnp.int32)
+    accept = proposals == target_greedy[:, :k]
+    prefix = jnp.cumprod(accept.astype(jnp.int32), axis=-1)
+    counts = prefix.sum(-1)
+    final_token = jnp.take_along_axis(
+        target_greedy, counts[:, None], axis=1)[:, 0]
+    window = jnp.arange(k + 1)[None, :]
+    tokens = jnp.where(jnp.arange(k)[None, :] < counts[:, None],
+                       proposals, 0)
+    tokens = jnp.concatenate(
+        [tokens, jnp.zeros((slots, 1), jnp.int32)], axis=1)
+    tokens = jnp.where(window == counts[:, None],
+                       final_token[:, None], tokens)
+    return tokens, counts + 1
+
+
+@functools.partial(jax.jit, static_argnames=("eos_id",))
+def spec_commit(state, window, counts_raw, eos_id: int = -1):
+    """In-jit commit for one speculative round against the resident
+    serving ``state`` (see ``llama.serve_chunk_ragged``): apply the
+    accepted window per slot with EOS/budget caps, advance the resident
+    token/positions, deactivate finished lanes, and emit everything the
+    host needs — all without a logits download.
+
+    Host-loop semantics preserved exactly: emission stops at the budget
+    (``remaining``), an EOS inside the emitted range is itself emitted
+    and retires the lane, and positions advance by the FULL committed
+    window (the verify pass wrote those cache rows regardless of caps).
+
+    Returns ``(emit_tokens (slots, k+1), emit_counts, drafted,
+    accepted, resync, new_state)``: ``emit_tokens[s, :emit_counts[s]]``
+    are the tokens to deliver; ``drafted``/``accepted`` are this
+    round's SpecStats increments (scalars, live lanes only); ``resync``
+    (slots, k) is the zero-padded committed-window-minus-last matrix
+    the draft replays to re-sync its cache."""
+    k1 = window.shape[1]
+    active = state["active"]
+    remaining = state["remaining"]
+    counts_raw = jnp.where(active, counts_raw, 0)
+    idx = jnp.arange(k1)[None, :]
+    valid = idx < counts_raw[:, None]
+    if eos_id >= 0:
+        is_eos = valid & (window == eos_id)
+        eos_cap = jnp.where(is_eos.any(-1),
+                            jnp.argmax(is_eos, axis=-1) + 1, k1 + 1)
+    else:
+        eos_cap = jnp.full(counts_raw.shape, k1 + 1, jnp.int32)
+    emit_counts = jnp.minimum(jnp.minimum(counts_raw, remaining),
+                              eos_cap)
+    emit_counts = jnp.where(active, emit_counts, 0)
+    new_remaining = remaining - emit_counts
+    ended = active & ((new_remaining <= 0) | (eos_cap <= emit_counts))
+    last = jnp.take_along_axis(
+        window, jnp.maximum(counts_raw - 1, 0)[:, None], axis=1)
+    new_state = dict(
+        state,
+        token=jnp.where(active[:, None], last, state["token"]),
+        positions=jnp.where(active, state["positions"] + counts_raw,
+                            state["positions"]),
+        active=active & ~ended,
+        remaining=new_remaining)
+    resync = jnp.where(
+        (jnp.arange(k1 - 1)[None, :] < (counts_raw - 1)[:, None])
+        & active[:, None], window[:, :k1 - 1], 0)
+    drafted = (active.sum() * (k1 - 1)).astype(jnp.int32)
+    accepted = jnp.where(active, counts_raw - 1, 0).sum().astype(
+        jnp.int32)
+    return (jnp.where(valid, window, 0), emit_counts, drafted,
+            accepted, resync, new_state)
 
 
 def _setup(target_params, draft_params, prompt, num_new, target_config,

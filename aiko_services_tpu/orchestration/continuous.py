@@ -276,6 +276,96 @@ class ContinuousBatchingServer:
         self._emitted = np.zeros(slots, np.int64)  # tokens emitted so far
         self._queue: List[DecodeRequest] = []
         self.completed: List[DecodeRequest] = []
+        # ---- device-resident serving state + async dispatch ring ---- #
+        # The decode state (token tail, positions, active, remaining
+        # budget, sampling controls, adapter ids — plus block tables in
+        # the paged layout) lives in ``self._state``, a chain of small
+        # immutable device dicts: each dispatched chunk consumes the
+        # head and returns the next.  The host keeps numpy mirrors for
+        # bookkeeping, but they ride to the device ONLY through
+        # ``_sync_dirty`` — a single masked merge covering the slots an
+        # admission/retirement actually touched — so the steady-state
+        # decode loop performs ZERO host→device uploads.
+        self._remaining = np.zeros(slots, np.int32)
+        self._state = self._init_device_state()
+        # In-flight ring: results of dispatched-but-unconsumed chunks.
+        # Depth max(2, lookahead) double-buffers by default: step t+1
+        # launches while step t's tiny (tokens, counts, active) result
+        # is still in flight, and np.asarray happens only at consume.
+        from collections import deque
+        self._ring = deque()
+        #: per-slot admission generation: an in-flight entry only
+        #: applies to a slot whose serial still matches the entry's
+        #: snapshot, so a retire-then-readmit can never credit a stale
+        #: chunk's tokens to the new occupant.
+        self._slot_serial = np.zeros(slots, np.int64)
+        #: decode steps dispatched but not yet consumed, per slot —
+        #: dispatch sizing subtracts this so a slot is never scheduled
+        #: past its budget while results are in flight.
+        self._inflight_sched = np.zeros(slots, np.int64)
+        #: slots whose host mirror changed since the last dispatch.
+        self._dirty = np.zeros(slots, bool)
+        self.counters: Dict = dict(
+            dispatches=0, decode_steps=0, tokens_committed=0,
+            host_syncs=0, sync_wait_ms=0.0, sync_elements=0,
+            state_uploads=0, max_in_flight=0, admission_deferred=0)
+        self._serve_started: Optional[float] = None
+
+        @jax.jit
+        def merge_state(state, host_state, mask):
+            def merge(dev, host):
+                m = mask.reshape((-1,) + (1,) * (dev.ndim - 1))
+                return jnp.where(m, host.astype(dev.dtype), dev)
+            return jax.tree.map(merge, state, host_state)
+
+        self._merge_state = merge_state
+
+    def _init_device_state(self) -> Dict:
+        """Device-resident per-slot serving state (layout hook: the
+        paged server adds its block tables)."""
+        jnp = self._jnp
+        slots = self.slots
+        return {
+            "token": jnp.zeros((slots, 1), jnp.int32),
+            "positions": jnp.zeros((slots,), jnp.int32),
+            "active": jnp.zeros((slots,), bool),
+            "remaining": jnp.zeros((slots,), jnp.int32),
+            "temps": jnp.zeros((slots,), jnp.float32),
+            "tops": jnp.ones((slots,), jnp.float32),
+            "adapter_ids": jnp.zeros((slots,), jnp.int32),
+        }
+
+    def _host_state(self) -> Dict:
+        """Host mirror of :meth:`_init_device_state` (same keys; numpy
+        views, uploaded only for dirty slots by ``_sync_dirty``)."""
+        return {
+            "token": self.tokens,
+            "positions": self.positions,
+            "active": self.active,
+            "remaining": self._remaining,
+            "temps": self._temperatures,
+            "tops": self._top_ps,
+            "adapter_ids": self._adapter_ids,
+        }
+
+    def _sync_dirty(self) -> None:
+        """Merge dirty host-mirror rows into the resident device state
+        — the ONLY host→device path for decode state.  No admissions or
+        retirements since the last dispatch ⇒ no upload at all.
+
+        The mirrors are SNAPSHOTTED (copied) here: the CPU backend may
+        alias a numpy argument zero-copy into the async computation,
+        and the host keeps mutating the mirrors (consume, retire)
+        before the merge actually reads them — without the copy the
+        merge races its own inputs."""
+        if not self._dirty.any():
+            return
+        snapshot = {key: np.array(value)
+                    for key, value in self._host_state().items()}
+        self._state = self._merge_state(self._state, snapshot,
+                                        self._dirty.copy())
+        self._dirty[:] = False
+        self.counters["state_uploads"] += 1
 
     def _init_layout(self):
         """Cache-layout hook (overridden by the paged server): the
@@ -361,8 +451,11 @@ class ContinuousBatchingServer:
     @property
     def busy(self) -> bool:
         # Prefilling slots hold their request in _requests, so
-        # slots_active covers chunked admissions too.
-        return bool(self._queue) or self.slots_active > 0
+        # slots_active covers chunked admissions too; in-flight ring
+        # entries carry undelivered tokens even after every slot's
+        # final chunk has been dispatched.
+        return bool(self._queue) or self.slots_active > 0 \
+            or bool(self._ring)
 
     def _admit(self) -> None:
         admissions = []
@@ -377,6 +470,7 @@ class ContinuousBatchingServer:
             padded = min(_bucket(prompt_len, self._bucket_minimum),
                          self.max_seq)
             if not self._reserve_slot(slot, padded, request):
+                self.counters["admission_deferred"] += 1
                 break      # capacity (paged pool) exhausted; next chunk
             self._queue.pop(0)
             prompt_padded = np.zeros((1, padded), np.int32)
@@ -419,6 +513,10 @@ class ContinuousBatchingServer:
         self._top_ps[slot] = float(request.top_p)
         self._requests[slot] = request
         self._emitted[slot] = 0
+        self._remaining[slot] = request.max_new_tokens
+        self._inflight_sched[slot] = 0
+        self._slot_serial[slot] += 1
+        self._dirty[slot] = True
         self._any_sampled = bool((self._temperatures > 0).any())
 
     def _advance_prefills(self) -> None:
@@ -693,6 +791,12 @@ class ContinuousBatchingServer:
         self._requests[slot] = None
         self.active[slot] = False
         self._adapter_ids[slot] = 0
+        self._remaining[slot] = 0
+        self._inflight_sched[slot] = 0
+        # Bump the admission generation: any still-in-flight entry's
+        # data for this slot is now stale and will be skipped.
+        self._slot_serial[slot] += 1
+        self._dirty[slot] = True
         # Reset sampling state so an all-greedy batch returns to the
         # pure-greedy compiled program (no sort/softmax per step).
         self._temperatures[slot] = 0.0
@@ -716,6 +820,14 @@ class ContinuousBatchingServer:
             request = self._requests[slot]
             if request is None or request.request_id != request_id:
                 continue
+            if slot not in self._prefilling:
+                # Decoding: drain the in-flight ring FIRST so chunks
+                # already dispatched deliver their partial tokens and
+                # the device provably stops touching this lane before
+                # its resources (paged blocks) are freed for reuse.
+                self._drain_ring()
+                if self._requests[slot] is not request:
+                    return True      # finished naturally while draining
             request.error = "cancelled"
             self._prefilling.pop(slot, None)
             self._retire(slot)
@@ -723,216 +835,253 @@ class ContinuousBatchingServer:
         return False
 
     def step(self) -> List[DecodeRequest]:
-        """Admit pending requests, decode one chunk run, retire
-        finished slots.  Returns (and clears) the completed list."""
+        """Admit pending requests, keep the in-flight ring full, apply
+        one (or, at the drain tail, every) completed chunk's results,
+        retire finished slots.  Returns (and clears) the completed
+        list.
+
+        Async double-buffering: dispatch fills the ring to ``max(2,
+        lookahead)`` entries, then consume drains it to depth-1 — so in
+        steady state every ``step()`` launches the next chunk BEFORE
+        blocking on the previous one's (tiny) result, and the device
+        never idles on host bookkeeping.  When nothing can be
+        dispatched (all budgets scheduled, or no live slot) the ring is
+        drained completely so results are never stranded."""
         self._admit()
         self._advance_prefills()
-        if self.active.any() and self._draft is not None:
-            self._spec_round()
-        elif self.active.any():
-            # Prefilling slots are occupied but not decode-active:
-            # they are excluded from run sizing and from bookkeeping.
-            remaining = [self._requests[s].max_new_tokens
-                         - int(self._emitted[s])
-                         for s in range(self.slots)
-                         if self._requests[s] is not None
-                         and self.active[s]]
-            steps = int(max(1, min(self.chunk_steps, max(remaining))))
-            # How many chunks may run before bookkeeping MUST happen:
-            # the earliest budget retirement (so a freed slot is not
-            # held past its readmission point).  An EOS retirement
-            # inside the run costs that slot at most lookahead-1
-            # chunks of FULL decode (active_d is frozen for the run,
-            # so the slot keeps computing and writing KV rows at
-            # advancing positions) — its post-EOS tokens are dropped
-            # on the host, never delivered, and the stale rows are
-            # rewritten at the slot's next admission.
-            budget_chunks = max(1, -(-min(remaining) // steps))
-            n_chunks = min(self.lookahead, budget_chunks)
-            chunk_active = self.active.copy()
-            jnp = self._jnp
-            tokens_d = jnp.asarray(self.tokens)
-            positions_d = jnp.asarray(self.positions)
-            active_d = jnp.asarray(self.active)
-            # Per-run-constant uploads stay OUT of the chunk loop
-            # (only the RNG key varies chunk-to-chunk).
-            if self._any_sampled:
-                temperatures_d = jnp.asarray(self._temperatures)
-                top_ps_d = jnp.asarray(self._top_ps)
-            lora = self._make_lora(self._adapter_ids)
-            self._begin_run()
-            outs = []
-            for _ in range(n_chunks):
-                if self._any_sampled:
-                    self._rng, chunk_key = \
-                        self._jax.random.split(self._rng)
-                    sampling = dict(temperatures=temperatures_d,
-                                    top_ps=top_ps_d,
-                                    rng_key=chunk_key)
-                else:
-                    sampling = {}      # pure-greedy compiled program
-                out, tokens_d, positions_d = self._run_chunk(
-                    tokens_d, positions_d, active_d, steps, sampling,
-                    lora)
-                outs.append(out)
-            # ONE host sync for the whole run (each fetch is ~KB; all
-            # chunks are already enqueued, so later ones compute while
-            # earlier ones transfer).
-            out_host = np.concatenate(
-                [np.asarray(out) for out in outs], axis=1)
-            total = steps * n_chunks
-            # Advance the host bookkeeping mirror by the same rule the
-            # compiled chunks applied on device: active rows moved
-            # ``total`` positions and their next seed token is the
-            # last one emitted.  (Slots that retire below are simply
-            # overwritten at their next admission.)
-            self.positions[chunk_active] += total
-            self.tokens[chunk_active, 0] = out_host[chunk_active,
-                                                    total - 1]
-            now = time.monotonic()
-            for slot in range(self.slots):
-                request = self._requests[slot]
-                if request is None or not chunk_active[slot]:
-                    continue
-                if request.first_token_ts is None:
-                    request.first_token_ts = now
-                for step_index in range(total):
-                    if self._emitted[slot] >= request.max_new_tokens:
-                        break
-                    token = int(out_host[slot, step_index])
-                    request.tokens.append(token)
-                    self._emitted[slot] += 1
-                    if (self.eos_id is not None
-                            and token == self.eos_id):
-                        self._emitted[slot] = request.max_new_tokens
-                if self._emitted[slot] >= request.max_new_tokens:
-                    self._retire(slot)
+        depth = max(2, self.lookahead)
+        dispatched = False
+        while len(self._ring) < depth and self._dispatch_round():
+            dispatched = True
+        target = depth - 1 if dispatched else 0
+        while len(self._ring) > target:
+            self._consume_one()
         done, self.completed = self.completed, []
         return done
 
-    def _spec_round(self) -> None:
-        """ONE per-slot speculative round: draft proposes ``k`` tokens
-        for every live slot (ragged chunk over its own cache), the
-        target scores ``[seed, d_1..d_k]`` in ONE
-        :func:`~..models.llama.verify_chunk_ragged` pass, and each
-        slot commits its accepted prefix plus the target's
-        correction/bonus token — so a round advances a slot by 1 to
-        k+1 tokens at ONE target weight-stream.  Greedy outputs are
-        exactly the plain server's (acceptance is argmax equality);
-        sampled slots run device-side modified rejection sampling
-        (``mrs_accept_batch``) — every committed token distributed
-        exactly as target-only sampling at the slot's controls."""
+    def _plan_remaining(self) -> "np.ndarray":
+        """Per-slot decode budget still UNSCHEDULED: max_new − emitted
+        − in-flight.  A slot at zero needs no further dispatch — the
+        chunks already in flight are guaranteed to finish it (the in-jit
+        budget cap retires the lane the moment ``remaining`` hits 0)."""
+        plan = np.zeros(self.slots, np.int64)
+        for slot in range(self.slots):
+            request = self._requests[slot]
+            if request is None or not self.active[slot]:
+                continue
+            plan[slot] = (request.max_new_tokens - self._emitted[slot]
+                          - self._inflight_sched[slot])
+        return plan
+
+    def _dispatch_round(self) -> bool:
+        """Launch one decode chunk (or speculative round) against the
+        resident device state WITHOUT waiting for its result.  Returns
+        False when no slot needs scheduling."""
+        if self._draft is not None:
+            return self._dispatch_spec_round()
+        return self._dispatch_chunk()
+
+    def _dispatch_chunk(self) -> bool:
+        plan = self._plan_remaining()
+        live = plan > 0
+        if not live.any():
+            return False
+        steps = int(min(self.chunk_steps, int(plan[live].max())))
+        self._sync_dirty()
+        rng_key = None
+        if self._any_sampled:
+            # One split per dispatched chunk — the RNG schedule the
+            # sampled-determinism tests pin down.
+            self._rng, rng_key = self._jax.random.split(self._rng)
+        tokens_d, counts_d, self._state = self._serve_chunk(
+            self._state, steps,
+            -1 if self.eos_id is None else int(self.eos_id),
+            self._any_sampled, rng_key, self._serve_lora())
+        sched = np.where(live, np.minimum(steps, plan), 0)
+        self._inflight_sched += sched
+        self._ring.append(dict(
+            kind="chunk", tokens=tokens_d, counts=counts_d,
+            active_after=self._state["active"], steps=steps,
+            sched=sched, serial=self._slot_serial.copy()))
+        self._note_dispatch()
+        return True
+
+    def _serve_lora(self):
+        """Stacked adapter factors for a serve dispatch — WITHOUT ids:
+        per-row routing comes from the resident ``adapter_ids`` state.
+        None while no live slot runs an adapter, so all-base traffic
+        keeps the adapter-free compiled program."""
+        if self._lora_shared is None or not self._adapter_ids.any():
+            return None
+        return self._lora_shared
+
+    def _serve_chunk(self, state, steps: int, eos_id: int,
+                     sampled: bool, rng_key, lora_shared):
+        """Cache-layout strategy hook: dispatch ``steps`` device-
+        resident decode steps.  The paged server overrides this with
+        :func:`~..models.llama.serve_chunk_paged`; ALL bookkeeping —
+        admission order, budgets, EOS, retirement — stays in this
+        class (and most of THAT now runs in-jit)."""
+        tokens_d, counts_d, new_state, self.cache = \
+            self._llama.serve_chunk_ragged(
+                self.params, state, self.cache, steps, self.config,
+                eos_id=eos_id, sampled=sampled, rng_key=rng_key,
+                lora_shared=lora_shared)
+        return tokens_d, counts_d, new_state
+
+    def _dispatch_spec_round(self) -> bool:
+        """ONE per-slot speculative round, dispatched entirely on
+        device: draft proposes ``k`` tokens from the resident state,
+        ONE target :func:`~..models.llama.verify_chunk_ragged` pass
+        scores them, the acceptance kernel (greedy argmax-prefix or
+        MRS) picks each slot's committed window, and
+        :func:`~..models.speculative.spec_commit` applies EOS/budget
+        caps and advances the resident state in-jit.  The draft then
+        replays committed[:-1] to re-sync its cache — still zero host
+        syncs; results flow through the same in-flight ring as plain
+        chunks.  Greedy outputs are exactly the plain server's;
+        sampled slots commit tokens distributed exactly as target-only
+        sampling (the MRS kernel, tested)."""
+        plan = self._plan_remaining()
+        live = plan > 0
+        if not live.any():
+            return False
         jnp, llama, draft = self._jnp, self._llama, self._draft
         k = draft["k"]
-        chunk_active = self.active.copy()
-        tokens_d = jnp.asarray(self.tokens)
-        positions_d = jnp.asarray(self.positions)
-        active_d = jnp.asarray(self.active)
-        lora = self._make_lora(self._adapter_ids)
+        self._sync_dirty()
+        st = self._state
+        lora_shared = self._serve_lora()
+        lora = (dict(lora_shared, ids=st["adapter_ids"])
+                if lora_shared is not None else None)
         if self._any_sampled:
-            # Sampled round: the draft SAMPLES proposals at each
-            # slot's controls (returning its per-step logits), and the
-            # on-device MRS kernel decides acceptance — every
-            # committed token distributed exactly as target-only
-            # sampling; greedy rows use exact argmax acceptance
-            # inside the same kernel (tested).
             self._rng, draft_key, accept_key = \
                 self._jax.random.split(self._rng, 3)
-            temps_d = jnp.asarray(self._temperatures)
-            tops_d = jnp.asarray(self._top_ps)
             proposals, draft_logits, _, _, draft["cache"] = \
                 llama.decode_chunk_ragged(
-                    draft["params"], tokens_d, draft["cache"],
-                    positions_d, active_d, k, draft["config"],
-                    temperatures=temps_d, top_ps=tops_d,
+                    draft["params"], st["token"], draft["cache"],
+                    st["positions"], st["active"], k, draft["config"],
+                    temperatures=st["temps"], top_ps=st["tops"],
                     rng_key=draft_key, return_logits=True)
         else:
             proposals, _, _, draft["cache"] = llama.decode_chunk_ragged(
-                draft["params"], tokens_d, draft["cache"], positions_d,
-                active_d, k, draft["config"])
-        chunk = jnp.concatenate([tokens_d, proposals], axis=1)
+                draft["params"], st["token"], draft["cache"],
+                st["positions"], st["active"], k, draft["config"])
+        chunk = jnp.concatenate([st["token"], proposals], axis=1)
         logits, self.cache = llama.verify_chunk_ragged(
-            self.params, chunk, self.cache, positions_d, active_d,
-            self.config, lora=lora)
+            self.params, chunk, self.cache, st["positions"],
+            st["active"], self.config, lora=lora)
+        from ..models.speculative import (greedy_accept_batch,
+                                          mrs_accept_batch, spec_commit)
         if self._any_sampled:
-            from ..models.speculative import mrs_accept_batch
-            tokens_dev, counts_dev = mrs_accept_batch(
-                logits, draft_logits, proposals, temps_d, tops_d,
-                accept_key)
-            committed_host = np.asarray(tokens_dev)
-            counts_host = np.asarray(counts_dev)
+            window, counts_raw = mrs_accept_batch(
+                logits, draft_logits, proposals, st["temps"],
+                st["tops"], accept_key)
         else:
-            greedy = np.asarray(jnp.argmax(logits, axis=-1))
-            committed_host = counts_host = None
-            # Only the greedy acceptance loop reads the proposals on
-            # host; sampled rounds commit from the kernel's output.
-            proposals_host = np.asarray(proposals)
-        self.spec_stats.target_passes += 1
-        now = time.monotonic()
-        resync = np.zeros((self.slots, k), np.int32)
-        for slot in range(self.slots):
-            request = self._requests[slot]
-            if request is None or not chunk_active[slot]:
-                continue
-            if request.first_token_ts is None:
-                request.first_token_ts = now
-            if committed_host is not None:
-                count = int(counts_host[slot])
-                new_tokens = [int(t) for t in
-                              committed_host[slot, :count]]
-                accepted = count - 1
-            else:
-                accepted = 0
-                while accepted < k and proposals_host[slot, accepted] \
-                        == greedy[slot, accepted]:
-                    accepted += 1
-                new_tokens = [int(t) for t in
-                              proposals_host[slot, :accepted]]
-                new_tokens.append(int(greedy[slot, accepted]))
-            self.spec_stats.drafted += k
-            self.spec_stats.accepted += accepted
-            for token in new_tokens:
-                if self._emitted[slot] >= request.max_new_tokens:
-                    break
-                request.tokens.append(token)
-                self._emitted[slot] += 1
-                if self.eos_id is not None and token == self.eos_id:
-                    self._emitted[slot] = request.max_new_tokens
-            # Host mirrors advance by the FULL committed list — the
-            # device wrote those rows regardless of budget/EOS caps.
-            resync[slot, :len(new_tokens) - 1] = new_tokens[:-1]
-            self.tokens[slot, 0] = new_tokens[-1]
-            self.positions[slot] += len(new_tokens)
-            if self._emitted[slot] >= request.max_new_tokens:
-                self._retire(slot)
+            window, counts_raw = greedy_accept_batch(logits, proposals)
+        prev_positions, prev_active = st["positions"], st["active"]
+        (emit_tokens, emit_counts, drafted, accepted, resync,
+         self._state) = spec_commit(
+            st, window, counts_raw,
+            eos_id=-1 if self.eos_id is None else int(self.eos_id))
         # Draft-cache resync: committed[:-1] spans positions+1 onward
         # (fixed k width, zero-padded; idempotent rewrites, stale pad
         # rows rewritten before they become attendable — the same
         # policy as models.speculative._resync_draft).
         _, draft["cache"] = llama.verify_chunk_ragged(
-            draft["params"], jnp.asarray(resync), draft["cache"],
-            positions_d + 1, active_d, draft["config"])
+            draft["params"], resync, draft["cache"],
+            prev_positions + 1, prev_active, draft["config"])
+        # A round commits AT LEAST one token per live lane, so 1 is
+        # the safe in-flight schedule increment (over-dispatch is
+        # harmless: exhausted lanes go inactive in-jit and emit 0).
+        sched = np.where(live, 1, 0)
+        self._inflight_sched += sched
+        self._ring.append(dict(
+            kind="spec", tokens=emit_tokens, counts=emit_counts,
+            counts_full=jnp.where(prev_active, counts_raw, 0),
+            drafted=drafted, accepted=accepted,
+            active_after=self._state["active"], steps=1, sched=sched,
+            serial=self._slot_serial.copy()))
+        self._note_dispatch()
+        return True
 
-    def _begin_run(self) -> None:
-        """Layout hook called once before a chunk run: stage any
-        layout state that is constant for the whole run (the paged
-        server uploads its block tables here, once, instead of once
-        per chunk)."""
+    def _note_dispatch(self) -> None:
+        if self._serve_started is None:
+            self._serve_started = time.monotonic()
+        self.counters["dispatches"] += 1
+        self.counters["max_in_flight"] = max(
+            self.counters["max_in_flight"], len(self._ring))
 
-    def _run_chunk(self, tokens_d, positions_d, active_d, steps: int,
-                   sampling: Dict, lora=None):
-        """Decode ``steps`` tokens for all slots from device-resident
-        decode state; returns ``(out, tokens_d, positions_d)`` so a
-        lookahead run can chain chunks without a host sync.  Cache-
-        layout strategy hook: the paged server overrides this (and the
-        admission/release hooks) while ALL bookkeeping — admission
-        order, budgets, EOS, retirement — stays in this class."""
-        out, tokens_d, positions_d, self.cache = \
-            self._llama.decode_chunk_ragged(
-                self.params, tokens_d, self.cache,
-                positions_d, active_d, steps, self.config,
-                lora=lora, **sampling)
-        return out, tokens_d, positions_d
+    def _consume_one(self) -> None:
+        """Apply the OLDEST in-flight entry's results to host
+        bookkeeping: deliver tokens, advance mirrors, retire lanes the
+        device deactivated.  This is the only device→host transfer on
+        the serving path — (slots × steps) token ids plus two
+        slots-sized vectors, never logits."""
+        entry = self._ring.popleft()
+        wait_start = time.monotonic()
+        tokens = np.asarray(entry["tokens"])
+        counts = np.asarray(entry["counts"])
+        active_after = np.asarray(entry["active_after"])
+        spec = entry["kind"] == "spec"
+        if spec:
+            counts_full = np.asarray(entry["counts_full"])
+            self.spec_stats.target_passes += 1
+            self.spec_stats.drafted += int(np.asarray(entry["drafted"]))
+            self.spec_stats.accepted += int(
+                np.asarray(entry["accepted"]))
+        now = time.monotonic()
+        self.counters["host_syncs"] += 1
+        self.counters["sync_wait_ms"] += (now - wait_start) * 1e3
+        self.counters["sync_elements"] += (tokens.size + counts.size
+                                           + active_after.size)
+        self.counters["decode_steps"] += entry["steps"]
+        for slot in range(self.slots):
+            if entry["serial"][slot] != self._slot_serial[slot]:
+                continue           # slot was retired/readmitted since
+            request = self._requests[slot]
+            if request is None or not self.active[slot]:
+                continue
+            self._inflight_sched[slot] -= entry["sched"][slot]
+            count = int(counts[slot])
+            if count:
+                if request.first_token_ts is None:
+                    request.first_token_ts = now
+                request.tokens.extend(
+                    int(t) for t in tokens[slot, :count])
+                self._emitted[slot] += count
+                self._remaining[slot] = (request.max_new_tokens
+                                         - self._emitted[slot])
+                # Mirrors advance by what the device WROTE: the full
+                # committed window for spec rounds (cache rows exist
+                # past the emit caps), the emitted prefix for chunks.
+                advance = int(counts_full[slot]) if spec else count
+                self.positions[slot] += advance
+                self.tokens[slot, 0] = int(tokens[slot, advance - 1]) \
+                    if spec else int(tokens[slot, count - 1])
+                self.counters["tokens_committed"] += count
+            if not active_after[slot]:
+                self._retire(slot)
+
+    def _drain_ring(self) -> None:
+        while self._ring:
+            self._consume_one()
+
+    def stats(self) -> Dict:
+        """Serving perf counters + derived rates (dashboard payloads,
+        bench sections, smoke assertions)."""
+        steps = self.counters["decode_steps"]
+        elapsed = (time.monotonic() - self._serve_started
+                   if self._serve_started is not None else 0.0)
+        return dict(
+            self.counters,
+            in_flight=len(self._ring),
+            queue_depth=self.queue_depth,
+            slots_active=self.slots_active,
+            decode_steps_per_sec=(
+                round(steps / elapsed, 1) if elapsed > 0 else 0.0),
+            sync_stalls_per_100_steps=(
+                round(100.0 * self.counters["host_syncs"] / steps, 2)
+                if steps else 0.0))
 
     def run_until_drained(self, max_chunks: int = 10_000):
         """Synchronous helper (tests / batch jobs): pump until every
@@ -1027,13 +1176,11 @@ class ContinuousReplica(Actor):
 
     def _share_telemetry(self):
         """Operator view (dashboard / any ECConsumer): live slot
-        occupancy, queue depth, and rolling p50 latencies, refreshed
-        every pump."""
+        occupancy, queue depth, async-loop perf counters, and rolling
+        p50 latencies, refreshed every pump."""
         import statistics
-        updates = {
-            "slots_active": int(self.server.slots_active),
-            "queue_depth": int(self.server.queue_depth),
-        }
+        from .serving import serving_telemetry
+        updates = serving_telemetry(self.server.stats())
         if self._ttft_window:
             updates["ttft_p50_ms"] = round(
                 statistics.median(self._ttft_window) * 1e3, 1)

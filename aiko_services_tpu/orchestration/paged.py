@@ -123,11 +123,38 @@ class PagedContinuousServer(ContinuousBatchingServer):
         self._children: dict = {}
         self._pending_shared: List[int] = [0] * self.slots
         self.prefix_hits = 0
+        self.prefix_misses = 0
         self.prefix_blocks_reused = 0
+        self.prefix_evictions = 0
+
+    def _init_device_state(self):
+        state = super()._init_device_state()
+        # Block tables ride the resident state: admission/retirement
+        # mark the slot dirty and the row merges in at the next
+        # dispatch — no per-run table upload.
+        state["tables"] = self._jnp.asarray(self.tables)
+        return state
+
+    def _host_state(self):
+        host = super()._host_state()
+        host["tables"] = self.tables
+        return host
 
     @property
     def free_blocks(self) -> int:
         return len(self._free)
+
+    def stats(self) -> dict:
+        out = super().stats()
+        out.update(
+            prefix_hits=self.prefix_hits,
+            prefix_misses=self.prefix_misses,
+            prefix_blocks_reused=self.prefix_blocks_reused,
+            prefix_evictions=self.prefix_evictions,
+            free_blocks=self.free_blocks,
+            total_blocks=self.total_blocks,
+        )
+        return out
 
     def _blocks_for(self, rows: int) -> int:
         return math.ceil(rows / self.block_size)
@@ -207,6 +234,7 @@ class PagedContinuousServer(ContinuousBatchingServer):
         for key, block in self._evictable.items():          # LRU order
             if self._children.get(key, 0) == 0:
                 self._purge_cached(key, block)
+                self.prefix_evictions += 1
                 return True
         return False
 
@@ -238,13 +266,12 @@ class PagedContinuousServer(ContinuousBatchingServer):
                 if block is None:
                     break
                 shared.append(block)
-            # Bound the compile count: the hit path's program shapes
-            # depend on the shared length, so round it DOWN to a power
-            # of two (0, 1, 2, 4, …) — log-many gather/tail shapes per
-            # prompt bucket instead of one per prefix length.
-            if shared:
-                usable_shared = 1 << (len(shared).bit_length() - 1)
-                shared = shared[:usable_shared]
+            # Every found block is used: _prefill_bucket bounds the
+            # compile count by DECOMPOSING the gather and the tail
+            # prefill into descending power-of-two pieces, so arbitrary
+            # prefix lengths reuse log-many program shapes instead of
+            # being rounded down (the old pow2 truncation threw away up
+            # to half the hit — the BENCH_r05 low-hit-rate culprit).
         # PIN the hits before any eviction (eviction must never free a
         # block we are about to reference), with rollback on deferral.
         # Snapshot the LRU order first: a deferred request never ran,
@@ -278,6 +305,9 @@ class PagedContinuousServer(ContinuousBatchingServer):
         if shared:
             self.prefix_hits += 1
             self.prefix_blocks_reused += len(shared)
+        elif keys:
+            # Shareable prefix existed but nothing was cached for it.
+            self.prefix_misses += 1
         # Register this prompt's remaining shareable blocks for future
         # requests.  ORDER DEPENDENCE: within one admission wave every
         # _reserve_slot runs before any prefill/insert, so a later
@@ -285,10 +315,8 @@ class PagedContinuousServer(ContinuousBatchingServer):
         # blocks still hold garbage — safe ONLY because
         # _prefill_and_insert walks the wave in the same admission
         # order, scattering this request's contents before a later
-        # request's gather.  Keys
-        # already indexed are SKIPPED: the pow2 truncation above can
-        # leave found-but-unpinned hits whose bindings must not be
-        # overwritten (an overwrite would strand the old block in
+        # request's gather.  Keys already indexed are SKIPPED
+        # (defensive: an overwrite would strand the old block in
         # _evictable under a reused key — a permanent leak).
         if self.enable_prefix_cache:
             for position in range(len(shared), len(keys)):
@@ -358,14 +386,31 @@ class PagedContinuousServer(ContinuousBatchingServer):
         padded = prompt_padded.shape[1]
         bucket = llama.init_cache(self.config, 1, padded,
                                   quantize_kv=self.quantize_kv)
-        shared_ids = jnp.asarray(self._owned[slot][:n_shared],
-                                 jnp.int32)
-        bucket = llama.paged_gather_blocks(self.pool, shared_ids,
-                                           bucket)
+        # Both the gather and the uncached-tail prefill run as
+        # descending power-of-two pieces: program shapes depend only on
+        # the piece size, so an arbitrary prefix length compiles
+        # log-many programs per prompt bucket while reusing EVERY
+        # cached block (no pow2 truncation of the hit).
+        shared_blocks = self._owned[slot][:n_shared]
+        done = 0
+        while done < n_shared:
+            size = 1 << ((n_shared - done).bit_length() - 1)
+            ids = jnp.asarray(shared_blocks[done:done + size],
+                              jnp.int32)
+            bucket = llama.paged_gather_blocks(self.pool, ids, bucket,
+                                               jnp.int32(done))
+            done += size
         start = n_shared * self.block_size
-        _, bucket = llama.prefill_chunk(
-            self.params, jnp.asarray(prompt_padded[:, start:]), bucket,
-            jnp.int32(start), self.config, lora=lora)
+        remaining = padded // self.block_size - n_shared
+        while remaining > 0:
+            size = 1 << (remaining.bit_length() - 1)
+            width = size * self.block_size
+            chunk = prompt_padded[:, start:start + width]
+            _, bucket = llama.prefill_chunk(
+                self.params, jnp.asarray(chunk), bucket,
+                jnp.int32(start), self.config, lora=lora)
+            start += width
+            remaining -= size
         return bucket
 
     def _insert_prefix(self, slot: int, bucket_cache, padded: int):
@@ -395,17 +440,11 @@ class PagedContinuousServer(ContinuousBatchingServer):
         self._pending_shared[slot] = 0
         self.tables[slot] = 0
 
-    def _begin_run(self) -> None:
-        # Block tables cannot change mid-run (admission/retirement
-        # happen only at run boundaries): upload once per run.
-        self._tables_d = self._jnp.asarray(self.tables)
-
-    def _run_chunk(self, tokens_d, positions_d, active_d, steps: int,
-                   sampling, lora=None):
-        out, tokens_d, positions_d, self.pool = \
-            self._llama.decode_chunk_paged(
-                self.params, tokens_d, self.pool,
-                self._tables_d, positions_d,
-                active_d, steps, self.config,
-                lora=lora, **sampling)
-        return out, tokens_d, positions_d
+    def _serve_chunk(self, state, steps: int, eos_id: int,
+                     sampled: bool, rng_key, lora_shared):
+        tokens_d, counts_d, new_state, self.pool = \
+            self._llama.serve_chunk_paged(
+                self.params, state, self.pool, steps, self.config,
+                eos_id=eos_id, sampled=sampled, rng_key=rng_key,
+                lora_shared=lora_shared)
+        return tokens_d, counts_d, new_state

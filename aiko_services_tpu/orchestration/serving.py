@@ -38,9 +38,32 @@ from ..utils.sexpr import generate
 
 __all__ = ["ModelReplica", "ReplicaRouter", "REPLICA_PROTOCOL",
            "make_llama_infer", "make_speculative_infer",
-           "make_constrained_infer"]
+           "make_constrained_infer", "serving_telemetry"]
 
 REPLICA_PROTOCOL = "model_replica:0"
+
+#: Server-stats keys worth broadcasting to operators.  Shared by
+#: ContinuousReplica EC shares, dashboard rendering, and bench
+#: reporting so all three show the SAME derived counters.
+TELEMETRY_KEYS = (
+    "slots_active", "queue_depth", "in_flight",
+    "decode_steps_per_sec", "sync_stalls_per_100_steps",
+    "admission_deferred", "state_uploads", "tokens_committed",
+    "prefix_hits", "prefix_misses", "prefix_evictions",
+)
+
+
+def serving_telemetry(stats: Dict) -> Dict:
+    """Project a server's :meth:`stats` dict onto the operator
+    telemetry keys (ints stay ints, rates stay floats; absent keys —
+    e.g. prefix counters on a non-paged server — are omitted)."""
+    out = {}
+    for key in TELEMETRY_KEYS:
+        if key in stats:
+            value = stats[key]
+            out[key] = round(float(value), 2) \
+                if isinstance(value, float) else int(value)
+    return out
 
 
 def _register_unsupported_adapter_commands(actor) -> None:

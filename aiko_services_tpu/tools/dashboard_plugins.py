@@ -148,6 +148,24 @@ def model_replica_plugin(fields, variables) -> List[str]:
                      f"/{slots} active (continuous batching)")
         lines.append(f"  queued:    "
                      f"{_get(variables, 'queue_depth', default=0)}")
+        steps_sec = _get(variables, "decode_steps_per_sec",
+                         default=None)
+        if steps_sec not in (None, "-"):
+            lines.append(
+                f"  decode:    {steps_sec} steps/s, "
+                f"{_get(variables, 'sync_stalls_per_100_steps', default=0)}"
+                f" stalls/100, "
+                f"{_get(variables, 'in_flight', default=0)} in flight")
+        deferred = _get(variables, "admission_deferred", default=None)
+        if deferred not in (None, "-", 0):
+            lines.append(f"  deferred:  {deferred} admissions")
+        hits = _get(variables, "prefix_hits", default=None)
+        if hits not in (None, "-"):
+            lines.append(
+                f"  prefix:    {hits} hits / "
+                f"{_get(variables, 'prefix_misses', default=0)} misses, "
+                f"{_get(variables, 'prefix_evictions', default=0)}"
+                f" evicted")
     adapters = _get(variables, "adapters", default=None)
     if adapters not in (None, "-", ""):
         lines.append(f"  adapters:  {adapters}")

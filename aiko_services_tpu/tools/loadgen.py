@@ -39,10 +39,18 @@ class LoadReport:
     timeouts: int
     elapsed_s: float
     latencies_ms: List[float]
+    tokens_total: int = 0
 
     @property
     def throughput_rps(self) -> float:
         return self.completed / self.elapsed_s if self.elapsed_s else 0.0
+
+    @property
+    def throughput_tps(self) -> float:
+        """Generated tokens per second — the number the device-resident
+        serving work moves; req/s alone hides per-request length."""
+        return (self.tokens_total / self.elapsed_s
+                if self.elapsed_s else 0.0)
 
     def _quantile(self, q: float) -> float:
         if not self.latencies_ms:
@@ -64,6 +72,7 @@ class LoadReport:
         return (f"LoadReport(sent={self.sent}, done={self.completed}, "
                 f"errors={self.errors}, timeouts={self.timeouts}, "
                 f"{self.throughput_rps:.1f} req/s, "
+                f"{self.throughput_tps:.1f} tok/s, "
                 f"p50={self.p50_ms:.1f} ms, p99={self.p99_ms:.1f} ms)")
 
 
@@ -85,6 +94,7 @@ class LoadGenerator:
         self._sent_at: Dict[str, float] = {}
         self._latencies: List[float] = []
         self._errors = 0
+        self._tokens = 0
         self._run_index = 0
         process.add_message_handler(self._on_response,
                                     self.response_topic)
@@ -109,6 +119,14 @@ class LoadGenerator:
             self._errors += 1
         else:
             self._latencies.append((self._clock() - started) * 1e3)
+            if isinstance(outputs, dict) and "tokens_out" in outputs:
+                try:
+                    from ..pipeline.codec import decode_value
+                    import numpy as np
+                    self._tokens += int(np.asarray(
+                        decode_value(outputs["tokens_out"])).size)
+                except Exception:  # noqa: BLE001 - telemetry only
+                    pass
 
     def run(self, n_requests: int, drain_timeout_s: float = 30.0,
             pump: Optional[Callable[[], None]] = None) -> LoadReport:
@@ -121,6 +139,7 @@ class LoadGenerator:
         self._sent_at.clear()
         self._latencies = []
         self._errors = 0
+        self._tokens = 0
         self._run_index += 1
         run_tag = self._run_index
         interval = 1.0 / self.rate_hz if self.rate_hz > 0 else 0.0
@@ -151,7 +170,8 @@ class LoadGenerator:
                           errors=self._errors,
                           timeouts=len(self._sent_at),
                           elapsed_s=elapsed,
-                          latencies_ms=list(self._latencies))
+                          latencies_ms=list(self._latencies),
+                          tokens_total=self._tokens)
 
 
 def service_scale_sweep(services: int, broker: str = "scale-sweep",
