@@ -25,6 +25,10 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from ..ops.attention import attention_reference, flash_attention
+from ..ops.paged_attention import (cached_gqa_attention,
+                                   contiguous_block_size,
+                                   decode_kernel_mode,
+                                   paged_decode_attention)
 from ..ops.quant import (_unpack_int4, int4_matmul, int8_matmul,
                          is_quantized, is_quantized_int4, quantize_tree)
 
@@ -870,10 +874,19 @@ def _attention_decode_paged(layer, config, x, cos, sin, pool_layer,
     k = apply_rope(k, cos, sin)
 
     new_pool = _paged_write_rows(pool_layer, k, v, tables, positions)
-    gathered = _paged_gather(new_pool, tables)
     q_g = q.reshape(batch, seq, kv, h // kv, hd)
-    out = _cached_gqa_attention(q_g, gathered, positions[:, None], hd,
-                                window=config.sliding_window)
+    use_kernel, interpret = decode_kernel_mode()
+    if use_kernel:
+        # The kernel walks the block table directly in HBM — the
+        # steady-state decode path never gathers the pool.
+        out = paged_decode_attention(
+            q_g[:, 0], new_pool["k"], new_pool["v"], tables, positions,
+            ks=new_pool.get("ks"), vs=new_pool.get("vs"),
+            window=config.sliding_window, interpret=interpret)[:, None]
+    else:
+        gathered = _paged_gather(new_pool, tables)
+        out = _cached_gqa_attention(q_g, gathered, positions[:, None],
+                                    hd, window=config.sliding_window)
     out = out.reshape(batch, seq, h * hd)
     return x + _lora_matmul(out, layer["wo"], lora_layer, "wo",
                             lora).astype(x.dtype), new_pool
@@ -1061,50 +1074,42 @@ decode_step = functools.partial(jax.jit, static_argnames=("config",),
                                 donate_argnames=("cache",))(_decode_core)
 
 
-def _cached_gqa_attention(q, cache_layer, query_positions, hd,
-                          window: Optional[int] = None):
-    """Masked GQA attention over a KV cache — the ONE implementation
-    shared by ragged decode and chunked prefill.  ``q`` (batch, Q, kv,
-    group, hd); ``query_positions`` (batch, Q) absolute positions; key
-    row ``s`` is attended iff ``s <= position`` of the query (and
-    within ``window`` of it, when sliding-window attention is on).
+# Masked GQA attention over a KV cache — the ONE jnp implementation
+# shared by ragged decode (CPU fallback), chunked prefill, and
+# speculative verify.  Lives in ops/paged_attention.py next to the
+# Pallas decode kernel it is the oracle for; the int8-KV path
+# dequantizes one span at a time (the kv8 per-step full-cache-copy
+# regression fix).
+_cached_gqa_attention = cached_gqa_attention
 
-    Int8 KV layout: per-(token, head) scales factor OUT of the q·k
-    contraction (over hd), so they multiply the score afterwards; on
-    the value side they factor INTO the softmax weights (contraction is
-    over tokens), so the weights are scaled per key row before the
-    weighted sum — both exact dequantizations, and the int8 cache is
-    read at 1 byte/element with the convert fused into the einsum."""
-    k_cache, v_cache = cache_layer["k"], cache_layer["v"]
-    quantized = "ks" in cache_layer
-    k_in = k_cache.astype(q.dtype) if quantized else k_cache
-    s = jnp.einsum("bqkgd,bskd->bkgqs", q, k_in,
-                   preferred_element_type=jnp.float32) * hd ** -0.5
-    if quantized:
-        # ks (b, s, kv) → (b, kv, 1, 1, s)
-        s = s * cache_layer["ks"].transpose(0, 2, 1)[:, :, None, None, :]
-    if "pos" in cache_layer:
-        # Rolling layout: each row stores its ABSOLUTE position (-1 =
-        # never written); visibility is decided from those, so ring
-        # wraparound needs no special casing.
-        key_pos = cache_layer["pos"][:, None, :]     # (b, 1, S)
-        mask = (key_pos >= 0) & (key_pos
-                                 <= query_positions[:, :, None])
-    else:
-        key_pos = jnp.arange(k_cache.shape[1])[None, None, :]
-        mask = key_pos <= query_positions[:, :, None]
-    if window is not None:
-        mask &= key_pos > query_positions[:, :, None] - window
-    s = jnp.where(mask[:, None, None, :, :], s, -1e30)
-    weights = jax.nn.softmax(s, axis=-1)
-    if quantized:
-        weights = weights * cache_layer["vs"].transpose(
-            0, 2, 1)[:, :, None, None, :]
-        return jnp.einsum("bkgqs,bskd->bqkgd",
-                          weights.astype(q.dtype),
-                          v_cache.astype(q.dtype))
-    return jnp.einsum("bkgqs,bskd->bqkgd",
-                      weights.astype(v_cache.dtype), v_cache)
+
+def _decode_attention_contiguous(q_g, cache_layer, positions, hd,
+                                 window):
+    """Single-token ragged decode attention over a CONTIGUOUS cache:
+    dispatch to the Pallas paged-decode kernel (the cache reshaped to a
+    degenerate block pool — a free reshape — with iota block tables) on
+    TPU, else the jnp oracle.  Rolling caches always take the oracle
+    (ring rows need the stored-position mask)."""
+    use_kernel, interpret = decode_kernel_mode()
+    max_seq = cache_layer["k"].shape[1]
+    block_size = contiguous_block_size(max_seq)
+    if not use_kernel or not block_size or "pos" in cache_layer:
+        return cached_gqa_attention(q_g, cache_layer,
+                                    positions[:, None], hd,
+                                    window=window)
+    batch = q_g.shape[0]
+    blocks_per_row = max_seq // block_size
+    tables = (jnp.arange(batch, dtype=jnp.int32)[:, None]
+              * blocks_per_row
+              + jnp.arange(blocks_per_row, dtype=jnp.int32)[None, :])
+    pool = {key: buf.reshape((batch * blocks_per_row, block_size)
+                             + buf.shape[2:])
+            for key, buf in cache_layer.items()}
+    out = paged_decode_attention(
+        q_g[:, 0], pool["k"], pool["v"], tables, positions,
+        ks=pool.get("ks"), vs=pool.get("vs"), window=window,
+        interpret=interpret)
+    return out[:, None]
 
 
 def _attention_decode_ragged(layer, config, x, cos, sin, cache_layer,
@@ -1129,9 +1134,8 @@ def _attention_decode_ragged(layer, config, x, cos, sin, cache_layer,
 
     group = h // kv
     q_g = q.reshape(batch, seq, kv, group, hd)
-    out = _cached_gqa_attention(q_g, new_cache,
-                                positions[:, None], hd,
-                                window=config.sliding_window)
+    out = _decode_attention_contiguous(q_g, new_cache, positions, hd,
+                                       config.sliding_window)
     out = out.reshape(batch, seq, h * hd)
     return x + _lora_matmul(out, layer["wo"], lora_layer, "wo",
                             lora).astype(x.dtype), new_cache

@@ -1,1 +1,7 @@
 from .attention import flash_attention, attention_reference
+from .paged_attention import (paged_decode_attention,
+                              paged_decode_reference,
+                              cached_gqa_attention,
+                              decode_attention_path,
+                              decode_kernel_mode,
+                              contiguous_block_size)

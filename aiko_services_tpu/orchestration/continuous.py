@@ -236,6 +236,14 @@ class ContinuousBatchingServer:
         self.quantize_kv = quantize_kv
         self._bucket_minimum = 16
         self._init_layout()
+        # Decode-attention dispatch tag ("kernel" = Pallas paged
+        # decode kernel, "reference" = jnp oracle) + the block
+        # geometry of the attention view — decided once at init, so
+        # bench regressions are attributable to the path taken.
+        from ..ops.paged_attention import decode_attention_path
+        self.decode_attention_path = decode_attention_path()
+        self._attn_block_size, self._attn_total_blocks = \
+            self._attention_blocks()
         # Bookkeeping state lives HOST-side (numpy): admissions and
         # retirements mutate it for free, and it rides into the chunk
         # dispatch as three tiny h2d transfers.  The device-returned
@@ -308,7 +316,8 @@ class ContinuousBatchingServer:
         self.counters: Dict = dict(
             dispatches=0, decode_steps=0, tokens_committed=0,
             host_syncs=0, sync_wait_ms=0.0, sync_elements=0,
-            state_uploads=0, max_in_flight=0, admission_deferred=0)
+            state_uploads=0, max_in_flight=0, admission_deferred=0,
+            decode_blocks_read=0)
         self._serve_started: Optional[float] = None
 
         @jax.jit
@@ -366,6 +375,37 @@ class ContinuousBatchingServer:
                                         self._dirty.copy())
         self._dirty[:] = False
         self.counters["state_uploads"] += 1
+
+    def _attention_blocks(self):
+        """``(block_size, total_blocks_per_row)`` of the decode-
+        attention view: the contiguous cache is the kernel's degenerate
+        block pool (the paged server overrides with its real pool
+        geometry)."""
+        from ..ops.paged_attention import contiguous_block_size
+        block_size = contiguous_block_size(self.max_seq) or self.max_seq
+        return block_size, -(-self.max_seq // block_size)
+
+    def _note_decode_blocks(self, live, sched) -> None:
+        """Estimate the KV blocks each dispatched decode step reads,
+        from the host position mirrors (positions as of dispatch;
+        intra-chunk advance is ignored — at most ``steps/block_size``
+        blocks/row of undercount).  Kernel path: only the row's live
+        blocks, window-clamped; reference path: the whole cache/table
+        every step — the counter makes the O(max_seq) → O(len) traffic
+        difference a tracked number."""
+        sched_live = sched[live]
+        if self.decode_attention_path == "kernel":
+            block_size = self._attn_block_size
+            blocks = (self.positions[live]
+                      + block_size) // block_size   # ceil((pos+1)/bs)
+            window = self.config.sliding_window
+            if window:
+                blocks = np.minimum(blocks, window // block_size + 1)
+        else:
+            blocks = np.full(sched_live.shape, self._attn_total_blocks,
+                             np.int64)
+        self.counters["decode_blocks_read"] += int(
+            (blocks * sched_live).sum())
 
     def _init_layout(self):
         """Cache-layout hook (overridden by the paged server): the
@@ -899,6 +939,7 @@ class ContinuousBatchingServer:
             self._any_sampled, rng_key, self._serve_lora())
         sched = np.where(live, np.minimum(steps, plan), 0)
         self._inflight_sched += sched
+        self._note_decode_blocks(live, sched)
         self._ring.append(dict(
             kind="chunk", tokens=tokens_d, counts=counts_d,
             active_after=self._state["active"], steps=steps,
@@ -1077,6 +1118,10 @@ class ContinuousBatchingServer:
             in_flight=len(self._ring),
             queue_depth=self.queue_depth,
             slots_active=self.slots_active,
+            decode_attention_path=self.decode_attention_path,
+            blocks_read_per_step=(
+                round(self.counters["decode_blocks_read"] / steps, 2)
+                if steps else 0.0),
             decode_steps_per_sec=(
                 round(steps / elapsed, 1) if elapsed > 0 else 0.0),
             sync_stalls_per_100_steps=(

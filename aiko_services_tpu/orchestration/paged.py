@@ -156,6 +156,10 @@ class PagedContinuousServer(ContinuousBatchingServer):
         )
         return out
 
+    def _attention_blocks(self):
+        # Real pool geometry: the kernel walks the slot's block table.
+        return self.block_size, self.tables.shape[1]
+
     def _blocks_for(self, rows: int) -> int:
         return math.ceil(rows / self.block_size)
 

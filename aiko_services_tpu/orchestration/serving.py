@@ -50,19 +50,25 @@ TELEMETRY_KEYS = (
     "decode_steps_per_sec", "sync_stalls_per_100_steps",
     "admission_deferred", "state_uploads", "tokens_committed",
     "prefix_hits", "prefix_misses", "prefix_evictions",
+    "decode_attention_path", "blocks_read_per_step",
 )
 
 
 def serving_telemetry(stats: Dict) -> Dict:
     """Project a server's :meth:`stats` dict onto the operator
-    telemetry keys (ints stay ints, rates stay floats; absent keys —
-    e.g. prefix counters on a non-paged server — are omitted)."""
+    telemetry keys (ints stay ints, rates stay floats, tags stay
+    strings; absent keys — e.g. prefix counters on a non-paged server
+    — are omitted)."""
     out = {}
     for key in TELEMETRY_KEYS:
         if key in stats:
             value = stats[key]
-            out[key] = round(float(value), 2) \
-                if isinstance(value, float) else int(value)
+            if isinstance(value, str):
+                out[key] = value
+            elif isinstance(value, float):
+                out[key] = round(float(value), 2)
+            else:
+                out[key] = int(value)
     return out
 
 

@@ -159,6 +159,13 @@ def model_replica_plugin(fields, variables) -> List[str]:
         deferred = _get(variables, "admission_deferred", default=None)
         if deferred not in (None, "-", 0):
             lines.append(f"  deferred:  {deferred} admissions")
+        attn_path = _get(variables, "decode_attention_path",
+                         default=None)
+        if attn_path not in (None, "-", ""):
+            lines.append(
+                f"  attn:      {attn_path} path, "
+                f"{_get(variables, 'blocks_read_per_step', default=0)}"
+                f" blocks/step")
         hits = _get(variables, "prefix_hits", default=None)
         if hits not in (None, "-"):
             lines.append(

@@ -40,6 +40,11 @@ class LoadReport:
     elapsed_s: float
     latencies_ms: List[float]
     tokens_total: int = 0
+    #: Optional server-side counters snapshot (``server.stats()`` or
+    #: :func:`~..orchestration.serving.serving_telemetry` payload)
+    #: attached by the harness after the run — ties the wire-level
+    #: tails to the decode-attention path that produced them.
+    server_stats: Optional[Dict] = None
 
     @property
     def throughput_rps(self) -> float:
@@ -69,11 +74,18 @@ class LoadReport:
         return self._quantile(0.99)
 
     def __repr__(self):
+        attn = ""
+        if self.server_stats and "decode_attention_path" in \
+                self.server_stats:
+            attn = (f", attn={self.server_stats['decode_attention_path']}"
+                    f"/{self.server_stats.get('blocks_read_per_step', 0)}"
+                    f" blk/step")
         return (f"LoadReport(sent={self.sent}, done={self.completed}, "
                 f"errors={self.errors}, timeouts={self.timeouts}, "
                 f"{self.throughput_rps:.1f} req/s, "
                 f"{self.throughput_tps:.1f} tok/s, "
-                f"p50={self.p50_ms:.1f} ms, p99={self.p99_ms:.1f} ms)")
+                f"p50={self.p50_ms:.1f} ms, p99={self.p99_ms:.1f} ms"
+                f"{attn})")
 
 
 class LoadGenerator:

@@ -45,6 +45,7 @@ from __future__ import annotations
 
 import argparse
 import contextlib
+import functools
 import json
 import os
 import queue
@@ -1215,6 +1216,115 @@ def _force_xla_wrapper(env_var, section_fn):
     return run
 
 
+def bench_decode_attention(lengths=(128, 1024, 8192), batch=8,
+                           kv_heads=8, group=4, head_dim=128,
+                           block_size=64, iters=20):
+    """Decode-attention microbench: the Pallas paged decode kernel
+    (ops/paged_attention.py) vs the gather+masked jnp reference, bf16
+    and int8 KV, across row lengths — with the estimated HBM bytes per
+    step for each, so the O(max_seq) → O(len) traffic win is a tracked
+    number.  The pool is sized for the LONGEST length; shorter rows
+    measure exactly the ragged case serving cares about (the reference
+    still scans the whole table; the kernel reads only live blocks).
+
+    Off-TPU the kernel is only parity-checked in interpret mode at the
+    smallest length (interpret at 8k would eat the budget); the byte
+    accounting is analytic either way."""
+    import jax
+    import jax.numpy as jnp
+    from aiko_services_tpu.ops import paged_attention as pa
+
+    on_tpu = jax.default_backend() == "tpu"
+    max_seq = max(lengths)
+    max_blocks = max_seq // block_size
+    n_blocks = batch * max_blocks + 1
+    rng = jax.random.PRNGKey(0)
+    keys = jax.random.split(rng, 4)
+    q = jax.random.normal(keys[0], (batch, kv_heads, group, head_dim),
+                          jnp.bfloat16)
+    k = jax.random.normal(keys[1],
+                          (n_blocks, block_size, kv_heads, head_dim),
+                          jnp.bfloat16)
+    v = jax.random.normal(keys[2],
+                          (n_blocks, block_size, kv_heads, head_dim),
+                          jnp.bfloat16)
+    tables = (jnp.arange(batch, dtype=jnp.int32)[:, None] * max_blocks
+              + jnp.arange(max_blocks, dtype=jnp.int32)[None, :] + 1)
+
+    def quantize(rows):
+        r32 = rows.astype(jnp.float32)
+        amax = jnp.max(jnp.abs(r32), axis=-1)
+        scale = jnp.where(amax == 0, 1.0, amax / 127.0)
+        qi = jnp.clip(jnp.round(r32 / scale[..., None]),
+                      -127, 127).astype(jnp.int8)
+        return qi, scale
+
+    kq, ks = quantize(k)
+    vq, vs = quantize(v)
+
+    kernel_fn = jax.jit(functools.partial(
+        pa.paged_decode_attention, interpret=False))
+    ref_fn = jax.jit(pa.paged_decode_reference)
+
+    def timed(fn, *args, **kwargs):
+        fn(*args, **kwargs).block_until_ready()    # compile
+        started = time.perf_counter()
+        for _ in range(iters):
+            out = fn(*args, **kwargs)
+        out.block_until_ready()
+        return (time.perf_counter() - started) / iters * 1e3
+
+    results = {}
+    for quant in (False, True):
+        tag = "int8" if quant else "bf16"
+        kv_args = dict(ks=ks, vs=vs) if quant else {}
+        k_in, v_in = (kq, vq) if quant else (k, v)
+        elem = 1 if quant else 2
+        scale_bytes = 4 * 2 if quant else 0     # ks + vs f32 per row
+        for length in lengths:
+            positions = jnp.full((batch,), length - 1, jnp.int32)
+            live_blocks = -(-length // block_size)
+            per_token = kv_heads * (head_dim * elem * 2 + scale_bytes)
+            kernel_bytes = batch * live_blocks * block_size * per_token
+            ref_bytes = batch * max_seq * per_token
+            results[f"decode_attention_{tag}_{length}"
+                    "_kernel_bytes_step"] = kernel_bytes
+            results[f"decode_attention_{tag}_{length}"
+                    "_reference_bytes_step"] = ref_bytes
+            ref_ms = timed(ref_fn, q, k_in, v_in, tables, positions,
+                           **kv_args)
+            results[f"decode_attention_{tag}_{length}"
+                    "_reference_ms"] = round(ref_ms, 3)
+            line = (f"decode_attention[{tag} len={length}]: reference "
+                    f"{ref_ms:.2f} ms ({ref_bytes / 1e6:.1f} MB/step)")
+            if on_tpu:
+                kernel_ms = timed(kernel_fn, q, k_in, v_in, tables,
+                                  positions, **kv_args)
+                results[f"decode_attention_{tag}_{length}"
+                        "_kernel_ms"] = round(kernel_ms, 3)
+                line += (f", kernel {kernel_ms:.2f} ms "
+                         f"({kernel_bytes / 1e6:.1f} MB/step, "
+                         f"{ref_ms / max(kernel_ms, 1e-9):.1f}x)")
+            log(line)
+        if not on_tpu:
+            # Interpret-mode parity at the smallest length stands in
+            # for the kernel timing (also covered by tier-1 tests).
+            length = min(lengths)
+            positions = jnp.full((batch,), length - 1, jnp.int32)
+            out = pa.paged_decode_attention(
+                q, k_in, v_in, tables, positions, interpret=True,
+                **kv_args)
+            ref = pa.paged_decode_reference(q, k_in, v_in, tables,
+                                            positions, **kv_args)
+            err = float(jnp.max(jnp.abs(
+                out.astype(jnp.float32) - ref.astype(jnp.float32))))
+            results[f"decode_attention_{tag}_interpret_parity_err"] = \
+                round(err, 6)
+            log(f"decode_attention[{tag}] interpret parity max err "
+                f"{err:.2e} (no TPU: kernel timing skipped)")
+    return results
+
+
 SECTIONS = [
     # (name, per-section budget seconds, zero-arg fn -> result dict)
     ("pipeline", 600,
@@ -1333,6 +1443,16 @@ SECTIONS = [
     # XLA-only compile, no new Pallas tiles.
     ("train_mfu_1b", 600, bench_train_mfu_1b),
     ("detector_mfu", 300, bench_detector_mfu),
+    # Decode-attention microbench: kernel vs gather+masked reference
+    # across row lengths, bf16 + int8 KV, with HBM bytes/step.  A
+    # FIRST-TIME Pallas compile (the paged decode kernel's scalar-
+    # prefetch grid), so it sits with the other compile-risk sections
+    # after everything established.
+    ("decode_attention", 420,
+     (lambda: bench_decode_attention(lengths=(64, 128), batch=2,
+                                     kv_heads=2, group=2, head_dim=64,
+                                     block_size=16, iters=3))
+     if SMOKE else bench_decode_attention),
     # First-time-on-hardware compile (16k flash grid) — window risk,
     # so it sits after every established section; still before the
     # int4 pair, the only sections that have actually wedged the
