@@ -29,6 +29,8 @@ from ..ops.paged_attention import (cached_gqa_attention,
                                    contiguous_block_size,
                                    decode_kernel_mode,
                                    paged_decode_attention)
+from ..ops.paged_prefill import (paged_prefill_attention,
+                                 prefill_kernel_mode)
 from ..ops.quant import (_unpack_int4, int4_matmul, int8_matmul,
                          is_quantized, is_quantized_int4, quantize_tree)
 
@@ -41,6 +43,7 @@ __all__ = ["LlamaConfig", "init_params", "forward",
            "decode_chunk_ragged", "prefill_chunk", "sample_logits",
            "init_paged_cache", "decode_chunk_paged",
            "serve_chunk_ragged", "serve_chunk_paged",
+           "serve_chunk_mixed", "prefill_append_paged",
            "paged_insert_prefix", "paged_scatter_blocks",
            "paged_gather_blocks", "complete", "CONFIGS"]
 
@@ -856,6 +859,22 @@ def _paged_gather(pool_layer, tables):
     return {key: view(buf) for key, buf in pool_layer.items()}
 
 
+def _paged_write_slab(pool_layer, k, v, tables, positions_b):
+    """Scatter a (batch, K, kv, hd) chunk slab into the pool at per-row
+    absolute positions — the append-admission reference path: the chunk
+    lands straight in its blocks, no bucket cache ever exists."""
+    block_size = pool_layer["k"].shape[1]
+    block_ids = jnp.take_along_axis(tables, positions_b // block_size,
+                                    axis=1)
+    offsets = positions_b % block_size
+
+    def scatter(pool, rows):
+        return pool.at[block_ids, offsets].set(rows.astype(pool.dtype))
+
+    return {key: scatter(pool_layer[key], src)
+            for key, src in _quantize_pairs(pool_layer, k, v).items()}
+
+
 def _attention_decode_paged(layer, config, x, cos, sin, pool_layer,
                             tables, positions, lora=None,
                             lora_layer=None):
@@ -1415,6 +1434,151 @@ def serve_chunk_paged(params, state, pool, num_steps,
                          % block_size)
     lora = (dict(lora_shared, ids=state["adapter_ids"])
             if lora_shared is not None else None)
+
+    def step_core(token, pool, positions, active):
+        write_tables = jnp.where(active[:, None], tables,
+                                 scratch_tables)
+        write_pos = jnp.where(active, positions, scratch_positions)
+        return _decode_core_paged(params, token, pool, write_tables,
+                                  write_pos, config, lora=lora)
+
+    return _serve_scan(step_core, state, pool, num_steps, eos_id,
+                       sampled, rng_key)
+
+
+def _prefill_append_core(params, tokens, pool, tables, start_index,
+                         config: LlamaConfig, lora=None, kv_limit=None,
+                         compute_logits: bool = True):
+    """Append-attention prefill straight against the block pool: the
+    chunk's K/V land in their pool blocks and its queries attend over
+    cached prefix blocks + the causally-visible chunk itself — no
+    bucket gather, no scatter-back.  All rows share one scalar
+    ``start_index`` (the admission loop prefills one request per call;
+    ``tables`` is that request's (1, max_blocks) row, or a slot batch
+    at a common boundary).
+
+    Kernel dispatch mirrors the decode path
+    (:func:`~..ops.paged_prefill.prefill_kernel_mode`); the reference
+    dispatch writes the slab in place and attends over the gathered
+    pool VIEW — still no bucket cache, so admission semantics are
+    identical either way.  ``compute_logits=False`` skips the final
+    norm + lm_head: the mixed serving step never reads prefill logits
+    (activation seeds the LAST prompt token, so the first decode step
+    produces the first output)."""
+    batch, K = tokens.shape
+    h, kv, hd = config.n_heads, config.n_kv_heads, config.head_dim
+    start_index = jnp.asarray(start_index, jnp.int32)
+    positions_b = jnp.broadcast_to(
+        start_index + jnp.arange(K, dtype=jnp.int32), (batch, K))
+    cached_lens = jnp.broadcast_to(start_index, (batch,))
+    chunk_lens = jnp.full((batch,), K, jnp.int32)
+    cos, sin = _rope_freqs(config, positions_b)
+    x = _embed_lookup(params, tokens, config.dtype)
+    use_kernel, interpret = prefill_kernel_mode()
+    new_pool = []
+    lora_layers = lora["layers"] if lora else [None] * len(pool)
+    for layer, pool_layer, lora_layer in zip(params["layers"], pool,
+                                             lora_layers):
+        normed = rms_norm(x, layer["attn_norm"], config.norm_eps)
+        q = _lora_matmul(normed, layer["wq"], lora_layer, "wq",
+                         lora).reshape(batch, K, h, hd)
+        k = _lora_matmul(normed, layer["wk"], lora_layer, "wk",
+                         lora).reshape(batch, K, kv, hd)
+        v = _lora_matmul(normed, layer["wv"], lora_layer, "wv",
+                         lora).reshape(batch, K, kv, hd)
+        q = apply_rope(q, cos, sin)
+        k = apply_rope(k, cos, sin)
+        q_g = q.reshape(batch, K, kv, h // kv, hd)
+        if use_kernel:
+            out, pool_layer = paged_prefill_attention(
+                q_g, k, v, pool_layer, tables, cached_lens, chunk_lens,
+                window=config.sliding_window, interpret=interpret,
+                kv_limit=kv_limit)
+        else:
+            pool_layer = _paged_write_slab(pool_layer, k, v, tables,
+                                           positions_b)
+            gathered = _paged_gather(pool_layer, tables)
+            out = _cached_gqa_attention(q_g, gathered, positions_b, hd,
+                                        window=config.sliding_window)
+        new_pool.append(pool_layer)
+        x = x + _lora_matmul(out.reshape(batch, K, h * hd),
+                             layer["wo"], lora_layer, "wo",
+                             lora).astype(x.dtype)
+        x = _mlp_block(layer, config, x)
+    if not compute_logits:
+        return None, new_pool
+    x = rms_norm(x, params["final_norm"], config.norm_eps)
+    logits = _matmul(x, params["lm_head"]).astype(jnp.float32)
+    return logits, new_pool
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("config", "kv_limit",
+                                    "compute_logits"),
+                   donate_argnames=("pool",))
+def prefill_append_paged(params, tokens, pool, tables, start_index,
+                         config: LlamaConfig, lora=None,
+                         kv_limit=None, compute_logits: bool = True):
+    """Admit a (batch, K) prompt chunk into the block pool by append
+    attention — the replacement for the gather → contiguous prefill →
+    scatter admission chain.  Prefix-cache hits skip straight past the
+    shared blocks: pass ``start_index = n_shared * block_size`` and the
+    cached blocks are only READ, never materialized into a bucket.
+
+    ``kv_limit`` (static) clips the kernel's block sweep to the
+    request's own allocation so short prompts don't pay for the full
+    table width; ``tokens`` width must be a multiple of the pool block
+    size for the kernel path (the dispatcher falls back to the
+    reference slab write otherwise)."""
+    return _prefill_append_core(params, tokens, pool, tables,
+                                start_index, config, lora=lora,
+                                kv_limit=kv_limit,
+                                compute_logits=compute_logits)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("config", "num_steps", "eos_id",
+                                    "sampled", "prefill_kv_limit"),
+                   donate_argnames=("pool",))
+def serve_chunk_mixed(params, state, pool, prefill_tokens, prefill_row,
+                      prefill_start, num_steps, config: LlamaConfig,
+                      eos_id: int = -1, sampled: bool = False,
+                      rng_key=None, lora_shared=None,
+                      prefill_kv_limit=None):
+    """Sarathi-style mixed step: ONE jitted dispatch that appends a
+    chunked-prefill slice for one admitting request and then runs
+    ``num_steps`` decode steps for the live slots — prefill no longer
+    stalls decode between chunks.
+
+    ``prefill_row`` is a TRACED slot index (the admitting slot's block
+    table row and adapter id are dynamically sliced out of the resident
+    state), so which slot is prefilling never triggers a recompile —
+    only the slice width and ``prefill_kv_limit`` (both shape-bounded
+    by the bucket ladder) are static.  The prefilling slot stays
+    inactive in ``state`` until its last slice lands, so the decode
+    scan treats it as a scratch lane; prefill logits are never
+    computed (the activation seed is the last prompt token)."""
+    block_size = pool[0]["k"].shape[1]
+    tables = state["tables"]
+    slots = tables.shape[0]
+    prefill_row = jnp.asarray(prefill_row, jnp.int32)
+    tables_row = jax.lax.dynamic_slice_in_dim(tables, prefill_row, 1,
+                                              axis=0)
+    if lora_shared is not None:
+        row_ids = jax.lax.dynamic_slice_in_dim(state["adapter_ids"],
+                                               prefill_row, 1, axis=0)
+        prefill_lora = dict(lora_shared, ids=row_ids)
+        lora = dict(lora_shared, ids=state["adapter_ids"])
+    else:
+        prefill_lora = lora = None
+    _, pool = _prefill_append_core(params, prefill_tokens, pool,
+                                   tables_row, prefill_start, config,
+                                   lora=prefill_lora,
+                                   kv_limit=prefill_kv_limit,
+                                   compute_logits=False)
+    scratch_tables = jnp.zeros_like(tables)
+    scratch_positions = (jnp.arange(slots, dtype=jnp.int32)
+                         % block_size)
 
     def step_core(token, pool, positions, active):
         write_tables = jnp.where(active[:, None], tables,

@@ -5,3 +5,7 @@ from .paged_attention import (paged_decode_attention,
                               decode_attention_path,
                               decode_kernel_mode,
                               contiguous_block_size)
+from .paged_prefill import (paged_prefill_attention,
+                            paged_prefill_reference,
+                            prefill_attention_path,
+                            prefill_kernel_mode)

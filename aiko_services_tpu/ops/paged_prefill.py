@@ -1,0 +1,498 @@
+"""Ragged paged append-attention: chunked prefill straight against the
+block pool, plus the jnp oracle it must match.
+
+Admission is the serving cold path that stalls the hot one: the bucket
+admission flow gathers a prompt's cached blocks into a contiguous
+bucket (``paged_gather_blocks``), runs contiguous chunked prefill over
+it, then scatters the result back into the pool
+(``paged_scatter_blocks``) — every prompt KV byte crosses HBM twice
+before the first decode step, and a prefix-cache hit still pays the
+full gather.  The append kernel here removes both copies:
+
+* a **write kernel** (grid ``(row, kv-head, chunk-block)``) quantizes
+  (int8 layout) and lands the chunk's new K/V rows directly in the
+  row's pool blocks — the block table rides scalar prefetch, so the
+  output BlockSpec index map targets ``tables[row, cached//bs + cb]``
+  and the flush IS the pool write.  Blocks past ``chunk_len`` retarget
+  the allocator's reserved scratch block 0 (never attendable, the same
+  contract inactive decode lanes rely on).
+* an **attention kernel** (grid ``(row, kv-head, query-tile,
+  kv-block)``, kv fastest) runs flash-style online softmax for the
+  chunk's queries over the row's cached prefix blocks plus the
+  causally-visible part of the chunk itself, reading K/V straight from
+  the pool.  Per-row ``(cached_len, chunk_len)`` metadata rides scalar
+  prefetch; dead steps (blocks past the tile's last query, or wholly
+  below its sliding window) clamp their index map to a resident block
+  and skip compute, so a row's HBM traffic is O(its real history).
+* all ``group`` query heads of a kv head stack into the tile's row
+  axis (``(q_tile·group, head_dim)``), so masking is per-row by
+  absolute ids and every matmul is MXU-shaped 2D.
+* unlike single-token decode, a multi-query tile CAN hold rows with no
+  visible key in a live block (a later chunk row's first block, or a
+  window that has slid past), so masked positions are explicitly
+  zeroed in the probability tile — the decode kernel's "every live
+  block has a visible key" invariant does not extend here.
+
+``cached_lens`` must be block-aligned (multiples of ``block_size``):
+shared prefixes are whole blocks and chunk widths are powers of two,
+so every caller satisfies this by construction.  Layout contract and
+dispatch rules are documented in docs/KERNELS.md.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from .attention import NEG_INF, _PALLAS_TPU
+from .paged_attention import cached_gqa_attention
+
+if _PALLAS_TPU:
+    from jax.experimental.pallas import tpu as pltpu
+else:  # pragma: no cover
+    pltpu = None
+
+__all__ = ["paged_prefill_attention", "paged_prefill_reference",
+           "prefill_kernel_mode", "prefill_attention_path"]
+
+#: Largest query tile (tokens) one attention program carries; the tile
+#: row axis is ``q_tile * group`` so this also bounds scratch size.
+Q_TILE_CAP = 128
+
+
+# ---------------------------------------------------------------------------
+# Dispatch policy
+
+
+def prefill_kernel_mode() -> Tuple[bool, bool]:
+    """``(use_kernel, interpret)`` for the append-attention dispatch.
+
+    Controlled by ``AIKO_PREFILL_ATTENTION`` (read at TRACE time — set
+    it before the first admission of a given shape, jit caches traces):
+
+    * ``auto`` (default): kernel on TPU, jnp reference elsewhere.
+    * ``kernel``: force the kernel; off-TPU it runs in interpret mode
+      (slow — testing only).
+    * ``interpret``: kernel in interpret mode everywhere.
+    * ``reference`` / ``off`` / ``0``: always the jnp reference.
+    """
+    mode = os.environ.get("AIKO_PREFILL_ATTENTION", "auto").lower()
+    if mode in ("reference", "fallback", "off", "0"):
+        return False, False
+    on_tpu = jax.default_backend() == "tpu"
+    if mode in ("kernel", "force"):
+        return _PALLAS_TPU, not on_tpu
+    if mode == "interpret":
+        return _PALLAS_TPU, True
+    return _PALLAS_TPU and on_tpu, False
+
+
+def prefill_attention_path() -> str:
+    """``"kernel"`` or ``"reference"`` — the serving-counter path tag."""
+    return "kernel" if prefill_kernel_mode()[0] else "reference"
+
+
+def _q_tile_size(chunk: int) -> int:
+    """Default query tile: largest power-of-two divisor of ``chunk``,
+    capped at :data:`Q_TILE_CAP`."""
+    return min(chunk & -chunk, Q_TILE_CAP)
+
+
+# ---------------------------------------------------------------------------
+# jnp oracle (also the CPU path) — numerics the kernel must match
+
+
+def _kv_quantize_rows(rows):
+    """(…, hd) → (int8 rows, f32 scales (…,)) — symmetric absmax per
+    vector, identical numerics to the models-side cache quantizer (one
+    scale per token per kv head)."""
+    r32 = rows.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(r32), axis=-1)
+    scale = jnp.where(amax == 0, 1.0, amax / 127.0)
+    q = jnp.clip(jnp.round(r32 / scale[..., None]),
+                 -127, 127).astype(jnp.int8)
+    return q, scale
+
+
+def _write_rows_reference(pool, k_new, v_new, tables, positions):
+    """Scatter the chunk rows (every padded row — pad keys land past
+    every real query's visibility) into the pool at their absolute
+    positions; int8 layouts quantize exactly like the cache writer."""
+    block_size = pool["k"].shape[1]
+    block_ids = jnp.take_along_axis(tables, positions // block_size,
+                                    axis=1)
+    offsets = positions % block_size
+    if "ks" in pool:
+        kq, ks = _kv_quantize_rows(k_new)
+        vq, vs = _kv_quantize_rows(v_new)
+        sources = {"k": kq, "v": vq, "ks": ks, "vs": vs}
+    else:
+        sources = {"k": k_new, "v": v_new}
+    return {key: pool[key].at[block_ids, offsets].set(
+                src.astype(pool[key].dtype))
+            for key, src in sources.items()}
+
+
+def paged_prefill_reference(q, k_new, v_new, pool, tables, cached_lens,
+                            chunk_lens, window: Optional[int] = None):
+    """Write-then-gather-then-attend oracle for the append kernel:
+    scatter the chunk's K/V into the pool, view ``pool[tables]`` as
+    per-row contiguous caches, and run :func:`cached_gqa_attention`
+    with query positions ``cached + [0, T)``.
+
+    ``q`` (batch, T, kv, group, hd); ``k_new``/``v_new`` (batch, T, kv,
+    hd); ``pool`` the per-layer dict (``k``/``v`` + optional
+    ``ks``/``vs``); returns ``(out (batch, T, kv, group, hd),
+    new_pool)``.  Query/output rows at or past ``chunk_lens[row]`` are
+    padding — attended against garbage, discarded by callers."""
+    batch, T = k_new.shape[:2]
+    hd = q.shape[-1]
+    positions = (cached_lens.astype(jnp.int32)[:, None]
+                 + jnp.arange(T, dtype=jnp.int32)[None, :])
+    new_pool = _write_rows_reference(pool, k_new, v_new, tables,
+                                     positions)
+
+    def view(buf):
+        gathered = buf[tables]
+        n_blocks, bs = gathered.shape[1:3]
+        return gathered.reshape((batch, n_blocks * bs)
+                                + gathered.shape[3:])
+
+    cache_layer = {key: view(buf) for key, buf in new_pool.items()}
+    out = cached_gqa_attention(q, cache_layer, positions, hd,
+                               window=window)
+    return out, new_pool
+
+
+# ---------------------------------------------------------------------------
+# The write kernel: land the chunk's K/V rows in their pool blocks
+
+
+def _append_kv_kernel(tables_ref, meta_ref,        # scalar prefetch
+                      k_new_ref, v_new_ref, k_in_ref, v_in_ref, *rest,
+                      quantized: bool):
+    """Grid: (batch, kv_heads, chunk_blocks).  One program moves one
+    (row, kv-head) chunk block from the activation slab into the pool
+    block the index map resolved from the prefetched table — the
+    output flush IS the pool write.  Dead steps (block past
+    ``chunk_len``) still flush, but the index map retargeted them at
+    reserved scratch block 0, which is never attendable."""
+    if quantized:
+        _ks_in, _vs_in, k_out, v_out, ks_out, vs_out = rest
+    else:
+        k_out, v_out = rest
+    k = k_new_ref[0, :, 0]                      # (bs, hd)
+    v = v_new_ref[0, :, 0]
+    if quantized:
+        for new, out, scale_out in ((k, k_out, ks_out),
+                                    (v, v_out, vs_out)):
+            r32 = new.astype(jnp.float32)
+            amax = jnp.max(jnp.abs(r32), axis=-1, keepdims=True)
+            scale = jnp.where(amax == 0, 1.0, amax / 127.0)  # (bs, 1)
+            out[0, :, 0] = jnp.clip(jnp.round(r32 / scale),
+                                    -127, 127).astype(out.dtype)
+            scale_out[0] = scale
+    else:
+        k_out[0, :, 0] = k.astype(k_out.dtype)
+        v_out[0, :, 0] = v.astype(v_out.dtype)
+
+
+def _append_kv(k_new, v_new, pool, tables, meta, interpret: bool):
+    """Write the (batch, T, kv, hd) chunk slabs into the pool blocks
+    named by ``tables`` starting at block ``cached // bs`` — in-kernel,
+    via aliased pool outputs whose index maps resolve the target block
+    from the scalar-prefetched table."""
+    batch, T, kv_heads, head_dim = k_new.shape
+    block_size = pool["k"].shape[1]
+    max_blocks = tables.shape[1]
+    quantized = "ks" in pool
+    chunk_blocks = T // block_size
+    grid = (batch, kv_heads, chunk_blocks)
+
+    def new_index(b, h, cb, tables_ref, meta_ref):
+        return (b, cb, h, 0)
+
+    def pool_index(b, h, cb, tables_ref, meta_ref):
+        # Blocks past the row's real chunk length flush garbage — but
+        # into reserved scratch block 0, exactly like inactive decode
+        # lanes.  The live-block table lookup is clamped so dead steps
+        # never read past the row's allocated entries.
+        live = cb * block_size < meta_ref[b, 1]
+        entry = jnp.minimum(meta_ref[b, 0] // block_size + cb,
+                            max_blocks - 1)
+        return (jnp.where(live, tables_ref[b, entry], 0), 0, h, 0)
+
+    def scale_index(b, h, cb, tables_ref, meta_ref):
+        return pool_index(b, h, cb, tables_ref, meta_ref)[:3]
+
+    kv_spec = pl.BlockSpec((1, block_size, 1, head_dim), new_index)
+    pool_spec = pl.BlockSpec((1, block_size, 1, head_dim), pool_index)
+    scale_spec = pl.BlockSpec((1, block_size, 1), scale_index)
+
+    in_specs = [kv_spec, kv_spec, pool_spec, pool_spec]
+    operands = [k_new, v_new, pool["k"], pool["v"]]
+    out_specs = [pool_spec, pool_spec]
+    out_shape = [jax.ShapeDtypeStruct(pool["k"].shape, pool["k"].dtype),
+                 jax.ShapeDtypeStruct(pool["v"].shape, pool["v"].dtype)]
+    # Aliased pool operands: positions count scalar-prefetch args, so
+    # (tables, meta, k_new, v_new, k, v[, ks, vs]) puts the pools at 4+.
+    aliases = {4: 0, 5: 1}
+    if quantized:
+        in_specs += [scale_spec, scale_spec]
+        operands += [pool["ks"], pool["vs"]]
+        out_specs += [scale_spec, scale_spec]
+        out_shape += [
+            jax.ShapeDtypeStruct(pool["ks"].shape, pool["ks"].dtype),
+            jax.ShapeDtypeStruct(pool["vs"].shape, pool["vs"].dtype)]
+        aliases.update({6: 2, 7: 3})
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2, grid=grid,
+        in_specs=in_specs, out_specs=out_specs)
+    outs = pl.pallas_call(
+        functools.partial(_append_kv_kernel, quantized=quantized),
+        grid_spec=grid_spec,
+        out_shape=out_shape,
+        input_output_aliases=aliases,
+        interpret=interpret,
+    )(tables, meta, *operands)
+    new_pool = {"k": outs[0], "v": outs[1]}
+    if quantized:
+        new_pool["ks"], new_pool["vs"] = outs[2], outs[3]
+    return new_pool
+
+
+# ---------------------------------------------------------------------------
+# The attention kernel: chunk queries over cached prefix + chunk
+
+
+def _prefill_attention_kernel(tables_ref, meta_ref,   # scalar prefetch
+                              q_ref, k_ref, v_ref, *rest,
+                              block_size: int, q_tile: int, group: int,
+                              sm_scale: float, window: Optional[int],
+                              quantized: bool):
+    """Grid: (batch, kv_heads, q_tiles, kv_blocks); kv fastest.
+
+    One program sweeps one (row, kv-head, query-tile) through the
+    row's pool blocks carrying online-softmax state in VMEM scratch.
+    The tile's row axis interleaves queries and their group heads
+    (``row = token·group + head``), so per-row masking by absolute ids
+    covers ragged causality AND the sliding window in one 2D tile."""
+    if quantized:
+        ks_ref, vs_ref, o_ref, m_scr, l_scr, acc_scr = rest
+    else:
+        o_ref, m_scr, l_scr, acc_scr = rest
+    b = pl.program_id(0)
+    qt = pl.program_id(2)
+    j = pl.program_id(3)
+    num_j = pl.num_programs(3)
+    cached = meta_ref[b, 0]
+    q_min = cached + qt * q_tile          # tile's first query position
+    q_max = q_min + q_tile - 1            # tile's last query position
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    # Liveness: a block wholly past the tile's LAST query contributes
+    # nothing; with a sliding window, neither does a block whose last
+    # key is out of even the FIRST query's window.  Dead steps also
+    # clamp their index map (see kv_index) so they trigger no HBM→VMEM
+    # copy.
+    block_live = j * block_size <= q_max
+    if window is not None:
+        block_live &= (j + 1) * block_size - 1 > q_min - window
+
+    @pl.when(block_live)
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32)       # (q_tile*group, hd)
+        k = k_ref[0, :, 0].astype(jnp.float32)    # (bs, hd)
+        v = v_ref[0, :, 0].astype(jnp.float32)
+        if quantized:
+            k = k * ks_ref[0]
+            v = v * vs_ref[0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale
+
+        q_ids = q_min + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, 0) // group
+        key_ids = jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, 1) + j * block_size
+        visible = key_ids <= q_ids
+        if window is not None:
+            visible &= key_ids > q_ids - window
+        s = jnp.where(visible, s, NEG_INF)
+
+        m_prev = m_scr[:]                         # (q_tile*group, 1)
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        # A live block can hold rows with NO visible key (later chunk
+        # rows, or a window that slid past): their m stays NEG_INF and
+        # exp(NEG_INF - NEG_INF) = 1 would be bogus mass — zero masked
+        # probabilities explicitly (the single-query decode kernel's
+        # every-live-block-has-a-visible-key invariant does not extend
+        # to multi-query tiles).
+        p = jnp.where(visible, jnp.exp(s - m_new), 0.0)
+        correction = jnp.exp(m_prev - m_new)
+        l_scr[:] = correction * l_scr[:] + jnp.sum(p, axis=-1,
+                                                   keepdims=True)
+        acc_scr[:] = acc_scr[:] * correction + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_scr[:] = m_new
+
+    @pl.when(j == num_j - 1)
+    def _finish():
+        denom = jnp.where(l_scr[:] == 0.0, 1.0, l_scr[:])
+        o_ref[0, 0] = (acc_scr[:] / denom).astype(o_ref.dtype)
+
+
+def _chunk_attention(q, pool, tables, meta, window: Optional[int],
+                     sm_scale: float, q_tile: int,
+                     kv_blocks: int, interpret: bool):
+    """Dispatch the attention kernel over the (already appended) pool.
+    ``q`` (batch, T, kv, group, hd) → out same shape."""
+    batch, T, kv_heads, group, head_dim = q.shape
+    block_size = pool["k"].shape[1]
+    quantized = "ks" in pool
+    # All group heads of a query stack into the tile row axis: 2D tiles
+    # everywhere in-kernel, one (q_tile*group, hd) x (hd, bs) matmul
+    # per block.
+    q_r = q.transpose(0, 2, 1, 3, 4).reshape(batch, kv_heads,
+                                             T * group, head_dim)
+    grid = (batch, kv_heads, T // q_tile, kv_blocks)
+    rows = q_tile * group
+
+    def q_index(b, h, qt, j, tables_ref, meta_ref):
+        return (b, h, qt, 0)
+
+    def kv_index(b, h, qt, j, tables_ref, meta_ref):
+        # Clamp dead steps into the tile's live band: an unchanged
+        # block index makes Pallas reuse the resident VMEM tile
+        # instead of issuing a fresh HBM copy.
+        cached = meta_ref[b, 0]
+        last = (cached + (qt + 1) * q_tile - 1) // block_size
+        j_c = jnp.minimum(j, last)
+        if window is not None:
+            first_live = jnp.maximum(
+                cached + qt * q_tile - window + 1, 0) // block_size
+            j_c = jnp.maximum(j_c, first_live)
+        return (tables_ref[b, j_c], 0, h, 0)
+
+    def scale_index(b, h, qt, j, tables_ref, meta_ref):
+        return kv_index(b, h, qt, j, tables_ref, meta_ref)[:3]
+
+    in_specs = [
+        pl.BlockSpec((1, 1, rows, head_dim), q_index),
+        pl.BlockSpec((1, block_size, 1, head_dim), kv_index),
+        pl.BlockSpec((1, block_size, 1, head_dim), kv_index),
+    ]
+    operands = [q_r, pool["k"], pool["v"]]
+    if quantized:
+        in_specs += [pl.BlockSpec((1, block_size, 1), scale_index),
+                     pl.BlockSpec((1, block_size, 1), scale_index)]
+        operands += [pool["ks"], pool["vs"]]
+
+    kernel = functools.partial(
+        _prefill_attention_kernel, block_size=block_size,
+        q_tile=q_tile, group=group, sm_scale=sm_scale, window=window,
+        quantized=quantized)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, 1, rows, head_dim), q_index),
+        scratch_shapes=[
+            pltpu.VMEM((rows, 1), jnp.float32),
+            pltpu.VMEM((rows, 1), jnp.float32),
+            pltpu.VMEM((rows, head_dim), jnp.float32),
+        ])
+    out_r = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct(q_r.shape, q.dtype),
+        interpret=interpret,
+    )(tables, meta, *operands)
+    return out_r.reshape(batch, kv_heads, T, group,
+                         head_dim).transpose(0, 2, 1, 3, 4)
+
+
+def paged_prefill_attention(q, k_new, v_new, pool, tables, cached_lens,
+                            chunk_lens, window: Optional[int] = None,
+                            sm_scale: Optional[float] = None,
+                            interpret: bool = False,
+                            q_tile: Optional[int] = None,
+                            kv_limit: Optional[int] = None):
+    """Ragged paged append attention: write the chunk's K/V into the
+    pool in-kernel, then attend the chunk's queries over cached prefix
+    blocks + the causally-visible chunk itself.
+
+    Args:
+      q: ``(batch, T, kv_heads, group, head_dim)`` chunk queries (rope
+        applied), all query heads of each kv head together.
+      k_new / v_new: ``(batch, T, kv_heads, head_dim)`` the chunk's new
+        K/V rows (rope applied to K) — written to the pool at absolute
+        positions ``cached_lens[row] + [0, T)``.
+      pool: per-layer dict ``{"k", "v"[, "ks", "vs"]}`` of
+        ``(n_blocks, block_size, kv_heads, head_dim)`` block pools
+        (int8 layouts quantize in-kernel, absmax per (token, head)).
+      tables: ``(batch, max_blocks)`` int32 block table; entries
+        covering ``[0, cached + T)`` must be allocated.
+      cached_lens: ``(batch,)`` int32 — tokens already in the pool for
+        the row; MUST be a multiple of ``block_size`` (true by
+        construction: shared prefixes are whole blocks, chunk widths
+        are powers of two ≥ the block size).
+      chunk_lens: ``(batch,)`` int32 — real new tokens (≤ T).  Rows at
+        or past a row's chunk length are padding: their K/V lands in
+        scratch-block garbage territory past every real query's
+        visibility, and their output rows are garbage the caller
+        discards.
+      window: sliding-window size (Mistral semantics).
+      sm_scale: score scale (default ``head_dim ** -0.5``).
+      interpret: run the Pallas kernels in interpret mode (CPU tests).
+      q_tile: queries per attention program (default: largest pow2
+        divisor of T, capped at :data:`Q_TILE_CAP`).
+      kv_limit: static bound on the kv-block sweep (e.g. the padded
+        bucket's block count) — trims dead grid steps when the table
+        is much longer than the row can be.
+
+    Returns ``(out (batch, T, kv_heads, group, head_dim) in q.dtype,
+    new_pool)``.  Falls back to :func:`paged_prefill_reference` when
+    Pallas TPU is unavailable (and not interpreting) or the shape is
+    unsupported (``head_dim > 128``, ``T`` not block-aligned).
+    """
+    batch, T, kv_heads, group, head_dim = q.shape
+    block_size = pool["k"].shape[1]
+    max_blocks = tables.shape[1]
+    if sm_scale is None:
+        sm_scale = head_dim ** -0.5
+
+    on_tpu = jax.default_backend() == "tpu"
+    if (not (_PALLAS_TPU and (on_tpu or interpret))
+            or head_dim > 128 or T % block_size != 0):
+        return paged_prefill_reference(q, k_new, v_new, pool, tables,
+                                       cached_lens, chunk_lens,
+                                       window=window)
+
+    tables = tables.astype(jnp.int32)
+    meta = jnp.stack([cached_lens.astype(jnp.int32),
+                      chunk_lens.astype(jnp.int32)], axis=1)
+    if q_tile is None:
+        q_tile = _q_tile_size(T)
+    if T % q_tile:
+        raise ValueError(f"q_tile {q_tile} must divide chunk width {T}")
+    kv_blocks = max_blocks if kv_limit is None else min(kv_limit,
+                                                        max_blocks)
+
+    new_pool = _append_kv(k_new, v_new, pool, tables, meta, interpret)
+    out = _chunk_attention(q, new_pool, tables, meta, window=window,
+                           sm_scale=sm_scale, q_tile=q_tile,
+                           kv_blocks=kv_blocks, interpret=interpret)
+    return out, new_pool

@@ -209,8 +209,13 @@ class ContinuousBatchingServer:
         self._draft = None
         if draft_config_name is not None:
             if self.chunk_prefill_tokens:
-                raise ValueError("speculative serving does not compose "
-                                 "with chunked-prefill admission yet")
+                raise ValueError(
+                    "speculative serving does not compose with "
+                    "chunked-prefill admission yet: chunked prompts "
+                    "admit through mixed prefill/decode steps (see "
+                    "docs/SERVING.md, 'Chunked prefill & mixed "
+                    "steps'), which do not run the draft model — "
+                    "pass chunk_prefill_tokens=0 with a draft")
             if spec_k + 1 > 16:        # the prompt bucket floor
                 raise ValueError(
                     f"spec_k {spec_k} too large: k+1 must be <= the "
@@ -241,7 +246,9 @@ class ContinuousBatchingServer:
         # geometry of the attention view — decided once at init, so
         # bench regressions are attributable to the path taken.
         from ..ops.paged_attention import decode_attention_path
+        from ..ops.paged_prefill import prefill_attention_path
         self.decode_attention_path = decode_attention_path()
+        self.prefill_attention_path = prefill_attention_path()
         self._attn_block_size, self._attn_total_blocks = \
             self._attention_blocks()
         # Bookkeeping state lives HOST-side (numpy): admissions and
@@ -317,7 +324,7 @@ class ContinuousBatchingServer:
             dispatches=0, decode_steps=0, tokens_committed=0,
             host_syncs=0, sync_wait_ms=0.0, sync_elements=0,
             state_uploads=0, max_in_flight=0, admission_deferred=0,
-            decode_blocks_read=0)
+            decode_blocks_read=0, prefill_tokens=0)
         self._serve_started: Optional[float] = None
 
         @jax.jit
@@ -519,16 +526,13 @@ class ContinuousBatchingServer:
                     and prompt_len > self.chunk_prefill_tokens:
                 # Chunked admission: the slot is OCCUPIED (queued
                 # requests cannot take it) but not yet active —
-                # _advance_prefills feeds one chunk per step between
-                # the running slots' decode runs.
+                # chunks are fed one per step between the running
+                # slots' decode runs (standalone _advance_prefills
+                # here; folded into the mixed decode dispatch on the
+                # paged backend).
                 self._requests[slot] = request
-                self._prefilling[slot] = dict(
-                    request=request, prompt_padded=prompt_padded,
-                    prompt_len=prompt_len, start=0,
-                    lora=self._request_lora(request),
-                    bucket=self._llama.init_cache(
-                        self.config, 1, padded,
-                        quantize_kv=self.quantize_kv))
+                self._begin_chunked_prefill(slot, request,
+                                            prompt_padded, prompt_len)
                 continue
             admissions.append((slot, request, prompt_padded, prompt_len))
         if not admissions:
@@ -559,6 +563,21 @@ class ContinuousBatchingServer:
         self._dirty[slot] = True
         self._any_sampled = bool((self._temperatures > 0).any())
 
+    def _begin_chunked_prefill(self, slot: int, request, prompt_padded,
+                               prompt_len: int) -> None:
+        """Layout hook: open a chunked admission for ``slot``.  The
+        contiguous layout prefills into a private batch-1 bucket that
+        :func:`_finish_prefill` seals into the slot cache; the paged
+        server overrides this to append straight into the slot's
+        block chain (no bucket ever exists)."""
+        self._prefilling[slot] = dict(
+            request=request, prompt_padded=prompt_padded,
+            prompt_len=prompt_len, start=0,
+            lora=self._request_lora(request),
+            bucket=self._llama.init_cache(
+                self.config, 1, prompt_padded.shape[1],
+                quantize_kv=self.quantize_kv))
+
     def _advance_prefills(self) -> None:
         """Run ONE prefill chunk for every in-progress chunked
         admission; a slot whose chunks now cover its whole prompt is
@@ -574,6 +593,7 @@ class ContinuousBatchingServer:
                 self.params, jnp.asarray(chunk), state["bucket"],
                 jnp.int32(start), self.config, lora=state["lora"])
             state["start"] = start + size
+            self._note_prefill(size)
             if state["start"] >= state["prompt_len"]:
                 # Rows past prompt_len stay zero-initialized — exactly
                 # as unattendable as the whole-prefill path's
@@ -630,6 +650,7 @@ class ContinuousBatchingServer:
                 slot_rows = jnp.asarray(np.asarray(slots, np.int32))
                 self.cache = self._insert_slots(
                     self.cache, bucket_cache, slot_rows, padded)
+                self._note_prefill(len(sub) * padded)
                 if self._draft is not None:
                     # The draft needs the SAME committed history: its
                     # prompt KV lands in its own slot cache alongside.
@@ -1052,6 +1073,16 @@ class ContinuousBatchingServer:
         self.counters["max_in_flight"] = max(
             self.counters["max_in_flight"], len(self._ring))
 
+    def _note_prefill(self, tokens: int) -> None:
+        """Count prompt tokens dispatched to prefill (any path:
+        whole-bucket, standalone chunk, mixed step).  Prefix-cache
+        hits never reach a prefill dispatch, so this measures work
+        actually done — the gap to raw admitted prompt length IS the
+        cache's savings."""
+        if self._serve_started is None:
+            self._serve_started = time.monotonic()
+        self.counters["prefill_tokens"] += int(tokens)
+
     def _consume_one(self) -> None:
         """Apply the OLDEST in-flight entry's results to host
         bookkeeping: deliver tokens, advance mirrors, retire lanes the
@@ -1119,11 +1150,16 @@ class ContinuousBatchingServer:
             queue_depth=self.queue_depth,
             slots_active=self.slots_active,
             decode_attention_path=self.decode_attention_path,
+            prefill_attention_path=self.prefill_attention_path,
             blocks_read_per_step=(
                 round(self.counters["decode_blocks_read"] / steps, 2)
                 if steps else 0.0),
             decode_steps_per_sec=(
                 round(steps / elapsed, 1) if elapsed > 0 else 0.0),
+            prefill_tokens_per_sec=(
+                round(self.counters["prefill_tokens"] / elapsed, 1)
+                if elapsed > 0 else 0.0),
+            prefill_queue_depth=len(self._prefilling),
             sync_stalls_per_100_steps=(
                 round(100.0 * self.counters["host_syncs"] / steps, 2)
                 if steps else 0.0))
@@ -1229,6 +1265,12 @@ class ContinuousReplica(Actor):
         if self._ttft_window:
             updates["ttft_p50_ms"] = round(
                 statistics.median(self._ttft_window) * 1e3, 1)
+            # Same nearest-rank convention as LoadReport._quantile —
+            # p95 is the admission-stall number SLOs watch (p50 hides
+            # a prefill convoy behind the median).
+            ordered = sorted(self._ttft_window)
+            index = min(len(ordered) - 1, int(0.95 * len(ordered)))
+            updates["ttft_p95_ms"] = round(ordered[index] * 1e3, 1)
         if self._total_window:
             updates["total_p50_ms"] = round(
                 statistics.median(self._total_window) * 1e3, 1)

@@ -53,6 +53,14 @@ class PagedContinuousServer(ContinuousBatchingServer):
     where paging admits the same worst case in half the HBM.
     """
 
+    #: Default chunked-prefill slice width (tokens).  Chunked admission
+    #: is the paged backend's DEFAULT mode: prompts longer than this
+    #: admit through mixed prefill/decode steps (one append-attention
+    #: slice folded into each decode dispatch) instead of stalling the
+    #: batch for their whole prefill.  Pass ``chunk_prefill_tokens=0``
+    #: to restore whole-bucket admission.
+    DEFAULT_CHUNK_PREFILL_TOKENS = 256
+
     def __init__(self, config_name: str = "tiny", slots: int = 4,
                  max_seq: Optional[int] = None, chunk_steps: int = 8,
                  quantize: bool = False, eos_id: Optional[int] = None,
@@ -61,16 +69,20 @@ class PagedContinuousServer(ContinuousBatchingServer):
                  total_blocks: Optional[int] = None,
                  enable_prefix_cache: bool = False,
                  lookahead: int = 1, adapters=None, lora_config=None,
-                 params=None):
+                 params=None,
+                 chunk_prefill_tokens: Optional[int] = None):
         self.block_size = block_size
         self._requested_blocks = total_blocks
         self.enable_prefix_cache = enable_prefix_cache
+        if chunk_prefill_tokens is None:
+            chunk_prefill_tokens = self.DEFAULT_CHUNK_PREFILL_TOKENS
         super().__init__(config_name=config_name, slots=slots,
                          max_seq=max_seq, chunk_steps=chunk_steps,
                          quantize=quantize, eos_id=eos_id, seed=seed,
                          quantize_kv=quantize_kv, lookahead=lookahead,
                          adapters=adapters, lora_config=lora_config,
-                         params=params)
+                         params=params,
+                         chunk_prefill_tokens=chunk_prefill_tokens)
 
     # ------------------------------------------------------------- #
     # Layout hooks
@@ -90,6 +102,14 @@ class PagedContinuousServer(ContinuousBatchingServer):
             raise ValueError(
                 f"block_size {block_size} must divide the prompt "
                 f"bucket floor {self._bucket_minimum}")
+        # Chunked-prefill slices append straight into block chains, so
+        # every slice boundary must land on a block boundary (the
+        # append kernel's cached_len is block-aligned by construction).
+        if self.chunk_prefill_tokens % block_size:
+            raise ValueError(
+                f"chunk_prefill_tokens {self.chunk_prefill_tokens} "
+                f"must be a multiple of block_size {block_size} on "
+                "the paged backend (slices land on block boundaries)")
         max_blocks = self.max_seq // block_size
         if self._requested_blocks is None:
             usable = max(max_blocks,
@@ -122,6 +142,12 @@ class PagedContinuousServer(ContinuousBatchingServer):
         self._parent: dict = {}
         self._children: dict = {}
         self._pending_shared: List[int] = [0] * self.slots
+        #: block -> slot whose chunked prefill has not yet written the
+        #: block's content.  The prefix-cache hit walk treats these as
+        #: misses: their keys are registered (so no duplicate block is
+        #: indexed) but the KV only lands slice by slice over the next
+        #: steps.  Cleared at _finish_prefill; purged on cancel.
+        self._producing: dict = {}
         self.prefix_hits = 0
         self.prefix_misses = 0
         self.prefix_blocks_reused = 0
@@ -267,15 +293,20 @@ class PagedContinuousServer(ContinuousBatchingServer):
                 :self._shareable_blocks(len(prompt))]
             for key in keys:
                 block = self._index.get(key)
-                if block is None:
+                if block is None or block in self._producing:
+                    # In-flight chunked prefills register their keys
+                    # at reservation but write content slice by slice
+                    # — sharing before the content lands would read
+                    # zeros.  Treated as a miss; shareable again once
+                    # the producer finishes.
                     break
                 shared.append(block)
-            # Every found block is used: _prefill_bucket bounds the
-            # compile count by DECOMPOSING the gather and the tail
-            # prefill into descending power-of-two pieces, so arbitrary
-            # prefix lengths reuse log-many program shapes instead of
-            # being rounded down (the old pow2 truncation threw away up
-            # to half the hit — the BENCH_r05 low-hit-rate culprit).
+            # Every found block is used: _append_prefill bounds the
+            # compile count by DECOMPOSING the uncached tail into
+            # descending power-of-two pieces, so arbitrary prefix
+            # lengths reuse log-many program shapes instead of being
+            # rounded down (the old pow2 truncation threw away up to
+            # half the hit — the BENCH_r05 low-hit-rate culprit).
         # PIN the hits before any eviction (eviction must never free a
         # block we are about to reference), with rollback on deferral.
         # Snapshot the LRU order first: a deferred request never ran,
@@ -314,14 +345,14 @@ class PagedContinuousServer(ContinuousBatchingServer):
             self.prefix_misses += 1
         # Register this prompt's remaining shareable blocks for future
         # requests.  ORDER DEPENDENCE: within one admission wave every
-        # _reserve_slot runs before any prefill/insert, so a later
-        # request in the wave may pin keys registered here while the
-        # blocks still hold garbage — safe ONLY because
-        # _prefill_and_insert walks the wave in the same admission
-        # order, scattering this request's contents before a later
-        # request's gather.  Keys already indexed are SKIPPED
-        # (defensive: an overwrite would strand the old block in
-        # _evictable under a reused key — a permanent leak).
+        # _reserve_slot runs before any prefill, so a later request in
+        # the wave may pin keys registered here while the blocks still
+        # hold garbage — safe ONLY because _prefill_and_insert runs
+        # producers before their dependents (same-wave shared-prefix
+        # overlaps keep admission order; disjoint chains carry no
+        # ordering).  Keys already indexed are SKIPPED (defensive: an
+        # overwrite would strand the old block in _evictable under a
+        # reused key — a permanent leak).
         if self.enable_prefix_cache:
             for position in range(len(shared), len(keys)):
                 key = keys[position]
@@ -354,83 +385,170 @@ class PagedContinuousServer(ContinuousBatchingServer):
                 self._purge_cached(key, block)
 
     def _prefill_and_insert(self, admissions) -> None:
-        """Paged admissions stay per-slot: each request's prefix-cache
-        walk (shared blocks gathered, only the uncached tail
-        prefilled) is its own gather/prefill/scatter chain, so there
-        is no common batched shape to group into.
+        """Append-attention admission: each request's chunk K/V lands
+        straight in its own blocks and shared prefix blocks are only
+        READ in place — there is no bucket cache, no pool gather and
+        no scatter-back (asserted by the jaxpr guard in
+        tests/test_paged_prefill.py).
 
-        MUST iterate in admission order: _reserve_slot already
-        registered each request's shareable block keys, and a later
-        request in this wave may have pinned an earlier one's blocks —
-        the earlier scatter has to land before the later gather reads
-        those blocks (see the ORDER DEPENDENCE note in
-        _reserve_slot).  The invariant is regression-locked by
+        Ordering matters ONLY where a request's shared prefix contains
+        blocks another admission in this same wave is about to write
+        (registered in _reserve_slot, prefilled here): disjoint block
+        chains run first in any order, dependent ones follow in
+        admission order — producer before reader, asserted.  The
+        invariant is regression-locked by
         test_prefix_cache_concurrent_slots_share_blocks (same-wave
-        share, exact-output assertion): reordering this walk makes
-        that test read garbage KV and fail."""
-        for slot, request, prompt_padded, prompt_len in admissions:
-            bucket_cache = self._prefill_bucket(
-                slot, prompt_padded, prompt_len,
-                lora=self._request_lora(request))
-            self._insert_prefix(slot, bucket_cache,
-                                prompt_padded.shape[1])
+        share, exact-output assertion)."""
+        produced = {}       # block -> wave index that writes it here
+        plans = []
+        for index, (slot, request, prompt_padded, prompt_len) \
+                in enumerate(admissions):
+            n_shared = self._pending_shared[slot]
+            n_total = prompt_padded.shape[1] // self.block_size
+            for block in self._owned[slot][n_shared:n_total]:
+                produced[block] = index
+            plans.append((slot, request, prompt_padded, n_shared))
+        independent, dependent = [], []
+        for index, plan in enumerate(plans):
+            slot, _, _, n_shared = plan
+            deps = {produced[block]
+                    for block in self._owned[slot][:n_shared]
+                    if block in produced and produced[block] != index}
+            (dependent if deps else independent).append(
+                (index, plan, deps))
+        ran = set()
+        for index, plan, _ in independent:
+            self._append_prefill(*plan)
+            ran.add(index)
+        for index, plan, deps in dependent:   # admission order kept
+            assert deps <= ran, (
+                "shared-prefix overlap requires the producing "
+                f"admission {sorted(deps - ran)} to prefill before "
+                f"wave index {index}")
+            self._append_prefill(*plan)
+            ran.add(index)
 
-    def _prefill_bucket(self, slot: int, prompt_padded,
-                        prompt_len: int, lora=None):
-        n_shared = self._pending_shared[slot]
-        if not n_shared:
-            return super()._prefill_bucket(slot, prompt_padded,
-                                           prompt_len, lora=lora)
-        # Prefix hit: materialize the shared blocks into the bucket and
-        # chunk-prefill ONLY the uncached tail (the whole point — the
-        # prefill FLOPs for the shared prefix are skipped).  The
-        # shared blocks were built under the SAME adapter (chain keys
-        # are adapter-seeded), and the tail runs it too.
+    def _append_prefill(self, slot: int, request, prompt_padded,
+                        n_shared: int) -> None:
+        """Prefill one admitted prompt by appending into its block
+        chain, starting PAST the shared prefix (its blocks are read by
+        the kernel's attention sweep, never copied).  The uncached
+        tail runs as descending power-of-two pieces so arbitrary
+        prefix lengths reuse log-many program shapes per bucket."""
         llama, jnp = self._llama, self._jnp
+        self._pending_shared[slot] = 0
+        block_size = self.block_size
         padded = prompt_padded.shape[1]
-        bucket = llama.init_cache(self.config, 1, padded,
-                                  quantize_kv=self.quantize_kv)
-        # Both the gather and the uncached-tail prefill run as
-        # descending power-of-two pieces: program shapes depend only on
-        # the piece size, so an arbitrary prefix length compiles
-        # log-many programs per prompt bucket while reusing EVERY
-        # cached block (no pow2 truncation of the hit).
-        shared_blocks = self._owned[slot][:n_shared]
-        done = 0
-        while done < n_shared:
-            size = 1 << ((n_shared - done).bit_length() - 1)
-            ids = jnp.asarray(shared_blocks[done:done + size],
-                              jnp.int32)
-            bucket = llama.paged_gather_blocks(self.pool, ids, bucket,
-                                               jnp.int32(done))
-            done += size
-        start = n_shared * self.block_size
-        remaining = padded // self.block_size - n_shared
+        kv_limit = padded // block_size
+        tables_row = jnp.asarray(self.tables[slot:slot + 1])
+        lora = self._request_lora(request)
+        start = n_shared * block_size
+        remaining = kv_limit - n_shared
         while remaining > 0:
             size = 1 << (remaining.bit_length() - 1)
-            width = size * self.block_size
+            width = size * block_size
             chunk = prompt_padded[:, start:start + width]
-            _, bucket = llama.prefill_chunk(
-                self.params, jnp.asarray(chunk), bucket,
-                jnp.int32(start), self.config, lora=lora)
+            _, self.pool = llama.prefill_append_paged(
+                self.params, jnp.asarray(chunk), self.pool,
+                tables_row, jnp.int32(start), self.config, lora=lora,
+                kv_limit=kv_limit, compute_logits=False)
+            self._note_prefill(width)
             start += width
             remaining -= size
-        return bucket
 
-    def _insert_prefix(self, slot: int, bucket_cache, padded: int):
-        jnp = self._jnp
+    # ------------------------------------------------------------- #
+    # Chunked prefill: mixed prefill/decode steps
+
+    def _begin_chunked_prefill(self, slot: int, request, prompt_padded,
+                               prompt_len: int) -> None:
+        """Chunked admission appends straight into the slot's block
+        chain — no bucket ever exists, and a prefix-cache hit skips
+        its shared blocks entirely (the first slice starts past
+        them).  Blocks this slot will produce are marked in-flight so
+        later admissions' hit walks treat them as misses until the
+        content lands."""
         n_shared = self._pending_shared[slot]
         self._pending_shared[slot] = 0
-        n_total = padded // self.block_size
-        # Scatter only the PRIVATE tail blocks; shared prefix blocks
-        # are read-only to this request.
-        tail_ids = self._owned[slot][n_shared:n_total]
-        self.pool = self._llama.paged_scatter_blocks(
-            self.pool, jnp.asarray(tail_ids, jnp.int32), bucket_cache,
-            jnp.int32(n_shared))
+        n_total = prompt_padded.shape[1] // self.block_size
+        for block in self._owned[slot][n_shared:n_total]:
+            if block in self._block_key:
+                self._producing[block] = slot
+        # The adapter id must be resident BEFORE the first mixed
+        # dispatch: serve_chunk_mixed slices the prefilling row's id
+        # out of the device state.  The slot is decode-inactive, so
+        # the early id is otherwise inert.
+        self._adapter_ids[slot] = self._adapter_id(request)
+        self._dirty[slot] = True
+        self._prefilling[slot] = dict(
+            request=request, prompt_padded=prompt_padded,
+            prompt_len=prompt_len, start=n_shared * self.block_size,
+            kv_limit=prompt_padded.shape[1] // self.block_size)
+
+    def _next_slice_width(self, prefill) -> int:
+        """Next chunked-prefill slice: the largest power-of-two block
+        count that fits both the remaining prompt and the configured
+        chunk width.  Pow2 slices keep the compile-shape count GLOBAL
+        (log2(chunk/block) widths total) — ``min(chunk, remaining)``
+        would mint one program per distinct prefix-hit offset."""
+        block_size = self.block_size
+        remaining = (prefill["prompt_padded"].shape[1]
+                     - prefill["start"]) // block_size
+        cap = self.chunk_prefill_tokens // block_size
+        return min(cap, 1 << (remaining.bit_length() - 1)) * block_size
+
+    def _advance_prefills(self) -> None:
+        """With live decode work, chunked prefills ride the MIXED
+        dispatch (one slice per chunk, inside the same jitted program
+        as decode) — standalone advance here would double-prefill.
+        Only when no decode can be scheduled do slices run standalone,
+        one per prefilling slot per step."""
+        if not self._prefilling:
+            return
+        if (self._plan_remaining() > 0).any():
+            return
+        llama, jnp = self._llama, self._jnp
+        for slot in list(self._prefilling):
+            state = self._prefilling[slot]
+            start = state["start"]
+            width = self._next_slice_width(state)
+            chunk = state["prompt_padded"][:, start:start + width]
+            tables_row = jnp.asarray(self.tables[slot:slot + 1])
+            _, self.pool = llama.prefill_append_paged(
+                self.params, jnp.asarray(chunk), self.pool,
+                tables_row, jnp.int32(start), self.config,
+                lora=self._request_lora(state["request"]),
+                kv_limit=state["kv_limit"], compute_logits=False)
+            state["start"] = start + width
+            self._note_prefill(width)
+            if state["start"] >= state["prompt_len"]:
+                self._finish_prefill(slot, state)
+
+    def _finish_prefill(self, slot: int, state) -> None:
+        # The chain's content is complete: its blocks become shareable
+        # by future admissions.  No bucket to seal (contrast the base
+        # class) — activation alone flips the lane to decode.
+        for block, owner in list(self._producing.items()):
+            if owner == slot:
+                del self._producing[block]
+        del self._prefilling[slot]
+        self._activate_slot(slot, state["request"],
+                            state["prompt_padded"],
+                            state["prompt_len"])
 
     def _release_slot(self, slot: int) -> None:
         for block in self._owned[slot]:
+            if self._producing.pop(block, None) == slot:
+                # Cancelled mid-prefill: the block's registered key
+                # points at content that never fully landed — purge it
+                # from the index (purge also returns it to the free
+                # list).  Only this slot can hold a ref (the hit walk
+                # skips producing blocks).
+                key = self._block_key.get(block)
+                if key is not None:
+                    self._purge_cached(key, block)
+                else:
+                    self._free.append(block)
+                continue
             key = self._block_key.get(block)
             if key is None:
                 self._free.append(block)        # plain private block
@@ -446,9 +564,34 @@ class PagedContinuousServer(ContinuousBatchingServer):
 
     def _serve_chunk(self, state, steps: int, eos_id: int,
                      sampled: bool, rng_key, lora_shared):
+        """Decode dispatch — MIXED when a chunked admission is in
+        flight: the oldest prefilling slot's next slice and the decode
+        chunk run as ONE jitted program
+        (:func:`~..models.llama.serve_chunk_mixed`), so admission no
+        longer stalls the running batch between chunks."""
+        llama, jnp = self._llama, self._jnp
+        slot = next(iter(self._prefilling), None) \
+            if self._prefilling else None
+        if slot is None:
+            tokens_d, counts_d, new_state, self.pool = \
+                llama.serve_chunk_paged(
+                    self.params, state, self.pool, steps, self.config,
+                    eos_id=eos_id, sampled=sampled, rng_key=rng_key,
+                    lora_shared=lora_shared)
+            return tokens_d, counts_d, new_state
+        prefill = self._prefilling[slot]
+        start = prefill["start"]
+        width = self._next_slice_width(prefill)
+        chunk = prefill["prompt_padded"][:, start:start + width]
         tokens_d, counts_d, new_state, self.pool = \
-            self._llama.serve_chunk_paged(
-                self.params, state, self.pool, steps, self.config,
+            llama.serve_chunk_mixed(
+                self.params, state, self.pool, jnp.asarray(chunk),
+                jnp.int32(slot), jnp.int32(start), steps, self.config,
                 eos_id=eos_id, sampled=sampled, rng_key=rng_key,
-                lora_shared=lora_shared)
+                lora_shared=lora_shared,
+                prefill_kv_limit=prefill["kv_limit"])
+        prefill["start"] = start + width
+        self._note_prefill(width)
+        if prefill["start"] >= prefill["prompt_len"]:
+            self._finish_prefill(slot, prefill)
         return tokens_d, counts_d, new_state

@@ -51,6 +51,8 @@ TELEMETRY_KEYS = (
     "admission_deferred", "state_uploads", "tokens_committed",
     "prefix_hits", "prefix_misses", "prefix_evictions",
     "decode_attention_path", "blocks_read_per_step",
+    "prefill_tokens_per_sec", "prefill_queue_depth",
+    "prefill_attention_path",
 )
 
 

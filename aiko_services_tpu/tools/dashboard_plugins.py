@@ -166,6 +166,18 @@ def model_replica_plugin(fields, variables) -> List[str]:
                 f"  attn:      {attn_path} path, "
                 f"{_get(variables, 'blocks_read_per_step', default=0)}"
                 f" blocks/step")
+        prefill_tps = _get(variables, "prefill_tokens_per_sec",
+                           default=None)
+        if prefill_tps not in (None, "-"):
+            lines.append(
+                f"  prefill:   {prefill_tps} tok/s, "
+                f"{_get(variables, 'prefill_queue_depth', default=0)}"
+                f" chunking"
+                + (f" ({_get(variables, 'prefill_attention_path')}"
+                   f" path)"
+                   if _get(variables, "prefill_attention_path",
+                           default=None) not in (None, "-", "")
+                   else ""))
         hits = _get(variables, "prefix_hits", default=None)
         if hits not in (None, "-"):
             lines.append(
@@ -177,10 +189,13 @@ def model_replica_plugin(fields, variables) -> List[str]:
     if adapters not in (None, "-", ""):
         lines.append(f"  adapters:  {adapters}")
     ttft = _get(variables, "ttft_p50_ms", default=None)
+    ttft95 = _get(variables, "ttft_p95_ms", default=None)
     total = _get(variables, "total_p50_ms", default=None)
-    if any(value not in (None, "-", "") for value in (ttft, total)):
-        lines.append(f"  latency:   p50 ttft {ttft or '?'} ms, "
-                     f"total {total or '?'} ms")
+    if any(value not in (None, "-", "")
+           for value in (ttft, ttft95, total)):
+        lines.append(f"  latency:   ttft p50 {ttft or '?'}"
+                     f"/p95 {ttft95 or '?'} ms, "
+                     f"total p50 {total or '?'} ms")
     return lines
 
 

@@ -40,6 +40,10 @@ class LoadReport:
     elapsed_s: float
     latencies_ms: List[float]
     tokens_total: int = 0
+    #: Server-reported time-to-first-token per completed request (the
+    #: replica stamps ``ttft_ms`` on the wire response) — what SLOs
+    #: watch; wire p50/p99 above includes full generation time.
+    ttfts_ms: List[float] = dataclasses.field(default_factory=list)
     #: Optional server-side counters snapshot (``server.stats()`` or
     #: :func:`~..orchestration.serving.serving_telemetry` payload)
     #: attached by the harness after the run — ties the wire-level
@@ -57,10 +61,11 @@ class LoadReport:
         return (self.tokens_total / self.elapsed_s
                 if self.elapsed_s else 0.0)
 
-    def _quantile(self, q: float) -> float:
-        if not self.latencies_ms:
+    @staticmethod
+    def _quantile(values: List[float], q: float) -> float:
+        if not values:
             return 0.0
-        ordered = sorted(self.latencies_ms)
+        ordered = sorted(values)
         index = min(len(ordered) - 1, int(q * len(ordered)))
         return ordered[index]
 
@@ -71,7 +76,16 @@ class LoadReport:
 
     @property
     def p99_ms(self) -> float:
-        return self._quantile(0.99)
+        return self._quantile(self.latencies_ms, 0.99)
+
+    @property
+    def ttft_p50_ms(self) -> float:
+        return (statistics.median(self.ttfts_ms)
+                if self.ttfts_ms else 0.0)
+
+    @property
+    def ttft_p95_ms(self) -> float:
+        return self._quantile(self.ttfts_ms, 0.95)
 
     def __repr__(self):
         attn = ""
@@ -80,12 +94,15 @@ class LoadReport:
             attn = (f", attn={self.server_stats['decode_attention_path']}"
                     f"/{self.server_stats.get('blocks_read_per_step', 0)}"
                     f" blk/step")
+        ttft = (f", ttft_p50={self.ttft_p50_ms:.1f}/"
+                f"p95={self.ttft_p95_ms:.1f} ms"
+                if self.ttfts_ms else "")
         return (f"LoadReport(sent={self.sent}, done={self.completed}, "
                 f"errors={self.errors}, timeouts={self.timeouts}, "
                 f"{self.throughput_rps:.1f} req/s, "
                 f"{self.throughput_tps:.1f} tok/s, "
                 f"p50={self.p50_ms:.1f} ms, p99={self.p99_ms:.1f} ms"
-                f"{attn})")
+                f"{ttft}{attn})")
 
 
 class LoadGenerator:
@@ -105,6 +122,7 @@ class LoadGenerator:
         self._sleep = sleep or time.sleep
         self._sent_at: Dict[str, float] = {}
         self._latencies: List[float] = []
+        self._ttfts: List[float] = []
         self._errors = 0
         self._tokens = 0
         self._run_index = 0
@@ -131,6 +149,11 @@ class LoadGenerator:
             self._errors += 1
         else:
             self._latencies.append((self._clock() - started) * 1e3)
+            if isinstance(outputs, dict) and "ttft_ms" in outputs:
+                try:
+                    self._ttfts.append(float(str(outputs["ttft_ms"])))
+                except (TypeError, ValueError):
+                    pass
             if isinstance(outputs, dict) and "tokens_out" in outputs:
                 try:
                     from ..pipeline.codec import decode_value
@@ -150,6 +173,7 @@ class LoadGenerator:
         # run-2 request.
         self._sent_at.clear()
         self._latencies = []
+        self._ttfts = []
         self._errors = 0
         self._tokens = 0
         self._run_index += 1
@@ -183,7 +207,8 @@ class LoadGenerator:
                           timeouts=len(self._sent_at),
                           elapsed_s=elapsed,
                           latencies_ms=list(self._latencies),
-                          tokens_total=self._tokens)
+                          tokens_total=self._tokens,
+                          ttfts_ms=list(self._ttfts))
 
 
 def service_scale_sweep(services: int, broker: str = "scale-sweep",

@@ -590,7 +590,9 @@ def _serving_head_to_head(server, label, slots, prompt_len, max_new,
     submits ``slots + slots//2`` requests so both the full first
     admission wave AND the smaller readmission sub-batch prefill
     programs compile before anything is timed.  Returns
-    ``(tps, tps_la1, ttft_p50_seconds_or_None)``."""
+    ``(tps, tps_la1, ttft_p50_s_or_None, ttft_p95_s_or_None)`` —
+    both TTFT tails from the timed lookahead=N run (nearest-rank p95,
+    the LoadReport/replica-telemetry convention)."""
     from aiko_services_tpu.orchestration.continuous import DecodeRequest
 
     rng = np.random.default_rng(0)
@@ -617,24 +619,29 @@ def _serving_head_to_head(server, label, slots, prompt_len, max_new,
         ttfts = sorted(r.first_token_ts - r.submitted_ts for r in done
                        if r.first_token_ts and r.submitted_ts)
         ttft_p50 = ttfts[len(ttfts) // 2] if ttfts else None
-        return total_tokens / elapsed, total_tokens, elapsed, ttft_p50
+        ttft_p95 = (ttfts[min(len(ttfts) - 1,
+                              int(0.95 * len(ttfts)))]
+                    if ttfts else None)
+        return (total_tokens / elapsed, total_tokens, elapsed,
+                ttft_p50, ttft_p95)
 
     server.lookahead = 1
     log(f"serving[{label}] timed lookahead=1: {n_requests} reqs x "
         f"{max_new} tokens through {slots} slots...")
-    tps_la1, total_tokens, elapsed, _ = timed("s")
+    tps_la1, total_tokens, elapsed, _, _ = timed("s")
     log(f"serving[{label}] lookahead=1: {tps_la1:.0f} tok/s/chip "
         f"({total_tokens} tokens, {elapsed:.2f}s)")
     server.lookahead = lookahead
     log(f"serving[{label}] timed lookahead={lookahead}...")
-    tps, total_tokens, elapsed, ttft_p50 = timed("r")
+    tps, total_tokens, elapsed, ttft_p50, ttft_p95 = timed("r")
     log(f"serving[{label}]: {tps:.0f} tokens/sec/chip sustained "
         f"({n_requests} reqs, {total_tokens} tokens, {elapsed:.2f}s; "
         f"multi-step scheduling {tps / max(tps_la1, 1e-9):.2f}x the "
         f"sync-every-chunk run; TTFT p50 "
-        f"{ttft_p50 * 1e3 if ttft_p50 else -1:.0f} ms incl. queue "
+        f"{ttft_p50 * 1e3 if ttft_p50 else -1:.0f}/p95 "
+        f"{ttft_p95 * 1e3 if ttft_p95 else -1:.0f} ms incl. queue "
         "wait under staggered admission)")
-    return tps, tps_la1, ttft_p50
+    return tps, tps_la1, ttft_p50, ttft_p95
 
 
 def bench_serving_continuous(slots=8, prompt_len=64, max_new=64,
@@ -654,7 +661,7 @@ def bench_serving_continuous(slots=8, prompt_len=64, max_new=64,
         config_name=config_name, slots=slots,
         max_seq=_bucket(prompt_len) + max_new + chunk_steps,
         chunk_steps=chunk_steps, quantize=True, lookahead=lookahead)
-    tps, tps_la1, _ = _serving_head_to_head(
+    tps, tps_la1, ttft_p50, ttft_p95 = _serving_head_to_head(
         server, "continuous", slots, prompt_len, max_new, n_requests,
         lookahead)
     stats = server.stats()
@@ -662,13 +669,17 @@ def bench_serving_continuous(slots=8, prompt_len=64, max_new=64,
         f"{stats['sync_stalls_per_100_steps']} host syncs/100 steps, "
         f"{stats['state_uploads']} state uploads, "
         f"{stats['admission_deferred']} deferred admissions")
-    return {"serving_continuous_tokens_per_sec_chip": round(tps),
-            "serving_continuous_lookahead1_tokens_per_sec_chip":
-                round(tps_la1),
-            "serving_continuous_sync_stalls_per_100_steps":
-                stats["sync_stalls_per_100_steps"],
-            "serving_continuous_state_uploads":
-                int(stats["state_uploads"])}
+    out = {"serving_continuous_tokens_per_sec_chip": round(tps),
+           "serving_continuous_lookahead1_tokens_per_sec_chip":
+               round(tps_la1),
+           "serving_continuous_sync_stalls_per_100_steps":
+               stats["sync_stalls_per_100_steps"],
+           "serving_continuous_state_uploads":
+               int(stats["state_uploads"])}
+    if ttft_p50 is not None:
+        out["serving_continuous_ttft_p50_ms"] = round(ttft_p50 * 1e3, 1)
+        out["serving_continuous_ttft_p95_ms"] = round(ttft_p95 * 1e3, 1)
+    return out
 
 
 def bench_serving_8b(paged=False, slots=16, prompt_len=128,
@@ -716,7 +727,7 @@ def bench_serving_8b(paged=False, slots=16, prompt_len=128,
             total_blocks=slots * (max_seq // block_size), **common)
     else:
         server = ContinuousBatchingServer(max_seq=max_seq, **common)
-    tps, tps_la1, ttft_p50 = _serving_head_to_head(
+    tps, tps_la1, ttft_p50, ttft_p95 = _serving_head_to_head(
         server, f"8b_{kind}", slots, prompt_len, max_new, n_requests,
         lookahead)
     out = {f"serving_8b_{kind}_tokens_per_sec_chip": round(tps),
@@ -725,6 +736,7 @@ def bench_serving_8b(paged=False, slots=16, prompt_len=128,
            f"serving_8b_{kind}_slots": slots}
     if ttft_p50 is not None:
         out[f"serving_8b_{kind}_ttft_p50_ms"] = round(ttft_p50 * 1e3, 1)
+        out[f"serving_8b_{kind}_ttft_p95_ms"] = round(ttft_p95 * 1e3, 1)
     return out
 
 
@@ -1089,9 +1101,11 @@ def bench_serving_paged(slots=8, prompt_len=64, max_new=64,
     started = time.perf_counter()
     finished = server.run_until_drained()
     elapsed = time.perf_counter() - started
-    total_tokens = sum(len(r.tokens) for r in finished
-                       if r.error is None)
+    done = [r for r in finished if r.error is None]
+    total_tokens = sum(len(r.tokens) for r in done)
     tps = total_tokens / elapsed
+    ttfts = sorted(r.first_token_ts - r.submitted_ts for r in done
+                   if r.first_token_ts and r.submitted_ts)
     stats = server.stats()
     log(f"serving[paged]: {tps:.0f} tokens/sec/chip sustained "
         f"({n_requests} reqs, prefix hits {server.prefix_hits}/"
@@ -1099,14 +1113,25 @@ def bench_serving_paged(slots=8, prompt_len=64, max_new=64,
         f"blocks reused {server.prefix_blocks_reused}, "
         f"evictions {server.prefix_evictions}; "
         f"{stats['sync_stalls_per_100_steps']} host syncs/100 steps, "
-        f"{stats['state_uploads']} state uploads)")
-    return {"serving_paged_tokens_per_sec_chip": round(tps),
-            "serving_paged_prefix_hits": int(server.prefix_hits),
-            "serving_paged_prefix_misses": int(server.prefix_misses),
-            "serving_paged_prefix_evictions":
-                int(server.prefix_evictions),
-            "serving_paged_sync_stalls_per_100_steps":
-                stats["sync_stalls_per_100_steps"]}
+        f"{stats['state_uploads']} state uploads; prefill "
+        f"{stats['prefill_tokens_per_sec']} tok/s "
+        f"{stats['prefill_attention_path']} path)")
+    out = {"serving_paged_tokens_per_sec_chip": round(tps),
+           "serving_paged_prefix_hits": int(server.prefix_hits),
+           "serving_paged_prefix_misses": int(server.prefix_misses),
+           "serving_paged_prefix_evictions":
+               int(server.prefix_evictions),
+           "serving_paged_sync_stalls_per_100_steps":
+               stats["sync_stalls_per_100_steps"],
+           "serving_paged_prefill_tokens_per_sec":
+               stats["prefill_tokens_per_sec"]}
+    if ttfts:
+        out["serving_paged_ttft_p50_ms"] = round(
+            ttfts[len(ttfts) // 2] * 1e3, 1)
+        out["serving_paged_ttft_p95_ms"] = round(
+            ttfts[min(len(ttfts) - 1, int(0.95 * len(ttfts)))] * 1e3,
+            1)
+    return out
 
 
 def bench_sexpr_codec(n_messages=20_000):
@@ -1325,6 +1350,146 @@ def bench_decode_attention(lengths=(128, 1024, 8192), batch=8,
     return results
 
 
+def bench_prefill_attention(lengths=(512, 2048, 8192), kv_heads=8,
+                            group=4, head_dim=128, block_size=64,
+                            iters=5):
+    """Append-attention admission microbench (ops/paged_prefill.py):
+    the in-place append kernel vs the gather+scatter oracle
+    (``paged_prefill_reference`` — scatter the chunk KV, gather the
+    WHOLE block table as a contiguous view, masked attend: the traffic
+    shape of the old bucket admission), per ADMITTED PROMPT at each
+    prompt length, bf16 and int8 KV.  Half of every prompt is already
+    cached (the prefix-hit case the append path optimizes: the kernel
+    READS those blocks in place, the old path copied them out and
+    back).
+
+    HBM bytes per admitted prompt are analytic (leading-order KV
+    traffic; activations identical on both paths and omitted):
+
+    * append: write the chunk (T rows) + the attention sweep's reads —
+      ``ceil(T/q_tile)`` passes over the cached prefix plus half the
+      chunk (causal average).
+    * gather+scatter: the same attention reads, plus gather the cached
+      prefix out (read+write), write the chunk into the bucket, and
+      scatter the WHOLE prompt back (read+write L rows).
+
+    Off-TPU the oracle is timed at the smallest length only (CPU flash
+    at 8k would eat the section budget) and the kernel is
+    parity-checked in interpret mode there; bytes are reported for
+    every length either way."""
+    import jax
+    import jax.numpy as jnp
+    from aiko_services_tpu.ops import paged_prefill as pp
+
+    on_tpu = jax.default_backend() == "tpu"
+    max_len = max(lengths)
+    n_blocks = max_len // block_size + 1
+    rng = jax.random.PRNGKey(3)
+    keys = jax.random.split(rng, 4)
+    pool_f = dict(
+        k=jax.random.normal(
+            keys[0], (n_blocks, block_size, kv_heads, head_dim),
+            jnp.bfloat16),
+        v=jax.random.normal(
+            keys[1], (n_blocks, block_size, kv_heads, head_dim),
+            jnp.bfloat16))
+
+    def quantize(rows):
+        r32 = rows.astype(jnp.float32)
+        amax = jnp.max(jnp.abs(r32), axis=-1)
+        scale = jnp.where(amax == 0, 1.0, amax / 127.0)
+        qi = jnp.clip(jnp.round(r32 / scale[..., None]),
+                      -127, 127).astype(jnp.int8)
+        return qi, scale
+
+    kq, ks = quantize(pool_f["k"])
+    vq, vs = quantize(pool_f["v"])
+    pool_q = dict(k=kq, v=vq, ks=ks, vs=vs)
+
+    def timed(fn, *args):
+        out, _ = fn(*args)
+        out.block_until_ready()                 # compile
+        started = time.perf_counter()
+        for _ in range(iters):
+            out, _ = fn(*args)
+        out.block_until_ready()
+        return (time.perf_counter() - started) / iters * 1e3
+
+    q_tile = 128
+    results = {}
+    for quant in (False, True):
+        tag = "int8" if quant else "bf16"
+        pool = pool_q if quant else pool_f
+        elem = 1 if quant else 2
+        scale_bytes = 4 * 2 if quant else 0     # ks + vs f32 per row
+        per_token = kv_heads * (head_dim * elem * 2 + scale_bytes)
+        for length in lengths:
+            cached = length // 2
+            T = length - cached                 # append chunk
+            tables = jnp.arange(1, length // block_size + 1,
+                                dtype=jnp.int32)[None, :]
+            q = jax.random.normal(
+                keys[2], (1, T, kv_heads, group, head_dim),
+                jnp.bfloat16)
+            k_new = jax.random.normal(
+                keys[3], (1, T, kv_heads, head_dim), jnp.bfloat16)
+            v_new = k_new * 0.5
+            cached_lens = jnp.full((1,), cached, jnp.int32)
+            chunk_lens = jnp.full((1,), T, jnp.int32)
+            args = (q, k_new, v_new, pool, tables, cached_lens,
+                    chunk_lens)
+            sweeps = -(-T // q_tile)
+            attend_rows = sweeps * (cached + T // 2)
+            kernel_bytes = (T + attend_rows) * per_token
+            ref_bytes = (attend_rows + 2 * cached + T
+                         + 2 * length) * per_token
+            prefix = f"prefill_attention_{tag}_{length}"
+            results[f"{prefix}_kernel_bytes_prompt"] = kernel_bytes
+            results[f"{prefix}_reference_bytes_prompt"] = ref_bytes
+            line = (f"prefill_attention[{tag} len={length}]: append "
+                    f"{kernel_bytes / 1e6:.1f} MB/prompt vs "
+                    f"gather+scatter {ref_bytes / 1e6:.1f} MB/prompt")
+            if on_tpu or length == min(lengths):
+                ref_ms = timed(jax.jit(pp.paged_prefill_reference),
+                               *args)
+                results[f"{prefix}_reference_ms"] = round(ref_ms, 3)
+                line += f"; gather+scatter {ref_ms:.2f} ms"
+            if on_tpu:
+                kernel_ms = timed(
+                    jax.jit(functools.partial(
+                        pp.paged_prefill_attention, interpret=False)),
+                    *args)
+                results[f"{prefix}_kernel_ms"] = round(kernel_ms, 3)
+                line += (f", append {kernel_ms:.2f} ms "
+                         f"({ref_ms / max(kernel_ms, 1e-9):.1f}x)")
+            log(line)
+        if not on_tpu:
+            # Interpret-mode parity at the smallest length stands in
+            # for kernel timing (also locked by tier-1 tests).
+            length = min(lengths)
+            cached = length // 2
+            T = length - cached
+            tables = jnp.arange(1, length // block_size + 1,
+                                dtype=jnp.int32)[None, :]
+            q = jax.random.normal(
+                keys[2], (1, T, kv_heads, group, head_dim),
+                jnp.bfloat16)
+            k_new = jax.random.normal(
+                keys[3], (1, T, kv_heads, head_dim), jnp.bfloat16)
+            args = (q, k_new, k_new * 0.5, pool, tables,
+                    jnp.full((1,), cached, jnp.int32),
+                    jnp.full((1,), T, jnp.int32))
+            out, _ = pp.paged_prefill_attention(*args, interpret=True)
+            ref, _ = pp.paged_prefill_reference(*args)
+            err = float(jnp.max(jnp.abs(
+                out.astype(jnp.float32) - ref.astype(jnp.float32))))
+            results[f"prefill_attention_{tag}_interpret_parity_err"] = \
+                round(err, 6)
+            log(f"prefill_attention[{tag}] interpret parity max err "
+                f"{err:.2e} (no TPU: kernel timing skipped)")
+    return results
+
+
 SECTIONS = [
     # (name, per-section budget seconds, zero-arg fn -> result dict)
     ("pipeline", 600,
@@ -1453,6 +1618,14 @@ SECTIONS = [
                                      kv_heads=2, group=2, head_dim=64,
                                      block_size=16, iters=3))
      if SMOKE else bench_decode_attention),
+    # Append-attention admission microbench: same compile-risk class
+    # as decode_attention (new scalar-prefetch Pallas grids), so it
+    # rides directly after it.
+    ("prefill_attention", 420,
+     (lambda: bench_prefill_attention(lengths=(128, 256), kv_heads=2,
+                                      group=2, head_dim=64,
+                                      block_size=16, iters=2))
+     if SMOKE else bench_prefill_attention),
     # First-time-on-hardware compile (16k flash grid) — window risk,
     # so it sits after every established section; still before the
     # int4 pair, the only sections that have actually wedged the
