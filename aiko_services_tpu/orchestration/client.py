@@ -15,12 +15,14 @@ hence a dedicated client).
 Futures, not blocking waits: the event engine may be driven by a
 VirtualClock in tests or run in a thread in an application, so
 ``submit`` returns an :class:`InferFuture` that fills as messages
-arrive; ``wait`` polls it for real engines.
+arrive; ``wait`` blocks on a condition variable that the response
+handler wakes (real engines only).
 """
 
 from __future__ import annotations
 
 import itertools
+import threading
 import uuid
 from typing import Callable, Dict, List, Optional
 
@@ -44,6 +46,17 @@ class InferFuture:
         self.error: Optional[str] = None
         self.done = False
         self.on_partial: Optional[Callable[[List[int]], None]] = None
+        self._event = threading.Event()
+
+    def _resolve(self, outputs: Optional[Dict], error) -> None:
+        """Terminal transition: set results, then wake waiters."""
+        if self.done:
+            return
+        if outputs is not None:
+            self.outputs = outputs
+        self.error = str(error) if error is not None else None
+        self.done = True
+        self._event.set()
 
     @property
     def tokens(self) -> List[int]:
@@ -78,8 +91,15 @@ class InferClient:
                stream: bool = False, adapter: Optional[str] = None,
                temperature: float = 0.0, top_p: float = 1.0,
                on_partial=None,
+               deadline_s: Optional[float] = None,
                request_id: Optional[str] = None) -> InferFuture:
-        """Send one ``(infer …)``; returns the future immediately."""
+        """Send one ``(infer …)``; returns the future immediately.
+
+        ``deadline_s`` is a client-relative budget: the replica rejects
+        the request at admission or evicts it from its slot once the
+        budget elapses (``error="deadline_exceeded"``), and routers
+        stop re-dispatching it.
+        """
         swag: Dict = {"tokens": np.asarray(tokens, np.int32),
                       "max_new_tokens": int(max_new_tokens)}
         if stream:
@@ -89,6 +109,8 @@ class InferClient:
         if temperature:
             swag["temperature"] = float(temperature)
             swag["top_p"] = float(top_p)
+        if deadline_s is not None:
+            swag["deadline_ms"] = int(float(deadline_s) * 1e3)
         return self._send("infer", swag, on_partial=on_partial,
                           request_id=request_id)
 
@@ -123,24 +145,32 @@ class InferClient:
 
     def cancel(self, future: InferFuture) -> None:
         """``(infer_cancel …)`` — the cancelled response resolves the
-        future with ``error="cancelled"`` and any partial tokens."""
+        future with ``error="cancelled"`` and any partial tokens.  The
+        reply topic rides along so a router can resolve cancels it no
+        longer has a route for (``error="cancel_unrouted"``)."""
         self.process.message.publish(
             self.topic_in,
-            generate("infer_cancel", [future.request_id]))
+            generate("infer_cancel", [future.request_id,
+                                      self.response_topic]))
 
     def wait(self, future: InferFuture, timeout: float = 30.0,
-             poll: float = 0.005) -> InferFuture:
+             poll: Optional[float] = None) -> InferFuture:
         """Block until done — for REAL engines (an engine thread is
-        pumping); under a VirtualClock drive the engine instead."""
-        import time
-        deadline = time.monotonic() + timeout
-        while not future.done:
-            if time.monotonic() > deadline:
-                # The future STAYS registered: a slow reply can still
-                # resolve it and a retried wait() then succeeds.  Call
-                # forget() to drop a request you are abandoning.
-                raise TimeoutError(future.request_id)
-            time.sleep(poll)
+        pumping); under a VirtualClock drive the engine instead.
+
+        Sleeps on the future's event (woken by the response handler —
+        no polling; ``poll`` is accepted for back-compat and ignored).
+        On timeout the future resolves with ``error="timeout"`` —
+        distinguishable from a replica-side ``error="cancelled"`` —
+        and is forgotten, so a late reply is dropped rather than
+        resolving an abandoned request.
+        """
+        del poll
+        if not future._event.wait(timeout):
+            # Lost the race vs. _on_message?  _resolve is idempotent:
+            # whichever terminal state landed first stands.
+            future._resolve(None, "timeout")
+            self.forget(future)
         return future
 
     def forget(self, future: InferFuture) -> None:
@@ -159,7 +189,18 @@ class InferClient:
         future = self._futures.get(str(params[0]))
         if future is None:
             return
-        outputs = decode_swag(params[1])
+        try:
+            outputs = decode_swag(params[1])
+        except Exception:
+            # A mangled final response still resolves the future — a
+            # corrupt partial is merely dropped (the final response
+            # carries the authoritative token list anyway).
+            if command == "infer_partial":
+                return
+            future._resolve({"error": "corrupt_response"},
+                            "corrupt_response")
+            self._futures.pop(future.request_id, None)
+            return
         if command == "infer_partial":
             increment = [int(t) for t in
                          np.asarray(outputs["tokens_out"])]
@@ -167,10 +208,7 @@ class InferClient:
             if future.on_partial is not None:
                 future.on_partial(increment)
             return
-        future.outputs = outputs
-        error = outputs.get("error")
-        future.error = str(error) if error is not None else None
-        future.done = True
+        future._resolve(outputs, outputs.get("error"))
         # pop, not del: a concurrent forget() may have removed the
         # entry between the get() above and here (documented usage
         # after a wait() timeout).

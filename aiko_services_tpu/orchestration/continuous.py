@@ -62,11 +62,13 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import threading
 import time
 from typing import Dict, List, Optional
 
 import numpy as np
 
+from ..runtime import faults
 from ..runtime.actor import Actor
 from ..utils.sexpr import generate
 
@@ -94,9 +96,16 @@ class DecodeRequest:
     #: base weight stream is paid once for all of them (SLoRA-style;
     #: server must be constructed with ``adapters=``).
     adapter: Optional[str] = None
+    #: Absolute host-monotonic deadline (``deadline_ms`` on the wire
+    #: travels as a RELATIVE budget — clocks never cross processes).
+    #: Expired requests are rejected at admission and evicted from
+    #: their slot with ``error="deadline_exceeded"``.
+    deadline_ts: Optional[float] = None
     # Filled by the server:
     tokens: Optional[List[int]] = None
     error: Optional[str] = None
+    #: Back-off hint attached to an ``error="overloaded"`` shed.
+    retry_after_ms: Optional[int] = None
     #: Latency telemetry (monotonic seconds, host-observed): TTFT is
     #: measured at the host sync that DELIVERS the first token — the
     #: number a client actually experiences under lookahead/chunked
@@ -124,7 +133,9 @@ class ContinuousBatchingServer:
                  lora_config=None, chunk_prefill_tokens: int = 0,
                  draft_config_name: Optional[str] = None,
                  draft_params=None, spec_k: int = 4,
-                 draft_quantize: bool = False, params=None):
+                 draft_quantize: bool = False, params=None,
+                 max_queue: Optional[int] = None,
+                 watchdog_s: float = 0.0):
         import jax
         import jax.numpy as jnp
         from ..models import llama
@@ -324,8 +335,22 @@ class ContinuousBatchingServer:
             dispatches=0, decode_steps=0, tokens_committed=0,
             host_syncs=0, sync_wait_ms=0.0, sync_elements=0,
             state_uploads=0, max_in_flight=0, admission_deferred=0,
-            decode_blocks_read=0, prefill_tokens=0)
+            decode_blocks_read=0, prefill_tokens=0,
+            deadline_exceeded=0, shed=0, watchdog_trips=0)
         self._serve_started: Optional[float] = None
+        # ---- robustness: backpressure + device watchdog -------------- #
+        #: bounded queue: submits past this depth shed with
+        #: ``error="overloaded"`` + a retry-after hint (None = unbounded,
+        #: the pre-robustness behavior).
+        self.max_queue = max_queue
+        #: host-side stall threshold (seconds) around the in-flight
+        #: ring sync; 0 disables.  A sync past the threshold trips the
+        #: watchdog: in-flight work fails with the RETRIABLE
+        #: ``error="watchdog_stalled"`` and the replica goes (and
+        #: stays) unhealthy until an operator restarts it.
+        self.watchdog_s = float(watchdog_s)
+        self.healthy = True
+        self._watchdog_tripped = False
 
         @jax.jit
         def merge_state(state, host_state, mask):
@@ -448,6 +473,21 @@ class ContinuousBatchingServer:
     def submit(self, request: DecodeRequest) -> None:
         request.tokens = []
         request.submitted_ts = time.monotonic()
+        if request.deadline_ts is not None \
+                and request.submitted_ts >= request.deadline_ts:
+            # Expired on arrival (queueing upstream, transit): never
+            # admit work whose answer nobody is waiting for.
+            self._finish_rejected(request, "deadline_exceeded")
+            return
+        if not self.healthy:
+            # Tripped watchdog: the router re-dispatches on this error.
+            self._finish_rejected(request, "watchdog_stalled")
+            return
+        if self.max_queue is not None \
+                and len(self._queue) >= self.max_queue:
+            request.retry_after_ms = self._retry_after_ms()
+            self._finish_rejected(request, "overloaded")
+            return
         prompt_len = int(np.asarray(request.prompt).shape[0])
         reason = self._admission_reject(prompt_len, request)
         if reason:
@@ -455,6 +495,27 @@ class ContinuousBatchingServer:
             self.completed.append(request)
             return
         self._queue.append(request)
+
+    def _finish_rejected(self, request: DecodeRequest,
+                         reason: str) -> None:
+        """Terminal admission rejection on the robustness paths —
+        counted, stamped, and flowed out through the normal completion
+        list (the replica publishes it like any other response)."""
+        request.error = reason
+        request.finished_ts = time.monotonic()
+        if reason == "deadline_exceeded":
+            self.counters["deadline_exceeded"] += 1
+        elif reason == "overloaded":
+            self.counters["shed"] += 1
+        self.completed.append(request)
+
+    def _retry_after_ms(self) -> int:
+        """Shed hint: scale with how far over capacity we are — a
+        saturated queue at 2× capacity hints twice the wait of one at
+        1×.  Coarse by design; clients jitter their own retries."""
+        depth = len(self._queue)
+        per_request_ms = 50
+        return int(min(5_000, per_request_ms * max(1, depth)))
 
     def _admission_reject(self, prompt_len: int,
                           request: DecodeRequest) -> Optional[str]:
@@ -908,6 +969,7 @@ class ContinuousBatchingServer:
         never idles on host bookkeeping.  When nothing can be
         dispatched (all budgets scheduled, or no live slot) the ring is
         drained completely so results are never stranded."""
+        self._evict_expired()
         self._admit()
         self._advance_prefills()
         depth = max(2, self.lookahead)
@@ -917,8 +979,64 @@ class ContinuousBatchingServer:
         target = depth - 1 if dispatched else 0
         while len(self._ring) > target:
             self._consume_one()
+        if self._watchdog_tripped:
+            # A stalled device step already failed this batch's
+            # guarantees — fail everything live/queued with the
+            # retriable error so routers move the work, rather than
+            # letting clients discover the wedge by timeout.
+            self._fail_all("watchdog_stalled")
         done, self.completed = self.completed, []
         return done
+
+    def _evict_expired(self) -> None:
+        """Deadline enforcement between chunks: drop expired queued
+        requests, and evict live slots past deadline (draining the
+        in-flight ring first, same discipline as :meth:`cancel`, so
+        the device provably stops touching the lane before its
+        resources are reused)."""
+        now = time.monotonic()
+        for index in reversed(range(len(self._queue))):
+            request = self._queue[index]
+            if request.deadline_ts is not None \
+                    and now >= request.deadline_ts:
+                self._queue.pop(index)
+                request.error = "deadline_exceeded"
+                request.finished_ts = now
+                self.counters["deadline_exceeded"] += 1
+                self.completed.append(request)
+        expired = [slot for slot in range(self.slots)
+                   if self._requests[slot] is not None
+                   and self._requests[slot].deadline_ts is not None
+                   and now >= self._requests[slot].deadline_ts]
+        if not expired:
+            return
+        self._drain_ring()
+        for slot in expired:
+            request = self._requests[slot]
+            if request is None or request.deadline_ts is None \
+                    or time.monotonic() < request.deadline_ts:
+                continue       # finished naturally while draining
+            request.error = "deadline_exceeded"
+            self.counters["deadline_exceeded"] += 1
+            self._prefilling.pop(slot, None)
+            self._retire(slot)
+
+    def _fail_all(self, reason: str) -> None:
+        """Fail every queued and live request with ``reason`` (the
+        watchdog path — in-flight ring results are consumed first so
+        partial tokens are preserved on the responses)."""
+        self._drain_ring()
+        now = time.monotonic()
+        for request in self._queue:
+            request.error = reason
+            request.finished_ts = now
+            self.completed.append(request)
+        self._queue.clear()
+        for slot in range(self.slots):
+            if self._requests[slot] is not None:
+                self._requests[slot].error = reason
+                self._prefilling.pop(slot, None)
+                self._retire(slot)
 
     def _plan_remaining(self) -> "np.ndarray":
         """Per-slot decode budget still UNSCHEDULED: max_new − emitted
@@ -1091,9 +1209,30 @@ class ContinuousBatchingServer:
         slots-sized vectors, never logits."""
         entry = self._ring.popleft()
         wait_start = time.monotonic()
+        if faults.PLAN is not None:
+            stall = faults.PLAN.check("stall_step")
+            if stall is not None:
+                # Simulated device wedge: the sync below "takes" this
+                # long — exactly what the watchdog exists to catch.
+                time.sleep(float(stall.get("ms", 50.0)) / 1e3)
+        alarm = None
+        if self.watchdog_s > 0:
+            # The alarm thread flips ``healthy`` even while this thread
+            # is still blocked inside np.asarray (a truly wedged jit
+            # never returns) — telemetry readers on other threads see
+            # the trip; the post-sync check below handles the
+            # recoverable-stall case deterministically.
+            alarm = threading.Timer(self.watchdog_s,
+                                    self._trip_watchdog)
+            alarm.daemon = True
+            alarm.start()
         tokens = np.asarray(entry["tokens"])
         counts = np.asarray(entry["counts"])
         active_after = np.asarray(entry["active_after"])
+        if alarm is not None:
+            alarm.cancel()
+            if time.monotonic() - wait_start > self.watchdog_s:
+                self._trip_watchdog()
         spec = entry["kind"] == "spec"
         if spec:
             counts_full = np.asarray(entry["counts_full"])
@@ -1134,6 +1273,17 @@ class ContinuousBatchingServer:
             if not active_after[slot]:
                 self._retire(slot)
 
+    def _trip_watchdog(self) -> None:
+        """Mark the replica wedged (idempotent; callable from the
+        alarm thread).  ``step()`` fails outstanding work on its next
+        pass; recovery is an operator restart, never self-clearing —
+        a device that stalled once is not trustworthy."""
+        if self._watchdog_tripped:
+            return
+        self._watchdog_tripped = True
+        self.healthy = False
+        self.counters["watchdog_trips"] += 1
+
     def _drain_ring(self) -> None:
         while self._ring:
             self._consume_one()
@@ -1149,6 +1299,8 @@ class ContinuousBatchingServer:
             in_flight=len(self._ring),
             queue_depth=self.queue_depth,
             slots_active=self.slots_active,
+            free_slots=self.slots - self.slots_active,
+            healthy=int(self.healthy),
             decode_attention_path=self.decode_attention_path,
             prefill_attention_path=self.prefill_attention_path,
             blocks_read_per_step=(
@@ -1225,6 +1377,13 @@ class ContinuousReplica(Actor):
                 int(np.asarray(inputs.get("stream", 0))))
             adapter = inputs.get("adapter")
             request.adapter = str(adapter) if adapter else None
+            deadline_ms = inputs.get("deadline_ms")
+            if deadline_ms is not None:
+                # Relative budget → local monotonic deadline (wall
+                # clocks never cross processes; transit time before
+                # arrival is not charged).
+                request.deadline_ts = time.monotonic() + \
+                    float(np.asarray(deadline_ms)) / 1e3
         except Exception:  # noqa: BLE001 - bad request must still respond
             self.logger.exception("%s: malformed infer request %s",
                                   self.name, request_id)
@@ -1245,6 +1404,22 @@ class ContinuousReplica(Actor):
                            delay=0.001)
 
     def _pump(self):
+        if faults.PLAN is not None:
+            hit = faults.PLAN.check("kill_replica", key=self.name)
+            if hit is not None:
+                # Die mid-decode with requests in flight — the LWT
+                # (absent) fires, the Registrar evicts this process's
+                # services, and routers re-dispatch.  ``hard=1``
+                # additionally kills the OS process (cross-process
+                # chaos; the exit code marks an injected death).
+                self.logger.warning("%s: fault kill_replica firing",
+                                    self.name)
+                self._pumping = False
+                self.process.kill()
+                if hit.get("hard"):
+                    import os
+                    os._exit(13)
+                return
         finished = self.server.step()
         self._stream_partials()
         for request in finished:
@@ -1274,6 +1449,13 @@ class ContinuousReplica(Actor):
         if self._total_window:
             updates["total_p50_ms"] = round(
                 statistics.median(self._total_window) * 1e3, 1)
+        if not self.server.healthy \
+                and self.share.get("lifecycle") != "unhealthy":
+            # The router watches lifecycle on the replica's state
+            # topic: flipping it drains this replica (in-flight work
+            # re-dispatched, no new routes) without waiting for the
+            # process to die.
+            updates["lifecycle"] = "unhealthy"
         changed = {key: value for key, value in updates.items()
                    if self.share.get(key) != value}
         if not changed:
@@ -1283,16 +1465,26 @@ class ContinuousReplica(Actor):
             for key, value in changed.items():
                 self.ec_producer.update(key, value)
 
-    def _wire_cancel(self, request_id):
-        """``(infer_cancel request_id)``: the cancelled request's
-        normal ``infer_response`` (error ``cancelled``, any partial
-        tokens) is the acknowledgement; an unknown id is logged only —
-        its response may already be in flight."""
+    def _wire_cancel(self, request_id, response_topic=None):
+        """``(infer_cancel request_id [response_topic])``: the
+        cancelled request's normal ``infer_response`` (error
+        ``cancelled``, any partial tokens) is the acknowledgement.  An
+        unknown id — already responded, or aged out — resolves the
+        caller's future with ``error="cancel_unrouted"`` when a reply
+        topic rides along (the true response may still arrive first;
+        the client's terminal-state race rules apply)."""
         if self.server.cancel(str(request_id)):
             self._ensure_pumping()
-        else:
-            self.logger.info("%s: infer_cancel for unknown id %s",
-                             self.name, request_id)
+            return
+        self.logger.info("%s: infer_cancel for unknown id %s",
+                         self.name, request_id)
+        if response_topic:
+            from ..pipeline.codec import encode_swag
+            self.process.message.publish(
+                str(response_topic),
+                generate("infer_response",
+                         [request_id,
+                          encode_swag({"error": "cancel_unrouted"})]))
 
     def _wire_adapter_load(self, request_id, response_topic,
                            payload=None):
@@ -1387,6 +1579,8 @@ class ContinuousReplica(Actor):
                 # Partial tokens are real work the client may keep.
                 outputs["tokens_out"] = np.asarray(request.tokens,
                                                    np.int32)
+            if request.retry_after_ms is not None:
+                outputs["retry_after_ms"] = int(request.retry_after_ms)
         else:
             outputs = {"tokens_out": np.asarray(request.tokens,
                                                 np.int32)}
@@ -1406,7 +1600,14 @@ class ContinuousReplica(Actor):
                 if served:
                     self._total_window.append(total)
         if request.response_topic:
+            encoded = encode_swag(outputs)
+            if faults.PLAN is not None:
+                if faults.PLAN.check("corrupt_response",
+                                     key=request.request_id) is not None:
+                    # Undecodable swag on the wire: the client resolves
+                    # the future with error="corrupt_response".
+                    encoded = "!corrupt!"
             self.process.message.publish(
                 request.response_topic,
                 generate("infer_response",
-                         [request.request_id, encode_swag(outputs)]))
+                         [request.request_id, encoded]))

@@ -70,7 +70,9 @@ class PagedContinuousServer(ContinuousBatchingServer):
                  enable_prefix_cache: bool = False,
                  lookahead: int = 1, adapters=None, lora_config=None,
                  params=None,
-                 chunk_prefill_tokens: Optional[int] = None):
+                 chunk_prefill_tokens: Optional[int] = None,
+                 max_queue: Optional[int] = None,
+                 watchdog_s: float = 0.0):
         self.block_size = block_size
         self._requested_blocks = total_blocks
         self.enable_prefix_cache = enable_prefix_cache
@@ -82,7 +84,8 @@ class PagedContinuousServer(ContinuousBatchingServer):
                          quantize_kv=quantize_kv, lookahead=lookahead,
                          adapters=adapters, lora_config=lora_config,
                          params=params,
-                         chunk_prefill_tokens=chunk_prefill_tokens)
+                         chunk_prefill_tokens=chunk_prefill_tokens,
+                         max_queue=max_queue, watchdog_s=watchdog_s)
 
     # ------------------------------------------------------------- #
     # Layout hooks

@@ -70,22 +70,47 @@ class ProcessManager:
             return [command]
         return [sys.executable, command]
 
-    def delete(self, id, kill: bool = False, wait: float = 0.0):
+    def delete(self, id, kill: bool = False, wait: float = 0.0,
+               grace: Optional[float] = None) -> Optional[str]:
+        """Stop a child with explicit terminate → grace-wait → kill
+        escalation.  ``grace`` is how long a SIGTERM'd child gets to
+        exit before SIGKILL (defaults to ``wait`` for back-compat);
+        ``wait`` additionally blocks until the child is reaped after a
+        kill.  Returns which path actually fired — ``"already_exited"``,
+        ``"terminated"``, ``"escalated_kill"`` (the child ignored its
+        grace period), or ``"killed"`` (immediate, ``kill=True``) — so
+        supervisors (and the chaos kill injector) can tell a graceful
+        shutdown from a hang."""
         id = str(id)
         process = self.processes.pop(id, None)
         self.commands.pop(id, None)
         if process is None:
-            return
-        if process.poll() is None:
-            if kill:
-                process.kill()
-            else:
-                process.terminate()
-            if wait:
+            return None
+        if process.poll() is not None:
+            return "already_exited"
+        if grace is None:
+            grace = wait
+        if kill:
+            process.kill()
+            outcome = "killed"
+        else:
+            process.terminate()
+            outcome = "terminated"
+            if grace:
                 try:
-                    process.wait(timeout=wait)
+                    process.wait(timeout=grace)
                 except subprocess.TimeoutExpired:
+                    _logger.warning(
+                        "Child %s ignored SIGTERM for %.1fs — killing",
+                        id, grace)
                     process.kill()
+                    outcome = "escalated_kill"
+        if wait:
+            try:
+                process.wait(timeout=wait)
+            except subprocess.TimeoutExpired:
+                pass
+        return outcome
 
     def terminate_all(self, kill: bool = False):
         for id in list(self.processes):

@@ -12,14 +12,19 @@ BASELINE.md "multi-replica serving actors, DP over chips" workload:
   ``response_topic`` — the reference's response-topic idiom
   (main/storage.py:87-103).
 - :class:`ReplicaRouter` — an Actor that discovers replicas through the
-  ServicesCache (by protocol), load-balances requests round-robin, and
-  prunes replicas the moment the Registrar evicts them (LWT death or
-  lease expiry).  Routing is fire-and-forget pass-through: the
-  *original* response topic rides along.  The only per-request state
-  is the bounded id→replica affinity ring that lets ``infer_cancel``
-  follow its request — so REPLICATED routers serve fine, but a cancel
-  must reach the router that routed the request (sticky clients, or
-  send cancels to every router instance).
+  ServicesCache (by protocol), load-balances requests (power-of-two-
+  choices over replica-published queue depth, round-robin while load is
+  unknown), and prunes replicas the moment the Registrar evicts them
+  (LWT death or lease expiry).  Requests OUTLIVE replicas: the router
+  proxies responses through its own reply topic, tracks every in-flight
+  request, and on replica death or health-state change re-dispatches
+  the stranded work to a survivor with bounded exponential backoff +
+  jitter.  Greedy requests replay idempotently from the prompt (the
+  paged prefix cache makes the retry cheap); streaming clients get
+  token-offset dedup, so no token is ever delivered twice.  When every
+  candidate replica is saturated the router sheds explicitly
+  (``error="overloaded"`` + ``retry_after_ms``) instead of queueing
+  silently.  See docs/SERVING.md "Failure model & fault injection".
 
 Payloads are swag-codec dicts (numpy arrays travel as typed tags), so
 token tensors cross process boundaries losslessly.
@@ -27,20 +32,30 @@ token tensors cross process boundaries losslessly.
 
 from __future__ import annotations
 
+import random
 from collections import OrderedDict
 from typing import Callable, Dict, List, Optional
+
+import numpy as np
 
 from ..pipeline.codec import decode_swag, encode_swag
 from ..registry.services_cache import services_cache_create_singleton
 from ..runtime.actor import Actor
 from ..runtime.service import ServiceFilter
-from ..utils.sexpr import generate
+from ..utils.sexpr import generate, parse
 
 __all__ = ["ModelReplica", "ReplicaRouter", "REPLICA_PROTOCOL",
-           "make_llama_infer", "make_speculative_infer",
-           "make_constrained_infer", "serving_telemetry"]
+           "ROUTER_PROTOCOL", "make_llama_infer",
+           "make_speculative_infer", "make_constrained_infer",
+           "serving_telemetry"]
 
 REPLICA_PROTOCOL = "model_replica:0"
+ROUTER_PROTOCOL = "replica_router:0"
+
+#: Replica-reported errors the router retries on a different replica
+#: instead of forwarding to the client (the failure is the REPLICA's,
+#: not the request's).
+RETRIABLE_ERRORS = ("watchdog_stalled",)
 
 #: Server-stats keys worth broadcasting to operators.  Shared by
 #: ContinuousReplica EC shares, dashboard rendering, and bench
@@ -53,6 +68,8 @@ TELEMETRY_KEYS = (
     "decode_attention_path", "blocks_read_per_step",
     "prefill_tokens_per_sec", "prefill_queue_depth",
     "prefill_attention_path",
+    "deadline_exceeded", "shed", "watchdog_trips", "free_slots",
+    "healthy",
 )
 
 
@@ -120,35 +137,94 @@ class ModelReplica(Actor):
 
 
 class ReplicaRouter(Actor):
-    """Discovers :class:`ModelReplica` services and round-robins
-    ``infer`` requests across the live set."""
+    """Discovers :class:`ModelReplica` services, load-balances
+    ``infer`` requests across the live set, and guarantees that a
+    request outlives the replica serving it.
+
+    Survivability machinery (each piece off the hot path until a
+    failure actually happens):
+
+    * Responses are PROXIED: replicas answer on the router's reply
+      topic, the router forwards to the client — this is what lets it
+      observe completion (in-flight tracking), dedup re-played
+      streaming tokens by offset, and intercept retriable errors.
+    * Registrar eviction (LWT death) or a replica flipping its shared
+      ``lifecycle`` to ``unhealthy`` re-dispatches that replica's
+      in-flight requests to survivors with bounded exponential
+      backoff + seeded jitter (``backoff_base_s``·2^attempt, capped;
+      ``max_redispatch`` attempts, then ``error="redispatch_failed"``).
+    * Routing is power-of-two-choices over replica-published
+      ``queue_depth`` (watched passively off each replica's EC-share
+      state topic — no lease held); while no load is known it is exact
+      round-robin.  When every candidate sits at ``shed_queue_depth``
+      or beyond, the request sheds immediately with
+      ``error="overloaded"`` and a ``retry_after_ms`` hint.
+    """
 
     def __init__(self, context, process=None,
-                 replica_protocol: str = REPLICA_PROTOCOL):
+                 replica_protocol: str = REPLICA_PROTOCOL,
+                 shed_queue_depth: int = 32,
+                 backoff_base_s: float = 0.05,
+                 backoff_cap_s: float = 2.0,
+                 max_redispatch: int = 4, seed: int = 0):
+        context.protocol = context.protocol or ROUTER_PROTOCOL
         super().__init__(context, process)
         self._replicas: List[str] = []   # replica topic paths, stable order
         self._next = 0
         self._command_handlers["infer"] = self.route
         self._command_handlers["infer_cancel"] = self._route_cancel
         _register_unsupported_adapter_commands(self)
+        self.shed_queue_depth = shed_queue_depth
+        self.backoff_base_s = backoff_base_s
+        self.backoff_cap_s = backoff_cap_s
+        self.max_redispatch = max_redispatch
+        self._rng = random.Random(seed)
         #: request_id -> replica topic path, so infer_cancel follows
         #: its request to the SAME replica.  Bounded ring evicting the
-        #: OLDEST ROUTED id (liveness is invisible to a pass-through
-        #: router): a cancel for an aged-out id is dropped with a log,
-        #: so size the ring well above the maximum in-flight window
-        #: (entries are two short strings each).
+        #: OLDEST ROUTED id: a cancel for an aged-out id resolves with
+        #: ``error="cancel_unrouted"``, so size the ring well above the
+        #: maximum in-flight window (entries are two short strings
+        #: each).  Entries persist after completion: a cancel lost in
+        #: transit can be retried.
         self._routed: "OrderedDict[str, str]" = OrderedDict()
         self._routed_limit = 65536
+        #: request_id -> live routing record (replica, client topic,
+        #: original payload, delivery offsets, attempts).  Unlike
+        #: ``_routed`` this IS completion-aware — entries leave when
+        #: the terminal response forwards.  Bounded as a safety net
+        #: against clients that never complete.
+        self._inflight: "OrderedDict[str, Dict]" = OrderedDict()
+        self._inflight_limit = 4096
+        #: replica topic path -> latest load numbers parsed off its
+        #: EC-share state topic (passive watch; no lease).
+        self._loads: Dict[str, Dict] = {}
+        self._unhealthy: set = set()
+        self.counters: Dict[str, int] = dict(
+            redispatches=0, replica_deaths_observed=0, shed=0,
+            deadline_exceeded=0, cancel_unrouted=0)
         self.share["replicas"] = 0
+        self.share["requests_routed"] = 0
+        self.share.update(self.counters)
+        #: replicas answer here; _on_reply forwards to the client.
+        self.topic_reply = f"{self.topic_path}/reply"
+        self.process.add_message_handler(self._on_reply,
+                                         self.topic_reply)
         self._cache = services_cache_create_singleton(self.process)
         self._cache.add_handler(
             ServiceFilter(protocol=replica_protocol),
             self._replica_added, self._replica_removed)
 
+    # -- membership & health ---------------------------------------- #
+
     def _replica_added(self, fields):
         if fields.topic_path not in self._replicas:
             self._replicas.append(fields.topic_path)
             self._replicas.sort()
+            # Passive load watch: the replica's ECProducer broadcasts
+            # every share mutation on its state topic; queue depth and
+            # lifecycle arrive without holding a lease.
+            self.process.add_message_handler(
+                self._replica_state, f"{fields.topic_path}/state")
             self._update_share()
             self.logger.info("%s: replica up %s (%d live)", self.name,
                              fields.topic_path, len(self._replicas))
@@ -156,53 +232,312 @@ class ReplicaRouter(Actor):
     def _replica_removed(self, fields):
         if fields.topic_path in self._replicas:
             self._replicas.remove(fields.topic_path)
+            self.process.remove_message_handler(
+                self._replica_state, f"{fields.topic_path}/state")
+            self._loads.pop(fields.topic_path, None)
+            self._unhealthy.discard(fields.topic_path)
+            self._bump("replica_deaths_observed")
             self._update_share()
             self.logger.info("%s: replica down %s (%d live)", self.name,
                              fields.topic_path, len(self._replicas))
+            self._drain_replica(fields.topic_path)
+
+    def _replica_state(self, topic: str, payload: str):
+        """EC-share broadcast off a replica's state topic:
+        ``(update|add key value)``.  Load keys feed P2C routing;
+        a ``lifecycle`` flip to ``unhealthy`` drains the replica."""
+        try:
+            command, params = parse(payload)
+        except Exception:
+            return
+        if command not in ("update", "add") or len(params) < 2:
+            return
+        replica = topic[:-len("/state")]
+        key, value = str(params[0]), params[1]
+        if key in ("queue_depth", "slots_active", "free_slots",
+                   "free_blocks", "slots"):
+            try:
+                self._loads.setdefault(replica, {})[key] = int(value)
+            except (TypeError, ValueError):
+                pass
+        elif key == "healthy":
+            self._set_health(replica, str(value) not in ("0", "False"))
+        elif key == "lifecycle":
+            self._set_health(replica, str(value) != "unhealthy")
+
+    def _set_health(self, replica: str, healthy: bool):
+        if healthy:
+            self._unhealthy.discard(replica)
+            return
+        if replica in self._unhealthy:
+            return
+        self._unhealthy.add(replica)
+        self.logger.warning("%s: replica %s unhealthy — draining",
+                            self.name, replica)
+        self._drain_replica(replica)
+
+    def _candidates(self) -> List[str]:
+        live = [r for r in self._replicas if r not in self._unhealthy]
+        # A fleet that is ALL unhealthy beats routing nowhere: the
+        # watchdogged replica still answers (with a retriable error)
+        # faster than a black hole.
+        return live or list(self._replicas)
 
     def _update_share(self):
         self.share["replicas"] = len(self._replicas)
         if self.ec_producer is not None:
             self.ec_producer.update("replicas", len(self._replicas))
 
+    def _bump(self, counter: str, by: int = 1):
+        self.counters[counter] += by
+        self.share[counter] = self.counters[counter]
+        if self.ec_producer is not None:
+            self.ec_producer.update(counter, self.counters[counter])
+
+    # -- routing ----------------------------------------------------- #
+
+    def _pick(self, candidates: List[str]) -> str:
+        """Power-of-two-choices by reported queue depth; exact
+        round-robin while load is unknown (cold start, static
+        ModelReplica fleets that publish no queue_depth)."""
+        known = [r for r in candidates if "queue_depth"
+                 in self._loads.get(r, ())]
+        if len(known) < 2 or len(known) < len(candidates):
+            target = candidates[self._next % len(candidates)]
+            self._next += 1
+            return target
+        first, second = self._rng.sample(known, 2)
+        return first if (self._loads[first]["queue_depth"]
+                         <= self._loads[second]["queue_depth"]) else second
+
+    def _saturated(self, candidates: List[str]) -> bool:
+        """True only when EVERY candidate reports a queue at or past
+        the shed threshold — unknown load never sheds."""
+        if not candidates:
+            return False
+        return all(
+            self._loads.get(r, {}).get("queue_depth", -1)
+            >= self.shed_queue_depth for r in candidates)
+
+    def _shed(self, request_id, response_topic, error: str,
+              retry_after_ms: Optional[int] = None):
+        """Terminal rejection published straight to the client — a
+        future must ALWAYS resolve; silent drops are the failure mode
+        this PR exists to remove."""
+        if error == "overloaded":
+            self._bump("shed")
+        elif error == "deadline_exceeded":
+            self._bump("deadline_exceeded")
+        outputs: Dict = {"error": error}
+        if retry_after_ms is not None:
+            outputs["retry_after_ms"] = int(retry_after_ms)
+        if response_topic:
+            self.process.message.publish(
+                str(response_topic),
+                generate("infer_response",
+                         [str(request_id), encode_swag(outputs)]))
+
     def route(self, request_id, response_topic, payload=None) -> bool:
-        """Forward one request to the next live replica.  Returns False
-        (and logs) when no replicas are live — the caller's retry is the
-        recovery path, per the fire-and-forget idiom."""
+        """Dispatch one request to a live replica and begin tracking
+        it.  Returns False when no replicas are live — the request
+        then sheds with ``error="overloaded"`` so the caller's future
+        resolves instead of hanging."""
+        request_id = str(request_id)
         if not self._replicas:
             self.logger.warning("%s: no live replicas for %s",
                                 self.name, request_id)
+            self._shed(request_id, response_topic, "overloaded",
+                       retry_after_ms=1000)
             return False
-        target = self._replicas[self._next % len(self._replicas)]
-        self._next += 1
-        self._routed[str(request_id)] = target
+        candidates = self._candidates()
+        if self._saturated(candidates):
+            depths = [self._loads[r]["queue_depth"] for r in candidates]
+            self._shed(request_id, response_topic, "overloaded",
+                       retry_after_ms=min(5000, 50 * min(depths)))
+            return False
+        target = self._pick(candidates)
+        self._routed[request_id] = target
         while len(self._routed) > self._routed_limit:
             self._routed.popitem(last=False)
+        self._inflight[request_id] = dict(
+            replica=target, client_topic=str(response_topic),
+            payload=payload or {}, attempts=0, delivered=0,
+            replica_sent=0, routed_at=self.process.event.now(),
+            deadline_ts=-1.0)    # -1 = not yet resolved from payload
+        while len(self._inflight) > self._inflight_limit:
+            dropped_id, _ = self._inflight.popitem(last=False)
+            self.logger.warning(
+                "%s: in-flight table full, dropping tracking for %s "
+                "(request still routed; no re-dispatch protection)",
+                self.name, dropped_id)
         self.process.message.publish(
             f"{target}/in",
-            generate("infer", [str(request_id), str(response_topic),
+            generate("infer", [request_id, self.topic_reply,
                                payload or {}]))
+        self.share["requests_routed"] += 1
+        if self.ec_producer is not None:
+            self.ec_producer.update("requests_routed",
+                                    self.share["requests_routed"])
         return True
 
-    def _route_cancel(self, request_id) -> None:
-        """Forward ``(infer_cancel id)`` to the replica that holds the
-        request (affinity recorded at route time); unknown or aged-out
-        ids are logged only — their response may already be in
-        flight.  The entry is KEPT after forwarding so a cancel lost in
-        transit can be retried (the fire-and-forget idiom's recovery
-        path); the router cannot see completions, so request ids must
-        be unique per client (``InferClient`` guarantees this) — a
-        hand-rolled client reusing an id would route its cancel to
-        whatever replica last held that id until the affinity ring
-        evicts it."""
-        target = self._routed.get(str(request_id))
+    # -- response proxy ---------------------------------------------- #
+
+    def _on_reply(self, _topic: str, payload: str):
+        """A replica answered on the reply topic: dedup + forward
+        partials, intercept retriable errors, forward terminal
+        responses and close out tracking."""
+        try:
+            command, params = parse(payload)
+        except Exception:
+            return
+        if command not in ("infer_partial", "infer_response") \
+                or len(params) < 2:
+            return
+        entry = self._inflight.get(str(params[0]))
+        if entry is None:
+            return        # already terminal (late reply after re-dispatch)
+        if command == "infer_partial":
+            self._forward_partial(str(params[0]), entry, params[1])
+            return
+        try:
+            outputs = decode_swag(params[1])
+            error = outputs.get("error")
+        except Exception:
+            error = None  # corrupt swag: client resolves corrupt_response
+        if error is not None and str(error) in RETRIABLE_ERRORS \
+                and entry["attempts"] < self.max_redispatch:
+            # The REPLICA failed, not the request — move the work.
+            self._schedule_redispatch(str(params[0]), entry)
+            return
+        self._inflight.pop(str(params[0]), None)
+        self.process.message.publish(entry["client_topic"], payload)
+
+    def _forward_partial(self, request_id: str, entry: Dict, swag):
+        """Token-offset dedup: a re-dispatched greedy request replays
+        from the prompt, so the new replica re-streams tokens the
+        client already has — forward only the suffix past what was
+        delivered."""
+        try:
+            increment = [int(t) for t in
+                         np.asarray(decode_swag(swag)["tokens_out"])]
+        except Exception:
+            return              # corrupt partial: drop (final is authoritative)
+        sent = entry["replica_sent"]
+        entry["replica_sent"] = sent + len(increment)
+        skip = max(0, entry["delivered"] - sent)
+        fresh = increment[skip:]
+        if not fresh:
+            return
+        entry["delivered"] += len(fresh)
+        self.process.message.publish(
+            entry["client_topic"],
+            generate("infer_partial",
+                     [request_id,
+                      encode_swag({"tokens_out":
+                                   np.asarray(fresh, np.int32)})]))
+
+    # -- re-dispatch -------------------------------------------------- #
+
+    def _drain_replica(self, replica: str):
+        """Re-dispatch every in-flight request the dead/unhealthy
+        replica holds."""
+        for request_id, entry in list(self._inflight.items()):
+            if entry["replica"] == replica:
+                self._schedule_redispatch(request_id, entry)
+
+    def _schedule_redispatch(self, request_id: str, entry: Dict):
+        """Arm a once-timer with bounded exponential backoff + seeded
+        jitter (0.5–1.5×): failures are correlated — a thundering herd
+        of instant retries onto the one survivor is how cascades
+        start."""
+        entry["replica"] = None
+        delay = min(self.backoff_cap_s,
+                    self.backoff_base_s * (2 ** entry["attempts"]))
+        delay *= 0.5 + self._rng.random()
+        self.process.event.add_timer_handler(
+            lambda: self._redispatch(request_id), delay, once=True)
+
+    def _redispatch(self, request_id: str):
+        entry = self._inflight.get(request_id)
+        if entry is None or entry["replica"] is not None:
+            return    # completed, or another path already re-routed it
+        if entry["deadline_ts"] < 0:
+            entry["deadline_ts"] = self._resolve_deadline(entry)
+        if entry["deadline_ts"] is not None and \
+                self.process.event.now() >= entry["deadline_ts"]:
+            self._inflight.pop(request_id, None)
+            self._shed(request_id, entry["client_topic"],
+                       "deadline_exceeded")
+            return
+        if entry["attempts"] >= self.max_redispatch:
+            self._inflight.pop(request_id, None)
+            self._shed(request_id, entry["client_topic"],
+                       "redispatch_failed")
+            return
+        entry["attempts"] += 1
+        live = [r for r in self._replicas if r not in self._unhealthy]
+        if not live:
+            # Nothing to route to YET — back off again; the attempt
+            # budget above bounds how long we hope.
+            self._schedule_redispatch(request_id, entry)
+            return
+        target = self._pick(live)
+        entry["replica"] = target
+        entry["replica_sent"] = 0     # new replica replays from prompt
+        self._routed[request_id] = target
+        self._bump("redispatches")
+        self.logger.info("%s: re-dispatching %s to %s (attempt %d)",
+                         self.name, request_id, target,
+                         entry["attempts"])
+        self.process.message.publish(
+            f"{target}/in",
+            generate("infer", [request_id, self.topic_reply,
+                               entry["payload"]]))
+
+    def _resolve_deadline(self, entry: Dict) -> Optional[float]:
+        """Lazily decode the original payload's ``deadline_ms`` (only
+        on the failure path — the route hot path never decodes swag).
+        Approximates the client's budget as starting at route time."""
+        try:
+            deadline_ms = decode_swag(entry["payload"]).get(
+                "deadline_ms")
+        except Exception:
+            return None
+        if deadline_ms is None:
+            return None
+        return entry["routed_at"] + float(np.asarray(deadline_ms)) / 1e3
+
+    # -- cancel ------------------------------------------------------- #
+
+    def _route_cancel(self, request_id, response_topic=None) -> None:
+        """Forward ``(infer_cancel id [reply_topic])`` to the replica
+        currently holding the request (the live in-flight record wins
+        over the routed-affinity ring — a re-dispatch may have moved
+        it).  An unknown or aged-out id resolves the caller's future
+        with ``error="cancel_unrouted"`` when a reply topic rides
+        along, instead of leaving it to time out.  Affinity entries are
+        KEPT after forwarding so a cancel lost in transit can be
+        retried; request ids must be unique per client
+        (``InferClient`` guarantees this)."""
+        request_id = str(request_id)
+        entry = self._inflight.get(request_id)
+        target = entry["replica"] if entry is not None \
+            else self._routed.get(request_id)
         if target is None:
             self.logger.info("%s: infer_cancel for unrouted id %s",
                              self.name, request_id)
+            self._bump("cancel_unrouted")
+            if response_topic:
+                self.process.message.publish(
+                    str(response_topic),
+                    generate("infer_response",
+                             [request_id,
+                              encode_swag({"error":
+                                           "cancel_unrouted"})]))
             return
         self.process.message.publish(
             f"{target}/in",
-            generate("infer_cancel", [str(request_id)]))
+            generate("infer_cancel", [request_id]))
 
 
 def _coerce_request(inputs: Dict, config, default_new: int):

@@ -1,4 +1,5 @@
 from .event import EventEngine, VirtualClock, event
+from .faults import FaultPlan, plan_from_spec
 from .lease import Lease
 from .connection import Connection, ConnectionState
 from .context import (

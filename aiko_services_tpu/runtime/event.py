@@ -98,6 +98,14 @@ class EventEngine:
         self._running = False
         self._loop_thread: Optional[threading.Thread] = None
 
+    def now(self) -> float:
+        """Current engine time — virtual under a VirtualClock, wall
+        monotonic otherwise.  Timestamps that feed timer scheduling
+        (e.g. router re-dispatch deadlines) must come from HERE, not
+        ``time.monotonic()``, or deterministic tests can't advance
+        them."""
+        return self._clock.now()
+
     # -- timers ------------------------------------------------------------ #
 
     def add_timer_handler(self, handler: Callable, period: float,

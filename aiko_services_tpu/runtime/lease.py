@@ -13,6 +13,7 @@ from __future__ import annotations
 from typing import Any, Callable, Optional
 
 from .event import EventEngine, event as _default_engine
+from . import faults
 
 __all__ = ["Lease"]
 
@@ -55,6 +56,11 @@ class Lease:
         """Push the expiry another ``lease_time`` seconds into the future."""
         if self.terminated:
             return
+        if faults.PLAN is not None:
+            if faults.PLAN.check("expire_lease",
+                                 key=str(self.lease_uuid)) is not None:
+                self._expired()
+                return
         if lease_time is not None:
             self.lease_time = lease_time
         self._engine.remove_timer_handler(self._expired)

@@ -36,6 +36,7 @@ from ..transport import create_message
 from ..transport.message import Message, topic_matcher
 from .connection import Connection, ConnectionState
 from .event import EventEngine, event as default_engine
+from . import faults
 
 __all__ = ["Process", "default_process", "set_default_process",
            "SERVICE_REGISTRAR_TOPIC_SUFFIX"]
@@ -187,6 +188,24 @@ class Process:
 
     def _on_message(self, topic: str, payload):
         """Transport thread → event queue."""
+        if faults.PLAN is not None:
+            # Key: topic + payload head, so a plan can target e.g. all
+            # infer_partial traffic or one replica's /in topic.
+            head = payload[:64] if isinstance(payload, str) else ""
+            key = f"{topic} {head}"
+            if faults.PLAN.check("drop_message", key=key) is not None:
+                return
+            delay = faults.PLAN.check("delay_message", key=key)
+            if delay is not None:
+                # Wall-clock delay (not VirtualClock-driven): reorders
+                # delivery under a real engine only.
+                timer = threading.Timer(
+                    float(delay.get("ms", 10.0)) / 1e3,
+                    lambda: self.event.queue_put(
+                        (topic, payload), self._message_queue))
+                timer.daemon = True
+                timer.start()
+                return
         self.event.queue_put((topic, payload), self._message_queue)
 
     def _message_queue_handler(self, item: Tuple[str, object]):

@@ -196,6 +196,41 @@ def model_replica_plugin(fields, variables) -> List[str]:
         lines.append(f"  latency:   ttft p50 {ttft or '?'}"
                      f"/p95 {ttft95 or '?'} ms, "
                      f"total p50 {total or '?'} ms")
+    healthy = _get(variables, "healthy", default=None)
+    if healthy not in (None, "-"):
+        state = "ok" if str(healthy) not in ("0", "False") else "STALLED"
+        lines.append(
+            f"  health:    {state}, "
+            f"{_get(variables, 'watchdog_trips', default=0)}"
+            f" watchdog trips, "
+            f"{_get(variables, 'free_slots', default='-')} free slots")
+    rejected = [(label, _get(variables, key, default=None))
+                for label, key in (("deadline", "deadline_exceeded"),
+                                   ("shed", "shed"))]
+    if any(value not in (None, "-", 0) for _, value in rejected):
+        lines.append("  rejected:  " + ", ".join(
+            f"{value or 0} {label}" for label, value in rejected))
+    return lines
+
+
+@dashboard_plugin(protocol="replica_router")
+def replica_router_plugin(fields, variables) -> List[str]:
+    """Router view: fleet size plus the robustness counters (failure
+    re-dispatches, observed replica deaths, load sheds)."""
+    lines = [
+        f"ReplicaRouter: {fields.name}",
+        f"  lifecycle:  {_get(variables, 'lifecycle')}",
+        f"  replicas:   {_get(variables, 'replicas')}",
+        f"  routed:     {_get(variables, 'requests_routed')}",
+        f"  redispatch: {_get(variables, 'redispatches', default=0)}"
+        f" ({_get(variables, 'replica_deaths_observed', default=0)}"
+        f" deaths observed)",
+        f"  shed:       {_get(variables, 'shed', default=0)} overload, "
+        f"{_get(variables, 'deadline_exceeded', default=0)} deadline",
+    ]
+    unrouted = _get(variables, "cancel_unrouted", default=None)
+    if unrouted not in (None, "-", 0):
+        lines.append(f"  cancels:    {unrouted} unrouted")
     return lines
 
 

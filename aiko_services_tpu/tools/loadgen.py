@@ -28,7 +28,8 @@ from typing import Callable, Dict, List, Optional
 from ..pipeline.codec import encode_swag
 from ..utils.sexpr import generate, parse
 
-__all__ = ["LoadGenerator", "LoadReport", "service_scale_sweep"]
+__all__ = ["LoadGenerator", "LoadReport", "service_scale_sweep",
+           "chaos_schedule", "run_chaos", "main"]
 
 
 @dataclasses.dataclass
@@ -49,6 +50,16 @@ class LoadReport:
     #: attached by the harness after the run — ties the wire-level
     #: tails to the decode-attention path that produced them.
     server_stats: Optional[Dict] = None
+    #: error string -> count.  ``errors`` alone can't distinguish a
+    #: healthy shed (``overloaded``/``deadline_exceeded`` — the
+    #: backpressure design working) from real failures.
+    error_kinds: Dict[str, int] = dataclasses.field(default_factory=dict)
+
+    @property
+    def lost(self) -> int:
+        """Requests neither completed nor error-terminal (hung or
+        dropped) — the number a chaos run asserts is ZERO."""
+        return self.sent - self.completed - self.errors
 
     @property
     def throughput_rps(self) -> float:
@@ -97,8 +108,12 @@ class LoadReport:
         ttft = (f", ttft_p50={self.ttft_p50_ms:.1f}/"
                 f"p95={self.ttft_p95_ms:.1f} ms"
                 if self.ttfts_ms else "")
+        kinds = (", kinds=" + "/".join(
+            f"{k}:{n}" for k, n in sorted(self.error_kinds.items()))
+            if self.error_kinds else "")
         return (f"LoadReport(sent={self.sent}, done={self.completed}, "
-                f"errors={self.errors}, timeouts={self.timeouts}, "
+                f"errors={self.errors}{kinds}, "
+                f"timeouts={self.timeouts}, "
                 f"{self.throughput_rps:.1f} req/s, "
                 f"{self.throughput_tps:.1f} tok/s, "
                 f"p50={self.p50_ms:.1f} ms, p99={self.p99_ms:.1f} ms"
@@ -124,6 +139,7 @@ class LoadGenerator:
         self._latencies: List[float] = []
         self._ttfts: List[float] = []
         self._errors = 0
+        self._error_kinds: Dict[str, int] = {}
         self._tokens = 0
         self._run_index = 0
         process.add_message_handler(self._on_response,
@@ -147,6 +163,9 @@ class LoadGenerator:
         outputs = params[1] if len(params) > 1 else {}
         if isinstance(outputs, dict) and "error" in outputs:
             self._errors += 1
+            kind = str(outputs["error"])
+            self._error_kinds[kind] = \
+                self._error_kinds.get(kind, 0) + 1
         else:
             self._latencies.append((self._clock() - started) * 1e3)
             if isinstance(outputs, dict) and "ttft_ms" in outputs:
@@ -175,6 +194,7 @@ class LoadGenerator:
         self._latencies = []
         self._ttfts = []
         self._errors = 0
+        self._error_kinds = {}
         self._tokens = 0
         self._run_index += 1
         run_tag = self._run_index
@@ -208,7 +228,8 @@ class LoadGenerator:
                           elapsed_s=elapsed,
                           latencies_ms=list(self._latencies),
                           tokens_total=self._tokens,
-                          ttfts_ms=list(self._ttfts))
+                          ttfts_ms=list(self._ttfts),
+                          error_kinds=dict(self._error_kinds))
 
 
 def service_scale_sweep(services: int, broker: str = "scale-sweep",
@@ -282,3 +303,148 @@ def service_scale_sweep(services: int, broker: str = "scale-sweep",
         process.terminate()
         engine.terminate()
         thread.join(timeout=5)
+
+
+def chaos_schedule(seed: int):
+    """The canonical seeded fault schedule for ``loadgen --chaos``:
+    one replica death mid-decode, streaming-increment message drops,
+    and a device-step stall — the three failure classes the serving
+    robustness machinery covers (re-dispatch, dedup-tolerant
+    streaming, watchdog/latency).  Deriving the plan purely from
+    ``seed`` is what makes a chaos run reproducible."""
+    from ..runtime import faults
+    return (
+        faults.FaultPlan(seed=seed)
+        # replica_a dies on its Nth pump — mid-decode under load.
+        .add("kill_replica", nth=6 + seed % 5, match="replica_a")
+        # Streamed increments are droppable by design (the final
+        # response is authoritative); finals are NOT dropped — nothing
+        # retries a silently-eaten terminal response.
+        .add("drop_message", nth=4, match="infer_partial")
+        .add("drop_message", nth=9, match="infer_partial")
+        # Latency blip well under the watchdog threshold: chaos runs
+        # exercise the stall POINT; the watchdog trip itself is
+        # unit-tested deterministically.
+        .add("stall_step", nth=7 + seed % 3, ms=40))
+
+
+def run_chaos(seed: int = 0, n_requests: int = 40,
+              rate_hz: float = 100.0,
+              drain_timeout_s: float = 90.0) -> LoadReport:
+    """Run an in-process 2-replica serving rig (loopback broker, real
+    event engine, Registrar + router) under :func:`chaos_schedule` and
+    return the LoadReport.  The invariant a chaos run checks:
+    ``report.lost == 0 and report.timeouts == 0`` — every request
+    reaches a terminal state (completed, or an explicit error like
+    ``deadline_exceeded``/``overloaded``) no matter which replica died
+    or which messages vanished.  CPU-friendly (tiny config); set
+    ``JAX_PLATFORMS=cpu`` when no accelerator is wanted."""
+    import numpy as np
+
+    from ..orchestration.continuous import (ContinuousBatchingServer,
+                                            ContinuousReplica)
+    from ..orchestration.serving import ReplicaRouter
+    from ..registry import Registrar
+    from ..runtime import (Process, actor_args, compose_instance,
+                           faults)
+    from ..runtime.event import EventEngine
+
+    def wait_for(predicate, timeout_s: float, what: str):
+        deadline = time.time() + timeout_s
+        while not predicate():
+            if time.time() > deadline:
+                raise TimeoutError(f"chaos rig: {what}")
+            time.sleep(0.02)
+
+    plan = faults.install(chaos_schedule(seed))
+    engine = EventEngine()
+    thread = engine.run_in_thread()
+    broker = f"chaos-{uuid.uuid4().hex[:6]}"
+    processes = []
+
+    def make_process(pid):
+        process = Process(namespace="chaos", hostname="h",
+                          pid=str(pid), engine=engine, broker=broker)
+        processes.append(process)
+        return process
+
+    generator = None
+    try:
+        registrar = Registrar(process=make_process(1))
+        wait_for(lambda: registrar.state == "primary", 10,
+                 "registrar primary")
+        for index, name in enumerate(("replica_a", "replica_b")):
+            # Same config+seed on purpose: greedy decode is replica-
+            # independent, so re-dispatched requests finish with the
+            # exact tokens the dead replica would have produced.
+            server = ContinuousBatchingServer(
+                config_name="tiny", slots=2, chunk_steps=4, seed=0,
+                max_queue=256, watchdog_s=5.0)
+            compose_instance(ContinuousReplica, actor_args(name),
+                             process=make_process(2 + index),
+                             server=server)
+        router = compose_instance(ReplicaRouter, actor_args("router"),
+                                  process=make_process(8))
+        wait_for(lambda: router.share["replicas"] == 2, 30,
+                 "router discovery")
+        generator = LoadGenerator(
+            make_process(9), f"{router.topic_path}/in",
+            payload_fn=lambda i: {
+                "tokens": np.arange(1, 5 + i % 3, dtype=np.int32),
+                "max_new_tokens": 6, "stream": 1},
+            rate_hz=rate_hz)
+        report = generator.run(n_requests,
+                               drain_timeout_s=drain_timeout_s)
+        report.server_stats = dict(
+            router.counters,
+            replicas_live=router.share["replicas"],
+            faults_fired=len(plan.fired))
+        return report
+    finally:
+        faults.uninstall()
+        if generator is not None:
+            generator.close()
+        for process in reversed(processes):
+            try:
+                process.terminate()
+            except Exception:  # noqa: BLE001 - the chaos run may have
+                pass           # already killed this process
+        engine.terminate()
+        thread.join(timeout=5)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    """``python -m aiko_services_tpu.tools.loadgen --chaos``: load
+    test under the seeded fault schedule; exit 1 if any request was
+    lost or hung."""
+    import argparse
+
+    parser = argparse.ArgumentParser(
+        description="Serving load generator (chaos mode: seeded "
+                    "fault-injection run asserting zero lost "
+                    "requests)")
+    parser.add_argument("--chaos", action="store_true",
+                        help="run the seeded fault schedule against "
+                             "an in-process 2-replica rig")
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--requests", type=int, default=40)
+    parser.add_argument("--rate-hz", type=float, default=100.0)
+    args = parser.parse_args(argv)
+    if not args.chaos:
+        parser.error("API runs use LoadGenerator directly; the CLI "
+                     "currently wires --chaos only")
+    report = run_chaos(seed=args.seed, n_requests=args.requests,
+                       rate_hz=args.rate_hz)
+    print(report)
+    print(f"router counters: {report.server_stats}")
+    if report.lost or report.timeouts:
+        print(f"CHAOS FAIL (seed={args.seed}): {report.lost} lost, "
+              f"{report.timeouts} hung")
+        return 1
+    print(f"CHAOS OK (seed={args.seed}): {report.sent}/{report.sent} "
+          "requests terminal under kill + drop + stall schedule")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -682,6 +682,125 @@ def bench_serving_continuous(slots=8, prompt_len=64, max_new=64,
     return out
 
 
+def bench_serving_faults(trials=5, max_new=24, prompt_len=8,
+                         chunk_steps=2):
+    """Failure-recovery latency through the FULL robustness path: kill
+    the replica holding a streaming request mid-stream and measure
+    kill → first post-failover token from the survivor (LWT death,
+    registrar eviction, router backoff + re-dispatch, prompt replay,
+    first fresh deduped increment).  p50/p95 over ``trials``
+    independent rigs.  Tiny config on purpose — this section measures
+    the control plane's recovery time, not the model."""
+    import uuid
+
+    from aiko_services_tpu.orchestration.client import InferClient
+    from aiko_services_tpu.orchestration.continuous import (
+        ContinuousBatchingServer, ContinuousReplica,
+    )
+    from aiko_services_tpu.orchestration.serving import ReplicaRouter
+    from aiko_services_tpu.registry import Registrar
+    from aiko_services_tpu.runtime import (
+        Process, actor_args, compose_instance,
+    )
+    from aiko_services_tpu.runtime.event import EventEngine
+
+    def wait_for(predicate, timeout_s, what):
+        deadline = time.time() + timeout_s
+        while not predicate():
+            if time.time() > deadline:
+                raise TimeoutError(f"serving_faults rig: {what}")
+            time.sleep(0.005)
+
+    recoveries = []
+    redispatches = 0
+    for _trial in range(trials):
+        engine = EventEngine()
+        thread = engine.run_in_thread()
+        broker = f"bench-faults-{uuid.uuid4().hex[:6]}"
+        processes = []
+
+        def make_process(pid):
+            process = Process(namespace="benchfaults", hostname="h",
+                              pid=str(pid), engine=engine,
+                              broker=broker)
+            processes.append(process)
+            return process
+
+        try:
+            registrar = Registrar(process=make_process(1))
+            wait_for(lambda: registrar.state == "primary", 10,
+                     "registrar primary")
+            procs_by_topic = {}
+            for index, name in enumerate(("fr_a", "fr_b")):
+                # Same seed on both: greedy parity across the failover.
+                server = ContinuousBatchingServer(
+                    config_name="tiny", slots=2,
+                    chunk_steps=chunk_steps, seed=0)
+                replica = compose_instance(
+                    ContinuousReplica, actor_args(name),
+                    process=make_process(2 + index), server=server)
+                procs_by_topic[replica.topic_path] = processes[-1]
+            router = compose_instance(
+                ReplicaRouter, actor_args("router"),
+                process=make_process(8))
+            wait_for(lambda: router.share["replicas"] == 2, 30,
+                     "router discovery")
+            client = InferClient(make_process(9),
+                                 f"{router.topic_path}/in")
+            prompt = np.arange(1, 1 + prompt_len, dtype=np.int32)
+            stamps = [[], []]
+            futures = [
+                client.submit(
+                    prompt, max_new_tokens=max_new, stream=True,
+                    on_partial=lambda inc, s=stamps[i]:
+                        s.append(time.monotonic()))
+                for i in range(2)]
+            victim = futures[0]
+            wait_for(lambda: victim.partial_tokens, 120,
+                     "first pre-kill token")
+            holder = router._inflight[victim.request_id]["replica"]
+            t_kill = time.monotonic()
+            procs_by_topic[holder].kill()
+            wait_for(lambda: router.counters["redispatches"] >= 1, 30,
+                     "re-dispatch")
+            t_redispatch = time.monotonic()
+            wait_for(lambda: victim.done, 60, "failover completion")
+            assert victim.error is None, victim.error
+            post = [t for t in stamps[0] if t >= t_redispatch]
+            assert post, "no post-failover token observed"
+            recoveries.append(post[0] - t_kill)
+            redispatches += router.counters["redispatches"]
+            # Greedy parity across the failover (same-seed replicas,
+            # identical prompts -> identical completions).
+            client.wait(futures[1], timeout=60)
+            assert futures[1].tokens == victim.tokens, \
+                (futures[1].tokens, victim.tokens)
+        finally:
+            for process in reversed(processes):
+                try:
+                    process.terminate()
+                except Exception:  # noqa: BLE001 - one process per
+                    pass           # trial was already killed
+            engine.terminate()
+            thread.join(timeout=5)
+
+    ordered = sorted(recoveries)
+
+    def quantile(fraction):
+        return ordered[min(len(ordered) - 1,
+                           int(fraction * len(ordered)))]
+
+    log(f"serving[faults] recovery over {trials} kills: "
+        f"p50 {quantile(0.5) * 1e3:.0f} ms, "
+        f"p95 {quantile(0.95) * 1e3:.0f} ms "
+        f"({redispatches} re-dispatches)")
+    return {"serving_faults_recovery_p50_ms":
+                round(quantile(0.5) * 1e3, 1),
+            "serving_faults_recovery_p95_ms":
+                round(quantile(0.95) * 1e3, 1),
+            "serving_faults_trials": trials}
+
+
 def bench_serving_8b(paged=False, slots=16, prompt_len=128,
                      max_new=128, n_requests=32, chunk_steps=8,
                      lookahead=4, config_name="llama3_8b",
@@ -1578,6 +1697,12 @@ SECTIONS = [
          slots=2, prompt_len=16, max_new=8, n_requests=4,
          config_name="tiny", chunk_steps=4))
      if SMOKE else bench_serving_continuous),
+    # Control-plane recovery latency (tiny model, CPU-capable): the
+    # kill→first-post-failover-token percentiles for the serving
+    # robustness machinery.
+    ("serving_faults", 600,
+     (lambda: bench_serving_faults(trials=2, max_new=12))
+     if SMOKE else bench_serving_faults),
     ("serving_paged", 420,
      (lambda: bench_serving_paged(
          slots=2, prompt_len=24, max_new=8, n_requests=4,

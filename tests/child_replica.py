@@ -5,7 +5,14 @@ named by AIKO_MQTT_HOST/AIKO_MQTT_PORT, optionally hosts the Registrar
 (CHILD_REGISTRAR=1), composes a ModelReplica serving the tiny
 Llama-architecture model, prints READY, and serves until killed — a
 one-chip serving worker as LifeCycleManager/ProcessManager would spawn
-it."""
+it.
+
+CHILD_CONTINUOUS=1 instead composes a streaming ContinuousReplica
+(continuous-batching server, fixed seed so every child produces the
+same greedy completion) for the failover tests.  AIKO_FAULTS is
+honoured through the fault module's env bootstrap — the chaos test
+hands one child a ``kill_replica`` schedule and expects the other to
+finish its work."""
 
 import os
 import sys
@@ -30,11 +37,20 @@ def main():
     process = Process(engine=engine, transport="mqtt")
     if os.environ.get("CHILD_REGISTRAR") == "1":
         Registrar(process=process)
-    compose_instance(
-        ModelReplica,
-        actor_args(os.environ.get("CHILD_REPLICA_NAME", "replica")),
-        process=process,
-        infer=make_llama_infer("tiny", max_new_tokens=4))
+    name = os.environ.get("CHILD_REPLICA_NAME", "replica")
+    if os.environ.get("CHILD_CONTINUOUS") == "1":
+        from aiko_services_tpu.orchestration.continuous import (
+            ContinuousBatchingServer, ContinuousReplica,
+        )
+        server = ContinuousBatchingServer(
+            config_name="tiny", slots=2, max_seq=64, chunk_steps=3,
+            seed=0, max_queue=64, watchdog_s=10.0)
+        compose_instance(ContinuousReplica, actor_args(name),
+                         process=process, server=server)
+    else:
+        compose_instance(
+            ModelReplica, actor_args(name), process=process,
+            infer=make_llama_infer("tiny", max_new_tokens=4))
     print("READY", flush=True)
     engine.loop()
 

@@ -49,6 +49,14 @@ def _fresh_brokers():
     reset_brokers()
 
 
+@pytest.fixture(autouse=True)
+def _no_leaked_faults():
+    """Never let a fault-injection plan escape the test that armed it."""
+    yield
+    from aiko_services_tpu.runtime import faults
+    faults.uninstall()
+
+
 @pytest.fixture()
 def engine():
     """Deterministic event engine driven by a virtual clock."""

@@ -1,0 +1,165 @@
+"""Chaos: seeded fault schedules against the full serving stack.
+
+The in-process runs drive :func:`~aiko_services_tpu.tools.loadgen
+.run_chaos` — a real EventEngine, loopback broker, Registrar, two
+continuous replicas, a router, and a seeded
+:class:`~aiko_services_tpu.runtime.faults.FaultPlan` that kills a
+replica and drops/delays wire messages mid-run.  The invariant under
+test is ZERO LOST REQUESTS: every submitted request reaches a terminal
+state (tokens or a typed error), reproducibly from the seed.
+
+The cross-process test is the real thing: two OS-process replicas over
+the built-in MQTT broker, one of them armed (via the ``AIKO_FAULTS``
+env bootstrap) to hard-exit mid-stream; its LWT fires over the broker,
+the Registrar evicts it, and the router in THIS process re-dispatches
+the stranded streaming request to the survivor — which must complete
+it with exact greedy parity and no token delivered twice.
+"""
+
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from tests.test_cross_process import (  # noqa: F401 (broker fixture)
+    REPO_ROOT, broker, read_ready, wait_for,
+)
+
+pytestmark = pytest.mark.chaos
+
+
+def test_chaos_zero_lost_requests():
+    """Kill a replica + drop streaming partials + stall a device step,
+    all mid-run: every request still resolves, and the router's
+    counters account for the injected faults."""
+    from aiko_services_tpu.tools.loadgen import run_chaos
+
+    report = run_chaos(seed=1, n_requests=8, rate_hz=200.0)
+    assert report.lost == 0, report
+    assert report.timeouts == 0, report
+    assert report.completed + sum(report.error_kinds.values()) == 8
+    stats = report.server_stats
+    assert stats["replica_deaths_observed"] == 1
+    assert stats["redispatches"] >= 1       # stranded work was moved
+    assert stats["faults_fired"] >= 2       # the schedule really ran
+    assert stats["replicas_live"] == 1
+
+
+def test_chaos_reproducible_from_seed():
+    """Same seed -> same fault firings and the same outcome tallies
+    (the property that makes a chaos failure debuggable)."""
+    from aiko_services_tpu.tools.loadgen import chaos_schedule
+
+    def firings(seed):
+        plan = chaos_schedule(seed)
+        return [(rule.point, rule.nth, rule.match)
+                for rule in plan._rules] + [plan.seed]
+
+    assert firings(4) == firings(4)
+    assert firings(4) != firings(5)         # the schedule DOES vary
+
+
+def test_chaos_long_schedule():
+    """Longer run, different seed: the kill lands at a different
+    request index and drops hit different partials — the invariant
+    (nothing lost, exactly one death observed) must hold anyway."""
+    from aiko_services_tpu.tools.loadgen import run_chaos
+
+    report = run_chaos(seed=3, n_requests=40, rate_hz=100.0)
+    assert report.lost == 0, report
+    assert report.timeouts == 0, report
+    assert report.server_stats["replica_deaths_observed"] == 1
+
+
+def test_cross_process_failover_mid_stream(broker, monkeypatch):
+    """Two continuous-batching replicas in REAL OS processes, one armed
+    to hard-exit (os._exit) on its 4th serving pump.  Its MQTT LWT
+    fires, the Registrar (in the surviving child) evicts it, and the
+    router here re-dispatches the dead replica's streaming request to
+    the survivor.  Both requests must complete with identical greedy
+    tokens (same-seed children) and no streamed token delivered
+    twice."""
+    from aiko_services_tpu.orchestration.client import InferClient
+    from aiko_services_tpu.orchestration.serving import ReplicaRouter
+    from aiko_services_tpu.runtime import (
+        Process, actor_args, compose_instance,
+    )
+    from aiko_services_tpu.runtime.event import EventEngine
+
+    monkeypatch.setenv("AIKO_MQTT_HOST", broker.host)
+    monkeypatch.setenv("AIKO_MQTT_PORT", str(broker.port))
+    namespace = f"chaos{broker.port}"
+    children = []
+    for name, registrar, fault_spec in (
+            ("replica_live", "1", ""),
+            ("replica_kill", "0",
+             "kill_replica:nth=4:hard=1:match=replica_kill")):
+        env = dict(os.environ,
+                   AIKO_MQTT_HOST=broker.host,
+                   AIKO_MQTT_PORT=str(broker.port),
+                   AIKO_NAMESPACE=namespace,
+                   JAX_PLATFORMS="cpu",
+                   CHILD_REGISTRAR=registrar,
+                   CHILD_CONTINUOUS="1",
+                   CHILD_REPLICA_NAME=name)
+        if fault_spec:
+            env["AIKO_FAULTS"] = fault_spec
+        child = subprocess.Popen(
+            [sys.executable, "-m", "tests.child_replica"],
+            cwd=REPO_ROOT, env=env, stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE, text=True)
+        children.append(read_ready(child, timeout=120))
+
+    engine = EventEngine()
+    thread = engine.run_in_thread()
+    process = None
+    try:
+        process = Process(namespace=namespace, engine=engine,
+                          transport="mqtt")
+        assert wait_for(lambda: process.message.connected, 10)
+        router = compose_instance(
+            ReplicaRouter, actor_args("router"), process=process)
+        assert wait_for(lambda: router.share["replicas"] == 2, 30), \
+            router.share
+
+        client = InferClient(process, f"{router.topic_path}/in")
+        prompt = np.arange(1, 8, dtype=np.int32)
+        futures = [client.submit(prompt, max_new_tokens=12,
+                                 stream=True) for _ in range(2)]
+        for future in futures:
+            client.wait(future, timeout=240.0)
+            assert future.done and future.error is None, \
+                (future.request_id, future.error)
+            assert len(future.tokens) == 12
+            # Offset dedup across the failover: the concatenated
+            # streamed increments ARE the final sequence.
+            assert future.partial_tokens == future.tokens
+        # Greedy parity: the re-dispatched request (replayed from the
+        # prompt on the survivor) matches the uninterrupted one.
+        assert futures[0].tokens == futures[1].tokens
+
+        # The fleet really lost a member and the router really moved
+        # work: counters match the injected fault.
+        assert wait_for(
+            lambda: router.counters["replica_deaths_observed"] == 1, 30)
+        assert router.counters["redispatches"] >= 1
+        assert router._inflight == {}
+
+        # The armed child died by its own injector, not our teardown.
+        dead = children[1]
+        assert wait_for(lambda: dead.poll() is not None, 30)
+        assert dead.returncode == 13
+    finally:
+        if process is not None:
+            process.terminate()
+        engine.terminate()
+        thread.join(timeout=5)
+        for child in children:
+            child.terminate()
+            try:
+                child.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                child.kill()

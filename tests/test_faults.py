@@ -1,0 +1,602 @@
+"""Fault injection & serving robustness: the FaultPlan switchboard,
+zero-cost guard discipline (AST + jaxpr), deadlines, backpressure
+shedding, the device watchdog, client wait semantics, router
+re-dispatch, and ProcessManager escalation — all CPU, all
+deterministic."""
+
+import ast
+import os
+import pathlib
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from aiko_services_tpu.runtime import faults
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+PKG = REPO / "aiko_services_tpu"
+
+
+# ---------------------------------------------------------------- #
+# FaultPlan semantics
+# ---------------------------------------------------------------- #
+
+def test_plan_nth_fires_exactly_once():
+    plan = faults.FaultPlan(seed=0).add("stall_step", nth=3, ms=80)
+    hits = [plan.check("stall_step") for _ in range(6)]
+    assert [h is not None for h in hits] == [False, False, True,
+                                             False, False, False]
+    assert hits[2] == {"ms": 80}
+    assert plan.fires("stall_step") == 1
+    assert plan.fired == [("stall_step", "", "stall_step:nth=3")]
+
+
+def test_plan_match_filters_by_site_key():
+    plan = faults.FaultPlan().add("drop_message", nth=1,
+                                  match="infer_partial")
+    assert plan.check("drop_message", key="t (infer_response r1)") \
+        is None
+    assert plan.check("drop_message", key="t (infer_partial r1)") \
+        is not None
+    # Non-matching calls never advanced the rule's counter.
+    assert plan.fires("drop_message") == 1
+
+
+def test_plan_prob_is_seed_deterministic():
+    def pattern(seed):
+        plan = faults.FaultPlan(seed=seed).add("drop_message",
+                                               prob=0.3)
+        return [plan.check("drop_message") is not None
+                for _ in range(50)]
+
+    assert pattern(7) == pattern(7)          # same seed, same firings
+    assert any(pattern(7)) and not all(pattern(7))
+
+
+def test_plan_rejects_bad_rules():
+    with pytest.raises(ValueError):
+        faults.FaultPlan().add("not_a_point", nth=1)
+    with pytest.raises(ValueError):
+        faults.FaultPlan().add("stall_step")     # neither nth nor prob
+
+
+def test_plan_from_spec_round_trip():
+    plan = faults.plan_from_spec(
+        "seed=7;kill_replica:nth=5:hard=1;"
+        "drop_message:prob=0.05:match=infer_partial;"
+        "stall_step:nth=3:ms=80")
+    assert plan.seed == 7
+    kill, drop, stall = plan._rules
+    assert (kill.point, kill.nth, kill.params) == \
+        ("kill_replica", 5, {"hard": 1})
+    assert (drop.point, drop.prob, drop.match) == \
+        ("drop_message", 0.05, "infer_partial")
+    assert (stall.point, stall.nth, stall.params) == \
+        ("stall_step", 3, {"ms": 80})
+    with pytest.raises(ValueError):
+        faults.plan_from_spec("stall_step:nth")
+
+
+def test_env_bootstrap_installs_plan():
+    """A child process selects faults purely via AIKO_FAULTS — the
+    hook the chaos children rely on."""
+    out = subprocess.run(
+        [sys.executable, "-c",
+         "from aiko_services_tpu.runtime import faults; "
+         "print(repr(faults.PLAN))"],
+        env=dict(os.environ, AIKO_FAULTS="seed=3;stall_step:nth=2:ms=9"),
+        cwd=REPO, capture_output=True, text=True, timeout=60)
+    assert out.returncode == 0, out.stderr
+    assert "seed=3" in out.stdout and "stall_step:nth=2" in out.stdout
+
+
+# ---------------------------------------------------------------- #
+# Zero-cost guard discipline
+# ---------------------------------------------------------------- #
+
+_INJECTION_MODULES = (
+    PKG / "orchestration" / "continuous.py",
+    PKG / "runtime" / "process.py",
+    PKG / "runtime" / "lease.py",
+)
+_JIT_MODULES = (
+    PKG / "models" / "llama.py",
+    PKG / "ops" / "paged_attention.py",
+    PKG / "ops" / "paged_prefill.py",
+)
+
+
+def _is_plan_check(node) -> bool:
+    """Matches ``faults.PLAN.check(...)``."""
+    return (isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "check"
+            and isinstance(node.func.value, ast.Attribute)
+            and node.func.value.attr == "PLAN"
+            and isinstance(node.func.value.value, ast.Name)
+            and node.func.value.value.id == "faults")
+
+
+def _is_plan_guard(test) -> bool:
+    """Matches the ``faults.PLAN is not None`` guard expression."""
+    return (isinstance(test, ast.Compare)
+            and isinstance(test.ops[0], ast.IsNot)
+            and isinstance(test.left, ast.Attribute)
+            and test.left.attr == "PLAN")
+
+
+def test_every_injection_site_is_guarded():
+    """Every ``faults.PLAN.check`` call sits under an ``if faults.PLAN
+    is not None`` guard — disabled fault injection costs one attribute
+    load + identity test, nothing more."""
+    offenders = []
+    for path in _INJECTION_MODULES:
+        tree = ast.parse(path.read_text())
+        guarded = set()
+        for node in ast.walk(tree):
+            if isinstance(node, ast.If) and _is_plan_guard(node.test):
+                for sub in ast.walk(node):
+                    if _is_plan_check(sub):
+                        guarded.add(id(sub))
+        for node in ast.walk(tree):
+            if _is_plan_check(node) and id(node) not in guarded:
+                offenders.append(f"{path.name}:{node.lineno}")
+    assert not offenders, \
+        f"unguarded faults.PLAN.check sites: {offenders}"
+
+
+def test_injection_sites_exist_where_wired():
+    """The docstring's site table is real: each wired module contains
+    at least one guarded check call."""
+    for path in _INJECTION_MODULES:
+        tree = ast.parse(path.read_text())
+        assert any(_is_plan_check(node) for node in ast.walk(tree)), \
+            f"{path.name} lost its injection site"
+
+
+def test_no_fault_code_in_jitted_modules():
+    """Model/kernels modules must not reference the faults module at
+    all: injection lives in host orchestration only, so jitted
+    programs cannot possibly change shape under a plan."""
+    for path in _JIT_MODULES:
+        tree = ast.parse(path.read_text())
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Name) and node.id == "faults":
+                raise AssertionError(
+                    f"{path.name}:{node.lineno} references faults")
+            if isinstance(node, (ast.Import, ast.ImportFrom)):
+                names = [a.name for a in node.names]
+                assert not any("faults" in n for n in names), \
+                    f"{path.name}:{node.lineno} imports faults"
+
+
+def test_installed_plan_does_not_change_jaxpr():
+    """The serving chunk's traced program is bit-identical with a plan
+    installed vs not — injection points are host-side, compiled code
+    is untouched."""
+    import jax
+
+    from aiko_services_tpu.models import llama
+    from aiko_services_tpu.orchestration.continuous import (
+        ContinuousBatchingServer,
+    )
+
+    server = ContinuousBatchingServer(config_name="tiny", slots=2,
+                                      max_seq=32, chunk_steps=2)
+
+    def trace():
+        return str(jax.make_jaxpr(
+            lambda state, cache: llama.serve_chunk_ragged(
+                server.params, state, cache, 2, server.config,
+                eos_id=-1, sampled=False))(server._state, server.cache))
+
+    clean = trace()
+    faults.install(faults.FaultPlan().add("stall_step", nth=1, ms=50))
+    try:
+        assert trace() == clean
+    finally:
+        faults.uninstall()
+
+
+# ---------------------------------------------------------------- #
+# Transport / lease injection points
+# ---------------------------------------------------------------- #
+
+def test_drop_message_point(engine):
+    from aiko_services_tpu.runtime import Process
+
+    process = Process(namespace="test", hostname="h", pid="1",
+                      engine=engine, broker="faultdrop")
+    got = []
+    process.add_message_handler(lambda t, p: got.append(p), "t/drop")
+    faults.install(faults.FaultPlan().add("drop_message", nth=1,
+                                          match="t/drop"))
+    process.message.publish("t/drop", "(one)")
+    process.message.publish("t/drop", "(two)")
+    engine.drain()
+    assert got == ["(two)"]                  # first was eaten
+
+
+def test_delay_message_point(engine):
+    from aiko_services_tpu.runtime import Process
+
+    process = Process(namespace="test", hostname="h", pid="1",
+                      engine=engine, broker="faultdelay")
+    got = []
+    process.add_message_handler(lambda t, p: got.append(p), "t/delay")
+    faults.install(faults.FaultPlan().add("delay_message", nth=1,
+                                          match="t/delay", ms=20))
+    process.message.publish("t/delay", "(late)")
+    engine.drain()
+    assert got == []                         # held by the wall timer
+    deadline = time.time() + 5
+    while not got and time.time() < deadline:
+        time.sleep(0.01)
+        engine.drain()
+    assert got == ["(late)"]
+
+
+def test_expire_lease_point(engine):
+    from aiko_services_tpu.runtime.lease import Lease
+
+    expired = []
+    lease = Lease(10.0, "L1", lease_expired_handler=expired.append,
+                  engine=engine)
+    faults.install(faults.FaultPlan().add("expire_lease", nth=1))
+    lease.extend()
+    assert lease.terminated and expired == ["L1"]
+
+
+# ---------------------------------------------------------------- #
+# Deadlines & backpressure (server level)
+# ---------------------------------------------------------------- #
+
+def _server(**kwargs):
+    from aiko_services_tpu.orchestration.continuous import (
+        ContinuousBatchingServer,
+    )
+    kwargs.setdefault("config_name", "tiny")
+    kwargs.setdefault("slots", 2)
+    kwargs.setdefault("max_seq", 64)
+    kwargs.setdefault("chunk_steps", 2)
+    return ContinuousBatchingServer(**kwargs)
+
+
+def _request(request_id, max_new=4, **kwargs):
+    from aiko_services_tpu.orchestration.continuous import DecodeRequest
+    return DecodeRequest(request_id=request_id,
+                         prompt=np.arange(1, 6, dtype=np.int32),
+                         max_new_tokens=max_new, **kwargs)
+
+
+def test_deadline_rejects_expired_at_admission():
+    server = _server()
+    request = _request("r1", deadline_ts=time.monotonic() - 0.01)
+    server.submit(request)
+    assert request.error == "deadline_exceeded"
+    assert request.finished_ts is not None
+    assert server.counters["deadline_exceeded"] == 1
+    assert server.step() == [request]        # flows out normally
+
+
+def test_deadline_evicts_queued_and_live():
+    server = _server(slots=1)
+    # Warm the compiled programs so the deadline race below measures
+    # decode steps, not XLA compilation.
+    warm = _request("warm", max_new=4)
+    server.submit(warm)
+    server.run_until_drained()
+    # Every decode step now stalls 30 ms, so the hog cannot finish its
+    # 40-token budget inside the 0.15 s deadline — but it DOES commit
+    # a few chunks first (partial work preserved on eviction).
+    faults.install(faults.FaultPlan().add("stall_step", prob=1.0,
+                                          ms=30))
+    hog = _request("hog", max_new=40,
+                   deadline_ts=time.monotonic() + 0.15)
+    queued = _request("queued", deadline_ts=time.monotonic() + 0.15)
+    server.submit(hog)
+    server.submit(queued)
+    done = []
+    deadline = time.time() + 60
+    while len(done) < 2 and time.time() < deadline:
+        done.extend(server.step())
+    by_id = {r.request_id: r for r in done}
+    assert by_id["hog"].error == "deadline_exceeded"
+    assert by_id["hog"].tokens              # partial work preserved
+    assert by_id["queued"].error == "deadline_exceeded"
+    assert server.counters["deadline_exceeded"] == 2
+    assert not server.busy                  # slot actually freed
+
+
+def test_overload_shed_with_retry_after():
+    server = _server(max_queue=1)
+    server.submit(_request("q0"))
+    shed = _request("q1")
+    server.submit(shed)
+    assert shed.error == "overloaded"
+    assert shed.retry_after_ms and shed.retry_after_ms > 0
+    assert server.counters["shed"] == 1
+    stats = server.stats()
+    assert stats["shed"] == 1 and stats["free_slots"] == server.slots
+
+
+def test_watchdog_trips_and_fails_retriable():
+    server = _server(slots=1, watchdog_s=0.01)
+    faults.install(faults.FaultPlan().add("stall_step", nth=1, ms=60))
+    victim = _request("w1", max_new=8)
+    server.submit(victim)
+    done = []
+    deadline = time.time() + 30
+    while not done and time.time() < deadline:
+        done.extend(server.step())
+    assert victim.error == "watchdog_stalled"
+    assert server.healthy is False
+    assert server.counters["watchdog_trips"] >= 1
+    assert server.stats()["healthy"] == 0
+    # Tripped = permanently unhealthy: new work is rejected with the
+    # same RETRIABLE error so a router moves it elsewhere.
+    late = _request("w2")
+    server.submit(late)
+    assert late.error == "watchdog_stalled"
+
+
+# ---------------------------------------------------------------- #
+# Client wait semantics
+# ---------------------------------------------------------------- #
+
+def test_client_wait_timeout_resolves_future(engine):
+    from aiko_services_tpu.orchestration.client import InferClient
+    from aiko_services_tpu.runtime import Process
+
+    process = Process(namespace="test", hostname="h", pid="1",
+                      engine=engine, broker="cliwait")
+    client = InferClient(process, "nowhere/in")
+    future = client.submit(np.arange(1, 5, dtype=np.int32))
+    client.wait(future, timeout=0.05)
+    assert future.done and future.error == "timeout"
+    assert client._futures == {}            # late replies are dropped
+
+
+def test_client_wait_wakes_on_resolve(engine):
+    """The condition-variable wake: a resolve from another thread
+    returns wait() immediately, not at the poll interval or timeout."""
+    from aiko_services_tpu.orchestration.client import InferClient
+    from aiko_services_tpu.runtime import Process
+
+    process = Process(namespace="test", hostname="h", pid="1",
+                      engine=engine, broker="cliwake")
+    client = InferClient(process, "nowhere/in")
+    future = client.submit(np.arange(1, 5, dtype=np.int32))
+    timer = threading.Timer(
+        0.05, lambda: future._resolve({"tokens_out":
+                                       np.asarray([3], np.int32)},
+                                      None))
+    timer.start()
+    started = time.monotonic()
+    client.wait(future, timeout=30.0)
+    elapsed = time.monotonic() - started
+    assert future.done and future.error is None
+    assert elapsed < 5.0                    # woke, never hit timeout
+    timer.cancel()
+
+
+# ---------------------------------------------------------------- #
+# Router: cancel_unrouted, shed, re-dispatch
+# ---------------------------------------------------------------- #
+
+def _router_rig(engine, broker):
+    from aiko_services_tpu.orchestration.serving import ReplicaRouter
+    from aiko_services_tpu.registry import Registrar
+    from aiko_services_tpu.runtime import (
+        Process, actor_args, compose_instance,
+    )
+
+    p0 = Process(namespace="test", hostname="h", pid="1",
+                 engine=engine, broker=broker)
+    Registrar(process=p0)
+    engine.advance(4.0)
+    pr = Process(namespace="test", hostname="h", pid="9",
+                 engine=engine, broker=broker)
+    router = compose_instance(ReplicaRouter, actor_args("router"),
+                              process=pr)
+    engine.drain()
+    return pr, router
+
+
+def test_router_cancel_unrouted_resolves_future(engine):
+    from aiko_services_tpu.orchestration.client import (
+        InferClient, InferFuture,
+    )
+
+    pr, router = _router_rig(engine, "cancelun")
+    client = InferClient(pr, f"{router.topic_path}/in")
+    ghost = InferFuture("ghost1")
+    client._futures["ghost1"] = ghost
+    client.cancel(ghost)
+    engine.drain()
+    assert ghost.done and ghost.error == "cancel_unrouted"
+    assert router.counters["cancel_unrouted"] == 1
+
+
+def test_router_sheds_when_all_replicas_saturated(engine):
+    pr, router = _router_rig(engine, "satur")
+    responses = []
+
+    def on_response(_topic, payload):
+        from aiko_services_tpu.pipeline.codec import decode_swag
+        from aiko_services_tpu.utils.sexpr import parse
+        command, params = parse(payload)
+        if command == "infer_response":
+            responses.append(decode_swag(params[1]))
+
+    pr.add_message_handler(on_response, "test/client/resp")
+    # Hand the router a saturated 2-replica view (no real replicas —
+    # this is the pure shed decision).
+    router.shed_queue_depth = 4
+    router._replicas = ["test/h/21/1", "test/h/22/1"]
+    router._loads = {"test/h/21/1": {"queue_depth": 4},
+                     "test/h/22/1": {"queue_depth": 9}}
+    assert router.route("s1", "test/client/resp", {}) is False
+    engine.drain()
+    assert responses and responses[0]["error"] == "overloaded"
+    assert int(np.asarray(responses[0]["retry_after_ms"])) == 200
+    assert router.counters["shed"] == 1
+    # One replica below threshold -> routes again.
+    router._loads["test/h/21/1"]["queue_depth"] = 0
+    assert router.route("s2", "test/client/resp", {}) is True
+
+
+def test_router_p2c_prefers_shallow_queue(engine):
+    _, router = _router_rig(engine, "p2c")
+    router._replicas = ["test/h/21/1", "test/h/22/1"]
+    router._loads = {"test/h/21/1": {"queue_depth": 7},
+                     "test/h/22/1": {"queue_depth": 1}}
+    picks = {router._pick(list(router._replicas)) for _ in range(8)}
+    assert picks == {"test/h/22/1"}          # always the shallow one
+    # Unknown load on ANY candidate -> exact round-robin (the pinned
+    # served == [3,3,3] behavior).
+    del router._loads["test/h/21/1"]["queue_depth"]
+    picks = [router._pick(list(router._replicas)) for _ in range(4)]
+    assert picks == ["test/h/21/1", "test/h/22/1"] * 2
+
+
+def test_router_redispatch_streaming_failover(engine):
+    """The tentpole, in-process and deterministic: two same-seed
+    continuous replicas behind a router, the one HOLDING a streaming
+    request dies mid-stream (LWT -> registrar eviction -> drain), the
+    request re-dispatches to the survivor and completes with EXACT
+    greedy parity and no token delivered twice."""
+    from aiko_services_tpu.orchestration.client import InferClient
+    from aiko_services_tpu.orchestration.continuous import (
+        ContinuousBatchingServer, ContinuousReplica,
+    )
+    from aiko_services_tpu.orchestration.serving import ReplicaRouter
+    from aiko_services_tpu.registry import Registrar
+    from aiko_services_tpu.runtime import (
+        Process, actor_args, compose_instance,
+    )
+    from .test_continuous import reference_greedy
+
+    broker = "failover"
+    p0 = Process(namespace="test", hostname="h", pid="1",
+                 engine=engine, broker=broker)
+    Registrar(process=p0)
+    engine.advance(4.0)
+    procs, servers = {}, {}
+    for index, name in enumerate(("cba", "cbb")):
+        p = Process(namespace="test", hostname="h", pid=str(20 + index),
+                    engine=engine, broker=broker)
+        server = ContinuousBatchingServer(
+            config_name="tiny", slots=2, max_seq=64, chunk_steps=2,
+            seed=0)
+        replica = compose_instance(ContinuousReplica, actor_args(name),
+                                   process=p, server=server)
+        procs[replica.topic_path] = p
+        servers[replica.topic_path] = server
+    pr = Process(namespace="test", hostname="h", pid="9",
+                 engine=engine, broker=broker)
+    router = compose_instance(ReplicaRouter, actor_args("router"),
+                              process=pr)
+    engine.drain()
+    assert router.share["replicas"] == 2
+
+    client = InferClient(pr, f"{router.topic_path}/in")
+    prompt = np.arange(1, 8, dtype=np.int32)
+    increments = []
+    victim = client.submit(prompt, max_new_tokens=12, stream=True,
+                           on_partial=increments.append)
+    for _ in range(20000):
+        engine.advance(0.001)
+        if victim.partial_tokens:
+            break
+    assert victim.partial_tokens and not victim.done
+
+    holder = router._inflight[victim.request_id]["replica"]
+    survivor = next(t for t in procs if t != holder)
+    procs[holder].kill()                    # LWT -> eviction -> drain
+    for _ in range(60000):
+        engine.advance(0.001)
+        if victim.done:
+            break
+    assert victim.done and victim.error is None
+    want = reference_greedy(servers[survivor], prompt, 12)
+    assert victim.tokens == want
+    # Offset dedup: concatenated streamed increments == the final
+    # sequence, even though the survivor re-streamed from token 0.
+    assert [t for inc in increments for t in inc] == want
+    assert victim.partial_tokens == want
+    assert router.counters["redispatches"] == 1
+    assert router.counters["replica_deaths_observed"] == 1
+    assert router._inflight == {}           # tracking closed out
+
+
+def test_corrupt_response_resolves_future(engine):
+    from aiko_services_tpu.orchestration.client import InferClient
+    from aiko_services_tpu.orchestration.continuous import (
+        ContinuousBatchingServer, ContinuousReplica,
+    )
+    from aiko_services_tpu.runtime import (
+        Process, actor_args, compose_instance,
+    )
+
+    process = Process(namespace="test", hostname="h", pid="1",
+                      engine=engine, broker="corrupt")
+    server = ContinuousBatchingServer(config_name="tiny", slots=1,
+                                      max_seq=64, chunk_steps=2)
+    replica = compose_instance(ContinuousReplica, actor_args("cx0"),
+                               process=process, server=server)
+    client = InferClient(process, replica.topic_in)
+    faults.install(faults.FaultPlan().add("corrupt_response", nth=1))
+    future = client.submit(np.arange(1, 6, dtype=np.int32),
+                           max_new_tokens=3)
+    for _ in range(20000):
+        engine.advance(0.001)
+        if future.done:
+            break
+    assert future.done and future.error == "corrupt_response"
+
+
+# ---------------------------------------------------------------- #
+# ProcessManager escalation
+# ---------------------------------------------------------------- #
+
+def test_process_manager_escalation_paths():
+    from aiko_services_tpu.orchestration.process_manager import (
+        ProcessManager,
+    )
+
+    manager = ProcessManager()
+
+    # Cooperative child: SIGTERM suffices.
+    manager.processes["good"] = subprocess.Popen(
+        [sys.executable, "-c", "import time; time.sleep(60)"])
+    assert manager.delete("good", grace=10.0, wait=10.0) == "terminated"
+
+    # SIGTERM-ignoring child: the grace wait expires and escalates.
+    stubborn = subprocess.Popen(
+        [sys.executable, "-c",
+         "import signal, time; "
+         "signal.signal(signal.SIGTERM, signal.SIG_IGN); "
+         "print('armed', flush=True); time.sleep(60)"],
+        stdout=subprocess.PIPE, text=True)
+    assert stubborn.stdout.readline().strip() == "armed"
+    manager.processes["stubborn"] = stubborn
+    manager.commands["stubborn"] = ["stubborn"]
+    assert manager.delete("stubborn", grace=0.5, wait=10.0) == \
+        "escalated_kill"
+    assert stubborn.poll() is not None
+
+    # Immediate kill, and the unknown/already-exited outcomes.
+    quick = subprocess.Popen([sys.executable, "-c",
+                              "import time; time.sleep(60)"])
+    manager.processes["quick"] = quick
+    assert manager.delete("quick", kill=True, wait=10.0) == "killed"
+    assert manager.delete("missing") is None
+    gone = subprocess.Popen([sys.executable, "-c", "pass"])
+    gone.wait(timeout=30)
+    manager.processes["gone"] = gone
+    assert manager.delete("gone") == "already_exited"
