@@ -1,0 +1,34 @@
+"""Distributed KV-cache subsystem: cluster-wide prefix reuse.
+
+Three layers (docs/SERVING.md "Distributed KV cache & prefix-aware
+routing"):
+
+1. **Prefix directory** (:mod:`.directory`) — each paged replica
+   publishes a compact digest of its cached prefix blocks (rolling
+   chain hash per block, refcount, hotness) through its existing
+   EC-share state topic; the router merges those into a
+   :class:`~.directory.PrefixDirectory` keyed by prefix hash with
+   lease-based staleness eviction.
+2. **Prefix-aware routing** — :class:`~..orchestration.serving
+   .ReplicaRouter` scores candidates by ``queue_depth − α ·
+   matched_prefix_blocks`` using the directory (exact P2C fallback
+   when nothing matches).
+3. **KV block transfer** (:mod:`.transfer`) — a replica→replica RPC
+   exporting table-resolved pool blocks (bf16 or int8 + scales) and
+   importing them into a peer's pool under a lease: warm-start and
+   opt-in prefill/decode disaggregation.
+
+Everything here is HOST-side: no function in this package may appear
+in (or change) a traced serve-chunk program — regression-locked by the
+jaxpr/AST guards in tests/test_kvstore.py.
+"""
+
+from .directory import (PrefixDirectory, chain_keys, chain_keys_hex,
+                        digest_decode, digest_encode, shareable_blocks)
+from .transfer import (export_payload, import_payload, payload_bytes,
+                       pool_signature, seed_chain)
+
+__all__ = ["PrefixDirectory", "chain_keys", "chain_keys_hex",
+           "digest_decode", "digest_encode", "shareable_blocks",
+           "export_payload", "import_payload", "payload_bytes",
+           "pool_signature", "seed_chain"]

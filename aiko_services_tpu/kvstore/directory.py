@@ -1,0 +1,223 @@
+"""Cluster-wide prefix directory: digest wire format + merged view.
+
+The paged server's prefix cache is content-addressed by a rolling
+chain hash (one SHA-256 per FULL prompt block, seeded with the adapter
+id — vLLM's scheme; see
+:meth:`~..orchestration.paged.PagedContinuousServer._chain_keys`).
+That hashing is defined HERE so the router and every replica compute
+byte-identical keys from tokens alone — a digest entry advertised by
+one process must be matchable by any other.
+
+Digest wire format (the value of the ``kv_prefixes`` EC-share key,
+published on the replica's state topic):
+
+    <block_size>;<role>;<entry>,<entry>,...
+    entry = <hex16>/<depth>/<refs>/<hotness>
+
+``hex16`` is the first 8 bytes of the chain key (64 collision bits —
+ample for directory routing; the replica re-verifies full keys at
+export time).  ``depth`` is the entry's position in its chain (blocks
+of whole-prefix history it represents); ``refs``/``hotness`` are
+advisory load signals.  The format is S-expression-safe by
+construction: hex, digits, ``;,/`` only — no spaces or parens.
+
+Staleness is LEASE-based: each replica's advertisement expires
+``lease_s`` after its last refresh (replicas re-advertise every pump
+and on a slow periodic timer), so a wedged or partitioned replica's
+prefixes silently drop out of routing instead of attracting traffic
+to a cache that may no longer exist.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+__all__ = ["chain_keys", "chain_keys_hex", "shareable_blocks",
+           "digest_encode", "digest_decode", "PrefixDirectory",
+           "HEX_KEY_CHARS"]
+
+#: Advertised key width: 16 hex chars = 8 bytes of the SHA-256 chain
+#: key.  Directory matching tolerates the (negligible) collision rate;
+#: block EXPORT re-resolves through the owner's full-key index.
+HEX_KEY_CHARS = 16
+
+
+def chain_keys(prompt, block_size: int,
+               adapter_id: int = 0) -> List[bytes]:
+    """Chained content keys, one per FULL prompt block: a block's key
+    is the SHA-256 of (parent key ‖ block tokens), so equal keys imply
+    equal whole-prefix token histories at O(block) per key.  The chain
+    is SEEDED with the adapter id: the same tokens prefilled under
+    different LoRA adapters produce different KV, so cached blocks may
+    only be shared within one adapter."""
+    prompt = np.asarray(prompt)
+    keys: List[bytes] = []
+    parent = int(adapter_id).to_bytes(4, "little")
+    for i in range(len(prompt) // block_size):
+        block = np.ascontiguousarray(
+            prompt[i * block_size:(i + 1) * block_size],
+            dtype=np.int32)
+        parent = hashlib.sha256(parent + block.tobytes()).digest()
+        keys.append(parent)
+    return keys
+
+
+def chain_keys_hex(prompt, block_size: int,
+                   adapter_id: int = 0) -> List[str]:
+    """Directory-width hex keys for a prompt's SHAREABLE blocks (full
+    blocks strictly before the last prompt position — see
+    :func:`shareable_blocks`)."""
+    n = shareable_blocks(len(np.asarray(prompt)), block_size)
+    return [key.hex()[:HEX_KEY_CHARS]
+            for key in chain_keys(prompt, block_size, adapter_id)[:n]]
+
+
+def shareable_blocks(prompt_len: int, block_size: int) -> int:
+    """Blocks safe to SHARE (and therefore to advertise/transfer):
+    full blocks strictly before position ``prompt_len - 1`` — the
+    admission seed rewrites the last prompt position's KV row, and a
+    rewrite must never land in a block other requests read."""
+    return max(0, (prompt_len - 1) // block_size)
+
+
+# ----------------------------------------------------------------- #
+# Digest wire format
+
+
+def digest_encode(block_size: int, role: str,
+                  entries: Sequence[Tuple[str, int, int, int]]) -> str:
+    """``entries`` = [(hex16, depth, refs, hotness)] — already
+    selected/ordered by the replica (hottest, deepest first)."""
+    body = ",".join(f"{hex_key}/{depth}/{refs}/{hot}"
+                    for hex_key, depth, refs, hot in entries)
+    return f"{block_size};{role};{body}"
+
+
+def digest_decode(text: str):
+    """Returns ``(block_size, role, entries)`` or ``None`` on any
+    malformed input (directory updates are best-effort: a corrupt
+    advertisement is dropped, never raises into the router)."""
+    try:
+        block_text, role, body = str(text).split(";", 2)
+        block_size = int(block_text)
+        entries = []
+        if body:
+            for item in body.split(","):
+                hex_key, depth, refs, hot = item.split("/")
+                entries.append((hex_key, int(depth), int(refs),
+                                int(hot)))
+        return block_size, role, entries
+    except (TypeError, ValueError):
+        return None
+
+
+# ----------------------------------------------------------------- #
+
+
+class PrefixDirectory:
+    """Router-side merged view of every replica's advertised prefix
+    blocks, with lease-based staleness eviction.
+
+    One advertisement per replica at a time: each ``update`` REPLACES
+    that replica's entry set and refreshes its lease.  Lookups skip
+    expired advertisements lazily; :meth:`purge_expired` reclaims them
+    (the router calls it opportunistically on update)."""
+
+    def __init__(self, lease_s: float = 30.0):
+        self.lease_s = lease_s
+        #: replica -> {hex16 -> (depth, refs, hotness)}
+        self._by_replica: Dict[str, Dict[str, Tuple[int, int, int]]] \
+            = {}
+        self._expiry: Dict[str, float] = {}
+        self._block_size: Dict[str, int] = {}
+        self._role: Dict[str, str] = {}
+
+    # -- ingest ---------------------------------------------------- #
+
+    def update(self, replica: str, digest_text: str,
+               now: float) -> bool:
+        """Ingest one ``kv_prefixes`` advertisement; returns True when
+        it parsed (and the lease was refreshed)."""
+        decoded = digest_decode(digest_text)
+        if decoded is None:
+            return False
+        block_size, role, entries = decoded
+        self._by_replica[replica] = {
+            hex_key: (depth, refs, hot)
+            for hex_key, depth, refs, hot in entries}
+        self._block_size[replica] = block_size
+        self._role[replica] = role
+        self._expiry[replica] = now + self.lease_s
+        return True
+
+    def evict_replica(self, replica: str) -> None:
+        self._by_replica.pop(replica, None)
+        self._expiry.pop(replica, None)
+        self._block_size.pop(replica, None)
+        self._role.pop(replica, None)
+
+    def purge_expired(self, now: float) -> None:
+        for replica in [r for r, t in self._expiry.items()
+                        if now >= t]:
+            self.evict_replica(replica)
+
+    # -- queries --------------------------------------------------- #
+
+    def alive(self, replica: str, now: float) -> bool:
+        return now < self._expiry.get(replica, float("-inf"))
+
+    def block_size(self, replica: str) -> Optional[int]:
+        return self._block_size.get(replica)
+
+    def role(self, replica: str) -> Optional[str]:
+        return self._role.get(replica)
+
+    def replicas(self) -> List[str]:
+        return list(self._by_replica)
+
+    def matched_blocks(self, replica: str, keys_hex: Sequence[str],
+                       now: float) -> int:
+        """Longest advertised prefix of ``keys_hex`` this replica
+        holds: chain keys encode whole-prefix history and eviction is
+        leaf-first, so the DEEPEST matching key alone implies every
+        ancestor is cached — walk deepest-first, first hit wins."""
+        if not self.alive(replica, now):
+            return 0
+        advertised = self._by_replica.get(replica)
+        if not advertised:
+            return 0
+        for depth in range(len(keys_hex), 0, -1):
+            if keys_hex[depth - 1] in advertised:
+                return depth
+        return 0
+
+    def best_owner(self, keys_hex: Sequence[str], now: float,
+                   exclude=()) -> Tuple[Optional[str], int]:
+        """The unexpired replica holding the longest match (ties break
+        by hotness of the matched entry, then replica order for
+        determinism)."""
+        best: Tuple[int, int, str] = (0, 0, "")
+        owner = None
+        for replica in sorted(self._by_replica):
+            if replica in exclude:
+                continue
+            depth = self.matched_blocks(replica, keys_hex, now)
+            if not depth:
+                continue
+            hot = self._by_replica[replica].get(
+                keys_hex[depth - 1], (0, 0, 0))[2]
+            # sorted() order makes the final tie deterministic.
+            if (depth, hot) > best[:2]:
+                best = (depth, hot, replica)
+                owner = replica
+        return owner, best[0]
+
+    @property
+    def size(self) -> int:
+        """Total advertised keys (expired advertisements included
+        until purged — the share counter the dashboard shows)."""
+        return sum(len(entries)
+                   for entries in self._by_replica.values())

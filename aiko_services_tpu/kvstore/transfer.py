@@ -1,0 +1,287 @@
+"""Cross-replica KV block transfer: export/import of pool blocks.
+
+A replica→replica RPC body: the owner resolves directory-width hex
+keys through its full-key prefix index, gathers the table-resolved
+pool rows HOST-side (``np.asarray`` pulls; never inside a jitted
+program — the jaxpr guard in tests/test_kvstore.py pins this), and
+ships them as a swag-codec dict.  The importer allocates blocks from
+its own pool (evicting cold cached prefixes if needed — counted as
+spills), writes the rows back with one ``.at[blocks].set`` per layer
+buffer, and registers the chain keys in its prefix index under a
+lease, pinned until adopted by an admission or released at expiry.
+
+Wire format (swag dict values; arrays ride the numpy codec tag):
+
+======================  =============================================
+``kv_keys``             json list of FULL (64-hex) chain keys,
+                        contiguous — the request carries
+                        directory-width hex16 keys, the response
+                        full keys, so the importer registers blocks
+                        under exactly the keys its own admission
+                        walk will compute from the prompt
+``kv_parent``           full hex of the key preceding ``kv_keys[0]``
+                        (empty string at chain root)
+``kv_start_depth``      chain depth of ``kv_parent`` (0 at root)
+``kv_block_size``       pool block size (must match importer)
+``kv_sig``              :func:`pool_signature` (layout handshake)
+``kv_dtype``            source dtype name (bf16 travels as uint16
+                        bit patterns — ``np.save`` cannot round-trip
+                        ml_dtypes)
+``kv_l<i>_<name>``      per-layer stacked rows, ``(n_blocks,
+                        block_size, kv_heads, head_dim)`` for
+                        ``k``/``v`` (+ ``ks``/``vs`` scale planes,
+                        ``(n_blocks, block_size, kv_heads)``, on
+                        int8 pools)
+======================  =============================================
+
+Transfers are base-model only (adapter id 0): stacked-adapter INDICES
+are replica-local, so a key seeded by adapter 3 here may mean a
+different adapter there — the digest never advertises them.
+
+Bit-exactness: exported rows are the owner's pool bytes verbatim
+(bf16, or int8 + f32 scales), and :func:`shareable_blocks` guarantees
+an imported block is never rewritten by the importer's admission
+seed — so greedy decode after an imported prefix exactly equals local
+prefill (asserted for both pool dtypes in tests/test_kvstore.py).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from .directory import HEX_KEY_CHARS, chain_keys, shareable_blocks
+
+__all__ = ["pool_signature", "export_payload", "import_payload",
+           "payload_bytes", "seed_chain"]
+
+_BF16 = "bfloat16"
+
+
+def pool_signature(server) -> str:
+    """Layout handshake string: two pools may exchange blocks only
+    when every field matches (mismatch means the bytes would be
+    reinterpreted, silently corrupting attention)."""
+    config = server.config
+    return (f"{config.n_layers}:{config.n_kv_heads}:"
+            f"{config.head_dim}:{int(server.quantize_kv)}:"
+            f"{np.dtype(server.pool[0]['k'].dtype).name}")
+
+
+def payload_bytes(payload: Dict) -> int:
+    """Transferred tensor bytes (the MB/s numerator; codec/base64
+    framing overhead excluded by convention)."""
+    return sum(int(value.nbytes) for value in payload.values()
+               if isinstance(value, np.ndarray))
+
+
+def _pack(array: np.ndarray) -> np.ndarray:
+    # np.save cannot round-trip ml_dtypes bfloat16 (loads as void16);
+    # ship the bit pattern and record the dtype out of band.
+    if array.dtype.name == _BF16:
+        return array.view(np.uint16)
+    return np.ascontiguousarray(array)
+
+
+def _unpack(array: np.ndarray, dtype_name: str,
+            target_dtype) -> np.ndarray:
+    if dtype_name == _BF16 and array.dtype == np.uint16:
+        return array.view(np.dtype(target_dtype))
+    return array
+
+
+def export_payload(server, keys_hex: List[str],
+                   start_depth: int) -> Optional[Dict]:
+    """Resolve ``keys_hex`` (a contiguous chain segment starting at
+    depth ``start_depth + 1``) through the owner's prefix index and
+    gather the pool rows.  Returns the wire dict, or ``None`` when
+    the owner no longer holds a usable segment (evicted since it was
+    advertised, still producing, adapter-seeded, or depth drifted) —
+    the caller answers with an error and the importer falls back to
+    local prefill."""
+    start_depth = int(start_depth)
+    resolved: List[bytes] = []
+    blocks: List[int] = []
+    for offset, hex_key in enumerate(keys_hex):
+        key = server._hex_key.get(str(hex_key)[:HEX_KEY_CHARS])
+        if key is None:
+            break
+        block = server._index.get(key)
+        if block is None or block in server._producing:
+            break
+        if server._depth.get(key) != start_depth + offset + 1:
+            break                      # not the chain we advertised
+        if server._key_seed.get(key, 0) != 0:
+            break                      # adapter-local: never exported
+        if resolved and server._parent.get(key) != resolved[-1]:
+            break                      # chain discontinuity
+        resolved.append(key)
+        blocks.append(block)
+    if not resolved:
+        return None
+    parent = server._parent.get(resolved[0])
+    payload: Dict = {
+        "kv_keys": [key.hex() for key in resolved],
+        "kv_parent": parent.hex() if parent else "",
+        "kv_start_depth": start_depth,
+        "kv_block_size": int(server.block_size),
+        "kv_sig": pool_signature(server),
+        "kv_dtype": np.dtype(server.pool[0]["k"].dtype).name,
+    }
+    ids = np.asarray(blocks, np.int32)
+    for layer, buffers in enumerate(server.pool):
+        for name, buf in buffers.items():
+            payload[f"kv_l{layer}_{name}"] = _pack(
+                np.asarray(buf)[ids])
+    return payload
+
+
+def import_payload(server, payload: Dict, engine=None,
+                   lease_s: float = 30.0) -> int:
+    """Adopt an exported segment into ``server``'s pool + prefix
+    index; returns the number of blocks imported (0 = nothing usable:
+    layout mismatch, broken chain linkage, or pool too full even
+    after eviction).
+
+    Imported keys are registered ref-pinned under a
+    :class:`~..runtime.lease.Lease` (released — made evictable — at
+    expiry if no admission adopted them; ``engine=None`` skips the
+    pin and registers them immediately evictable, the synchronous
+    test/bench mode)."""
+    if str(payload.get("kv_sig")) != pool_signature(server) or \
+            int(payload.get("kv_block_size", -1)) != server.block_size:
+        return 0
+    try:
+        keys = [bytes.fromhex(str(k)) for k in
+                payload.get("kv_keys", [])]
+    except ValueError:
+        return 0
+    if not keys or any(len(k) != 32 for k in keys):
+        return 0
+    start_depth = int(payload.get("kv_start_depth", 0))
+    parent: Optional[bytes] = None
+    if start_depth > 0:
+        try:
+            parent = bytes.fromhex(str(payload.get("kv_parent", "")))
+        except ValueError:
+            return 0
+        if server._index.get(parent) is None \
+                or server._depth.get(parent) != start_depth:
+            return 0       # local prefix evicted since the request
+    # Skip the prefix another import/admission already landed; stop
+    # at any later already-present key (never re-import, never fork).
+    offset = 0
+    while offset < len(keys):
+        key = keys[offset]
+        if server._index.get(key) is None \
+                or server._index[key] in server._producing:
+            break
+        parent = key
+        offset += 1
+    fresh = keys[offset:]
+    for index, key in enumerate(fresh):
+        if key in server._index:
+            fresh = fresh[:index]
+            break
+    if not fresh:
+        return 0
+    needed = len(fresh)
+    if needed > len(server._free) + len(server._evictable):
+        return 0
+    evictions_before = server.prefix_evictions
+    server._evict_until(needed)
+    server.kv_spill_evictions += \
+        server.prefix_evictions - evictions_before
+    if needed > len(server._free):
+        return 0
+    blocks = [server._free.pop() for _ in range(needed)]
+
+    jnp = server._jnp
+    ids = jnp.asarray(np.asarray(blocks, np.int32))
+    dtype_name = str(payload.get("kv_dtype", ""))
+    for layer, buffers in enumerate(server.pool):
+        written = {}
+        for name, buf in buffers.items():
+            data = payload.get(f"kv_l{layer}_{name}")
+            if data is None or data.shape[0] < offset + needed:
+                # Incomplete payload: roll the allocation back.
+                server._free.extend(blocks)
+                return 0
+            rows = _unpack(np.asarray(data)[offset:offset + needed],
+                           dtype_name, buf.dtype)
+            written[name] = buf.at[ids].set(
+                jnp.asarray(rows).astype(buf.dtype))
+        server.pool[layer] = written
+
+    imported: List[bytes] = []
+    for index, key in enumerate(fresh):
+        block = blocks[index]
+        depth = start_depth + offset + index + 1
+        server._index[key] = block
+        server._block_key[block] = key
+        server._refs[block] = 1
+        server._key_seed[key] = 0
+        server._depth[key] = depth
+        server._hex_key[key.hex()[:HEX_KEY_CHARS]] = key
+        server._imported_keys.add(key)
+        if parent is not None:
+            server._parent[key] = parent
+            server._children[parent] = \
+                server._children.get(parent, 0) + 1
+        parent = key
+        imported.append(key)
+
+    def release(_uuid=None):
+        for key in imported:
+            block = server._index.get(key)
+            if block is None or server._block_key.get(block) != key:
+                continue               # already purged/re-owned
+            if server._refs.get(block, 0) > 0:
+                server._refs[block] -= 1
+                if server._refs[block] == 0:
+                    server._evictable[key] = block
+
+    if engine is not None:
+        from ..runtime.lease import Lease
+        Lease(lease_s, f"kv_import:{fresh[0].hex()[:8]}",
+              lease_expired_handler=release, engine=engine)
+    else:
+        release()
+    return needed
+
+
+def seed_chain(server, tokens, adapter_id: int = 0) -> int:
+    """Bench/test helper: allocate and REGISTER the shareable chain
+    for ``tokens`` without prefilling (block content stays zeros) —
+    lets transfer bandwidth be measured without paying an 8k-token
+    prefill first.  Never used on the serving path."""
+    tokens = np.asarray(tokens)
+    block_size = server.block_size
+    n = shareable_blocks(len(tokens), block_size)
+    keys = chain_keys(tokens, block_size, adapter_id)[:n]
+    registered = 0
+    parent = None
+    for position, key in enumerate(keys):
+        if key in server._index:
+            parent = key
+            continue
+        server._evict_until(1)
+        if not server._free:
+            break
+        block = server._free.pop()
+        server._index[key] = block
+        server._block_key[block] = key
+        server._refs[block] = 0
+        server._key_seed[key] = adapter_id
+        server._depth[key] = position + 1
+        server._hex_key[key.hex()[:HEX_KEY_CHARS]] = key
+        if parent is not None:
+            server._parent[key] = parent
+            server._children[parent] = \
+                server._children.get(parent, 0) + 1
+        server._evictable[key] = block
+        parent = key
+        registered += 1
+    return registered

@@ -70,7 +70,7 @@ import numpy as np
 
 from ..runtime import faults
 from ..runtime.actor import Actor
-from ..utils.sexpr import generate
+from ..utils.sexpr import generate, parse
 
 __all__ = ["ContinuousBatchingServer", "ContinuousReplica",
            "DecodeRequest"]
@@ -1333,19 +1333,46 @@ class ContinuousReplica(Actor):
     """Actor wrapper: same ``(infer …)`` protocol as
     :class:`~.serving.ModelReplica`, but requests join the continuous
     batch instead of running serially.  A delayed self-post pump runs
-    decode chunks between message deliveries while any slot is live."""
+    decode chunks between message deliveries while any slot is live.
 
-    def __init__(self, context, process=None, server=None):
+    Paged servers with the prefix cache enabled additionally join the
+    distributed KV cache (:mod:`~..kvstore`): the replica advertises
+    its cached prefix digest on its EC-share state topic (every pump,
+    plus a slow re-advertise timer so idle replicas keep their
+    directory lease alive), answers ``(kv_export …)`` block-transfer
+    RPCs from peers, and — when a routed request carries a
+    ``kv_source`` hint — pulls the prefix from the named owner before
+    admission, falling back to plain local prefill if the owner does
+    not answer within ``kv_fetch_timeout_s`` (a dead owner costs
+    latency, never correctness).
+
+    ``prefill_only=True`` makes this a dedicated PREFILL replica for
+    the opt-in disaggregated mode: generation budgets clamp to one
+    token (the admission seed), the cache retains the prompt's
+    blocks, and the digest advertises role ``prefill`` so routers
+    never send it decode traffic."""
+
+    #: Re-advertise the prefix digest this often even when idle —
+    #: must stay well under the router directory's ``lease_s`` or an
+    #: idle replica's cached prefixes drop out of routing.
+    KV_ADVERTISE_S = 5.0
+
+    def __init__(self, context, process=None, server=None,
+                 prefill_only: bool = False,
+                 kv_fetch_timeout_s: float = 2.0):
         from .serving import REPLICA_PROTOCOL
         context.protocol = context.protocol or REPLICA_PROTOCOL
         super().__init__(context, process)
         self.server = server or ContinuousBatchingServer()
+        self.prefill_only = prefill_only
+        self.kv_fetch_timeout_s = kv_fetch_timeout_s
         self._command_handlers["infer"] = self._wire_infer
         self._command_handlers["pump"] = self._pump
         self._command_handlers["adapter_load"] = self._wire_adapter_load
         self._command_handlers["adapter_unload"] = \
             self._wire_adapter_unload
         self._command_handlers["infer_cancel"] = self._wire_cancel
+        self._command_handlers["kv_export"] = self._wire_kv_export
         self.share["slots"] = self.server.slots
         self.share["requests_served"] = 0
         self._pumping = False
@@ -1358,6 +1385,23 @@ class ContinuousReplica(Actor):
         from collections import deque
         self._ttft_window = deque(maxlen=64)
         self._total_window = deque(maxlen=64)
+        # Warm-start fetches in flight: token -> parked DecodeRequest.
+        self._kv_pending: Dict[str, DecodeRequest] = {}
+        self._kv_counter = 0
+        self._kv_topic = f"{self.topic_path}/kv"
+        if self._kv_capable():
+            self.process.add_message_handler(self._on_kv_message,
+                                             self._kv_topic)
+            self.process.event.add_timer_handler(
+                self._kv_advertise, self.KV_ADVERTISE_S)
+
+    def _kv_capable(self) -> bool:
+        return getattr(self.server, "enable_prefix_cache", False) \
+            and hasattr(self.server, "kv_export_payload")
+
+    @property
+    def kv_role(self) -> str:
+        return "prefill" if self.prefill_only else "decode"
 
     def _wire_infer(self, request_id, response_topic, payload=None):
         from ..pipeline.codec import decode_swag
@@ -1384,12 +1428,23 @@ class ContinuousReplica(Actor):
                 # arrival is not charged).
                 request.deadline_ts = time.monotonic() + \
                     float(np.asarray(deadline_ms)) / 1e3
+            kv_source = inputs.get("kv_source")
+            if self.prefill_only or inputs.get("prefill_only"):
+                # Dedicated prefill: the admission seed IS the one
+                # generated token; the prompt's blocks stay cached
+                # for the decode replica to pull.
+                request.max_new_tokens = 1
+                request.stream = False
         except Exception:  # noqa: BLE001 - bad request must still respond
             self.logger.exception("%s: malformed infer request %s",
                                   self.name, request_id)
             request.error = "infer_failed"
             self._respond(request)
             return
+        if kv_source and self._kv_capable() \
+                and request.adapter is None:
+            if self._begin_kv_fetch(request, str(kv_source)):
+                return        # parked until import or timeout
         self.server.submit(request)
         self._ensure_pumping()
 
@@ -1437,6 +1492,9 @@ class ContinuousReplica(Actor):
         import statistics
         from .serving import serving_telemetry
         updates = serving_telemetry(self.server.stats())
+        if self._kv_capable():
+            updates["kv_prefixes"] = \
+                self.server.prefix_digest(role=self.kv_role)
         if self._ttft_window:
             updates["ttft_p50_ms"] = round(
                 statistics.median(self._ttft_window) * 1e3, 1)
@@ -1464,6 +1522,115 @@ class ContinuousReplica(Actor):
         if self.ec_producer is not None:
             for key, value in changed.items():
                 self.ec_producer.update(key, value)
+
+    # -- distributed KV cache (kvstore subsystem) ------------------- #
+
+    def _kv_advertise(self, *_args):
+        """Slow periodic re-advertise: refreshes the router
+        directory's lease on this replica's prefixes while idle (no
+        pump runs, so :meth:`_share_telemetry`'s diff never fires),
+        and catches routers that subscribed after the last change."""
+        if not self._kv_capable():
+            return
+        digest = self.server.prefix_digest(role=self.kv_role)
+        self.share["kv_prefixes"] = digest
+        if self.ec_producer is not None:
+            self.ec_producer.update("kv_prefixes", digest)
+
+    def _wire_kv_export(self, request_id, response_topic,
+                        payload=None):
+        """``(kv_export id reply swag)`` — peer block-transfer RPC:
+        resolve the requested chain segment and answer with the pool
+        rows, or an error the importer treats as a recompute
+        fallback."""
+        from ..pipeline.codec import decode_swag, encode_swag
+        outputs = {"error": "kv_unsupported"}
+        if self._kv_capable():
+            try:
+                inputs = decode_swag(payload or {})
+                exported = self.server.kv_export_payload(
+                    [str(k) for k in inputs["kv_keys"]],
+                    int(np.asarray(inputs.get("kv_start_depth", 0))))
+                outputs = exported if exported is not None \
+                    else {"error": "kv_prefix_gone"}
+            except Exception:  # noqa: BLE001 - RPC must answer
+                self.logger.exception("%s: kv_export failed",
+                                      self.name)
+                outputs = {"error": "kv_export_failed"}
+        self.process.message.publish(
+            str(response_topic),
+            generate("kv_export_response",
+                     [str(request_id), encode_swag(outputs)]))
+
+    def _begin_kv_fetch(self, request: DecodeRequest,
+                        kv_source: str) -> bool:
+        """Warm start: request the prompt's missing prefix blocks
+        from the owner the router named.  Returns False when there is
+        nothing worth fetching (prompt too short, already cached
+        locally, or the owner is this replica) — the caller submits
+        normally.  Otherwise the request PARKS until the import lands
+        or the fallback timer fires; either way it is submitted
+        exactly once."""
+        from ..pipeline.codec import encode_swag
+        if kv_source == self.topic_path:
+            return False
+        keys = self.server.prefix_keys_hex(request.prompt)
+        local = self.server.prefix_local_depth(request.prompt)
+        if not keys or local >= len(keys):
+            return False
+        self._kv_counter += 1
+        token = f"kvf{self._kv_counter}"
+        self._kv_pending[token] = request
+        self.process.message.publish(
+            f"{kv_source}/in",
+            generate("kv_export",
+                     [token, self._kv_topic,
+                      encode_swag({"kv_keys": keys[local:],
+                                   "kv_start_depth": local})]))
+        self.process.event.add_timer_handler(
+            lambda: self._kv_fetch_timeout(token),
+            self.kv_fetch_timeout_s, once=True)
+        return True
+
+    def _kv_fetch_timeout(self, token: str):
+        """Owner never answered (dead, partitioned, or slow): fall
+        back to plain local prefill — correctness never depended on
+        the transfer."""
+        request = self._kv_pending.pop(token, None)
+        if request is None:
+            return                    # import landed first
+        self.server.kv_transfer_failures += 1
+        self.logger.warning("%s: kv fetch %s timed out — local "
+                            "prefill fallback", self.name, token)
+        self.server.submit(request)
+        self._ensure_pumping()
+
+    def _on_kv_message(self, _topic: str, payload: str):
+        """``(kv_export_response token swag)`` from the owner:
+        import, then submit the parked request (the admission hit
+        walk adopts the imported blocks)."""
+        from ..pipeline.codec import decode_swag
+        try:
+            command, params = parse(payload)
+        except Exception:
+            return
+        if command != "kv_export_response" or len(params) < 2:
+            return
+        request = self._kv_pending.pop(str(params[0]), None)
+        if request is None:
+            return                    # timed out already; late reply
+        try:
+            outputs = decode_swag(params[1])
+            if "error" in outputs:
+                self.server.kv_transfer_failures += 1
+            else:
+                self.server.kv_import_payload(
+                    outputs, engine=self.process.event)
+        except Exception:  # noqa: BLE001 - fall back to local prefill
+            self.logger.exception("%s: kv import failed", self.name)
+            self.server.kv_transfer_failures += 1
+        self.server.submit(request)
+        self._ensure_pumping()
 
     def _wire_cancel(self, request_id, response_topic=None):
         """``(infer_cancel request_id [response_topic])``: the

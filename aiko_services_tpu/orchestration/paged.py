@@ -33,13 +33,15 @@ Greedy outputs exactly match the contiguous server and per-request
 
 from __future__ import annotations
 
-import hashlib
 import math
+import time
 from collections import OrderedDict
-from typing import List, Optional
+from typing import Dict, List, Optional
 
 import numpy as np
 
+from ..kvstore import directory as _kvdir
+from ..kvstore import transfer as _kvxfer
 from .continuous import ContinuousBatchingServer
 
 __all__ = ["PagedContinuousServer"]
@@ -151,10 +153,27 @@ class PagedContinuousServer(ContinuousBatchingServer):
         #: indexed) but the KV only lands slice by slice over the next
         #: steps.  Cleared at _finish_prefill; purged on cancel.
         self._producing: dict = {}
+        # Distributed KV-cache state (kvstore subsystem):
+        #   _hex_key: directory-width hex16 -> full chain key (block
+        #     EXPORT requests arrive with truncated keys)
+        #   _depth: chain key -> position in its chain (1-based)
+        #   _key_hits: chain key -> admission hit count (digest
+        #     hotness signal; drives advertisement selection)
+        #   _imported_keys: keys whose content arrived by transfer —
+        #     the first admission adopting one counts a remote hit.
+        self._hex_key: dict = {}
+        self._depth: dict = {}
+        self._key_hits: dict = {}
+        self._imported_keys: set = set()
         self.prefix_hits = 0
         self.prefix_misses = 0
         self.prefix_blocks_reused = 0
         self.prefix_evictions = 0
+        self.prefix_remote_hits = 0
+        self.kv_transfer_bytes = 0
+        self.kv_transfer_ms = 0.0
+        self.kv_transfer_failures = 0
+        self.kv_spill_evictions = 0
 
     def _init_device_state(self):
         state = super()._init_device_state()
@@ -180,6 +199,11 @@ class PagedContinuousServer(ContinuousBatchingServer):
             prefix_misses=self.prefix_misses,
             prefix_blocks_reused=self.prefix_blocks_reused,
             prefix_evictions=self.prefix_evictions,
+            prefix_remote_hits=self.prefix_remote_hits,
+            kv_transfer_bytes=self.kv_transfer_bytes,
+            kv_transfer_ms=round(self.kv_transfer_ms, 2),
+            kv_transfer_failures=self.kv_transfer_failures,
+            kv_spill_evictions=self.kv_spill_evictions,
             free_blocks=self.free_blocks,
             total_blocks=self.total_blocks,
         )
@@ -215,32 +239,23 @@ class PagedContinuousServer(ContinuousBatchingServer):
     # Prefix cache (content-addressed full prompt blocks)
 
     def _chain_keys(self, prompt, adapter_id: int = 0) -> List[bytes]:
-        """Chained content keys, one per FULL prompt block: a block's
-        key is the SHA-256 of (parent key ‖ block tokens), so equal
-        keys imply equal whole-prefix token histories (vLLM's hashing
-        scheme) at O(block) per key — no nested-tuple rehashing of the
-        whole ancestor history on every dict operation.
-
-        The chain is SEEDED with the adapter id: the same tokens
-        prefilled under different LoRA adapters produce different KV,
-        so cached blocks may only be shared within one adapter."""
-        bs = self.block_size
-        keys: List[bytes] = []
-        parent = int(adapter_id).to_bytes(4, "little")
-        for i in range(len(prompt) // bs):
-            block = np.ascontiguousarray(
-                prompt[i * bs:(i + 1) * bs], dtype=np.int32)
-            parent = hashlib.sha256(parent + block.tobytes()).digest()
-            keys.append(parent)
-        return keys
+        """Chained content keys, one per FULL prompt block (vLLM's
+        rolling-hash scheme, adapter-seeded).  Defined in
+        :mod:`~..kvstore.directory` so the router and every replica
+        compute byte-identical keys from tokens alone — the contract
+        the cluster-wide prefix directory rests on."""
+        return _kvdir.chain_keys(prompt, self.block_size, adapter_id)
 
     def _shareable_blocks(self, prompt_len: int) -> int:
         """Blocks safe to SHARE: full blocks strictly before position
         ``prompt_len - 1`` — the admission seed rewrites the last
         prompt position's KV row, and a rewrite (bit-identical in
         principle, batch-width rounding in practice) must never land
-        in a block other requests read."""
-        return max(0, (prompt_len - 1) // self.block_size)
+        in a block other requests read.  Also the TRANSFER bound: an
+        imported block is never rewritten by the importer's admission
+        seed, which is what makes transferred-prefix decode bit-exact
+        (docs/ARCHITECTURE.md invariant 6)."""
+        return _kvdir.shareable_blocks(prompt_len, self.block_size)
 
     def _purge_cached(self, key, block) -> None:
         self._index.pop(key, None)
@@ -248,6 +263,12 @@ class PagedContinuousServer(ContinuousBatchingServer):
         self._block_key.pop(block, None)
         self._refs.pop(block, None)
         self._key_seed.pop(key, None)
+        self._depth.pop(key, None)
+        self._key_hits.pop(key, None)
+        self._imported_keys.discard(key)
+        hex_key = key.hex()[:_kvdir.HEX_KEY_CHARS]
+        if self._hex_key.get(hex_key) == key:
+            del self._hex_key[hex_key]
         parent = self._parent.pop(key, None)
         if parent is not None and parent in self._children:
             self._children[parent] -= 1
@@ -343,6 +364,15 @@ class PagedContinuousServer(ContinuousBatchingServer):
         if shared:
             self.prefix_hits += 1
             self.prefix_blocks_reused += len(shared)
+            adopted = [key for key in keys[:len(shared)]
+                       if key in self._imported_keys]
+            if adopted:
+                # First local use of peer-transferred blocks: the
+                # warm start the kvstore transfer exists for.
+                self.prefix_remote_hits += 1
+                self._imported_keys.difference_update(adopted)
+            for key in keys[:len(shared)]:
+                self._key_hits[key] = self._key_hits.get(key, 0) + 1
         elif keys:
             # Shareable prefix existed but nothing was cached for it.
             self.prefix_misses += 1
@@ -366,6 +396,8 @@ class PagedContinuousServer(ContinuousBatchingServer):
                 self._block_key[block] = key
                 self._refs[block] = 1
                 self._key_seed[key] = adapter_id
+                self._depth[key] = position + 1
+                self._hex_key[key.hex()[:_kvdir.HEX_KEY_CHARS]] = key
                 if position > 0:
                     parent = keys[position - 1]
                     self._parent[key] = parent
@@ -598,3 +630,80 @@ class PagedContinuousServer(ContinuousBatchingServer):
         if prefill["start"] >= prefill["prompt_len"]:
             self._finish_prefill(slot, prefill)
         return tokens_d, counts_d, new_state
+
+    # ------------------------------------------------------------- #
+    # Distributed KV cache (kvstore subsystem) — ALL host-side: none
+    # of these run inside, or change, a traced serve-chunk program
+    # (jaxpr + AST guards in tests/test_kvstore.py).
+
+    def prefix_digest(self, role: str = "decode",
+                      max_entries: int = 64) -> str:
+        """Compact advertisement of this replica's cached prefix
+        blocks for the cluster directory: content-complete (not
+        producing), base-adapter chains only, hottest + deepest first,
+        capped at ``max_entries`` (the EC share rides MQTT control
+        topics — the digest must stay small)."""
+        entries = []
+        for key, block in self._index.items():
+            if block in self._producing:
+                continue
+            if self._key_seed.get(key, 0) != 0:
+                continue        # adapter indices are replica-local
+            entries.append((key.hex()[:_kvdir.HEX_KEY_CHARS],
+                            self._depth.get(key, 0),
+                            self._refs.get(block, 0),
+                            self._key_hits.get(key, 0)))
+        entries.sort(key=lambda e: (-e[3], -e[1], e[0]))
+        return _kvdir.digest_encode(self.block_size, role,
+                                    entries[:max_entries])
+
+    def prefix_keys_hex(self, prompt) -> List[str]:
+        """Directory-width keys for a prompt's shareable blocks
+        (base adapter — the only chains that cross replicas)."""
+        return _kvdir.chain_keys_hex(prompt, self.block_size)
+
+    def prefix_local_depth(self, prompt) -> int:
+        """Longest locally-cached, content-complete prefix of
+        ``prompt`` in blocks — what a warm-start fetch may SKIP
+        requesting from the owner."""
+        depth = 0
+        for key in self._chain_keys(np.asarray(prompt))[
+                :self._shareable_blocks(len(np.asarray(prompt)))]:
+            block = self._index.get(key)
+            if block is None or block in self._producing:
+                break
+            depth += 1
+        return depth
+
+    def kv_export_payload(self, keys_hex: List[str],
+                          start_depth: int) -> Optional[Dict]:
+        """Serve one export RPC: gather the requested chain segment's
+        pool rows host-side.  Returns the wire dict or ``None`` (the
+        segment is gone — caller answers with an error and the
+        importer recomputes)."""
+        started = time.perf_counter()
+        payload = _kvxfer.export_payload(self, keys_hex, start_depth)
+        if payload is None:
+            self.kv_transfer_failures += 1
+            return None
+        self.kv_transfer_bytes += _kvxfer.payload_bytes(payload)
+        self.kv_transfer_ms += (time.perf_counter() - started) * 1e3
+        return payload
+
+    def kv_import_payload(self, payload: Dict, engine=None,
+                          lease_s: float = 30.0) -> int:
+        """Adopt an exported segment into this pool under a lease;
+        returns blocks imported (0 counts as a transfer failure —
+        the caller falls back to local prefill, which is always
+        correct, just colder)."""
+        started = time.perf_counter()
+        imported = _kvxfer.import_payload(self, payload,
+                                          engine=engine,
+                                          lease_s=lease_s)
+        if imported:
+            self.kv_transfer_bytes += _kvxfer.payload_bytes(payload)
+            self.kv_transfer_ms += \
+                (time.perf_counter() - started) * 1e3
+        else:
+            self.kv_transfer_failures += 1
+        return imported

@@ -65,6 +65,8 @@ TELEMETRY_KEYS = (
     "decode_steps_per_sec", "sync_stalls_per_100_steps",
     "admission_deferred", "state_uploads", "tokens_committed",
     "prefix_hits", "prefix_misses", "prefix_evictions",
+    "prefix_remote_hits", "kv_transfer_bytes", "kv_transfer_ms",
+    "kv_transfer_failures", "kv_spill_evictions",
     "decode_attention_path", "blocks_read_per_step",
     "prefill_tokens_per_sec", "prefill_queue_depth",
     "prefill_attention_path",
@@ -166,7 +168,11 @@ class ReplicaRouter(Actor):
                  shed_queue_depth: int = 32,
                  backoff_base_s: float = 0.05,
                  backoff_cap_s: float = 2.0,
-                 max_redispatch: int = 4, seed: int = 0):
+                 max_redispatch: int = 4, seed: int = 0,
+                 prefix_alpha: float = 1.0,
+                 kv_transfer: bool = False,
+                 disaggregate: bool = False,
+                 directory_lease_s: float = 30.0):
         context.protocol = context.protocol or ROUTER_PROTOCOL
         super().__init__(context, process)
         self._replicas: List[str] = []   # replica topic paths, stable order
@@ -178,6 +184,23 @@ class ReplicaRouter(Actor):
         self.backoff_base_s = backoff_base_s
         self.backoff_cap_s = backoff_cap_s
         self.max_redispatch = max_redispatch
+        #: Prefix-aware scoring weight: a candidate's score is
+        #: ``queue_depth − prefix_alpha · matched_prefix_blocks``
+        #: (lower wins).  0 disables prefix routing entirely (exact
+        #: PR-4 behavior); with no directory match the route falls
+        #: back to exact P2C regardless.
+        self.prefix_alpha = prefix_alpha
+        #: Attach ``kv_source`` warm-start hints when the prefix
+        #: owner is not the chosen target (opt-in: transfers cost
+        #: wire bytes; prefix AFFINITY alone is free).
+        self.kv_transfer = kv_transfer
+        #: Opt-in disaggregated serving: requests prefill on a
+        #: ``prefill``-role replica first, then decode on a decode
+        #: replica that pulls the prefix.  Requires ``kv_transfer``
+        #: semantics regardless of the flag.
+        self.disaggregate = disaggregate
+        from ..kvstore import PrefixDirectory
+        self.directory = PrefixDirectory(lease_s=directory_lease_s)
         self._rng = random.Random(seed)
         #: request_id -> replica topic path, so infer_cancel follows
         #: its request to the SAME replica.  Bounded ring evicting the
@@ -201,9 +224,11 @@ class ReplicaRouter(Actor):
         self._unhealthy: set = set()
         self.counters: Dict[str, int] = dict(
             redispatches=0, replica_deaths_observed=0, shed=0,
-            deadline_exceeded=0, cancel_unrouted=0)
+            deadline_exceeded=0, cancel_unrouted=0,
+            prefix_routed=0, kv_remote_hints=0)
         self.share["replicas"] = 0
         self.share["requests_routed"] = 0
+        self.share["kv_directory_size"] = 0
         self.share.update(self.counters)
         #: replicas answer here; _on_reply forwards to the client.
         self.topic_reply = f"{self.topic_path}/reply"
@@ -236,6 +261,11 @@ class ReplicaRouter(Actor):
                 self._replica_state, f"{fields.topic_path}/state")
             self._loads.pop(fields.topic_path, None)
             self._unhealthy.discard(fields.topic_path)
+            # A dead owner's advertised prefixes must stop attracting
+            # routes IMMEDIATELY — survivors recompute (in-flight
+            # fetches against it time out into local prefill).
+            self.directory.evict_replica(fields.topic_path)
+            self._update_directory_share()
             self._bump("replica_deaths_observed")
             self._update_share()
             self.logger.info("%s: replica down %s (%d live)", self.name,
@@ -260,10 +290,21 @@ class ReplicaRouter(Actor):
                 self._loads.setdefault(replica, {})[key] = int(value)
             except (TypeError, ValueError):
                 pass
+        elif key == "kv_prefixes":
+            now = self.process.event.now()
+            if self.directory.update(replica, str(value), now):
+                self.directory.purge_expired(now)
+                self._update_directory_share()
         elif key == "healthy":
             self._set_health(replica, str(value) not in ("0", "False"))
         elif key == "lifecycle":
             self._set_health(replica, str(value) != "unhealthy")
+
+    def _update_directory_share(self):
+        size = self.directory.size
+        if self.ec_producer is not None:
+            self.ec_producer.update_if_changed("kv_directory_size", size)
+        self.share["kv_directory_size"] = size
 
     def _set_health(self, replica: str, healthy: bool):
         if healthy:
@@ -272,6 +313,8 @@ class ReplicaRouter(Actor):
         if replica in self._unhealthy:
             return
         self._unhealthy.add(replica)
+        self.directory.evict_replica(replica)
+        self._update_directory_share()
         self.logger.warning("%s: replica %s unhealthy — draining",
                             self.name, replica)
         self._drain_replica(replica)
@@ -309,6 +352,67 @@ class ReplicaRouter(Actor):
         first, second = self._rng.sample(known, 2)
         return first if (self._loads[first]["queue_depth"]
                          <= self._loads[second]["queue_depth"]) else second
+
+    # -- prefix-aware routing (kvstore directory) -------------------- #
+
+    def _decode_candidates(self, candidates: List[str]) -> List[str]:
+        """Exclude dedicated PREFILL replicas from decode routing —
+        they clamp generation to one token.  A fleet that is ALL
+        prefill still serves (degraded) rather than black-holing."""
+        decode = [r for r in candidates
+                  if self.directory.role(r) != "prefill"]
+        return decode or candidates
+
+    def _prefill_candidates(self) -> List[str]:
+        return [r for r in self._candidates()
+                if self.directory.role(r) == "prefill"]
+
+    def _prompt_keys(self, payload) -> Dict[int, List[str]]:
+        """Directory-width chain keys of the request's prompt, one
+        list per block size advertised in the fleet (usually one).
+        Decodes only the ``tokens`` entry of the swag — and only when
+        a directory exists to match against."""
+        from ..kvstore import chain_keys_hex
+        from ..pipeline.codec import decode_value
+        try:
+            tokens = np.asarray(
+                decode_value(payload["tokens"])).reshape(-1)
+        except Exception:  # noqa: BLE001 - malformed → no prefix info
+            return {}
+        sizes = {self.directory.block_size(r)
+                 for r in self.directory.replicas()}
+        return {bs: chain_keys_hex(tokens, bs)
+                for bs in sizes if bs}
+
+    def _pick_prefix(self, candidates: List[str], payload):
+        """Score ``queue_depth − α·matched_prefix_blocks`` (lower
+        wins; ties break by replica order for determinism).  Returns
+        ``(target, owner, owner_matched, target_matched)`` or None
+        when nothing matches — the caller falls back to EXACT P2C, so
+        fleets without paged prefix caches see PR-4 routing
+        unchanged."""
+        if self.prefix_alpha <= 0 or not payload \
+                or not self.directory.size:
+            return None
+        keys_by_bs = self._prompt_keys(payload)
+        if not keys_by_bs:
+            return None
+        now = self.process.event.now()
+        matched = {}
+        for replica in candidates:
+            keys = keys_by_bs.get(self.directory.block_size(replica))
+            matched[replica] = self.directory.matched_blocks(
+                replica, keys, now) if keys else 0
+        if not any(matched.values()):
+            return None
+
+        def score(replica):
+            depth = self._loads.get(replica, {}).get("queue_depth", 0)
+            return depth - self.prefix_alpha * matched[replica]
+
+        target = min(candidates, key=lambda r: (score(r), r))
+        owner = max(candidates, key=lambda r: (matched[r], r))
+        return target, owner, matched[owner], matched[target]
 
     def _saturated(self, candidates: List[str]) -> bool:
         """True only when EVERY candidate reports a queue at or past
@@ -355,7 +459,35 @@ class ReplicaRouter(Actor):
             self._shed(request_id, response_topic, "overloaded",
                        retry_after_ms=min(5000, 50 * min(depths)))
             return False
-        target = self._pick(candidates)
+        decode = self._decode_candidates(candidates)
+        picked = self._pick_prefix(decode, payload)
+        if picked is None:
+            target = self._pick(decode)
+            owner = owner_matched = target_matched = None
+        else:
+            target, owner, owner_matched, target_matched = picked
+            self._bump("prefix_routed")
+        send_payload = payload or {}
+        phase = "decode"
+        if self.kv_transfer and owner is not None \
+                and owner != target and owner_matched > (
+                    target_matched or 0):
+            # Load won over affinity — hint the target to PULL the
+            # owner's blocks instead of recomputing the prefix.
+            send_payload = dict(send_payload)
+            send_payload["kv_source"] = f"s:{owner}"
+            self._bump("kv_remote_hints")
+        elif self.disaggregate and self.kv_transfer:
+            prefill = [r for r in self._prefill_candidates()
+                       if r in candidates]
+            if prefill and target not in prefill:
+                # Two-phase: prefill replica computes the prompt KV,
+                # the decode target pulls it (see _begin_decode_phase).
+                phase = "prefill"
+                prefill_target = self._pick(prefill)
+                send_payload = dict(send_payload)
+                send_payload["prefill_only"] = "i:1"
+                target = prefill_target
         self._routed[request_id] = target
         while len(self._routed) > self._routed_limit:
             self._routed.popitem(last=False)
@@ -363,7 +495,8 @@ class ReplicaRouter(Actor):
             replica=target, client_topic=str(response_topic),
             payload=payload or {}, attempts=0, delivered=0,
             replica_sent=0, routed_at=self.process.event.now(),
-            deadline_ts=-1.0)    # -1 = not yet resolved from payload
+            deadline_ts=-1.0,    # -1 = not yet resolved from payload
+            phase=phase)
         while len(self._inflight) > self._inflight_limit:
             dropped_id, _ = self._inflight.popitem(last=False)
             self.logger.warning(
@@ -373,7 +506,7 @@ class ReplicaRouter(Actor):
         self.process.message.publish(
             f"{target}/in",
             generate("infer", [request_id, self.topic_reply,
-                               payload or {}]))
+                               send_payload]))
         self.share["requests_routed"] += 1
         if self.ec_producer is not None:
             self.ec_producer.update("requests_routed",
@@ -409,14 +542,56 @@ class ReplicaRouter(Actor):
             # The REPLICA failed, not the request — move the work.
             self._schedule_redispatch(str(params[0]), entry)
             return
+        if entry.get("phase") == "prefill":
+            if error is not None and str(error) != "cancelled":
+                # Prefill leg failed terminally: decode from scratch
+                # on a decode replica (no kv hint) — the request still
+                # MUST resolve.
+                self._begin_decode_phase(str(params[0]), entry, None)
+            elif error is None:
+                self._begin_decode_phase(str(params[0]), entry,
+                                         entry["replica"])
+            else:             # cancelled: terminal for the client too
+                self._inflight.pop(str(params[0]), None)
+                self.process.message.publish(entry["client_topic"],
+                                             payload)
+            return
         self._inflight.pop(str(params[0]), None)
         self.process.message.publish(entry["client_topic"], payload)
+
+    def _begin_decode_phase(self, request_id: str, entry: Dict,
+                            prefill_replica: Optional[str]):
+        """Second leg of disaggregated serving: the prefill replica
+        finished (its 1-token answer is DISCARDED — the decode leg
+        regenerates it from the transferred KV), now route the full
+        request to a decode replica with a ``kv_source`` hint at the
+        warm prefill cache.  ``prefill_replica=None`` means the
+        prefill leg failed and decode recomputes locally."""
+        entry["phase"] = "decode"
+        entry["replica_sent"] = 0
+        candidates = self._decode_candidates(self._candidates())
+        picked = self._pick_prefix(candidates, entry["payload"])
+        target = picked[0] if picked else self._pick(candidates)
+        send_payload = entry["payload"]
+        if prefill_replica is not None and self.kv_transfer \
+                and target != prefill_replica:
+            send_payload = dict(send_payload)
+            send_payload["kv_source"] = f"s:{prefill_replica}"
+            self._bump("kv_remote_hints")
+        entry["replica"] = target
+        self._routed[request_id] = target
+        self.process.message.publish(
+            f"{target}/in",
+            generate("infer", [request_id, self.topic_reply,
+                               send_payload]))
 
     def _forward_partial(self, request_id: str, entry: Dict, swag):
         """Token-offset dedup: a re-dispatched greedy request replays
         from the prompt, so the new replica re-streams tokens the
         client already has — forward only the suffix past what was
         delivered."""
+        if entry.get("phase") == "prefill":
+            return    # prefill leg's token is regenerated by decode
         try:
             increment = [int(t) for t in
                          np.asarray(decode_swag(swag)["tokens_out"])]
@@ -481,7 +656,14 @@ class ReplicaRouter(Actor):
             # budget above bounds how long we hope.
             self._schedule_redispatch(request_id, entry)
             return
-        target = self._pick(live)
+        if entry.get("phase") == "prefill":
+            # The prefill leg died: demote to a plain single-phase
+            # request on a decode survivor (recompute, no kv hint) —
+            # the zero-lost guarantee outranks disaggregation.
+            entry["phase"] = "decode"
+        live = self._decode_candidates(live)
+        picked = self._pick_prefix(live, entry["payload"])
+        target = picked[0] if picked else self._pick(live)
         entry["replica"] = target
         entry["replica_sent"] = 0     # new replica replays from prompt
         self._routed[request_id] = target

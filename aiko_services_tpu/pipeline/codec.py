@@ -8,6 +8,13 @@ encoded as ``"<tag>:<text>"`` where the tag selects str/int/float/bool/
 json/numpy(+zlib+base64).  JAX arrays are converted to numpy at the
 process boundary — on-pod element hand-offs never hit this codec (device
 buffers stay resident; see the TPU execution layer).
+
+Large HIGH-ENTROPY tensors (KV-cache block transfers, quantized
+activations) defeat zlib: near-random bf16/int8 bytes compress to ≥99%
+of their size while burning a full CPU pass.  ``encode_value`` switches
+to the uncompressed ``N`` tag (base64'd ``np.save`` bytes, no zlib) once
+an array exceeds :data:`RAW_NBYTES` — decode accepts both tags
+regardless of size, so the threshold can move without a wire break.
 """
 
 from __future__ import annotations
@@ -20,7 +27,12 @@ from typing import Any, Dict
 
 import numpy as np
 
-__all__ = ["encode_value", "decode_value", "encode_swag", "decode_swag"]
+__all__ = ["encode_value", "decode_value", "encode_swag", "decode_swag",
+           "RAW_NBYTES"]
+
+#: Arrays at or above this many bytes skip zlib (``N`` tag): token id
+#: vectors stay tiny-and-compressible, KV block payloads are entropy.
+RAW_NBYTES = 16384
 
 
 def encode_value(value: Any) -> str:
@@ -38,7 +50,10 @@ def encode_value(value: Any) -> str:
         array = np.asarray(value)
         buffer = io.BytesIO()
         np.save(buffer, array, allow_pickle=False)
-        packed = base64.b64encode(zlib.compress(buffer.getvalue()))
+        raw = buffer.getvalue()
+        if array.nbytes >= RAW_NBYTES:
+            return f"N:{base64.b64encode(raw).decode('ascii')}"
+        packed = base64.b64encode(zlib.compress(raw))
         return f"n:{packed.decode('ascii')}"
     # Lists / dicts of JSON-compatible values.
     return f"j:{json.dumps(value)}"
@@ -58,6 +73,9 @@ def decode_value(text: str) -> Any:
         return float(body)
     if tag == "n":
         raw = zlib.decompress(base64.b64decode(body.encode("ascii")))
+        return np.load(io.BytesIO(raw), allow_pickle=False)
+    if tag == "N":
+        raw = base64.b64decode(body.encode("ascii"))
         return np.load(io.BytesIO(raw), allow_pickle=False)
     if tag == "j":
         return json.loads(body)

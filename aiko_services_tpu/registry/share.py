@@ -112,8 +112,25 @@ class ECProducer:
         return dict_path_get(self.share, path, default)
 
     def update(self, path: str, value):
+        """Set + broadcast UNCONDITIONALLY — identical-value updates
+        still go to the wire.  Consumers may rely on re-broadcast as a
+        liveness signal (the kvstore prefix directory refreshes its
+        per-replica lease on every ``kv_prefixes`` update, changed or
+        not); use :meth:`update_if_changed` to suppress no-op traffic
+        for plain counters."""
         dict_path_set(self.share, path, value)
         self._broadcast("update", path, value)
+
+    def update_if_changed(self, path: str, value) -> bool:
+        """Broadcast only when ``value`` differs from the stored one
+        (compared post-stringification, matching what the wire would
+        carry).  Returns True when a broadcast was sent."""
+        sentinel = object()
+        current = dict_path_get(self.share, path, sentinel)
+        if current is not sentinel and str(current) == str(value):
+            return False
+        self.update(path, value)
+        return True
 
     def add(self, path: str, value):
         dict_path_set(self.share, path, value)

@@ -185,6 +185,18 @@ def model_replica_plugin(fields, variables) -> List[str]:
                 f"{_get(variables, 'prefix_misses', default=0)} misses, "
                 f"{_get(variables, 'prefix_evictions', default=0)}"
                 f" evicted")
+        remote = _get(variables, "prefix_remote_hits", default=None)
+        xfer_bytes = _get(variables, "kv_transfer_bytes", default=None)
+        if remote not in (None, "-") or \
+                xfer_bytes not in (None, "-", 0):
+            lines.append(
+                f"  kv xfer:   {remote or 0} remote hits, "
+                f"{xfer_bytes or 0} B in "
+                f"{_get(variables, 'kv_transfer_ms', default=0)} ms, "
+                f"{_get(variables, 'kv_transfer_failures', default=0)}"
+                f" failed, "
+                f"{_get(variables, 'kv_spill_evictions', default=0)}"
+                f" spills")
     adapters = _get(variables, "adapters", default=None)
     if adapters not in (None, "-", ""):
         lines.append(f"  adapters:  {adapters}")
@@ -231,6 +243,14 @@ def replica_router_plugin(fields, variables) -> List[str]:
     unrouted = _get(variables, "cancel_unrouted", default=None)
     if unrouted not in (None, "-", 0):
         lines.append(f"  cancels:    {unrouted} unrouted")
+    directory = _get(variables, "kv_directory_size", default=None)
+    if directory not in (None, "-"):
+        lines.append(
+            f"  kv dir:     {directory} advertised blocks, "
+            f"{_get(variables, 'prefix_routed', default=0)}"
+            f" prefix-routed, "
+            f"{_get(variables, 'kv_remote_hints', default=0)}"
+            f" transfer hints")
     return lines
 
 

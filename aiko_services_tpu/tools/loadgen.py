@@ -29,7 +29,8 @@ from ..pipeline.codec import encode_swag
 from ..utils.sexpr import generate, parse
 
 __all__ = ["LoadGenerator", "LoadReport", "service_scale_sweep",
-           "chaos_schedule", "run_chaos", "main"]
+           "chaos_schedule", "run_chaos", "shared_prefix_payloads",
+           "run_shared_prefix", "main"]
 
 
 @dataclasses.dataclass
@@ -54,6 +55,14 @@ class LoadReport:
     #: healthy shed (``overloaded``/``deadline_exceeded`` — the
     #: backpressure design working) from real failures.
     error_kinds: Dict[str, int] = dataclasses.field(default_factory=dict)
+    #: Fleet prefix-cache hit fraction over the run
+    #: (``Σ prefix_hits / Σ (prefix_hits + prefix_misses)`` across
+    #: replicas; None when the fleet has no prefix caches) — attached
+    #: by the harness from server stats, like ``server_stats``.
+    prefix_hit_rate: Optional[float] = None
+    #: Total cross-replica KV bytes moved during the run (Σ replica
+    #: ``kv_transfer_bytes`` deltas).
+    kv_transfer_bytes: int = 0
 
     @property
     def lost(self) -> int:
@@ -111,13 +120,17 @@ class LoadReport:
         kinds = (", kinds=" + "/".join(
             f"{k}:{n}" for k, n in sorted(self.error_kinds.items()))
             if self.error_kinds else "")
+        prefix = (f", prefix_hit={self.prefix_hit_rate:.0%}"
+                  if self.prefix_hit_rate is not None else "")
+        kv = (f", kv_xfer={self.kv_transfer_bytes}B"
+              if self.kv_transfer_bytes else "")
         return (f"LoadReport(sent={self.sent}, done={self.completed}, "
                 f"errors={self.errors}{kinds}, "
                 f"timeouts={self.timeouts}, "
                 f"{self.throughput_rps:.1f} req/s, "
                 f"{self.throughput_tps:.1f} tok/s, "
                 f"p50={self.p50_ms:.1f} ms, p99={self.p99_ms:.1f} ms"
-                f"{ttft}{attn})")
+                f"{ttft}{prefix}{kv}{attn})")
 
 
 class LoadGenerator:
@@ -163,15 +176,24 @@ class LoadGenerator:
         outputs = params[1] if len(params) > 1 else {}
         if isinstance(outputs, dict) and "error" in outputs:
             self._errors += 1
-            kind = str(outputs["error"])
+            # Values on the wire are codec-tagged ("s:overloaded") —
+            # decode, so error_kinds keys match the error strings the
+            # replicas publish.
+            try:
+                from ..pipeline.codec import decode_value
+                kind = str(decode_value(outputs["error"]))
+            except Exception:  # noqa: BLE001 - count it regardless
+                kind = str(outputs["error"])
             self._error_kinds[kind] = \
                 self._error_kinds.get(kind, 0) + 1
         else:
             self._latencies.append((self._clock() - started) * 1e3)
             if isinstance(outputs, dict) and "ttft_ms" in outputs:
                 try:
-                    self._ttfts.append(float(str(outputs["ttft_ms"])))
-                except (TypeError, ValueError):
+                    from ..pipeline.codec import decode_value
+                    self._ttfts.append(
+                        float(decode_value(outputs["ttft_ms"])))
+                except Exception:  # noqa: BLE001 - telemetry only
                     pass
             if isinstance(outputs, dict) and "tokens_out" in outputs:
                 try:
@@ -265,6 +287,13 @@ def service_scale_sweep(services: int, broker: str = "scale-sweep",
     while registrar.state != "primary" \
             and time_module.time() < deadline:
         time_module.sleep(0.02)
+    if registrar.state != "primary":
+        # Fail HERE, not as a misleading discovery-count assertion
+        # 2 minutes later: nothing registers without a primary.
+        process.terminate()
+        engine.terminate()
+        thread.join(timeout=5)
+        raise TimeoutError("scale sweep: registrar never went primary")
     try:
         t0 = time_module.perf_counter()
         actors = [compose_instance(Echo, actor_args(f"svc{i}"),
@@ -305,6 +334,146 @@ def service_scale_sweep(services: int, broker: str = "scale-sweep",
         thread.join(timeout=5)
 
 
+def shared_prefix_payloads(n_conversations: int = 4, turns: int = 4,
+                           system_len: int = 48, turn_len: int = 8,
+                           max_new_tokens: int = 6, vocab: int = 1024,
+                           seed: int = 0, stream: bool = True
+                           ) -> Callable[[int], Dict]:
+    """Multi-turn chat-style workload: ``n_conversations`` interleaved
+    conversations of ``turns`` turns, ALL sharing one
+    ``system_len``-token system prompt, each turn re-sending the
+    conversation so far plus ``turn_len`` fresh tokens — the workload
+    shape where a cluster-wide prefix cache pays (every request's
+    prompt head is either the shared system prompt or a prior turn's
+    whole prompt).
+
+    ``payload_fn(index)``: conversation ``index % n_conversations``,
+    turn ``(index // n_conversations) % turns`` — so concurrent
+    requests hit DIFFERENT conversations (interleaving, like real
+    traffic) while turn order within a conversation is preserved by
+    send order.  Deterministic from ``seed``."""
+    import numpy as np
+
+    rng = np.random.RandomState(seed)
+    system = rng.randint(1, vocab, size=system_len).astype(np.int32)
+    turn_tokens = [[rng.randint(1, vocab,
+                                size=turn_len).astype(np.int32)
+                    for _ in range(turns)]
+                   for _ in range(n_conversations)]
+
+    def payload_fn(index: int) -> Dict:
+        conversation = index % n_conversations
+        turn = (index // n_conversations) % turns
+        prompt = np.concatenate(
+            [system] + turn_tokens[conversation][:turn + 1])
+        payload = {"tokens": prompt, "max_new_tokens": max_new_tokens}
+        if stream:
+            payload["stream"] = 1
+        return payload
+
+    return payload_fn
+
+
+def _fleet_kv_stats(servers) -> Dict:
+    """Aggregate the kvstore counters a shared-prefix run reports."""
+    totals = dict(prefix_hits=0, prefix_misses=0, kv_transfer_bytes=0,
+                  prefix_remote_hits=0, kv_transfer_failures=0)
+    for server in servers:
+        stats = server.stats()
+        for key in totals:
+            totals[key] += int(stats.get(key, 0))
+    return totals
+
+
+def run_shared_prefix(n_requests: int = 24, rate_hz: float = 50.0,
+                      n_conversations: int = 3, turns: int = 4,
+                      system_len: int = 48,
+                      prefix_routing: bool = True,
+                      kv_transfer: bool = True,
+                      drain_timeout_s: float = 90.0,
+                      seed: int = 0) -> LoadReport:
+    """In-process 2-replica PAGED serving rig (prefix caches on)
+    driven by :func:`shared_prefix_payloads` through a ReplicaRouter.
+    ``prefix_routing=False`` degrades the router to pure
+    least-loaded P2C (``prefix_alpha=0``) — the A/B baseline bench.py
+    compares TTFT against.  The report carries ``prefix_hit_rate``
+    and ``kv_transfer_bytes`` aggregated across the fleet."""
+    from ..orchestration.continuous import ContinuousReplica
+    from ..orchestration.paged import PagedContinuousServer
+    from ..orchestration.serving import ReplicaRouter
+    from ..registry import Registrar
+    from ..runtime import Process, actor_args, compose_instance
+    from ..runtime.event import EventEngine
+
+    def wait_for(predicate, timeout_s: float, what: str):
+        deadline = time.time() + timeout_s
+        while not predicate():
+            if time.time() > deadline:
+                raise TimeoutError(f"shared-prefix rig: {what}")
+            time.sleep(0.02)
+
+    engine = EventEngine()
+    thread = engine.run_in_thread()
+    broker = f"sharedpfx-{uuid.uuid4().hex[:6]}"
+    processes = []
+
+    def make_process(pid):
+        process = Process(namespace="sharedpfx", hostname="h",
+                          pid=str(pid), engine=engine, broker=broker)
+        processes.append(process)
+        return process
+
+    generator = None
+    servers = []
+    try:
+        registrar = Registrar(process=make_process(1))
+        wait_for(lambda: registrar.state == "primary", 10,
+                 "registrar primary")
+        for index, name in enumerate(("replica_a", "replica_b")):
+            server = PagedContinuousServer(
+                config_name="tiny", slots=2, chunk_steps=4, seed=0,
+                enable_prefix_cache=True, max_queue=256,
+                watchdog_s=5.0)
+            servers.append(server)
+            compose_instance(ContinuousReplica, actor_args(name),
+                             process=make_process(2 + index),
+                             server=server)
+        router = compose_instance(
+            ReplicaRouter, actor_args("router"),
+            process=make_process(8),
+            prefix_alpha=1.0 if prefix_routing else 0.0,
+            kv_transfer=kv_transfer)
+        wait_for(lambda: router.share["replicas"] == 2, 30,
+                 "router discovery")
+        generator = LoadGenerator(
+            make_process(9), f"{router.topic_path}/in",
+            payload_fn=shared_prefix_payloads(
+                n_conversations=n_conversations, turns=turns,
+                system_len=system_len, seed=seed),
+            rate_hz=rate_hz)
+        report = generator.run(n_requests,
+                               drain_timeout_s=drain_timeout_s)
+        totals = _fleet_kv_stats(servers)
+        lookups = totals["prefix_hits"] + totals["prefix_misses"]
+        if lookups:
+            report.prefix_hit_rate = totals["prefix_hits"] / lookups
+        report.kv_transfer_bytes = totals["kv_transfer_bytes"]
+        report.server_stats = dict(
+            router.counters, **totals,
+            kv_directory_size=router.share.get("kv_directory_size", 0))
+        return report
+    finally:
+        if generator is not None:
+            generator.close()
+        for process in reversed(processes):
+            try:
+                process.terminate()
+            except Exception:  # noqa: BLE001 - teardown best-effort
+                pass
+        engine.terminate()
+        thread.join(timeout=5)
+
+
 def chaos_schedule(seed: int):
     """The canonical seeded fault schedule for ``loadgen --chaos``:
     one replica death mid-decode, streaming-increment message drops,
@@ -338,11 +507,15 @@ def run_chaos(seed: int = 0, n_requests: int = 40,
     reaches a terminal state (completed, or an explicit error like
     ``deadline_exceeded``/``overloaded``) no matter which replica died
     or which messages vanished.  CPU-friendly (tiny config); set
-    ``JAX_PLATFORMS=cpu`` when no accelerator is wanted."""
-    import numpy as np
+    ``JAX_PLATFORMS=cpu`` when no accelerator is wanted.
 
-    from ..orchestration.continuous import (ContinuousBatchingServer,
-                                            ContinuousReplica)
+    Replicas run the PAGED backend with prefix caches on and the
+    router routes prefix-aware with KV transfer enabled — the chaos
+    gate covers the kvstore path too: killing a directory-advertised
+    prefix owner mid-stream must still lose ZERO requests (directory
+    eviction + fetch-timeout fallback to local prefill)."""
+    from ..orchestration.continuous import ContinuousReplica
+    from ..orchestration.paged import PagedContinuousServer
     from ..orchestration.serving import ReplicaRouter
     from ..registry import Registrar
     from ..runtime import (Process, actor_args, compose_instance,
@@ -369,6 +542,7 @@ def run_chaos(seed: int = 0, n_requests: int = 40,
         return process
 
     generator = None
+    servers = []
     try:
         registrar = Registrar(process=make_process(1))
         wait_for(lambda: registrar.state == "primary", 10,
@@ -377,26 +551,39 @@ def run_chaos(seed: int = 0, n_requests: int = 40,
             # Same config+seed on purpose: greedy decode is replica-
             # independent, so re-dispatched requests finish with the
             # exact tokens the dead replica would have produced.
-            server = ContinuousBatchingServer(
+            server = PagedContinuousServer(
                 config_name="tiny", slots=2, chunk_steps=4, seed=0,
-                max_queue=256, watchdog_s=5.0)
+                enable_prefix_cache=True, max_queue=256,
+                watchdog_s=5.0)
+            servers.append(server)
             compose_instance(ContinuousReplica, actor_args(name),
                              process=make_process(2 + index),
-                             server=server)
+                             server=server,
+                             # Dead-owner fallback must fire well
+                             # inside the drain budget.
+                             kv_fetch_timeout_s=2.0)
         router = compose_instance(ReplicaRouter, actor_args("router"),
-                                  process=make_process(8))
+                                  process=make_process(8),
+                                  kv_transfer=True)
         wait_for(lambda: router.share["replicas"] == 2, 30,
                  "router discovery")
         generator = LoadGenerator(
             make_process(9), f"{router.topic_path}/in",
-            payload_fn=lambda i: {
-                "tokens": np.arange(1, 5 + i % 3, dtype=np.int32),
-                "max_new_tokens": 6, "stream": 1},
+            # Shared 32-token system prefix: the fault schedule then
+            # kills a replica the directory advertises as an owner.
+            payload_fn=shared_prefix_payloads(
+                n_conversations=3, turns=4, system_len=32,
+                seed=seed),
             rate_hz=rate_hz)
         report = generator.run(n_requests,
                                drain_timeout_s=drain_timeout_s)
+        totals = _fleet_kv_stats(servers)
+        lookups = totals["prefix_hits"] + totals["prefix_misses"]
+        if lookups:
+            report.prefix_hit_rate = totals["prefix_hits"] / lookups
+        report.kv_transfer_bytes = totals["kv_transfer_bytes"]
         report.server_stats = dict(
-            router.counters,
+            router.counters, **totals,
             replicas_live=router.share["replicas"],
             faults_fired=len(plan.fired))
         return report
@@ -414,25 +601,49 @@ def run_chaos(seed: int = 0, n_requests: int = 40,
 
 
 def main(argv: Optional[List[str]] = None) -> int:
-    """``python -m aiko_services_tpu.tools.loadgen --chaos``: load
-    test under the seeded fault schedule; exit 1 if any request was
-    lost or hung."""
+    """``python -m aiko_services_tpu.tools.loadgen --chaos`` (seeded
+    fault schedule; exit 1 if any request was lost or hung) or
+    ``--workload shared_prefix`` (multi-turn shared-system-prompt
+    profile through the prefix-aware router)."""
     import argparse
 
     parser = argparse.ArgumentParser(
         description="Serving load generator (chaos mode: seeded "
                     "fault-injection run asserting zero lost "
-                    "requests)")
+                    "requests; shared_prefix workload: multi-turn "
+                    "conversations against the prefix-aware router)")
     parser.add_argument("--chaos", action="store_true",
                         help="run the seeded fault schedule against "
                              "an in-process 2-replica rig")
+    parser.add_argument("--workload", choices=["shared_prefix"],
+                        help="named workload profile (in-process rig)")
     parser.add_argument("--seed", type=int, default=0)
     parser.add_argument("--requests", type=int, default=40)
     parser.add_argument("--rate-hz", type=float, default=100.0)
+    parser.add_argument("--conversations", type=int, default=3,
+                        help="shared_prefix: interleaved conversations")
+    parser.add_argument("--turns", type=int, default=4,
+                        help="shared_prefix: turns per conversation")
+    parser.add_argument("--system-len", type=int, default=48,
+                        help="shared_prefix: shared system prompt "
+                             "tokens")
+    parser.add_argument("--no-prefix-routing", action="store_true",
+                        help="shared_prefix: disable prefix-aware "
+                             "scoring (A/B baseline)")
     args = parser.parse_args(argv)
+    if args.workload == "shared_prefix":
+        report = run_shared_prefix(
+            n_requests=args.requests, rate_hz=args.rate_hz,
+            n_conversations=args.conversations, turns=args.turns,
+            system_len=args.system_len,
+            prefix_routing=not args.no_prefix_routing,
+            seed=args.seed)
+        print(report)
+        print(f"fleet counters: {report.server_stats}")
+        return 1 if (report.lost or report.timeouts) else 0
     if not args.chaos:
         parser.error("API runs use LoadGenerator directly; the CLI "
-                     "currently wires --chaos only")
+                     "wires --chaos and --workload shared_prefix")
     report = run_chaos(seed=args.seed, n_requests=args.requests,
                        rate_hz=args.rate_hz)
     print(report)

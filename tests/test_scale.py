@@ -12,6 +12,20 @@ import pytest
 from aiko_services_tpu.tools.loadgen import service_scale_sweep
 
 
+def test_multi_actor_single_process_rpc_sweep():
+    """Fast tier-1 cover for the multi-actor-in-one-process path the
+    slow 1500-service test exercises at density: a dozen actors in ONE
+    process must all register, be discovered, and answer an RPC each
+    through the full parse→mailbox→dispatch path."""
+    report = service_scale_sweep(12, broker="scale-fast",
+                                 create_timeout_s=30.0,
+                                 rpc_timeout_s=30.0)
+    assert report["registrar_discovered"] == 12
+    assert report["rpc_sweep_per_sec"] > 0   # sweep asserts all answered
+    assert report["exact_indexed_topics"] >= 12
+    assert report["wildcard_patterns"] < 10
+
+
 @pytest.mark.slow
 def test_1500_services_register_and_answer_rpcs():
     report = service_scale_sweep(1500, broker="scale-test")
