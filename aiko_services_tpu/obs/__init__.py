@@ -1,0 +1,30 @@
+"""Observability subsystem: tracing, step timeline, unified metrics.
+
+Three pillars (ISSUE 6):
+
+* :mod:`.trace` — distributed request tracing.  Spans carry
+  ``trace_id/span_id/parent_id`` plus monotonic, epoch-aligned
+  timestamps; context rides the existing S-expression payloads as an
+  optional ``trace`` swag field, so one request produces ONE span tree
+  across InferClient → ReplicaRouter → replica → kvstore transfer
+  source.  Export is Chrome trace-event JSON (Perfetto-loadable).
+* :mod:`.steplog` — fixed-size ring-buffer recorder for host-side
+  engine step events (dispatch, ring-sync wait, commit, admission
+  wave, sampling edit).  Zero-cost when disabled: every call site is
+  guarded by ``steplog.RECORDER is not None`` (the ``faults.PLAN``
+  discipline), and AST/jaxpr tests pin that NO obs code lands inside
+  jitted modules.
+* :mod:`.metrics` — Counter / Gauge / Histogram registry with FIXED
+  log-spaced histogram buckets, so replicas' histograms merge exactly
+  at the router and dashboard; exported through EC shares and the
+  ``(metrics …)`` Prometheus-text actor command.
+
+Import discipline: ``obs`` modules import nothing from the rest of the
+package (stdlib only; ``jax`` strictly lazily), so every layer —
+transport, runtime, orchestration, tools — may depend on them without
+cycles, and ``ops/`` + ``models/`` must not import them at all.
+"""
+
+from . import metrics, steplog, trace  # noqa: F401
+
+__all__ = ["metrics", "steplog", "trace"]

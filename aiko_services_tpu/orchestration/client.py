@@ -28,6 +28,7 @@ from typing import Callable, Dict, List, Optional
 
 import numpy as np
 
+from ..obs import trace
 from ..pipeline.codec import decode_swag, encode_swag
 from ..utils.sexpr import generate, parse
 
@@ -46,6 +47,11 @@ class InferFuture:
         self.error: Optional[str] = None
         self.done = False
         self.on_partial: Optional[Callable[[List[int]], None]] = None
+        #: Full request span tree (root + router + replica + kv
+        #: source spans) when tracing was on at submit — the remote
+        #: spans ride back on the response's ``trace_spans`` field.
+        self.spans: List = []
+        self._root_span = None
         self._event = threading.Event()
 
     def _resolve(self, outputs: Optional[Dict], error) -> None:
@@ -136,6 +142,12 @@ class InferClient:
             f"{prefix}{self._uid}_{next(self._counter)}"
         future = InferFuture(request_id)
         future.on_partial = on_partial
+        if trace.TRACER is not None and command == "infer":
+            span = trace.TRACER.start_span(
+                "infer", attrs={"request_id": request_id,
+                                "target": self.topic_in})
+            swag = dict(swag, trace=trace.inject(span))
+            future._root_span = span
         self._futures[request_id] = future
         self.process.message.publish(
             self.topic_in,
@@ -202,12 +214,24 @@ class InferClient:
             self._futures.pop(future.request_id, None)
             return
         if command == "infer_partial":
+            if future._root_span is not None and \
+                    not future.partial_tokens:
+                future._root_span.mark("client_first_token")
             increment = [int(t) for t in
                          np.asarray(outputs["tokens_out"])]
             future.partial_tokens.extend(increment)
             if future.on_partial is not None:
                 future.on_partial(increment)
             return
+        if future._root_span is not None:
+            root = future._root_span
+            if trace.TRACER is not None:
+                trace.TRACER.finish(root)
+            elif root.end is None:
+                root.end = root.start
+            remote = outputs.get("trace_spans")
+            future.spans = [root] + (trace.decode_spans(remote)
+                                     if remote else [])
         future._resolve(outputs, outputs.get("error"))
         # pop, not del: a concurrent forget() may have removed the
         # entry between the get() above and here (documented usage

@@ -68,6 +68,8 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
+from ..obs import steplog, trace
+from ..obs.metrics import CounterDict, Histogram
 from ..runtime import faults
 from ..runtime.actor import Actor
 from ..utils.sexpr import generate, parse
@@ -113,6 +115,20 @@ class DecodeRequest:
     submitted_ts: Optional[float] = None
     first_token_ts: Optional[float] = None
     finished_ts: Optional[float] = None
+    #: Slot activation (admission wave that reserved the slot) — with
+    #: the stamps above this decomposes the request's life into the
+    #: phases the obs layer histograms: queue-wait (submit→activate),
+    #: prefill (activate→first token), decode (first→finish).
+    activated_ts: Optional[float] = None
+    #: Milliseconds spent restoring this request's prefix KV from a
+    #: remote replica (0 when no kv_source hint / local hit).
+    kv_restore_ms: float = 0.0
+    #: Propagated trace context (``trace_id/span_id`` wire form) — the
+    #: replica synthesizes phase spans under it at response time.
+    trace_ctx: Optional[str] = None
+    #: Encoded spans fetched alongside a remote KV restore (the
+    #: source's ``kv_export`` span) — merged into the response tree.
+    remote_spans: Optional[str] = None
 
 
 def _bucket(n: int, minimum: int = 16) -> int:
@@ -331,12 +347,26 @@ class ContinuousBatchingServer:
         self._inflight_sched = np.zeros(slots, np.int64)
         #: slots whose host mirror changed since the last dispatch.
         self._dirty = np.zeros(slots, bool)
-        self.counters: Dict = dict(
+        # Registry-mirrored engine counters: the dict API is unchanged
+        # (tests and stats() read it directly) while every write also
+        # lands in the process metrics registry under
+        # ``aiko_server_<key>{instance=…}`` for the (metrics …) dump.
+        self._metrics_labels = {"instance": f"srv{id(self) & 0xffff:x}"}
+        self.counters: Dict = CounterDict(dict(
             dispatches=0, decode_steps=0, tokens_committed=0,
             host_syncs=0, sync_wait_ms=0.0, sync_elements=0,
             state_uploads=0, max_in_flight=0, admission_deferred=0,
             decode_blocks_read=0, prefill_tokens=0,
-            deadline_exceeded=0, shed=0, watchdog_trips=0)
+            deadline_exceeded=0, shed=0, watchdog_trips=0),
+            prefix="server", labels=self._metrics_labels)
+        # Per-phase latency histograms — FIXED log-spaced buckets, so
+        # the router/loadgen can merge them across replicas exactly
+        # (they ride EC shares as ``hist.<phase>`` encoded strings).
+        self.latency_hists: Dict[str, Histogram] = {
+            phase: Histogram(name=f"aiko_latency_{phase}_ms",
+                             labels=self._metrics_labels)
+            for phase in ("ttft", "total", "queue", "prefill",
+                          "decode", "kv_restore")}
         self._serve_started: Optional[float] = None
         # ---- robustness: backpressure + device watchdog -------------- #
         #: bounded queue: submits past this depth shed with
@@ -401,6 +431,9 @@ class ContinuousBatchingServer:
         merge races its own inputs."""
         if not self._dirty.any():
             return
+        if steplog.RECORDER is not None:
+            steplog.RECORDER.record("state_upload",
+                                    rows=int(self._dirty.sum()))
         snapshot = {key: np.array(value)
                     for key, value in self._host_state().items()}
         self._state = self._merge_state(self._state, snapshot,
@@ -581,6 +614,7 @@ class ContinuousBatchingServer:
                 self.counters["admission_deferred"] += 1
                 break      # capacity (paged pool) exhausted; next chunk
             self._queue.pop(0)
+            request.activated_ts = time.monotonic()
             prompt_padded = np.zeros((1, padded), np.int32)
             prompt_padded[:, :prompt_len] = prompt
             if self.chunk_prefill_tokens \
@@ -596,6 +630,11 @@ class ContinuousBatchingServer:
                                             prompt_padded, prompt_len)
                 continue
             admissions.append((slot, request, prompt_padded, prompt_len))
+        if steplog.RECORDER is not None:
+            if admissions or self._prefilling:
+                steplog.RECORDER.record("admission",
+                                        slots=len(admissions),
+                                        chunked=len(self._prefilling))
         if not admissions:
             return
         self._prefill_and_insert(admissions)
@@ -623,6 +662,11 @@ class ContinuousBatchingServer:
         self._slot_serial[slot] += 1
         self._dirty[slot] = True
         self._any_sampled = bool((self._temperatures > 0).any())
+        if steplog.RECORDER is not None:
+            steplog.RECORDER.record(
+                "sampling_edit", slot=slot,
+                temperature=float(request.temperature),
+                top_p=float(request.top_p))
 
     def _begin_chunked_prefill(self, slot: int, request, prompt_padded,
                                prompt_len: int) -> None:
@@ -1190,6 +1234,8 @@ class ContinuousBatchingServer:
         self.counters["dispatches"] += 1
         self.counters["max_in_flight"] = max(
             self.counters["max_in_flight"], len(self._ring))
+        if steplog.RECORDER is not None:
+            steplog.RECORDER.record("dispatch", ring=len(self._ring))
 
     def _note_prefill(self, tokens: int) -> None:
         """Count prompt tokens dispatched to prefill (any path:
@@ -1246,6 +1292,10 @@ class ContinuousBatchingServer:
         self.counters["sync_elements"] += (tokens.size + counts.size
                                            + active_after.size)
         self.counters["decode_steps"] += entry["steps"]
+        if steplog.RECORDER is not None:
+            steplog.RECORDER.record(
+                "sync", wait_ms=round((now - wait_start) * 1e3, 3),
+                steps=int(entry["steps"]))
         for slot in range(self.slots):
             if entry["serial"][slot] != self._slot_serial[slot]:
                 continue           # slot was retired/readmitted since
@@ -1272,6 +1322,10 @@ class ContinuousBatchingServer:
                 self.counters["tokens_committed"] += count
             if not active_after[slot]:
                 self._retire(slot)
+        if steplog.RECORDER is not None:
+            # Device-reported emit counts: stale-serial lanes may be
+            # excluded above, so this is an upper bound on committed.
+            steplog.RECORDER.record("commit", tokens=int(counts.sum()))
 
     def _trip_watchdog(self) -> None:
         """Mark the replica wedged (idempotent; callable from the
@@ -1380,13 +1434,13 @@ class ContinuousReplica(Actor):
         #: Keyed by object identity, not request_id: the client owns
         #: that string and may reuse it across concurrent requests.
         self._stream_sent: Dict[int, int] = {}
-        #: rolling window of completed-request latencies (seconds);
-        #: p50s surface in the EC share for the dashboard.
-        from collections import deque
-        self._ttft_window = deque(maxlen=64)
-        self._total_window = deque(maxlen=64)
+        #: slowest completed requests — ``(total_ms, request_id,
+        #: {phase: ms})`` kept sorted descending; surfaces in the EC
+        #: share as ``slow_requests`` for the dashboard pane.
+        self._slow: List = []
         # Warm-start fetches in flight: token -> parked DecodeRequest.
         self._kv_pending: Dict[str, DecodeRequest] = {}
+        self._kv_started: Dict[str, float] = {}
         self._kv_counter = 0
         self._kv_topic = f"{self.topic_path}/kv"
         if self._kv_capable():
@@ -1428,6 +1482,9 @@ class ContinuousReplica(Actor):
                 # arrival is not charged).
                 request.deadline_ts = time.monotonic() + \
                     float(np.asarray(deadline_ms)) / 1e3
+            carrier = inputs.get("trace")
+            if carrier:
+                request.trace_ctx = str(carrier)
             kv_source = inputs.get("kv_source")
             if self.prefill_only or inputs.get("prefill_only"):
                 # Dedicated prefill: the admission seed IS the one
@@ -1487,26 +1544,39 @@ class ContinuousReplica(Actor):
 
     def _share_telemetry(self):
         """Operator view (dashboard / any ECConsumer): live slot
-        occupancy, queue depth, async-loop perf counters, and rolling
-        p50 latencies, refreshed every pump."""
-        import statistics
+        occupancy, queue depth, async-loop perf counters, latency
+        quantiles and encoded histograms, refreshed every pump.
+
+        Quantiles come from the server's fixed-bucket histograms
+        (obs.metrics) rather than a rolling raw-sample window: the
+        SAME bucket bounds everywhere mean a router can merge the
+        ``hist.<phase>`` encodings it watches across replicas and
+        quote exact fleet-level p50/p95/p99 — nearest-rank lists
+        cannot merge without shipping every sample."""
         from .serving import serving_telemetry
         updates = serving_telemetry(self.server.stats())
         if self._kv_capable():
             updates["kv_prefixes"] = \
                 self.server.prefix_digest(role=self.kv_role)
-        if self._ttft_window:
-            updates["ttft_p50_ms"] = round(
-                statistics.median(self._ttft_window) * 1e3, 1)
-            # Same nearest-rank convention as LoadReport._quantile —
+        hists = self.server.latency_hists
+        if hists["ttft"].count:
+            updates["ttft_p50_ms"] = round(hists["ttft"].quantile(0.5), 1)
             # p95 is the admission-stall number SLOs watch (p50 hides
             # a prefill convoy behind the median).
-            ordered = sorted(self._ttft_window)
-            index = min(len(ordered) - 1, int(0.95 * len(ordered)))
-            updates["ttft_p95_ms"] = round(ordered[index] * 1e3, 1)
-        if self._total_window:
+            updates["ttft_p95_ms"] = round(
+                hists["ttft"].quantile(0.95), 1)
+        if hists["total"].count:
             updates["total_p50_ms"] = round(
-                statistics.median(self._total_window) * 1e3, 1)
+                hists["total"].quantile(0.5), 1)
+        for phase, hist in hists.items():
+            if hist.count:
+                updates[f"hist.{phase}"] = hist.encode()
+        if self._slow:
+            updates["slow_requests"] = " ".join(
+                f"{request_id}:{total_ms}:" + ",".join(
+                    f"{phase}={value}" for phase, value
+                    in sorted(breakdown.items()))
+                for total_ms, request_id, breakdown in self._slow)
         if not self.server.healthy \
                 and self.share.get("lifecycle") != "unhealthy":
             # The router watches lifecycle on the replica's state
@@ -1543,13 +1613,18 @@ class ContinuousReplica(Actor):
         resolve the requested chain segment and answer with the pool
         rows, or an error the importer treats as a recompute
         fallback."""
+        from ..obs import trace
         from ..pipeline.codec import decode_swag, encode_swag
+        started = trace.now()
+        carrier = None
         outputs = {"error": "kv_unsupported"}
         if self._kv_capable():
             try:
                 inputs = decode_swag(payload or {})
+                carrier = inputs.get("trace")
+                keys = [str(k) for k in inputs["kv_keys"]]
                 exported = self.server.kv_export_payload(
-                    [str(k) for k in inputs["kv_keys"]],
+                    keys,
                     int(np.asarray(inputs.get("kv_start_depth", 0))))
                 outputs = exported if exported is not None \
                     else {"error": "kv_prefix_gone"}
@@ -1557,6 +1632,13 @@ class ContinuousReplica(Actor):
                 self.logger.exception("%s: kv_export failed",
                                       self.name)
                 outputs = {"error": "kv_export_failed"}
+        if carrier and "error" not in outputs:
+            # Transfer-source span: the exporter's share of a traced
+            # request's warm start, riding back with the blocks.
+            span = trace.synth_span(
+                "kv_export", str(carrier), self.name, started,
+                trace.now(), attrs={"keys": len(keys)})
+            outputs["trace_spans"] = trace.encode_spans([span])
         self.process.message.publish(
             str(response_topic),
             generate("kv_export_response",
@@ -1581,12 +1663,16 @@ class ContinuousReplica(Actor):
         self._kv_counter += 1
         token = f"kvf{self._kv_counter}"
         self._kv_pending[token] = request
+        self._kv_started[token] = time.monotonic()
+        swag = {"kv_keys": keys[local:], "kv_start_depth": local}
+        if request.trace_ctx:
+            # The owner answers with its "kv_export" span under the
+            # SAME trace — the transfer source joins the request tree.
+            swag["trace"] = request.trace_ctx
         self.process.message.publish(
             f"{kv_source}/in",
             generate("kv_export",
-                     [token, self._kv_topic,
-                      encode_swag({"kv_keys": keys[local:],
-                                   "kv_start_depth": local})]))
+                     [token, self._kv_topic, encode_swag(swag)]))
         self.process.event.add_timer_handler(
             lambda: self._kv_fetch_timeout(token),
             self.kv_fetch_timeout_s, once=True)
@@ -1597,8 +1683,14 @@ class ContinuousReplica(Actor):
         back to plain local prefill — correctness never depended on
         the transfer."""
         request = self._kv_pending.pop(token, None)
+        started = self._kv_started.pop(token, None)
         if request is None:
             return                    # import landed first
+        if started is not None:
+            # The wait WAS spent — latency the kv_restore phase owns
+            # even though no blocks arrived.
+            request.kv_restore_ms = round(
+                (time.monotonic() - started) * 1e3, 3)
         self.server.kv_transfer_failures += 1
         self.logger.warning("%s: kv fetch %s timed out — local "
                             "prefill fallback", self.name, token)
@@ -1617,6 +1709,7 @@ class ContinuousReplica(Actor):
         if command != "kv_export_response" or len(params) < 2:
             return
         request = self._kv_pending.pop(str(params[0]), None)
+        started = self._kv_started.pop(str(params[0]), None)
         if request is None:
             return                    # timed out already; late reply
         try:
@@ -1626,9 +1719,15 @@ class ContinuousReplica(Actor):
             else:
                 self.server.kv_import_payload(
                     outputs, engine=self.process.event)
+                remote = outputs.get("trace_spans")
+                if remote:
+                    request.remote_spans = str(remote)
         except Exception:  # noqa: BLE001 - fall back to local prefill
             self.logger.exception("%s: kv import failed", self.name)
             self.server.kv_transfer_failures += 1
+        if started is not None:
+            request.kv_restore_ms = round(
+                (time.monotonic() - started) * 1e3, 3)
         self.server.submit(request)
         self._ensure_pumping()
 
@@ -1752,20 +1851,18 @@ class ContinuousReplica(Actor):
             outputs = {"tokens_out": np.asarray(request.tokens,
                                                 np.int32)}
         served = request.error is None
-        if request.submitted_ts is not None:
-            if request.first_token_ts is not None:
-                ttft = request.first_token_ts - request.submitted_ts
-                outputs["ttft_ms"] = round(ttft * 1e3, 2)
-                if served:
-                    # Aggregates track SERVED requests only: a burst
-                    # of queued-then-cancelled requests must not drag
-                    # the dashboard's p50 toward zero.
-                    self._ttft_window.append(ttft)
-            if request.finished_ts is not None:
-                total = request.finished_ts - request.submitted_ts
-                outputs["total_ms"] = round(total * 1e3, 2)
-                if served:
-                    self._total_window.append(total)
+        phases = self._phase_latencies(request)
+        for phase, seconds in phases.items():
+            outputs[f"{phase}_ms"] = round(seconds * 1e3, 2)
+        if served:
+            # Aggregates track SERVED requests only: a burst of
+            # queued-then-cancelled requests must not drag the
+            # dashboard's p50 toward zero.
+            for phase, seconds in phases.items():
+                self.server.latency_hists[phase].observe(seconds * 1e3)
+            self._note_slow(request, phases)
+        if request.trace_ctx:
+            outputs["trace_spans"] = self._request_spans(request)
         if request.response_topic:
             encoded = encode_swag(outputs)
             if faults.PLAN is not None:
@@ -1778,3 +1875,96 @@ class ContinuousReplica(Actor):
                 request.response_topic,
                 generate("infer_response",
                          [request.request_id, encoded]))
+
+    def _phase_latencies(self, request: DecodeRequest) -> Dict[str, float]:
+        """Seconds per phase from the request's lifecycle stamps:
+        ``queue`` (submit→slot), ``prefill`` (slot→first token),
+        ``decode`` (first→finish), the classic end-to-end ``ttft`` /
+        ``total``, and any ``kv_restore`` time (the warm-start fetch
+        runs BEFORE submission, so it is invisible to — not double-
+        counted by — the queue phase).  Keys match the server's
+        ``latency_hists`` phases and respond as ``<phase>_ms``."""
+        out: Dict[str, float] = {}
+        if request.submitted_ts is None:
+            return out
+        if request.first_token_ts is not None:
+            out["ttft"] = request.first_token_ts - request.submitted_ts
+        if request.finished_ts is not None:
+            out["total"] = request.finished_ts - request.submitted_ts
+        if request.activated_ts is not None:
+            out["queue"] = request.activated_ts - request.submitted_ts
+            if request.first_token_ts is not None:
+                out["prefill"] = (request.first_token_ts
+                                  - request.activated_ts)
+                if request.finished_ts is not None:
+                    out["decode"] = (request.finished_ts
+                                     - request.first_token_ts)
+        if request.kv_restore_ms:
+            out["kv_restore"] = request.kv_restore_ms / 1e3
+        return out
+
+    _SLOW_K = 5
+
+    def _note_slow(self, request: DecodeRequest,
+                   phases: Dict[str, float]) -> None:
+        """Track the top-k slowest served requests with their phase
+        breakdown — the dashboard's \"slowest requests\" pane."""
+        total = phases.get("total")
+        if total is None:
+            return
+        self._slow.append((round(total * 1e3, 1), request.request_id,
+                           {phase: round(seconds * 1e3, 1)
+                            for phase, seconds in phases.items()}))
+        self._slow.sort(key=lambda entry: -entry[0])
+        del self._slow[self._SLOW_K:]
+
+    def _request_spans(self, request: DecodeRequest) -> str:
+        """Synthesize this replica's phase spans for a TRACED request
+        (``trace_ctx`` arrived on the wire) from its lifecycle stamps
+        — no tracer calls anywhere near the engine hot path, and an
+        untraced request pays exactly one ``is None`` test.
+
+        The monotonic stamps convert to the epoch-aligned span clock
+        through one wall-clock anchor taken here; sub-ms skew at
+        worst, far below the cross-process clock sync the tree
+        already tolerates."""
+        from ..obs import trace
+        offset = time.time() - time.monotonic()
+        spans = []
+        if request.submitted_ts is not None:
+            submitted = offset + request.submitted_ts
+            finished = offset + (request.finished_ts
+                                 or request.submitted_ts)
+            restore_s = request.kv_restore_ms / 1e3
+            replica_span = trace.synth_span(
+                "replica", request.trace_ctx, self.name,
+                submitted - restore_s, finished,
+                attrs={"request_id": request.request_id,
+                       "tokens_out": len(request.tokens or [])})
+            if request.error is not None:
+                replica_span.set_attr("error", request.error)
+            spans.append(replica_span)
+            parent = trace.inject(replica_span)
+            if restore_s:
+                spans.append(trace.synth_span(
+                    "kv_restore", parent, self.name,
+                    submitted - restore_s, submitted))
+            if request.activated_ts is not None:
+                activated = offset + request.activated_ts
+                spans.append(trace.synth_span(
+                    "queue", parent, self.name, submitted, activated))
+                if request.first_token_ts is not None:
+                    first = offset + request.first_token_ts
+                    spans.append(trace.synth_span(
+                        "prefill", parent, self.name, activated,
+                        first))
+                    decode_span = trace.synth_span(
+                        "decode", parent, self.name, first, finished)
+                    decode_span.mark("first_token", first)
+                    decode_span.mark("last_token", finished)
+                    spans.append(decode_span)
+        encoded = [span.to_dict() for span in spans]
+        if request.remote_spans:
+            encoded.extend(span.to_dict() for span in
+                           trace.decode_spans(request.remote_spans))
+        return trace.encode_spans(encoded)

@@ -42,6 +42,7 @@ import numpy as np
 
 from ..kvstore import directory as _kvdir
 from ..kvstore import transfer as _kvxfer
+from ..obs import steplog
 from .continuous import ContinuousBatchingServer
 
 __all__ = ["PagedContinuousServer"]
@@ -471,6 +472,10 @@ class PagedContinuousServer(ContinuousBatchingServer):
         tail runs as descending power-of-two pieces so arbitrary
         prefix lengths reuse log-many program shapes per bucket."""
         llama, jnp = self._llama, self._jnp
+        if steplog.RECORDER is not None:
+            steplog.RECORDER.record(
+                "paged_prefill", slot=slot, shared_blocks=n_shared,
+                total_blocks=prompt_padded.shape[1] // self.block_size)
         self._pending_shared[slot] = 0
         block_size = self.block_size
         padded = prompt_padded.shape[1]

@@ -38,7 +38,9 @@ from typing import Callable, Dict, List, Optional
 
 import numpy as np
 
-from ..pipeline.codec import decode_swag, encode_swag
+from ..obs import trace
+from ..obs.metrics import CounterDict, Histogram
+from ..pipeline.codec import decode_swag, decode_value, encode_swag
 from ..registry.services_cache import services_cache_create_singleton
 from ..runtime.actor import Actor
 from ..runtime.service import ServiceFilter
@@ -222,10 +224,15 @@ class ReplicaRouter(Actor):
         #: EC-share state topic (passive watch; no lease).
         self._loads: Dict[str, Dict] = {}
         self._unhealthy: set = set()
-        self.counters: Dict[str, int] = dict(
+        #: replica topic path -> {phase: encoded histogram string}
+        #: parsed off EC-share ``hist.*`` broadcasts — the mergeable
+        #: replacements for sampling one replica's nearest-rank p95.
+        self._replica_hists: Dict[str, Dict[str, str]] = {}
+        self.counters: Dict[str, int] = CounterDict(dict(
             redispatches=0, replica_deaths_observed=0, shed=0,
             deadline_exceeded=0, cancel_unrouted=0,
-            prefix_routed=0, kv_remote_hints=0)
+            prefix_routed=0, kv_remote_hints=0),
+            prefix="router", labels={"actor": self.name})
         self.share["replicas"] = 0
         self.share["requests_routed"] = 0
         self.share["kv_directory_size"] = 0
@@ -260,6 +267,7 @@ class ReplicaRouter(Actor):
             self.process.remove_message_handler(
                 self._replica_state, f"{fields.topic_path}/state")
             self._loads.pop(fields.topic_path, None)
+            self._replica_hists.pop(fields.topic_path, None)
             self._unhealthy.discard(fields.topic_path)
             # A dead owner's advertised prefixes must stop attracting
             # routes IMMEDIATELY — survivors recompute (in-flight
@@ -295,6 +303,10 @@ class ReplicaRouter(Actor):
             if self.directory.update(replica, str(value), now):
                 self.directory.purge_expired(now)
                 self._update_directory_share()
+        elif key.startswith("hist."):
+            self._replica_hists.setdefault(
+                replica, {})[key[len("hist."):]] = str(value)
+            self._publish_fleet_latency(key[len("hist."):])
         elif key == "healthy":
             self._set_health(replica, str(value) not in ("0", "False"))
         elif key == "lifecycle":
@@ -336,6 +348,72 @@ class ReplicaRouter(Actor):
         self.share[counter] = self.counters[counter]
         if self.ec_producer is not None:
             self.ec_producer.update(counter, self.counters[counter])
+
+    # -- fleet latency (merged replica histograms) -------------------- #
+
+    def fleet_histogram(self, phase: str) -> Histogram:
+        """Merge every replica's ``hist.<phase>`` EC broadcast into one
+        histogram — EXACT because the buckets are fixed process-wide,
+        unlike sampling one replica's window."""
+        merged = Histogram(name=f"fleet_{phase}")
+        for hists in self._replica_hists.values():
+            encoded = hists.get(phase)
+            if not encoded:
+                continue
+            try:
+                merged.merge(Histogram.decode(encoded))
+            except (ValueError, IndexError):
+                continue
+        return merged
+
+    def _publish_fleet_latency(self, phase: str):
+        """Fleet p50/p95/p99 for the phase that just updated, into the
+        router's own share (dashboard + loadgen read these)."""
+        merged = self.fleet_histogram(phase)
+        if not merged.count:
+            return
+        for label, q in (("p50", 0.50), ("p95", 0.95), ("p99", 0.99)):
+            key = f"fleet_{phase}_{label}_ms"
+            value = round(merged.quantile(q), 2)
+            self.share[key] = value
+            if self.ec_producer is not None:
+                self.ec_producer.update_if_changed(key, value)
+
+    # -- tracing ------------------------------------------------------ #
+
+    @staticmethod
+    def _trace_ctx(payload) -> Optional[str]:
+        """Propagated trace context out of an ENCODED swag (the route
+        hot path never decodes the full payload)."""
+        carrier = (payload or {}).get("trace")
+        if not carrier:
+            return None
+        try:
+            return str(decode_value(str(carrier)))
+        except Exception:  # noqa: BLE001 - junk context → no parent
+            return None
+
+    def _finish_trace(self, request_id: str, entry: Dict, swag):
+        """Terminal response passing through the proxy: close the
+        route span, merge the replica's ride-back spans with the
+        router's own, and return a REBUILT response payload carrying
+        the combined ``trace_spans``.  Only called when this request
+        actually has router spans — untraced requests forward the
+        replica's payload byte-identical."""
+        spans = entry.get("spans") or []
+        route_span = entry.get("route_span")
+        if route_span is not None and trace.TRACER is not None:
+            trace.TRACER.finish(route_span)
+        try:
+            outputs = decode_swag(swag)
+        except Exception:  # noqa: BLE001 - corrupt stays corrupt
+            return None
+        remote = outputs.get("trace_spans")
+        combined = (trace.decode_spans(remote) if remote else [])
+        combined += [span for span in spans if span.end is not None]
+        outputs["trace_spans"] = trace.encode_spans(combined)
+        return generate("infer_response",
+                        [request_id, encode_swag(outputs)])
 
     # -- routing ----------------------------------------------------- #
 
@@ -424,7 +502,7 @@ class ReplicaRouter(Actor):
             >= self.shed_queue_depth for r in candidates)
 
     def _shed(self, request_id, response_topic, error: str,
-              retry_after_ms: Optional[int] = None):
+              retry_after_ms: Optional[int] = None, parent=None):
         """Terminal rejection published straight to the client — a
         future must ALWAYS resolve; silent drops are the failure mode
         this PR exists to remove."""
@@ -435,6 +513,12 @@ class ReplicaRouter(Actor):
         outputs: Dict = {"error": error}
         if retry_after_ms is not None:
             outputs["retry_after_ms"] = int(retry_after_ms)
+        if trace.TRACER is not None and parent is not None:
+            span = trace.TRACER.start_span(
+                "shed", parent=parent,
+                attrs={"request_id": str(request_id), "error": error})
+            trace.TRACER.finish(span)
+            outputs["trace_spans"] = trace.encode_spans([span])
         if response_topic:
             self.process.message.publish(
                 str(response_topic),
@@ -447,17 +531,21 @@ class ReplicaRouter(Actor):
         then sheds with ``error="overloaded"`` so the caller's future
         resolves instead of hanging."""
         request_id = str(request_id)
+        ctx = None
+        if trace.TRACER is not None:
+            ctx = self._trace_ctx(payload)
         if not self._replicas:
             self.logger.warning("%s: no live replicas for %s",
                                 self.name, request_id)
             self._shed(request_id, response_topic, "overloaded",
-                       retry_after_ms=1000)
+                       retry_after_ms=1000, parent=ctx)
             return False
         candidates = self._candidates()
         if self._saturated(candidates):
             depths = [self._loads[r]["queue_depth"] for r in candidates]
             self._shed(request_id, response_topic, "overloaded",
-                       retry_after_ms=min(5000, 50 * min(depths)))
+                       retry_after_ms=min(5000, 50 * min(depths)),
+                       parent=ctx)
             return False
         decode = self._decode_candidates(candidates)
         picked = self._pick_prefix(decode, payload)
@@ -488,6 +576,21 @@ class ReplicaRouter(Actor):
                 send_payload = dict(send_payload)
                 send_payload["prefill_only"] = "i:1"
                 target = prefill_target
+        route_span = None
+        if trace.TRACER is not None:
+            # The route span OPENS here and closes when the terminal
+            # response passes back through the proxy — it measures the
+            # request's routed lifetime; redispatch/shed spans nest
+            # under it.
+            route_span = trace.TRACER.start_span(
+                "route", parent=ctx,
+                attrs={"request_id": request_id, "target": target,
+                       "phase": phase})
+            if owner_matched:
+                route_span.set_attr("prefix_matched",
+                                    int(owner_matched))
+            send_payload = dict(send_payload)
+            send_payload["trace"] = f"s:{trace.inject(route_span)}"
         self._routed[request_id] = target
         while len(self._routed) > self._routed_limit:
             self._routed.popitem(last=False)
@@ -496,7 +599,8 @@ class ReplicaRouter(Actor):
             payload=payload or {}, attempts=0, delivered=0,
             replica_sent=0, routed_at=self.process.event.now(),
             deadline_ts=-1.0,    # -1 = not yet resolved from payload
-            phase=phase)
+            phase=phase, route_span=route_span,
+            spans=[route_span] if route_span is not None else None)
         while len(self._inflight) > self._inflight_limit:
             dropped_id, _ = self._inflight.popitem(last=False)
             self.logger.warning(
@@ -557,6 +661,11 @@ class ReplicaRouter(Actor):
                                              payload)
             return
         self._inflight.pop(str(params[0]), None)
+        if entry.get("spans"):
+            rebuilt = self._finish_trace(str(params[0]), entry,
+                                         params[1])
+            if rebuilt is not None:
+                payload = rebuilt
         self.process.message.publish(entry["client_topic"], payload)
 
     def _begin_decode_phase(self, request_id: str, entry: Dict,
@@ -578,6 +687,16 @@ class ReplicaRouter(Actor):
             send_payload = dict(send_payload)
             send_payload["kv_source"] = f"s:{prefill_replica}"
             self._bump("kv_remote_hints")
+        if trace.TRACER is not None and \
+                entry.get("route_span") is not None:
+            span = trace.TRACER.start_span(
+                "decode_phase", parent=entry["route_span"],
+                attrs={"request_id": request_id, "target": target})
+            trace.TRACER.finish(span)
+            entry["spans"].append(span)
+            send_payload = dict(send_payload)
+            send_payload["trace"] = \
+                f"s:{trace.inject(entry['route_span'])}"
         entry["replica"] = target
         self._routed[request_id] = target
         self.process.message.publish(
@@ -642,12 +761,14 @@ class ReplicaRouter(Actor):
                 self.process.event.now() >= entry["deadline_ts"]:
             self._inflight.pop(request_id, None)
             self._shed(request_id, entry["client_topic"],
-                       "deadline_exceeded")
+                       "deadline_exceeded",
+                       parent=entry.get("route_span"))
             return
         if entry["attempts"] >= self.max_redispatch:
             self._inflight.pop(request_id, None)
             self._shed(request_id, entry["client_topic"],
-                       "redispatch_failed")
+                       "redispatch_failed",
+                       parent=entry.get("route_span"))
             return
         entry["attempts"] += 1
         live = [r for r in self._replicas if r not in self._unhealthy]
@@ -668,13 +789,27 @@ class ReplicaRouter(Actor):
         entry["replica_sent"] = 0     # new replica replays from prompt
         self._routed[request_id] = target
         self._bump("redispatches")
+        send_payload = entry["payload"]
+        if trace.TRACER is not None and \
+                entry.get("route_span") is not None:
+            span = trace.TRACER.start_span(
+                "redispatch", parent=entry["route_span"],
+                attrs={"request_id": request_id, "target": target,
+                       "attempt": entry["attempts"]})
+            trace.TRACER.finish(span)
+            entry["spans"].append(span)
+            # Re-point the propagated context at the route span so the
+            # NEW replica's spans still join this request's tree.
+            send_payload = dict(send_payload)
+            send_payload["trace"] = \
+                f"s:{trace.inject(entry['route_span'])}"
         self.logger.info("%s: re-dispatching %s to %s (attempt %d)",
                          self.name, request_id, target,
                          entry["attempts"])
         self.process.message.publish(
             f"{target}/in",
             generate("infer", [request_id, self.topic_reply,
-                               entry["payload"]]))
+                               send_payload]))
 
     def _resolve_deadline(self, entry: Dict) -> Optional[float]:
         """Lazily decode the original payload's ``deadline_ms`` (only

@@ -143,6 +143,18 @@ class Actor(Service):
             self.share["log_level"] = level
         self.logger.setLevel(level)
 
+    def metrics(self, response_topic: str = ""):
+        """Dump the process-wide metrics registry as Prometheus text:
+        ``(metrics <response_topic>)`` → ``(metrics_response <name>
+        <text>)`` on the response topic (or this actor's topic_out).
+        Every actor answers — any service in the fleet is scrapeable
+        over the wire without an HTTP port."""
+        from ..obs.metrics import REGISTRY
+        text = REGISTRY.to_prometheus()
+        topic = str(response_topic) or self.topic_out
+        self.process.message.publish(
+            topic, generate("metrics_response", [self.name, text]))
+
     def terminate(self):
         self.stop()
 

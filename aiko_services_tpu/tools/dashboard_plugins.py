@@ -74,6 +74,57 @@ def _get(variables: Dict, *path, default="-"):
     return node
 
 
+def _get_hist(variables: Dict, phase: str):
+    """Replica ``hist.<phase>`` shares arrive either nested (ECProducer
+    expands dotted paths) or flat, depending on the consumer's cache
+    shape — accept both and decode to a Histogram, or None."""
+    encoded = _get(variables, "hist", phase, default=None)
+    if encoded in (None, "-"):
+        encoded = _get(variables, f"hist.{phase}", default=None)
+    if encoded in (None, "-"):
+        return None
+    from ..obs.metrics import Histogram
+    try:
+        return Histogram.decode(str(encoded))
+    except (ValueError, IndexError):
+        return None
+
+
+#: Bar width for the slowest-requests phase breakdown.
+_BAR_CELLS = 20
+_PHASE_ORDER = ("queue", "kv_restore", "prefill", "decode")
+
+
+def _slow_request_lines(raw: str) -> List[str]:
+    """Render the ``slow_requests`` share — space-joined entries of
+    ``<request_id>:<total_ms>:<phase>=<ms>,…`` — as one line per
+    request with a proportional per-phase bar."""
+    lines: List[str] = []
+    for entry in str(raw).split():
+        try:
+            request_id, total, breakdown = entry.split(":", 2)
+            total_ms = float(total)
+            phases = {}
+            if breakdown:
+                for pair in breakdown.split(","):
+                    phase, value = pair.split("=", 1)
+                    phases[phase] = float(value)
+        except (ValueError, IndexError):
+            continue
+        bar = ""
+        if total_ms > 0:
+            for phase in _PHASE_ORDER:
+                cells = round(phases.get(phase, 0.0)
+                              / total_ms * _BAR_CELLS)
+                bar += phase[0] * cells
+        bar = (bar[:_BAR_CELLS]).ljust(_BAR_CELLS, ".")
+        detail = " ".join(f"{phase}={phases[phase]:.0f}"
+                          for phase in _PHASE_ORDER if phase in phases)
+        lines.append(f"    {request_id:12} {total_ms:8.1f} ms "
+                     f"[{bar}] {detail}")
+    return lines
+
+
 def _pipeline_stop_action(process, fields, variables):
     """Operator stop: Pipeline.stop() destroys all streams and halts
     the elements (dispatched by the actor's command path)."""
@@ -208,6 +259,25 @@ def model_replica_plugin(fields, variables) -> List[str]:
         lines.append(f"  latency:   ttft p50 {ttft or '?'}"
                      f"/p95 {ttft95 or '?'} ms, "
                      f"total p50 {total or '?'} ms")
+    phase_lines = []
+    for phase in ("ttft", "total") + _PHASE_ORDER:
+        hist = _get_hist(variables, phase)
+        if hist is None or not hist.count:
+            continue
+        phase_lines.append(
+            f"    {phase:10} p50 {hist.quantile(0.50):8.1f}  "
+            f"p95 {hist.quantile(0.95):8.1f}  "
+            f"p99 {hist.quantile(0.99):8.1f}  n={hist.count}")
+    if phase_lines:
+        lines += ["", "  phase latency (ms, mergeable histograms):"]
+        lines += phase_lines
+    slow = _get(variables, "slow_requests", default=None)
+    if slow not in (None, "-", ""):
+        slow_lines = _slow_request_lines(slow)
+        if slow_lines:
+            lines += ["", "  slowest requests "
+                          "(q=queue k=kv_restore p=prefill d=decode):"]
+            lines += slow_lines
     healthy = _get(variables, "healthy", default=None)
     if healthy not in (None, "-"):
         state = "ok" if str(healthy) not in ("0", "False") else "STALLED"
@@ -251,6 +321,18 @@ def replica_router_plugin(fields, variables) -> List[str]:
             f" prefix-routed, "
             f"{_get(variables, 'kv_remote_hints', default=0)}"
             f" transfer hints")
+    fleet_lines = []
+    for phase in ("ttft", "total") + _PHASE_ORDER:
+        p50 = _get(variables, f"fleet_{phase}_p50_ms", default=None)
+        if p50 in (None, "-"):
+            continue
+        fleet_lines.append(
+            f"    {phase:10} p50 {p50:>8}  "
+            f"p95 {_get(variables, f'fleet_{phase}_p95_ms'):>8}  "
+            f"p99 {_get(variables, f'fleet_{phase}_p99_ms'):>8}")
+    if fleet_lines:
+        lines += ["", "  fleet latency (ms, merged across replicas):"]
+        lines += fleet_lines
     return lines
 
 

@@ -20,17 +20,23 @@ built-in MQTT broker cross-process).
 from __future__ import annotations
 
 import dataclasses
+import os
 import statistics
 import time
 import uuid
-from typing import Callable, Dict, List, Optional
+from typing import Callable, Dict, List, Optional, Tuple
 
+from ..obs import trace
 from ..pipeline.codec import encode_swag
 from ..utils.sexpr import generate, parse
 
 __all__ = ["LoadGenerator", "LoadReport", "service_scale_sweep",
            "chaos_schedule", "run_chaos", "shared_prefix_payloads",
-           "run_shared_prefix", "main"]
+           "run_shared_prefix", "fleet_latency", "main"]
+
+#: Per-phase latency keys the replicas stamp on responses, in report
+#: order (``kv_restore`` is the cross-replica transfer phase).
+PHASES = ("queue", "prefill", "decode", "kv_restore")
 
 
 @dataclasses.dataclass
@@ -63,6 +69,15 @@ class LoadReport:
     #: Total cross-replica KV bytes moved during the run (Σ replica
     #: ``kv_transfer_bytes`` deltas).
     kv_transfer_bytes: int = 0
+    #: phase -> per-request latencies (ms) as stamped by the replicas
+    #: (``queue_ms``/``prefill_ms``/``decode_ms``/``kv_restore_ms``)
+    #: — the per-phase breakdown :meth:`phase_table` renders.
+    phase_ms: Dict[str, List[float]] = dataclasses.field(
+        default_factory=dict)
+    #: Fleet-level quantiles from EXACT merges of the replicas'
+    #: fixed-bucket histograms (phase -> {p50_ms, p95_ms, p99_ms,
+    #: count}); attached by the harness via :func:`fleet_latency`.
+    fleet_latency_ms: Optional[Dict[str, Dict[str, float]]] = None
 
     @property
     def lost(self) -> int:
@@ -106,6 +121,26 @@ class LoadReport:
     @property
     def ttft_p95_ms(self) -> float:
         return self._quantile(self.ttfts_ms, 0.95)
+
+    def phase_table(self) -> str:
+        """Per-phase latency breakdown (queue/prefill/decode/
+        kv_restore) — WHERE a slow run spent its time, one line per
+        phase with nearest-rank quantiles over this run's samples."""
+        if not self.phase_ms:
+            return "(no per-phase latency samples)"
+        lines = [f"{'phase':<12}{'p50_ms':>9}{'p95_ms':>9}"
+                 f"{'p99_ms':>9}{'n':>7}"]
+        for phase in PHASES:
+            values = self.phase_ms.get(phase)
+            if not values:
+                continue
+            lines.append(
+                f"{phase:<12}"
+                f"{self._quantile(values, 0.5):>9.1f}"
+                f"{self._quantile(values, 0.95):>9.1f}"
+                f"{self._quantile(values, 0.99):>9.1f}"
+                f"{len(values):>7}")
+        return "\n".join(lines)
 
     def __repr__(self):
         attn = ""
@@ -151,10 +186,16 @@ class LoadGenerator:
         self._sent_at: Dict[str, float] = {}
         self._latencies: List[float] = []
         self._ttfts: List[float] = []
+        self._phases: Dict[str, List[float]] = {}
         self._errors = 0
         self._error_kinds: Dict[str, int] = {}
         self._tokens = 0
         self._run_index = 0
+        # Tracing (rides the global trace.TRACER switchboard): root
+        # span per request, full ride-back tree kept per request id
+        # for dump_traces().
+        self._root_spans: Dict[str, object] = {}
+        self._traces: List[Tuple[float, str, List]] = []
         process.add_message_handler(self._on_response,
                                     self.response_topic)
 
@@ -174,6 +215,7 @@ class LoadGenerator:
         if started is None:
             return
         outputs = params[1] if len(params) > 1 else {}
+        self._collect_trace(request_id, started, outputs)
         if isinstance(outputs, dict) and "error" in outputs:
             self._errors += 1
             # Values on the wire are codec-tagged ("s:overloaded") —
@@ -203,6 +245,57 @@ class LoadGenerator:
                         decode_value(outputs["tokens_out"])).size)
                 except Exception:  # noqa: BLE001 - telemetry only
                     pass
+            if isinstance(outputs, dict):
+                for phase in PHASES:
+                    if f"{phase}_ms" not in outputs:
+                        continue
+                    try:
+                        from ..pipeline.codec import decode_value
+                        self._phases.setdefault(phase, []).append(
+                            float(decode_value(outputs[f"{phase}_ms"])))
+                    except Exception:  # noqa: BLE001 - telemetry only
+                        pass
+
+    def _collect_trace(self, request_id: str, started: float,
+                       outputs) -> None:
+        """Close this request's root span and keep the full ride-back
+        tree (root + router + replica + kv source spans), keyed by
+        wire latency so :meth:`dump_traces` can rank by slowest."""
+        span = self._root_spans.pop(request_id, None)
+        if span is None:
+            return
+        if trace.TRACER is not None:
+            trace.TRACER.finish(span)
+        elif span.end is None:
+            span.end = span.start
+        spans = [span]
+        if isinstance(outputs, dict) and "trace_spans" in outputs:
+            try:
+                from ..pipeline.codec import decode_value
+                spans.extend(trace.decode_spans(
+                    str(decode_value(outputs["trace_spans"]))))
+            except Exception:  # noqa: BLE001 - telemetry only
+                pass
+        self._traces.append(((self._clock() - started) * 1e3,
+                             request_id, spans))
+
+    def dump_traces(self, directory: str, top_k: int = 5) -> List[str]:
+        """Export the ``top_k`` SLOWEST traced requests' span trees as
+        Chrome trace-event JSON files (Perfetto-loadable), one file
+        per request, named ``trace_<rank>_<request_id>.json``.
+        Returns the written paths (empty when tracing was off)."""
+        if not self._traces:
+            return []
+        os.makedirs(directory, exist_ok=True)
+        ranked = sorted(self._traces,
+                        key=lambda entry: -entry[0])[:top_k]
+        paths = []
+        for rank, (_total_ms, request_id, spans) in enumerate(ranked):
+            path = os.path.join(
+                directory, f"trace_{rank:02d}_{request_id}.json")
+            trace.export_chrome(path, spans)
+            paths.append(path)
+        return paths
 
     def run(self, n_requests: int, drain_timeout_s: float = 30.0,
             pump: Optional[Callable[[], None]] = None) -> LoadReport:
@@ -215,21 +308,31 @@ class LoadGenerator:
         self._sent_at.clear()
         self._latencies = []
         self._ttfts = []
+        self._phases = {}
         self._errors = 0
         self._error_kinds = {}
         self._tokens = 0
+        self._root_spans.clear()
+        self._traces = []
         self._run_index += 1
         run_tag = self._run_index
         interval = 1.0 / self.rate_hz if self.rate_hz > 0 else 0.0
         started = self._clock()
         for index in range(n_requests):
             request_id = f"lg{run_tag}_{index}"
+            swag = self.payload_fn(index)
+            if trace.TRACER is not None:
+                span = trace.TRACER.start_span(
+                    "infer", attrs={"request_id": request_id,
+                                    "target": self.target_topic})
+                swag = dict(swag, trace=trace.inject(span))
+                self._root_spans[request_id] = span
             self._sent_at[request_id] = self._clock()
             self.process.message.publish(
                 self.target_topic,
                 generate("infer",
                          [request_id, self.response_topic,
-                          encode_swag(self.payload_fn(index))]))
+                          encode_swag(swag)]))
             if pump is not None:
                 pump()
             if interval:
@@ -251,7 +354,9 @@ class LoadGenerator:
                           latencies_ms=list(self._latencies),
                           tokens_total=self._tokens,
                           ttfts_ms=list(self._ttfts),
-                          error_kinds=dict(self._error_kinds))
+                          error_kinds=dict(self._error_kinds),
+                          phase_ms={phase: list(values) for phase,
+                                    values in self._phases.items()})
 
 
 def service_scale_sweep(services: int, broker: str = "scale-sweep",
@@ -374,6 +479,28 @@ def shared_prefix_payloads(n_conversations: int = 4, turns: int = 4,
     return payload_fn
 
 
+def fleet_latency(servers) -> Dict[str, Dict[str, float]]:
+    """Fleet-level latency quantiles by EXACTLY merging the replicas'
+    fixed-bucket phase histograms (element-wise bucket adds — the
+    same numbers a router derives from the ``hist.<phase>`` EC shares
+    it watches).  phase -> {p50_ms, p95_ms, p99_ms, count}."""
+    from ..obs.metrics import Histogram
+    out: Dict[str, Dict[str, float]] = {}
+    by_phase: Dict[str, List[Histogram]] = {}
+    for server in servers:
+        for phase, histogram in getattr(server, "latency_hists",
+                                        {}).items():
+            by_phase.setdefault(phase, []).append(histogram)
+    for phase, histograms in sorted(by_phase.items()):
+        merged = Histogram.merged(histograms)
+        if merged.count:
+            out[phase] = {"p50_ms": round(merged.quantile(0.5), 1),
+                          "p95_ms": round(merged.quantile(0.95), 1),
+                          "p99_ms": round(merged.quantile(0.99), 1),
+                          "count": merged.count}
+    return out
+
+
 def _fleet_kv_stats(servers) -> Dict:
     """Aggregate the kvstore counters a shared-prefix run reports."""
     totals = dict(prefix_hits=0, prefix_misses=0, kv_transfer_bytes=0,
@@ -391,13 +518,18 @@ def run_shared_prefix(n_requests: int = 24, rate_hz: float = 50.0,
                       prefix_routing: bool = True,
                       kv_transfer: bool = True,
                       drain_timeout_s: float = 90.0,
-                      seed: int = 0) -> LoadReport:
+                      seed: int = 0,
+                      trace_out: Optional[str] = None,
+                      trace_top: int = 5) -> LoadReport:
     """In-process 2-replica PAGED serving rig (prefix caches on)
     driven by :func:`shared_prefix_payloads` through a ReplicaRouter.
     ``prefix_routing=False`` degrades the router to pure
     least-loaded P2C (``prefix_alpha=0``) — the A/B baseline bench.py
-    compares TTFT against.  The report carries ``prefix_hit_rate``
-    and ``kv_transfer_bytes`` aggregated across the fleet."""
+    compares TTFT against.  The report carries ``prefix_hit_rate``,
+    ``kv_transfer_bytes`` and histogram-merged ``fleet_latency_ms``
+    aggregated across the fleet.  ``trace_out`` enables distributed
+    tracing for the run and dumps the ``trace_top`` slowest requests'
+    span trees as Chrome trace-event JSON into that directory."""
     from ..orchestration.continuous import ContinuousReplica
     from ..orchestration.paged import PagedContinuousServer
     from ..orchestration.serving import ReplicaRouter
@@ -412,6 +544,12 @@ def run_shared_prefix(n_requests: int = 24, rate_hz: float = 50.0,
                 raise TimeoutError(f"shared-prefix rig: {what}")
             time.sleep(0.02)
 
+    tracing = trace_out is not None and trace.TRACER is None
+    if tracing:
+        # One in-process rig → one tracer covers loadgen root spans
+        # AND router spans; replicas synthesize theirs from the
+        # propagated context without needing any tracer at all.
+        trace.install(service="loadgen")
     engine = EventEngine()
     thread = engine.run_in_thread()
     broker = f"sharedpfx-{uuid.uuid4().hex[:6]}"
@@ -458,11 +596,16 @@ def run_shared_prefix(n_requests: int = 24, rate_hz: float = 50.0,
         if lookups:
             report.prefix_hit_rate = totals["prefix_hits"] / lookups
         report.kv_transfer_bytes = totals["kv_transfer_bytes"]
+        report.fleet_latency_ms = fleet_latency(servers)
         report.server_stats = dict(
             router.counters, **totals,
             kv_directory_size=router.share.get("kv_directory_size", 0))
+        if trace_out is not None:
+            generator.dump_traces(trace_out, top_k=trace_top)
         return report
     finally:
+        if tracing:
+            trace.uninstall()
         if generator is not None:
             generator.close()
         for process in reversed(processes):
@@ -582,6 +725,7 @@ def run_chaos(seed: int = 0, n_requests: int = 40,
         if lookups:
             report.prefix_hit_rate = totals["prefix_hits"] / lookups
         report.kv_transfer_bytes = totals["kv_transfer_bytes"]
+        report.fleet_latency_ms = fleet_latency(servers)
         report.server_stats = dict(
             router.counters, **totals,
             replicas_live=router.share["replicas"],
@@ -630,6 +774,14 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser.add_argument("--no-prefix-routing", action="store_true",
                         help="shared_prefix: disable prefix-aware "
                              "scoring (A/B baseline)")
+    parser.add_argument("--trace-out", metavar="DIR",
+                        help="enable distributed tracing and dump the "
+                             "slowest requests' span trees as Chrome "
+                             "trace-event JSON (Perfetto-loadable) "
+                             "into DIR")
+    parser.add_argument("--trace-top", type=int, default=5,
+                        help="how many slowest requests --trace-out "
+                             "dumps")
     args = parser.parse_args(argv)
     if args.workload == "shared_prefix":
         report = run_shared_prefix(
@@ -637,9 +789,17 @@ def main(argv: Optional[List[str]] = None) -> int:
             n_conversations=args.conversations, turns=args.turns,
             system_len=args.system_len,
             prefix_routing=not args.no_prefix_routing,
-            seed=args.seed)
+            seed=args.seed, trace_out=args.trace_out,
+            trace_top=args.trace_top)
         print(report)
+        print(report.phase_table())
+        if report.fleet_latency_ms:
+            print(f"fleet latency (merged histograms): "
+                  f"{report.fleet_latency_ms}")
         print(f"fleet counters: {report.server_stats}")
+        if args.trace_out:
+            print(f"trace-event JSON for the {args.trace_top} slowest "
+                  f"requests written to {args.trace_out}")
         return 1 if (report.lost or report.timeouts) else 0
     if not args.chaos:
         parser.error("API runs use LoadGenerator directly; the CLI "
@@ -647,6 +807,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     report = run_chaos(seed=args.seed, n_requests=args.requests,
                        rate_hz=args.rate_hz)
     print(report)
+    print(report.phase_table())
     print(f"router counters: {report.server_stats}")
     if report.lost or report.timeouts:
         print(f"CHAOS FAIL (seed={args.seed}): {report.lost} lost, "

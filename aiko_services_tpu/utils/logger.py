@@ -13,6 +13,7 @@ from __future__ import annotations
 import logging
 import os
 import sys
+import time
 from collections import deque
 from typing import Optional
 
@@ -48,18 +49,69 @@ class TopicLogHandler(logging.Handler):
 
     Records emitted before the transport is connected are ring-buffered
     (most recent ``_RING_SIZE``) and flushed on first successful publish.
+
+    Two observability hooks:
+
+    * When a trace span is active on the emitting thread
+      (``obs.trace``), its ``trace_id/span_id`` is appended to the
+      published record — a broker-side log line joins the distributed
+      trace that produced it.
+    * A per-handler token bucket (``rate_limit_hz`` sustained,
+      ``burst`` bucket depth) stops a hot error path from storming the
+      broker; dropped records count into the process metrics registry
+      (``aiko_log_records_dropped_total``, labelled by topic) so the
+      drop itself is observable.  ``rate_limit_hz=0`` disables the
+      limiter (the default keeps historical behavior for tests).
     """
 
-    def __init__(self, message, topic: str):
+    def __init__(self, message, topic: str,
+                 rate_limit_hz: float = 0.0, burst: int = 20):
         super().__init__()
         self.message = message
         self.topic = topic
+        self.rate_limit_hz = float(rate_limit_hz)
+        self._bucket = float(burst)
+        self._burst = float(burst)
+        self._refill_at = time.monotonic()
+        self.dropped = 0
         self._ring: deque = deque(maxlen=_RING_SIZE)
         self.setFormatter(logging.Formatter(LOG_FORMAT, LOG_DATE_FORMAT))
 
+    def _admit(self) -> bool:
+        """Token bucket: refill at ``rate_limit_hz``, cap at burst."""
+        if self.rate_limit_hz <= 0:
+            return True
+        now = time.monotonic()
+        self._bucket = min(
+            self._burst,
+            self._bucket + (now - self._refill_at) * self.rate_limit_hz)
+        self._refill_at = now
+        if self._bucket < 1.0:
+            self.dropped += 1
+            try:  # lazy: utils must not hard-depend on obs at import
+                from ..obs.metrics import REGISTRY
+                REGISTRY.counter("aiko_log_records_dropped_total",
+                                 help="log records dropped by the "
+                                      "per-topic rate limit",
+                                 labels={"topic": self.topic}).inc()
+            except Exception:  # noqa: BLE001 - never raise from logging
+                pass
+            return False
+        self._bucket -= 1.0
+        return True
+
     def emit(self, record: logging.LogRecord):
         try:
+            if not self._admit():
+                return
             payload = self.format(record)
+            try:
+                from ..obs.trace import current_ids
+                ids = current_ids()
+            except Exception:  # noqa: BLE001 - never raise from logging
+                ids = None
+            if ids is not None:
+                payload = f"{payload} trace={ids[0]}/{ids[1]}"
             if self.message is not None and self.message.connected:
                 while self._ring:
                     self.message.publish(self.topic, self._ring.popleft())
