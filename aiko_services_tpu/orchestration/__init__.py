@@ -12,3 +12,8 @@ from .continuous import (
 from .paged import PagedContinuousServer
 from .client import InferClient, InferFuture
 from .trainer import TrainerActor, TRAINER_PROTOCOL
+from .autoscaler import (
+    FleetAutoscaler, AutoscalerPolicy, FleetSnapshot, ReplicaView,
+    PendingView, DeathEvent, Action, ControllerState, decide,
+    AUTOSCALER_PROTOCOL, manager_spawner, manager_terminator,
+)

@@ -1427,9 +1427,13 @@ class ContinuousReplica(Actor):
             self._wire_adapter_unload
         self._command_handlers["infer_cancel"] = self._wire_cancel
         self._command_handlers["kv_export"] = self._wire_kv_export
+        self._command_handlers["retire"] = self._wire_retire
         self.share["slots"] = self.server.slots
         self.share["requests_served"] = 0
         self._pumping = False
+        #: Graceful drain in progress (``(retire)`` received): routers
+        #: stop sending new work; queued/active requests finish here.
+        self._retiring = False
         #: id(request) -> tokens already delivered via infer_partial.
         #: Keyed by object identity, not request_id: the client owns
         #: that string and may reuse it across concurrent requests.
@@ -1505,6 +1509,29 @@ class ContinuousReplica(Actor):
         self.server.submit(request)
         self._ensure_pumping()
 
+    def _wire_retire(self, *_args):
+        """``(retire)`` — graceful drain (autoscaler scale-in): flip
+        the shared ``lifecycle`` to ``retiring`` so routers stop
+        sending NEW work, keep serving whatever is queued or active,
+        and advertise ``drained 1`` once idle so the supervisor knows
+        the process is safe to stop.  Requests that raced the flip in
+        transit are still served — zero-lost outranks a prompt exit."""
+        if self._retiring:
+            return
+        self._retiring = True
+        self.logger.info("%s: retiring — draining %d queued / %d active",
+                         self.name, self.server.queue_depth,
+                         self.server.slots_active)
+        updates = {"lifecycle": "retiring"}
+        if not self.server.busy and not self._kv_pending:
+            updates["drained"] = 1
+        self.share.update(updates)
+        if self.ec_producer is not None:
+            for key, value in updates.items():
+                self.ec_producer.update(key, value)
+        if self.server.busy:
+            self._ensure_pumping()
+
     def _ensure_pumping(self):
         if not self._pumping:
             self._pumping = True
@@ -1577,6 +1604,12 @@ class ContinuousReplica(Actor):
                     f"{phase}={value}" for phase, value
                     in sorted(breakdown.items()))
                 for total_ms, request_id, breakdown in self._slow)
+        if self._retiring and not self.server.busy \
+                and not self._kv_pending:
+            # Drain complete: every queued/active request reached a
+            # terminal state.  The supervisor watches this key before
+            # stopping the process.
+            updates["drained"] = 1
         if not self.server.healthy \
                 and self.share.get("lifecycle") != "unhealthy":
             # The router watches lifecycle on the replica's state

@@ -25,11 +25,22 @@ POLL_PERIOD = 0.2  # reference process_manager.py:41
 
 
 class ProcessManager:
+    """``exit_handler(id, argv, return_code)`` fires exactly once per
+    child that leaves on its own: ``return_code`` is the OS exit code,
+    or ``None`` when the spawn itself failed (the supervisor contract —
+    a crash-loop detector needs the code, a respawn loop needs to see
+    launch failures through the same funnel as deaths).  Intentional
+    ``delete`` calls do NOT fire it; their outcome is the return
+    value.  ``exit_codes`` keeps the last-known code per id for both
+    paths."""
+
     def __init__(self, exit_handler: Optional[Callable] = None,
                  engine: Optional[EventEngine] = None):
         self.exit_handler = exit_handler
         self.processes: Dict[str, subprocess.Popen] = {}
         self.commands: Dict[str, List[str]] = {}
+        #: id -> last observed return code (None = spawn failed).
+        self.exit_codes: Dict[str, Optional[int]] = {}
         self._engine = engine or default_engine
         self._polling = False
 
@@ -52,7 +63,18 @@ class ProcessManager:
         if env is not None:
             child_env = dict(os.environ)
             child_env.update({k: str(v) for k, v in env.items()})
-        process = subprocess.Popen(argv, env=child_env)
+        try:
+            process = subprocess.Popen(argv, env=child_env)
+        except OSError as error:
+            # Spawn failures report through the SAME funnel as child
+            # deaths (return_code None) — a supervisor's respawn loop
+            # must not need a second error path — and still raise for
+            # direct callers.
+            _logger.warning("Child %s failed to spawn: %s", id, error)
+            self.exit_codes[id] = None
+            if self.exit_handler:
+                self.exit_handler(id, argv, None)
+            raise
         self.processes[id] = process
         self.commands[id] = argv
         if not self._polling:
@@ -83,10 +105,22 @@ class ProcessManager:
         shutdown from a hang."""
         id = str(id)
         process = self.processes.pop(id, None)
-        self.commands.pop(id, None)
+        command = self.commands.pop(id, None)
         if process is None:
             return None
         if process.poll() is not None:
+            # The child exited on its own and delete() won the pop
+            # race against _poll: honor ``wait`` (reap, never leave a
+            # zombie behind an early return) and deliver the exit
+            # notification _poll can no longer see.
+            if wait:
+                try:
+                    process.wait(timeout=wait)
+                except subprocess.TimeoutExpired:
+                    pass
+            self.exit_codes[id] = process.returncode
+            if self.exit_handler:
+                self.exit_handler(id, command, process.returncode)
             return "already_exited"
         if grace is None:
             grace = wait
@@ -110,6 +144,8 @@ class ProcessManager:
                 process.wait(timeout=wait)
             except subprocess.TimeoutExpired:
                 pass
+        if process.poll() is not None:
+            self.exit_codes[id] = process.returncode
         return outcome
 
     def terminate_all(self, kill: bool = False):
@@ -125,6 +161,7 @@ class ProcessManager:
             if return_code is not None:
                 self.processes.pop(id, None)
                 command = self.commands.pop(id, None)
+                self.exit_codes[id] = return_code
                 _logger.info("Child %s exited: %s", id, return_code)
                 if self.exit_handler:
                     self.exit_handler(id, command, return_code)

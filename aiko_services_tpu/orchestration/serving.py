@@ -224,6 +224,13 @@ class ReplicaRouter(Actor):
         #: EC-share state topic (passive watch; no lease).
         self._loads: Dict[str, Dict] = {}
         self._unhealthy: set = set()
+        #: replica topic paths mid graceful drain (``lifecycle`` flip
+        #: to ``retiring``, usually by the autoscaler): excluded from
+        #: NEW routing immediately, but — unlike ``_unhealthy`` — their
+        #: in-flight requests are left to finish in place.  Death while
+        #: retiring falls through to the normal re-dispatch path, so
+        #: drain + kill still loses nothing.
+        self._retiring: set = set()
         #: replica topic path -> {phase: encoded histogram string}
         #: parsed off EC-share ``hist.*`` broadcasts — the mergeable
         #: replacements for sampling one replica's nearest-rank p95.
@@ -234,6 +241,7 @@ class ReplicaRouter(Actor):
             prefix_routed=0, kv_remote_hints=0),
             prefix="router", labels={"actor": self.name})
         self.share["replicas"] = 0
+        self.share["replicas_retiring"] = 0
         self.share["requests_routed"] = 0
         self.share["kv_directory_size"] = 0
         self.share.update(self.counters)
@@ -269,6 +277,7 @@ class ReplicaRouter(Actor):
             self._loads.pop(fields.topic_path, None)
             self._replica_hists.pop(fields.topic_path, None)
             self._unhealthy.discard(fields.topic_path)
+            self._set_retiring(fields.topic_path, False)
             # A dead owner's advertised prefixes must stop attracting
             # routes IMMEDIATELY — survivors recompute (in-flight
             # fetches against it time out into local prefill).
@@ -310,6 +319,7 @@ class ReplicaRouter(Actor):
         elif key == "healthy":
             self._set_health(replica, str(value) not in ("0", "False"))
         elif key == "lifecycle":
+            self._set_retiring(replica, str(value) == "retiring")
             self._set_health(replica, str(value) != "unhealthy")
 
     def _update_directory_share(self):
@@ -317,6 +327,27 @@ class ReplicaRouter(Actor):
         if self.ec_producer is not None:
             self.ec_producer.update_if_changed("kv_directory_size", size)
         self.share["kv_directory_size"] = size
+
+    def _set_retiring(self, replica: str, retiring: bool):
+        """Graceful-drain membership: a retiring replica NEVER receives
+        a new route (ARCHITECTURE invariant 8) but keeps its in-flight
+        work — the drain's whole point is letting that work finish
+        instead of re-dispatch-replaying it."""
+        if retiring == (replica in self._retiring):
+            return
+        if retiring:
+            self._retiring.add(replica)
+            # Its cached prefixes must stop attracting routes too.
+            self.directory.evict_replica(replica)
+            self._update_directory_share()
+            self.logger.info("%s: replica %s retiring — no new routes",
+                             self.name, replica)
+        else:
+            self._retiring.discard(replica)
+        self.share["replicas_retiring"] = len(self._retiring)
+        if self.ec_producer is not None:
+            self.ec_producer.update_if_changed(
+                "replicas_retiring", len(self._retiring))
 
     def _set_health(self, replica: str, healthy: bool):
         if healthy:
@@ -332,6 +363,13 @@ class ReplicaRouter(Actor):
         self._drain_replica(replica)
 
     def _candidates(self) -> List[str]:
+        live = [r for r in self._replicas if r not in self._unhealthy
+                and r not in self._retiring]
+        if live:
+            return live
+        # A fleet that is ALL retiring still serves: the drain is an
+        # operator intent, not a failure — better to extend one
+        # replica's drain than to shed everything.
         live = [r for r in self._replicas if r not in self._unhealthy]
         # A fleet that is ALL unhealthy beats routing nowhere: the
         # watchdogged replica still answers (with a retriable error)
@@ -771,7 +809,11 @@ class ReplicaRouter(Actor):
                        parent=entry.get("route_span"))
             return
         entry["attempts"] += 1
-        live = [r for r in self._replicas if r not in self._unhealthy]
+        # Re-dispatch prefers non-retiring survivors; a fleet that is
+        # ALL retiring still absorbs stranded work (drain ≠ dead).
+        live = [r for r in self._replicas if r not in self._unhealthy
+                and r not in self._retiring] or \
+               [r for r in self._replicas if r not in self._unhealthy]
         if not live:
             # Nothing to route to YET — back off again; the attempt
             # budget above bounds how long we hope.

@@ -29,6 +29,15 @@ point               effect at the wired site
 ``corrupt_response``  the replica mangles the response swag on the
                     wire; the client resolves the future with
                     ``error="corrupt_response"``.
+``fail_spawn``      :class:`~..orchestration.autoscaler.FleetAutoscaler`
+                    spawn path fails the replica launch outright (the
+                    spawner is never called); the supervisor records a
+                    spawn failure and retries with backoff — the
+                    crash-loop/quarantine machinery's quarry.
+``slow_start``      ...delays the launch ``ms=`` milliseconds instead
+                    (a replica that takes forever to announce), so the
+                    controller's pending-spawn accounting, not a fresh
+                    spawn storm, must cover the gap.
 ==================  =====================================================
 
 Zero-cost when disabled: every site guards with ``if faults.PLAN is
@@ -65,7 +74,8 @@ __all__ = ["FaultPlan", "FAULT_POINTS", "PLAN", "install", "uninstall",
            "plan_from_spec"]
 
 FAULT_POINTS = ("kill_replica", "drop_message", "delay_message",
-                "stall_step", "expire_lease", "corrupt_response")
+                "stall_step", "expire_lease", "corrupt_response",
+                "fail_spawn", "slow_start")
 
 
 @dataclasses.dataclass

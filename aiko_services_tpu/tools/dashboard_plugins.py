@@ -336,6 +336,47 @@ def replica_router_plugin(fields, variables) -> List[str]:
     return lines
 
 
+@dashboard_plugin(protocol="autoscaler")
+def autoscaler_plugin(fields, variables) -> List[str]:
+    """Elastic-fleet view: replica counts against targets, the last
+    scaling action, crash-loop quarantine, and SLO headroom."""
+    targets = ", ".join(
+        f"{key[len('target_'):]}={value}"
+        for key, value in sorted(variables.items())
+        if key.startswith("target_")) or "-"
+    lines = [
+        f"FleetAutoscaler: {fields.name}",
+        f"  lifecycle:  {_get(variables, 'lifecycle')}",
+        f"  fleet:      {_get(variables, 'replicas_live', default=0)}"
+        f" live / {_get(variables, 'replicas_pending', default=0)}"
+        f" pending / {_get(variables, 'replicas_draining', default=0)}"
+        f" draining  (targets: {targets})",
+        f"  scaling:    {_get(variables, 'scale_out', default=0)} out, "
+        f"{_get(variables, 'scale_in', default=0)} in, "
+        f"last: {_get(variables, 'last_action')}",
+        f"  healing:    {_get(variables, 'respawns', default=0)}"
+        f" respawns, {_get(variables, 'spawn_failures', default=0)}"
+        f" spawn failures, "
+        f"{_get(variables, 'deaths_observed', default=0)} deaths",
+        f"  drains:     {_get(variables, 'drains', default=0)} begun, "
+        f"{_get(variables, 'drain_completed', default=0)} completed, "
+        f"{_get(variables, 'drain_timeouts', default=0)} timed out",
+    ]
+    quarantine = _get(variables, "quarantine", default="")
+    if quarantine not in ("", "-", None):
+        lines.append(f"  QUARANTINE: {quarantine} "
+                     f"({_get(variables, 'quarantines', default=0)}"
+                     f" total)")
+    headroom = _get(variables, "slo_headroom_ms", default=None)
+    if headroom not in (None, "-", ""):
+        lines.append(f"  slo:        {headroom} ms TTFT headroom")
+    replica_seconds = _get(variables, "replica_seconds", default=None)
+    if replica_seconds not in (None, "-", ""):
+        lines.append(f"  usage:      {replica_seconds}"
+                     f" replica-seconds")
+    return lines
+
+
 def _trainer_pause_action(process, fields, variables):
     process.message.publish(f"{fields.topic_path}/in", "(pause)")
 

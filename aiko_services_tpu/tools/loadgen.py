@@ -32,7 +32,9 @@ from ..utils.sexpr import generate, parse
 
 __all__ = ["LoadGenerator", "LoadReport", "service_scale_sweep",
            "chaos_schedule", "run_chaos", "shared_prefix_payloads",
-           "run_shared_prefix", "fleet_latency", "main"]
+           "run_shared_prefix", "fleet_latency", "diurnal_trace",
+           "elastic_chaos_schedule", "run_elastic",
+           "run_elastic_chaos", "main"]
 
 #: Per-phase latency keys the replicas stamp on responses, in report
 #: order (``kv_restore`` is the cross-replica transfer phase).
@@ -78,6 +80,17 @@ class LoadReport:
     #: fixed-bucket histograms (phase -> {p50_ms, p95_ms, p99_ms,
     #: count}); attached by the harness via :func:`fleet_latency`.
     fleet_latency_ms: Optional[Dict[str, Dict[str, float]]] = None
+    #: TTFT SLO (ms) goodput is judged against; None = goodput is raw
+    #: throughput.  Attached by the harness (``run_elastic``).
+    slo_ttft_ms: Optional[float] = None
+    #: ∫ replica-count dt over the run — the denominator of
+    #: :attr:`goodput_per_replica` (autoscaler share delta, or
+    #: ``N * elapsed_s`` for a static fleet).
+    replica_seconds: float = 0.0
+    #: Final ``infer_response`` arriving for an already-completed
+    #: request id — the double-delivery a drain/re-dispatch chaos run
+    #: asserts is ZERO.
+    duplicate_finals: int = 0
 
     @property
     def lost(self) -> int:
@@ -95,6 +108,39 @@ class LoadReport:
         serving work moves; req/s alone hides per-request length."""
         return (self.tokens_total / self.elapsed_s
                 if self.elapsed_s else 0.0)
+
+    @property
+    def good_completions(self) -> int:
+        """Completions WITHIN the TTFT SLO (DistServe's goodput
+        numerator).  Completions without a ``ttft_ms`` stamp count as
+        good — only a measured breach disqualifies."""
+        if self.slo_ttft_ms is None:
+            return self.completed
+        within = sum(1 for ttft in self.ttfts_ms
+                     if ttft <= self.slo_ttft_ms)
+        unstamped = self.completed - len(self.ttfts_ms)
+        return within + max(0, unstamped)
+
+    @property
+    def goodput_rps(self) -> float:
+        """SLO-attaining completions per second."""
+        return (self.good_completions / self.elapsed_s
+                if self.elapsed_s else 0.0)
+
+    @property
+    def avg_replicas(self) -> float:
+        """Time-averaged fleet size over the run."""
+        return (self.replica_seconds / self.elapsed_s
+                if self.elapsed_s else 0.0)
+
+    @property
+    def goodput_per_replica(self) -> float:
+        """Goodput divided by average fleet size — the efficiency
+        number an autoscaled fleet must beat a static-peak fleet on
+        (serving the valleys with fewer replicas is the whole
+        point)."""
+        average = self.avg_replicas
+        return self.goodput_rps / average if average else 0.0
 
     @staticmethod
     def _quantile(values: List[float], q: float) -> float:
@@ -159,13 +205,21 @@ class LoadReport:
                   if self.prefix_hit_rate is not None else "")
         kv = (f", kv_xfer={self.kv_transfer_bytes}B"
               if self.kv_transfer_bytes else "")
+        goodput = ""
+        if self.slo_ttft_ms is not None:
+            goodput = (f", goodput={self.goodput_rps:.1f} req/s"
+                       f"@{self.slo_ttft_ms:g}ms")
+            if self.replica_seconds:
+                goodput += (f", {self.goodput_per_replica:.2f} "
+                            f"req/s/replica (avg "
+                            f"{self.avg_replicas:.2f})")
         return (f"LoadReport(sent={self.sent}, done={self.completed}, "
                 f"errors={self.errors}{kinds}, "
                 f"timeouts={self.timeouts}, "
                 f"{self.throughput_rps:.1f} req/s, "
                 f"{self.throughput_tps:.1f} tok/s, "
                 f"p50={self.p50_ms:.1f} ms, p99={self.p99_ms:.1f} ms"
-                f"{ttft}{prefix}{kv}{attn})")
+                f"{ttft}{goodput}{prefix}{kv}{attn})")
 
 
 class LoadGenerator:
@@ -191,6 +245,14 @@ class LoadGenerator:
         self._error_kinds: Dict[str, int] = {}
         self._tokens = 0
         self._run_index = 0
+        #: request_id -> concatenated streaming increments as
+        #: delivered (``infer_partial``); public so chaos tests can
+        #: assert partials == final tokens with no double-delivery.
+        self.partial_tokens: Dict[str, List[int]] = {}
+        #: request_id -> the final response's token list.
+        self.final_tokens: Dict[str, List[int]] = {}
+        self._completed_ids: set = set()
+        self._duplicate_finals = 0
         # Tracing (rides the global trace.TRACER switchboard): root
         # span per request, full ride-back tree kept per request id
         # for dump_traces().
@@ -208,13 +270,22 @@ class LoadGenerator:
 
     def _on_response(self, _topic: str, payload: str):
         command, params = parse(payload)
+        if command == "infer_partial" and len(params) > 1:
+            self._on_partial(str(params[0]), params[1])
+            return
         if command != "infer_response" or not params:
             return
         request_id = str(params[0])
         started = self._sent_at.pop(request_id, None)
         if started is None:
+            if request_id in self._completed_ids:
+                # A second FINAL for a finished request: the
+                # double-delivery chaos runs must never see.
+                self._duplicate_finals += 1
             return
+        self._completed_ids.add(request_id)
         outputs = params[1] if len(params) > 1 else {}
+        self._record_final_tokens(request_id, outputs)
         self._collect_trace(request_id, started, outputs)
         if isinstance(outputs, dict) and "error" in outputs:
             self._errors += 1
@@ -255,6 +326,35 @@ class LoadGenerator:
                             float(decode_value(outputs[f"{phase}_ms"])))
                     except Exception:  # noqa: BLE001 - telemetry only
                         pass
+
+    def _on_partial(self, request_id: str, outputs) -> None:
+        """Accumulate a streaming increment (chaos tests assert the
+        concatenation equals the final token list — a drained replica
+        finishing in place must never re-stream)."""
+        if not isinstance(outputs, dict) or "tokens_out" not in outputs:
+            return
+        try:
+            from ..pipeline.codec import decode_value
+            import numpy as np
+            increment = [int(t) for t in
+                         np.asarray(decode_value(outputs["tokens_out"]))
+                         .reshape(-1)]
+        except Exception:  # noqa: BLE001 - telemetry only
+            return
+        self.partial_tokens.setdefault(request_id, []).extend(increment)
+
+    def _record_final_tokens(self, request_id: str, outputs) -> None:
+        if not isinstance(outputs, dict) or "tokens_out" not in outputs:
+            return
+        try:
+            from ..pipeline.codec import decode_value
+            import numpy as np
+            self.final_tokens[request_id] = [
+                int(t) for t in
+                np.asarray(decode_value(outputs["tokens_out"]))
+                .reshape(-1)]
+        except Exception:  # noqa: BLE001 - telemetry only
+            pass
 
     def _collect_trace(self, request_id: str, started: float,
                        outputs) -> None:
@@ -302,7 +402,20 @@ class LoadGenerator:
         """Send ``n_requests`` at ``rate_hz``, then wait for stragglers.
         ``pump`` (optional) is called between waits — pass
         ``engine.drain`` when driving a VirtualClock engine in tests."""
-        # Per-run state: run() is re-runnable (rate sweeps), and ids
+        interval = 1.0 / self.rate_hz if self.rate_hz > 0 else 0.0
+        return self.run_trace(
+            [index * interval for index in range(n_requests)],
+            drain_timeout_s=drain_timeout_s, pump=pump)
+
+    def run_trace(self, send_offsets_s: List[float],
+                  drain_timeout_s: float = 30.0,
+                  pump: Optional[Callable[[], None]] = None
+                  ) -> LoadReport:
+        """Open-loop injection on an explicit schedule: request ``i``
+        is sent ``send_offsets_s[i]`` seconds after the run starts
+        (:func:`diurnal_trace` generates such schedules).  ``run()``
+        is the constant-rate special case."""
+        # Per-run state: runs are re-runnable (rate sweeps), and ids
         # are unique per run so a run-1 straggler cannot satisfy a
         # run-2 request.
         self._sent_at.clear()
@@ -314,11 +427,17 @@ class LoadGenerator:
         self._tokens = 0
         self._root_spans.clear()
         self._traces = []
+        self.partial_tokens = {}
+        self.final_tokens = {}
+        self._completed_ids = set()
+        self._duplicate_finals = 0
         self._run_index += 1
         run_tag = self._run_index
-        interval = 1.0 / self.rate_hz if self.rate_hz > 0 else 0.0
         started = self._clock()
-        for index in range(n_requests):
+        for index, offset in enumerate(send_offsets_s):
+            delay = started + offset - self._clock()
+            if delay > 0:
+                self._sleep(delay)
             request_id = f"lg{run_tag}_{index}"
             swag = self.payload_fn(index)
             if trace.TRACER is not None:
@@ -335,18 +454,13 @@ class LoadGenerator:
                           encode_swag(swag)]))
             if pump is not None:
                 pump()
-            if interval:
-                next_due = started + (index + 1) * interval
-                delay = next_due - self._clock()
-                if delay > 0:
-                    self._sleep(delay)
         deadline = self._clock() + drain_timeout_s
         while self._sent_at and self._clock() < deadline:
             if pump is not None:
                 pump()
             self._sleep(0.01)
         elapsed = self._clock() - started
-        return LoadReport(sent=n_requests,
+        return LoadReport(sent=len(send_offsets_s),
                           completed=len(self._latencies),
                           errors=self._errors,
                           timeouts=len(self._sent_at),
@@ -356,7 +470,8 @@ class LoadGenerator:
                           ttfts_ms=list(self._ttfts),
                           error_kinds=dict(self._error_kinds),
                           phase_ms={phase: list(values) for phase,
-                                    values in self._phases.items()})
+                                    values in self._phases.items()},
+                          duplicate_finals=self._duplicate_finals)
 
 
 def service_scale_sweep(services: int, broker: str = "scale-sweep",
@@ -744,6 +859,352 @@ def run_chaos(seed: int = 0, n_requests: int = 40,
         thread.join(timeout=5)
 
 
+def diurnal_trace(duration_s: float, base_hz: float = 2.0,
+                  peak_hz: float = 12.0, period_s: float = 8.0,
+                  burst_hz: float = 0.0, burst_every_s: float = 0.0,
+                  burst_len_s: float = 1.0,
+                  seed: int = 0) -> List[float]:
+    """Seeded diurnal arrival schedule: send offsets (seconds) for a
+    sinusoidal base rate — ``base_hz`` in the valley, ``peak_hz`` at
+    the crest, period ``period_s`` — with optional Poisson-arriving
+    bursts (``burst_hz`` extra for ``burst_len_s``, mean gap
+    ``burst_every_s``).  Arrivals are a non-homogeneous Poisson
+    process generated by thinning, fully deterministic per ``seed`` —
+    the workload shape an autoscaler must track (valleys are where a
+    static peak-sized fleet wastes replicas; bursts are what hysteresis
+    must not overreact to).  Feed to :meth:`LoadGenerator.run_trace`."""
+    import math
+    import random
+
+    rng = random.Random(seed)
+    bursts: List[Tuple[float, float]] = []
+    if burst_hz > 0 and burst_every_s > 0:
+        t = rng.expovariate(1.0 / burst_every_s)
+        while t < duration_s:
+            bursts.append((t, t + burst_len_s))
+            t += burst_len_s + rng.expovariate(1.0 / burst_every_s)
+
+    def rate_at(t: float) -> float:
+        wave = 0.5 * (1.0 - math.cos(2.0 * math.pi * t / period_s))
+        rate = base_hz + (peak_hz - base_hz) * wave
+        if any(start <= t < end for start, end in bursts):
+            rate += burst_hz
+        return rate
+
+    rate_max = max(base_hz, peak_hz) + burst_hz
+    times: List[float] = []
+    t = 0.0
+    while True:
+        t += rng.expovariate(rate_max)
+        if t >= duration_s:
+            return times
+        if rng.random() * rate_max < rate_at(t):
+            times.append(t)
+
+
+def elastic_chaos_schedule(seed: int):
+    """The seeded fault schedule gating elastic scale-down: during a
+    scripted ``scale_target`` 3→2 scale-down (victim: the idlest
+    replica — lexicographically ``decode1`` in the early-run valley),
+    ``decode3`` is killed outright, its replacement's first spawn
+    attempt fails, and the retry is slow-started.  The invariant: the
+    fleet still converges to the target with zero lost and zero
+    double-delivered requests.  The rig installs this plan AFTER its
+    warmup phase, so ``nth`` counts start with the measured run."""
+    from ..runtime import faults
+    return (
+        faults.FaultPlan(seed=seed)
+        # In-process kill (no hard=1: os._exit would take the whole
+        # rig); pump count puts it mid-load, after the scale-down.
+        .add("kill_replica", nth=6 + seed % 5, match="decode3")
+        # The post-kill REPLACEMENT spawn fails outright (bootstrap
+        # spawns happened before the plan was installed).
+        .add("fail_spawn", nth=1, match="decode3")
+        # The retry after the failed replacement announces late
+        # (pending-spawn accounting covers the gap — no spawn storm).
+        .add("slow_start", nth=1, match="decode3", ms=300))
+
+
+def _elastic_payloads(seed: int = 0, prompt_len: int = 12,
+                      max_new_tokens: int = 4, vocab: int = 1024,
+                      stream: bool = False) -> Callable[[int], Dict]:
+    """Independent random prompts (no shared prefix — elasticity, not
+    cache locality, is under test)."""
+    import numpy as np
+
+    rng = np.random.RandomState(seed)
+    prompts = rng.randint(1, vocab,
+                          size=(32, prompt_len)).astype(np.int32)
+
+    def payload_fn(index: int) -> Dict:
+        payload = {"tokens": prompts[index % len(prompts)],
+                   "max_new_tokens": max_new_tokens}
+        if stream:
+            payload["stream"] = 1
+        return payload
+
+    return payload_fn
+
+
+def run_elastic(duration_s: float = 10.0, seed: int = 0,
+                base_hz: float = 2.0, peak_hz: float = 12.0,
+                period_s: float = 8.0, burst_hz: float = 0.0,
+                burst_every_s: float = 0.0, burst_len_s: float = 1.0,
+                slo_ttft_ms: float = 500.0,
+                static_replicas: Optional[int] = None,
+                policy=None, stream: bool = False,
+                max_new_tokens: int = 4,
+                drain_timeout_s: float = 90.0,
+                fault_plan=None,
+                scale_script: Tuple[Tuple[float, int], ...] = (),
+                converge_s: float = 0.0,
+                warmup: int = 0) -> LoadReport:
+    """In-process ELASTIC serving rig: a :class:`FleetAutoscaler`
+    owns the replica fleet (in-process spawner building tiny PAGED
+    servers on background threads; terminator kills the replica's
+    Process so the Registrar LWT path runs for real) behind a
+    ReplicaRouter, driven by a :func:`diurnal_trace` schedule.
+
+    ``static_replicas=N`` instead pins a fixed N-replica fleet with no
+    autoscaler — the A/B baseline: the autoscaled fleet must beat the
+    static PEAK-sized fleet on ``goodput_per_replica`` over a diurnal
+    day (bench.py's ``serving_autoscale`` section and the slow gate).
+
+    ``scale_script`` is a sequence of ``(delay_s, target)`` operator
+    ``(scale_target …)`` commands fired mid-run (the chaos gate's
+    scripted scale-down); ``fault_plan`` installs a
+    :mod:`~..runtime.faults` plan for the run; ``converge_s`` waits
+    after the load for the fleet to settle (live == target, nothing
+    pending or draining) and records ``converged`` in
+    ``server_stats``.
+
+    ``warmup`` sends that many throwaway requests BEFORE the measured
+    run (and before the fault plan installs): the first decode step
+    JIT-compiles on the engine thread, a multi-second stall that would
+    otherwise smear the scale/fault timeline into one wakeup."""
+    import threading
+
+    from ..orchestration.autoscaler import (AutoscalerPolicy,
+                                            FleetAutoscaler)
+    from ..orchestration.continuous import ContinuousReplica
+    from ..orchestration.paged import PagedContinuousServer
+    from ..orchestration.serving import ReplicaRouter
+    from ..registry import Registrar
+    from ..runtime import (Process, actor_args, compose_instance,
+                           faults)
+    from ..runtime.event import EventEngine
+
+    def wait_for(predicate, timeout_s: float, what: str):
+        deadline = time.time() + timeout_s
+        while not predicate():
+            if time.time() > deadline:
+                raise TimeoutError(f"elastic rig: {what}")
+            time.sleep(0.02)
+
+    engine = EventEngine()
+    thread = engine.run_in_thread()
+    broker = f"elastic-{uuid.uuid4().hex[:6]}"
+    processes: List = []
+    pid_lock = threading.Lock()
+    next_pid = [1]
+
+    def make_process():
+        with pid_lock:
+            pid = next_pid[0]
+            next_pid[0] += 1
+        process = Process(namespace="elastic", hostname="h",
+                          pid=str(pid), engine=engine, broker=broker)
+        processes.append(process)
+        return process
+
+    #: slot -> {"process", "server"} for every replica ever built.
+    fleet: Dict[str, Dict] = {}
+    fleet_lock = threading.Lock()
+    servers: List = []
+
+    def build_replica(slot: str):
+        # Heavy JAX construction runs OFF the engine thread (the
+        # autoscaler calls the spawner from its tick timer; blocking
+        # the engine would stall every announcement and drain).
+        server = PagedContinuousServer(
+            config_name="tiny", slots=2, chunk_steps=4, seed=0,
+            enable_prefix_cache=True, max_queue=256, watchdog_s=5.0)
+        process = make_process()
+        compose_instance(ContinuousReplica, actor_args(slot),
+                         process=process, server=server)
+        with fleet_lock:
+            fleet[slot] = {"process": process, "server": server}
+            servers.append(server)
+
+    def spawner(slot: str, _role: str):
+        threading.Thread(target=build_replica, args=(slot,),
+                         daemon=True).start()
+
+    def terminator(slot: str, _mode: str):
+        with fleet_lock:
+            entry = fleet.get(slot)
+        if entry is None:
+            return
+        # Non-graceful: the LWT (absent) fires, exactly the eviction
+        # path a real dead OS process takes.  Off the engine thread —
+        # terminate pumps the transport.
+        threading.Thread(target=entry["process"].terminate,
+                         kwargs=dict(graceful=False),
+                         daemon=True).start()
+
+    generator = None
+    autoscaler = None
+    timers: List = []
+    try:
+        registrar = Registrar(process=make_process())
+        wait_for(lambda: registrar.state == "primary", 10,
+                 "registrar primary")
+        router = compose_instance(ReplicaRouter, actor_args("router"),
+                                  process=make_process(),
+                                  kv_transfer=True)
+        if static_replicas is not None:
+            expected = static_replicas
+            for index in range(static_replicas):
+                build_replica(f"static{index + 1}")
+        else:
+            if policy is None:
+                policy = AutoscalerPolicy(
+                    target=1, max_replicas=3, ttft_slo_ms=slo_ttft_ms,
+                    breach_windows=2, clear_windows=8,
+                    cooldown_s=2.0, spawn_timeout_s=60.0,
+                    drain_timeout_s=15.0)
+            expected = policy.initial_targets().get("decode", 1)
+            autoscaler = compose_instance(
+                FleetAutoscaler, actor_args("autoscaler"),
+                process=make_process(), spawner=spawner,
+                terminator=terminator, policy=policy, tick_s=0.25)
+        wait_for(lambda: router.share["replicas"] >= expected, 90,
+                 f"router discovery of {expected} replicas")
+        generator = LoadGenerator(
+            make_process(), f"{router.topic_path}/in",
+            payload_fn=_elastic_payloads(
+                seed=seed, max_new_tokens=max_new_tokens,
+                stream=stream),
+            rate_hz=0)
+        if warmup:
+            # Throwaway compile-warming burst; spacing gives P2C a
+            # chance to touch every replica.
+            generator.run_trace([0.1 * i for i in range(warmup)],
+                                drain_timeout_s=30.0)
+        if fault_plan is not None:
+            faults.install(fault_plan)
+        for delay_s, target in (scale_script if autoscaler is not None
+                                else ()):
+            timer = threading.Timer(
+                delay_s,
+                lambda t=target: autoscaler.process.message.publish(
+                    f"{autoscaler.topic_path}/in",
+                    f"(scale_target {t})"))
+            timer.daemon = True
+            timer.start()
+            timers.append(timer)
+        times = diurnal_trace(
+            duration_s, base_hz=base_hz, peak_hz=peak_hz,
+            period_s=period_s, burst_hz=burst_hz,
+            burst_every_s=burst_every_s, burst_len_s=burst_len_s,
+            seed=seed)
+        replica_seconds_0 = (
+            float(autoscaler.share["replica_seconds"])
+            if autoscaler is not None else 0.0)
+        report = generator.run_trace(times,
+                                     drain_timeout_s=drain_timeout_s)
+        report.slo_ttft_ms = slo_ttft_ms
+        # Stream-consistency audit: for every streamed request the
+        # concatenated partials must equal the final token sequence —
+        # a drain/kill/re-dispatch that re-streams or drops a token
+        # shows up here as a mismatch.
+        stream_mismatches = sum(
+            1 for request_id, partials in generator.partial_tokens.items()
+            if request_id in generator.final_tokens
+            and partials != generator.final_tokens[request_id])
+        converged = None
+        if autoscaler is not None:
+            if converge_s:
+                want = sum(autoscaler.state.targets.values())
+
+                def settled():
+                    return (autoscaler.share["replicas_live"] == want
+                            and autoscaler.share["replicas_pending"]
+                            == 0
+                            and autoscaler.share["replicas_draining"]
+                            == 0)
+
+                deadline = time.time() + converge_s
+                while not settled() and time.time() < deadline:
+                    time.sleep(0.05)
+                converged = settled()
+            report.replica_seconds = (
+                float(autoscaler.share["replica_seconds"])
+                - replica_seconds_0)
+            report.server_stats = dict(
+                autoscaler.stats(),
+                router_shed=router.counters["shed"],
+                redispatches=router.counters["redispatches"],
+                stream_mismatches=stream_mismatches,
+                faults_fired=(len(fault_plan.fired)
+                              if fault_plan is not None else 0))
+            if converged is not None:
+                report.server_stats["converged"] = converged
+        else:
+            report.replica_seconds = static_replicas * report.elapsed_s
+            report.server_stats = dict(
+                replicas_live=router.share["replicas"],
+                router_shed=router.counters["shed"],
+                redispatches=router.counters["redispatches"],
+                stream_mismatches=stream_mismatches)
+        report.fleet_latency_ms = fleet_latency(servers)
+        return report
+    finally:
+        if fault_plan is not None:
+            faults.uninstall()
+        for timer in timers:
+            timer.cancel()
+        if generator is not None:
+            generator.close()
+        for process in reversed(processes):
+            try:
+                process.terminate()
+            except Exception:  # noqa: BLE001 - the chaos run may have
+                pass           # already killed this process
+        engine.terminate()
+        thread.join(timeout=5)
+
+
+def run_elastic_chaos(seed: int = 0, duration_s: float = 8.0,
+                      **kwargs) -> LoadReport:
+    """The chaos gate for elastic scale-down (ISSUE acceptance): a
+    3-replica autoscaled fleet under streaming load gets a scripted
+    ``scale_target 2`` (graceful drain) while
+    :func:`elastic_chaos_schedule` kills a NON-draining replica and
+    fails its replacement's first spawn.  The run must converge to the
+    target with ``lost == 0`` and ``duplicate_finals == 0`` — the
+    hard invariant of the drain design.  SLO-driven scaling is frozen
+    (huge windows) so only the scripted scale-in and self-healing
+    move the fleet."""
+    from ..orchestration.autoscaler import AutoscalerPolicy
+
+    policy = AutoscalerPolicy(
+        target=3, min_replicas=1, max_replicas=4,
+        breach_windows=10 ** 6, clear_windows=10 ** 6,
+        cooldown_s=3600.0, spawn_timeout_s=60.0,
+        drain_timeout_s=10.0, backoff_base_s=0.5,
+        crash_loop_threshold=3, crash_loop_window_s=60.0)
+    kwargs.setdefault("scale_script", ((max(0.6, duration_s * 0.1),
+                                        2),))
+    kwargs.setdefault("converge_s", 30.0)
+    kwargs.setdefault("stream", True)
+    kwargs.setdefault("warmup", 6)
+    return run_elastic(duration_s=duration_s, seed=seed,
+                       policy=policy,
+                       fault_plan=elastic_chaos_schedule(seed),
+                       **kwargs)
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     """``python -m aiko_services_tpu.tools.loadgen --chaos`` (seeded
     fault schedule; exit 1 if any request was lost or hung) or
@@ -759,11 +1220,31 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser.add_argument("--chaos", action="store_true",
                         help="run the seeded fault schedule against "
                              "an in-process 2-replica rig")
-    parser.add_argument("--workload", choices=["shared_prefix"],
+    parser.add_argument("--elastic-chaos", action="store_true",
+                        help="run the elastic scale-down chaos gate "
+                             "(drain + kill-during-drain + failed "
+                             "replacement spawn; exit 1 unless zero "
+                             "lost/duplicated and converged)")
+    parser.add_argument("--workload",
+                        choices=["shared_prefix", "diurnal"],
                         help="named workload profile (in-process rig)")
     parser.add_argument("--seed", type=int, default=0)
     parser.add_argument("--requests", type=int, default=40)
     parser.add_argument("--rate-hz", type=float, default=100.0)
+    parser.add_argument("--duration", type=float, default=12.0,
+                        help="diurnal/elastic: run length (seconds)")
+    parser.add_argument("--base-hz", type=float, default=2.0,
+                        help="diurnal: valley request rate")
+    parser.add_argument("--peak-hz", type=float, default=12.0,
+                        help="diurnal: crest request rate")
+    parser.add_argument("--period", type=float, default=8.0,
+                        help="diurnal: sinusoid period (seconds)")
+    parser.add_argument("--slo-ttft-ms", type=float, default=500.0,
+                        help="diurnal: TTFT SLO goodput is judged "
+                             "against")
+    parser.add_argument("--static-replicas", type=int, default=None,
+                        help="diurnal: pin a fixed fleet (A/B "
+                             "baseline) instead of autoscaling")
     parser.add_argument("--conversations", type=int, default=3,
                         help="shared_prefix: interleaved conversations")
     parser.add_argument("--turns", type=int, default=4,
@@ -783,6 +1264,42 @@ def main(argv: Optional[List[str]] = None) -> int:
                         help="how many slowest requests --trace-out "
                              "dumps")
     args = parser.parse_args(argv)
+    if args.elastic_chaos:
+        report = run_elastic_chaos(seed=args.seed,
+                                   duration_s=args.duration,
+                                   base_hz=args.base_hz,
+                                   peak_hz=args.peak_hz,
+                                   period_s=args.period)
+        print(report)
+        print(f"autoscaler: {report.server_stats}")
+        ok = (not report.lost and not report.timeouts
+              and not report.duplicate_finals
+              and not report.server_stats.get("stream_mismatches")
+              and report.server_stats.get("converged"))
+        if not ok:
+            print(f"ELASTIC CHAOS FAIL (seed={args.seed}): "
+                  f"{report.lost} lost, {report.timeouts} hung, "
+                  f"{report.duplicate_finals} duplicated, "
+                  f"{report.server_stats.get('stream_mismatches')} "
+                  f"stream mismatches, "
+                  f"converged={report.server_stats.get('converged')}")
+            return 1
+        print(f"ELASTIC CHAOS OK (seed={args.seed}): drain + kill + "
+              f"failed respawn, nothing lost, fleet converged")
+        return 0
+    if args.workload == "diurnal":
+        report = run_elastic(duration_s=args.duration, seed=args.seed,
+                             base_hz=args.base_hz,
+                             peak_hz=args.peak_hz,
+                             period_s=args.period,
+                             slo_ttft_ms=args.slo_ttft_ms,
+                             static_replicas=args.static_replicas)
+        print(report)
+        print(f"fleet: {report.server_stats}")
+        print(f"goodput {report.goodput_rps:.2f} req/s over avg "
+              f"{report.avg_replicas:.2f} replicas = "
+              f"{report.goodput_per_replica:.2f} req/s/replica")
+        return 1 if (report.lost or report.timeouts) else 0
     if args.workload == "shared_prefix":
         report = run_shared_prefix(
             n_requests=args.requests, rate_hz=args.rate_hz,

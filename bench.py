@@ -801,6 +801,44 @@ def bench_serving_faults(trials=5, max_new=24, prompt_len=8,
             "serving_faults_trials": trials}
 
 
+def bench_serving_autoscale(duration_s=16.0, base_hz=1.0, peak_hz=8.0,
+                            period_s=8.0, slo_ttft_ms=500.0,
+                            static_peak=3, warmup=4, seed=2):
+    """Elastic A/B (DistServe goodput framing): the SAME diurnal trace
+    through an SLO-driven autoscaled fleet vs a static fleet sized for
+    the peak.  The headline is goodput PER REPLICA — the autoscaler
+    serves the valleys with fewer replicas, so its efficiency must
+    strictly beat the static-peak baseline (the slow-test gate asserts
+    the same).  Tiny config, CPU-capable like serving_faults."""
+    from aiko_services_tpu.tools.loadgen import run_elastic
+
+    knobs = dict(duration_s=duration_s, seed=seed, base_hz=base_hz,
+                 peak_hz=peak_hz, period_s=period_s,
+                 slo_ttft_ms=slo_ttft_ms, warmup=warmup)
+    autoscaled = run_elastic(**knobs)
+    static = run_elastic(static_replicas=static_peak, **knobs)
+    assert autoscaled.lost == 0 and autoscaled.timeouts == 0, autoscaled
+    assert static.lost == 0 and static.timeouts == 0, static
+    log(f"serving[autoscale] goodput/replica "
+        f"{autoscaled.goodput_per_replica:.2f} req/s over avg "
+        f"{autoscaled.avg_replicas:.2f} replicas vs static×"
+        f"{static_peak} {static.goodput_per_replica:.2f} req/s "
+        f"({autoscaled.server_stats.get('scale_out', 0)} scale-outs, "
+        f"{autoscaled.server_stats.get('drains', 0)} drains)")
+    return {"serving_autoscale_goodput_per_replica":
+                round(autoscaled.goodput_per_replica, 3),
+            "serving_autoscale_static_goodput_per_replica":
+                round(static.goodput_per_replica, 3),
+            "serving_autoscale_avg_replicas":
+                round(autoscaled.avg_replicas, 2),
+            "serving_autoscale_goodput_rps":
+                round(autoscaled.goodput_rps, 2),
+            "serving_autoscale_scale_outs":
+                autoscaled.server_stats.get("scale_out", 0),
+            "serving_autoscale_drains":
+                autoscaled.server_stats.get("drains", 0)}
+
+
 def bench_serving_8b(paged=False, slots=16, prompt_len=128,
                      max_new=128, n_requests=32, chunk_steps=8,
                      lookahead=4, config_name="llama3_8b",
@@ -1788,6 +1826,13 @@ SECTIONS = [
     ("serving_faults", 600,
      (lambda: bench_serving_faults(trials=2, max_new=12))
      if SMOKE else bench_serving_faults),
+    # Elastic goodput-per-replica A/B: SLO-driven autoscaled fleet vs
+    # a static peak-sized fleet over the same diurnal trace (tiny
+    # model, CPU-capable like serving_faults).
+    ("serving_autoscale", 600,
+     (lambda: bench_serving_autoscale(duration_s=8.0, peak_hz=5.0,
+                                      warmup=2))
+     if SMOKE else bench_serving_autoscale),
     ("serving_paged", 420,
      (lambda: bench_serving_paged(
          slots=2, prompt_len=24, max_new=8, n_requests=4,

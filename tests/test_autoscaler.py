@@ -1,0 +1,549 @@
+"""Self-healing elastic fleet: the SLO-driven autoscaler.
+
+Three layers, matching the design:
+
+* :func:`~aiko_services_tpu.orchestration.autoscaler.decide` is a PURE
+  function of ``(snapshot, policy, state)`` — the unit tests below
+  replay telemetry sequences and pin the exact action sequences
+  (hysteresis, cooldown, backoff growth, crash-loop quarantine and its
+  containment semantics, the capacity ledger's forget-surplus rule).
+* :class:`~aiko_services_tpu.orchestration.autoscaler.FleetAutoscaler`
+  + :class:`~aiko_services_tpu.orchestration.process_manager
+  .ProcessManager` integration: REAL child processes exiting 13 drive
+  the exit-code funnel into quarantine, and the ``fail_spawn`` /
+  ``slow_start`` fault points hit the spawn path.
+* The slow chaos gates (``slow_tests.txt``) run the full JAX serving
+  rig: scripted scale-down drain under streaming load with a kill +
+  failed respawn (zero lost, zero double-delivered), and the diurnal
+  goodput-per-replica A/B against a static peak-sized fleet.
+"""
+
+import dataclasses
+import sys
+import time
+
+import pytest
+
+from aiko_services_tpu.orchestration.autoscaler import (
+    Action, AutoscalerPolicy, ControllerState, DeathEvent,
+    FleetSnapshot, PendingView, ReplicaView, decide,
+)
+
+
+def _policy(**overrides) -> AutoscalerPolicy:
+    """Deterministic test policy: SLO scaling frozen unless a test
+    opts in (huge windows), tight backoff."""
+    defaults = dict(target=1, min_replicas=1, max_replicas=8,
+                    backoff_base_s=1.0, backoff_cap_s=8.0,
+                    cooldown_s=10.0,
+                    breach_windows=10 ** 6, clear_windows=10 ** 6,
+                    crash_loop_threshold=3, crash_loop_window_s=60.0,
+                    quarantine_s=300.0)
+    defaults.update(overrides)
+    return AutoscalerPolicy(**defaults)
+
+
+def _live(slot, **kw) -> ReplicaView:
+    return ReplicaView(slot=slot, **kw)
+
+
+# ---------------------------------------------------------------- #
+# decide(): bootstrap & self-healing
+# ---------------------------------------------------------------- #
+
+def test_bootstrap_spawns_to_target():
+    actions, state = decide(FleetSnapshot(now=0.0), _policy(target=2))
+    assert [a.kind for a in actions] == ["spawn", "spawn"]
+    assert [a.slot for a in actions] == ["decode1", "decode2"]
+    assert all(a.reason == "scale_out" for a in actions)
+    assert state.targets == {"decode": 2}
+    assert state.slots == {"decode1": "decode", "decode2": "decode"}
+
+
+def test_replace_dead_slot_after_backoff():
+    policy = _policy()
+    # Adopt a live replica, then watch it die.
+    actions, state = decide(
+        FleetSnapshot(now=0.0, replicas=(_live("decode1"),)), policy)
+    assert actions == []
+
+    # Death at t=10: backoff (base 1s) gates the respawn.
+    actions, state = decide(FleetSnapshot(
+        now=10.0, deaths=(DeathEvent("decode1", ts=10.0),)),
+        policy, state)
+    assert actions == []
+    assert state.backoff_until["decode1"] == pytest.approx(11.0)
+
+    actions, state = decide(FleetSnapshot(now=10.5), policy, state)
+    assert actions == []                       # still backing off
+
+    actions, state = decide(FleetSnapshot(now=11.0), policy, state)
+    assert actions == [Action("spawn", "decode1", role="decode",
+                              reason="replace")]
+
+    # Second death doubles the backoff: base * 2^(2-1).
+    actions, state = decide(FleetSnapshot(
+        now=20.0, deaths=(DeathEvent("decode1", ts=20.0),)),
+        policy, state)
+    assert actions == []
+    assert state.backoff_until["decode1"] == pytest.approx(22.0)
+
+
+def test_pending_spawn_is_not_down():
+    """A spawn in flight must not trigger a duplicate replacement."""
+    policy = _policy()
+    _, state = decide(FleetSnapshot(now=0.0), policy)   # spawns decode1
+    actions, state = decide(FleetSnapshot(
+        now=1.0, pending=(PendingView("decode1", due=30.0),)),
+        policy, state)
+    assert actions == []
+
+
+def test_expected_death_ends_the_slot():
+    """Drain-completion termination is bookkeeping, not a crash: the
+    slot is forgotten, never respawned."""
+    state = ControllerState(
+        targets={"decode": 1},
+        slots={"decode1": "decode", "decode2": "decode"})
+    actions, state = decide(FleetSnapshot(
+        now=5.0, replicas=(_live("decode2"),),
+        deaths=(DeathEvent("decode1", ts=5.0, expected=True),)),
+        _policy(), state)
+    assert "decode1" not in state.slots
+    assert actions == []
+    assert "decode1" not in state.deaths
+
+
+def test_fresh_slot_names_skip_adopted_squatters():
+    """An adopted replica may already be called ``decode1``; new
+    capacity must not collide with it."""
+    actions, state = decide(
+        FleetSnapshot(now=0.0, replicas=(_live("decode1"),)),
+        _policy(target=2))
+    assert actions == [Action("spawn", "decode2", role="decode",
+                              reason="scale_out")]
+    assert set(state.slots) == {"decode1", "decode2"}
+
+
+# ---------------------------------------------------------------- #
+# decide(): crash-loop quarantine & containment
+# ---------------------------------------------------------------- #
+
+def _quarantine_decode1(policy):
+    """Drive decode1 through 3 deaths inside the window; decode2 stays
+    live throughout."""
+    _, state = decide(
+        FleetSnapshot(now=0.0, replicas=(_live("decode1"),
+                                         _live("decode2"))), policy)
+    actions = []
+    for ts in (10.0, 12.0, 14.0):
+        actions, state = decide(FleetSnapshot(
+            now=ts, replicas=(_live("decode2"),),
+            deaths=(DeathEvent("decode1", ts=ts, exit_code=13),)),
+            policy, state)
+    return actions, state
+
+
+def test_crash_loop_quarantine_contains_the_slot():
+    policy = _policy(target=2)
+    actions, state = _quarantine_decode1(policy)
+    assert [a.kind for a in actions] == ["quarantine"]
+    assert "exit=13" in actions[0].reason
+    assert "decode1" in state.quarantined
+    assert state.quarantined["decode1"] == pytest.approx(14.0 + 300.0)
+
+    # Containment: the quarantined slot pads the ledger — no backfill
+    # spawn, no respawn, and decode2 (the last healthy replica) is
+    # NEVER drained on the zombie's behalf.
+    actions, state = decide(FleetSnapshot(
+        now=20.0, replicas=(_live("decode2"),)), policy, state)
+    assert actions == []
+    actions, state = decide(FleetSnapshot(
+        now=40.0, replicas=(_live("decode2"),)), policy, state)
+    assert actions == []
+
+
+def test_quarantine_expiry_forgets_surplus_slot():
+    """When the quarantine lapses and the target no longer wants the
+    capacity, the slot is forgotten outright — not respawned just to
+    be drained again."""
+    state = ControllerState(
+        targets={"decode": 1},
+        slots={"decode1": "decode", "decode2": "decode"},
+        quarantined={"decode1": 314.0})
+    actions, state = decide(FleetSnapshot(
+        now=315.0, replicas=(_live("decode2"),)), _policy(), state)
+    assert actions == []
+    assert state.quarantined == {}
+    assert "decode1" not in state.slots        # forgotten, not respawned
+    assert list(state.slots) == ["decode2"]
+
+
+def test_draining_replica_counts_out_of_eventual_capacity():
+    """While a drain is in flight the fleet's EVENTUAL size already
+    excludes it: no replacement is spawned and no second drain fires."""
+    state = ControllerState(
+        targets={"decode": 1},
+        slots={"decode1": "decode", "decode2": "decode"})
+    actions, state = decide(FleetSnapshot(
+        now=5.0, replicas=(_live("decode1", retiring=True),
+                           _live("decode2"))), _policy(), state)
+    assert actions == []
+
+
+# ---------------------------------------------------------------- #
+# decide(): SLO scaling — hysteresis, cooldown, scale-in
+# ---------------------------------------------------------------- #
+
+def test_scale_out_needs_consecutive_breaches_and_cooldown():
+    policy = _policy(breach_windows=3, cooldown_s=10.0)
+    _, state = decide(FleetSnapshot(now=0.0), policy)   # decode1
+    fleet = (_live("decode1"),)
+
+    # Two breach ticks: hysteresis holds the target.
+    for now in (1.0, 2.0):
+        actions, state = decide(FleetSnapshot(
+            now=now, replicas=fleet, ttft_p95_ms=900.0), policy, state)
+        assert state.targets == {"decode": 1}
+
+    # Third consecutive breach scales out.
+    actions, state = decide(FleetSnapshot(
+        now=3.0, replicas=fleet, ttft_p95_ms=900.0), policy, state)
+    assert state.targets == {"decode": 2}
+    assert [a for a in actions if a.kind == "spawn"] == \
+        [Action("spawn", "decode2", role="decode", reason="scale_out")]
+
+    # Still breaching, but the cooldown blocks a second raise...
+    fleet = (_live("decode1"), _live("decode2"))
+    for now in (4.0, 5.0, 6.0, 9.0):
+        actions, state = decide(FleetSnapshot(
+            now=now, replicas=fleet, ttft_p95_ms=900.0), policy, state)
+        assert state.targets == {"decode": 2}
+
+    # ...until it expires (last scale at t=3, cooldown 10).
+    actions, state = decide(FleetSnapshot(
+        now=13.0, replicas=fleet, ttft_p95_ms=900.0), policy, state)
+    assert state.targets == {"decode": 3}
+
+
+def test_shed_delta_counts_as_breach():
+    policy = _policy(breach_windows=1, cooldown_s=0.0)
+    _, state = decide(FleetSnapshot(now=0.0), policy)
+    actions, state = decide(FleetSnapshot(
+        now=1.0, replicas=(_live("decode1"),), shed_delta=4),
+        policy, state)
+    assert state.targets == {"decode": 2}
+
+
+def test_scale_in_drains_the_idlest_replica():
+    policy = _policy(target=2, clear_windows=3, cooldown_s=0.0)
+    fleet = (_live("decode1", slots_active=1), _live("decode2"))
+    # The bootstrap decide already counts clear tick #1.
+    _, state = decide(FleetSnapshot(now=0.0, replicas=fleet), policy)
+    actions, state = decide(FleetSnapshot(now=1.0, replicas=fleet),
+                            policy, state)
+    assert state.targets == {"decode": 2}      # two clear ticks so far
+    actions, state = decide(FleetSnapshot(now=2.0, replicas=fleet),
+                            policy, state)
+    assert state.targets == {"decode": 1}
+    assert actions == [Action("drain", "decode2", role="decode",
+                              reason="scale_in")]   # idlest wins
+
+
+def test_scale_in_blocked_by_queue_pending_and_floor():
+    # Queued work blocks scale-in even after the clear streak.  The
+    # bootstrap decide counts clear tick #1, so by t=1 the streak is
+    # already past the window — only the queue holds the target.
+    policy = _policy(target=2, clear_windows=2, cooldown_s=0.0)
+    _, state = decide(FleetSnapshot(now=0.0, replicas=(
+        _live("decode1", queue_depth=3), _live("decode2"))), policy)
+    actions, state = decide(FleetSnapshot(
+        now=1.0, replicas=(_live("decode1", queue_depth=3),
+                           _live("decode2"))), policy, state)
+    assert state.targets == {"decode": 2}
+
+    # A pending spawn blocks it too (fleet still in motion).
+    actions, state = decide(FleetSnapshot(
+        now=2.0, replicas=(_live("decode1"), _live("decode2")),
+        pending=(PendingView("decode3", due=30.0),)), policy, state)
+    assert state.targets == {"decode": 2}
+
+    # And min_replicas is a hard floor.
+    policy_floor = _policy(target=1, clear_windows=1, cooldown_s=0.0)
+    _, state = decide(FleetSnapshot(now=0.0,
+                                    replicas=(_live("decode1"),)),
+                      policy_floor)
+    for now in (1.0, 2.0, 3.0):
+        actions, state = decide(FleetSnapshot(
+            now=now, replicas=(_live("decode1"),)), policy_floor, state)
+        assert state.targets == {"decode": 1}
+        assert actions == []
+
+
+def test_disaggregated_breach_attribution():
+    """TTFT breaches grow the prefill pool, shed breaches decode."""
+    policy = _policy(target=1, prefill_target=1, prefill_max=4,
+                     breach_windows=1, cooldown_s=0.0)
+    _, state = decide(FleetSnapshot(now=0.0), policy)
+    assert state.targets == {"decode": 1, "prefill": 1}
+    prefill_slot = next(s for s, r in state.slots.items()
+                        if r == "prefill")
+    fleet = (_live("decode1"), _live(prefill_slot, role="prefill"))
+
+    actions, state = decide(FleetSnapshot(
+        now=1.0, replicas=fleet, ttft_p95_ms=900.0), policy, state)
+    assert state.targets == {"decode": 1, "prefill": 2}
+    spawned = [a for a in actions if a.kind == "spawn"]
+    assert [a.role for a in spawned] == ["prefill"]
+
+    actions, state = decide(FleetSnapshot(
+        now=2.0, replicas=fleet, shed_delta=5), policy, state)
+    assert state.targets == {"decode": 2, "prefill": 2}
+
+
+def test_decide_is_pure_and_deterministic():
+    policy = _policy(target=2, breach_windows=1, cooldown_s=0.0)
+    state = ControllerState(
+        targets={"decode": 2},
+        slots={"decode1": "decode", "decode2": "decode"},
+        deaths={"decode1": [3.0]}, backoff_until={"decode1": 4.0})
+    snapshot = FleetSnapshot(
+        now=9.0, replicas=(_live("decode2", queue_depth=1),),
+        deaths=(DeathEvent("decode2", ts=9.0),), ttft_p95_ms=800.0)
+    frozen = dataclasses.asdict(state)
+
+    first_actions, first_state = decide(snapshot, policy, state)
+    second_actions, second_state = decide(snapshot, policy, state)
+    assert dataclasses.asdict(state) == frozen     # input untouched
+    assert first_actions == second_actions
+    assert dataclasses.asdict(first_state) == \
+        dataclasses.asdict(second_state)
+
+
+# ---------------------------------------------------------------- #
+# FleetAutoscaler actor: wire commands & fault points
+# ---------------------------------------------------------------- #
+
+def _make_autoscaler(engine, policy, spawner=None, terminator=None,
+                     tick_s=0.05, broker="asc"):
+    from aiko_services_tpu.orchestration.autoscaler import (
+        FleetAutoscaler,
+    )
+    from aiko_services_tpu.runtime import (
+        Process, actor_args, compose_instance,
+    )
+    process = Process(namespace="asc", hostname="h", pid="1",
+                      engine=engine, broker=broker)
+    return compose_instance(
+        FleetAutoscaler, actor_args("autoscaler"), process=process,
+        spawner=spawner, terminator=terminator, policy=policy,
+        tick_s=tick_s)
+
+
+def test_scale_target_wire_command_clamps(engine):
+    policy = _policy(target=1, max_replicas=4, prefill_max=2)
+    autoscaler = _make_autoscaler(engine, policy)
+
+    autoscaler._wire_scale_target("9")
+    assert autoscaler.state.targets["decode"] == 4     # clamped to cap
+    assert autoscaler.share["target_decode"] == 4
+    autoscaler._wire_scale_target("prefill", "2")
+    assert autoscaler.state.targets["prefill"] == 2
+    autoscaler._wire_scale_target("warp", "3")          # unknown role
+    autoscaler._wire_scale_target("not_a_number")       # junk value
+    assert autoscaler.state.targets == {"decode": 4, "prefill": 2}
+
+
+def test_fail_spawn_fault_reports_through_death_funnel(engine):
+    """``fail_spawn`` must fail the launch WITHOUT calling the
+    spawner, feed the same funnel as a real spawn failure, and let
+    backoff drive the retry (which succeeds once the rule is spent)."""
+    from aiko_services_tpu.runtime import faults
+
+    calls = []
+    policy = _policy(target=1, backoff_base_s=0.2)
+    autoscaler = _make_autoscaler(
+        engine, policy, spawner=lambda slot, role: calls.append(slot),
+        broker="failspawn")
+    faults.install(faults.FaultPlan().add("fail_spawn", nth=1))
+    try:
+        for _ in range(40):
+            engine.advance(0.05)
+            if calls:
+                break
+    finally:
+        faults.uninstall()
+    assert calls == ["decode1"]               # only the RETRY launched
+    assert autoscaler.counters["spawn_failures"] == 1
+    assert autoscaler.counters["respawns"] == 1
+    assert autoscaler.counters["spawns"] == 0
+
+
+def test_slow_start_fault_delays_the_launch(engine):
+    from aiko_services_tpu.runtime import faults
+
+    calls = []
+    autoscaler = _make_autoscaler(
+        engine, _policy(target=1),
+        spawner=lambda slot, role: calls.append(slot),
+        broker="slowstart")
+    faults.install(faults.FaultPlan().add("slow_start", nth=1, ms=500))
+    try:
+        engine.advance(0.05)                  # first tick: spawn decided
+        assert autoscaler.counters["slow_starts"] == 1
+        assert "decode1" in autoscaler._pending
+        assert calls == []                    # held by the delay
+        engine.advance(0.3)
+        assert calls == []
+        engine.advance(0.3)                   # past the 0.5s delay
+        assert calls == ["decode1"]
+    finally:
+        faults.uninstall()
+
+
+# ---------------------------------------------------------------- #
+# ProcessManager integration: exit codes -> crash-loop quarantine
+# ---------------------------------------------------------------- #
+
+def test_exit_13_crash_loop_quarantines_and_stops_respawning(engine):
+    """Satellite 4's quarantine gate with REAL child processes: a slot
+    whose child exits 13 three times is quarantined — the supervisor
+    stops feeding the crash loop — and ``(clear_quarantine)`` resumes
+    it."""
+    from aiko_services_tpu.orchestration.autoscaler import (
+        manager_spawner, manager_terminator,
+    )
+    from aiko_services_tpu.orchestration.process_manager import (
+        ProcessManager,
+    )
+
+    policy = _policy(target=1, backoff_base_s=0.1, backoff_cap_s=0.4,
+                     crash_loop_threshold=3, crash_loop_window_s=60.0,
+                     spawn_timeout_s=30.0)
+    autoscaler = _make_autoscaler(engine, policy, broker="crashloop")
+    manager = ProcessManager(exit_handler=autoscaler.note_exit,
+                             engine=engine)
+    autoscaler._spawner = manager_spawner(
+        manager, sys.executable,
+        argv_fn=lambda slot, role: ["-c", "import sys; sys.exit(13)"])
+    autoscaler._terminator = manager_terminator(manager)
+
+    def pump(predicate, what, real_timeout_s=60.0):
+        deadline = time.time() + real_timeout_s
+        while not predicate():
+            assert time.time() < deadline, what
+            engine.advance(0.05)              # virtual timers
+            time.sleep(0.005)                 # real child lifecycles
+
+    try:
+        pump(lambda: "decode1" in autoscaler.state.quarantined,
+             "slot never quarantined")
+        assert autoscaler.counters["quarantines"] == 1
+        assert autoscaler.counters["deaths_observed"] == 3
+        assert manager.exit_codes["decode1"] == 13
+        assert autoscaler.share["quarantine"] == "decode1"
+
+        # Containment: no further launches while quarantined.
+        launches = (autoscaler.counters["spawns"]
+                    + autoscaler.counters["respawns"])
+        for _ in range(40):
+            engine.advance(0.05)
+            time.sleep(0.002)
+        assert (autoscaler.counters["spawns"]
+                + autoscaler.counters["respawns"]) == launches
+        assert autoscaler.share["replicas_live"] == 0
+
+        # Operator override resumes the respawn loop.
+        autoscaler._wire_clear_quarantine("decode1")
+        assert autoscaler.state.quarantined == {}
+        pump(lambda: (autoscaler.counters["spawns"]
+                      + autoscaler.counters["respawns"]) > launches,
+             "no respawn after clear_quarantine")
+    finally:
+        manager.terminate_all(kill=True)
+
+
+# ---------------------------------------------------------------- #
+# Diurnal workload trace (satellite: loadgen)
+# ---------------------------------------------------------------- #
+
+def test_diurnal_trace_is_seeded_and_bounded():
+    from aiko_services_tpu.tools.loadgen import diurnal_trace
+
+    times = diurnal_trace(20.0, base_hz=2.0, peak_hz=10.0,
+                          period_s=5.0, seed=1)
+    assert times == diurnal_trace(20.0, base_hz=2.0, peak_hz=10.0,
+                                  period_s=5.0, seed=1)
+    assert times != diurnal_trace(20.0, base_hz=2.0, peak_hz=10.0,
+                                  period_s=5.0, seed=2)
+    assert times == sorted(times)
+    assert all(0.0 <= t < 20.0 for t in times)
+    # E[arrivals] = ∫rate = 20·(2 + 8·0.5) = 120; allow wide Poisson
+    # slack but reject a flat-rate or empty trace.
+    assert 60 < len(times) < 200
+
+    bursty = diurnal_trace(20.0, base_hz=2.0, peak_hz=10.0,
+                           period_s=5.0, burst_hz=40.0,
+                           burst_every_s=5.0, burst_len_s=0.5, seed=1)
+    assert bursty == sorted(bursty)
+    assert len(bursty) > len(times)           # bursts add arrivals
+
+
+def test_goodput_accounting():
+    from aiko_services_tpu.tools.loadgen import LoadReport
+
+    report = LoadReport(
+        sent=10, completed=8, errors=2, timeouts=0, elapsed_s=4.0,
+        latencies_ms=[10.0] * 8,
+        ttfts_ms=[100.0, 100.0, 100.0, 100.0, 100.0, 900.0],
+        slo_ttft_ms=500.0, replica_seconds=8.0)
+    # 5 within-SLO + 2 unstamped completions count as good; the 900ms
+    # breach does not.
+    assert report.good_completions == 7
+    assert report.goodput_rps == pytest.approx(7 / 4.0)
+    assert report.avg_replicas == pytest.approx(2.0)
+    assert report.goodput_per_replica == pytest.approx(7 / 8.0)
+    assert "goodput" in repr(report)
+
+
+# ---------------------------------------------------------------- #
+# Chaos gates (slow: full JAX serving rig — see slow_tests.txt)
+# ---------------------------------------------------------------- #
+
+def test_elastic_chaos_drain_loses_nothing():
+    """ISSUE acceptance: scripted scale-down drain under streaming
+    load, with a kill during the drain window and a failed + slowed
+    replacement spawn — the fleet converges to the target and no
+    request is lost, duplicated, or re-streamed."""
+    from aiko_services_tpu.tools.loadgen import run_elastic_chaos
+
+    report = run_elastic_chaos(seed=0, duration_s=8.0)
+    assert report.lost == 0, report
+    assert report.timeouts == 0, report
+    assert report.duplicate_finals == 0, report
+    stats = report.server_stats
+    assert stats["stream_mismatches"] == 0    # partials == final, once
+    assert stats["converged"] is True
+    assert stats["drains"] >= 1
+    assert stats["drain_completed"] >= 1
+    assert stats["spawn_failures"] >= 1       # fail_spawn fired
+    assert stats["slow_starts"] >= 1          # slow_start fired
+    assert stats["deaths_observed"] >= 2      # kill + failed respawn
+    assert stats["faults_fired"] >= 3         # the schedule really ran
+    assert stats["replicas_live"] == sum(stats["targets"].values())
+
+
+def test_diurnal_autoscaled_beats_static_peak_goodput():
+    """ISSUE acceptance: over a diurnal day the autoscaled fleet's
+    goodput PER REPLICA strictly beats a static fleet sized for the
+    peak — serving the valleys with fewer replicas is the point."""
+    from aiko_services_tpu.tools.loadgen import run_elastic
+
+    knobs = dict(duration_s=16.0, seed=2, base_hz=1.0, peak_hz=8.0,
+                 period_s=8.0, slo_ttft_ms=500.0, warmup=4)
+    autoscaled = run_elastic(**knobs)
+    static = run_elastic(static_replicas=3, **knobs)
+    assert autoscaled.lost == 0 and autoscaled.timeouts == 0
+    assert static.lost == 0 and static.timeouts == 0
+    assert autoscaled.avg_replicas < 3.0
+    assert autoscaled.goodput_per_replica > static.goodput_per_replica

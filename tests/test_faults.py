@@ -99,6 +99,7 @@ def test_env_bootstrap_installs_plan():
 # ---------------------------------------------------------------- #
 
 _INJECTION_MODULES = (
+    PKG / "orchestration" / "autoscaler.py",
     PKG / "orchestration" / "continuous.py",
     PKG / "runtime" / "process.py",
     PKG / "runtime" / "lease.py",
