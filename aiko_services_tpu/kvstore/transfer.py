@@ -43,6 +43,15 @@ Bit-exactness: exported rows are the owner's pool bytes verbatim
 an imported block is never rewritten by the importer's admission
 seed — so greedy decode after an imported prefix exactly equals local
 prefill (asserted for both pool dtypes in tests/test_kvstore.py).
+
+Tensor-parallel replicas: a TP replica's pool is a kv-head-sharded
+global ``jax.Array``, but the wire format stays the FULL kv-head
+width — export gathers full rows from the shards, import scatters
+them back and re-pins the pool sharding.  Replicas with different TP
+degrees (including TP=1) therefore exchange blocks with no layout
+negotiation beyond :func:`pool_signature`, which is mesh-agnostic by
+construction (tested: TP=2 → TP=4 greedy handoff is bit-exact in
+bf16 and int8).
 """
 
 from __future__ import annotations
@@ -130,11 +139,16 @@ def export_payload(server, keys_hex: List[str],
         "kv_sig": pool_signature(server),
         "kv_dtype": np.dtype(server.pool[0]["k"].dtype).name,
     }
-    ids = np.asarray(blocks, np.int32)
+    # Device-side row gather, THEN the host pull: only the selected
+    # blocks cross to host, and on a TP replica (kv-head-sharded pool)
+    # the gather assembles full-width rows from every shard — the wire
+    # format is always the full kv-head width, so replicas with
+    # DIFFERENT TP degrees exchange blocks without reshaping.
+    ids = server._jnp.asarray(np.asarray(blocks, np.int32))
     for layer, buffers in enumerate(server.pool):
         for name, buf in buffers.items():
             payload[f"kv_l{layer}_{name}"] = _pack(
-                np.asarray(buf)[ids])
+                np.asarray(buf[ids]))
     return payload
 
 
@@ -211,8 +225,16 @@ def import_payload(server, payload: Dict, engine=None,
                 return 0
             rows = _unpack(np.asarray(data)[offset:offset + needed],
                            dtype_name, buf.dtype)
-            written[name] = buf.at[ids].set(
-                jnp.asarray(rows).astype(buf.dtype))
+            new = buf.at[ids].set(jnp.asarray(rows).astype(buf.dtype))
+            if getattr(buf, "sharding", None) is not None \
+                    and getattr(server, "_mesh", None) is not None:
+                # TP replica: re-pin the written buffer to the pool's
+                # kv-head sharding — the scatter above must not leave
+                # a replicated copy behind (the shard_map engine's
+                # in_specs expect the sharded layout, and a gathered
+                # pool would defeat the whole memory split).
+                new = server._jax.device_put(new, buf.sharding)
+            written[name] = new
         server.pool[layer] = written
 
     imported: List[bytes] = []

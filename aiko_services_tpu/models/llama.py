@@ -99,6 +99,13 @@ CONFIGS: Dict[str, LlamaConfig] = {
     "tiny": LlamaConfig(vocab_size=1024, d_model=128, n_layers=2,
                         n_heads=4, n_kv_heads=2, d_ff=352,
                         max_seq_len=512),
+    # TP-shardable test config: every sharded dim (kv heads, q heads,
+    # d_model, d_ff, vocab) divides by 8, so one config exercises
+    # TP=1/2/4/8 on the virtual CPU mesh; GQA group of 2 keeps the
+    # grouped-head slicing honest.
+    "tiny_tp": LlamaConfig(vocab_size=1024, d_model=128, n_layers=2,
+                           n_heads=16, n_kv_heads=8, d_ff=352,
+                           max_seq_len=512),
     "small": LlamaConfig(vocab_size=32_000, d_model=1024, n_layers=8,
                          n_heads=16, n_kv_heads=8, d_ff=2816,
                          max_seq_len=2048),

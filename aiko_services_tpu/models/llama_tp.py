@@ -1,0 +1,442 @@
+"""Tensor-parallel serving engine: one replica = one mesh.
+
+shard_map mirrors of the paged serving entry points in
+:mod:`.llama` (``serve_chunk_paged`` / ``serve_chunk_mixed`` /
+``prefill_append_paged``) that run TP-sharded over a
+:class:`~..parallel.mesh.ReplicaMesh`:
+
+* Every 2-D weight leaf is sharded on its LAST (output-feature) axis —
+  one uniform rule that covers dense bf16 weights, int8 ``{"q","s"}``
+  and int4 ``{"q4","s"}`` trees (scales are 2-D with the output axis
+  last), the embedding (feature-sharded rows), and the LM head
+  (vocab-sharded logits).  Each local matmul therefore keeps the FULL
+  contraction dimension and computes a contiguous slice of output
+  columns; the only collective is an ``all_gather`` of those columns.
+  An all-gather is pure data movement — no partial-sum reduction whose
+  float ordering could differ from the single-chip program — which is
+  what makes TP greedy decode token-identical to single-chip greedy
+  (the exact-equality gate in tests/test_tp_serving.py).  The
+  row-parallel/``reduce-scatter`` layout (see
+  :mod:`..parallel.collective_matmul`, usable on TPU to overlap the
+  collective with the matmul) trades that exactness for bandwidth and
+  is deliberately NOT used here.
+
+* The paged KV pool shards along its kv-head axis (dim 2) as GLOBAL
+  ``jax.Array``s — host-side block bookkeeping (prefix-cache
+  scatter/gather, kvstore export/import) keeps operating on full-width
+  arrays and jax resolves blocks to per-shard slices.  Because wq/wk/wv
+  shard by whole heads (contiguous output ranges), shard ``i`` computes
+  exactly q-heads ``[i*h/tp, (i+1)*h/tp)`` and kv-heads
+  ``[i*kv/tp, (i+1)*kv/tp)`` — and since ``tp | n_kv_heads``, every
+  shard's q-head range covers whole GQA groups of its local kv heads.
+  Attention is a per-kv-head computation, so it stays entirely local
+  between the QKV projections and the output-projection gather: the
+  pool is NEVER gathered across shards (jaxpr-guarded).
+
+* Per-slot decode state (tokens/positions/active/remaining/tables) is
+  replicated, so the host admission/commit/dirty-sync protocol is
+  byte-identical to the single-chip server, and the tiny per-step
+  (tokens, counts) sync stays tiny.
+
+LoRA adapters and MoE configs are rejected under TP (adapter factors
+and expert weights don't fit the 2-D output-axis rule yet).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+try:                                      # jax >= 0.8
+    from jax import shard_map
+except ImportError:                       # jax 0.4.x
+    from jax.experimental.shard_map import shard_map
+
+from ..ops.paged_attention import paged_decode_attention
+from ..ops.paged_prefill import paged_prefill_attention
+from . import llama
+from .llama import LlamaConfig
+
+__all__ = ["TPEngine", "tp_param_specs", "tp_pool_specs",
+           "shard_params", "shard_pool", "replicate"]
+
+
+# --------------------------------------------------------------------------- #
+# Sharding layout
+
+
+def tp_param_specs(params, axis: str = "tp"):
+    """Output-axis PartitionSpecs for an ACTUAL parameter tree (dense
+    or quantized): every 2-D leaf shards its last axis, everything
+    else (1-D norm vectors) replicates.  Operating on the real tree —
+    not the config — means one rule serves bf16, int8 and int4
+    layouts identically."""
+    return jax.tree.map(
+        lambda leaf: P(None, axis) if getattr(leaf, "ndim", 0) == 2
+        else P(), params)
+
+
+def tp_pool_specs(pool, axis: str = "tp"):
+    """Kv-head-axis PartitionSpecs for a paged pool (list of per-layer
+    ``{"k","v"[,"ks","vs"]}`` dicts): the 4-D k/v buffers
+    ``(n_blocks, block_size, kv_heads, head_dim)`` shard dim 2, the
+    3-D int8 scales shard their trailing kv-head dim."""
+    return jax.tree.map(
+        lambda buf: P(None, None, axis, None) if buf.ndim == 4
+        else P(None, None, axis), pool)
+
+
+def shard_params(params, mesh: Mesh, axis: str = "tp"):
+    """Lay a parameter tree out over the replica mesh (global arrays,
+    output axis sharded)."""
+    return jax.tree.map(
+        lambda leaf, spec: jax.device_put(leaf,
+                                          NamedSharding(mesh, spec)),
+        params, tp_param_specs(params, axis))
+
+
+def shard_pool(pool, mesh: Mesh, axis: str = "tp"):
+    """Lay a paged pool out over the replica mesh (global arrays,
+    kv-head axis sharded)."""
+    return jax.tree.map(
+        lambda buf, spec: jax.device_put(buf, NamedSharding(mesh, spec)),
+        pool, tp_pool_specs(pool, axis))
+
+
+def replicate(tree, mesh: Mesh):
+    """Replicate a pytree onto every device of the replica mesh (the
+    per-slot decode state layout)."""
+    sharding = NamedSharding(mesh, P())
+    return jax.tree.map(lambda leaf: jax.device_put(leaf, sharding),
+                        tree)
+
+
+# --------------------------------------------------------------------------- #
+# Shard-local model mirrors
+#
+# These mirror llama's paged decode/prefill cores LINE FOR LINE, with
+# three mechanical changes: head counts become shard-local
+# (h/tp, kv/tp), LoRA plumbing is dropped (rejected under TP), and an
+# output-column all_gather follows each matmul whose result the next
+# (replicated-input) op needs in full.  f32 cast discipline is kept
+# exactly where the originals cast — every gathered value is bitwise
+# the concatenation of per-shard values, so the math matches the
+# single-chip program bit for bit.
+
+
+def _gather_cols(x, axis_name: str):
+    """All-gather the local output columns back to the full feature
+    axis (pure data movement — the exactness-preserving collective)."""
+    return jax.lax.all_gather(x, axis_name, axis=x.ndim - 1, tiled=True)
+
+
+def _tp_embed(params, tokens, config: LlamaConfig, axis: str):
+    return _gather_cols(
+        llama._embed_lookup(params, tokens, config.dtype), axis)
+
+
+def _tp_lm_head(params, config: LlamaConfig, axis: str, x):
+    x = llama.rms_norm(x, params["final_norm"], config.norm_eps)
+    logits = llama._matmul(x, params["lm_head"]).astype(jnp.float32)
+    return _gather_cols(logits, axis)
+
+
+def _tp_mlp_block(layer, config: LlamaConfig, axis: str, x):
+    normed = llama.rms_norm(x, layer["mlp_norm"], config.norm_eps)
+    gate = jax.nn.silu(
+        llama._matmul(normed, layer["w_gate"]).astype(jnp.float32))
+    up = llama._matmul(normed, layer["w_up"]).astype(jnp.float32)
+    act = _gather_cols((gate * up).astype(x.dtype), axis)
+    return x + _gather_cols(llama._matmul(act, layer["w_down"]), axis)
+
+
+def _tp_attention_decode_paged(layer, config: LlamaConfig, tp: int,
+                               axis: str, x, cos, sin, pool_layer,
+                               tables, positions):
+    """Shard-local mirror of ``llama._attention_decode_paged``:
+    projections produce this shard's contiguous head range, the pool
+    write and the attention kernel/reference run on the LOCAL kv-head
+    slice, and only the attention output's feature columns gather
+    before the output projection."""
+    batch, seq = x.shape[:2]
+    h, kv = config.n_heads // tp, config.n_kv_heads // tp
+    hd = config.head_dim
+    normed = llama.rms_norm(x, layer["attn_norm"], config.norm_eps)
+    q = llama._matmul(normed, layer["wq"]).reshape(batch, seq, h, hd)
+    k = llama._matmul(normed, layer["wk"]).reshape(batch, seq, kv, hd)
+    v = llama._matmul(normed, layer["wv"]).reshape(batch, seq, kv, hd)
+    q = llama.apply_rope(q, cos, sin)
+    k = llama.apply_rope(k, cos, sin)
+    new_pool = llama._paged_write_rows(pool_layer, k, v, tables,
+                                       positions)
+    use_kernel, interpret = llama.decode_kernel_mode()
+    q_g = q.reshape(batch, seq, kv, h // kv, hd)
+    if use_kernel:
+        out = paged_decode_attention(
+            q_g[:, 0], new_pool["k"], new_pool["v"], tables, positions,
+            ks=new_pool.get("ks"), vs=new_pool.get("vs"),
+            window=config.sliding_window, interpret=interpret)[:, None]
+    else:
+        gathered = llama._paged_gather(new_pool, tables)
+        out = llama._cached_gqa_attention(q_g, gathered,
+                                          positions[:, None], hd,
+                                          window=config.sliding_window)
+    out = _gather_cols(out.reshape(batch, seq, h * hd), axis)
+    attn = _gather_cols(llama._matmul(out, layer["wo"]), axis)
+    return x + attn.astype(x.dtype), new_pool
+
+
+def _tp_decode_core_paged(params, token, pool, tables, positions,
+                          config: LlamaConfig, tp: int, axis: str):
+    positions_2d = positions[:, None]
+    cos, sin = llama._rope_freqs(config, positions_2d)
+    x = _tp_embed(params, token, config, axis)
+    new_pool = []
+    for layer, pool_layer in zip(params["layers"], pool):
+        x, layer_pool = _tp_attention_decode_paged(
+            layer, config, tp, axis, x, cos, sin, pool_layer, tables,
+            positions)
+        new_pool.append(layer_pool)
+        x = _tp_mlp_block(layer, config, axis, x)
+    logits = _tp_lm_head(params, config, axis, x)
+    return logits, new_pool
+
+
+def _tp_prefill_append_core(params, tokens, pool, tables, start_index,
+                            config: LlamaConfig, tp: int, axis: str,
+                            kv_limit=None,
+                            compute_logits: bool = False):
+    """Shard-local mirror of ``llama._prefill_append_core``: the
+    chunk's K/V land in the LOCAL pool slice, append attention runs
+    per local kv head, activations gather after each projection."""
+    batch, K = tokens.shape
+    h, kv = config.n_heads // tp, config.n_kv_heads // tp
+    hd = config.head_dim
+    start_index = jnp.asarray(start_index, jnp.int32)
+    positions_b = jnp.broadcast_to(
+        start_index + jnp.arange(K, dtype=jnp.int32), (batch, K))
+    cached_lens = jnp.broadcast_to(start_index, (batch,))
+    chunk_lens = jnp.full((batch,), K, jnp.int32)
+    cos, sin = llama._rope_freqs(config, positions_b)
+    x = _tp_embed(params, tokens, config, axis)
+    use_kernel, interpret = llama.prefill_kernel_mode()
+    new_pool = []
+    for layer, pool_layer in zip(params["layers"], pool):
+        normed = llama.rms_norm(x, layer["attn_norm"], config.norm_eps)
+        q = llama._matmul(normed, layer["wq"]).reshape(batch, K, h, hd)
+        k = llama._matmul(normed, layer["wk"]).reshape(batch, K, kv, hd)
+        v = llama._matmul(normed, layer["wv"]).reshape(batch, K, kv, hd)
+        q = llama.apply_rope(q, cos, sin)
+        k = llama.apply_rope(k, cos, sin)
+        q_g = q.reshape(batch, K, kv, h // kv, hd)
+        if use_kernel:
+            out, pool_layer = paged_prefill_attention(
+                q_g, k, v, pool_layer, tables, cached_lens, chunk_lens,
+                window=config.sliding_window, interpret=interpret,
+                kv_limit=kv_limit)
+        else:
+            pool_layer = llama._paged_write_slab(pool_layer, k, v,
+                                                 tables, positions_b)
+            gathered = llama._paged_gather(pool_layer, tables)
+            out = llama._cached_gqa_attention(
+                q_g, gathered, positions_b, hd,
+                window=config.sliding_window)
+        new_pool.append(pool_layer)
+        out = _gather_cols(out.reshape(batch, K, h * hd), axis)
+        x = x + _gather_cols(llama._matmul(out, layer["wo"]),
+                             axis).astype(x.dtype)
+        x = _tp_mlp_block(layer, config, axis, x)
+    if not compute_logits:
+        return None, new_pool
+    return _tp_lm_head(params, config, axis, x), new_pool
+
+
+# --------------------------------------------------------------------------- #
+# The engine
+
+
+class TPEngine:
+    """Per-server dispatcher for the TP serving entry points.
+
+    Built once per :class:`PagedContinuousServer` (the shard_map
+    in/out spec trees depend on the server's actual parameter and pool
+    pytree structure — quantization layout, layer count — so the
+    jitted closures are constructed per engine and cached per static
+    signature).  Mirrors the llama entry points' signatures so the
+    server's dispatch sites stay one-line switches:
+
+    * :meth:`serve_chunk_paged` — decode chunk (pool donated)
+    * :meth:`serve_chunk_mixed` — chunked-prefill slice + decode chunk
+    * :meth:`prefill_append_paged` — standalone prefill append
+    """
+
+    def __init__(self, config: LlamaConfig, mesh: Mesh, params, pool,
+                 axis: str = "tp"):
+        if axis not in mesh.axis_names:
+            raise ValueError(
+                f"mesh has no '{axis}' axis: {mesh.axis_names}")
+        self.config = config
+        self.mesh = mesh
+        self.axis = axis
+        self.tp = mesh.shape[axis]
+        if config.n_kv_heads % self.tp or config.n_heads % self.tp:
+            raise ValueError(
+                f"tp={self.tp} must divide n_kv_heads="
+                f"{config.n_kv_heads} and n_heads={config.n_heads}")
+        self._param_specs = tp_param_specs(params, axis)
+        self._pool_specs = tp_pool_specs(pool, axis)
+        self._cache: Dict[Any, Any] = {}
+
+    # -- spec helpers -------------------------------------------------- #
+
+    def _shard_map(self, body, in_specs, out_specs):
+        return shard_map(body, mesh=self.mesh, in_specs=in_specs,
+                         out_specs=out_specs, check_rep=False)
+
+    # -- decode chunk -------------------------------------------------- #
+
+    def serve_chunk_paged(self, params, state, pool, num_steps,
+                          eos_id: int = -1, sampled: bool = False,
+                          rng_key=None):
+        """TP twin of :func:`llama.serve_chunk_paged` (no LoRA)."""
+        num_steps = int(num_steps)
+        key = ("serve", num_steps, int(eos_id), bool(sampled),
+               rng_key is not None)
+        fn = self._cache.get(key)
+        if fn is None:
+            fn = self._build_serve(num_steps, int(eos_id),
+                                   bool(sampled), rng_key is not None)
+            self._cache[key] = fn
+        args = (params, state, pool) + (
+            (rng_key,) if rng_key is not None else ())
+        return fn(*args)
+
+    def _build_serve(self, num_steps, eos_id, sampled, has_rng):
+        config, tp, axis = self.config, self.tp, self.axis
+
+        def body(params, state, pool, rng_key=None):
+            block_size = pool[0]["k"].shape[1]
+            tables = state["tables"]
+            slots = tables.shape[0]
+            scratch_tables = jnp.zeros_like(tables)
+            scratch_positions = (jnp.arange(slots, dtype=jnp.int32)
+                                 % block_size)
+
+            def step_core(token, pool, positions, active):
+                write_tables = jnp.where(active[:, None], tables,
+                                         scratch_tables)
+                write_pos = jnp.where(active, positions,
+                                      scratch_positions)
+                return _tp_decode_core_paged(params, token, pool,
+                                             write_tables, write_pos,
+                                             config, tp, axis)
+
+            return llama._serve_scan(step_core, state, pool, num_steps,
+                                     eos_id, sampled, rng_key)
+
+        in_specs = (self._param_specs, P(), self._pool_specs)
+        if has_rng:
+            in_specs += (P(),)
+        out_specs = (P(), P(), P(), self._pool_specs)
+        return jax.jit(self._shard_map(body, in_specs, out_specs),
+                       donate_argnums=(2,))
+
+    # -- mixed prefill/decode chunk ------------------------------------ #
+
+    def serve_chunk_mixed(self, params, state, pool, prefill_tokens,
+                          prefill_row, prefill_start, num_steps,
+                          eos_id: int = -1, sampled: bool = False,
+                          rng_key=None, prefill_kv_limit=None):
+        """TP twin of :func:`llama.serve_chunk_mixed` (no LoRA)."""
+        num_steps = int(num_steps)
+        key = ("mixed", num_steps, int(eos_id), bool(sampled),
+               rng_key is not None, prefill_kv_limit)
+        fn = self._cache.get(key)
+        if fn is None:
+            fn = self._build_mixed(num_steps, int(eos_id),
+                                   bool(sampled), rng_key is not None,
+                                   prefill_kv_limit)
+            self._cache[key] = fn
+        args = (params, state, pool, prefill_tokens,
+                jnp.asarray(prefill_row, jnp.int32),
+                jnp.asarray(prefill_start, jnp.int32)) + (
+            (rng_key,) if rng_key is not None else ())
+        return fn(*args)
+
+    def _build_mixed(self, num_steps, eos_id, sampled, has_rng,
+                     prefill_kv_limit):
+        config, tp, axis = self.config, self.tp, self.axis
+
+        def body(params, state, pool, prefill_tokens, prefill_row,
+                 prefill_start, rng_key=None):
+            block_size = pool[0]["k"].shape[1]
+            tables = state["tables"]
+            slots = tables.shape[0]
+            tables_row = jax.lax.dynamic_slice_in_dim(
+                tables, prefill_row, 1, axis=0)
+            _, pool = _tp_prefill_append_core(
+                params, prefill_tokens, pool, tables_row,
+                prefill_start, config, tp, axis,
+                kv_limit=prefill_kv_limit, compute_logits=False)
+            scratch_tables = jnp.zeros_like(tables)
+            scratch_positions = (jnp.arange(slots, dtype=jnp.int32)
+                                 % block_size)
+
+            def step_core(token, pool, positions, active):
+                write_tables = jnp.where(active[:, None], tables,
+                                         scratch_tables)
+                write_pos = jnp.where(active, positions,
+                                      scratch_positions)
+                return _tp_decode_core_paged(params, token, pool,
+                                             write_tables, write_pos,
+                                             config, tp, axis)
+
+            return llama._serve_scan(step_core, state, pool, num_steps,
+                                     eos_id, sampled, rng_key)
+
+        in_specs = (self._param_specs, P(), self._pool_specs,
+                    P(), P(), P())
+        if has_rng:
+            in_specs += (P(),)
+        out_specs = (P(), P(), P(), self._pool_specs)
+        return jax.jit(self._shard_map(body, in_specs, out_specs),
+                       donate_argnums=(2,))
+
+    # -- standalone prefill append ------------------------------------- #
+
+    def prefill_append_paged(self, params, tokens, pool, tables,
+                             start_index, kv_limit=None,
+                             compute_logits: bool = False):
+        """TP twin of :func:`llama.prefill_append_paged` (no LoRA).
+        Always dispatched with ``compute_logits=False`` by the paged
+        server (the mixed step owns logits); returns ``(None,
+        new_pool)`` to match the llama call-site unpacking."""
+        if compute_logits:
+            raise NotImplementedError(
+                "TP prefill_append_paged serves the paged admission "
+                "path, which never reads prefill logits")
+        key = ("prefill", kv_limit)
+        fn = self._cache.get(key)
+        if fn is None:
+            fn = self._build_prefill(kv_limit)
+            self._cache[key] = fn
+        return None, fn(params, tokens, pool, tables,
+                        jnp.asarray(start_index, jnp.int32))
+
+    def _build_prefill(self, kv_limit):
+        config, tp, axis = self.config, self.tp, self.axis
+
+        def body(params, tokens, pool, tables, start_index):
+            _, new_pool = _tp_prefill_append_core(
+                params, tokens, pool, tables, start_index, config, tp,
+                axis, kv_limit=kv_limit, compute_logits=False)
+            return new_pool
+
+        in_specs = (self._param_specs, P(), self._pool_specs, P(), P())
+        out_specs = self._pool_specs
+        return jax.jit(self._shard_map(body, in_specs, out_specs),
+                       donate_argnums=(2,))

@@ -84,6 +84,9 @@ class ReplicaView:
     queue_depth: int = 0
     slots_active: int = 0
     deadline_exceeded: int = 0
+    #: chips this replica occupies (TP=k replica = k chips in the
+    #: capacity ledger; 1 = the single-chip replica).
+    tp_degree: int = 1
 
 
 @dataclasses.dataclass(frozen=True)
@@ -190,6 +193,10 @@ class ControllerState:
     #: slot -> quarantine release timestamp.
     quarantined: Dict[str, float] = dataclasses.field(
         default_factory=dict)
+    #: slot -> chip weight (TP degree) last seen in telemetry — kept
+    #: here so a draining or dead TP=4 replica still counts as 4
+    #: chips in the ledger after its telemetry stops.
+    chips: Dict[str, int] = dataclasses.field(default_factory=dict)
     breach_streak: int = 0
     clear_streak: int = 0
     last_scale_ts: Optional[float] = None
@@ -202,6 +209,7 @@ class ControllerState:
             deaths={slot: list(ts) for slot, ts in self.deaths.items()},
             backoff_until=dict(self.backoff_until),
             quarantined=dict(self.quarantined),
+            chips=dict(self.chips),
             breach_streak=self.breach_streak,
             clear_streak=self.clear_streak,
             last_scale_ts=self.last_scale_ts,
@@ -252,6 +260,7 @@ def decide(snapshot: FleetSnapshot, policy: AutoscalerPolicy,
     # -- adopt replicas spawned outside this controller ------------- #
     for view in snapshot.replicas:
         state.slots.setdefault(view.slot, view.role)
+        state.chips[view.slot] = max(1, int(view.tp_degree))
 
     # -- ingest deaths ---------------------------------------------- #
     for death in snapshot.deaths:
@@ -260,6 +269,7 @@ def decide(snapshot: FleetSnapshot, policy: AutoscalerPolicy,
             state.slots.pop(death.slot, None)
             state.deaths.pop(death.slot, None)
             state.backoff_until.pop(death.slot, None)
+            state.chips.pop(death.slot, None)
             continue
         history = state.deaths.setdefault(death.slot, [])
         history.append(death.ts)
@@ -354,7 +364,13 @@ def decide(snapshot: FleetSnapshot, policy: AutoscalerPolicy,
                 and slot not in state.quarantined]
         quarantined = [slot for slot in owned
                        if slot in state.quarantined]
-        eventual = len(owned) - len(draining)
+        # The ledger counts CHIPS, not replicas: a TP=k replica is k
+        # chips of capacity, so targets reconcile in chip units.  With
+        # every weight 1 (the TP=1 fleet) this is exactly the old
+        # replica count.
+        weight = lambda slot: state.chips.get(slot, 1)
+        eventual = sum(weight(slot) for slot in owned) \
+            - sum(weight(slot) for slot in draining)
 
         # Shrinking with dead surplus: forget down slots outright —
         # respawning capacity the target no longer wants just to
@@ -364,7 +380,8 @@ def decide(snapshot: FleetSnapshot, policy: AutoscalerPolicy,
             state.slots.pop(slot, None)
             state.backoff_until.pop(slot, None)
             state.deaths.pop(slot, None)
-            eventual -= 1
+            eventual -= weight(slot)
+            state.chips.pop(slot, None)
 
         # Self-healing: respawn dead owned slots once backoff expires.
         for slot in down:
@@ -388,9 +405,16 @@ def decide(snapshot: FleetSnapshot, policy: AutoscalerPolicy,
         # role — drains are deliberate, not avalanches.  A
         # quarantined slot pads the ledger against backfill but is NOT
         # serving capacity: it must never get a healthy replica
-        # drained on its behalf.
-        if eventual - len(quarantined) > target and live:
-            idlest = min(live, key=lambda slot: (
+        # drained on its behalf.  Surplus is measured in chips; prefer
+        # a replica that FITS the surplus (draining a TP=4 replica to
+        # shed one chip of excess overshoots by three), falling back
+        # to any live replica when none fits.
+        surplus = eventual - sum(weight(s) for s in quarantined) \
+            - target
+        if surplus > 0 and live:
+            fitting = [slot for slot in live
+                       if weight(slot) <= surplus] or live
+            idlest = min(fitting, key=lambda slot: (
                 alive[slot].queue_depth, alive[slot].slots_active,
                 slot))
             actions.append(Action("drain", idlest, role=role,
@@ -703,7 +727,8 @@ class FleetAutoscaler(Actor):
                 queue_depth=int(telemetry.get("queue_depth", 0)),
                 slots_active=int(telemetry.get("slots_active", 0)),
                 deadline_exceeded=int(
-                    telemetry.get("deadline_exceeded", 0))))
+                    telemetry.get("deadline_exceeded", 0)),
+                tp_degree=int(telemetry.get("tp_degree", 1) or 1)))
         shed = self._router_stats.get("shed", 0.0)
         redispatch = self._router_stats.get("redispatches", 0.0)
         shed_delta = max(0, int(shed - self._last_shed))

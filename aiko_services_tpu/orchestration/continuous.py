@@ -151,7 +151,7 @@ class ContinuousBatchingServer:
                  draft_params=None, spec_k: int = 4,
                  draft_quantize: bool = False, params=None,
                  max_queue: Optional[int] = None,
-                 watchdog_s: float = 0.0):
+                 watchdog_s: float = 0.0, replica_mesh=None):
         import jax
         import jax.numpy as jnp
         from ..models import llama
@@ -186,6 +186,38 @@ class ContinuousBatchingServer:
                 lambda leaf, spec: jax.device_put(
                     leaf, NamedSharding(mesh, spec)),
                 self.params, specs)
+        # Tensor-parallel replica: ONE replica owns ONE mesh.  Weights
+        # shard on their output-feature axis, the paged KV pool shards
+        # on the kv-head dimension, and the per-slot decode state stays
+        # replicated — the host admission/commit protocol is untouched.
+        # Collectives are all-gathers (pure data movement), so greedy
+        # decode is BITWISE equal to the single-chip server (tested).
+        self.replica_mesh = replica_mesh
+        self._mesh = None
+        self.tp_degree = 1
+        self.mesh_shape = ""
+        if replica_mesh is not None:
+            if mesh is not None:
+                raise ValueError(
+                    "mesh= (GSPMD megatron sharding) and replica_mesh= "
+                    "(shard_map TP engine) are distinct parallel "
+                    "paths; pass one")
+            if adapters or lora_config is not None:
+                raise ValueError(
+                    "replica_mesh does not compose with LoRA adapters "
+                    "yet: per-slot factor gathers are not sharded")
+            if draft_config_name is not None:
+                raise ValueError(
+                    "replica_mesh does not compose with speculative "
+                    "decoding yet: the draft cache is unsharded")
+            replica_mesh.validate(self.config)
+            from ..models import llama_tp
+            self._llama_tp = llama_tp
+            self._mesh = replica_mesh.build()
+            self.tp_degree = int(replica_mesh.tp)
+            self.mesh_shape = f"{replica_mesh.axis}={self.tp_degree}"
+            self.params = llama_tp.shard_params(
+                self.params, self._mesh, replica_mesh.axis)
         self.slots = slots
         # Row max_seq-1 is the inactive-slot scratch row (see
         # decode_chunk_ragged); a live request may use at most
@@ -330,6 +362,12 @@ class ContinuousBatchingServer:
         # decode loop performs ZERO host→device uploads.
         self._remaining = np.zeros(slots, np.int32)
         self._state = self._init_device_state()
+        if self._mesh is not None:
+            # Slot state (and the paged layout's block tables) must be
+            # REPLICATED jax.Arrays on the replica mesh so shard_map's
+            # P() in_specs see one consistent copy per shard.
+            self._state = self._llama_tp.replicate(self._state,
+                                                   self._mesh)
         # In-flight ring: results of dispatched-but-unconsumed chunks.
         # Depth max(2, lookahead) double-buffers by default: step t+1
         # launches while step t's tiny (tokens, counts, active) result
@@ -480,6 +518,14 @@ class ContinuousBatchingServer:
         self.cache = self._llama.init_cache(
             self.config, self.slots, self.max_seq,
             quantize_kv=self.quantize_kv)
+        if self._mesh is not None:
+            # Contiguous layout under a replica mesh: weights are
+            # sharded (output axis), cache/state replicated, and the
+            # existing jitted programs run under GSPMD — XLA inserts
+            # the activation all-gathers.  The paged layout instead
+            # uses the explicit shard_map TPEngine (pool sharding).
+            self.cache = self._llama_tp.replicate(self.cache,
+                                                  self._mesh)
 
         @functools.partial(jax.jit, donate_argnames=("cache",),
                            static_argnames=("padded",))
@@ -1355,6 +1401,8 @@ class ContinuousBatchingServer:
             slots_active=self.slots_active,
             free_slots=self.slots - self.slots_active,
             healthy=int(self.healthy),
+            tp_degree=self.tp_degree,
+            mesh_shape=self.mesh_shape,
             decode_attention_path=self.decode_attention_path,
             prefill_attention_path=self.prefill_attention_path,
             blocks_read_per_step=(
@@ -1429,6 +1477,9 @@ class ContinuousReplica(Actor):
         self._command_handlers["kv_export"] = self._wire_kv_export
         self._command_handlers["retire"] = self._wire_retire
         self.share["slots"] = self.server.slots
+        self.share["tp_degree"] = getattr(self.server, "tp_degree", 1)
+        self.share["mesh_shape"] = getattr(self.server, "mesh_shape",
+                                           "")
         self.share["requests_served"] = 0
         self._pumping = False
         #: Graceful drain in progress (``(retire)`` received): routers

@@ -75,7 +75,7 @@ class PagedContinuousServer(ContinuousBatchingServer):
                  params=None,
                  chunk_prefill_tokens: Optional[int] = None,
                  max_queue: Optional[int] = None,
-                 watchdog_s: float = 0.0):
+                 watchdog_s: float = 0.0, replica_mesh=None):
         self.block_size = block_size
         self._requested_blocks = total_blocks
         self.enable_prefix_cache = enable_prefix_cache
@@ -88,7 +88,8 @@ class PagedContinuousServer(ContinuousBatchingServer):
                          adapters=adapters, lora_config=lora_config,
                          params=params,
                          chunk_prefill_tokens=chunk_prefill_tokens,
-                         max_queue=max_queue, watchdog_s=watchdog_s)
+                         max_queue=max_queue, watchdog_s=watchdog_s,
+                         replica_mesh=replica_mesh)
 
     # ------------------------------------------------------------- #
     # Layout hooks
@@ -125,6 +126,19 @@ class PagedContinuousServer(ContinuousBatchingServer):
         self.pool = self._llama.init_paged_cache(
             self.config, usable + 1, block_size,
             quantize_kv=self.quantize_kv)            # +1: scratch
+        self._tp_engine = None
+        if self._mesh is not None:
+            # TP replica: the pool becomes a GLOBAL jax.Array sharded
+            # on its kv-head axis over the replica mesh; every model
+            # dispatch below routes through the shard_map TPEngine.
+            # Host-side block bookkeeping (tables, free lists, prefix
+            # index, transfer export/import) keeps operating on the
+            # full-width global view — jax resolves per-shard slices.
+            self.pool = self._llama_tp.shard_pool(
+                self.pool, self._mesh, self.replica_mesh.axis)
+            self._tp_engine = self._llama_tp.TPEngine(
+                self.config, self._mesh, self.params, self.pool,
+                axis=self.replica_mesh.axis)
         self.tables = np.zeros((self.slots, max_blocks), np.int32)
         self.total_blocks = usable
         self._free: List[int] = list(range(1, usable + 1))
@@ -488,10 +502,15 @@ class PagedContinuousServer(ContinuousBatchingServer):
             size = 1 << (remaining.bit_length() - 1)
             width = size * block_size
             chunk = prompt_padded[:, start:start + width]
-            _, self.pool = llama.prefill_append_paged(
-                self.params, jnp.asarray(chunk), self.pool,
-                tables_row, jnp.int32(start), self.config, lora=lora,
-                kv_limit=kv_limit, compute_logits=False)
+            if self._tp_engine is not None:
+                _, self.pool = self._tp_engine.prefill_append_paged(
+                    self.params, jnp.asarray(chunk), self.pool,
+                    tables_row, jnp.int32(start), kv_limit=kv_limit)
+            else:
+                _, self.pool = llama.prefill_append_paged(
+                    self.params, jnp.asarray(chunk), self.pool,
+                    tables_row, jnp.int32(start), self.config,
+                    lora=lora, kv_limit=kv_limit, compute_logits=False)
             self._note_prefill(width)
             start += width
             remaining -= size
@@ -553,11 +572,17 @@ class PagedContinuousServer(ContinuousBatchingServer):
             width = self._next_slice_width(state)
             chunk = state["prompt_padded"][:, start:start + width]
             tables_row = jnp.asarray(self.tables[slot:slot + 1])
-            _, self.pool = llama.prefill_append_paged(
-                self.params, jnp.asarray(chunk), self.pool,
-                tables_row, jnp.int32(start), self.config,
-                lora=self._request_lora(state["request"]),
-                kv_limit=state["kv_limit"], compute_logits=False)
+            if self._tp_engine is not None:
+                _, self.pool = self._tp_engine.prefill_append_paged(
+                    self.params, jnp.asarray(chunk), self.pool,
+                    tables_row, jnp.int32(start),
+                    kv_limit=state["kv_limit"])
+            else:
+                _, self.pool = llama.prefill_append_paged(
+                    self.params, jnp.asarray(chunk), self.pool,
+                    tables_row, jnp.int32(start), self.config,
+                    lora=self._request_lora(state["request"]),
+                    kv_limit=state["kv_limit"], compute_logits=False)
             state["start"] = start + width
             self._note_prefill(width)
             if state["start"] >= state["prompt_len"]:
@@ -613,23 +638,38 @@ class PagedContinuousServer(ContinuousBatchingServer):
         slot = next(iter(self._prefilling), None) \
             if self._prefilling else None
         if slot is None:
-            tokens_d, counts_d, new_state, self.pool = \
-                llama.serve_chunk_paged(
-                    self.params, state, self.pool, steps, self.config,
-                    eos_id=eos_id, sampled=sampled, rng_key=rng_key,
-                    lora_shared=lora_shared)
+            if self._tp_engine is not None:
+                tokens_d, counts_d, new_state, self.pool = \
+                    self._tp_engine.serve_chunk_paged(
+                        self.params, state, self.pool, steps,
+                        eos_id=eos_id, sampled=sampled,
+                        rng_key=rng_key)
+            else:
+                tokens_d, counts_d, new_state, self.pool = \
+                    llama.serve_chunk_paged(
+                        self.params, state, self.pool, steps,
+                        self.config, eos_id=eos_id, sampled=sampled,
+                        rng_key=rng_key, lora_shared=lora_shared)
             return tokens_d, counts_d, new_state
         prefill = self._prefilling[slot]
         start = prefill["start"]
         width = self._next_slice_width(prefill)
         chunk = prefill["prompt_padded"][:, start:start + width]
-        tokens_d, counts_d, new_state, self.pool = \
-            llama.serve_chunk_mixed(
-                self.params, state, self.pool, jnp.asarray(chunk),
-                jnp.int32(slot), jnp.int32(start), steps, self.config,
-                eos_id=eos_id, sampled=sampled, rng_key=rng_key,
-                lora_shared=lora_shared,
-                prefill_kv_limit=prefill["kv_limit"])
+        if self._tp_engine is not None:
+            tokens_d, counts_d, new_state, self.pool = \
+                self._tp_engine.serve_chunk_mixed(
+                    self.params, state, self.pool, jnp.asarray(chunk),
+                    jnp.int32(slot), jnp.int32(start), steps,
+                    eos_id=eos_id, sampled=sampled, rng_key=rng_key,
+                    prefill_kv_limit=prefill["kv_limit"])
+        else:
+            tokens_d, counts_d, new_state, self.pool = \
+                llama.serve_chunk_mixed(
+                    self.params, state, self.pool, jnp.asarray(chunk),
+                    jnp.int32(slot), jnp.int32(start), steps,
+                    self.config, eos_id=eos_id, sampled=sampled,
+                    rng_key=rng_key, lora_shared=lora_shared,
+                    prefill_kv_limit=prefill["kv_limit"])
         prefill["start"] = start + width
         self._note_prefill(width)
         if prefill["start"] >= prefill["prompt_len"]:

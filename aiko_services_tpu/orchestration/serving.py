@@ -73,7 +73,7 @@ TELEMETRY_KEYS = (
     "prefill_tokens_per_sec", "prefill_queue_depth",
     "prefill_attention_path",
     "deadline_exceeded", "shed", "watchdog_trips", "free_slots",
-    "healthy",
+    "healthy", "tp_degree", "mesh_shape",
 )
 
 

@@ -19,6 +19,7 @@ or the 8 virtual CPU devices used in tests via
 
 from __future__ import annotations
 
+import dataclasses
 import math
 from typing import Dict, Optional, Sequence, Tuple
 
@@ -29,6 +30,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 __all__ = [
     "MeshSpec", "make_mesh", "named_sharding", "shard_batch_spec",
     "logical_axis_rules", "filter_specs_for_mesh", "DEFAULT_AXES",
+    "ReplicaMesh",
 ]
 
 DEFAULT_AXES = ("dp", "tp")
@@ -73,6 +75,57 @@ class MeshSpec:
 
 def make_mesh(devices: Optional[Sequence] = None, **axes: int) -> Mesh:
     return MeshSpec(**axes).build(devices)
+
+
+@dataclasses.dataclass(frozen=True)
+class ReplicaMesh:
+    """One serving replica's device mesh: ``tp`` chips, one named
+    axis.  The serving tier's unit of capacity changes from "one chip"
+    to "one mesh" — the paged KV pool shards along the kv-head
+    dimension over ``axis``, model weights shard on their output
+    feature axis, and the per-slot decode state stays replicated so
+    the host-side admission/commit protocol is mesh-agnostic.
+
+    ``tp=1`` degenerates to the single-chip layout (a 1-device mesh).
+    """
+
+    tp: int = 1
+    axis: str = "tp"
+
+    def build(self, devices: Optional[Sequence] = None) -> Mesh:
+        devices = list(devices if devices is not None
+                       else jax.devices())
+        if self.tp < 1:
+            raise ValueError(f"tp must be >= 1, got {self.tp}")
+        if len(devices) < self.tp:
+            raise ValueError(
+                f"ReplicaMesh(tp={self.tp}) needs {self.tp} devices, "
+                f"have {len(devices)} (tests: set XLA_FLAGS="
+                "--xla_force_host_platform_device_count=8)")
+        array = np.asarray(devices[: self.tp])
+        return Mesh(array, (self.axis,))
+
+    def validate(self, config) -> None:
+        """Fail fast on layouts the TP engine cannot shard exactly.
+
+        Every sharded dimension must divide by ``tp``: kv heads (the
+        paged pool + attention grid), query heads (contiguous q-head
+        ranges must cover whole kv-head groups), d_model / d_ff /
+        vocab (output-axis weight sharding).  MoE expert weights are
+        3-D and stay outside the 2-D sharding rule, so MoE configs are
+        rejected outright."""
+        if getattr(config, "n_experts", 0):
+            raise ValueError(
+                "ReplicaMesh does not support MoE configs: expert "
+                "weights are 3-D and outside the output-axis sharding "
+                "rule")
+        for name in ("n_kv_heads", "n_heads", "d_model", "d_ff",
+                     "vocab_size"):
+            value = getattr(config, name)
+            if value % self.tp:
+                raise ValueError(
+                    f"ReplicaMesh(tp={self.tp}): config.{name}="
+                    f"{value} is not divisible by tp")
 
 
 def named_sharding(mesh: Mesh, *spec) -> NamedSharding:

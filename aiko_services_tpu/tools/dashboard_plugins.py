@@ -199,6 +199,13 @@ def model_replica_plugin(fields, variables) -> List[str]:
                      f"/{slots} active (continuous batching)")
         lines.append(f"  queued:    "
                      f"{_get(variables, 'queue_depth', default=0)}")
+        tp = _get(variables, "tp_degree", default=None)
+        if tp not in (None, "-", 0, 1, "1"):
+            mesh_shape = _get(variables, "mesh_shape", default="")
+            lines.append(
+                f"  mesh:      TP={tp}"
+                + (f" ({mesh_shape})"
+                   if mesh_shape not in (None, "-", "") else ""))
         steps_sec = _get(variables, "decode_steps_per_sec",
                          default=None)
         if steps_sec not in (None, "-"):

@@ -91,6 +91,10 @@ class LoadReport:
     #: request id — the double-delivery a drain/re-dispatch chaos run
     #: asserts is ZERO.
     duplicate_finals: int = 0
+    #: replica topic/name -> TP degree (chips per replica), attached
+    #: by the harness from fleet telemetry — per-chip efficiency needs
+    #: the chip count, not the replica count, as denominator.
+    replica_tp: Dict[str, int] = dataclasses.field(default_factory=dict)
 
     @property
     def lost(self) -> int:
@@ -205,6 +209,11 @@ class LoadReport:
                   if self.prefix_hit_rate is not None else "")
         kv = (f", kv_xfer={self.kv_transfer_bytes}B"
               if self.kv_transfer_bytes else "")
+        tp = ""
+        if any(degree > 1 for degree in self.replica_tp.values()):
+            tp = ", tp=" + "/".join(
+                f"{name}:{degree}" for name, degree
+                in sorted(self.replica_tp.items()))
         goodput = ""
         if self.slo_ttft_ms is not None:
             goodput = (f", goodput={self.goodput_rps:.1f} req/s"
@@ -219,7 +228,7 @@ class LoadReport:
                 f"{self.throughput_rps:.1f} req/s, "
                 f"{self.throughput_tps:.1f} tok/s, "
                 f"p50={self.p50_ms:.1f} ms, p99={self.p99_ms:.1f} ms"
-                f"{ttft}{goodput}{prefix}{kv}{attn})")
+                f"{ttft}{goodput}{prefix}{kv}{tp}{attn})")
 
 
 class LoadGenerator:

@@ -10,7 +10,8 @@ import os
 # sitecustomize hook, so plain env overrides are ignored; force the CPU
 # backend through jax.config (works post-import, pre-backend-init) and an
 # 8-device virtual host platform for mesh tests.
-xla_flags = os.environ.get("XLA_FLAGS", "")
+_ORIG_XLA_FLAGS = os.environ.get("XLA_FLAGS")
+xla_flags = _ORIG_XLA_FLAGS or ""
 if "xla_force_host_platform_device_count" not in xla_flags:
     os.environ["XLA_FLAGS"] = (
         xla_flags + " --xla_force_host_platform_device_count=8").strip()
@@ -18,8 +19,23 @@ if "xla_force_host_platform_device_count" not in xla_flags:
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+# Force backend init NOW (while the flag is set), then restore the
+# caller's XLA_FLAGS so subprocesses spawned by tests (bench probes,
+# CLI smoke runs) don't inherit the 8-device virtual platform.
+jax.devices()
+if _ORIG_XLA_FLAGS is None:
+    os.environ.pop("XLA_FLAGS", None)
+else:
+    os.environ["XLA_FLAGS"] = _ORIG_XLA_FLAGS
 
 import pytest  # noqa: E402
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "multichip: needs the virtual 8-device CPU mesh "
+        "(skipped when fewer devices are available)")
 
 #: Tests measured ≥4 s on the reference 1-core box (regenerate with
 #: ``pytest --durations=0`` and refresh this file).  They carry the
@@ -62,3 +78,13 @@ def engine():
     """Deterministic event engine driven by a virtual clock."""
     from aiko_services_tpu.runtime.event import EventEngine, VirtualClock
     return EventEngine(clock=VirtualClock())
+
+
+@pytest.fixture()
+def virtual_mesh_devices():
+    """The 8 virtual CPU devices ``multichip`` tests shard over;
+    skips (rather than fails) if the backend came up with fewer —
+    e.g. a stray XLA_FLAGS override from the invoking shell."""
+    if jax.device_count() < 8:
+        pytest.skip(f"needs 8 devices, have {jax.device_count()}")
+    return jax.devices()[:8]
