@@ -12,14 +12,18 @@ Digest wire format (the value of the ``kv_prefixes`` EC-share key,
 published on the replica's state topic):
 
     <block_size>;<role>;<entry>,<entry>,...
-    entry = <hex16>/<depth>/<refs>/<hotness>
+    entry = <hex16>/<depth>/<refs>/<hotness>[/<tier>]
 
 ``hex16`` is the first 8 bytes of the chain key (64 collision bits —
 ample for directory routing; the replica re-verifies full keys at
 export time).  ``depth`` is the entry's position in its chain (blocks
 of whole-prefix history it represents); ``refs``/``hotness`` are
-advisory load signals.  The format is S-expression-safe by
-construction: hex, digits, ``;,/`` only — no spaces or parens.
+advisory load signals.  ``tier`` is where the block's bytes live —
+0 = HBM (omitted on the wire: the pre-tier 4-field entry stays valid),
+1 = host RAM (a hit needs a restore upload before decode can read it,
+so the router prices it below an HBM hit but above a recompute).  The
+format is S-expression-safe by construction: hex, digits, ``;,/``
+only — no spaces or parens.
 
 Staleness is LEASE-based: each replica's advertisement expires
 ``lease_s`` after its last refresh (replicas re-advertise every pump
@@ -88,27 +92,40 @@ def shareable_blocks(prompt_len: int, block_size: int) -> int:
 
 
 def digest_encode(block_size: int, role: str,
-                  entries: Sequence[Tuple[str, int, int, int]]) -> str:
-    """``entries`` = [(hex16, depth, refs, hotness)] — already
-    selected/ordered by the replica (hottest, deepest first)."""
-    body = ",".join(f"{hex_key}/{depth}/{refs}/{hot}"
-                    for hex_key, depth, refs, hot in entries)
-    return f"{block_size};{role};{body}"
+                  entries: Sequence[Tuple]) -> str:
+    """``entries`` = [(hex16, depth, refs, hotness[, tier])] — already
+    selected/ordered by the replica (hottest, deepest first).  A
+    missing or zero tier (HBM) is omitted on the wire, so untiered
+    replicas keep emitting the 4-field format byte-for-byte."""
+    parts = []
+    for entry in entries:
+        hex_key, depth, refs, hot = entry[:4]
+        tier = entry[4] if len(entry) > 4 else 0
+        item = f"{hex_key}/{depth}/{refs}/{hot}"
+        if tier:
+            item += f"/{int(tier)}"
+        parts.append(item)
+    return f"{block_size};{role};{','.join(parts)}"
 
 
 def digest_decode(text: str):
-    """Returns ``(block_size, role, entries)`` or ``None`` on any
-    malformed input (directory updates are best-effort: a corrupt
-    advertisement is dropped, never raises into the router)."""
+    """Returns ``(block_size, role, entries)`` with 5-tuple entries
+    ``(hex16, depth, refs, hotness, tier)`` — tier defaults to 0 for
+    4-field (pre-tier) entries — or ``None`` on any malformed input
+    (directory updates are best-effort: a corrupt advertisement is
+    dropped, never raises into the router)."""
     try:
         block_text, role, body = str(text).split(";", 2)
         block_size = int(block_text)
         entries = []
         if body:
             for item in body.split(","):
-                hex_key, depth, refs, hot = item.split("/")
-                entries.append((hex_key, int(depth), int(refs),
-                                int(hot)))
+                fields = item.split("/")
+                if len(fields) not in (4, 5):
+                    return None
+                tier = int(fields[4]) if len(fields) == 5 else 0
+                entries.append((fields[0], int(fields[1]),
+                                int(fields[2]), int(fields[3]), tier))
         return block_size, role, entries
     except (TypeError, ValueError):
         return None
@@ -128,9 +145,9 @@ class PrefixDirectory:
 
     def __init__(self, lease_s: float = 30.0):
         self.lease_s = lease_s
-        #: replica -> {hex16 -> (depth, refs, hotness)}
-        self._by_replica: Dict[str, Dict[str, Tuple[int, int, int]]] \
-            = {}
+        #: replica -> {hex16 -> (depth, refs, hotness, tier)}
+        self._by_replica: \
+            Dict[str, Dict[str, Tuple[int, int, int, int]]] = {}
         self._expiry: Dict[str, float] = {}
         self._block_size: Dict[str, int] = {}
         self._role: Dict[str, str] = {}
@@ -146,8 +163,8 @@ class PrefixDirectory:
             return False
         block_size, role, entries = decoded
         self._by_replica[replica] = {
-            hex_key: (depth, refs, hot)
-            for hex_key, depth, refs, hot in entries}
+            hex_key: (depth, refs, hot, tier)
+            for hex_key, depth, refs, hot, tier in entries}
         self._block_size[replica] = block_size
         self._role[replica] = role
         self._expiry[replica] = now + self.lease_s
@@ -194,6 +211,22 @@ class PrefixDirectory:
                 return depth
         return 0
 
+    def matched_detail(self, replica: str, keys_hex: Sequence[str],
+                       now: float) -> Tuple[int, int]:
+        """``(depth, host_blocks)``: the :meth:`matched_blocks` depth
+        plus how many of the matched keys this replica advertises in
+        the HOST tier (restore-priced).  Matched ancestors the digest
+        cap dropped are assumed HBM — eviction is leaf-first, so a
+        chain demotes from its leaves and an unadvertised ancestor of
+        an HBM entry cannot sit in a colder tier than its child."""
+        depth = self.matched_blocks(replica, keys_hex, now)
+        if not depth:
+            return 0, 0
+        advertised = self._by_replica.get(replica, {})
+        host = sum(1 for key in keys_hex[:depth]
+                   if advertised.get(key, (0, 0, 0, 0))[3])
+        return depth, host
+
     def best_owner(self, keys_hex: Sequence[str], now: float,
                    exclude=()) -> Tuple[Optional[str], int]:
         """The unexpired replica holding the longest match (ties break
@@ -208,7 +241,7 @@ class PrefixDirectory:
             if not depth:
                 continue
             hot = self._by_replica[replica].get(
-                keys_hex[depth - 1], (0, 0, 0))[2]
+                keys_hex[depth - 1], (0, 0, 0, 0))[2]
             # sorted() order makes the final tie deterministic.
             if (depth, hot) > best[:2]:
                 best = (depth, hot, replica)

@@ -4,11 +4,19 @@ A replica→replica RPC body: the owner resolves directory-width hex
 keys through its full-key prefix index, gathers the table-resolved
 pool rows HOST-side (``np.asarray`` pulls; never inside a jitted
 program — the jaxpr guard in tests/test_kvstore.py pins this), and
-ships them as a swag-codec dict.  The importer allocates blocks from
-its own pool (evicting cold cached prefixes if needed — counted as
-spills), writes the rows back with one ``.at[blocks].set`` per layer
-buffer, and registers the chain keys in its prefix index under a
-lease, pinned until adopted by an admission or released at expiry.
+ships them as a swag-codec dict.  A chain demoted to the owner's
+host tier exports straight from its host rows — no promotion.  The
+importer allocates blocks from its own pool (evicting — demoting,
+when a host tier is configured — cold cached prefixes if needed),
+writes the rows back with one ``.at[blocks].set`` per layer buffer,
+and registers the chain keys in its prefix index under a lease,
+pinned until adopted by an admission or released at expiry.
+
+The same gather/scatter primitives back the TIERED KV cache:
+:func:`gather_block_rows` is the demotion copy (device→host),
+:func:`scatter_block_rows` the restore upload (host→device) — one
+codec, three movers (wire, demote, restore), so bit-exactness is
+proved once.
 
 Wire format (swag dict values; arrays ride the numpy codec tag):
 
@@ -64,7 +72,8 @@ import numpy as np
 from .directory import HEX_KEY_CHARS, chain_keys, shareable_blocks
 
 __all__ = ["pool_signature", "export_payload", "import_payload",
-           "payload_bytes", "seed_chain"]
+           "payload_bytes", "seed_chain", "gather_block_rows",
+           "scatter_block_rows"]
 
 _BF16 = "bfloat16"
 
@@ -101,25 +110,98 @@ def _unpack(array: np.ndarray, dtype_name: str,
     return array
 
 
+def _bucket_ids(blocks: List[int]) -> np.ndarray:
+    """Pad a block-id list to the next power of two by REPEATING the
+    last id.  Eager JAX compiles one gather/scatter executable per
+    operand shape; demote/restore batch sizes vary per admission, and
+    without bucketing every new size pays a ~100 ms compile — which
+    dwarfed the recompute the host tier saves.  Repeating an id is
+    shape-safe in both directions: gathered duplicates are sliced
+    off, scattered duplicates write the same row twice."""
+    ids = np.asarray(blocks, np.int32)
+    size = 1
+    while size < len(ids):
+        size *= 2
+    if size > len(ids):
+        ids = np.concatenate(
+            [ids, np.full(size - len(ids), ids[-1], np.int32)])
+    return ids
+
+
+def gather_block_rows(server, blocks: List[int]) -> Dict[str,
+                                                         np.ndarray]:
+    """Host copy of the pool rows for ``blocks``: ``{"l<i>_<name>":
+    (n_blocks, block_size, ...)}`` in the pool's native dtype (bf16
+    rows stay bf16, int8 rows keep their f32 scale planes — stored
+    bytes are the pool bytes verbatim, which is what makes demotion →
+    restore bit-exact).  Device-side row gather, THEN the host pull —
+    only the selected blocks cross; on a TP replica the gather
+    assembles full kv-head-width rows from every shard, exactly like
+    the wire format."""
+    count = len(blocks)
+    ids = server._jnp.asarray(_bucket_ids(blocks))
+    rows = {}
+    for layer, buffers in enumerate(server.pool):
+        for name, buf in buffers.items():
+            rows[f"l{layer}_{name}"] = np.asarray(buf[ids])[:count]
+    return rows
+
+
+def scatter_block_rows(server, blocks: List[int],
+                       rows: Dict[str, np.ndarray]) -> None:
+    """Write stacked host rows (the :func:`gather_block_rows` layout)
+    back into pool ``blocks`` — one batched ``.at[ids].set`` per layer
+    buffer, dispatched asynchronously like every other pool write.  On
+    a TP replica the written buffer is re-pinned to the pool's kv-head
+    sharding (the scatter of a replicated host array must not leave a
+    gathered copy behind)."""
+    jnp = server._jnp
+    count = len(blocks)
+    ids = jnp.asarray(_bucket_ids(blocks))
+    for layer, buffers in enumerate(server.pool):
+        written = {}
+        for name, buf in buffers.items():
+            data = np.asarray(rows[f"l{layer}_{name}"])
+            if len(ids) > count:
+                pad = np.repeat(data[-1:], len(ids) - count, axis=0)
+                data = np.concatenate([data, pad], axis=0)
+            new = buf.at[ids].set(jnp.asarray(data).astype(buf.dtype))
+            if getattr(buf, "sharding", None) is not None \
+                    and getattr(server, "_mesh", None) is not None:
+                new = server._jax.device_put(new, buf.sharding)
+            written[name] = new
+        server.pool[layer] = written
+
+
 def export_payload(server, keys_hex: List[str],
                    start_depth: int) -> Optional[Dict]:
     """Resolve ``keys_hex`` (a contiguous chain segment starting at
     depth ``start_depth + 1``) through the owner's prefix index and
-    gather the pool rows.  Returns the wire dict, or ``None`` when
-    the owner no longer holds a usable segment (evicted since it was
-    advertised, still producing, adapter-seeded, or depth drifted) —
-    the caller answers with an error and the importer falls back to
-    local prefill."""
+    gather the pool rows.  A key demoted to the owner's host tier is
+    served straight from its host rows — same bytes, no promotion, no
+    pool pressure on the owner.  Returns the wire dict, or ``None``
+    when the owner no longer holds a usable segment (evicted since it
+    was advertised, still producing, adapter-seeded, or depth
+    drifted) — the caller answers with an error and the importer
+    falls back to local prefill."""
     start_depth = int(start_depth)
+    host_tier = getattr(server, "_host", {})
     resolved: List[bytes] = []
-    blocks: List[int] = []
+    sources: List = []          # int pool block | host rows dict
     for offset, hex_key in enumerate(keys_hex):
         key = server._hex_key.get(str(hex_key)[:HEX_KEY_CHARS])
         if key is None:
             break
         block = server._index.get(key)
-        if block is None or block in server._producing:
-            break
+        if block is None:
+            entry = host_tier.get(key)
+            if entry is None:
+                break
+            source = entry["rows"]
+        elif block in server._producing:
+            break                      # content not landed yet
+        else:
+            source = block
         if server._depth.get(key) != start_depth + offset + 1:
             break                      # not the chain we advertised
         if server._key_seed.get(key, 0) != 0:
@@ -127,7 +209,7 @@ def export_payload(server, keys_hex: List[str],
         if resolved and server._parent.get(key) != resolved[-1]:
             break                      # chain discontinuity
         resolved.append(key)
-        blocks.append(block)
+        sources.append(source)
     if not resolved:
         return None
     parent = server._parent.get(resolved[0])
@@ -139,16 +221,22 @@ def export_payload(server, keys_hex: List[str],
         "kv_sig": pool_signature(server),
         "kv_dtype": np.dtype(server.pool[0]["k"].dtype).name,
     }
-    # Device-side row gather, THEN the host pull: only the selected
-    # blocks cross to host, and on a TP replica (kv-head-sharded pool)
-    # the gather assembles full-width rows from every shard — the wire
-    # format is always the full kv-head width, so replicas with
-    # DIFFERENT TP degrees exchange blocks without reshaping.
-    ids = server._jnp.asarray(np.asarray(blocks, np.int32))
+    # The wire format is always the full kv-head width (TP-agnostic);
+    # HBM rows gather through gather_block_rows, host rows splice in
+    # verbatim — both are the owner's pool bytes.
+    hbm = [source for source in sources if isinstance(source, int)]
+    gathered = gather_block_rows(server, hbm) if hbm else {}
     for layer, buffers in enumerate(server.pool):
-        for name, buf in buffers.items():
-            payload[f"kv_l{layer}_{name}"] = _pack(
-                np.asarray(buf[ids]))
+        for name in buffers:
+            field = f"l{layer}_{name}"
+            stacked, cursor = [], 0
+            for source in sources:
+                if isinstance(source, int):
+                    stacked.append(gathered[field][cursor])
+                    cursor += 1
+                else:
+                    stacked.append(source[field])
+            payload[f"kv_{field}"] = _pack(np.stack(stacked))
     return payload
 
 
@@ -204,43 +292,36 @@ def import_payload(server, payload: Dict, engine=None,
     needed = len(fresh)
     if needed > len(server._free) + len(server._evictable):
         return 0
-    evictions_before = server.prefix_evictions
-    server._evict_until(needed)
-    server.kv_spill_evictions += \
-        server.prefix_evictions - evictions_before
-    if needed > len(server._free):
-        return 0
-    blocks = [server._free.pop() for _ in range(needed)]
-
-    jnp = server._jnp
-    ids = jnp.asarray(np.asarray(blocks, np.int32))
+    # Validate + unpack EVERY layer's rows before touching the pool or
+    # the free list — an incomplete payload rejects with zero side
+    # effects (with a host tier, eviction demotes rather than deletes,
+    # so even the _evict_until below destroys nothing demotable).
     dtype_name = str(payload.get("kv_dtype", ""))
+    rows: Dict[str, np.ndarray] = {}
     for layer, buffers in enumerate(server.pool):
-        written = {}
         for name, buf in buffers.items():
             data = payload.get(f"kv_l{layer}_{name}")
             if data is None or data.shape[0] < offset + needed:
-                # Incomplete payload: roll the allocation back.
-                server._free.extend(blocks)
                 return 0
-            rows = _unpack(np.asarray(data)[offset:offset + needed],
-                           dtype_name, buf.dtype)
-            new = buf.at[ids].set(jnp.asarray(rows).astype(buf.dtype))
-            if getattr(buf, "sharding", None) is not None \
-                    and getattr(server, "_mesh", None) is not None:
-                # TP replica: re-pin the written buffer to the pool's
-                # kv-head sharding — the scatter above must not leave
-                # a replicated copy behind (the shard_map engine's
-                # in_specs expect the sharded layout, and a gathered
-                # pool would defeat the whole memory split).
-                new = server._jax.device_put(new, buf.sharding)
-            written[name] = new
-        server.pool[layer] = written
+            rows[f"l{layer}_{name}"] = _unpack(
+                np.asarray(data)[offset:offset + needed],
+                dtype_name, buf.dtype)
+    server._evict_until(needed)
+    if needed > len(server._free):
+        return 0
+    blocks = [server._free.pop() for _ in range(needed)]
+    scatter_block_rows(server, blocks, rows)
 
+    discard_host = getattr(server, "_host_discard", None)
     imported: List[bytes] = []
     for index, key in enumerate(fresh):
         block = blocks[index]
         depth = start_depth + offset + index + 1
+        if discard_host is not None:
+            # Freshly imported content supersedes any demoted copy of
+            # the same chain key (identical bytes by construction —
+            # the index must just never resolve one key both ways).
+            discard_host(key)
         server._index[key] = block
         server._block_key[block] = key
         server._refs[block] = 1
@@ -285,10 +366,13 @@ def seed_chain(server, tokens, adapter_id: int = 0) -> int:
     keys = chain_keys(tokens, block_size, adapter_id)[:n]
     registered = 0
     parent = None
+    discard_host = getattr(server, "_host_discard", None)
     for position, key in enumerate(keys):
         if key in server._index:
             parent = key
             continue
+        if discard_host is not None:
+            discard_host(key)
         server._evict_until(1)
         if not server._free:
             break

@@ -26,6 +26,19 @@ Static-shape TPU design (no dynamic allocation inside jit):
 * Block 0 is reserved scratch: unallocated table entries point at it
   and inactive slots write there; absolute-position masking keeps it
   unattendable.
+* **Tiered KV cache** (``host_tier_blocks > 0``): leaf-first eviction
+  DEMOTES zero-ref cached blocks to pinned host RAM (device→host copy
+  of the block rows via the transfer codec's gather) instead of
+  deleting them — the chain index keeps demoted chains addressable as
+  a HOST state.  A prefix hit against a demoted chain starts an
+  ASYNCHRONOUS restore: host rows promote back into freshly allocated
+  pool blocks a few per step (``restore_blocks_per_step``), riding the
+  same async-dispatch discipline as chunked admission, with
+  ``_producing``-style miss semantics until landed — decode never
+  stalls on a restore and never reads a half-landed chain
+  (ARCHITECTURE invariant 10).  All of it host-side bookkeeping: no
+  tier branch exists in any traced module (invariant 7, jaxpr/AST
+  pinned in tests/test_kv_tier.py).
 
 Greedy outputs exactly match the contiguous server and per-request
 ``generate_tokens`` (tested) — paging changes memory shape only.
@@ -46,6 +59,11 @@ from ..obs import steplog
 from .continuous import ContinuousBatchingServer
 
 __all__ = ["PagedContinuousServer"]
+
+#: ``_producing`` owner sentinel for blocks whose content is an
+#: in-flight host→device restore upload (real owners are slot ids
+#: ≥ 0, so no slot's cancel/finish path can ever claim these).
+RESTORING = -1
 
 
 class PagedContinuousServer(ContinuousBatchingServer):
@@ -75,10 +93,23 @@ class PagedContinuousServer(ContinuousBatchingServer):
                  params=None,
                  chunk_prefill_tokens: Optional[int] = None,
                  max_queue: Optional[int] = None,
-                 watchdog_s: float = 0.0, replica_mesh=None):
+                 watchdog_s: float = 0.0, replica_mesh=None,
+                 host_tier_blocks: Optional[int] = None,
+                 restore_blocks_per_step: int = 4):
         self.block_size = block_size
         self._requested_blocks = total_blocks
         self.enable_prefix_cache = enable_prefix_cache
+        #: Host-RAM demotion tier capacity in blocks (0/None disables
+        #: the tier — eviction deletes, the pre-tier behavior).  Host
+        #: rows are full kv-head width in the pool's native dtype, so
+        #: a block costs the same bytes as on device.
+        self.host_tier_blocks = int(host_tier_blocks or 0)
+        #: Restore upload rate: host→device blocks landed per engine
+        #: step (one batched scatter).  Bounds the per-step host work
+        #: so a long restore overlaps many decode dispatches instead
+        #: of stalling one.
+        self.restore_blocks_per_step = max(1,
+                                           int(restore_blocks_per_step))
         if chunk_prefill_tokens is None:
             chunk_prefill_tokens = self.DEFAULT_CHUNK_PREFILL_TOKENS
         super().__init__(config_name=config_name, slots=slots,
@@ -180,6 +211,25 @@ class PagedContinuousServer(ContinuousBatchingServer):
         self._depth: dict = {}
         self._key_hits: dict = {}
         self._imported_keys: set = set()
+        # Tiered KV cache (host-RAM demotion tier):
+        #   _host: chain key -> {"rows": {l<i>_<name>: (block_size,
+        #     ...) ndarray}, "nbytes": int} for every DEMOTED block,
+        #     insertion order = demotion order (leaf-first eviction
+        #     demotes children before parents, so overflow popping the
+        #     oldest entry always drops a chain's deepest remnant
+        #     first — host chains stay rooted).  A key is in _index
+        #     XOR _host, never both.  Demoted keys KEEP _depth,
+        #     _parent, _key_seed, _hex_key, _key_hits: the chain stays
+        #     addressable by hit walks, digests, and exports.
+        #   _restoring: [(key, block, rows)] host→device uploads
+        #     waiting for _advance_restores; the blocks are allocated,
+        #     indexed, ref-pinned, and _producing[block] = RESTORING.
+        #   _restored_keys: landed restores not yet adopted by an
+        #     admission — the first adoption counts prefix_hits_host
+        #     (mirrors _imported_keys / prefix_remote_hits).
+        self._host: "OrderedDict[bytes, dict]" = OrderedDict()
+        self._restoring: list = []
+        self._restored_keys: set = set()
         self.prefix_hits = 0
         self.prefix_misses = 0
         self.prefix_blocks_reused = 0
@@ -188,7 +238,10 @@ class PagedContinuousServer(ContinuousBatchingServer):
         self.kv_transfer_bytes = 0
         self.kv_transfer_ms = 0.0
         self.kv_transfer_failures = 0
-        self.kv_spill_evictions = 0
+        self.kv_demotions = 0
+        self.kv_restores = 0
+        self.kv_host_bytes = 0
+        self.prefix_hits_host = 0
 
     def _init_device_state(self):
         state = super()._init_device_state()
@@ -218,7 +271,12 @@ class PagedContinuousServer(ContinuousBatchingServer):
             kv_transfer_bytes=self.kv_transfer_bytes,
             kv_transfer_ms=round(self.kv_transfer_ms, 2),
             kv_transfer_failures=self.kv_transfer_failures,
-            kv_spill_evictions=self.kv_spill_evictions,
+            kv_demotions=self.kv_demotions,
+            kv_restores=self.kv_restores,
+            kv_host_blocks=len(self._host),
+            kv_host_bytes=self.kv_host_bytes,
+            restore_queue_depth=len(self._restoring),
+            prefix_hits_host=self.prefix_hits_host,
             free_blocks=self.free_blocks,
             total_blocks=self.total_blocks,
         )
@@ -299,16 +357,232 @@ class PagedContinuousServer(ContinuousBatchingServer):
         block per call instead of flushing a whole cached chain when a
         single block would do.  A leaf always exists: an evictable
         entry's indexed children are themselves evictable (owners of a
-        child own the whole prefix path)."""
+        child own the whole prefix path).
+
+        With a host tier configured, eviction DEMOTES instead of
+        deleting: the block's rows copy to host RAM and the chain key
+        stays addressable (restored on the next hit).  Adapter-seeded
+        chains still delete — their stacked indices are replica-local
+        and hot unload must be able to purge them synchronously."""
         for key, block in self._evictable.items():          # LRU order
             if self._children.get(key, 0) == 0:
-                self._purge_cached(key, block)
-                self.prefix_evictions += 1
+                if self.host_tier_blocks \
+                        and self._key_seed.get(key, 0) == 0:
+                    self._demote(key, block)
+                else:
+                    self._purge_cached(key, block)
+                    self.prefix_evictions += 1
                 return True
         return False
 
+    # ------------------------------------------------------------- #
+    # Tiered KV cache: host-RAM demotion tier + async restore.  ALL
+    # host-side bookkeeping — no method here runs inside, or changes,
+    # a traced serve-chunk program (jaxpr + AST guards in
+    # tests/test_kv_tier.py).
+
+    def _demote(self, key, block) -> None:
+        """Move one zero-ref cached block's rows to the host tier and
+        free its pool block.  The chain identity (_depth, _parent,
+        _key_seed, _hex_key, _key_hits) survives — only the HBM
+        binding drops.  The parent's indexed-children count decrements
+        (leaf-first order then demotes the parent next), and host
+        overflow discards the OLDEST demotion — a chain's deepest
+        remnant, so host chains stay rooted."""
+        rows = _kvxfer.gather_block_rows(self, [block])
+        self._demote_rows(key, block,
+                          {name: np.ascontiguousarray(stack[0])
+                           for name, stack in rows.items()})
+
+    def _demote_rows(self, key, block, row_dict) -> None:
+        entry = {"rows": row_dict}
+        entry["nbytes"] = sum(int(r.nbytes)
+                              for r in entry["rows"].values())
+        self._index.pop(key, None)
+        self._evictable.pop(key, None)
+        self._block_key.pop(block, None)
+        self._refs.pop(block, None)
+        parent = self._parent.get(key)
+        if parent is not None and parent in self._children:
+            self._children[parent] -= 1
+            if self._children[parent] <= 0:
+                del self._children[parent]
+        self._free.append(block)
+        self._host[key] = entry
+        self.kv_demotions += 1
+        self.kv_host_bytes += entry["nbytes"]
+        while len(self._host) > self.host_tier_blocks:
+            old_key, old_entry = self._host.popitem(last=False)
+            self._purge_host_entry(old_key, old_entry)
+
+    def _purge_host_entry(self, key, entry) -> None:
+        """A host-tier entry leaves the cache FOR GOOD (overflow):
+        now its chain identity goes too — this is the true eviction
+        the tier deferred."""
+        self.kv_host_bytes -= entry["nbytes"]
+        self.prefix_evictions += 1
+        self._depth.pop(key, None)
+        self._key_seed.pop(key, None)
+        self._key_hits.pop(key, None)
+        self._imported_keys.discard(key)
+        hex_key = key.hex()[:_kvdir.HEX_KEY_CHARS]
+        if self._hex_key.get(hex_key) == key:
+            del self._hex_key[hex_key]
+        self._parent.pop(key, None)
+        self._children.pop(key, None)
+
+    def _host_discard(self, key) -> None:
+        """Drop a host copy whose key is about to re-register in HBM
+        (recompute admission, import, or seed) — identical bytes by
+        construction, but a key must never resolve both ways.  Not an
+        eviction: the content lives on in the pool."""
+        entry = self._host.pop(key, None)
+        if entry is not None:
+            self.kv_host_bytes -= entry["nbytes"]
+
+    def _begin_restore(self, keys, shared) -> bool:
+        """Start an asynchronous promotion of the demoted tail of
+        ``keys`` (everything past the ``shared`` HBM prefix) back into
+        pool blocks.  Each host key registers under a freshly
+        allocated block with ``_producing[block] = RESTORING`` — hit
+        walks and exports treat it as a miss until the upload lands in
+        :meth:`_advance_restores` — and its rows queue for upload.
+
+        Returns True when the restore was queued (the caller DEFERS
+        the admission; the FIFO head retries and adopts the chain once
+        landed) or False when the pool cannot hold the segment right
+        now (the caller admits as a plain miss and recomputes — cold
+        but correct, and it cannot livelock)."""
+        segment = []
+        for position in range(len(shared), len(keys)):
+            # Pop host entries FIRST: the eviction below may demote
+            # more blocks, and an overflow purge must never race away
+            # rows we are about to upload.
+            entry = self._host.pop(keys[position], None)
+            if entry is None:
+                break
+            segment.append((position, keys[position], entry))
+        if not segment:
+            return False
+        # Pin the HBM prefix across the eviction (it must not demote
+        # out from under the chain we are rebuilding onto it).
+        for block in shared:
+            self._refs[block] += 1
+            self._evictable.pop(self._block_key[block], None)
+        needed = len(segment)
+        self._evict_until(needed)
+        fits = needed <= len(self._free)
+        blocks = [self._free.pop() for _ in range(needed)] \
+            if fits else []
+        for block in shared:
+            self._refs[block] -= 1
+            if self._refs[block] == 0:
+                self._evictable[self._block_key[block]] = block
+        if not fits:
+            for position, key, entry in segment:
+                self._host[key] = entry
+            return False
+        for (position, key, entry), block in zip(segment, blocks):
+            self._index[key] = block
+            self._block_key[block] = key
+            self._refs[block] = 1          # pinned until landed
+            self._producing[block] = RESTORING
+            if position > 0:
+                parent = keys[position - 1]
+                self._parent[key] = parent
+                self._children[parent] = \
+                    self._children.get(parent, 0) + 1
+            self.kv_host_bytes -= entry["nbytes"]
+            self._restoring.append((key, block, entry["rows"]))
+        return True
+
+    def _advance_restores(self) -> None:
+        """Land up to ``restore_blocks_per_step`` queued host→device
+        restore uploads as ONE batched scatter.  Called at the top of
+        every :meth:`step`, so the upload dispatch overlaps the decode
+        chunk that follows (async dispatch, same discipline as chunked
+        admission).  JAX program order makes the rows resident before
+        any later read of the buffer, so the _producing sentinel
+        clears immediately — a landed key is shareable the same step,
+        and a not-yet-landed key is still a miss: no reader ever sees
+        a half-landed chain."""
+        if not self._restoring:
+            return
+        batch = self._restoring[:self.restore_blocks_per_step]
+        del self._restoring[:len(batch)]
+        blocks = [block for _, block, _ in batch]
+        rows = {name: np.stack([entry_rows[name]
+                                for _, _, entry_rows in batch])
+                for name in batch[0][2]}
+        _kvxfer.scatter_block_rows(self, blocks, rows)
+        for key, block, _ in batch:
+            self._producing.pop(block, None)
+            self._refs[block] = 0
+            self._evictable[key] = block       # cached again, MRU
+            self._restored_keys.add(key)
+            self.kv_restores += 1
+
+    def step(self) -> List:
+        # Restores land BEFORE admission so a deferred head request
+        # adopts freshly landed chains this very step.
+        self._advance_restores()
+        return super().step()
+
+    def _select_victims(self, want: int) -> List:
+        """Leaf-first LRU victim selection WITHOUT touching the
+        index: repeatedly take the least-recently-used evictable
+        entry whose indexed children are all already selected —
+        selecting a leaf makes its parent selectable, so the order
+        is exactly what ``want`` sequential :meth:`_evict_one` calls
+        would produce."""
+        victims: List = []
+        taken = set()
+        pending: Dict = {}
+        while len(victims) < want:
+            picked = None
+            for key, block in self._evictable.items():   # LRU order
+                if key in taken:
+                    continue
+                if self._children.get(key, 0) \
+                        - pending.get(key, 0) == 0:
+                    picked = (key, block)
+                    break
+            if picked is None:
+                break
+            victims.append(picked)
+            taken.add(picked[0])
+            parent = self._parent.get(picked[0])
+            if parent is not None:
+                pending[parent] = pending.get(parent, 0) + 1
+        return victims
+
     def _evict_until(self, needed: int) -> None:
-        while len(self._free) < needed:
+        """Free pool blocks until ``needed`` are available.
+        Demotions are BATCHED: victims are selected up front and
+        their rows leave the device in ONE gather — per-block
+        gathers cost a device sync each, ~24 of them per admission
+        under longtail churn, and that per-step tax was bigger than
+        the recompute the tier saves."""
+        want = needed - len(self._free)
+        if want <= 0:
+            return
+        demote = []
+        for key, block in self._select_victims(want):
+            if self.host_tier_blocks \
+                    and self._key_seed.get(key, 0) == 0:
+                demote.append((key, block))
+            else:
+                self._purge_cached(key, block)
+                self.prefix_evictions += 1
+        if demote:
+            rows = _kvxfer.gather_block_rows(
+                self, [block for _, block in demote])
+            for position, (key, block) in enumerate(demote):
+                self._demote_rows(
+                    key, block,
+                    {name: np.ascontiguousarray(stack[position])
+                     for name, stack in rows.items()})
+        while len(self._free) < needed:    # selection fell short
             if not self._evict_one():
                 break
 
@@ -330,16 +604,36 @@ class PagedContinuousServer(ContinuousBatchingServer):
             keys = self._chain_keys(
                 prompt, adapter_id)[
                 :self._shareable_blocks(len(prompt))]
+            restore_host = restore_wait = False
             for key in keys:
                 block = self._index.get(key)
-                if block is None or block in self._producing:
+                if block is None:
+                    # A demoted continuation: restore it instead of
+                    # recomputing work the host tier still holds.
+                    restore_host = bool(self.host_tier_blocks) \
+                        and key in self._host
+                    break
+                if block in self._producing:
                     # In-flight chunked prefills register their keys
                     # at reservation but write content slice by slice
                     # — sharing before the content lands would read
                     # zeros.  Treated as a miss; shareable again once
-                    # the producer finishes.
+                    # the producer finishes.  A RESTORING block is
+                    # this chain's own promotion still landing: WAIT
+                    # for it (it lands within queue/rate steps) —
+                    # admitting now would recompute the very blocks in
+                    # flight.
+                    restore_wait = self._producing[block] == RESTORING
                     break
                 shared.append(block)
+            if restore_wait:
+                return False       # defer: restore lands next steps
+            if restore_host and self._begin_restore(keys, shared):
+                # Defer WITHOUT pinning anything: the queue head
+                # retries each step and adopts the chain once landed.
+                # Decode in other slots keeps running throughout —
+                # the restore rides _advance_restores, never a stall.
+                return False
             # Every found block is used: _append_prefill bounds the
             # compile count by DECOMPOSING the uncached tail into
             # descending power-of-two pieces, so arbitrary prefix
@@ -386,6 +680,13 @@ class PagedContinuousServer(ContinuousBatchingServer):
                 # warm start the kvstore transfer exists for.
                 self.prefix_remote_hits += 1
                 self._imported_keys.difference_update(adopted)
+            restored = [key for key in keys[:len(shared)]
+                        if key in self._restored_keys]
+            if restored:
+                # First adoption of blocks that came back from the
+                # host tier: the hit the demotion preserved.
+                self.prefix_hits_host += 1
+                self._restored_keys.difference_update(restored)
             for key in keys[:len(shared)]:
                 self._key_hits[key] = self._key_hits.get(key, 0) + 1
         elif keys:
@@ -406,6 +707,11 @@ class PagedContinuousServer(ContinuousBatchingServer):
                 key = keys[position]
                 if key in self._index:
                     continue
+                # Recomputing a chain the host tier still holds (the
+                # restore could not fit): the fresh registration
+                # supersedes the demoted copy — identical bytes, but
+                # one key must never resolve both ways.
+                self._host_discard(key)
                 block = blocks[position]
                 self._index[key] = block
                 self._block_key[block] = key
@@ -687,7 +993,9 @@ class PagedContinuousServer(ContinuousBatchingServer):
         blocks for the cluster directory: content-complete (not
         producing), base-adapter chains only, hottest + deepest first,
         capped at ``max_entries`` (the EC share rides MQTT control
-        topics — the digest must stay small)."""
+        topics — the digest must stay small).  Host-tier entries
+        advertise with ``tier=1`` so the router prices the restore:
+        below an HBM hit, above a recompute."""
         entries = []
         for key, block in self._index.items():
             if block in self._producing:
@@ -697,7 +1005,11 @@ class PagedContinuousServer(ContinuousBatchingServer):
             entries.append((key.hex()[:_kvdir.HEX_KEY_CHARS],
                             self._depth.get(key, 0),
                             self._refs.get(block, 0),
-                            self._key_hits.get(key, 0)))
+                            self._key_hits.get(key, 0), 0))
+        for key in self._host:
+            entries.append((key.hex()[:_kvdir.HEX_KEY_CHARS],
+                            self._depth.get(key, 0), 0,
+                            self._key_hits.get(key, 0), 1))
         entries.sort(key=lambda e: (-e[3], -e[1], e[0]))
         return _kvdir.digest_encode(self.block_size, role,
                                     entries[:max_entries])
@@ -710,12 +1022,16 @@ class PagedContinuousServer(ContinuousBatchingServer):
     def prefix_local_depth(self, prompt) -> int:
         """Longest locally-cached, content-complete prefix of
         ``prompt`` in blocks — what a warm-start fetch may SKIP
-        requesting from the owner."""
+        requesting from the owner.  Host-tier blocks count as local:
+        a restore beats a wire transfer of the same bytes."""
         depth = 0
         for key in self._chain_keys(np.asarray(prompt))[
                 :self._shareable_blocks(len(np.asarray(prompt)))]:
             block = self._index.get(key)
-            if block is None or block in self._producing:
+            if block is None:
+                if key not in self._host:
+                    break
+            elif block in self._producing:
                 break
             depth += 1
         return depth

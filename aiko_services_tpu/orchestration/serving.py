@@ -68,7 +68,9 @@ TELEMETRY_KEYS = (
     "admission_deferred", "state_uploads", "tokens_committed",
     "prefix_hits", "prefix_misses", "prefix_evictions",
     "prefix_remote_hits", "kv_transfer_bytes", "kv_transfer_ms",
-    "kv_transfer_failures", "kv_spill_evictions",
+    "kv_transfer_failures", "kv_demotions", "kv_restores",
+    "kv_host_blocks", "kv_host_bytes", "restore_queue_depth",
+    "prefix_hits_host",
     "decode_attention_path", "blocks_read_per_step",
     "prefill_tokens_per_sec", "prefill_queue_depth",
     "prefill_attention_path",
@@ -172,6 +174,7 @@ class ReplicaRouter(Actor):
                  backoff_cap_s: float = 2.0,
                  max_redispatch: int = 4, seed: int = 0,
                  prefix_alpha: float = 1.0,
+                 host_prefix_weight: float = 0.5,
                  kv_transfer: bool = False,
                  disaggregate: bool = False,
                  directory_lease_s: float = 30.0):
@@ -192,6 +195,13 @@ class ReplicaRouter(Actor):
         #: PR-4 behavior); with no directory match the route falls
         #: back to exact P2C regardless.
         self.prefix_alpha = prefix_alpha
+        #: Value of a HOST-tier matched block relative to an HBM one
+        #: (tiered KV cache): an advertised block that needs a restore
+        #: upload before decode can read it scores ``host_prefix_weight
+        #: · prefix_alpha`` instead of ``prefix_alpha``.  The default
+        #: 0.5 prices a restore below an HBM hit but well above a
+        #: recompute (weight 0); 1.0 ignores tier entirely.
+        self.host_prefix_weight = host_prefix_weight
         #: Attach ``kv_source`` warm-start hints when the prefix
         #: owner is not the chosen target (opt-in: transfers cost
         #: wire bytes; prefix AFFINITY alone is free).
@@ -238,7 +248,7 @@ class ReplicaRouter(Actor):
         self.counters: Dict[str, int] = CounterDict(dict(
             redispatches=0, replica_deaths_observed=0, shed=0,
             deadline_exceeded=0, cancel_unrouted=0,
-            prefix_routed=0, kv_remote_hints=0),
+            prefix_routed=0, prefix_routed_host=0, kv_remote_hints=0),
             prefix="router", labels={"actor": self.name})
         self.share["replicas"] = 0
         self.share["replicas_retiring"] = 0
@@ -501,11 +511,15 @@ class ReplicaRouter(Actor):
                 for bs in sizes if bs}
 
     def _pick_prefix(self, candidates: List[str], payload):
-        """Score ``queue_depth − α·matched_prefix_blocks`` (lower
-        wins; ties break by replica order for determinism).  Returns
-        ``(target, owner, owner_matched, target_matched)`` or None
-        when nothing matches — the caller falls back to EXACT P2C, so
-        fleets without paged prefix caches see PR-4 routing
+        """Score ``queue_depth − α·effective_matched_blocks`` (lower
+        wins; ties break by replica order for determinism), where a
+        matched block advertised in the HOST tier contributes
+        ``host_prefix_weight`` of an HBM block — a restore is cheaper
+        than a recompute but dearer than a resident hit, and the
+        placement decision should reflect that.  Returns ``(target,
+        owner, owner_matched, target_matched, target_host_matched)``
+        or None when nothing matches — the caller falls back to EXACT
+        P2C, so fleets without paged prefix caches see PR-4 routing
         unchanged."""
         if self.prefix_alpha <= 0 or not payload \
                 or not self.directory.size:
@@ -514,21 +528,28 @@ class ReplicaRouter(Actor):
         if not keys_by_bs:
             return None
         now = self.process.event.now()
-        matched = {}
+        matched, host = {}, {}
         for replica in candidates:
             keys = keys_by_bs.get(self.directory.block_size(replica))
-            matched[replica] = self.directory.matched_blocks(
-                replica, keys, now) if keys else 0
+            matched[replica], host[replica] = \
+                self.directory.matched_detail(replica, keys, now) \
+                if keys else (0, 0)
         if not any(matched.values()):
             return None
 
+        def effective(replica):
+            return matched[replica] - \
+                (1.0 - self.host_prefix_weight) * host[replica]
+
         def score(replica):
             depth = self._loads.get(replica, {}).get("queue_depth", 0)
-            return depth - self.prefix_alpha * matched[replica]
+            return depth - self.prefix_alpha * effective(replica)
 
         target = min(candidates, key=lambda r: (score(r), r))
-        owner = max(candidates, key=lambda r: (matched[r], r))
-        return target, owner, matched[owner], matched[target]
+        owner = max(candidates,
+                    key=lambda r: (effective(r), matched[r], r))
+        return (target, owner, matched[owner], matched[target],
+                host[target])
 
     def _saturated(self, candidates: List[str]) -> bool:
         """True only when EVERY candidate reports a queue at or past
@@ -591,8 +612,13 @@ class ReplicaRouter(Actor):
             target = self._pick(decode)
             owner = owner_matched = target_matched = None
         else:
-            target, owner, owner_matched, target_matched = picked
+            (target, owner, owner_matched, target_matched,
+             target_host) = picked
             self._bump("prefix_routed")
+            if target_host:
+                # The chosen target's match includes demoted blocks —
+                # this request will trigger (or ride) a restore there.
+                self._bump("prefix_routed_host")
         send_payload = payload or {}
         phase = "decode"
         if self.kv_transfer and owner is not None \

@@ -252,9 +252,21 @@ def model_replica_plugin(fields, variables) -> List[str]:
                 f"{xfer_bytes or 0} B in "
                 f"{_get(variables, 'kv_transfer_ms', default=0)} ms, "
                 f"{_get(variables, 'kv_transfer_failures', default=0)}"
-                f" failed, "
-                f"{_get(variables, 'kv_spill_evictions', default=0)}"
-                f" spills")
+                f" failed")
+        host_blocks = _get(variables, "kv_host_blocks", default=None)
+        demotions = _get(variables, "kv_demotions", default=None)
+        if host_blocks not in (None, "-") or \
+                demotions not in (None, "-", 0):
+            lines.append(
+                f"  kv tier:   {host_blocks or 0} host blocks "
+                f"({_get(variables, 'kv_host_bytes', default=0)} B), "
+                f"{demotions or 0} demoted / "
+                f"{_get(variables, 'kv_restores', default=0)}"
+                f" restored, "
+                f"{_get(variables, 'restore_queue_depth', default=0)}"
+                f" restoring, "
+                f"{_get(variables, 'prefix_hits_host', default=0)}"
+                f" host hits")
     adapters = _get(variables, "adapters", default=None)
     if adapters not in (None, "-", ""):
         lines.append(f"  adapters:  {adapters}")
@@ -322,10 +334,16 @@ def replica_router_plugin(fields, variables) -> List[str]:
         lines.append(f"  cancels:    {unrouted} unrouted")
     directory = _get(variables, "kv_directory_size", default=None)
     if directory not in (None, "-"):
+        routed_host = _get(variables, "prefix_routed_host", default=0)
+        routed = _get(variables, "prefix_routed", default=0)
+        try:
+            hbm_routed = int(routed) - int(routed_host)
+        except (TypeError, ValueError):
+            hbm_routed = routed
         lines.append(
             f"  kv dir:     {directory} advertised blocks, "
-            f"{_get(variables, 'prefix_routed', default=0)}"
-            f" prefix-routed, "
+            f"{routed}"
+            f" prefix-routed ({hbm_routed} hbm / {routed_host} host), "
             f"{_get(variables, 'kv_remote_hints', default=0)}"
             f" transfer hints")
     fleet_lines = []

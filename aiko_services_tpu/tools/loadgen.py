@@ -68,6 +68,12 @@ class LoadReport:
     #: replicas; None when the fleet has no prefix caches) — attached
     #: by the harness from server stats, like ``server_stats``.
     prefix_hit_rate: Optional[float] = None
+    #: Fraction of prefix HITS that adopted blocks restored from the
+    #: host tier (``Σ prefix_hits_host / Σ prefix_hits``; None when
+    #: the fleet has no host tier or took no hits) — the tiered-KV
+    #: number the longtail workload reports: hits the HBM pool alone
+    #: would have lost.
+    prefix_hit_rate_host: Optional[float] = None
     #: Total cross-replica KV bytes moved during the run (Σ replica
     #: ``kv_transfer_bytes`` deltas).
     kv_transfer_bytes: int = 0
@@ -95,6 +101,12 @@ class LoadReport:
     #: by the harness from fleet telemetry — per-chip efficiency needs
     #: the chip count, not the replica count, as denominator.
     replica_tp: Dict[str, int] = dataclasses.field(default_factory=dict)
+    #: request id -> final token list as delivered on the wire,
+    #: attached by the harness — lets A/B runs over the same seeded
+    #: payload sequence assert BIT-EXACT outputs (e.g. tier-on chaos
+    #: vs tier-off chaos must produce identical greedy tokens).
+    final_tokens: Dict[str, List[int]] = dataclasses.field(
+        default_factory=dict)
 
     @property
     def lost(self) -> int:
@@ -207,6 +219,8 @@ class LoadReport:
             if self.error_kinds else "")
         prefix = (f", prefix_hit={self.prefix_hit_rate:.0%}"
                   if self.prefix_hit_rate is not None else "")
+        if self.prefix_hit_rate_host is not None:
+            prefix += f" ({self.prefix_hit_rate_host:.0%} via host tier)"
         kv = (f", kv_xfer={self.kv_transfer_bytes}B"
               if self.kv_transfer_bytes else "")
         tp = ""
@@ -626,14 +640,30 @@ def fleet_latency(servers) -> Dict[str, Dict[str, float]]:
 
 
 def _fleet_kv_stats(servers) -> Dict:
-    """Aggregate the kvstore counters a shared-prefix run reports."""
+    """Aggregate the kvstore + tier counters a shared-prefix or
+    longtail run reports."""
     totals = dict(prefix_hits=0, prefix_misses=0, kv_transfer_bytes=0,
-                  prefix_remote_hits=0, kv_transfer_failures=0)
+                  prefix_remote_hits=0, kv_transfer_failures=0,
+                  kv_demotions=0, kv_restores=0, kv_host_blocks=0,
+                  kv_host_bytes=0, restore_queue_depth=0,
+                  prefix_hits_host=0)
     for server in servers:
         stats = server.stats()
         for key in totals:
             totals[key] += int(stats.get(key, 0))
     return totals
+
+
+def _attach_kv_rates(report: LoadReport, totals: Dict) -> None:
+    """Derive the report's hit-rate fields from fleet totals."""
+    lookups = totals["prefix_hits"] + totals["prefix_misses"]
+    if lookups:
+        report.prefix_hit_rate = totals["prefix_hits"] / lookups
+    if totals["prefix_hits"] and (totals["kv_demotions"]
+                                  or totals["prefix_hits_host"]):
+        report.prefix_hit_rate_host = \
+            totals["prefix_hits_host"] / totals["prefix_hits"]
+    report.kv_transfer_bytes = totals["kv_transfer_bytes"]
 
 
 def run_shared_prefix(n_requests: int = 24, rate_hz: float = 50.0,
@@ -716,10 +746,7 @@ def run_shared_prefix(n_requests: int = 24, rate_hz: float = 50.0,
         report = generator.run(n_requests,
                                drain_timeout_s=drain_timeout_s)
         totals = _fleet_kv_stats(servers)
-        lookups = totals["prefix_hits"] + totals["prefix_misses"]
-        if lookups:
-            report.prefix_hit_rate = totals["prefix_hits"] / lookups
-        report.kv_transfer_bytes = totals["kv_transfer_bytes"]
+        _attach_kv_rates(report, totals)
         report.fleet_latency_ms = fleet_latency(servers)
         report.server_stats = dict(
             router.counters, **totals,
@@ -730,6 +757,143 @@ def run_shared_prefix(n_requests: int = 24, rate_hz: float = 50.0,
     finally:
         if tracing:
             trace.uninstall()
+        if generator is not None:
+            generator.close()
+        for process in reversed(processes):
+            try:
+                process.terminate()
+            except Exception:  # noqa: BLE001 - teardown best-effort
+                pass
+        engine.terminate()
+        thread.join(timeout=5)
+
+
+def longtail_payloads(n_prefixes: int = 8, prefix_len: int = 96,
+                      tail_len: int = 8, max_new_tokens: int = 4,
+                      vocab: int = 1024, seed: int = 0,
+                      stream: bool = True) -> Callable[[int], Dict]:
+    """Long-tail prefix workload: ``n_prefixes`` DISTINCT shared
+    prefixes visited round-robin, each request re-sending its prefix
+    plus ``tail_len`` fresh tokens.  The reuse distance is therefore
+    ``n_prefixes`` requests — size the prefix working set
+    (``n_prefixes × prefix_len/block_size`` blocks) past the HBM pool
+    and an HBM-only cache thrashes (every hit evicted before its
+    reuse), while a host tier holds the whole tail and serves it back
+    through restores.  Deterministic from ``seed``."""
+    import numpy as np
+
+    rng = np.random.RandomState(seed)
+    prefixes = [rng.randint(1, vocab, size=prefix_len).astype(np.int32)
+                for _ in range(n_prefixes)]
+
+    def payload_fn(index: int) -> Dict:
+        which = index % n_prefixes
+        tail = np.asarray(
+            [1 + (7919 * (index + 1) + 31 * position) % (vocab - 1)
+             for position in range(tail_len)], np.int32)
+        payload = {"tokens": np.concatenate([prefixes[which], tail]),
+                   "max_new_tokens": max_new_tokens}
+        if stream:
+            payload["stream"] = 1
+        return payload
+
+    return payload_fn
+
+
+def run_longtail(n_requests: int = 36, rate_hz: float = 25.0,
+                 n_prefixes: int = 6, prefix_len: int = 384,
+                 tail_len: int = 8,
+                 total_blocks: int = 52,
+                 host_tier_blocks: int = 160,
+                 restore_blocks_per_step: int = 24,
+                 chunk_prefill_tokens: int = 64,
+                 warmup_requests: int = 12,
+                 drain_timeout_s: float = 180.0,
+                 seed: int = 0) -> LoadReport:
+    """Capacity A/B rig for the tiered KV cache: ONE paged replica
+    whose HBM pool (``total_blocks``) is deliberately smaller than the
+    longtail workload's prefix working set, behind a prefix-aware
+    router.  ``host_tier_blocks=0`` is the HBM-only baseline — same
+    pool, same workload, eviction deletes.  The tier-on run must beat
+    it on BOTH ``prefix_hit_rate`` and mean TTFT (the capacity gate in
+    tests/test_kv_tier.py; numbers in bench.py's ``kv_tier``
+    section).  The report's ``prefix_hit_rate_host`` says how many of
+    the hits only existed because demotion preserved them.
+
+    Default sizing makes restore beat recompute in STEPS, which is
+    what TTFT measures on any backend: a 384-token prefix is 24
+    blocks, so a miss re-prefills 6 chunks of ``chunk_prefill_tokens``
+    = 64 while a host hit defers one step, lands the whole chain in
+    one batched scatter (``restore_blocks_per_step=24``) and prefills
+    only the tail."""
+    from ..orchestration.continuous import ContinuousReplica
+    from ..orchestration.paged import PagedContinuousServer
+    from ..orchestration.serving import ReplicaRouter
+    from ..registry import Registrar
+    from ..runtime import Process, actor_args, compose_instance
+    from ..runtime.event import EventEngine
+
+    def wait_for(predicate, timeout_s: float, what: str):
+        deadline = time.time() + timeout_s
+        while not predicate():
+            if time.time() > deadline:
+                raise TimeoutError(f"longtail rig: {what}")
+            time.sleep(0.02)
+
+    engine = EventEngine()
+    thread = engine.run_in_thread()
+    broker = f"longtail-{uuid.uuid4().hex[:6]}"
+    processes = []
+
+    def make_process(pid):
+        process = Process(namespace="longtail", hostname="h",
+                          pid=str(pid), engine=engine, broker=broker)
+        processes.append(process)
+        return process
+
+    generator = None
+    try:
+        registrar = Registrar(process=make_process(1))
+        wait_for(lambda: registrar.state == "primary", 10,
+                 "registrar primary")
+        prompt_len = prefix_len + tail_len
+        max_seq = ((prompt_len + 8 + 15) // 16) * 16
+        server = PagedContinuousServer(
+            config_name="tiny", slots=2, max_seq=max_seq,
+            chunk_steps=4, seed=0, enable_prefix_cache=True,
+            total_blocks=total_blocks,
+            host_tier_blocks=host_tier_blocks,
+            restore_blocks_per_step=restore_blocks_per_step,
+            chunk_prefill_tokens=chunk_prefill_tokens,
+            max_queue=256, watchdog_s=10.0)
+        compose_instance(ContinuousReplica, actor_args("replica_a"),
+                         process=make_process(2), server=server)
+        router = compose_instance(ReplicaRouter, actor_args("router"),
+                                  process=make_process(8))
+        wait_for(lambda: router.share["replicas"] == 1, 30,
+                 "router discovery")
+        generator = LoadGenerator(
+            make_process(9), f"{router.topic_path}/in",
+            payload_fn=longtail_payloads(
+                n_prefixes=n_prefixes, prefix_len=prefix_len,
+                tail_len=tail_len, seed=seed),
+            rate_hz=rate_hz)
+        if warmup_requests:
+            # Same payload sequence both arms see in the measured
+            # run: compiles every serve/gather/scatter shape and
+            # brings each arm to ITS steady state (tier-on: working
+            # set demoted to host; tier-off: pool thrashed) so the
+            # A/B measures serving, not first-touch compilation.
+            generator.run(warmup_requests,
+                          drain_timeout_s=drain_timeout_s)
+        report = generator.run(n_requests,
+                               drain_timeout_s=drain_timeout_s)
+        totals = _fleet_kv_stats([server])
+        _attach_kv_rates(report, totals)
+        report.fleet_latency_ms = fleet_latency([server])
+        report.server_stats = dict(router.counters, **totals)
+        return report
+    finally:
         if generator is not None:
             generator.close()
         for process in reversed(processes):
@@ -766,7 +930,10 @@ def chaos_schedule(seed: int):
 
 def run_chaos(seed: int = 0, n_requests: int = 40,
               rate_hz: float = 100.0,
-              drain_timeout_s: float = 90.0) -> LoadReport:
+              drain_timeout_s: float = 90.0,
+              total_blocks: Optional[int] = None,
+              host_tier_blocks: int = 0,
+              restore_blocks_per_step: int = 2) -> LoadReport:
     """Run an in-process 2-replica serving rig (loopback broker, real
     event engine, Registrar + router) under :func:`chaos_schedule` and
     return the LoadReport.  The invariant a chaos run checks:
@@ -821,7 +988,9 @@ def run_chaos(seed: int = 0, n_requests: int = 40,
             server = PagedContinuousServer(
                 config_name="tiny", slots=2, chunk_steps=4, seed=0,
                 enable_prefix_cache=True, max_queue=256,
-                watchdog_s=5.0)
+                watchdog_s=5.0, total_blocks=total_blocks,
+                host_tier_blocks=host_tier_blocks,
+                restore_blocks_per_step=restore_blocks_per_step)
             servers.append(server)
             compose_instance(ContinuousReplica, actor_args(name),
                              process=make_process(2 + index),
@@ -845,10 +1014,8 @@ def run_chaos(seed: int = 0, n_requests: int = 40,
         report = generator.run(n_requests,
                                drain_timeout_s=drain_timeout_s)
         totals = _fleet_kv_stats(servers)
-        lookups = totals["prefix_hits"] + totals["prefix_misses"]
-        if lookups:
-            report.prefix_hit_rate = totals["prefix_hits"] / lookups
-        report.kv_transfer_bytes = totals["kv_transfer_bytes"]
+        _attach_kv_rates(report, totals)
+        report.final_tokens = dict(generator.final_tokens)
         report.fleet_latency_ms = fleet_latency(servers)
         report.server_stats = dict(
             router.counters, **totals,
@@ -1235,7 +1402,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                              "replacement spawn; exit 1 unless zero "
                              "lost/duplicated and converged)")
     parser.add_argument("--workload",
-                        choices=["shared_prefix", "diurnal"],
+                        choices=["shared_prefix", "diurnal",
+                                 "longtail"],
                         help="named workload profile (in-process rig)")
     parser.add_argument("--seed", type=int, default=0)
     parser.add_argument("--requests", type=int, default=40)
@@ -1264,6 +1432,21 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser.add_argument("--no-prefix-routing", action="store_true",
                         help="shared_prefix: disable prefix-aware "
                              "scoring (A/B baseline)")
+    parser.add_argument("--prefixes", type=int, default=6,
+                        help="longtail: distinct shared prefixes "
+                             "(working set = prefixes x prefix-len "
+                             "blocks)")
+    parser.add_argument("--prefix-len", type=int, default=384,
+                        help="longtail: tokens per shared prefix")
+    parser.add_argument("--hbm-blocks", type=int, default=52,
+                        help="longtail: HBM pool size in blocks "
+                             "(deliberately smaller than the prefix "
+                             "working set)")
+    parser.add_argument("--host-blocks", type=int, default=160,
+                        help="longtail: host-RAM tier capacity in "
+                             "blocks (0 = tier off, the A/B baseline)")
+    parser.add_argument("--tier-off", action="store_true",
+                        help="longtail: shorthand for --host-blocks 0")
     parser.add_argument("--trace-out", metavar="DIR",
                         help="enable distributed tracing and dump the "
                              "slowest requests' span trees as Chrome "
@@ -1308,6 +1491,27 @@ def main(argv: Optional[List[str]] = None) -> int:
         print(f"goodput {report.goodput_rps:.2f} req/s over avg "
               f"{report.avg_replicas:.2f} replicas = "
               f"{report.goodput_per_replica:.2f} req/s/replica")
+        return 1 if (report.lost or report.timeouts) else 0
+    if args.workload == "longtail":
+        host_blocks = 0 if args.tier_off else args.host_blocks
+        report = run_longtail(
+            n_requests=args.requests, rate_hz=args.rate_hz,
+            n_prefixes=args.prefixes, prefix_len=args.prefix_len,
+            total_blocks=args.hbm_blocks,
+            host_tier_blocks=host_blocks, seed=args.seed)
+        print(report)
+        print(report.phase_table())
+        print(f"fleet counters: {report.server_stats}")
+        tier = f"host tier {host_blocks} blocks" if host_blocks \
+            else "host tier OFF"
+        mean_ttft = (statistics.fmean(report.ttfts_ms)
+                     if report.ttfts_ms else 0.0)
+        print(f"longtail ({args.prefixes} prefixes x "
+              f"{args.prefix_len} tok over {args.hbm_blocks} HBM "
+              f"blocks, {tier}): "
+              f"prefix_hit_rate={report.prefix_hit_rate}, "
+              f"host share={report.prefix_hit_rate_host}, "
+              f"mean TTFT={mean_ttft:.1f}ms")
         return 1 if (report.lost or report.timeouts) else 0
     if args.workload == "shared_prefix":
         report = run_shared_prefix(

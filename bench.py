@@ -1376,6 +1376,126 @@ def bench_kv_transfer(prefix_lens=(512, 2048, 8192),
     return results
 
 
+def bench_kv_tier(chain_tokens=2048, longtail_requests=36,
+                  longtail_warmup=12):
+    """Tiered KV cache numbers: (1) HBM→host demotion and host→HBM
+    restore bandwidth per pool dtype (pure data movement over
+    :func:`~aiko_services_tpu.kvstore.seed_chain`-registered chains,
+    no model compiles); (2) TTFT at the longtail working point for
+    the three ways an admission can resolve — HBM prefix hit, host
+    restore, full recompute — the crossover that decides when the
+    tier pays; (3) the longtail overflow A/B itself: tier-on vs
+    tier-off prefix hit rate and mean TTFT at the SAME HBM pool."""
+    import numpy as np
+    from aiko_services_tpu.kvstore import seed_chain
+    from aiko_services_tpu.orchestration.continuous import \
+        DecodeRequest
+    from aiko_services_tpu.orchestration.paged import \
+        PagedContinuousServer
+    from aiko_services_tpu.tools.loadgen import run_longtail
+
+    results = {}
+
+    # (1) Demote/restore bandwidth, both pool dtypes.
+    max_seq = -(-(chain_tokens + 256) // 16) * 16
+    for quantize_kv in (False, True):
+        tag = "int8" if quantize_kv else "bf16"
+        server = PagedContinuousServer(
+            config_name="tiny", slots=2, max_seq=max_seq,
+            enable_prefix_cache=True, quantize_kv=quantize_kv,
+            host_tier_blocks=2 * (chain_tokens // 16),
+            restore_blocks_per_step=16)
+        rng = np.random.RandomState(0)
+        tokens = rng.randint(1, 1024,
+                             size=chain_tokens + 1).astype(np.int32)
+        n_blocks = seed_chain(server, tokens)
+        assert n_blocks == chain_tokens // 16, n_blocks
+        t0 = time.perf_counter()
+        while server._evict_one():
+            pass
+        demote_ms = (time.perf_counter() - t0) * 1e3
+        nbytes = server.kv_host_bytes
+        assert server.kv_demotions == n_blocks
+        keys = server._chain_keys(tokens)[:n_blocks]
+        t0 = time.perf_counter()
+        assert server._begin_restore(keys, [])
+        while server._restoring:
+            server._advance_restores()
+        restore_ms = (time.perf_counter() - t0) * 1e3
+        assert server.kv_restores == n_blocks
+        prefix = f"kv_tier_{tag}"
+        results[f"{prefix}_blocks"] = n_blocks
+        results[f"{prefix}_bytes"] = nbytes
+        results[f"{prefix}_demote_ms"] = round(demote_ms, 2)
+        results[f"{prefix}_demote_mb_per_sec"] = round(
+            nbytes / 1e6 / (demote_ms / 1e3), 1) if demote_ms else 0.0
+        results[f"{prefix}_restore_ms"] = round(restore_ms, 2)
+        results[f"{prefix}_restore_mb_per_sec"] = round(
+            nbytes / 1e6 / (restore_ms / 1e3), 1) if restore_ms else 0.0
+        log(f"kv_tier[{tag}]: {n_blocks} blocks {nbytes / 1e6:.2f} MB "
+            f"demote {demote_ms:.1f} ms / restore {restore_ms:.1f} ms")
+
+    # (2) TTFT per admission path at the longtail working point:
+    # 384-token prefix, 64-token prefill chunks (a miss is 6 chunks).
+    server = PagedContinuousServer(
+        config_name="tiny", slots=2, max_seq=416, chunk_steps=4,
+        seed=0, enable_prefix_cache=True, chunk_prefill_tokens=64,
+        total_blocks=96, host_tier_blocks=64,
+        restore_blocks_per_step=24)
+    rng = np.random.RandomState(1)
+    prompt = rng.randint(1, 1024, size=392).astype(np.int32)
+    other = rng.randint(1, 1024, size=392).astype(np.int32)
+
+    def run_one(tokens, request_id):
+        t0 = time.perf_counter()
+        server.submit(DecodeRequest(request_id=request_id,
+                                    prompt=tokens, max_new_tokens=1))
+        finished = server.run_until_drained()
+        assert [r.request_id for r in finished] == [request_id]
+        return (time.perf_counter() - t0) * 1e3
+
+    run_one(prompt, "compile_miss")         # compiles the miss shapes
+    run_one(prompt, "compile_hit")          # compiles the hit shapes
+    hit_ms = run_one(prompt, "hit")
+    while server._evict_one():              # compiles demote/restore
+        pass
+    run_one(prompt, "compile_restore")
+    while server._evict_one():
+        pass
+    restore_ms = run_one(prompt, "restore")
+    recompute_ms = run_one(other, "recompute")   # miss shapes warm
+    results["kv_tier_ttft_hbm_hit_ms"] = round(hit_ms, 2)
+    results["kv_tier_ttft_host_restore_ms"] = round(restore_ms, 2)
+    results["kv_tier_ttft_recompute_ms"] = round(recompute_ms, 2)
+    log(f"kv_tier[ttft]: hbm hit {hit_ms:.1f} / host restore "
+        f"{restore_ms:.1f} / recompute {recompute_ms:.1f} ms")
+
+    # (3) Longtail overflow A/B: 52-block HBM pool vs a ~144-block
+    # working set; only host_tier_blocks differs between the arms.
+    for label, host_blocks in (("tier_on", 160), ("tier_off", 0)):
+        report = run_longtail(n_requests=longtail_requests,
+                              warmup_requests=longtail_warmup,
+                              host_tier_blocks=host_blocks, seed=0)
+        assert report.lost == 0 and report.timeouts == 0, \
+            f"kv_tier[{label}]: {report!r}"
+        mean_ttft = (statistics.fmean(report.ttfts_ms)
+                     if report.ttfts_ms else 0.0)
+        results[f"kv_tier_{label}_prefix_hit_rate"] = round(
+            report.prefix_hit_rate or 0.0, 3)
+        results[f"kv_tier_{label}_ttft_mean_ms"] = round(mean_ttft, 1)
+        results[f"kv_tier_{label}_ttft_p95_ms"] = round(
+            report.ttft_p95_ms, 1)
+        if label == "tier_on":
+            results["kv_tier_on_host_hit_share"] = round(
+                report.prefix_hit_rate_host or 0.0, 3)
+            results["kv_tier_on_restores"] = \
+                report.server_stats["kv_restores"]
+        log(f"kv_tier[{label}]: prefix hit "
+            f"{(report.prefix_hit_rate or 0.0):.0%}, ttft mean "
+            f"{mean_ttft:.1f} / p95 {report.ttft_p95_ms:.1f} ms")
+    return results
+
+
 def _raw_decode_tps(config_name, slots, max_seq, block_size,
                     chunk_steps, quantize_kv, n_chunks=8):
     """Bare paged decode throughput: ``serve_chunk_paged`` chained
@@ -2035,6 +2155,14 @@ SECTIONS = [
                                 routed_requests=6,
                                 routed_rate_hz=10.0))
      if SMOKE else bench_kv_transfer),
+    # Tiered KV cache: demote/restore bandwidth (host-side data
+    # movement, no compiles), per-path TTFT crossover, and the
+    # longtail overflow A/B through the live rig (tiny model,
+    # CPU-capable like kv_transfer).
+    ("kv_tier", 600,
+     (lambda: bench_kv_tier(chain_tokens=256, longtail_requests=10,
+                            longtail_warmup=6))
+     if SMOKE else bench_kv_tier),
     # Tensor-parallel replica serving: TP degree sweep on the paged
     # server (virtual CPU mesh off-TPU, real mesh on TPU) + the
     # cross-degree greedy exactness bit + engine-vs-raw-decode ratio.

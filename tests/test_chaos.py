@@ -74,6 +74,78 @@ def test_chaos_long_schedule():
     assert report.server_stats["replica_deaths_observed"] == 1
 
 
+def test_chaos_kill_mid_restore_tier_matches_flat():
+    """Demotion/restore composed into the fault plan: tiny HBM pools
+    force the shared-prefix chains through the host tier while the
+    seeded schedule kills a replica (``restore_blocks_per_step=1``
+    stretches every restore across steps, so the kill window overlaps
+    in-flight promotions).  Gates: zero lost requests, no restore
+    stranded by the death, and BIT-EXACT outputs — the same seeded run
+    without a host tier must deliver identical greedy tokens for every
+    request both runs completed."""
+    from aiko_services_tpu.tools.loadgen import run_chaos
+
+    tiered = run_chaos(seed=1, n_requests=24, rate_hz=200.0,
+                       total_blocks=8, host_tier_blocks=32,
+                       restore_blocks_per_step=1)
+    assert tiered.lost == 0, tiered
+    assert tiered.timeouts == 0, tiered
+    stats = tiered.server_stats
+    assert stats["replica_deaths_observed"] == 1
+    assert stats["kv_demotions"] > 0        # the tier really churned
+    assert stats["kv_restores"] > 0
+    assert stats["restore_queue_depth"] == 0    # nothing half-landed
+
+    flat = run_chaos(seed=1, n_requests=24, rate_hz=200.0,
+                     total_blocks=8)
+    assert flat.lost == 0 and flat.timeouts == 0
+    assert flat.server_stats["kv_demotions"] == 0
+    both = set(tiered.final_tokens) & set(flat.final_tokens)
+    assert both                             # runs really overlap
+    for request_id in both:
+        assert tiered.final_tokens[request_id] \
+            == flat.final_tokens[request_id], request_id
+
+
+def test_lease_expiry_on_demoted_chain_is_graceful(engine):
+    """A replica death mid-transfer can leave an import lease racing
+    pool pressure: the pins are shed (slot teardown decrements refs
+    exactly as retirement would) and the imported chain demotes to
+    host BEFORE the lease fires.  The expiry handler must skip keys no
+    longer in the HBM index — no resurrection, no double-free, host
+    tier untouched — and the demoted chain must still restore
+    bit-exactly afterwards."""
+    from tests.test_kvstore import _warm, make_server
+
+    prompt = np.arange(1, 50, dtype=np.int32)
+    owner = make_server(host_tier_blocks=16)
+    want = _warm(owner, prompt)
+    payload = owner.kv_export_payload(owner.prefix_keys_hex(prompt), 0)
+
+    importer = make_server(host_tier_blocks=16)
+    assert importer.kv_import_payload(dict(payload), engine=engine,
+                                      lease_s=5.0) == 3
+    for key in list(importer._imported_keys):   # teardown sheds pins
+        block = importer._index[key]
+        importer._refs[block] -= 1
+        if importer._refs[block] == 0:
+            importer._evictable[key] = block
+    demoted = 0
+    while importer._evict_one():
+        demoted += 1
+    assert demoted == 3
+    assert importer.stats()["kv_host_blocks"] == 3
+
+    engine.advance(6.0)                     # lease fires post-demotion
+    engine.drain()
+    assert importer.stats()["kv_host_blocks"] == 3
+    assert not importer._evictable          # nothing resurrected
+
+    got = _warm(importer, prompt)           # restores from host tier
+    assert got == want
+    assert importer.stats()["kv_restores"] == 3
+
+
 def test_cross_process_failover_mid_stream(broker, monkeypatch):
     """Two continuous-batching replicas in REAL OS processes, one armed
     to hard-exit (os._exit) on its 4th serving pump.  Its MQTT LWT

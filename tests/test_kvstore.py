@@ -76,15 +76,29 @@ def test_shareable_blocks_excludes_admission_seed_block():
 
 
 def test_digest_roundtrip_and_malformed():
+    # 4-field entries stay valid wire (pre-tier replicas); decode
+    # always returns 5-tuples with tier 0 appended.
     entries = [("ab12cd34ef567890", 3, 1, 7),
                ("ffee001122334455", 2, 0, 1)]
     text = digest_encode(16, "decode", entries)
-    assert digest_decode(text) == (16, "decode", entries)
+    assert digest_decode(text) == (
+        16, "decode", [entry + (0,) for entry in entries])
+    # Host-tier entries carry a 5th field; tier 0 encodes 4-field
+    # (the wire only grows where the tier is actually in play).
+    tiered = [("ab12cd34ef567890", 3, 1, 7, 0),
+              ("ffee001122334455", 2, 0, 1, 1)]
+    text = digest_encode(16, "decode", tiered)
+    assert "ab12cd34ef567890/3/1/7," in text     # tier 0 stays 4-field
+    assert text.endswith("/2/0/1/1")             # tier 1 appends
+    assert digest_decode(text) == (
+        16, "decode", [("ab12cd34ef567890", 3, 1, 7, 0),
+                       ("ffee001122334455", 2, 0, 1, 1)])
     # S-expression safe: survives the EC-share broadcast wire.
     command, params = parse(generate("update", ["kv_prefixes", text]))
     assert (command, params[1]) == ("update", text)
     for bad in ("", "16;decode", "x;decode;a/1/2/3",
-                "16;decode;nodepth", None, "16;d;a/b/c/d"):
+                "16;decode;nodepth", None, "16;d;a/b/c/d",
+                "16;decode;ab/1/2/3/4/5"):
         assert digest_decode(bad) is None
 
 
@@ -204,7 +218,7 @@ def test_export_unknown_prefix_returns_none_and_counts():
 def test_import_lease_release_and_spill_accounting(engine):
     """Imported blocks stay ref-pinned until the lease expires, then
     become evictable; imports that evict cached prefixes count as
-    spills."""
+    evictions (no host tier) or demotions (host tier configured)."""
     prompt = np.arange(1, 50, dtype=np.int32)
     owner = make_server()
     _warm(owner, prompt)
@@ -219,13 +233,22 @@ def test_import_lease_release_and_spill_accounting(engine):
     engine.drain()
     assert len(importer._evictable) == evictable_before + 3
 
-    # Spills: a tiny pool already full of cached prefixes must evict
-    # to accept the import.
+    # A tiny pool already full of cached prefixes must evict to
+    # accept the import — deletions without a host tier, demotions
+    # with one.
     small = make_server(total_blocks=5)
     _warm(small, np.arange(100, 149, dtype=np.int32))
     assert len(small._evictable) > 0          # cached prefix occupies pool
     assert small.kv_import_payload(dict(payload)) == 3
-    assert small.stats()["kv_spill_evictions"] > 0
+    assert small.stats()["prefix_evictions"] > 0
+    assert small.stats()["kv_demotions"] == 0
+
+    tiered = make_server(total_blocks=5, host_tier_blocks=8)
+    _warm(tiered, np.arange(100, 149, dtype=np.int32))
+    assert tiered.kv_import_payload(dict(payload)) == 3
+    stats = tiered.stats()
+    assert stats["kv_demotions"] > 0
+    assert stats["kv_host_blocks"] > 0 and stats["kv_host_bytes"] > 0
 
 
 def test_seed_chain_registers_without_prefill():
@@ -260,7 +283,9 @@ def test_kv_counters_flow_to_dashboard_plugins():
     stats = importer.stats()
     for key in ("prefix_remote_hits", "kv_transfer_bytes",
                 "kv_transfer_ms", "kv_transfer_failures",
-                "kv_spill_evictions"):
+                "kv_demotions", "kv_restores", "kv_host_blocks",
+                "kv_host_bytes", "restore_queue_depth",
+                "prefix_hits_host"):
         assert key in stats and key in TELEMETRY_KEYS
     telemetry = serving_telemetry(stats)
     assert telemetry["prefix_remote_hits"] == 1
