@@ -30,6 +30,7 @@ from ..ops.paged_attention import (cached_gqa_attention,
                                    decode_kernel_mode,
                                    paged_decode_attention)
 from ..ops.paged_prefill import (paged_prefill_attention,
+                                 paged_verify_attention,
                                  prefill_kernel_mode)
 from ..ops.quant import (_unpack_int4, int4_matmul, int8_matmul,
                          is_quantized, is_quantized_int4, quantize_tree)
@@ -44,6 +45,7 @@ __all__ = ["LlamaConfig", "init_params", "forward",
            "init_paged_cache", "decode_chunk_paged",
            "serve_chunk_ragged", "serve_chunk_paged",
            "serve_chunk_mixed", "prefill_append_paged",
+           "verify_chunk_paged",
            "paged_insert_prefix", "paged_scatter_blocks",
            "paged_gather_blocks", "complete", "CONFIGS"]
 
@@ -1596,6 +1598,94 @@ def serve_chunk_mixed(params, state, pool, prefill_tokens, prefill_row,
 
     return _serve_scan(step_core, state, pool, num_steps, eos_id,
                        sampled, rng_key)
+
+
+def _verify_append_core(params, tokens, pool, tables, positions,
+                        active, config: LlamaConfig, lora=None,
+                        kv_limit=None):
+    """Teacher-forced scoring of a (batch, K) speculative window
+    straight against the block pool — the paged twin of
+    :func:`verify_chunk_ragged`: every row at its OWN absolute start
+    position (mid-block starts included), the window's K/V appended
+    into table-resolved pool blocks, no gather, no bucket.
+
+    Kernel dispatch mirrors :func:`_prefill_append_core`; the reference
+    dispatch writes the slab in place (:func:`_paged_write_slab`, the
+    SAME quantizer the decode write path uses, so verify-written rows
+    are byte-identical to what plain decode would have written) and
+    attends over the gathered pool view.  Inactive rows write scratch
+    block 0 (kernel: nothing at all — their programs identity-flush)
+    and their logits are garbage the acceptance mask discards."""
+    batch, K = tokens.shape
+    h, kv, hd = config.n_heads, config.n_kv_heads, config.head_dim
+    starts = jnp.where(active, positions, 0).astype(jnp.int32)
+    positions_b = starts[:, None] + jnp.arange(K, dtype=jnp.int32)[None]
+    cached_lens = starts
+    chunk_lens = jnp.where(active, K, 0).astype(jnp.int32)
+    scratch_tables = jnp.zeros_like(tables)
+    write_tables = jnp.where(active[:, None], tables, scratch_tables)
+    cos, sin = _rope_freqs(config, positions_b)
+    x = _embed_lookup(params, tokens, config.dtype)
+    use_kernel, interpret = prefill_kernel_mode()
+    new_pool = []
+    lora_layers = lora["layers"] if lora else [None] * len(pool)
+    for layer, pool_layer, lora_layer in zip(params["layers"], pool,
+                                             lora_layers):
+        normed = rms_norm(x, layer["attn_norm"], config.norm_eps)
+        q = _lora_matmul(normed, layer["wq"], lora_layer, "wq",
+                         lora).reshape(batch, K, h, hd)
+        k = _lora_matmul(normed, layer["wk"], lora_layer, "wk",
+                         lora).reshape(batch, K, kv, hd)
+        v = _lora_matmul(normed, layer["wv"], lora_layer, "wv",
+                         lora).reshape(batch, K, kv, hd)
+        q = apply_rope(q, cos, sin)
+        k = apply_rope(k, cos, sin)
+        q_g = q.reshape(batch, K, kv, h // kv, hd)
+        if use_kernel:
+            out, pool_layer = paged_verify_attention(
+                q_g, k, v, pool_layer, write_tables, cached_lens,
+                chunk_lens, window=config.sliding_window,
+                interpret=interpret, kv_limit=kv_limit)
+        else:
+            pool_layer = _paged_write_slab(pool_layer, k, v,
+                                           write_tables, positions_b)
+            gathered = _paged_gather(pool_layer, write_tables)
+            out = _cached_gqa_attention(q_g, gathered, positions_b, hd,
+                                        window=config.sliding_window)
+        new_pool.append(pool_layer)
+        x = x + _lora_matmul(out.reshape(batch, K, h * hd),
+                             layer["wo"], lora_layer, "wo",
+                             lora).astype(x.dtype)
+        x = _mlp_block(layer, config, x)
+    x = rms_norm(x, params["final_norm"], config.norm_eps)
+    logits = _matmul(x, params["lm_head"]).astype(jnp.float32)
+    return logits, new_pool
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("config", "kv_limit"),
+                   donate_argnames=("pool",))
+def verify_chunk_paged(params, tokens, pool, tables, positions, active,
+                       config: LlamaConfig, lora=None, kv_limit=None):
+    """Speculative verify on the PAGED layout: score K tokens per slot
+    against the block pool, each row at its own absolute position —
+    the pool-backed twin of :func:`verify_chunk_ragged`.  ``tokens``
+    (batch, K) int32 windows (seed token + proposals), ``tables`` the
+    resident (slots, max_blocks) block tables, ``positions`` (batch,)
+    the absolute position of ``tokens[:, 0]``.
+
+    Returns ``(logits (batch, K, vocab), pool)`` — ``logits[:, j]``
+    predicts position ``positions + j + 1``.  The window's K/V rows
+    land in each slot's own blocks at ``[positions, positions + K)``;
+    rejected-tail rows are left stale (unattendable by the absolute-
+    position mask until a later round rewrites them — the module-wide
+    invariant; the server counts them as ``spec_rollback_blocks``).
+    Callers must reserve ``K`` rows of block headroom past the last
+    committed position (the paged server's worst-case reservation
+    includes ``spec_k + 1``)."""
+    return _verify_append_core(params, tokens, pool, tables, positions,
+                               active, config, lora=lora,
+                               kv_limit=kv_limit)
 
 
 def _sample_logits_per_row(logits, key, temperatures, top_ps):

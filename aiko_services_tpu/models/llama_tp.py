@@ -57,7 +57,8 @@ except ImportError:                       # jax 0.4.x
     from jax.experimental.shard_map import shard_map
 
 from ..ops.paged_attention import paged_decode_attention
-from ..ops.paged_prefill import paged_prefill_attention
+from ..ops.paged_prefill import (paged_prefill_attention,
+                                 paged_verify_attention)
 from . import llama
 from .llama import LlamaConfig
 
@@ -255,6 +256,58 @@ def _tp_prefill_append_core(params, tokens, pool, tables, start_index,
     return _tp_lm_head(params, config, axis, x), new_pool
 
 
+def _tp_verify_core(params, tokens, pool, tables, positions, active,
+                    config: LlamaConfig, tp: int, axis: str,
+                    kv_limit=None):
+    """Shard-local mirror of ``llama._verify_append_core`` (the
+    speculative verify): every row at its OWN absolute start position,
+    the window's K/V appended into the LOCAL kv-head slice of the
+    pool, inactive rows routed to scratch block 0.  The all-gathers
+    are the same column gathers as the decode/prefill mirrors —
+    bitwise concatenations — so TP verify logits equal single-chip
+    verify logits bit for bit (invariants 9 + 11)."""
+    batch, K = tokens.shape
+    h, kv = config.n_heads // tp, config.n_kv_heads // tp
+    hd = config.head_dim
+    starts = jnp.where(active, positions, 0).astype(jnp.int32)
+    positions_b = starts[:, None] + jnp.arange(K, dtype=jnp.int32)[None]
+    cached_lens = starts
+    chunk_lens = jnp.where(active, K, 0).astype(jnp.int32)
+    write_tables = jnp.where(active[:, None], tables,
+                             jnp.zeros_like(tables))
+    cos, sin = llama._rope_freqs(config, positions_b)
+    x = _tp_embed(params, tokens, config, axis)
+    use_kernel, interpret = llama.prefill_kernel_mode()
+    new_pool = []
+    for layer, pool_layer in zip(params["layers"], pool):
+        normed = llama.rms_norm(x, layer["attn_norm"], config.norm_eps)
+        q = llama._matmul(normed, layer["wq"]).reshape(batch, K, h, hd)
+        k = llama._matmul(normed, layer["wk"]).reshape(batch, K, kv, hd)
+        v = llama._matmul(normed, layer["wv"]).reshape(batch, K, kv, hd)
+        q = llama.apply_rope(q, cos, sin)
+        k = llama.apply_rope(k, cos, sin)
+        q_g = q.reshape(batch, K, kv, h // kv, hd)
+        if use_kernel:
+            out, pool_layer = paged_verify_attention(
+                q_g, k, v, pool_layer, write_tables, cached_lens,
+                chunk_lens, window=config.sliding_window,
+                interpret=interpret, kv_limit=kv_limit)
+        else:
+            pool_layer = llama._paged_write_slab(pool_layer, k, v,
+                                                 write_tables,
+                                                 positions_b)
+            gathered = llama._paged_gather(pool_layer, write_tables)
+            out = llama._cached_gqa_attention(
+                q_g, gathered, positions_b, hd,
+                window=config.sliding_window)
+        new_pool.append(pool_layer)
+        out = _gather_cols(out.reshape(batch, K, h * hd), axis)
+        x = x + _gather_cols(llama._matmul(out, layer["wo"]),
+                             axis).astype(x.dtype)
+        x = _tp_mlp_block(layer, config, axis, x)
+    return _tp_lm_head(params, config, axis, x), new_pool
+
+
 # --------------------------------------------------------------------------- #
 # The engine
 
@@ -272,6 +325,7 @@ class TPEngine:
     * :meth:`serve_chunk_paged` — decode chunk (pool donated)
     * :meth:`serve_chunk_mixed` — chunked-prefill slice + decode chunk
     * :meth:`prefill_append_paged` — standalone prefill append
+    * :meth:`verify_chunk_paged` — speculative verify window
     """
 
     def __init__(self, config: LlamaConfig, mesh: Mesh, params, pool,
@@ -403,6 +457,38 @@ class TPEngine:
         if has_rng:
             in_specs += (P(),)
         out_specs = (P(), P(), P(), self._pool_specs)
+        return jax.jit(self._shard_map(body, in_specs, out_specs),
+                       donate_argnums=(2,))
+
+    # -- speculative verify window ------------------------------------- #
+
+    def verify_chunk_paged(self, params, tokens, pool, tables,
+                           positions, active, kv_limit=None):
+        """TP twin of :func:`llama.verify_chunk_paged` (no LoRA):
+        score a (slots, k+1) speculative window against the sharded
+        pool, each row at its own absolute position.  Returns
+        ``(logits (slots, k+1, vocab), pool)`` with the pool donated —
+        bitwise equal to the single-chip verify (all-gather is the
+        only collective)."""
+        K = int(tokens.shape[1])
+        key = ("verify", K, kv_limit)
+        fn = self._cache.get(key)
+        if fn is None:
+            fn = self._build_verify(kv_limit)
+            self._cache[key] = fn
+        return fn(params, tokens, pool, tables, positions, active)
+
+    def _build_verify(self, kv_limit):
+        config, tp, axis = self.config, self.tp, self.axis
+
+        def body(params, tokens, pool, tables, positions, active):
+            return _tp_verify_core(params, tokens, pool, tables,
+                                   positions, active, config, tp,
+                                   axis, kv_limit=kv_limit)
+
+        in_specs = (self._param_specs, P(), self._pool_specs,
+                    P(), P(), P())
+        out_specs = (P(), self._pool_specs)
         return jax.jit(self._shard_map(body, in_specs, out_specs),
                        donate_argnums=(2,))
 

@@ -44,6 +44,11 @@ class SpecStats:
         self.target_passes = 0
         self.drafted = 0
         self.accepted = 0
+        #: Pool blocks a paged verify wrote past the committed
+        #: frontier (rejected speculation) — logical rollback only:
+        #: worst-case reservation keeps the blocks owned, the stale
+        #: rows are unattendable and rewritten before reachable.
+        self.rollback_blocks = 0
 
     @property
     def acceptance_rate(self) -> float:

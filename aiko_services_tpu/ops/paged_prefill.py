@@ -58,6 +58,7 @@ else:  # pragma: no cover
     pltpu = None
 
 __all__ = ["paged_prefill_attention", "paged_prefill_reference",
+           "paged_verify_attention",
            "prefill_kernel_mode", "prefill_attention_path"]
 
 #: Largest query tile (tokens) one attention program carries; the tile
@@ -496,3 +497,191 @@ def paged_prefill_attention(q, k_new, v_new, pool, tables, cached_lens,
                            sm_scale=sm_scale, q_tile=q_tile,
                            kv_blocks=kv_blocks, interpret=interpret)
     return out, new_pool
+
+
+# ---------------------------------------------------------------------------
+# Ragged verify: short append chunks at UNALIGNED per-row positions
+# (speculative decoding on the paged path — each slot's verify window
+# starts mid-block at its own decode position)
+
+
+def _append_kv_ragged_kernel(tables_ref, meta_ref,     # scalar prefetch
+                             k_new_ref, v_new_ref, k_in_ref, v_in_ref,
+                             *rest, block_size: int, span: int,
+                             quantized: bool):
+    """Grid: (batch, kv_heads, span_blocks).  One program MERGES the
+    row's verify slab into one pool block: unlike the aligned chunk
+    writer (whole-block overwrite), a verify window starts mid-block,
+    so the program reads the resident block, replaces only the rows in
+    ``[cached, cached + chunk_len)``, and flushes the merge back.
+
+    Row selection is an unrolled ``jnp.where`` sweep over the slab (2D
+    tiles only, no gather): exact value passthrough, so the int8 quant
+    below is bit-identical to the aligned writer's per-row absmax."""
+    if quantized:
+        ks_in, vs_in, k_out, v_out, ks_out, vs_out = rest
+    else:
+        k_out, v_out = rest
+    b = pl.program_id(0)
+    sb = pl.program_id(2)
+    cached = meta_ref[b, 0]
+    chunk_len = meta_ref[b, 1]
+    # Token index held by this block's row 0 (negative in the first
+    # block of an unaligned span: rows before ``cached`` keep their
+    # committed values).
+    entry = cached // block_size + sb
+    base = entry * block_size - cached
+    t = base + jax.lax.broadcasted_iota(jnp.int32, (block_size, 1), 0)
+    row_new = (t >= 0) & (t < chunk_len)
+
+    def select(slab_ref):
+        slab = slab_ref[0, :, 0].astype(jnp.float32)      # (span, hd)
+        acc = jnp.zeros((block_size, slab.shape[-1]), jnp.float32)
+        for tt in range(span):
+            acc = jnp.where(t == tt, slab[tt:tt + 1, :], acc)
+        return acc
+
+    if quantized:
+        for slab_ref, in_ref, s_in, out, s_out in (
+                (k_new_ref, k_in_ref, ks_in, k_out, ks_out),
+                (v_new_ref, v_in_ref, vs_in, v_out, vs_out)):
+            r32 = select(slab_ref)
+            amax = jnp.max(jnp.abs(r32), axis=-1, keepdims=True)
+            scale = jnp.where(amax == 0, 1.0, amax / 127.0)  # (bs, 1)
+            rows_q = jnp.clip(jnp.round(r32 / scale),
+                              -127, 127).astype(out.dtype)
+            out[0, :, 0] = jnp.where(row_new, rows_q, in_ref[0, :, 0])
+            s_out[0] = jnp.where(row_new, scale, s_in[0])
+    else:
+        for slab_ref, in_ref, out in ((k_new_ref, k_in_ref, k_out),
+                                      (v_new_ref, v_in_ref, v_out)):
+            rows = select(slab_ref).astype(out.dtype)
+            out[0, :, 0] = jnp.where(row_new, rows, in_ref[0, :, 0])
+
+
+def _append_kv_ragged(k_new, v_new, pool, tables, meta,
+                      interpret: bool):
+    """Merge (batch, T, kv, hd) verify slabs into pool blocks at
+    arbitrary (unaligned) per-row start positions ``meta[:, 0]``.
+    Blocks outside a row's live span — and every block of a row with
+    ``chunk_len == 0`` — retarget reserved scratch block 0 and write
+    back what they read (identity flush)."""
+    batch, T, kv_heads, head_dim = k_new.shape
+    block_size = pool["k"].shape[1]
+    max_blocks = tables.shape[1]
+    quantized = "ks" in pool
+    # An unaligned span of T rows straddles at most ceil(T/bs)+1 blocks.
+    span_blocks = -(-T // block_size) + 1
+    grid = (batch, kv_heads, span_blocks)
+
+    def new_index(b, h, sb, tables_ref, meta_ref):
+        return (b, 0, h, 0)
+
+    def pool_index(b, h, sb, tables_ref, meta_ref):
+        cached = meta_ref[b, 0]
+        entry = cached // block_size + sb
+        live = (entry * block_size < cached + meta_ref[b, 1]) \
+            & (meta_ref[b, 1] > 0)
+        entry = jnp.minimum(entry, max_blocks - 1)
+        return (jnp.where(live, tables_ref[b, entry], 0), 0, h, 0)
+
+    def scale_index(b, h, sb, tables_ref, meta_ref):
+        return pool_index(b, h, sb, tables_ref, meta_ref)[:3]
+
+    kv_spec = pl.BlockSpec((1, T, 1, head_dim), new_index)
+    pool_spec = pl.BlockSpec((1, block_size, 1, head_dim), pool_index)
+    scale_spec = pl.BlockSpec((1, block_size, 1), scale_index)
+
+    in_specs = [kv_spec, kv_spec, pool_spec, pool_spec]
+    operands = [k_new, v_new, pool["k"], pool["v"]]
+    out_specs = [pool_spec, pool_spec]
+    out_shape = [jax.ShapeDtypeStruct(pool["k"].shape, pool["k"].dtype),
+                 jax.ShapeDtypeStruct(pool["v"].shape, pool["v"].dtype)]
+    aliases = {4: 0, 5: 1}
+    if quantized:
+        in_specs += [scale_spec, scale_spec]
+        operands += [pool["ks"], pool["vs"]]
+        out_specs += [scale_spec, scale_spec]
+        out_shape += [
+            jax.ShapeDtypeStruct(pool["ks"].shape, pool["ks"].dtype),
+            jax.ShapeDtypeStruct(pool["vs"].shape, pool["vs"].dtype)]
+        aliases.update({6: 2, 7: 3})
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2, grid=grid,
+        in_specs=in_specs, out_specs=out_specs)
+    outs = pl.pallas_call(
+        functools.partial(_append_kv_ragged_kernel,
+                          block_size=block_size, span=T,
+                          quantized=quantized),
+        grid_spec=grid_spec,
+        out_shape=out_shape,
+        input_output_aliases=aliases,
+        interpret=interpret,
+    )(tables, meta, *operands)
+    new_pool = {"k": outs[0], "v": outs[1]}
+    if quantized:
+        new_pool["ks"], new_pool["vs"] = outs[2], outs[3]
+    return new_pool
+
+
+def paged_verify_attention(q, k_new, v_new, pool, tables, cached_lens,
+                           chunk_lens, window: Optional[int] = None,
+                           sm_scale: Optional[float] = None,
+                           interpret: bool = False,
+                           kv_limit: Optional[int] = None):
+    """Ragged paged VERIFY attention: the speculative twin of
+    :func:`paged_prefill_attention` for short windows at arbitrary
+    (mid-block) per-row start positions.
+
+    Two contract differences from the prefill entry:
+
+    * ``cached_lens`` need NOT be block-aligned — each slot verifies at
+      its own decode position, so the write kernel merges into the
+      partial first block instead of overwriting whole blocks.
+    * ``chunk_lens`` may vary per row (ragged k across the batch); rows
+      with ``chunk_lens[row] == 0`` (inactive slots) write nothing at
+      all — their programs identity-flush scratch block 0.
+
+    ``T`` (the slab width) is padded internally to a power of two ≥ 16
+    so the attention tile satisfies the TPU sublane floor; pad rows are
+    never written and their output rows are sliced off.  The attention
+    sweep is the SAME online-softmax kernel chunked prefill uses
+    (absolute-id masking already handles unaligned ``cached``), so a
+    verify pass reads each row's real history once — no pool gather.
+
+    Returns ``(out (batch, T, kv_heads, group, head_dim), new_pool)``.
+    Falls back to :func:`paged_prefill_reference` (which supports
+    arbitrary per-row positions natively) off-TPU or for
+    ``head_dim > 128`` / ``T > Q_TILE_CAP``.
+    """
+    batch, T, kv_heads, group, head_dim = q.shape
+    max_blocks = tables.shape[1]
+    if sm_scale is None:
+        sm_scale = head_dim ** -0.5
+
+    on_tpu = jax.default_backend() == "tpu"
+    if (not (_PALLAS_TPU and (on_tpu or interpret))
+            or head_dim > 128 or T > Q_TILE_CAP):
+        return paged_prefill_reference(q, k_new, v_new, pool, tables,
+                                       cached_lens, chunk_lens,
+                                       window=window)
+
+    Tp = max(16, 1 << (T - 1).bit_length())
+    if Tp != T:
+        pad = ((0, 0), (0, Tp - T)) + ((0, 0),) * (q.ndim - 2)
+        q = jnp.pad(q, pad)
+        k_new = jnp.pad(k_new, pad[:k_new.ndim])
+        v_new = jnp.pad(v_new, pad[:v_new.ndim])
+
+    tables = tables.astype(jnp.int32)
+    meta = jnp.stack([cached_lens.astype(jnp.int32),
+                      chunk_lens.astype(jnp.int32)], axis=1)
+    kv_blocks = max_blocks if kv_limit is None else min(kv_limit,
+                                                        max_blocks)
+    new_pool = _append_kv_ragged(k_new, v_new, pool, tables, meta,
+                                 interpret)
+    out = _chunk_attention(q, new_pool, tables, meta, window=window,
+                           sm_scale=sm_scale, q_tile=Tp,
+                           kv_blocks=kv_blocks, interpret=interpret)
+    return out[:, :T], new_pool

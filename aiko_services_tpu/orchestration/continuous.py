@@ -129,6 +129,11 @@ class DecodeRequest:
     #: Encoded spans fetched alongside a remote KV restore (the
     #: source's ``kv_export`` span) — merged into the response tree.
     remote_spans: Optional[str] = None
+    #: Per-spec-round accepted-proposal counts for THIS request (one
+    #: entry per verify pass that advanced it; empty without a draft).
+    #: Loadgen histograms these — the per-request acceptance shape,
+    #: not just the fleet-mean rate.
+    spec_accepted_rounds: Optional[List[int]] = None
 
 
 def _bucket(n: int, minimum: int = 16) -> int:
@@ -206,10 +211,6 @@ class ContinuousBatchingServer:
                 raise ValueError(
                     "replica_mesh does not compose with LoRA adapters "
                     "yet: per-slot factor gathers are not sharded")
-            if draft_config_name is not None:
-                raise ValueError(
-                    "replica_mesh does not compose with speculative "
-                    "decoding yet: the draft cache is unsharded")
             replica_mesh.validate(self.config)
             from ..models import llama_tp
             self._llama_tp = llama_tp
@@ -267,14 +268,18 @@ class ContinuousBatchingServer:
         # at admission alongside the target's.
         self._draft = None
         if draft_config_name is not None:
-            if self.chunk_prefill_tokens:
+            # Speculation now composes with chunked-prefill admission
+            # (the draft's prompt KV lands whole at _finish_prefill —
+            # the draft is small, so one un-chunked prefill does not
+            # reintroduce the stall chunking removes) and with
+            # replica_mesh TP (draft replicated on the mesh, below).
+            # Still-unsupported combos stay LOUD errors:
+            if mesh is not None:
                 raise ValueError(
-                    "speculative serving does not compose with "
-                    "chunked-prefill admission yet: chunked prompts "
-                    "admit through mixed prefill/decode steps (see "
-                    "docs/SERVING.md, 'Chunked prefill & mixed "
-                    "steps'), which do not run the draft model — "
-                    "pass chunk_prefill_tokens=0 with a draft")
+                    "speculative decoding does not compose with mesh= "
+                    "(GSPMD megatron sharding): draft placement is "
+                    "only defined for replica_mesh= (shard_map TP, "
+                    "draft replicated) — or pass no mesh")
             if spec_k + 1 > 16:        # the prompt bucket floor
                 raise ValueError(
                     f"spec_k {spec_k} too large: k+1 must be <= the "
@@ -294,6 +299,18 @@ class ContinuousBatchingServer:
                 k=int(spec_k),
                 cache=llama.init_cache(draft_config, slots,
                                        self.max_seq))
+            if self._mesh is not None:
+                # TP replica: the draft model rides the SAME mesh,
+                # fully replicated (params + its contiguous cache).
+                # Draft dispatches then run the ordinary jitted
+                # programs on every device with no collectives — each
+                # chip computes the identical proposal stream, so TP
+                # spec greedy output is bitwise the single-chip
+                # server's (invariants 9 + 11).
+                self._draft["params"] = self._llama_tp.replicate(
+                    self._draft["params"], self._mesh)
+                self._draft["cache"] = self._llama_tp.replicate(
+                    self._draft["cache"], self._mesh)
             from ..models.speculative import SpecStats
             self.spec_stats = SpecStats()
         self.eos_id = eos_id
@@ -758,6 +775,12 @@ class ContinuousBatchingServer:
             jnp.asarray(np.asarray([slot], np.int32)),
             state["prompt_padded"].shape[1])
         del self._prefilling[slot]
+        if self._draft is not None:
+            # The draft needs the SAME committed history before the
+            # slot's first spec round.  Whole-prompt in one dispatch:
+            # the draft is small by construction, so this does not
+            # reintroduce the batch stall chunked admission removes.
+            self._prefill_draft_rows([slot], state["prompt_padded"])
         self._activate_slot(slot, state["request"],
                             state["prompt_padded"],
                             state["prompt_len"])
@@ -805,15 +828,44 @@ class ContinuousBatchingServer:
                 if self._draft is not None:
                     # The draft needs the SAME committed history: its
                     # prompt KV lands in its own slot cache alongside.
-                    draft = self._draft
-                    draft_bucket = self._llama.init_cache(
-                        draft["config"], len(sub), padded)
-                    _, draft_bucket = self._llama.prefill(
-                        draft["params"], jnp.asarray(prompts),
-                        draft_bucket, draft["config"])
-                    draft["cache"] = self._insert_slots(
-                        draft["cache"], draft_bucket, slot_rows,
-                        padded)
+                    self._prefill_draft_rows(slots, prompts)
+
+    def _prefill_draft_rows(self, slots_list, prompts) -> None:
+        """Land the draft model's prompt KV for ``slots_list`` (its
+        contiguous per-slot cache rows), batched.  The ONE draft
+        admission path shared by every layout and admission mode:
+        whole-bucket waves, chunked-admission finishes, and the paged
+        server's per-request appends all funnel here — the draft has
+        no prefix cache and no pool, so it always prefills the whole
+        padded prompt regardless of what the target reused."""
+        draft, jax, jnp = self._draft, self._jax, self._jnp
+        if "insert" not in draft:
+            # Same insert-batch closure as the contiguous target
+            # layout, built lazily because the paged server's
+            # _init_layout never creates one.
+            @functools.partial(jax.jit, donate_argnames=("cache",),
+                               static_argnames=("padded",))
+            def draft_insert(cache, bucket_cache, slot_rows, padded):
+                new_cache = []
+                for cache_layer, filled in zip(cache, bucket_cache):
+                    layer = {}
+                    for key in cache_layer:
+                        dst = cache_layer[key]
+                        layer[key] = dst.at[slot_rows, :padded].set(
+                            filled[key].astype(dst.dtype))
+                    new_cache.append(layer)
+                return new_cache
+
+            draft["insert"] = draft_insert
+        padded = prompts.shape[1]
+        bucket = self._llama.init_cache(draft["config"],
+                                        len(slots_list), padded)
+        _, bucket = self._llama.prefill(
+            draft["params"], jnp.asarray(prompts), bucket,
+            draft["config"])
+        slot_rows = jnp.asarray(np.asarray(slots_list, np.int32))
+        draft["cache"] = draft["insert"](draft["cache"], bucket,
+                                         slot_rows, padded)
 
     def _reserve_slot(self, slot: int, padded: int, request) -> bool:
         """Capacity hook: claim layout resources for an admission.
@@ -1162,6 +1214,15 @@ class ContinuousBatchingServer:
             # One split per dispatched chunk — the RNG schedule the
             # sampled-determinism tests pin down.
             self._rng, rng_key = self._jax.random.split(self._rng)
+        # Snapshot slot occupancy BEFORE the dispatch: a mixed step
+        # whose slice finishes the prompt calls _finish_prefill →
+        # _activate_slot inside _serve_chunk, bumping the slot serial.
+        # The entry must carry the serials of the occupancy the
+        # program actually READ — copying after the bump would judge
+        # the freshly activated request by an active_after flag
+        # computed while its lane was still a scratch row, silently
+        # retiring it with zero tokens.
+        serial = self._slot_serial.copy()
         tokens_d, counts_d, self._state = self._serve_chunk(
             self._state, steps,
             -1 if self.eos_id is None else int(self.eos_id),
@@ -1172,7 +1233,7 @@ class ContinuousBatchingServer:
         self._ring.append(dict(
             kind="chunk", tokens=tokens_d, counts=counts_d,
             active_after=self._state["active"], steps=steps,
-            sched=sched, serial=self._slot_serial.copy()))
+            sched=sched, serial=serial))
         self._note_dispatch()
         return True
 
@@ -1237,9 +1298,7 @@ class ContinuousBatchingServer:
                 draft["params"], st["token"], draft["cache"],
                 st["positions"], st["active"], k, draft["config"])
         chunk = jnp.concatenate([st["token"], proposals], axis=1)
-        logits, self.cache = llama.verify_chunk_ragged(
-            self.params, chunk, self.cache, st["positions"],
-            st["active"], self.config, lora=lora)
+        logits = self._spec_verify(st, chunk, lora)
         from ..models.speculative import (greedy_accept_batch,
                                           mrs_accept_batch, spec_commit)
         if self._any_sampled:
@@ -1273,6 +1332,27 @@ class ContinuousBatchingServer:
             serial=self._slot_serial.copy()))
         self._note_dispatch()
         return True
+
+    def _spec_verify(self, st, chunk, lora):
+        """Target-verify dispatch hook (cache-layout strategy): score
+        the (slots, k+1) window against the resident cache, every row
+        at its own absolute position.  Contiguous layout appends into
+        the slot rows via :func:`~..models.llama.verify_chunk_ragged`;
+        the paged server overrides this with the pool-direct
+        :func:`~..models.llama.verify_chunk_paged` (and its TPEngine
+        twin under a replica mesh)."""
+        logits, self.cache = self._llama.verify_chunk_ragged(
+            self.params, chunk, self.cache, st["positions"],
+            st["active"], self.config, lora=lora)
+        return logits
+
+    def _note_spec_rollback(self, slot: int, advance: int,
+                            width: int) -> None:
+        """Layout hook: account KV rows a spec round wrote past the
+        committed frontier (``advance`` of ``width`` window rows
+        kept).  The contiguous layout has nothing to account — slot
+        rows are reserved wholesale; the paged server counts the
+        rolled-back BLOCKS (``spec_rollback_blocks``)."""
 
     def _note_dispatch(self) -> None:
         if self._serve_started is None:
@@ -1362,6 +1442,15 @@ class ContinuousBatchingServer:
                 # committed window for spec rounds (cache rows exist
                 # past the emit caps), the emitted prefix for chunks.
                 advance = int(counts_full[slot]) if spec else count
+                if spec:
+                    # Pre-advance mirror position = the window's first
+                    # written row; the layout hook turns the rejected
+                    # tail into its block-rollback accounting.
+                    self._note_spec_rollback(slot, advance,
+                                             self._draft["k"] + 1)
+                    if request.spec_accepted_rounds is None:
+                        request.spec_accepted_rounds = []
+                    request.spec_accepted_rounds.append(advance - 1)
                 self.positions[slot] += advance
                 self.tokens[slot, 0] = int(tokens[slot, advance - 1]) \
                     if spec else int(tokens[slot, count - 1])
@@ -1394,7 +1483,7 @@ class ContinuousBatchingServer:
         steps = self.counters["decode_steps"]
         elapsed = (time.monotonic() - self._serve_started
                    if self._serve_started is not None else 0.0)
-        return dict(
+        out = dict(
             self.counters,
             in_flight=len(self._ring),
             queue_depth=self.queue_depth,
@@ -1417,6 +1506,20 @@ class ContinuousBatchingServer:
             sync_stalls_per_100_steps=(
                 round(100.0 * self.counters["host_syncs"] / steps, 2)
                 if steps else 0.0))
+        if self._draft is not None:
+            # Speculation counters (host-side SpecStats increments in
+            # _consume_one — never traced, invariant 7).
+            out.update(
+                spec_k=self._draft["k"],
+                spec_rounds=self.spec_stats.target_passes,
+                spec_proposed=self.spec_stats.drafted,
+                spec_accepted=self.spec_stats.accepted,
+                spec_acceptance_rate=round(
+                    self.spec_stats.acceptance_rate, 4),
+                spec_tokens_per_target_pass=round(
+                    self.spec_stats.tokens_per_target_pass, 4),
+                spec_rollback_blocks=self.spec_stats.rollback_blocks)
+        return out
 
     def run_until_drained(self, max_chunks: int = 10_000):
         """Synchronous helper (tests / batch jobs): pump until every
@@ -1934,6 +2037,12 @@ class ContinuousReplica(Actor):
         else:
             outputs = {"tokens_out": np.asarray(request.tokens,
                                                 np.int32)}
+        if request.spec_accepted_rounds is not None:
+            # Per-round accepted-token counts (draft replicas only):
+            # the client-side acceptance histogram loadgen A/B runs
+            # aggregate without touching server internals.
+            outputs["spec_accepted_rounds"] = np.asarray(
+                request.spec_accepted_rounds, np.int32)
         served = request.error is None
         phases = self._phase_latencies(request)
         for phase, seconds in phases.items():

@@ -95,7 +95,10 @@ class PagedContinuousServer(ContinuousBatchingServer):
                  max_queue: Optional[int] = None,
                  watchdog_s: float = 0.0, replica_mesh=None,
                  host_tier_blocks: Optional[int] = None,
-                 restore_blocks_per_step: int = 4):
+                 restore_blocks_per_step: int = 4,
+                 draft_config_name: Optional[str] = None,
+                 draft_params=None, spec_k: int = 4,
+                 draft_quantize: bool = False):
         self.block_size = block_size
         self._requested_blocks = total_blocks
         self.enable_prefix_cache = enable_prefix_cache
@@ -120,7 +123,10 @@ class PagedContinuousServer(ContinuousBatchingServer):
                          params=params,
                          chunk_prefill_tokens=chunk_prefill_tokens,
                          max_queue=max_queue, watchdog_s=watchdog_s,
-                         replica_mesh=replica_mesh)
+                         replica_mesh=replica_mesh,
+                         draft_config_name=draft_config_name,
+                         draft_params=draft_params, spec_k=spec_k,
+                         draft_quantize=draft_quantize)
 
     # ------------------------------------------------------------- #
     # Layout hooks
@@ -289,11 +295,20 @@ class PagedContinuousServer(ContinuousBatchingServer):
     def _blocks_for(self, rows: int) -> int:
         return math.ceil(rows / self.block_size)
 
+    def _spec_headroom(self) -> int:
+        """Rows past the live position a speculative verify may write:
+        the (k+1)-token window lands at ``[pos, pos + k + 1)``, so a
+        spec-enabled reservation covers k+1 rows beyond the plain
+        worst case (the admission check already bounds prompt + new +
+        k + 1 by max_seq, so this never overflows a table)."""
+        return self._draft["k"] + 1 if self._draft is not None else 0
+
     def _worst_case_blocks(self, prompt_len: int, max_new: int) -> int:
         from .continuous import _bucket
         padded = min(_bucket(prompt_len, self._bucket_minimum),
                      self.max_seq)
-        return self._blocks_for(min(padded + max_new, self.max_seq))
+        return self._blocks_for(min(
+            padded + max_new + self._spec_headroom(), self.max_seq))
 
     def _admission_reject(self, prompt_len: int, request):
         reason = super()._admission_reject(prompt_len, request)
@@ -589,11 +604,13 @@ class PagedContinuousServer(ContinuousBatchingServer):
     def _reserve_slot(self, slot: int, padded: int, request) -> bool:
         # Worst case rows this request can ever touch: the padded
         # prompt bucket (prefill writes all its rows) or the prompt +
-        # every generated token, whichever is larger — and never more
-        # than max_seq (submit() bounds prompt+new to max_seq-1, so the
-        # bucket-rounded sum may overshoot max_seq while the rows
-        # actually touched cannot).
-        rows = min(padded + request.max_new_tokens, self.max_seq)
+        # every generated token — plus the speculative verify window's
+        # k+1 rows when a draft is configured — whichever is larger,
+        # and never more than max_seq (submit() bounds prompt+new to
+        # max_seq-1, so the bucket-rounded sum may overshoot max_seq
+        # while the rows actually touched cannot).
+        rows = min(padded + request.max_new_tokens
+                   + self._spec_headroom(), self.max_seq)
         needed = self._blocks_for(rows)
 
         prompt = np.asarray(request.prompt)
@@ -820,6 +837,12 @@ class PagedContinuousServer(ContinuousBatchingServer):
             self._note_prefill(width)
             start += width
             remaining -= size
+        if self._draft is not None:
+            # Draft prompt KV for this slot's contiguous draft cache —
+            # ALWAYS the whole padded prompt: the draft has no pool
+            # and no prefix cache, so target-side block reuse never
+            # shortens its prefill.
+            self._prefill_draft_rows([slot], prompt_padded)
 
     # ------------------------------------------------------------- #
     # Chunked prefill: mixed prefill/decode steps
@@ -866,10 +889,13 @@ class PagedContinuousServer(ContinuousBatchingServer):
         dispatch (one slice per chunk, inside the same jitted program
         as decode) — standalone advance here would double-prefill.
         Only when no decode can be scheduled do slices run standalone,
-        one per prefilling slot per step."""
+        one per prefilling slot per step.  SPECULATIVE rounds never
+        run the mixed step (the verify chunk is its own program), so
+        with a draft configured the slices always advance standalone —
+        interleaved between spec rounds, one slice per step."""
         if not self._prefilling:
             return
-        if (self._plan_remaining() > 0).any():
+        if self._draft is None and (self._plan_remaining() > 0).any():
             return
         llama, jnp = self._llama, self._jnp
         for slot in list(self._prefilling):
@@ -902,6 +928,10 @@ class PagedContinuousServer(ContinuousBatchingServer):
             if owner == slot:
                 del self._producing[block]
         del self._prefilling[slot]
+        if self._draft is not None:
+            # Whole-prompt draft prefill at the chunked finish (the
+            # draft is small — one dispatch, no batch stall).
+            self._prefill_draft_rows([slot], state["prompt_padded"])
         self._activate_slot(slot, state["request"],
                             state["prompt_padded"],
                             state["prompt_len"])
@@ -981,6 +1011,48 @@ class PagedContinuousServer(ContinuousBatchingServer):
         if prefill["start"] >= prefill["prompt_len"]:
             self._finish_prefill(slot, prefill)
         return tokens_d, counts_d, new_state
+
+    # ------------------------------------------------------------- #
+    # Speculative decoding on the paged path
+
+    def _spec_verify(self, st, chunk, lora):
+        """Pool-direct verify: the (slots, k+1) window's K/V appends
+        straight into each slot's table-resolved blocks (ragged
+        starts, in-kernel int8 quant — no gather, no bucket,
+        jaxpr-guarded in tests/test_spec_paged.py), logits come back
+        for the acceptance kernel.  Inactive rows (chunked prefills in
+        flight, free slots) write scratch block 0.  Rejected tails
+        stay as stale rows behind the absolute-position mask; the
+        commit consumer counts them via :meth:`_note_spec_rollback`."""
+        if self._tp_engine is not None:
+            logits, self.pool = self._tp_engine.verify_chunk_paged(
+                self.params, chunk, self.pool, st["tables"],
+                st["positions"], st["active"])
+            return logits
+        logits, self.pool = self._llama.verify_chunk_paged(
+            self.params, chunk, self.pool, st["tables"],
+            st["positions"], st["active"], self.config, lora=lora)
+        return logits
+
+    def _note_spec_rollback(self, slot: int, advance: int,
+                            width: int) -> None:
+        """Count blocks the verify window touched BEYOND the committed
+        frontier: rows ``[pos + advance, pos + width)`` hold rejected
+        speculation.  Rollback is LOGICAL, not a free: worst-case
+        reservation already owns these blocks for the request's own
+        future tokens, the stale rows are unattendable (absolute-
+        position mask) and are rewritten by later rounds before any
+        position makes them reachable — and none of them are ever
+        registered in the prefix index (_reserve_slot indexes only
+        full blocks strictly before prompt_len-1), so speculated
+        content can never be exported, matched, or demoted.  The
+        counter measures discarded speculative write work."""
+        pos = int(self.positions[slot])       # pre-advance mirror
+        block_size = self.block_size
+        last_written = (pos + width - 1) // block_size
+        last_committed = (pos + advance - 1) // block_size
+        self.spec_stats.rollback_blocks += max(
+            0, last_written - last_committed)
 
     # ------------------------------------------------------------- #
     # Distributed KV cache (kvstore subsystem) — ALL host-side: none

@@ -76,6 +76,10 @@ TELEMETRY_KEYS = (
     "prefill_attention_path",
     "deadline_exceeded", "shed", "watchdog_trips", "free_slots",
     "healthy", "tp_degree", "mesh_shape",
+    # Speculative decoding (present only when a draft is configured)
+    "spec_k", "spec_rounds", "spec_proposed", "spec_accepted",
+    "spec_acceptance_rate", "spec_tokens_per_target_pass",
+    "spec_rollback_blocks",
 )
 
 

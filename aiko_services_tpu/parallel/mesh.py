@@ -86,6 +86,12 @@ class ReplicaMesh:
     feature axis, and the per-slot decode state stays replicated so
     the host-side admission/commit protocol is mesh-agnostic.
 
+    A speculative DRAFT model rides the same mesh fully REPLICATED
+    (params + its contiguous cache): draft passes run collective-free
+    on every chip, identical by construction, and only the target's
+    verify/decode programs shard — so TP spec serving stays bitwise
+    equal to single-chip (ARCHITECTURE invariants 9 + 11).
+
     ``tp=1`` degenerates to the single-chip layout (a 1-device mesh).
     """
 

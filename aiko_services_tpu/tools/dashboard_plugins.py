@@ -267,6 +267,20 @@ def model_replica_plugin(fields, variables) -> List[str]:
                 f" restoring, "
                 f"{_get(variables, 'prefix_hits_host', default=0)}"
                 f" host hits")
+        spec_rounds = _get(variables, "spec_rounds", default=None)
+        if spec_rounds not in (None, "-"):
+            lines.append(
+                f"  spec:      k={_get(variables, 'spec_k', default='?')}, "
+                f"{spec_rounds} rounds, "
+                f"{_get(variables, 'spec_accepted', default=0)}"
+                f"/{_get(variables, 'spec_proposed', default=0)}"
+                f" accepted "
+                f"({_get(variables, 'spec_acceptance_rate', default=0)}"
+                f" rate), "
+                f"{_get(variables, 'spec_tokens_per_target_pass', default=0)}"
+                f" tok/pass, "
+                f"{_get(variables, 'spec_rollback_blocks', default=0)}"
+                f" rollback blocks")
     adapters = _get(variables, "adapters", default=None)
     if adapters not in (None, "-", ""):
         lines.append(f"  adapters:  {adapters}")

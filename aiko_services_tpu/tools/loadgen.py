@@ -107,6 +107,16 @@ class LoadReport:
     #: vs tier-off chaos must produce identical greedy tokens).
     final_tokens: Dict[str, List[int]] = dataclasses.field(
         default_factory=dict)
+    #: request id -> per-spec-round accepted-token counts as stamped
+    #: by draft-enabled replicas; empty when the fleet runs no draft.
+    #: An A/B run reports the acceptance distribution per request —
+    #: the number that explains WHERE speculative decoding paid off
+    #: (long accepted runs) and where it degraded to plain decode.
+    spec_accept_hist: Dict[str, List[int]] = dataclasses.field(
+        default_factory=dict)
+    #: Fleet speculative counters (Σ over replicas of the server
+    #: ``spec_*`` stats); None when no replica runs a draft.
+    spec_stats: Optional[Dict] = None
 
     @property
     def lost(self) -> int:
@@ -274,6 +284,9 @@ class LoadGenerator:
         self.partial_tokens: Dict[str, List[int]] = {}
         #: request_id -> the final response's token list.
         self.final_tokens: Dict[str, List[int]] = {}
+        #: request_id -> per-spec-round accepted-token counts as
+        #: stamped by draft-enabled replicas (absent otherwise).
+        self.spec_accept_hist: Dict[str, List[int]] = {}
         self._completed_ids: set = set()
         self._duplicate_finals = 0
         # Tracing (rides the global trace.TRACER switchboard): root
@@ -309,6 +322,15 @@ class LoadGenerator:
         self._completed_ids.add(request_id)
         outputs = params[1] if len(params) > 1 else {}
         self._record_final_tokens(request_id, outputs)
+        if isinstance(outputs, dict) and "spec_accepted_rounds" in outputs:
+            try:
+                from ..pipeline.codec import decode_value
+                import numpy as np
+                self.spec_accept_hist[request_id] = [
+                    int(count) for count in np.asarray(decode_value(
+                        outputs["spec_accepted_rounds"])).reshape(-1)]
+            except Exception:  # noqa: BLE001 - telemetry only
+                pass
         self._collect_trace(request_id, started, outputs)
         if isinstance(outputs, dict) and "error" in outputs:
             self._errors += 1
@@ -452,6 +474,7 @@ class LoadGenerator:
         self._traces = []
         self.partial_tokens = {}
         self.final_tokens = {}
+        self.spec_accept_hist = {}
         self._completed_ids = set()
         self._duplicate_finals = 0
         self._run_index += 1
@@ -666,6 +689,42 @@ def _attach_kv_rates(report: LoadReport, totals: Dict) -> None:
     report.kv_transfer_bytes = totals["kv_transfer_bytes"]
 
 
+def _fleet_spec_stats(servers) -> Optional[Dict]:
+    """Σ the per-replica speculative counters (None when no replica
+    runs a draft).  Rates are recomputed from the summed raw counts —
+    averaging per-replica rates would weight idle replicas equally."""
+    totals: Dict[str, float] = {}
+    for server in servers:
+        stats = server.stats()
+        if "spec_rounds" not in stats:
+            continue
+        for key in ("spec_rounds", "spec_proposed", "spec_accepted",
+                    "spec_rollback_blocks"):
+            totals[key] = totals.get(key, 0) + int(stats[key])
+    if not totals:
+        return None
+    proposed = totals["spec_proposed"]
+    rounds = totals["spec_rounds"]
+    totals["spec_acceptance_rate"] = round(
+        totals["spec_accepted"] / proposed, 4) if proposed else 0.0
+    totals["spec_tokens_per_target_pass"] = round(
+        (totals["spec_accepted"] + rounds) / rounds, 4) \
+        if rounds else 0.0
+    return totals
+
+
+def _enable_paired_draft(server, spec_k: int) -> None:
+    """Alias the target weights in as the draft (the 'paired toy'):
+    on the tiny CPU configs a real small draft is meaningless, and an
+    identical draft gives the HIGH-acceptance regime — multi-token
+    commits every round — while greedy outputs stay bitwise equal to
+    the plain server by the verify construction (what the A/B run
+    asserts).  Counters and histograms then show the mechanism at
+    full stretch instead of degenerating to acceptance ≈ 0."""
+    server._draft["params"] = server.params
+    server._draft["config"] = server.config
+
+
 def run_shared_prefix(n_requests: int = 24, rate_hz: float = 50.0,
                       n_conversations: int = 3, turns: int = 4,
                       system_len: int = 48,
@@ -674,7 +733,8 @@ def run_shared_prefix(n_requests: int = 24, rate_hz: float = 50.0,
                       drain_timeout_s: float = 90.0,
                       seed: int = 0,
                       trace_out: Optional[str] = None,
-                      trace_top: int = 5) -> LoadReport:
+                      trace_top: int = 5,
+                      spec_k: int = 0) -> LoadReport:
     """In-process 2-replica PAGED serving rig (prefix caches on)
     driven by :func:`shared_prefix_payloads` through a ReplicaRouter.
     ``prefix_routing=False`` degrades the router to pure
@@ -725,7 +785,11 @@ def run_shared_prefix(n_requests: int = 24, rate_hz: float = 50.0,
             server = PagedContinuousServer(
                 config_name="tiny", slots=2, chunk_steps=4, seed=0,
                 enable_prefix_cache=True, max_queue=256,
-                watchdog_s=5.0)
+                watchdog_s=5.0,
+                draft_config_name="tiny" if spec_k else None,
+                spec_k=spec_k or 4)
+            if spec_k:
+                _enable_paired_draft(server, spec_k)
             servers.append(server)
             compose_instance(ContinuousReplica, actor_args(name),
                              process=make_process(2 + index),
@@ -748,6 +812,9 @@ def run_shared_prefix(n_requests: int = 24, rate_hz: float = 50.0,
         totals = _fleet_kv_stats(servers)
         _attach_kv_rates(report, totals)
         report.fleet_latency_ms = fleet_latency(servers)
+        report.final_tokens = dict(generator.final_tokens)
+        report.spec_stats = _fleet_spec_stats(servers)
+        report.spec_accept_hist = dict(generator.spec_accept_hist)
         report.server_stats = dict(
             router.counters, **totals,
             kv_directory_size=router.share.get("kv_directory_size", 0))
@@ -933,7 +1000,8 @@ def run_chaos(seed: int = 0, n_requests: int = 40,
               drain_timeout_s: float = 90.0,
               total_blocks: Optional[int] = None,
               host_tier_blocks: int = 0,
-              restore_blocks_per_step: int = 2) -> LoadReport:
+              restore_blocks_per_step: int = 2,
+              spec_k: int = 0) -> LoadReport:
     """Run an in-process 2-replica serving rig (loopback broker, real
     event engine, Registrar + router) under :func:`chaos_schedule` and
     return the LoadReport.  The invariant a chaos run checks:
@@ -990,7 +1058,14 @@ def run_chaos(seed: int = 0, n_requests: int = 40,
                 enable_prefix_cache=True, max_queue=256,
                 watchdog_s=5.0, total_blocks=total_blocks,
                 host_tier_blocks=host_tier_blocks,
-                restore_blocks_per_step=restore_blocks_per_step)
+                restore_blocks_per_step=restore_blocks_per_step,
+                draft_config_name="tiny" if spec_k else None,
+                spec_k=spec_k or 4)
+            if spec_k:
+                # Kill-mid-spec-round coverage: greedy determinism +
+                # idempotent replay must hold through rejected-tail
+                # rollbacks exactly as through plain decode.
+                _enable_paired_draft(server, spec_k)
             servers.append(server)
             compose_instance(ContinuousReplica, actor_args(name),
                              process=make_process(2 + index),
@@ -1017,6 +1092,8 @@ def run_chaos(seed: int = 0, n_requests: int = 40,
         _attach_kv_rates(report, totals)
         report.final_tokens = dict(generator.final_tokens)
         report.fleet_latency_ms = fleet_latency(servers)
+        report.spec_stats = _fleet_spec_stats(servers)
+        report.spec_accept_hist = dict(generator.spec_accept_hist)
         report.server_stats = dict(
             router.counters, **totals,
             replicas_live=router.share["replicas"],
@@ -1033,6 +1110,54 @@ def run_chaos(seed: int = 0, n_requests: int = 40,
                 pass           # already killed this process
         engine.terminate()
         thread.join(timeout=5)
+
+
+def run_spec_ab(spec_k: int = 4, n_requests: int = 24,
+                rate_hz: float = 50.0, seed: int = 0,
+                chaos: bool = False,
+                drain_timeout_s: float = 90.0
+                ) -> Tuple[LoadReport, LoadReport]:
+    """A/B gate for speculative decoding on the serving path: the SAME
+    seeded payload sequence through the same 2-replica paged rig, once
+    plain and once with a ``spec_k``-token paired draft, asserting the
+    greedy outputs are BIT-EXACT request for request.  ``chaos=True``
+    runs both sides under :func:`chaos_schedule` instead — a replica
+    dying mid-spec-round must re-dispatch idempotently (zero lost,
+    zero duplicate finals) and still match the plain side token for
+    token, which rules out half-committed speculative state leaking
+    across the replay.  Returns ``(base_report, spec_report)``; the
+    spec report carries the fleet ``spec_stats`` counters and the
+    per-request ``spec_accept_hist`` acceptance histograms."""
+    if chaos:
+        base = run_chaos(seed=seed, n_requests=n_requests,
+                         rate_hz=rate_hz,
+                         drain_timeout_s=drain_timeout_s)
+        spec = run_chaos(seed=seed, n_requests=n_requests,
+                         rate_hz=rate_hz,
+                         drain_timeout_s=drain_timeout_s,
+                         spec_k=spec_k)
+    else:
+        base = run_shared_prefix(n_requests=n_requests,
+                                 rate_hz=rate_hz, seed=seed,
+                                 drain_timeout_s=drain_timeout_s)
+        spec = run_shared_prefix(n_requests=n_requests,
+                                 rate_hz=rate_hz, seed=seed,
+                                 drain_timeout_s=drain_timeout_s,
+                                 spec_k=spec_k)
+    both = set(base.final_tokens) & set(spec.final_tokens)
+    mismatched = [request_id for request_id in sorted(both)
+                  if base.final_tokens[request_id]
+                  != spec.final_tokens[request_id]]
+    if mismatched:
+        raise AssertionError(
+            f"spec A/B not bit-exact (spec_k={spec_k}, seed={seed}): "
+            f"{len(mismatched)}/{len(both)} requests diverged, first "
+            f"{mismatched[0]}")
+    if not both:
+        raise AssertionError(
+            "spec A/B compared zero requests — both runs completed "
+            "disjoint id sets, the gate proved nothing")
+    return base, spec
 
 
 def diurnal_trace(duration_s: float, base_hz: float = 2.0,
@@ -1455,7 +1580,42 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser.add_argument("--trace-top", type=int, default=5,
                         help="how many slowest requests --trace-out "
                              "dumps")
+    parser.add_argument("--spec-k", type=int, default=0,
+                        help="speculative A/B gate: run the seeded "
+                             "payload sequence plain AND with a "
+                             "k-token paired draft, assert BIT-EXACT "
+                             "outputs, report acceptance histograms "
+                             "(composes with --chaos: both sides run "
+                             "the fault schedule)")
     args = parser.parse_args(argv)
+    if args.spec_k:
+        base, spec = run_spec_ab(
+            spec_k=args.spec_k, n_requests=args.requests,
+            rate_hz=args.rate_hz, seed=args.seed, chaos=args.chaos)
+        print("base:", base)
+        print("spec:", spec)
+        print(f"fleet spec counters: {spec.spec_stats}")
+        lengths = sorted(len(hist) for hist
+                         in spec.spec_accept_hist.values())
+        accepted = [count for hist in spec.spec_accept_hist.values()
+                    for count in hist]
+        mean_accept = (statistics.fmean(accepted) if accepted else 0.0)
+        print(f"accept histograms: {len(lengths)} requests, "
+              f"rounds/request p50="
+              f"{lengths[len(lengths) // 2] if lengths else 0}, "
+              f"mean accepted/round={mean_accept:.2f}")
+        if args.chaos and (spec.lost or spec.timeouts
+                           or spec.duplicate_finals):
+            print(f"SPEC CHAOS FAIL (seed={args.seed}): "
+                  f"{spec.lost} lost, {spec.timeouts} hung, "
+                  f"{spec.duplicate_finals} duplicated")
+            return 1
+        mode = "chaos" if args.chaos else "shared_prefix"
+        print(f"SPEC A/B OK (k={args.spec_k}, {mode}, "
+              f"seed={args.seed}): bit-exact, "
+              f"tokens/target-pass="
+              f"{(spec.spec_stats or {}).get('spec_tokens_per_target_pass')}")
+        return 0
     if args.elastic_chaos:
         report = run_elastic_chaos(seed=args.seed,
                                    duration_s=args.duration,

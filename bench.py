@@ -1291,6 +1291,122 @@ def bench_serving_paged(slots=8, prompt_len=64, max_new=64,
     return out
 
 
+def bench_serving_spec(slots=4, prompt_len=64, max_new=64,
+                       n_requests=8, config_name="small",
+                       chunk_steps=8, ks=(2, 4, 8)):
+    """Speculative decoding A/B on the PAGED production path: the same
+    seeded request batch decoded plain and with a k-token draft, for
+    k ∈ ``ks`` and both KV dtypes (bf16 pool and int8+scales pool).
+    The paired-toy draft (target weights aliased in as the draft)
+    gives the high-acceptance regime — the mechanism's ceiling: every
+    verify pass commits up to k+1 tokens for ONE target forward, so
+    tokens/target-pass approaches k+1 while wall-clock latency shows
+    what the extra draft passes and the wider verify cost back.  A
+    degraded draft (the default independently-initialized weights —
+    acceptance ≈ 0 on random toys) sweeps the loss regime: every
+    round still commits its one bonus token, so correctness holds but
+    tokens/target-pass pins at ~1 and spec pays the draft for
+    nothing.  Greedy outputs are asserted IDENTICAL to the plain
+    server in every cell — the bitwise-equality invariant riding the
+    bench, not just the test suite."""
+    from aiko_services_tpu.orchestration.continuous import (
+        DecodeRequest, _bucket,
+    )
+    from aiko_services_tpu.orchestration.paged import (
+        PagedContinuousServer,
+    )
+
+    block_size = 16
+    max_seq = _bucket(prompt_len) + max_new + chunk_steps + 16
+    max_seq += -max_seq % block_size
+
+    def build(spec_k=0, paired=True, quantize_kv=False):
+        server = PagedContinuousServer(
+            config_name=config_name, slots=slots, max_seq=max_seq,
+            chunk_steps=chunk_steps, quantize=True,
+            quantize_kv=quantize_kv, block_size=block_size,
+            draft_config_name=config_name if spec_k else None,
+            spec_k=spec_k or 4)
+        if spec_k and paired:
+            server._draft["params"] = server.params
+            server._draft["config"] = server.config
+        return server
+
+    def run(server, tag):
+        rng = np.random.default_rng(7)
+        requests = [DecodeRequest(
+            request_id=f"{tag}{i}",
+            prompt=rng.integers(1, server.config.vocab_size,
+                                prompt_len).astype(np.int32),
+            max_new_tokens=max_new) for i in range(n_requests)]
+        for request in requests[:slots]:      # warmup wave compiles
+            server.submit(request)
+        server.run_until_drained()
+        for request in requests[slots:]:
+            server.submit(request)
+        started = time.perf_counter()
+        server.run_until_drained()
+        elapsed = time.perf_counter() - started
+        tokens = sum(len(r.tokens) for r in requests[slots:])
+        # Tag-independent keys so A/B cells compare across runs.
+        return ({index: list(r.tokens)
+                 for index, r in enumerate(requests)},
+                tokens / elapsed, server.stats())
+
+    out = {}
+    plain_maps = {}
+    for kv_tag, quantize_kv in (("bf16", False), ("int8", True)):
+        plain, plain_tps, _ = run(build(quantize_kv=quantize_kv),
+                                  f"p{kv_tag}")
+        plain_maps[kv_tag] = plain
+        log(f"serving[spec] plain {kv_tag} KV: {plain_tps:.0f} tok/s")
+        out[f"serving_spec_plain_{kv_tag}_tokens_per_sec"] = \
+            round(plain_tps)
+        for k in ks:
+            spec, spec_tps, stats = run(
+                build(spec_k=k, quantize_kv=quantize_kv),
+                f"s{kv_tag}{k}")
+            if spec != plain:
+                raise AssertionError(
+                    f"serving_spec: spec k={k} {kv_tag} outputs "
+                    f"diverged from plain greedy — the bitwise "
+                    f"invariant is broken")
+            tpp = stats["spec_tokens_per_target_pass"]
+            log(f"serving[spec] k={k} {kv_tag} KV: {spec_tps:.0f} "
+                f"tok/s ({spec_tps / plain_tps:.2f}x plain), "
+                f"{tpp} tok/target-pass, acceptance "
+                f"{stats['spec_acceptance_rate']}, "
+                f"{stats['spec_rollback_blocks']} rollback blocks "
+                f"— outputs exact")
+            out[f"serving_spec_k{k}_{kv_tag}_tokens_per_sec"] = \
+                round(spec_tps)
+            out[f"serving_spec_k{k}_{kv_tag}_speedup"] = round(
+                spec_tps / plain_tps, 2)
+            out[f"serving_spec_k{k}_{kv_tag}_tokens_per_target_pass"] \
+                = tpp
+            out[f"serving_spec_k{k}_{kv_tag}_acceptance_rate"] = \
+                stats["spec_acceptance_rate"]
+    # Degraded-draft sweep: independently-initialized draft weights,
+    # the acceptance floor (≈ 0 on random toys).  Still bit-exact.
+    degraded, degraded_tps, stats = run(
+        build(spec_k=4, paired=False), "d")
+    plain4 = out["serving_spec_plain_bf16_tokens_per_sec"]
+    if degraded != plain_maps["bf16"]:
+        raise AssertionError(
+            "serving_spec: degraded-draft outputs diverged from "
+            "plain greedy")
+    log(f"serving[spec] degraded draft k=4: {degraded_tps:.0f} tok/s "
+        f"(plain {plain4}), acceptance "
+        f"{stats['spec_acceptance_rate']}, "
+        f"{stats['spec_tokens_per_target_pass']} tok/target-pass")
+    out["serving_spec_degraded_tokens_per_sec"] = round(degraded_tps)
+    out["serving_spec_degraded_acceptance_rate"] = \
+        stats["spec_acceptance_rate"]
+    out["serving_spec_degraded_tokens_per_target_pass"] = \
+        stats["spec_tokens_per_target_pass"]
+    return out
+
+
 def bench_kv_transfer(prefix_lens=(512, 2048, 8192),
                       routed_requests=16, routed_rate_hz=30.0):
     """Distributed KV-cache numbers: (1) cross-replica block
@@ -2147,6 +2263,14 @@ SECTIONS = [
          slots=2, prompt_len=24, max_new=8, n_requests=4,
          config_name="tiny", chunk_steps=4, shared_prefix=16))
      if SMOKE else bench_serving_paged),
+    # Speculative decoding A/B on the paged path: k sweep x KV dtype,
+    # paired-toy ceiling + degraded-draft floor, bitwise-equality
+    # asserted in every cell (tiny model in SMOKE, CPU-capable).
+    ("serving_spec", 700,
+     (lambda: bench_serving_spec(
+         slots=2, prompt_len=24, max_new=8, n_requests=4,
+         config_name="tiny", chunk_steps=4, ks=(4,)))
+     if SMOKE else bench_serving_spec),
     # Distributed KV cache: host-side transfer bandwidth (no device,
     # no compile) + routed-vs-load-only TTFT through the live rig
     # (tiny model, CPU-capable like serving_faults).

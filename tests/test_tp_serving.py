@@ -240,10 +240,12 @@ def test_replica_mesh_validation():
 
 
 def test_tp_rejects_unsupported_compositions(virtual_mesh_devices):
-    with pytest.raises(ValueError, match="speculative"):
-        ContinuousBatchingServer(config_name="tiny_tp",
-                                 replica_mesh=ReplicaMesh(tp=2),
-                                 draft_config_name="tiny_tp")
+    # Speculative decoding now COMPOSES with replica_mesh (draft
+    # replicated on the mesh) — the PR 3 rejection is gone.
+    server = ContinuousBatchingServer(config_name="tiny_tp",
+                                      replica_mesh=ReplicaMesh(tp=2),
+                                      draft_config_name="tiny_tp")
+    assert server._draft is not None and server.tp_degree == 2
     from aiko_services_tpu.models.lora import LoRAConfig
     with pytest.raises(ValueError, match="LoRA"):
         ContinuousBatchingServer(config_name="tiny_tp",
