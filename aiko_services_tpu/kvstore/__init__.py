@@ -16,7 +16,10 @@ routing"):
 3. **KV block transfer** (:mod:`.transfer`) — a replica→replica RPC
    exporting table-resolved pool blocks (bf16 or int8 + scales) and
    importing them into a peer's pool under a lease: warm-start and
-   opt-in prefill/decode disaggregation.
+   opt-in prefill/decode disaggregation.  Movement rides a FUSED
+   staging-buffer engine: one-sync export, one-upload import, and
+   step-overlapped async landing behind the tier sentinel — the same
+   primitives back host-tier demote/restore.
 
 Everything here is HOST-side: no function in this package may appear
 in (or change) a traced serve-chunk program — regression-locked by the
@@ -25,10 +28,13 @@ jaxpr/AST guards in tests/test_kvstore.py.
 
 from .directory import (PrefixDirectory, chain_keys, chain_keys_hex,
                         digest_decode, digest_encode, shareable_blocks)
-from .transfer import (export_payload, import_payload, payload_bytes,
-                       pool_signature, seed_chain)
+from .transfer import (export_payload, gather_block_rows,
+                       import_payload, payload_bytes, pool_signature,
+                       scatter_block_row_dicts, scatter_block_rows,
+                       seed_chain)
 
 __all__ = ["PrefixDirectory", "chain_keys", "chain_keys_hex",
            "digest_decode", "digest_encode", "shareable_blocks",
            "export_payload", "import_payload", "payload_bytes",
-           "pool_signature", "seed_chain"]
+           "pool_signature", "seed_chain", "gather_block_rows",
+           "scatter_block_rows", "scatter_block_row_dicts"]

@@ -1,22 +1,47 @@
 """Cross-replica KV block transfer: export/import of pool blocks.
 
 A replica→replica RPC body: the owner resolves directory-width hex
-keys through its full-key prefix index, gathers the table-resolved
-pool rows HOST-side (``np.asarray`` pulls; never inside a jitted
-program — the jaxpr guard in tests/test_kvstore.py pins this), and
-ships them as a swag-codec dict.  A chain demoted to the owner's
-host tier exports straight from its host rows — no promotion.  The
+keys through its full-key prefix index and gathers the table-resolved
+pool rows through the FUSED STAGING BUFFER engine — one jitted
+device-side gather across every layer and buffer concatenates the
+selected rows' raw bytes into a single contiguous staging array,
+pulled to host with ONE device sync (``kv_export_sync_count``); the
+wire fields are zero-copy views of that buffer (bf16 rows view as
+uint16 bit patterns in place — no per-field ``np.stack``, no
+``ascontiguousarray`` re-copy).  A chain demoted to the owner's host
+tier exports straight from its host rows — no promotion.  The
 importer allocates blocks from its own pool (evicting — demoting,
 when a host tier is configured — cold cached prefixes if needed),
-writes the rows back with one ``.at[blocks].set`` per layer buffer,
-and registers the chain keys in its prefix index under a lease,
-pinned until adopted by an admission or released at expiry.
+assembles the inbound rows into one staging buffer HOST-side, uploads
+it with ONE host→device transfer, writes every layer back with one
+fused batched scatter (TP re-pin included), and registers the chain
+keys in its prefix index under a lease, pinned until adopted by an
+admission or released at expiry.
 
-The same gather/scatter primitives back the TIERED KV cache:
-:func:`gather_block_rows` is the demotion copy (device→host),
-:func:`scatter_block_rows` the restore upload (host→device) — one
-codec, three movers (wire, demote, restore), so bit-exactness is
-proved once.
+On the serving path imports are ASYNC and step-overlapped
+(``async_import=True``): the keys register immediately behind the
+tiered-cache ``RESTORING`` producing sentinel and the rows land a few
+blocks per engine step through the same queue as host-tier restores —
+decode never stalls on an inbound segment, no reader ever sees a
+half-landed chain, and the lease arms only when the last block lands
+(``kv_imports_async``).
+
+The same fused primitives back the TIERED KV cache:
+:func:`gather_block_rows` is the demotion copy (device→host, one
+sync per victim batch), :func:`scatter_block_rows` /
+:func:`scatter_block_row_dicts` the restore upload (host→device, one
+upload per landing batch) — one codec, three movers (wire, demote,
+restore), so bit-exactness is proved once.  The pre-fusion per-layer
+implementations survive as ``*_legacy`` for the bench A/B and the
+byte-identity tests.
+
+Shape discipline: the big fused gather/scatter programs compile once
+per power-of-two id bucket (``_bucket_ids``).  Padding never crosses
+the PCIe bus: a tiny slices-and-concatenate program trims the
+duplicate rows DEVICE-side before the one host pull (export), and the
+import uploads exactly the inbound rows, padding the staging
+host-side with repeated last-row bytes (duplicate scatter ids write
+identical content, so the pad is shape stability only).
 
 Wire format (swag dict values; arrays ride the numpy codec tag):
 
@@ -50,16 +75,17 @@ Bit-exactness: exported rows are the owner's pool bytes verbatim
 (bf16, or int8 + f32 scales), and :func:`shareable_blocks` guarantees
 an imported block is never rewritten by the importer's admission
 seed — so greedy decode after an imported prefix exactly equals local
-prefill (asserted for both pool dtypes in tests/test_kvstore.py).
+prefill (asserted for both pool dtypes in tests/test_kvstore.py; the
+fused-vs-legacy byte identity in tests/test_kv_transfer_fast.py).
 
 Tensor-parallel replicas: a TP replica's pool is a kv-head-sharded
 global ``jax.Array``, but the wire format stays the FULL kv-head
-width — export gathers full rows from the shards, import scatters
-them back and re-pins the pool sharding.  Replicas with different TP
-degrees (including TP=1) therefore exchange blocks with no layout
-negotiation beyond :func:`pool_signature`, which is mesh-agnostic by
-construction (tested: TP=2 → TP=4 greedy handoff is bit-exact in
-bf16 and int8).
+width — the fused gather assembles full rows from the shards, the
+fused scatter writes them back and re-pins the pool sharding.
+Replicas with different TP degrees (including TP=1) therefore
+exchange blocks with no layout negotiation beyond
+:func:`pool_signature`, which is mesh-agnostic by construction
+(tested: TP=2 → TP=4 greedy handoff is bit-exact in bf16 and int8).
 """
 
 from __future__ import annotations
@@ -73,7 +99,8 @@ from .directory import HEX_KEY_CHARS, chain_keys, shareable_blocks
 
 __all__ = ["pool_signature", "export_payload", "import_payload",
            "payload_bytes", "seed_chain", "gather_block_rows",
-           "scatter_block_rows"]
+           "scatter_block_rows", "scatter_block_row_dicts",
+           "gather_block_rows_legacy", "scatter_block_rows_legacy"]
 
 _BF16 = "bfloat16"
 
@@ -97,10 +124,23 @@ def payload_bytes(payload: Dict) -> int:
 
 def _pack(array: np.ndarray) -> np.ndarray:
     # np.save cannot round-trip ml_dtypes bfloat16 (loads as void16);
-    # ship the bit pattern and record the dtype out of band.
+    # ship the bit pattern and record the dtype out of band.  The
+    # legacy codec helper — the fused path views bit patterns in
+    # place (:func:`_pack_view`) instead of re-copying.
     if array.dtype.name == _BF16:
         return array.view(np.uint16)
     return np.ascontiguousarray(array)
+
+
+def _pack_view(array: np.ndarray) -> np.ndarray:
+    """Zero-copy wire packing: bf16 views as its uint16 bit pattern
+    without the contiguity re-copy ``_pack`` pays (staging views are
+    contiguous by construction)."""
+    if array.dtype.name == _BF16:
+        if not array.flags["C_CONTIGUOUS"]:
+            array = np.ascontiguousarray(array)
+        return array.view(np.uint16)
+    return array
 
 
 def _unpack(array: np.ndarray, dtype_name: str,
@@ -116,8 +156,9 @@ def _bucket_ids(blocks: List[int]) -> np.ndarray:
     operand shape; demote/restore batch sizes vary per admission, and
     without bucketing every new size pays a ~100 ms compile — which
     dwarfed the recompute the host tier saves.  Repeating an id is
-    shape-safe in both directions: gathered duplicates are sliced
-    off, scattered duplicates write the same row twice."""
+    shape-safe in both directions: gathered duplicates are trimmed
+    DEVICE-side before the host pull (they never cross the bus), and
+    scattered duplicates write the same row twice."""
     ids = np.asarray(blocks, np.int32)
     size = 1
     while size < len(ids):
@@ -128,16 +169,171 @@ def _bucket_ids(blocks: List[int]) -> np.ndarray:
     return ids
 
 
+# ---------------------------------------------------------------- #
+# Fused staging-buffer engine.  The pool crosses the host/device
+# boundary as ONE contiguous uint8 staging array in field-major
+# order: for every layer×buffer (sorted name order within a layer),
+# the selected blocks' raw bytes sit in one contiguous span, so each
+# host-side field is a zero-copy ``.view(dtype)`` of its span.  The
+# big gather/scatter programs compile once per pow2 id bucket; the
+# only shape-varying program is a trivial slices-and-concatenate
+# trim, orders of magnitude cheaper to compile than the gather it
+# feeds.
+
+_JITS: Dict[str, object] = {}
+
+
+def _field_layout(server) -> List[tuple]:
+    """Ordered staging schema: ``(field, per-row shape, dtype,
+    row_bytes)`` per layer buffer, sorted buffer name within layer —
+    the exact iteration order of the traced programs below (jax
+    pytree flattening sorts dict keys, so sorted order is the one
+    order host and device agree on)."""
+    layout = []
+    for layer, buffers in enumerate(server.pool):
+        for name in sorted(buffers):
+            buf = buffers[name]
+            shape = tuple(int(s) for s in buf.shape[1:])
+            dtype = np.dtype(buf.dtype)
+            layout.append((f"l{layer}_{name}", shape, dtype,
+                           int(np.prod(shape)) * dtype.itemsize))
+    return layout
+
+
+def _jit_gather(jax_mod, jnp_mod):
+    fn = _JITS.get("gather")
+    if fn is None:
+        def program(pool, ids):
+            parts = []
+            for buffers in pool:
+                for name in sorted(buffers):
+                    rows = buffers[name][ids]
+                    parts.append(jax_mod.lax.bitcast_convert_type(
+                        rows, jnp_mod.uint8).reshape(-1))
+            return jnp_mod.concatenate(parts)
+        fn = jax_mod.jit(program)
+        _JITS["gather"] = fn
+    return fn
+
+
+def _jit_trim(jax_mod, jnp_mod):
+    # spans: static ((byte offset, bytes kept), ...) — one slice per
+    # field dropping the pad duplicates, device-side.
+    fn = _JITS.get("trim")
+    if fn is None:
+        def program(staging, spans):
+            parts = [jax_mod.lax.slice(staging, (offset,),
+                                       (offset + keep,))
+                     for offset, keep in spans]
+            return jnp_mod.concatenate(parts)
+        fn = jax_mod.jit(program, static_argnums=(1,))
+        _JITS["trim"] = fn
+    return fn
+
+
+def _jit_scatter(jax_mod, jnp_mod):
+    fn = _JITS.get("scatter")
+    if fn is None:
+        def program(pool, ids, staging):
+            padded = ids.shape[0]
+            offset = 0
+            new_pool = []
+            for buffers in pool:
+                new = {}
+                for name in sorted(buffers):
+                    buf = buffers[name]
+                    shape = tuple(buf.shape[1:])
+                    itemsize = np.dtype(buf.dtype).itemsize
+                    nbytes = padded * int(np.prod(shape)) * itemsize
+                    raw = jax_mod.lax.slice(staging, (offset,),
+                                            (offset + nbytes,))
+                    raw = raw.reshape(
+                        (padded,) + shape
+                        + ((itemsize,) if itemsize > 1 else ()))
+                    new[name] = buf.at[ids].set(
+                        jax_mod.lax.bitcast_convert_type(
+                            raw, buf.dtype))
+                    offset += nbytes
+                new_pool.append(new)
+            return new_pool
+        # Donating the pool avoids a second pool-sized HBM allocation
+        # during the scatter (safe: only the server holds the pool —
+        # TPEngine stores specs, not buffers).  CPU ignores donation
+        # and warns, so gate it.
+        donate = (0,) if jax_mod.default_backend() != "cpu" else ()
+        fn = jax_mod.jit(program, donate_argnums=donate)
+        _JITS["scatter"] = fn
+    return fn
+
+
+def _account(server, syncs: int = 0, host_ms: float = 0.0) -> None:
+    if syncs:
+        server.kv_export_sync_count = \
+            getattr(server, "kv_export_sync_count", 0) + syncs
+    if host_ms:
+        server.kv_transfer_host_ms = \
+            getattr(server, "kv_transfer_host_ms", 0.0) + host_ms
+
+
+def gather_block_bytes(server, blocks: List[int]):
+    """Fused export gather: ONE jitted device-side gather over every
+    layer/buffer into a single field-major staging array, duplicates
+    trimmed device-side, pulled to host with ONE sync.  Returns
+    ``(staging uint8 ndarray, layout)``."""
+    started = time.perf_counter()
+    jax_mod, jnp_mod = server._jax, server._jnp
+    count = len(blocks)
+    ids = jnp_mod.asarray(_bucket_ids(blocks))
+    staged = _jit_gather(jax_mod, jnp_mod)(server.pool, ids)
+    layout = _field_layout(server)
+    padded = int(ids.shape[0])
+    if padded != count:
+        spans, offset = [], 0
+        for _field, _shape, _dtype, row_bytes in layout:
+            spans.append((offset, count * row_bytes))
+            offset += padded * row_bytes
+        staged = _jit_trim(jax_mod, jnp_mod)(staged, tuple(spans))
+    staging = np.asarray(staged)       # the ONE device→host sync
+    _account(server, syncs=1,
+             host_ms=(time.perf_counter() - started) * 1e3)
+    return staging, layout
+
+
+def _staging_views(staging: np.ndarray, layout, count: int,
+                   wire: bool = False) -> Dict[str, np.ndarray]:
+    """Zero-copy per-field views of a (trimmed) staging buffer —
+    native dtype, or the uint16 wire bit pattern for bf16 fields when
+    ``wire``."""
+    views, offset = {}, 0
+    for field, shape, dtype, row_bytes in layout:
+        nbytes = count * row_bytes
+        flat = staging[offset:offset + nbytes]
+        view_dtype = np.uint16 if wire and dtype.name == _BF16 \
+            else dtype
+        views[field] = flat.view(view_dtype).reshape((count,) + shape)
+        offset += nbytes
+    return views
+
+
 def gather_block_rows(server, blocks: List[int]) -> Dict[str,
                                                          np.ndarray]:
     """Host copy of the pool rows for ``blocks``: ``{"l<i>_<name>":
     (n_blocks, block_size, ...)}`` in the pool's native dtype (bf16
     rows stay bf16, int8 rows keep their f32 scale planes — stored
     bytes are the pool bytes verbatim, which is what makes demotion →
-    restore bit-exact).  Device-side row gather, THEN the host pull —
-    only the selected blocks cross; on a TP replica the gather
+    restore bit-exact).  Rides the fused staging engine: one device
+    program, one sync, zero-copy views; on a TP replica the gather
     assembles full kv-head-width rows from every shard, exactly like
     the wire format."""
+    staging, layout = gather_block_bytes(server, blocks)
+    return _staging_views(staging, layout, len(blocks))
+
+
+def gather_block_rows_legacy(server, blocks: List[int]) -> Dict[
+        str, np.ndarray]:
+    """Pre-fusion gather: one blocking ``np.asarray`` pull per
+    layer×buffer.  Kept for the bench legacy-vs-fused A/B and the
+    byte-identity tests — never on the serving path."""
     count = len(blocks)
     ids = server._jnp.asarray(_bucket_ids(blocks))
     rows = {}
@@ -147,14 +343,117 @@ def gather_block_rows(server, blocks: List[int]) -> Dict[str,
     return rows
 
 
+def _row_bytes_2d(array: np.ndarray) -> np.ndarray:
+    """(n, ...) array → (n, row_bytes) uint8 view (copy only if the
+    source is non-contiguous)."""
+    return np.ascontiguousarray(array).view(np.uint8).reshape(
+        array.shape[0], -1)
+
+
+def _scatter_staged(server, blocks: List[int], layout,
+                    fill) -> None:
+    """Shared fused-import tail: allocate the PADDED field-major
+    staging, let ``fill(field_index, region)`` write each field's
+    ``(count, row_bytes)`` rows, replicate the last row into the pad
+    span (duplicate ids write identical bytes), then ONE host→device
+    upload and ONE fused multi-layer scatter.  TP pools re-pin their
+    kv-head sharding afterwards, exactly like every other pool
+    write."""
+    started = time.perf_counter()
+    jax_mod, jnp_mod = server._jax, server._jnp
+    count = len(blocks)
+    ids_host = _bucket_ids(blocks)
+    padded = len(ids_host)
+    staging = np.empty(
+        padded * sum(row_bytes for *_rest, row_bytes in layout),
+        np.uint8)
+    offset = 0
+    for index, (_field, _shape, _dtype, row_bytes) in \
+            enumerate(layout):
+        region = staging[offset:offset + padded * row_bytes]
+        region = region.reshape(padded, row_bytes)
+        fill(index, region[:count])
+        if padded > count:
+            region[count:] = region[count - 1]
+        offset += padded * row_bytes
+    shardings = None
+    if getattr(server, "_mesh", None) is not None:
+        shardings = [{name: getattr(buf, "sharding", None)
+                      for name, buf in buffers.items()}
+                     for buffers in server.pool]
+    device = jnp_mod.asarray(staging)  # the ONE host→device upload
+    server.pool = _jit_scatter(jax_mod, jnp_mod)(
+        server.pool, jnp_mod.asarray(ids_host), device)
+    if shardings is not None:
+        # The scatter of a replicated staging must not leave a
+        # gathered pool copy behind: re-pin each written buffer to
+        # the pool's kv-head sharding (async dispatch, no sync).
+        for layer, buffers in enumerate(server.pool):
+            server.pool[layer] = {
+                name: server._jax.device_put(
+                    buf, shardings[layer][name])
+                if shardings[layer][name] is not None else buf
+                for name, buf in buffers.items()}
+    _account(server,
+             host_ms=(time.perf_counter() - started) * 1e3)
+
+
 def scatter_block_rows(server, blocks: List[int],
                        rows: Dict[str, np.ndarray]) -> None:
     """Write stacked host rows (the :func:`gather_block_rows` layout)
-    back into pool ``blocks`` — one batched ``.at[ids].set`` per layer
-    buffer, dispatched asynchronously like every other pool write.  On
-    a TP replica the written buffer is re-pinned to the pool's kv-head
-    sharding (the scatter of a replicated host array must not leave a
-    gathered copy behind)."""
+    back into pool ``blocks``: one host-side staging assembly, one
+    H2D upload, one fused batched scatter across every layer buffer.
+    Accepts native-dtype rows or their wire bit patterns (same
+    bytes — the scatter bitcasts, never casts, so the no-op dtype
+    cast the legacy path paid is structurally gone)."""
+    count = len(blocks)
+    layout = _field_layout(server)
+
+    def fill(index, region):
+        field, _shape, _dtype, row_bytes = layout[index]
+        source = _row_bytes_2d(np.asarray(rows[field]))
+        if source.shape != (count, row_bytes):
+            raise ValueError(
+                f"{field}: rows {source.shape} != "
+                f"({count}, {row_bytes})")
+        region[:] = source
+
+    _scatter_staged(server, blocks, layout, fill)
+
+
+def scatter_block_row_dicts(server, blocks: List[int],
+                            row_dicts: List[Dict[str, np.ndarray]]
+                            ) -> None:
+    """Per-block variant of :func:`scatter_block_rows` for the
+    restore/async-import landing queue: assembles the staging
+    straight from each block's row dict — no intermediate
+    ``np.stack`` per field."""
+    count = len(blocks)
+    layout = _field_layout(server)
+
+    def fill(index, region):
+        field, _shape, _dtype, row_bytes = layout[index]
+        for position, row_dict in enumerate(row_dicts):
+            source = np.ascontiguousarray(
+                row_dict[field]).view(np.uint8).reshape(-1)
+            if source.shape[0] != row_bytes:
+                raise ValueError(
+                    f"{field}[{position}]: {source.shape[0]} != "
+                    f"{row_bytes} bytes")
+            region[position] = source
+        assert count == len(row_dicts)
+
+    _scatter_staged(server, blocks, layout, fill)
+
+
+def scatter_block_rows_legacy(server, blocks: List[int],
+                              rows: Dict[str, np.ndarray]) -> None:
+    """Pre-fusion scatter: one ``.at[ids].set`` plus one H2D upload
+    per layer buffer.  Kept for the bench legacy-vs-fused A/B —
+    never on the serving path.  (The unconditional ``.astype`` the
+    original paid is fixed here too: the cast is skipped when the
+    host rows already match the pool dtype, which they always do on
+    the demote→restore path.)"""
     jnp = server._jnp
     count = len(blocks)
     ids = jnp.asarray(_bucket_ids(blocks))
@@ -165,7 +464,10 @@ def scatter_block_rows(server, blocks: List[int],
             if len(ids) > count:
                 pad = np.repeat(data[-1:], len(ids) - count, axis=0)
                 data = np.concatenate([data, pad], axis=0)
-            new = buf.at[ids].set(jnp.asarray(data).astype(buf.dtype))
+            value = jnp.asarray(data)
+            if value.dtype != buf.dtype:
+                value = value.astype(buf.dtype)
+            new = buf.at[ids].set(value)
             if getattr(buf, "sharding", None) is not None \
                     and getattr(server, "_mesh", None) is not None:
                 new = server._jax.device_put(new, buf.sharding)
@@ -173,8 +475,8 @@ def scatter_block_rows(server, blocks: List[int],
         server.pool[layer] = written
 
 
-def export_payload(server, keys_hex: List[str],
-                   start_depth: int) -> Optional[Dict]:
+def export_payload(server, keys_hex: List[str], start_depth: int,
+                   fused: bool = True) -> Optional[Dict]:
     """Resolve ``keys_hex`` (a contiguous chain segment starting at
     depth ``start_depth + 1``) through the owner's prefix index and
     gather the pool rows.  A key demoted to the owner's host tier is
@@ -183,7 +485,11 @@ def export_payload(server, keys_hex: List[str],
     when the owner no longer holds a usable segment (evicted since it
     was advertised, still producing, adapter-seeded, or depth
     drifted) — the caller answers with an error and the importer
-    falls back to local prefill."""
+    falls back to local prefill.
+
+    ``fused`` (default) serves the wire fields as zero-copy views of
+    the one-sync staging buffer; ``fused=False`` is the legacy
+    per-layer gather + per-position splice, kept for the A/B."""
     start_depth = int(start_depth)
     host_tier = getattr(server, "_host", {})
     resolved: List[bytes] = []
@@ -222,26 +528,59 @@ def export_payload(server, keys_hex: List[str],
         "kv_dtype": np.dtype(server.pool[0]["k"].dtype).name,
     }
     # The wire format is always the full kv-head width (TP-agnostic);
-    # HBM rows gather through gather_block_rows, host rows splice in
-    # verbatim — both are the owner's pool bytes.
+    # HBM rows gather through the fused staging buffer, host rows
+    # splice in verbatim — both are the owner's pool bytes.
     hbm = [source for source in sources if isinstance(source, int)]
-    gathered = gather_block_rows(server, hbm) if hbm else {}
-    for layer, buffers in enumerate(server.pool):
-        for name in buffers:
-            field = f"l{layer}_{name}"
-            stacked, cursor = [], 0
-            for source in sources:
-                if isinstance(source, int):
-                    stacked.append(gathered[field][cursor])
-                    cursor += 1
-                else:
-                    stacked.append(source[field])
-            payload[f"kv_{field}"] = _pack(np.stack(stacked))
+    if not fused:
+        gathered = gather_block_rows_legacy(server, hbm) if hbm \
+            else {}
+        for layer, buffers in enumerate(server.pool):
+            for name in buffers:
+                field = f"l{layer}_{name}"
+                stacked, cursor = [], 0
+                for source in sources:
+                    if isinstance(source, int):
+                        stacked.append(gathered[field][cursor])
+                        cursor += 1
+                    else:
+                        stacked.append(source[field])
+                payload[f"kv_{field}"] = _pack(np.stack(stacked))
+        return payload
+    if hbm:
+        staging, layout = gather_block_bytes(server, hbm)
+        views = _staging_views(staging, layout, len(hbm), wire=True)
+    else:
+        layout, views = _field_layout(server), {}
+    started = time.perf_counter()
+    if len(hbm) == len(sources):
+        # Pure-HBM segment (the common wire case): the payload fields
+        # ARE the staging views — zero host copies past the one pull.
+        for field, *_rest in layout:
+            payload[f"kv_{field}"] = views[field]
+    else:
+        # Mixed HBM/host splice: one allocation per field, HBM
+        # positions filled with a single vectorized assignment from
+        # the staging views, host rows copied in place — no
+        # per-position np.stack.
+        hbm_at = np.array([position for position, source
+                           in enumerate(sources)
+                           if isinstance(source, int)], np.intp)
+        for field, shape, dtype, _row_bytes in layout:
+            wire_dtype = np.uint16 if dtype.name == _BF16 else dtype
+            out = np.empty((len(sources),) + shape, wire_dtype)
+            if len(hbm_at):
+                out[hbm_at] = views[field]
+            for position, source in enumerate(sources):
+                if not isinstance(source, int):
+                    out[position] = _pack_view(source[field])
+            payload[f"kv_{field}"] = out
+    _account(server, host_ms=(time.perf_counter() - started) * 1e3)
     return payload
 
 
 def import_payload(server, payload: Dict, engine=None,
-                   lease_s: float = 30.0) -> int:
+                   lease_s: float = 30.0, fused: bool = True,
+                   async_import: bool = False) -> int:
     """Adopt an exported segment into ``server``'s pool + prefix
     index; returns the number of blocks imported (0 = nothing usable:
     layout mismatch, broken chain linkage, or pool too full even
@@ -251,7 +590,16 @@ def import_payload(server, payload: Dict, engine=None,
     :class:`~..runtime.lease.Lease` (released — made evictable — at
     expiry if no admission adopted them; ``engine=None`` skips the
     pin and registers them immediately evictable, the synchronous
-    test/bench mode)."""
+    test/bench mode).
+
+    ``async_import=True`` (the serving path, requires ``engine`` and
+    a tiered-queue server) registers the keys immediately behind the
+    ``RESTORING`` producing sentinel and queues the rows to land a
+    few blocks per engine step alongside host-tier restores — the
+    step loop keeps producing while the segment lands, no reader
+    ever resolves a half-landed chain, and the lease arms when the
+    last block lands.  ``fused=False`` keeps the legacy per-layer
+    scatter for the bench A/B (synchronous only)."""
     if str(payload.get("kv_sig")) != pool_signature(server) or \
             int(payload.get("kv_block_size", -1)) != server.block_size:
         return 0
@@ -292,25 +640,39 @@ def import_payload(server, payload: Dict, engine=None,
     needed = len(fresh)
     if needed > len(server._free) + len(server._evictable):
         return 0
-    # Validate + unpack EVERY layer's rows before touching the pool or
-    # the free list — an incomplete payload rejects with zero side
-    # effects (with a host tier, eviction demotes rather than deletes,
-    # so even the _evict_until below destroys nothing demotable).
+    # Validate + slice EVERY layer's rows before touching the pool or
+    # the free list — an incomplete or misshapen payload rejects with
+    # zero side effects (with a host tier, eviction demotes rather
+    # than deletes, so even the _evict_until below destroys nothing
+    # demotable).  Slices are views of the wire arrays: the fused
+    # scatter consumes raw bytes, so no unpack copy is ever made.
     dtype_name = str(payload.get("kv_dtype", ""))
+    layout = _field_layout(server)
     rows: Dict[str, np.ndarray] = {}
-    for layer, buffers in enumerate(server.pool):
-        for name, buf in buffers.items():
-            data = payload.get(f"kv_l{layer}_{name}")
-            if data is None or data.shape[0] < offset + needed:
-                return 0
-            rows[f"l{layer}_{name}"] = _unpack(
-                np.asarray(data)[offset:offset + needed],
-                dtype_name, buf.dtype)
+    for field, _shape, dtype, row_bytes in layout:
+        data = payload.get(f"kv_{field}")
+        if data is None or data.shape[0] < offset + needed:
+            return 0
+        sliced = np.asarray(data)[offset:offset + needed]
+        if int(sliced.nbytes) != needed * row_bytes:
+            return 0               # trailing-shape/dtype mismatch
+        rows[field] = sliced if fused else _unpack(
+            sliced, dtype_name, dtype)
     server._evict_until(needed)
     if needed > len(server._free):
         return 0
     blocks = [server._free.pop() for _ in range(needed)]
-    scatter_block_rows(server, blocks, rows)
+    queue_async = bool(async_import) and engine is not None \
+        and hasattr(server, "_queue_import")
+    if not queue_async:
+        if fused:
+            scatter_block_rows(server, blocks, rows)
+        else:
+            scatter_block_rows_legacy(server, blocks, {
+                field: _unpack(np.asarray(value), dtype_name,
+                               dict((f, d) for f, _s, d, _r
+                                    in layout)[field])
+                for field, value in rows.items()})
 
     discard_host = getattr(server, "_host_discard", None)
     imported: List[bytes] = []
@@ -346,10 +708,19 @@ def import_payload(server, payload: Dict, engine=None,
                 if server._refs[block] == 0:
                     server._evictable[key] = block
 
-    if engine is not None:
+    label = f"kv_import:{fresh[0].hex()[:8]}"
+    if queue_async:
+        per_block = [{field: rows[field][index]
+                      for field, *_rest in layout}
+                     for index in range(needed)]
+        server._queue_import(
+            list(zip(imported, blocks)), per_block,
+            dict(engine=engine, lease_s=lease_s, release=release,
+                 label=label))
+    elif engine is not None:
         from ..runtime.lease import Lease
-        Lease(lease_s, f"kv_import:{fresh[0].hex()[:8]}",
-              lease_expired_handler=release, engine=engine)
+        Lease(lease_s, label, lease_expired_handler=release,
+              engine=engine)
     else:
         release()
     return needed

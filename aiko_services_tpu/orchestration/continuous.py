@@ -1422,26 +1422,39 @@ class ContinuousBatchingServer:
             steplog.RECORDER.record(
                 "sync", wait_ms=round((now - wait_start) * 1e3, 3),
                 steps=int(entry["steps"]))
-        for slot in range(self.slots):
-            if entry["serial"][slot] != self._slot_serial[slot]:
-                continue           # slot was retired/readmitted since
+        # Batched token dispatch: one tolist() per result field turns
+        # the step's whole token matrix into Python ints up front and
+        # the walk touches only live lanes — no per-token numpy
+        # scalar boxing, no per-slot ndarray indexing (the host-path
+        # tax the step log attributed to token delivery).
+        dispatch_start = time.monotonic()
+        live = ((np.asarray(entry["serial"]) == self._slot_serial)
+                & self.active
+                & np.fromiter((request is not None
+                               for request in self._requests),
+                              bool, self.slots))
+        sched = np.asarray(entry["sched"])
+        self._inflight_sched[live] -= sched[live]
+        token_rows = tokens.tolist()
+        count_list = counts.tolist()
+        full_list = counts_full.tolist() if spec else count_list
+        active_list = active_after.tolist()
+        delivered = 0
+        live_slots = [int(slot) for slot in np.nonzero(live)[0]]
+        for slot in live_slots:
             request = self._requests[slot]
-            if request is None or not self.active[slot]:
-                continue
-            self._inflight_sched[slot] -= entry["sched"][slot]
-            count = int(counts[slot])
+            count = count_list[slot]
             if count:
                 if request.first_token_ts is None:
                     request.first_token_ts = now
-                request.tokens.extend(
-                    int(t) for t in tokens[slot, :count])
+                request.tokens.extend(token_rows[slot][:count])
                 self._emitted[slot] += count
                 self._remaining[slot] = (request.max_new_tokens
                                          - self._emitted[slot])
                 # Mirrors advance by what the device WROTE: the full
                 # committed window for spec rounds (cache rows exist
                 # past the emit caps), the emitted prefix for chunks.
-                advance = int(counts_full[slot]) if spec else count
+                advance = full_list[slot]
                 if spec:
                     # Pre-advance mirror position = the window's first
                     # written row; the layout hook turns the rejected
@@ -1452,12 +1465,17 @@ class ContinuousBatchingServer:
                         request.spec_accepted_rounds = []
                     request.spec_accepted_rounds.append(advance - 1)
                 self.positions[slot] += advance
-                self.tokens[slot, 0] = int(tokens[slot, advance - 1]) \
-                    if spec else int(tokens[slot, count - 1])
-                self.counters["tokens_committed"] += count
-            if not active_after[slot]:
+                self.tokens[slot, 0] = token_rows[slot][advance - 1] \
+                    if spec else token_rows[slot][count - 1]
+                delivered += count
+            if not active_list[slot]:
                 self._retire(slot)
+        self.counters["tokens_committed"] += delivered
         if steplog.RECORDER is not None:
+            steplog.RECORDER.record(
+                "token_dispatch", slots=len(live_slots),
+                tokens=delivered,
+                ms=round((time.monotonic() - dispatch_start) * 1e3, 3))
             # Device-reported emit counts: stale-serial lanes may be
             # excluded above, so this is an upper bound on committed.
             steplog.RECORDER.record("commit", tokens=int(counts.sum()))
@@ -1904,8 +1922,14 @@ class ContinuousReplica(Actor):
             if "error" in outputs:
                 self.server.kv_transfer_failures += 1
             else:
+                # Async landing: the keys register behind the
+                # RESTORING sentinel now, the rows land a few blocks
+                # per step — the submit below parks on the hit walk's
+                # restore_wait defer until the chain is whole, and
+                # decode keeps producing meanwhile.
                 self.server.kv_import_payload(
-                    outputs, engine=self.process.event)
+                    outputs, engine=self.process.event,
+                    async_import=True)
                 remote = outputs.get("trace_spans")
                 if remote:
                     request.remote_spans = str(remote)

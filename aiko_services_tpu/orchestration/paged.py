@@ -56,6 +56,7 @@ import numpy as np
 from ..kvstore import directory as _kvdir
 from ..kvstore import transfer as _kvxfer
 from ..obs import steplog
+from ..runtime.lease import Lease
 from .continuous import ContinuousBatchingServer
 
 __all__ = ["PagedContinuousServer"]
@@ -227,9 +228,12 @@ class PagedContinuousServer(ContinuousBatchingServer):
         #     XOR _host, never both.  Demoted keys KEEP _depth,
         #     _parent, _key_seed, _hex_key, _key_hits: the chain stays
         #     addressable by hit walks, digests, and exports.
-        #   _restoring: [(key, block, rows)] host→device uploads
-        #     waiting for _advance_restores; the blocks are allocated,
-        #     indexed, ref-pinned, and _producing[block] = RESTORING.
+        #   _restoring: [{"key", "block", "rows", "group"}]
+        #     host→device uploads waiting for _advance_restores; the
+        #     blocks are allocated, indexed, ref-pinned, and
+        #     _producing[block] = RESTORING.  Host-tier restores queue
+        #     with group=None; async wire imports share a group dict
+        #     (lease armed when the group's last block lands).
         #   _restored_keys: landed restores not yet adopted by an
         #     admission — the first adoption counts prefix_hits_host
         #     (mirrors _imported_keys / prefix_remote_hits).
@@ -248,6 +252,12 @@ class PagedContinuousServer(ContinuousBatchingServer):
         self.kv_restores = 0
         self.kv_host_bytes = 0
         self.prefix_hits_host = 0
+        # Fused transfer-engine counters (kvstore/transfer.py writes
+        # them): device→host syncs paid by exports/demotions, host-side
+        # staging time, and wire imports landed step-overlapped.
+        self.kv_export_sync_count = 0
+        self.kv_transfer_host_ms = 0.0
+        self.kv_imports_async = 0
 
     def _init_device_state(self):
         state = super()._init_device_state()
@@ -283,6 +293,9 @@ class PagedContinuousServer(ContinuousBatchingServer):
             kv_host_bytes=self.kv_host_bytes,
             restore_queue_depth=len(self._restoring),
             prefix_hits_host=self.prefix_hits_host,
+            kv_export_sync_count=self.kv_export_sync_count,
+            kv_transfer_host_ms=round(self.kv_transfer_host_ms, 2),
+            kv_imports_async=self.kv_imports_async,
             free_blocks=self.free_blocks,
             total_blocks=self.total_blocks,
         )
@@ -508,34 +521,65 @@ class PagedContinuousServer(ContinuousBatchingServer):
                 self._children[parent] = \
                     self._children.get(parent, 0) + 1
             self.kv_host_bytes -= entry["nbytes"]
-            self._restoring.append((key, block, entry["rows"]))
+            self._restoring.append(dict(key=key, block=block,
+                                        rows=entry["rows"],
+                                        group=None))
         return True
+
+    def _queue_import(self, key_blocks, per_block_rows,
+                      group_info) -> None:
+        """Queue an async wire import's blocks onto the restore
+        landing queue (called by :func:`kvstore.transfer
+        .import_payload` with ``async_import=True`` AFTER registering
+        the keys ref-pinned).  Each block gets ``_producing[block] =
+        RESTORING`` so hit walks defer instead of adopting half a
+        chain, and the segment shares one group dict: when its last
+        block lands, the import lease arms (refs stay 1 until an
+        admission adopts the chain or the lease expires)."""
+        group = dict(group_info)
+        group["remaining"] = len(key_blocks)
+        for (key, block), rows in zip(key_blocks, per_block_rows):
+            self._producing[block] = RESTORING
+            self._restoring.append(dict(key=key, block=block,
+                                        rows=rows, group=group))
 
     def _advance_restores(self) -> None:
         """Land up to ``restore_blocks_per_step`` queued host→device
-        restore uploads as ONE batched scatter.  Called at the top of
-        every :meth:`step`, so the upload dispatch overlaps the decode
-        chunk that follows (async dispatch, same discipline as chunked
-        admission).  JAX program order makes the rows resident before
-        any later read of the buffer, so the _producing sentinel
-        clears immediately — a landed key is shareable the same step,
-        and a not-yet-landed key is still a miss: no reader ever sees
-        a half-landed chain."""
+        uploads — tier restores and async wire imports share the
+        queue — as ONE batched scatter.  Called at the top of every
+        :meth:`step`, so the upload dispatch overlaps the decode
+        chunk that follows (async dispatch, same discipline as
+        chunked admission).  JAX program order makes the rows
+        resident before any later read of the buffer, so the
+        _producing sentinel clears immediately — a landed key is
+        shareable the same step, and a not-yet-landed key is still a
+        miss: no reader ever sees a half-landed chain."""
         if not self._restoring:
             return
         batch = self._restoring[:self.restore_blocks_per_step]
         del self._restoring[:len(batch)]
-        blocks = [block for _, block, _ in batch]
-        rows = {name: np.stack([entry_rows[name]
-                                for _, _, entry_rows in batch])
-                for name in batch[0][2]}
-        _kvxfer.scatter_block_rows(self, blocks, rows)
-        for key, block, _ in batch:
+        _kvxfer.scatter_block_row_dicts(
+            self, [entry["block"] for entry in batch],
+            [entry["rows"] for entry in batch])
+        for entry in batch:
+            block = entry["block"]
             self._producing.pop(block, None)
-            self._refs[block] = 0
-            self._evictable[key] = block       # cached again, MRU
-            self._restored_keys.add(key)
-            self.kv_restores += 1
+            group = entry["group"]
+            if group is None:
+                # Host-tier restore: cached again, MRU, adoptable.
+                self._refs[block] = 0
+                self._evictable[entry["key"]] = block
+                self._restored_keys.add(entry["key"])
+                self.kv_restores += 1
+                continue
+            # Async wire import: the block stays ref-pinned; the
+            # lease arms once the whole segment has landed.
+            group["remaining"] -= 1
+            if group["remaining"] == 0:
+                self.kv_imports_async += 1
+                Lease(group["lease_s"], group["label"],
+                      lease_expired_handler=group["release"],
+                      engine=group["engine"])
 
     def step(self) -> List:
         # Restores land BEFORE admission so a deferred head request
@@ -1124,15 +1168,20 @@ class PagedContinuousServer(ContinuousBatchingServer):
         return payload
 
     def kv_import_payload(self, payload: Dict, engine=None,
-                          lease_s: float = 30.0) -> int:
+                          lease_s: float = 30.0,
+                          async_import: bool = False) -> int:
         """Adopt an exported segment into this pool under a lease;
         returns blocks imported (0 counts as a transfer failure —
         the caller falls back to local prefill, which is always
-        correct, just colder)."""
+        correct, just colder).  ``async_import=True`` (the serving
+        path) registers the keys behind the ``RESTORING`` sentinel
+        and lands the rows a few blocks per step — see
+        :func:`~..kvstore.transfer.import_payload`."""
         started = time.perf_counter()
         imported = _kvxfer.import_payload(self, payload,
                                           engine=engine,
-                                          lease_s=lease_s)
+                                          lease_s=lease_s,
+                                          async_import=async_import)
         if imported:
             self.kv_transfer_bytes += _kvxfer.payload_bytes(payload)
             self.kv_transfer_ms += \

@@ -70,7 +70,8 @@ TELEMETRY_KEYS = (
     "prefix_remote_hits", "kv_transfer_bytes", "kv_transfer_ms",
     "kv_transfer_failures", "kv_demotions", "kv_restores",
     "kv_host_blocks", "kv_host_bytes", "restore_queue_depth",
-    "prefix_hits_host",
+    "prefix_hits_host", "kv_export_sync_count",
+    "kv_transfer_host_ms", "kv_imports_async",
     "decode_attention_path", "blocks_read_per_step",
     "prefill_tokens_per_sec", "prefill_queue_depth",
     "prefill_attention_path",

@@ -1414,16 +1414,26 @@ def bench_kv_transfer(prefix_lens=(512, 2048, 8192),
     prefixes for both pool dtypes (bf16 and int8+scales) — pure
     host-side data movement, no model compile (chains are registered
     with :func:`~aiko_services_tpu.kvstore.seed_chain`, never
-    prefilled); (2) routed-vs-load-only TTFT p50/p95 on the
+    prefilled) — through the FUSED staging-buffer engine, with a
+    legacy per-layer A/B and the ``host_overhead_ratio``
+    ((export_ms + import_ms) / wire_ms) the fused engine exists to
+    crush; (2) a warm-start-migration tok/s trace: tokens per step on
+    an active decode slot WHILE an async import lands, the
+    step-overlap gate; (3) routed-vs-load-only TTFT p50/p95 on the
     shared-prefix workload through a live 2-replica rig — the number
     prefix-aware routing exists to move."""
     import numpy as np
     from aiko_services_tpu.kvstore import (payload_bytes, seed_chain,
                                            chain_keys_hex)
+    from aiko_services_tpu.kvstore import transfer as kvxfer
+    from aiko_services_tpu.orchestration.continuous import \
+        DecodeRequest
     from aiko_services_tpu.orchestration.paged import \
         PagedContinuousServer
     from aiko_services_tpu.pipeline.codec import (decode_swag,
                                                   encode_swag)
+    from aiko_services_tpu.runtime.event import (EventEngine,
+                                                 VirtualClock)
     from aiko_services_tpu.tools.loadgen import run_shared_prefix
 
     max_len = max(prefix_lens)
@@ -1437,38 +1447,149 @@ def bench_kv_transfer(prefix_lens=(512, 2048, 8192),
         rng = np.random.RandomState(0)
         tokens = rng.randint(1, 1024, size=max_len + 1).astype(np.int32)
         seed_chain(owner, tokens)
-        for length in prefix_lens:
-            importer = PagedContinuousServer(
+        def fresh():
+            return PagedContinuousServer(
                 config_name="tiny", slots=2, max_seq=max_seq,
                 enable_prefix_cache=True, quantize_kv=quantize_kv)
+
+        for length in prefix_lens:
             keys = chain_keys_hex(tokens[:length + 1],
                                   owner.block_size)
-            t0 = time.perf_counter()
-            payload = owner.kv_export_payload(keys, 0)
-            export_ms = (time.perf_counter() - t0) * 1e3
-            assert payload is not None, \
-                f"kv_transfer[{tag}/{length}]: export resolved nothing"
-            nbytes = payload_bytes(payload)
-            t0 = time.perf_counter()
-            wire = decode_swag(encode_swag(payload))
-            wire_ms = (time.perf_counter() - t0) * 1e3
-            t0 = time.perf_counter()
-            imported = importer.kv_import_payload(wire)
-            import_ms = (time.perf_counter() - t0) * 1e3
-            assert imported == len(keys), \
-                f"kv_transfer[{tag}/{length}]: {imported}/{len(keys)}"
+            # Untimed warmup at this shape for BOTH paths: the fused
+            # engine jit-compiles one gather/scatter program per pow2
+            # id bucket (a one-time cost production pays once per
+            # shape class, not per transfer) and the legacy eager ops
+            # compile per shape too — the timed pass below measures
+            # steady-state movement, same as every other section.
+            for _warm in range(3):
+                warm_wire = decode_swag(encode_swag(
+                    owner.kv_export_payload(keys, 0)))
+                assert fresh().kv_import_payload(warm_wire) == \
+                    len(keys)
+                kvxfer.export_payload(owner, keys, 0, fused=False)
+                assert kvxfer.import_payload(
+                    fresh(), warm_wire, fused=False) == len(keys)
+            # Best-of-5 per leg: one-shot host timings at the small
+            # shapes are dominated by allocator/GC noise, not the
+            # datapath under test.
+            import gc
+            export_ms = wire_ms = import_ms = float("inf")
+            ratio = float("inf")
+            for _rep in range(5):
+                importer = fresh()
+                gc.collect()
+                t0 = time.perf_counter()
+                payload = owner.kv_export_payload(keys, 0)
+                rep_export = (time.perf_counter() - t0) * 1e3
+                assert payload is not None, \
+                    f"kv_transfer[{tag}/{length}]: export " \
+                    f"resolved nothing"
+                nbytes = payload_bytes(payload)
+                t0 = time.perf_counter()
+                wire = decode_swag(encode_swag(payload))
+                rep_wire = (time.perf_counter() - t0) * 1e3
+                t0 = time.perf_counter()
+                imported = importer.kv_import_payload(wire)
+                rep_import = (time.perf_counter() - t0) * 1e3
+                assert imported == len(keys), \
+                    f"kv_transfer[{tag}/{length}]: " \
+                    f"{imported}/{len(keys)}"
+                export_ms = min(export_ms, rep_export)
+                wire_ms = min(wire_ms, rep_wire)
+                import_ms = min(import_ms, rep_import)
+                # Ratio is scored WITHIN a rep (all three legs under
+                # the same CPU-contention weather), best rep wins.
+                if rep_wire:
+                    ratio = min(ratio, (rep_export + rep_import)
+                                / rep_wire)
             total_ms = export_ms + wire_ms + import_ms
             mbps = nbytes / 1e6 / (total_ms / 1e3) if total_ms else 0.0
+            # Legacy per-layer A/B: the pre-fusion datapath on the
+            # SAME payload (fresh importer so eviction state
+            # matches), best-of-3 like the fused pass.
+            legacy_export_ms = legacy_import_ms = float("inf")
+            legacy_ratio = float("inf")
+            for _rep in range(5):
+                gc.collect()
+                t0 = time.perf_counter()
+                legacy_payload = kvxfer.export_payload(
+                    owner, keys, 0, fused=False)
+                rep_export = (time.perf_counter() - t0) * 1e3
+                legacy_importer = fresh()
+                t0 = time.perf_counter()
+                legacy_wire = decode_swag(encode_swag(legacy_payload))
+                rep_wire = (time.perf_counter() - t0) * 1e3
+                t0 = time.perf_counter()
+                assert kvxfer.import_payload(
+                    legacy_importer, legacy_wire,
+                    fused=False) == len(keys)
+                rep_import = (time.perf_counter() - t0) * 1e3
+                legacy_export_ms = min(legacy_export_ms, rep_export)
+                legacy_import_ms = min(legacy_import_ms, rep_import)
+                if rep_wire:
+                    legacy_ratio = min(
+                        legacy_ratio,
+                        (rep_export + rep_import) / rep_wire)
             prefix = f"kv_transfer_{tag}_{length}"
             results[f"{prefix}_bytes"] = nbytes
             results[f"{prefix}_export_ms"] = round(export_ms, 2)
             results[f"{prefix}_wire_ms"] = round(wire_ms, 2)
             results[f"{prefix}_import_ms"] = round(import_ms, 2)
             results[f"{prefix}_mb_per_sec"] = round(mbps, 1)
+            results[f"{prefix}_host_overhead_ratio"] = round(ratio, 2)
+            results[f"{prefix}_legacy_export_ms"] = \
+                round(legacy_export_ms, 2)
+            results[f"{prefix}_legacy_import_ms"] = \
+                round(legacy_import_ms, 2)
+            results[f"{prefix}_legacy_host_overhead_ratio"] = \
+                round(legacy_ratio, 2)
             log(f"kv_transfer[{tag}/{length}]: {nbytes / 1e6:.2f} MB "
                 f"in {total_ms:.1f} ms ({mbps:.0f} MB/s; export "
                 f"{export_ms:.1f} / wire {wire_ms:.1f} / import "
-                f"{import_ms:.1f})")
+                f"{import_ms:.1f}; host/wire {ratio:.2f}x, legacy "
+                f"{legacy_export_ms:.1f}+{legacy_import_ms:.1f} ms = "
+                f"{legacy_ratio:.2f}x)")
+
+    # Warm-start migration trace: an active decode slot keeps
+    # producing while a 2048-token segment lands async, one landing
+    # batch per step (the ISSUE gate: the step loop never stalls on
+    # an inbound segment).
+    owner = PagedContinuousServer(
+        config_name="tiny", slots=2, max_seq=192, total_blocks=32,
+        enable_prefix_cache=True)
+    mig_prompt = np.arange(1, 130, dtype=np.int32)   # 8 shareable blocks
+    owner.submit(DecodeRequest(request_id="warm", prompt=mig_prompt,
+                               max_new_tokens=4))
+    owner.run_until_drained()
+    payload = owner.kv_export_payload(
+        owner.prefix_keys_hex(mig_prompt), 0)
+    wire = decode_swag(encode_swag(payload))
+    migrant = PagedContinuousServer(
+        config_name="tiny", slots=2, max_seq=192, total_blocks=32,
+        enable_prefix_cache=True, restore_blocks_per_step=1,
+        chunk_steps=2)
+    active = DecodeRequest(request_id="active",
+                           prompt=np.arange(500, 540, dtype=np.int32),
+                           max_new_tokens=64)
+    migrant.submit(active)
+    while not active.tokens:
+        migrant.step()
+    engine = EventEngine(clock=VirtualClock())
+    assert migrant.kv_import_payload(
+        wire, engine=engine, async_import=True) == 8
+    trace = []
+    while migrant.stats()["restore_queue_depth"] > 0:
+        before = len(active.tokens)
+        migrant.step()
+        trace.append(len(active.tokens) - before)
+    producing = sum(1 for t in trace if t > 0)
+    results["kv_migration_import_steps"] = len(trace)
+    results["kv_migration_steps_producing"] = producing
+    results["kv_migration_tok_trace"] = ",".join(
+        str(t) for t in trace)
+    log(f"kv_migration: {len(trace)} landing steps, active slot "
+        f"produced in {producing} of them (trace "
+        f"{results['kv_migration_tok_trace']})")
 
     # Routed vs load-only TTFT on the shared-prefix workload (full
     # wire rig both times; only the router's scoring differs).

@@ -1,0 +1,372 @@
+"""Fused staging-buffer KV transfer engine (kvstore/transfer.py).
+
+Four gates:
+
+* **Byte identity** — the fused one-sync export and the legacy
+  per-layer gather produce BYTE-identical wire payloads (bf16 and
+  int8), and fused vs legacy scatter land byte-identical pool rows;
+  demote/restore parity rides the same equality.
+* **One sync** — a full multi-layer export pays exactly ONE
+  device→host transfer (counted at the numpy boundary AND by the
+  ``kv_export_sync_count`` telemetry counter); the legacy path pays
+  one per layer×buffer, which is the whole point.
+* **Exact-count bandwidth** — the pow2 id bucketing pads by
+  repeating the last block id, but the duplicate rows are trimmed
+  DEVICE-side: the staging buffer that crosses the bus holds exactly
+  ``count`` rows' bytes.
+* **Async landing** — ``async_import=True`` registers the keys
+  behind the ``RESTORING`` sentinel and lands the rows a few blocks
+  per step: decode keeps producing mid-import, no reader ever adopts
+  a half-landed chain, a kill mid-import loses nothing (the importer
+  falls back to local prefill, bit-exact), and a truncated payload
+  rejects with ZERO side effects.
+"""
+
+import numpy as np
+import pytest
+
+from aiko_services_tpu.kvstore import chain_keys_hex, payload_bytes
+from aiko_services_tpu.kvstore import transfer as kvxfer
+from aiko_services_tpu.orchestration.continuous import DecodeRequest
+from aiko_services_tpu.orchestration.paged import RESTORING
+from aiko_services_tpu.orchestration.serving import TELEMETRY_KEYS
+from aiko_services_tpu.pipeline.codec import decode_swag, encode_swag
+
+from .test_kvstore import _warm, make_server
+
+BOTH_DTYPES = pytest.mark.parametrize("quantize_kv", [False, True],
+                                      ids=["bf16", "int8"])
+
+
+def _count_device_pulls(monkeypatch):
+    """Count host pulls of device arrays through the numpy boundary
+    (``np.asarray`` on a ``jax.Array`` is the only way bytes leave
+    the device in this codebase)."""
+    import jax
+    pulls = []
+    real = np.asarray
+
+    def counting(obj, *args, **kwargs):
+        if isinstance(obj, jax.Array):
+            pulls.append(obj)
+        return real(obj, *args, **kwargs)
+
+    monkeypatch.setattr(np, "asarray", counting)
+    return pulls
+
+
+def _wire_fields(payload):
+    return sorted(k for k in payload if k.startswith("kv_l"))
+
+
+# ---------------------------------------------------------------- #
+# Byte identity: fused == legacy, both directions
+# ---------------------------------------------------------------- #
+
+@BOTH_DTYPES
+def test_fused_and_legacy_export_byte_identical(quantize_kv):
+    prompt = np.arange(1, 50, dtype=np.int32)       # 3 shareable blocks
+    owner = make_server(quantize_kv=quantize_kv)
+    _warm(owner, prompt)
+    keys = owner.prefix_keys_hex(prompt)
+
+    fused = kvxfer.export_payload(owner, keys, 0)
+    legacy = kvxfer.export_payload(owner, keys, 0, fused=False)
+    assert fused is not None and legacy is not None
+    assert _wire_fields(fused) == _wire_fields(legacy)
+    for field in _wire_fields(fused):
+        assert fused[field].dtype == legacy[field].dtype, field
+        assert fused[field].shape == legacy[field].shape, field
+        assert np.array_equal(fused[field], legacy[field]), field
+    for meta in ("kv_keys", "kv_parent", "kv_start_depth",
+                 "kv_block_size", "kv_sig", "kv_dtype"):
+        assert fused[meta] == legacy[meta]
+    # And both survive the real wire codec identically.
+    assert payload_bytes(decode_swag(encode_swag(fused))) == \
+        payload_bytes(decode_swag(encode_swag(legacy)))
+
+
+@BOTH_DTYPES
+def test_fused_and_legacy_import_land_identical_rows(quantize_kv):
+    prompt = np.arange(1, 50, dtype=np.int32)
+    owner = make_server(quantize_kv=quantize_kv)
+    _warm(owner, prompt)
+    payload = owner.kv_export_payload(owner.prefix_keys_hex(prompt), 0)
+    wire = decode_swag(encode_swag(payload))
+
+    fused = make_server(quantize_kv=quantize_kv)
+    legacy = make_server(quantize_kv=quantize_kv)
+    assert kvxfer.import_payload(fused, dict(wire)) == 3
+    assert kvxfer.import_payload(legacy, dict(wire), fused=False) == 3
+    blocks_f = [fused._index[bytes.fromhex(k)] for k in wire["kv_keys"]]
+    blocks_l = [legacy._index[bytes.fromhex(k)]
+                for k in wire["kv_keys"]]
+    rows_f = kvxfer.gather_block_rows(fused, blocks_f)
+    rows_l = kvxfer.gather_block_rows_legacy(legacy, blocks_l)
+    assert sorted(rows_f) == sorted(rows_l)
+    for field in rows_f:
+        assert np.array_equal(
+            np.asarray(rows_f[field]).view(np.uint8),
+            np.asarray(rows_l[field]).view(np.uint8)), field
+
+
+@BOTH_DTYPES
+def test_demote_restore_parity_through_fused_path(quantize_kv):
+    """gather → scatter through the fused engine is a byte-level
+    identity on pool rows (the demote/restore mechanism), and the
+    fused gather equals the legacy per-layer gather on the SAME
+    blocks."""
+    prompt = np.arange(1, 50, dtype=np.int32)
+    server = make_server(quantize_kv=quantize_kv)
+    _warm(server, prompt)
+    blocks = sorted(server._index.values())[:3]
+
+    rows = kvxfer.gather_block_rows(server, blocks)
+    rows_legacy = kvxfer.gather_block_rows_legacy(server, blocks)
+    for field in rows_legacy:
+        assert rows[field].dtype == rows_legacy[field].dtype, field
+        assert np.array_equal(
+            np.asarray(rows[field]).view(np.uint8),
+            np.asarray(rows_legacy[field]).view(np.uint8)), field
+
+    # Scatter into a fresh pool (fused) and re-gather: identity.
+    target = make_server(quantize_kv=quantize_kv)
+    landing = [target._free.pop() for _ in range(3)]
+    kvxfer.scatter_block_rows(target, landing, rows)
+    back = kvxfer.gather_block_rows(target, landing)
+    for field in rows:
+        assert np.array_equal(
+            np.asarray(back[field]).view(np.uint8),
+            np.asarray(rows[field]).view(np.uint8)), field
+
+    # Per-block landing (the restore/async-import queue path) lands
+    # the same bytes as the stacked scatter.
+    per_block = make_server(quantize_kv=quantize_kv)
+    landing2 = [per_block._free.pop() for _ in range(3)]
+    kvxfer.scatter_block_row_dicts(
+        per_block, landing2,
+        [{field: rows[field][i] for field in rows} for i in range(3)])
+    back2 = kvxfer.gather_block_rows(per_block, landing2)
+    for field in rows:
+        assert np.array_equal(
+            np.asarray(back2[field]).view(np.uint8),
+            np.asarray(rows[field]).view(np.uint8)), field
+
+
+# ---------------------------------------------------------------- #
+# One sync / exact-count bandwidth
+# ---------------------------------------------------------------- #
+
+@BOTH_DTYPES
+def test_export_pays_exactly_one_device_sync(quantize_kv, monkeypatch):
+    prompt = np.arange(1, 50, dtype=np.int32)
+    owner = make_server(quantize_kv=quantize_kv)
+    _warm(owner, prompt)
+    keys = owner.prefix_keys_hex(prompt)
+    n_fields = len(owner.pool) * len(owner.pool[0])
+    assert n_fields >= 4                    # multi-layer, multi-buffer
+
+    pulls = _count_device_pulls(monkeypatch)
+    syncs_before = owner.stats()["kv_export_sync_count"]
+    payload = kvxfer.export_payload(owner, keys, 0)
+    assert payload is not None
+    assert len(pulls) == 1                  # ONE fused staging pull
+    assert owner.stats()["kv_export_sync_count"] == syncs_before + 1
+    assert owner.stats()["kv_transfer_host_ms"] > 0
+
+    # The legacy path pays one pull per layer×buffer — the tax the
+    # fused engine deletes.
+    del pulls[:]
+    assert kvxfer.export_payload(owner, keys, 0, fused=False) \
+        is not None
+    assert len(pulls) == n_fields
+
+
+def test_bucket_padding_never_crosses_the_bus(monkeypatch):
+    """5 blocks bucket to 8 ids, but the duplicates are sliced off
+    device-side: the ONE pulled staging array holds exactly 5 rows'
+    bytes per field."""
+    server = make_server(max_seq=128)
+    _warm(server, np.arange(1, 86, dtype=np.int32))  # 5 shareable blocks
+    blocks = sorted(server._index.values())[:5]
+    assert len(kvxfer._bucket_ids(blocks)) == 8      # pow2 bucket
+
+    pulls = _count_device_pulls(monkeypatch)
+    staging, layout = kvxfer.gather_block_bytes(server, blocks)
+    assert len(pulls) == 1
+    row_total = sum(row_bytes for *_rest, row_bytes in layout)
+    assert staging.nbytes == 5 * row_total           # count, not bucket
+    # And the trimmed bytes are the right rows.
+    rows = kvxfer._staging_views(staging, layout, 5)
+    legacy = kvxfer.gather_block_rows_legacy(server, blocks)
+    for field in legacy:
+        assert np.array_equal(
+            np.asarray(rows[field]).view(np.uint8),
+            np.asarray(legacy[field]).view(np.uint8)), field
+
+
+def test_export_serves_zero_copy_views():
+    """Wire fields of a pure-HBM export are VIEWS of one staging
+    buffer — no per-field copy, no ascontiguousarray re-copy."""
+    server = make_server()
+    _warm(server, np.arange(1, 50, dtype=np.int32))
+    payload = kvxfer.export_payload(
+        server, server.prefix_keys_hex(
+            np.arange(1, 50, dtype=np.int32)), 0)
+    bases = [payload[f].base for f in _wire_fields(payload)]
+    assert all(base is not None for base in bases)
+    assert len({id(base) for base in bases}) == 1
+
+
+def test_transfer_counters_flow_to_telemetry():
+    for key in ("kv_export_sync_count", "kv_transfer_host_ms",
+                "kv_imports_async"):
+        assert key in TELEMETRY_KEYS
+        assert key in make_server().stats()
+
+
+# ---------------------------------------------------------------- #
+# Async import: sentinel, overlap, chaos kill-mid-import
+# ---------------------------------------------------------------- #
+
+def _async_rig(engine, restore_blocks_per_step=1):
+    prompt = np.arange(1, 66, dtype=np.int32)        # 4 shareable blocks
+    owner = make_server(max_seq=128, total_blocks=24)
+    want = _warm(owner, prompt)
+    payload = owner.kv_export_payload(owner.prefix_keys_hex(prompt), 0)
+    wire = decode_swag(encode_swag(payload))
+    importer = make_server(
+        max_seq=128, total_blocks=24,
+        restore_blocks_per_step=restore_blocks_per_step)
+    return prompt, want, wire, importer
+
+
+def test_async_import_lands_behind_sentinel_and_decode_produces(
+        engine):
+    prompt, want, wire, importer = _async_rig(engine)
+
+    # An unrelated active slot, mid-decode before the import arrives.
+    active = DecodeRequest(request_id="active",
+                           prompt=np.arange(200, 220, dtype=np.int32),
+                           max_new_tokens=16)
+    importer.submit(active)
+    for _ in range(8):
+        importer.step()
+        if active.tokens:
+            break
+    assert active.tokens
+
+    assert importer.kv_import_payload(
+        dict(wire), engine=engine, async_import=True) == 4
+    # Registered instantly — but EVERY block sits behind the
+    # RESTORING sentinel until its rows land, so nothing is adoptable
+    # and nothing is evictable.
+    stats = importer.stats()
+    assert stats["restore_queue_depth"] == 4
+    assert stats["kv_imports_async"] == 0            # not landed yet
+    fresh_keys = [bytes.fromhex(k) for k in wire["kv_keys"]]
+    for key in fresh_keys:
+        block = importer._index[key]
+        assert importer._producing[block] == RESTORING
+        assert importer._refs[block] == 1
+        assert key not in importer._evictable
+
+    # A same-prefix request defers on the sentinel; the active slot
+    # keeps emitting while the segment lands one block per step.
+    restored = DecodeRequest(request_id="restored", prompt=prompt,
+                             max_new_tokens=4)
+    importer.submit(restored)
+    produced_during_import = False
+    for _ in range(40):
+        depth_before = importer.stats()["restore_queue_depth"]
+        emitted_before = len(active.tokens)
+        importer.step()
+        if depth_before > 0 and len(active.tokens) > emitted_before:
+            produced_during_import = True
+        if not importer.busy:
+            break
+    assert produced_during_import
+    assert restored.tokens == want                   # bit-exact adoption
+    stats = importer.stats()
+    assert stats["kv_imports_async"] == 1
+    assert stats["prefix_remote_hits"] == 1
+    assert stats["restore_queue_depth"] == 0
+
+
+def test_async_import_lease_arms_at_landing(engine):
+    """The import lease starts when the LAST block lands (not at
+    registration): expiry then releases the pinned refs exactly like
+    a synchronous import's lease."""
+    _prompt, _want, wire, importer = _async_rig(
+        engine, restore_blocks_per_step=2)
+    evictable_before = len(importer._evictable)
+    assert importer.kv_import_payload(
+        dict(wire), engine=engine, lease_s=5.0, async_import=True) == 4
+    # Expiry clock starts only once landed; advancing now is a no-op.
+    engine.advance(6.0)
+    engine.drain()
+    assert importer.stats()["kv_imports_async"] == 0
+    importer.step()                                  # 2 blocks land
+    importer.step()                                  # all 4 landed
+    assert importer.stats()["kv_imports_async"] == 1
+    assert importer.stats()["restore_queue_depth"] == 0
+    assert len(importer._evictable) == evictable_before
+    engine.advance(6.0)
+    engine.drain()
+    assert len(importer._evictable) == evictable_before + 4
+
+
+def test_chaos_kill_mid_import_loses_nothing(engine):
+    """Kill the importer with the segment half-landed: no other
+    replica observes half a chain (the dead pool dies whole), and the
+    request re-routes to a fresh replica whose local prefill is
+    bit-exact — zero tokens lost.  On the surviving-importer side,
+    the half-landed chain is never adoptable mid-flight and finishes
+    bit-exact if the replica lives."""
+    prompt, want, wire, importer = _async_rig(engine)
+    assert importer.kv_import_payload(
+        dict(wire), engine=engine, async_import=True) == 4
+    importer.step()                                  # ONE block lands
+    assert 0 < importer.stats()["restore_queue_depth"] < 4
+    # Mid-flight, the partial chain must not be advertised or served:
+    # exports of the importing segment resolve nothing past the
+    # landed prefix, and the hit walk still defers.
+    depth = importer.prefix_local_depth(prompt)
+    assert depth < 4
+    # ... kill: the importer is abandoned mid-landing.  A fresh
+    # replica serves the same request by local prefill — bit-exact,
+    # zero lost.
+    fallback = make_server(max_seq=128, total_blocks=24)
+    assert _warm(fallback, prompt) == want
+
+
+def test_truncated_async_payload_rejects_with_zero_side_effects(
+        engine):
+    """The owner dying MID-SEND delivers a truncated payload; the
+    async import must reject it before touching the pool, the free
+    list, or the landing queue."""
+    _prompt, _want, wire, importer = _async_rig(engine)
+    truncated = {k: v for k, v in wire.items()
+                 if not k.startswith("kv_l1_")}
+    free_before = len(importer._free)
+    index_before = dict(importer._index)
+    assert importer.kv_import_payload(
+        truncated, engine=engine, async_import=True) == 0
+    assert len(importer._free) == free_before
+    assert importer._index == index_before
+    assert importer.stats()["restore_queue_depth"] == 0
+    assert not any(owner == RESTORING
+                   for owner in importer._producing.values())
+
+
+def test_import_rejects_row_byte_mismatch():
+    """A payload whose field bytes don't match the pool layout (e.g.
+    wrong trailing shape smuggled past the leading-axis check) is
+    rejected before any allocation."""
+    _prompt, _want, wire, importer = _async_rig(engine=None)
+    field = next(k for k in wire if k.startswith("kv_l0_k"))
+    bad = dict(wire)
+    bad[field] = wire[field][..., :-1]               # shave head_dim
+    free_before = len(importer._free)
+    assert importer.kv_import_payload(bad) == 0
+    assert len(importer._free) == free_before
