@@ -20,6 +20,11 @@ routing"):
    staging-buffer engine: one-sync export, one-upload import, and
    step-overlapped async landing behind the tier sentinel — the same
    primitives back host-tier demote/restore.
+4. **SSD spill store** (:mod:`.spill`) — the crash-durable bottom
+   tier: host-RAM overflow writes CRC-sealed block files (write-temp
+   + fsync + rename groups) that a respawned replica re-adopts, so a
+   restart is a warm start and a checksum trip degrades to recompute,
+   never to wrong tokens.
 
 Everything here is HOST-side: no function in this package may appear
 in (or change) a traced serve-chunk program — regression-locked by the
@@ -28,6 +33,7 @@ jaxpr/AST guards in tests/test_kvstore.py.
 
 from .directory import (PrefixDirectory, chain_keys, chain_keys_hex,
                         digest_decode, digest_encode, shareable_blocks)
+from .spill import SpillCorruptionError, SpillFormatError, SpillStore
 from .transfer import (export_payload, gather_block_rows,
                        import_payload, payload_bytes, pool_signature,
                        scatter_block_row_dicts, scatter_block_rows,
@@ -37,4 +43,5 @@ __all__ = ["PrefixDirectory", "chain_keys", "chain_keys_hex",
            "digest_decode", "digest_encode", "shareable_blocks",
            "export_payload", "import_payload", "payload_bytes",
            "pool_signature", "seed_chain", "gather_block_rows",
-           "scatter_block_rows", "scatter_block_row_dicts"]
+           "scatter_block_rows", "scatter_block_row_dicts",
+           "SpillStore", "SpillFormatError", "SpillCorruptionError"]

@@ -12,7 +12,7 @@ Digest wire format (the value of the ``kv_prefixes`` EC-share key,
 published on the replica's state topic):
 
     <block_size>;<role>;<entry>,<entry>,...
-    entry = <hex16>/<depth>/<refs>/<hotness>[/<tier>]
+    entry = <hex16>/<depth>/<refs>/<hotness>[/<tier>[/<adopted>]]
 
 ``hex16`` is the first 8 bytes of the chain key (64 collision bits —
 ample for directory routing; the replica re-verifies full keys at
@@ -21,9 +21,13 @@ of whole-prefix history it represents); ``refs``/``hotness`` are
 advisory load signals.  ``tier`` is where the block's bytes live —
 0 = HBM (omitted on the wire: the pre-tier 4-field entry stays valid),
 1 = host RAM (a hit needs a restore upload before decode can read it,
-so the router prices it below an HBM hit but above a recompute).  The
-format is S-expression-safe by construction: hex, digits, ``;,/``
-only — no spaces or parens.
+so the router prices it below an HBM hit but above a recompute),
+2 = SSD spill (priced below a host hit, still above a recompute).
+``adopted`` marks a tier-2 entry re-adopted from the spill directory
+by a warm replica restart (0 omitted on the wire — the 5-field tier
+format stays valid byte-for-byte, same back-compat move the ``tier``
+field made on the 4-field format).  The format is S-expression-safe
+by construction: hex, digits, ``;,/`` only — no spaces or parens.
 
 Staleness is LEASE-based: each replica's advertisement expires
 ``lease_s`` after its last refresh (replicas re-advertise every pump
@@ -93,27 +97,33 @@ def shareable_blocks(prompt_len: int, block_size: int) -> int:
 
 def digest_encode(block_size: int, role: str,
                   entries: Sequence[Tuple]) -> str:
-    """``entries`` = [(hex16, depth, refs, hotness[, tier])] — already
-    selected/ordered by the replica (hottest, deepest first).  A
-    missing or zero tier (HBM) is omitted on the wire, so untiered
-    replicas keep emitting the 4-field format byte-for-byte."""
+    """``entries`` = [(hex16, depth, refs, hotness[, tier[,
+    adopted]])] — already selected/ordered by the replica (hottest,
+    deepest first).  A missing or zero tier (HBM) is omitted on the
+    wire, so untiered replicas keep emitting the 4-field format
+    byte-for-byte; likewise a zero adopted flag keeps the 5-field
+    tier format."""
     parts = []
     for entry in entries:
         hex_key, depth, refs, hot = entry[:4]
         tier = entry[4] if len(entry) > 4 else 0
+        adopted = entry[5] if len(entry) > 5 else 0
         item = f"{hex_key}/{depth}/{refs}/{hot}"
-        if tier:
+        if tier or adopted:
             item += f"/{int(tier)}"
+        if adopted:
+            item += f"/{int(adopted)}"
         parts.append(item)
     return f"{block_size};{role};{','.join(parts)}"
 
 
 def digest_decode(text: str):
-    """Returns ``(block_size, role, entries)`` with 5-tuple entries
-    ``(hex16, depth, refs, hotness, tier)`` — tier defaults to 0 for
-    4-field (pre-tier) entries — or ``None`` on any malformed input
-    (directory updates are best-effort: a corrupt advertisement is
-    dropped, never raises into the router)."""
+    """Returns ``(block_size, role, entries)`` with 6-tuple entries
+    ``(hex16, depth, refs, hotness, tier, adopted)`` — tier/adopted
+    default to 0 for the shorter (pre-tier, pre-spill) formats — or
+    ``None`` on any malformed input (directory updates are
+    best-effort: a corrupt advertisement is dropped, never raises
+    into the router)."""
     try:
         block_text, role, body = str(text).split(";", 2)
         block_size = int(block_text)
@@ -121,11 +131,13 @@ def digest_decode(text: str):
         if body:
             for item in body.split(","):
                 fields = item.split("/")
-                if len(fields) not in (4, 5):
+                if len(fields) not in (4, 5, 6):
                     return None
-                tier = int(fields[4]) if len(fields) == 5 else 0
+                tier = int(fields[4]) if len(fields) > 4 else 0
+                adopted = int(fields[5]) if len(fields) > 5 else 0
                 entries.append((fields[0], int(fields[1]),
-                                int(fields[2]), int(fields[3]), tier))
+                                int(fields[2]), int(fields[3]),
+                                tier, adopted))
         return block_size, role, entries
     except (TypeError, ValueError):
         return None
@@ -145,9 +157,9 @@ class PrefixDirectory:
 
     def __init__(self, lease_s: float = 30.0):
         self.lease_s = lease_s
-        #: replica -> {hex16 -> (depth, refs, hotness, tier)}
+        #: replica -> {hex16 -> (depth, refs, hotness, tier, adopted)}
         self._by_replica: \
-            Dict[str, Dict[str, Tuple[int, int, int, int]]] = {}
+            Dict[str, Dict[str, Tuple[int, int, int, int, int]]] = {}
         self._expiry: Dict[str, float] = {}
         self._block_size: Dict[str, int] = {}
         self._role: Dict[str, str] = {}
@@ -163,8 +175,8 @@ class PrefixDirectory:
             return False
         block_size, role, entries = decoded
         self._by_replica[replica] = {
-            hex_key: (depth, refs, hot, tier)
-            for hex_key, depth, refs, hot, tier in entries}
+            hex_key: (depth, refs, hot, tier, adopted)
+            for hex_key, depth, refs, hot, tier, adopted in entries}
         self._block_size[replica] = block_size
         self._role[replica] = role
         self._expiry[replica] = now + self.lease_s
@@ -219,13 +231,27 @@ class PrefixDirectory:
         cap dropped are assumed HBM — eviction is leaf-first, so a
         chain demotes from its leaves and an unadvertised ancestor of
         an HBM entry cannot sit in a colder tier than its child."""
+        depth, host, _disk = self.matched_tiers(replica, keys_hex, now)
+        return depth, host
+
+    def matched_tiers(self, replica: str, keys_hex: Sequence[str],
+                      now: float) -> Tuple[int, int, int]:
+        """``(depth, host_blocks, disk_blocks)``: the matched depth
+        split by where the bytes live, so the router can price each
+        rung of the tower separately (HBM > host restore > disk
+        restore > recompute)."""
         depth = self.matched_blocks(replica, keys_hex, now)
         if not depth:
-            return 0, 0
+            return 0, 0, 0
         advertised = self._by_replica.get(replica, {})
-        host = sum(1 for key in keys_hex[:depth]
-                   if advertised.get(key, (0, 0, 0, 0))[3])
-        return depth, host
+        host = disk = 0
+        for key in keys_hex[:depth]:
+            tier = advertised.get(key, (0, 0, 0, 0, 0))[3]
+            if tier == 1:
+                host += 1
+            elif tier == 2:
+                disk += 1
+        return depth, host, disk
 
     def best_owner(self, keys_hex: Sequence[str], now: float,
                    exclude=()) -> Tuple[Optional[str], int]:
@@ -241,7 +267,7 @@ class PrefixDirectory:
             if not depth:
                 continue
             hot = self._by_replica[replica].get(
-                keys_hex[depth - 1], (0, 0, 0, 0))[2]
+                keys_hex[depth - 1], (0, 0, 0, 0, 0))[2]
             # sorted() order makes the final tie deterministic.
             if (depth, hot) > best[:2]:
                 best = (depth, hot, replica)

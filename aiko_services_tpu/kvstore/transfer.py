@@ -481,7 +481,10 @@ def export_payload(server, keys_hex: List[str], start_depth: int,
     depth ``start_depth + 1``) through the owner's prefix index and
     gather the pool rows.  A key demoted to the owner's host tier is
     served straight from its host rows — same bytes, no promotion, no
-    pool pressure on the owner.  Returns the wire dict, or ``None``
+    pool pressure on the owner — and a key spilled to the owner's disk
+    tier splices in through its checksum-verified read (a corrupt file
+    fails the export instead of shipping bad KV).  Returns the wire
+    dict, or ``None``
     when the owner no longer holds a usable segment (evicted since it
     was advertised, still producing, adapter-seeded, or depth
     drifted) — the caller answers with an error and the importer
@@ -502,8 +505,14 @@ def export_payload(server, keys_hex: List[str], start_depth: int,
         if block is None:
             entry = host_tier.get(key)
             if entry is None:
-                break
-            source = entry["rows"]
+                spill_rows = getattr(server, "_spill_rows", None)
+                rows = spill_rows(key) \
+                    if spill_rows is not None else None
+                if rows is None:
+                    break
+                source = rows
+            else:
+                source = entry["rows"]
         elif block in server._producing:
             break                      # content not landed yet
         else:
@@ -540,11 +549,14 @@ def export_payload(server, keys_hex: List[str], start_depth: int,
                 stacked, cursor = [], 0
                 for source in sources:
                     if isinstance(source, int):
-                        stacked.append(gathered[field][cursor])
+                        stacked.append(_pack(gathered[field][cursor]))
                         cursor += 1
                     else:
-                        stacked.append(source[field])
-                payload[f"kv_{field}"] = _pack(np.stack(stacked))
+                        # Host rows are native dtype; spill rows are
+                        # already wire bit patterns — _pack makes the
+                        # stack dtype-uniform either way.
+                        stacked.append(_pack(np.asarray(source[field])))
+                payload[f"kv_{field}"] = np.stack(stacked)
         return payload
     if hbm:
         staging, layout = gather_block_bytes(server, hbm)

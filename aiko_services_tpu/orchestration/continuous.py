@@ -1662,6 +1662,7 @@ class ContinuousReplica(Actor):
             if carrier:
                 request.trace_ctx = str(carrier)
             kv_source = inputs.get("kv_source")
+            kv_tier_hint = inputs.get("kv_tier_hint")
             if self.prefill_only or inputs.get("prefill_only"):
                 # Dedicated prefill: the admission seed IS the one
                 # generated token; the prompt's blocks stay cached
@@ -1678,6 +1679,13 @@ class ContinuousReplica(Actor):
                 and request.adapter is None:
             if self._begin_kv_fetch(request, str(kv_source)):
                 return        # parked until import or timeout
+        if kv_tier_hint and request.adapter is None \
+                and hasattr(self.server, "prefetch_promote"):
+            # Router hinted this prompt at a demoted/spilled chain:
+            # start the async promotion NOW so the restore overlaps
+            # the request's queue wait instead of beginning at its
+            # admission deferral (tier-aware prefetch).
+            self.server.prefetch_promote(request.prompt)
         self.server.submit(request)
         self._ensure_pumping()
 

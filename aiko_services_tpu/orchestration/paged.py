@@ -39,6 +39,16 @@ Static-shape TPU design (no dynamic allocation inside jit):
   (ARCHITECTURE invariant 10).  All of it host-side bookkeeping: no
   tier branch exists in any traced module (invariant 7, jaxpr/AST
   pinned in tests/test_kv_tier.py).
+* **SSD spill tier** (``spill_dir=``): host-RAM overflow demotes block
+  rows to a crash-durable spill directory (:mod:`~..kvstore.spill`:
+  write-temp + fsync + rename groups, CRC-sealed headers carrying the
+  full chain identity) instead of purging them, and a respawned
+  replica re-adopts the directory at startup — a crash restart is a
+  WARM start, advertised at tier 2 in the prefix digest.  A checksum
+  trip NEVER serves the bytes: the chain degrades to plain recompute
+  and ``kv_checksum_failures`` increments (ARCHITECTURE invariant 13).
+  One eviction clock spans HBM → host → disk, so every tier's
+  overflow drops the globally coldest remnant.
 
 Greedy outputs exactly match the contiguous server and per-request
 ``generate_tokens`` (tested) — paging changes memory shape only.
@@ -97,6 +107,9 @@ class PagedContinuousServer(ContinuousBatchingServer):
                  watchdog_s: float = 0.0, replica_mesh=None,
                  host_tier_blocks: Optional[int] = None,
                  restore_blocks_per_step: int = 4,
+                 spill_dir: Optional[str] = None,
+                 spill_blocks: Optional[int] = None,
+                 spill_adopt: bool = True,
                  draft_config_name: Optional[str] = None,
                  draft_params=None, spec_k: int = 4,
                  draft_quantize: bool = False):
@@ -114,6 +127,17 @@ class PagedContinuousServer(ContinuousBatchingServer):
         #: of stalling one.
         self.restore_blocks_per_step = max(1,
                                            int(restore_blocks_per_step))
+        #: SSD spill tier (kvstore/spill.py): directory where host-RAM
+        #: overflow demotes block rows instead of purging them —
+        #: crash-durable, re-adopted at startup.  None disables (the
+        #: two-tier behavior).
+        self.spill_dir = str(spill_dir) if spill_dir else None
+        #: Disk tier capacity in blocks; overflow drops the coldest
+        #: remnant by the shared eviction clock.
+        self.spill_blocks = int(spill_blocks) if spill_blocks else 1024
+        #: Scan + re-adopt the spill directory at startup (the warm
+        #: restart); off for pools that want a private scratch dir.
+        self.spill_adopt = bool(spill_adopt)
         if chunk_prefill_tokens is None:
             chunk_prefill_tokens = self.DEFAULT_CHUNK_PREFILL_TOKENS
         super().__init__(config_name=config_name, slots=slots,
@@ -258,6 +282,35 @@ class PagedContinuousServer(ContinuousBatchingServer):
         self.kv_export_sync_count = 0
         self.kv_transfer_host_ms = 0.0
         self.kv_imports_async = 0
+        # Durable SSD spill tier (kvstore/spill.py):
+        #   _spill: chain key -> {"nbytes": int} for every block whose
+        #     rows live ON DISK, insertion order = spill order under
+        #     ONE shared eviction clock (host overflow pops its oldest
+        #     demotion, so disk overflow keeps dropping the globally
+        #     coldest remnant).  A key resolves in exactly one of
+        #     _index / _host / _spill; spilled keys KEEP the same
+        #     chain-identity maps demoted keys do.
+        #   _adopted_keys: chains re-adopted from disk by a warm
+        #     restart and not yet promoted — advertised with the
+        #     digest's adopted flag so peers can tell a survivor from
+        #     a live working set.
+        self._spill: "OrderedDict[bytes, dict]" = OrderedDict()
+        self._adopted_keys: set = set()
+        self._evict_clock = 0
+        self.kv_spills = 0
+        self.kv_disk_bytes = 0
+        self.kv_disk_restores = 0
+        self.kv_checksum_failures = 0
+        self.kv_adopted_chains = 0
+        self.kv_prefetch_promotions = 0
+        self.spill = None
+        if self.spill_dir:
+            from ..kvstore.spill import SpillStore
+            self.spill = SpillStore(self.spill_dir,
+                                    _kvxfer.pool_signature(self),
+                                    self.block_size)
+            if self.spill_adopt:
+                self._adopt_spill()
 
     def _init_device_state(self):
         state = super()._init_device_state()
@@ -296,6 +349,13 @@ class PagedContinuousServer(ContinuousBatchingServer):
             kv_export_sync_count=self.kv_export_sync_count,
             kv_transfer_host_ms=round(self.kv_transfer_host_ms, 2),
             kv_imports_async=self.kv_imports_async,
+            kv_spills=self.kv_spills,
+            kv_disk_blocks=len(self._spill),
+            kv_disk_bytes=self.kv_disk_bytes,
+            kv_disk_restores=self.kv_disk_restores,
+            kv_checksum_failures=self.kv_checksum_failures,
+            kv_adopted_chains=self.kv_adopted_chains,
+            kv_prefetch_promotions=self.kv_prefetch_promotions,
             free_blocks=self.free_blocks,
             total_blocks=self.total_blocks,
         )
@@ -387,14 +447,15 @@ class PagedContinuousServer(ContinuousBatchingServer):
         entry's indexed children are themselves evictable (owners of a
         child own the whole prefix path).
 
-        With a host tier configured, eviction DEMOTES instead of
-        deleting: the block's rows copy to host RAM and the chain key
-        stays addressable (restored on the next hit).  Adapter-seeded
-        chains still delete — their stacked indices are replica-local
-        and hot unload must be able to purge them synchronously."""
+        With a host tier (or spill tier) configured, eviction DEMOTES
+        instead of deleting: the block's rows copy down the tower and
+        the chain key stays addressable (restored on the next hit).
+        Adapter-seeded chains still delete — their stacked indices are
+        replica-local and hot unload must be able to purge them
+        synchronously."""
         for key, block in self._evictable.items():          # LRU order
             if self._children.get(key, 0) == 0:
-                if self.host_tier_blocks \
+                if self._tier_enabled() \
                         and self._key_seed.get(key, 0) == 0:
                     self._demote(key, block)
                 else:
@@ -422,10 +483,22 @@ class PagedContinuousServer(ContinuousBatchingServer):
                           {name: np.ascontiguousarray(stack[0])
                            for name, stack in rows.items()})
 
+    def _tier_enabled(self) -> bool:
+        """Eviction demotes (host RAM and/or disk) instead of
+        deleting.  A disabled spill store (disk full, write error)
+        with no host tier reverts eviction to plain deletion."""
+        return self.host_tier_blocks > 0 or (
+            self.spill is not None and self.spill.enabled)
+
     def _demote_rows(self, key, block, row_dict) -> None:
         entry = {"rows": row_dict}
         entry["nbytes"] = sum(int(r.nbytes)
                               for r in entry["rows"].values())
+        # One eviction clock spans the whole tower: stamped here at
+        # demotion, carried into the disk header, restored by
+        # adoption — so overflow ordering survives a restart.
+        self._evict_clock += 1
+        entry["clock"] = self._evict_clock
         self._index.pop(key, None)
         self._evictable.pop(key, None)
         self._block_key.pop(block, None)
@@ -439,16 +512,79 @@ class PagedContinuousServer(ContinuousBatchingServer):
         self._host[key] = entry
         self.kv_demotions += 1
         self.kv_host_bytes += entry["nbytes"]
+        self._host_overflow()
+
+    def _host_overflow(self) -> None:
+        """Pop host-tier overflow and SPILL it to disk as one
+        crash-consistent block group (kvstore/spill.py: every file
+        staged + fsync'd, then renamed) — the tower's bottom rung.
+        Entries the spill cannot take (no store, store disabled by a
+        write error, adapter-seeded) purge for good.  Disk overflow
+        then drops the oldest-clock remnant, keeping the same
+        leaf-first rootedness the host tier's ordering gives."""
+        excess = []
         while len(self._host) > self.host_tier_blocks:
-            old_key, old_entry = self._host.popitem(last=False)
-            self._purge_host_entry(old_key, old_entry)
+            excess.append(self._host.popitem(last=False))
+        if not excess:
+            return
+        spilled = self._spill_entries(
+            [(key, entry) for key, entry in excess
+             if self.spill is not None and self.spill.enabled
+             and self._key_seed.get(key, 0) == 0])
+        for key, entry in excess:
+            if key in spilled:
+                self._spill[key] = {"nbytes": entry["nbytes"]}
+                self.kv_host_bytes -= entry["nbytes"]
+                self.kv_spills += 1
+                self.kv_disk_bytes += entry["nbytes"]
+            else:
+                self._purge_host_entry(key, entry)
+        while len(self._spill) > self.spill_blocks:
+            old_key, old_meta = self._spill.popitem(last=False)
+            self._purge_spill_entry(old_key, old_meta)
+
+    def _spill_entries(self, items) -> set:
+        """Write ``[(key, host_entry)]`` to the spill store as ONE
+        block group; returns the set of keys durably on disk (empty
+        when the store is off, disabled, or the write failed — the
+        caller purges those entries instead, degrading gracefully)."""
+        if not items or self.spill is None:
+            return set()
+        group = []
+        for key, entry in items:
+            parent = self._parent.get(key)
+            group.append((key.hex(), dict(
+                parent=parent.hex() if parent is not None else "",
+                depth=int(self._depth.get(key, 0)),
+                key_seed=0,
+                hits=int(self._key_hits.get(key, 0)),
+                clock=int(entry.get("clock", 0))), entry["rows"]))
+        if not self.spill.put_group(group):
+            return set()
+        return {key for key, _entry in items}
 
     def _purge_host_entry(self, key, entry) -> None:
-        """A host-tier entry leaves the cache FOR GOOD (overflow):
-        now its chain identity goes too — this is the true eviction
-        the tier deferred."""
+        """A host-tier entry leaves the cache FOR GOOD (overflow with
+        nowhere lower to go): now its chain identity goes too — this
+        is the true eviction the tier deferred."""
         self.kv_host_bytes -= entry["nbytes"]
         self.prefix_evictions += 1
+        self._purge_tier_identity(key)
+
+    def _purge_spill_entry(self, key, meta) -> None:
+        """A disk-tier entry leaves the cache FOR GOOD (capacity
+        overflow or a failed checksum): file and chain identity both
+        go — the bottom of the tower has nowhere lower."""
+        if self.spill is not None:
+            self.spill.discard(key.hex())
+        self.kv_disk_bytes -= meta["nbytes"]
+        self._adopted_keys.discard(key)
+        self.prefix_evictions += 1
+        self._purge_tier_identity(key)
+
+    def _purge_tier_identity(self, key) -> None:
+        """Drop a tier-resident key's chain identity (the shared tail
+        of every host/disk purge)."""
         self._depth.pop(key, None)
         self._key_seed.pop(key, None)
         self._key_hits.pop(key, None)
@@ -460,13 +596,156 @@ class PagedContinuousServer(ContinuousBatchingServer):
         self._children.pop(key, None)
 
     def _host_discard(self, key) -> None:
-        """Drop a host copy whose key is about to re-register in HBM
-        (recompute admission, import, or seed) — identical bytes by
-        construction, but a key must never resolve both ways.  Not an
-        eviction: the content lives on in the pool."""
+        """Drop a host/disk copy whose key is about to re-register in
+        HBM (recompute admission, import, or seed) — identical bytes
+        by construction, but a key must never resolve both ways.  Not
+        an eviction: the content lives on in the pool."""
         entry = self._host.pop(key, None)
         if entry is not None:
             self.kv_host_bytes -= entry["nbytes"]
+        meta = self._spill.pop(key, None)
+        if meta is not None:
+            self.kv_disk_bytes -= meta["nbytes"]
+            self._adopted_keys.discard(key)
+            if self.spill is not None:
+                self.spill.discard(key.hex())
+
+    def _spill_rows(self, key) -> Optional[Dict]:
+        """Checksum-verified rows of a spilled block, reconstructed in
+        the pool's wire layout (bf16 as uint16 bit patterns — the
+        restore scatter bitcasts and the export splice ships bit
+        patterns anyway, so bytes are the whole contract).
+        Non-destructive on success (exports read in place).  ANY
+        verification failure purges the entry and returns None:
+        corrupt KV never leaves this method (invariant 13)."""
+        if self.spill is None or key not in self._spill:
+            return None
+        from ..kvstore import spill as _kvspill
+        record = None
+        try:
+            record = self.spill.read(key.hex())
+        except _kvspill.SpillCorruptionError:
+            self.kv_checksum_failures += 1
+        except _kvspill.SpillFormatError:
+            pass
+        rows = None
+        if record is not None:
+            rows = {}
+            for field, shape, dtype, row_bytes in \
+                    _kvxfer._field_layout(self):
+                raw = record["rows"].get(field)
+                if raw is None or raw.nbytes != row_bytes:
+                    self.kv_checksum_failures += 1
+                    rows = None
+                    break
+                wire = np.dtype(np.uint16) \
+                    if dtype.name == _kvspill.BF16 else dtype
+                rows[field] = raw.view(wire).reshape(shape)
+        if rows is None:
+            meta = self._spill.pop(key, None)
+            if meta is not None:
+                self._purge_spill_entry(key, meta)
+            return None
+        return rows
+
+    def _take_spill(self, key) -> Optional[Dict]:
+        """Destructive verified read for a restore: the rows leave the
+        disk tier (the HBM registration supersedes the file).  Returns
+        a host-entry-shaped dict, or None on a verification failure —
+        the entry is purged and the caller degrades that chain tail to
+        plain recompute (cold but correct, never wrong tokens)."""
+        rows = self._spill_rows(key)
+        if rows is None:
+            return None
+        meta = self._spill.pop(key)
+        self.kv_disk_bytes -= meta["nbytes"]
+        self._adopted_keys.discard(key)
+        self.spill.discard(key.hex())
+        return {"rows": rows, "nbytes": meta["nbytes"]}
+
+    def _adopt_spill(self) -> None:
+        """Warm replica restart: inventory the spill directory and
+        re-adopt every chain that is still ROOTED (depth 1 upward, no
+        gaps — the hit walk only ever reaches contiguous prefixes).
+        Adopted keys re-enter the chain-identity maps and the disk
+        tier in the previous process's clock order, so overflow keeps
+        dropping the globally coldest remnant across the restart.
+        Rootless files are discarded; corrupt files were already
+        deleted (and counted) by the scan.  Read-only over the
+        adopted files themselves — a crash mid-adopt leaves the
+        directory re-adoptable."""
+        metas, corrupt = self.spill.scan()
+        self.kv_checksum_failures += corrupt
+        by_hex: Dict[str, dict] = {}
+        for meta in metas:
+            hex_key = str(meta.get("key", ""))
+            if len(hex_key) == 64 and meta.get("key_seed", 0) == 0 \
+                    and int(meta.get("depth", 0)) >= 1:
+                by_hex[hex_key] = meta
+        adopted: Dict[str, dict] = {}
+        for hex_key, meta in sorted(
+                by_hex.items(), key=lambda kv: kv[1].get("depth", 0)):
+            if int(meta["depth"]) == 1 \
+                    or meta.get("parent", "") in adopted:
+                adopted[hex_key] = meta
+        for meta in metas:
+            hex_key = str(meta.get("key", ""))
+            if hex_key not in adopted:
+                self.spill.discard(hex_key)
+        for hex_key, meta in sorted(
+                adopted.items(), key=lambda kv: kv[1].get("clock", 0)):
+            key = bytes.fromhex(hex_key)
+            depth = int(meta["depth"])
+            self._depth[key] = depth
+            self._key_seed[key] = 0
+            self._key_hits[key] = int(meta.get("hits", 0))
+            self._hex_key[hex_key[:_kvdir.HEX_KEY_CHARS]] = key
+            parent_hex = meta.get("parent", "")
+            if parent_hex in adopted:
+                self._parent[key] = bytes.fromhex(parent_hex)
+            nbytes = int(meta.get("nbytes", 0))
+            self._spill[key] = {"nbytes": nbytes}
+            self.kv_disk_bytes += nbytes
+            self._adopted_keys.add(key)
+            self._evict_clock = max(self._evict_clock,
+                                    int(meta.get("clock", 0)))
+            if depth == 1:
+                self.kv_adopted_chains += 1
+        while len(self._spill) > self.spill_blocks:
+            old_key, old_meta = self._spill.popitem(last=False)
+            self._purge_spill_entry(old_key, old_meta)
+
+    def prefetch_promote(self, prompt) -> bool:
+        """Tier-aware prefetch: begin the async promotion of a
+        demoted/spilled chain for ``prompt`` BEFORE its admission walk
+        trips over it.  The router hints the owning replica at route
+        time (``kv_tier_hint``), so the restore overlaps the request's
+        queue wait instead of starting at its deferral.  Host-side
+        bookkeeping only; returns True when a restore was queued."""
+        if not self.enable_prefix_cache:
+            return False
+        prompt = np.asarray(prompt)
+        keys = self._chain_keys(prompt)[
+            :self._shareable_blocks(len(prompt))]
+        shared: List[int] = []
+        for key in keys:
+            block = self._index.get(key)
+            if block is None:
+                break
+            if block in self._producing:
+                # Producing or already RESTORING: in flight — a second
+                # promotion would double-register the chain.
+                return False
+            shared.append(block)
+        if len(shared) == len(keys):
+            return False            # fully resident: nothing to do
+        key = keys[len(shared)]
+        if key not in self._host and key not in self._spill:
+            return False            # cold continuation: recompute
+        if not self._begin_restore(keys, shared):
+            return False
+        self.kv_prefetch_promotions += 1
+        return True
 
     def _begin_restore(self, keys, shared) -> bool:
         """Start an asynchronous promotion of the demoted tail of
@@ -485,11 +764,19 @@ class PagedContinuousServer(ContinuousBatchingServer):
         for position in range(len(shared), len(keys)):
             # Pop host entries FIRST: the eviction below may demote
             # more blocks, and an overflow purge must never race away
-            # rows we are about to upload.
-            entry = self._host.pop(keys[position], None)
+            # rows we are about to upload.  Disk entries splice in
+            # where the host runs out — to this walk a disk tier is
+            # just a slower host store.
+            key = keys[position]
+            entry = self._host.pop(key, None)
             if entry is None:
-                break
-            segment.append((position, keys[position], entry))
+                if key not in self._spill:
+                    break
+                entry = self._take_spill(key)
+                if entry is None:
+                    break   # checksum trip: the tail recomputes
+                entry["src"] = "disk"
+            segment.append((position, key, entry))
         if not segment:
             return False
         # Pin the HBM prefix across the eviction (it must not demote
@@ -509,6 +796,12 @@ class PagedContinuousServer(ContinuousBatchingServer):
         if not fits:
             for position, key, entry in segment:
                 self._host[key] = entry
+                if entry.pop("src", None) == "disk":
+                    # The disk bytes were consumed by _take_spill: the
+                    # rows now live in the host tier instead (and may
+                    # re-spill on its next overflow).
+                    self.kv_host_bytes += entry["nbytes"]
+            self._host_overflow()
             return False
         for (position, key, entry), block in zip(segment, blocks):
             self._index[key] = block
@@ -520,10 +813,12 @@ class PagedContinuousServer(ContinuousBatchingServer):
                 self._parent[key] = parent
                 self._children[parent] = \
                     self._children.get(parent, 0) + 1
-            self.kv_host_bytes -= entry["nbytes"]
+            src = entry.get("src")
+            if src != "disk":
+                self.kv_host_bytes -= entry["nbytes"]
             self._restoring.append(dict(key=key, block=block,
                                         rows=entry["rows"],
-                                        group=None))
+                                        group=None, src=src))
         return True
 
     def _queue_import(self, key_blocks, per_block_rows,
@@ -566,11 +861,15 @@ class PagedContinuousServer(ContinuousBatchingServer):
             self._producing.pop(block, None)
             group = entry["group"]
             if group is None:
-                # Host-tier restore: cached again, MRU, adoptable.
+                # Host/disk-tier restore: cached again, MRU,
+                # adoptable.
                 self._refs[block] = 0
                 self._evictable[entry["key"]] = block
                 self._restored_keys.add(entry["key"])
-                self.kv_restores += 1
+                if entry.get("src") == "disk":
+                    self.kv_disk_restores += 1
+                else:
+                    self.kv_restores += 1
                 continue
             # Async wire import: the block stays ref-pinned; the
             # lease arms once the whole segment has landed.
@@ -627,7 +926,7 @@ class PagedContinuousServer(ContinuousBatchingServer):
             return
         demote = []
         for key, block in self._select_victims(want):
-            if self.host_tier_blocks \
+            if self._tier_enabled() \
                     and self._key_seed.get(key, 0) == 0:
                 demote.append((key, block))
             else:
@@ -670,9 +969,10 @@ class PagedContinuousServer(ContinuousBatchingServer):
                 block = self._index.get(key)
                 if block is None:
                     # A demoted continuation: restore it instead of
-                    # recomputing work the host tier still holds.
-                    restore_host = bool(self.host_tier_blocks) \
-                        and key in self._host
+                    # recomputing work a lower tier still holds (host
+                    # RAM or the spill directory — same machinery).
+                    restore_host = key in self._host \
+                        or key in self._spill
                     break
                 if block in self._producing:
                     # In-flight chunked prefills register their keys
@@ -1110,8 +1410,10 @@ class PagedContinuousServer(ContinuousBatchingServer):
         producing), base-adapter chains only, hottest + deepest first,
         capped at ``max_entries`` (the EC share rides MQTT control
         topics — the digest must stay small).  Host-tier entries
-        advertise with ``tier=1`` so the router prices the restore:
-        below an HBM hit, above a recompute."""
+        advertise with ``tier=1`` and spilled entries with ``tier=2``
+        (plus the adopted flag for warm-restart survivors) so the
+        router prices each rung: HBM hit > host restore > disk
+        restore > recompute."""
         entries = []
         for key, block in self._index.items():
             if block in self._producing:
@@ -1126,6 +1428,11 @@ class PagedContinuousServer(ContinuousBatchingServer):
             entries.append((key.hex()[:_kvdir.HEX_KEY_CHARS],
                             self._depth.get(key, 0), 0,
                             self._key_hits.get(key, 0), 1))
+        for key in self._spill:
+            entries.append((key.hex()[:_kvdir.HEX_KEY_CHARS],
+                            self._depth.get(key, 0), 0,
+                            self._key_hits.get(key, 0), 2,
+                            1 if key in self._adopted_keys else 0))
         entries.sort(key=lambda e: (-e[3], -e[1], e[0]))
         return _kvdir.digest_encode(self.block_size, role,
                                     entries[:max_entries])
@@ -1138,14 +1445,15 @@ class PagedContinuousServer(ContinuousBatchingServer):
     def prefix_local_depth(self, prompt) -> int:
         """Longest locally-cached, content-complete prefix of
         ``prompt`` in blocks — what a warm-start fetch may SKIP
-        requesting from the owner.  Host-tier blocks count as local:
-        a restore beats a wire transfer of the same bytes."""
+        requesting from the owner.  Host-tier AND spilled blocks count
+        as local: a restore beats a wire transfer of the same
+        bytes."""
         depth = 0
         for key in self._chain_keys(np.asarray(prompt))[
                 :self._shareable_blocks(len(np.asarray(prompt)))]:
             block = self._index.get(key)
             if block is None:
-                if key not in self._host:
+                if key not in self._host and key not in self._spill:
                     break
             elif block in self._producing:
                 break

@@ -72,6 +72,9 @@ TELEMETRY_KEYS = (
     "kv_host_blocks", "kv_host_bytes", "restore_queue_depth",
     "prefix_hits_host", "kv_export_sync_count",
     "kv_transfer_host_ms", "kv_imports_async",
+    "kv_spills", "kv_disk_blocks", "kv_disk_bytes",
+    "kv_disk_restores", "kv_checksum_failures", "kv_adopted_chains",
+    "kv_prefetch_promotions",
     "decode_attention_path", "blocks_read_per_step",
     "prefill_tokens_per_sec", "prefill_queue_depth",
     "prefill_attention_path",
@@ -180,6 +183,7 @@ class ReplicaRouter(Actor):
                  max_redispatch: int = 4, seed: int = 0,
                  prefix_alpha: float = 1.0,
                  host_prefix_weight: float = 0.5,
+                 disk_prefix_weight: float = 0.25,
                  kv_transfer: bool = False,
                  disaggregate: bool = False,
                  directory_lease_s: float = 30.0):
@@ -207,6 +211,11 @@ class ReplicaRouter(Actor):
         #: 0.5 prices a restore below an HBM hit but well above a
         #: recompute (weight 0); 1.0 ignores tier entirely.
         self.host_prefix_weight = host_prefix_weight
+        #: Value of a DISK-tier (spilled) matched block: the restore
+        #: pays an SSD read on top of the upload, so the default 0.25
+        #: prices it below a host hit and still above a recompute —
+        #: the tower's full ordering HBM > host > disk > nothing.
+        self.disk_prefix_weight = disk_prefix_weight
         #: Attach ``kv_source`` warm-start hints when the prefix
         #: owner is not the chosen target (opt-in: transfers cost
         #: wire bytes; prefix AFFINITY alone is free).
@@ -253,7 +262,8 @@ class ReplicaRouter(Actor):
         self.counters: Dict[str, int] = CounterDict(dict(
             redispatches=0, replica_deaths_observed=0, shed=0,
             deadline_exceeded=0, cancel_unrouted=0,
-            prefix_routed=0, prefix_routed_host=0, kv_remote_hints=0),
+            prefix_routed=0, prefix_routed_host=0,
+            prefix_routed_disk=0, kv_tier_hints=0, kv_remote_hints=0),
             prefix="router", labels={"actor": self.name})
         self.share["replicas"] = 0
         self.share["replicas_retiring"] = 0
@@ -519,12 +529,14 @@ class ReplicaRouter(Actor):
         """Score ``queue_depth − α·effective_matched_blocks`` (lower
         wins; ties break by replica order for determinism), where a
         matched block advertised in the HOST tier contributes
-        ``host_prefix_weight`` of an HBM block — a restore is cheaper
-        than a recompute but dearer than a resident hit, and the
-        placement decision should reflect that.  Returns ``(target,
-        owner, owner_matched, target_matched, target_host_matched)``
-        or None when nothing matches — the caller falls back to EXACT
-        P2C, so fleets without paged prefix caches see PR-4 routing
+        ``host_prefix_weight`` of an HBM block and one in the DISK
+        tier ``disk_prefix_weight`` — each rung of the tower is
+        cheaper than a recompute but dearer than the rung above, and
+        the placement decision should reflect that.  Returns
+        ``(target, owner, owner_matched, target_matched,
+        target_host_matched, target_disk_matched)`` or None when
+        nothing matches — the caller falls back to EXACT P2C, so
+        fleets without paged prefix caches see PR-4 routing
         unchanged."""
         if self.prefix_alpha <= 0 or not payload \
                 or not self.directory.size:
@@ -533,18 +545,19 @@ class ReplicaRouter(Actor):
         if not keys_by_bs:
             return None
         now = self.process.event.now()
-        matched, host = {}, {}
+        matched, host, disk = {}, {}, {}
         for replica in candidates:
             keys = keys_by_bs.get(self.directory.block_size(replica))
-            matched[replica], host[replica] = \
-                self.directory.matched_detail(replica, keys, now) \
-                if keys else (0, 0)
+            matched[replica], host[replica], disk[replica] = \
+                self.directory.matched_tiers(replica, keys, now) \
+                if keys else (0, 0, 0)
         if not any(matched.values()):
             return None
 
         def effective(replica):
-            return matched[replica] - \
-                (1.0 - self.host_prefix_weight) * host[replica]
+            return matched[replica] \
+                - (1.0 - self.host_prefix_weight) * host[replica] \
+                - (1.0 - self.disk_prefix_weight) * disk[replica]
 
         def score(replica):
             depth = self._loads.get(replica, {}).get("queue_depth", 0)
@@ -554,7 +567,7 @@ class ReplicaRouter(Actor):
         owner = max(candidates,
                     key=lambda r: (effective(r), matched[r], r))
         return (target, owner, matched[owner], matched[target],
-                host[target])
+                host[target], disk[target])
 
     def _saturated(self, candidates: List[str]) -> bool:
         """True only when EVERY candidate reports a queue at or past
@@ -616,15 +629,26 @@ class ReplicaRouter(Actor):
         if picked is None:
             target = self._pick(decode)
             owner = owner_matched = target_matched = None
+            target_host = target_disk = 0
         else:
             (target, owner, owner_matched, target_matched,
-             target_host) = picked
+             target_host, target_disk) = picked
             self._bump("prefix_routed")
             if target_host:
                 # The chosen target's match includes demoted blocks —
                 # this request will trigger (or ride) a restore there.
                 self._bump("prefix_routed_host")
+            if target_disk:
+                self._bump("prefix_routed_disk")
         send_payload = payload or {}
+        if target_host or target_disk:
+            # Tier-aware prefetch: tell the target NOW that this
+            # request lands on a demoted/spilled chain, so it begins
+            # the async promotion while the request rides the wire and
+            # the queue — instead of at the admission walk's deferral.
+            send_payload = dict(send_payload)
+            send_payload["kv_tier_hint"] = "i:1"
+            self._bump("kv_tier_hints")
         phase = "decode"
         if self.kv_transfer and owner is not None \
                 and owner != target and owner_matched > (

@@ -38,6 +38,17 @@ point               effect at the wired site
                     (a replica that takes forever to announce), so the
                     controller's pending-spawn accounting, not a fresh
                     spawn storm, must cover the gap.
+``corrupt_disk_block``  :class:`~..kvstore.spill.SpillStore` flips one
+                    payload byte as it writes the block: the header
+                    stays valid (a warm restart re-adopts the file)
+                    but the per-field CRC trips at read — the chain
+                    must degrade to recompute, never serve the bytes.
+``disk_full``       ...fails the block-group write with ``ENOSPC``;
+                    the spill tier disables itself and the cache
+                    degrades to two-tier behaviour, serving unstalled.
+``slow_disk``       ...sleeps ``ms=`` milliseconds inside the spill
+                    write/read path — a saturated or dying device; the
+                    admission walk must keep deferring, not block.
 ==================  =====================================================
 
 Zero-cost when disabled: every site guards with ``if faults.PLAN is
@@ -75,7 +86,8 @@ __all__ = ["FaultPlan", "FAULT_POINTS", "PLAN", "install", "uninstall",
 
 FAULT_POINTS = ("kill_replica", "drop_message", "delay_message",
                 "stall_step", "expire_lease", "corrupt_response",
-                "fail_spawn", "slow_start")
+                "fail_spawn", "slow_start", "corrupt_disk_block",
+                "disk_full", "slow_disk")
 
 
 @dataclasses.dataclass

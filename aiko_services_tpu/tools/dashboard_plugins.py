@@ -267,6 +267,20 @@ def model_replica_plugin(fields, variables) -> List[str]:
                 f" restoring, "
                 f"{_get(variables, 'prefix_hits_host', default=0)}"
                 f" host hits")
+        disk_blocks = _get(variables, "kv_disk_blocks", default=None)
+        spills = _get(variables, "kv_spills", default=None)
+        if disk_blocks not in (None, "-") or \
+                spills not in (None, "-", 0):
+            lines.append(
+                f"  kv disk:   {disk_blocks or 0} blocks "
+                f"({_get(variables, 'kv_disk_bytes', default=0)} B), "
+                f"{spills or 0} spilled / "
+                f"{_get(variables, 'kv_disk_restores', default=0)}"
+                f" restored, "
+                f"{_get(variables, 'kv_adopted_chains', default=0)}"
+                f" adopted, "
+                f"{_get(variables, 'kv_checksum_failures', default=0)}"
+                f" checksum fails")
         spec_rounds = _get(variables, "spec_rounds", default=None)
         if spec_rounds not in (None, "-"):
             lines.append(

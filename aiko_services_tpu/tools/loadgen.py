@@ -34,7 +34,8 @@ __all__ = ["LoadGenerator", "LoadReport", "service_scale_sweep",
            "chaos_schedule", "run_chaos", "shared_prefix_payloads",
            "run_shared_prefix", "fleet_latency", "diurnal_trace",
            "elastic_chaos_schedule", "run_elastic",
-           "run_elastic_chaos", "main"]
+           "run_elastic_chaos", "run_longtail", "run_restart",
+           "run_restart_ab", "main"]
 
 #: Per-phase latency keys the replicas stamp on responses, in report
 #: order (``kv_restore`` is the cross-replica transfer phase).
@@ -669,7 +670,10 @@ def _fleet_kv_stats(servers) -> Dict:
                   prefix_remote_hits=0, kv_transfer_failures=0,
                   kv_demotions=0, kv_restores=0, kv_host_blocks=0,
                   kv_host_bytes=0, restore_queue_depth=0,
-                  prefix_hits_host=0)
+                  prefix_hits_host=0, kv_spills=0, kv_disk_blocks=0,
+                  kv_disk_bytes=0, kv_disk_restores=0,
+                  kv_checksum_failures=0, kv_adopted_chains=0,
+                  kv_prefetch_promotions=0)
     for server in servers:
         stats = server.stats()
         for key in totals:
@@ -876,7 +880,9 @@ def run_longtail(n_requests: int = 36, rate_hz: float = 25.0,
                  chunk_prefill_tokens: int = 64,
                  warmup_requests: int = 12,
                  drain_timeout_s: float = 180.0,
-                 seed: int = 0) -> LoadReport:
+                 seed: int = 0,
+                 spill_dir: Optional[str] = None,
+                 spill_blocks: int = 1024) -> LoadReport:
     """Capacity A/B rig for the tiered KV cache: ONE paged replica
     whose HBM pool (``total_blocks``) is deliberately smaller than the
     longtail workload's prefix working set, behind a prefix-aware
@@ -892,7 +898,14 @@ def run_longtail(n_requests: int = 36, rate_hz: float = 25.0,
     blocks, so a miss re-prefills 6 chunks of ``chunk_prefill_tokens``
     = 64 while a host hit defers one step, lands the whole chain in
     one batched scatter (``restore_blocks_per_step=24``) and prefills
-    only the tail."""
+    only the tail.
+
+    ``spill_dir`` enables the SSD spill tier under the host tier
+    (loadgen ``--disk-blocks``): host-RAM overflow demotes to disk
+    instead of purging, so the comparison becomes a FOUR-way ladder —
+    HBM hit, host restore, disk restore, recompute — and the report's
+    ``kv_spills`` / ``kv_disk_restores`` counters say how much of the
+    working set only survived on disk."""
     from ..orchestration.continuous import ContinuousReplica
     from ..orchestration.paged import PagedContinuousServer
     from ..orchestration.serving import ReplicaRouter
@@ -932,6 +945,7 @@ def run_longtail(n_requests: int = 36, rate_hz: float = 25.0,
             host_tier_blocks=host_tier_blocks,
             restore_blocks_per_step=restore_blocks_per_step,
             chunk_prefill_tokens=chunk_prefill_tokens,
+            spill_dir=spill_dir, spill_blocks=spill_blocks,
             max_queue=256, watchdog_s=10.0)
         compose_instance(ContinuousReplica, actor_args("replica_a"),
                          process=make_process(2), server=server)
@@ -972,6 +986,229 @@ def run_longtail(n_requests: int = 36, rate_hz: float = 25.0,
         thread.join(timeout=5)
 
 
+def run_restart(n_requests: int = 12, rate_hz: float = 40.0,
+                n_prefixes: int = 3, prefix_len: int = 192,
+                tail_len: int = 8,
+                total_blocks: int = 20,
+                restore_blocks_per_step: int = 16,
+                chunk_prefill_tokens: int = 64,
+                warmup_requests: int = 6,
+                recovery_batch: int = 4,
+                hit_rate_floor: float = 0.34,
+                drain_timeout_s: float = 180.0,
+                seed: int = 0,
+                spill_dir: Optional[str] = None,
+                spill_blocks: int = 1024,
+                adopt: bool = True) -> LoadReport:
+    """Warm-replica-restart rig (loadgen ``--restart-replica``): ONE
+    paged replica with ``host_tier_blocks=0`` and an SSD spill dir, so
+    every demotion lands straight on disk — the durable working set.
+    After a warmup phase that spills the longtail prefixes, the
+    replica's PROCESS is killed mid-run (the LWT fires, the router
+    sees it leave) and a fresh replica is composed on the same broker.
+    ``adopt=True`` hands the respawn the same ``spill_dir`` (warm
+    restart: the ctor scan re-adopts every intact chain and advertises
+    tier 2); ``adopt=False`` is the cold-restart A/B baseline — same
+    death, same respawn, same spill CONFIG (an empty sibling
+    directory, so both arms pay the durability tax on eviction), but
+    the pre-crash state is lost.  Adoption is the only variable.
+
+    The measured phase runs in ``recovery_batch``-request sub-batches;
+    per batch the rig computes the respawned replica's prefix hit rate
+    from counter deltas and stamps ``restart_recovery_ms`` — time from
+    respawn to the END of the first batch at or above
+    ``hit_rate_floor`` — into ``report.server_stats`` (alongside the
+    per-batch ``restart_hit_rates`` curve, ``None`` recovery when the
+    floor is never reached).  :func:`run_restart_ab` asserts warm
+    beats cold on hit rate AND mean TTFT with bit-exact greedy
+    outputs."""
+    from ..orchestration.continuous import ContinuousReplica
+    from ..orchestration.paged import PagedContinuousServer
+    from ..orchestration.serving import ReplicaRouter
+    from ..registry import Registrar
+    from ..runtime import Process, actor_args, compose_instance
+    from ..runtime.event import EventEngine
+
+    def wait_for(predicate, timeout_s: float, what: str):
+        deadline = time.time() + timeout_s
+        while not predicate():
+            if time.time() > deadline:
+                raise TimeoutError(f"restart rig: {what}")
+            time.sleep(0.02)
+
+    if spill_dir is None:
+        raise ValueError("run_restart needs a spill_dir — the rig "
+                         "exists to measure spill adoption")
+    engine = EventEngine()
+    thread = engine.run_in_thread()
+    broker = f"restart-{uuid.uuid4().hex[:6]}"
+    processes = []
+
+    def make_process(pid):
+        process = Process(namespace="restart", hostname="h",
+                          pid=str(pid), engine=engine, broker=broker)
+        processes.append(process)
+        return process
+
+    def make_server(directory: str):
+        prompt_len = prefix_len + tail_len
+        max_seq = ((prompt_len + 8 + 15) // 16) * 16
+        return PagedContinuousServer(
+            config_name="tiny", slots=2, max_seq=max_seq,
+            chunk_steps=4, seed=0, enable_prefix_cache=True,
+            total_blocks=total_blocks, host_tier_blocks=0,
+            restore_blocks_per_step=restore_blocks_per_step,
+            chunk_prefill_tokens=chunk_prefill_tokens,
+            spill_dir=directory, spill_blocks=spill_blocks,
+            max_queue=256, watchdog_s=10.0)
+
+    generator = None
+    try:
+        registrar = Registrar(process=make_process(1))
+        wait_for(lambda: registrar.state == "primary", 10,
+                 "registrar primary")
+        server_a = make_server(spill_dir)
+        process_a = make_process(2)
+        compose_instance(ContinuousReplica, actor_args("replica_a"),
+                         process=process_a, server=server_a)
+        router = compose_instance(ReplicaRouter, actor_args("router"),
+                                  process=make_process(8))
+        wait_for(lambda: router.share["replicas"] == 1, 30,
+                 "router discovery")
+        payloads = longtail_payloads(
+            n_prefixes=n_prefixes, prefix_len=prefix_len,
+            tail_len=tail_len, seed=seed)
+        generator = LoadGenerator(
+            make_process(9), f"{router.topic_path}/in",
+            payload_fn=payloads, rate_hz=rate_hz)
+        sent_total = 0
+        if warmup_requests:
+            generator.run(warmup_requests,
+                          drain_timeout_s=drain_timeout_s)
+            sent_total += warmup_requests
+        spilled = int(server_a.stats().get("kv_spills", 0))
+
+        # --- the restart: CRASH the only replica (LWT fires, the
+        # registrar evicts it), then respawn it fresh ---
+        process_a.kill()
+        wait_for(lambda: router.share["replicas"] == 0, 30,
+                 "dead replica leaving the fleet")
+        server_b = make_server(spill_dir if adopt
+                               else spill_dir + "-cold")
+        respawned_at = time.time()
+        compose_instance(ContinuousReplica, actor_args("replica_b"),
+                         process=make_process(3), server=server_b)
+        wait_for(lambda: router.share["replicas"] == 1, 30,
+                 "respawn discovery")
+
+        # --- measured phase: sub-batched so the hit-rate RECOVERY
+        # curve is observable, payload index offset so batches keep
+        # walking the same longtail instead of replaying batch one ---
+        batches: List[LoadReport] = []
+        final_tokens: Dict[str, List[int]] = {}
+        hit_rates: List[float] = []
+        recovery_ms: Optional[float] = None
+        remaining = n_requests
+        while remaining > 0:
+            batch_n = min(recovery_batch, remaining)
+            before = server_b.stats()
+            generator.payload_fn = \
+                lambda i, base=sent_total: payloads(base + i)
+            batch = generator.run(batch_n,
+                                  drain_timeout_s=drain_timeout_s)
+            for request_id, tokens in generator.final_tokens.items():
+                final_tokens[f"r{sent_total}_{request_id}"] = tokens
+            after = server_b.stats()
+            hits = int(after["prefix_hits"]) - int(before["prefix_hits"])
+            lookups = hits + (int(after["prefix_misses"])
+                              - int(before["prefix_misses"]))
+            rate = hits / lookups if lookups else 0.0
+            hit_rates.append(round(rate, 4))
+            if recovery_ms is None and rate >= hit_rate_floor:
+                recovery_ms = round(
+                    (time.time() - respawned_at) * 1000.0, 1)
+            batches.append(batch)
+            sent_total += batch_n
+            remaining -= batch_n
+        report = LoadReport(
+            sent=sum(b.sent for b in batches),
+            completed=sum(b.completed for b in batches),
+            errors=sum(b.errors for b in batches),
+            timeouts=sum(b.timeouts for b in batches),
+            elapsed_s=sum(b.elapsed_s for b in batches),
+            latencies_ms=[v for b in batches for v in b.latencies_ms],
+            tokens_total=sum(b.tokens_total for b in batches),
+            ttfts_ms=[v for b in batches for v in b.ttfts_ms],
+            duplicate_finals=sum(b.duplicate_finals for b in batches))
+        for batch in batches:
+            for phase, values in batch.phase_ms.items():
+                report.phase_ms.setdefault(phase, []).extend(values)
+            for kind, count in batch.error_kinds.items():
+                report.error_kinds[kind] = \
+                    report.error_kinds.get(kind, 0) + count
+        report.final_tokens = final_tokens
+        totals = _fleet_kv_stats([server_b])
+        _attach_kv_rates(report, totals)
+        report.fleet_latency_ms = fleet_latency([server_b])
+        report.server_stats = dict(
+            router.counters, **totals,
+            warmup_spills=spilled,
+            restart_recovery_ms=recovery_ms,
+            restart_hit_rates=hit_rates)
+        return report
+    finally:
+        if generator is not None:
+            generator.close()
+        for process in reversed(processes):
+            try:
+                process.terminate()
+            except Exception:  # noqa: BLE001 - replica_a is already
+                pass           # dead by design
+        engine.terminate()
+        thread.join(timeout=5)
+
+
+def run_restart_ab(n_requests: int = 18, rate_hz: float = 25.0,
+                   seed: int = 0,
+                   drain_timeout_s: float = 180.0,
+                   **kwargs) -> Tuple[LoadReport, LoadReport]:
+    """Warm-restart A/B gate: the SAME seeded longtail sequence
+    through :func:`run_restart` twice — cold (respawn spills to an
+    empty sibling directory) then warm (respawn adopts the dead
+    replica's) — each arm rooted in its own fresh temp dir so the
+    warmup phases are identical.  Asserts
+    the greedy outputs are BIT-EXACT request for request (a restored
+    block may never change a token), then returns ``(cold, warm)``;
+    the caller (bench.py's ``kv_tier`` section, tests/test_kv_spill)
+    checks warm strictly beats cold on measured-phase hit rate and
+    mean TTFT."""
+    import tempfile
+
+    reports = []
+    for adopt in (False, True):
+        with tempfile.TemporaryDirectory(prefix="kvspill-ab-") as root:
+            reports.append(run_restart(
+                n_requests=n_requests, rate_hz=rate_hz, seed=seed,
+                drain_timeout_s=drain_timeout_s,
+                spill_dir=os.path.join(root, "spill"),
+                adopt=adopt, **kwargs))
+    cold, warm = reports
+    both = set(cold.final_tokens) & set(warm.final_tokens)
+    mismatched = [request_id for request_id in sorted(both)
+                  if cold.final_tokens[request_id]
+                  != warm.final_tokens[request_id]]
+    if mismatched:
+        raise AssertionError(
+            f"restart A/B not bit-exact (seed={seed}): "
+            f"{len(mismatched)}/{len(both)} requests diverged, first "
+            f"{mismatched[0]}")
+    if not both:
+        raise AssertionError(
+            "restart A/B compared zero requests — the gate proved "
+            "nothing")
+    return cold, warm
+
+
 def chaos_schedule(seed: int):
     """The canonical seeded fault schedule for ``loadgen --chaos``:
     one replica death mid-decode, streaming-increment message drops,
@@ -1001,6 +1238,8 @@ def run_chaos(seed: int = 0, n_requests: int = 40,
               total_blocks: Optional[int] = None,
               host_tier_blocks: int = 0,
               restore_blocks_per_step: int = 2,
+              spill_dir: Optional[str] = None,
+              spill_blocks: int = 1024,
               spec_k: int = 0) -> LoadReport:
     """Run an in-process 2-replica serving rig (loopback broker, real
     event engine, Registrar + router) under :func:`chaos_schedule` and
@@ -1015,7 +1254,14 @@ def run_chaos(seed: int = 0, n_requests: int = 40,
     router routes prefix-aware with KV transfer enabled — the chaos
     gate covers the kvstore path too: killing a directory-advertised
     prefix owner mid-stream must still lose ZERO requests (directory
-    eviction + fetch-timeout fallback to local prefill)."""
+    eviction + fetch-timeout fallback to local prefill).
+
+    ``spill_dir`` gives each replica its OWN subdirectory of it as an
+    SSD spill tier (spill dirs are single-owner by design — the
+    signature/lease story is per-replica), so a chaos kill lands
+    mid-spill: the crash gate in tests/test_chaos.py asserts zero
+    lost requests AND that a fresh server adopting the dead replica's
+    directory serves bit-exact tokens — torn writes never surface."""
     from ..orchestration.continuous import ContinuousReplica
     from ..orchestration.paged import PagedContinuousServer
     from ..orchestration.serving import ReplicaRouter
@@ -1059,6 +1305,9 @@ def run_chaos(seed: int = 0, n_requests: int = 40,
                 watchdog_s=5.0, total_blocks=total_blocks,
                 host_tier_blocks=host_tier_blocks,
                 restore_blocks_per_step=restore_blocks_per_step,
+                spill_dir=(os.path.join(spill_dir, name)
+                           if spill_dir else None),
+                spill_blocks=spill_blocks,
                 draft_config_name="tiny" if spec_k else None,
                 spec_k=spec_k or 4)
             if spec_k:
@@ -1572,6 +1821,17 @@ def main(argv: Optional[List[str]] = None) -> int:
                              "blocks (0 = tier off, the A/B baseline)")
     parser.add_argument("--tier-off", action="store_true",
                         help="longtail: shorthand for --host-blocks 0")
+    parser.add_argument("--disk-blocks", type=int, default=0,
+                        help="longtail: SSD spill tier capacity in "
+                             "blocks under a fresh temp directory "
+                             "(0 = no spill tier); host overflow "
+                             "demotes to disk instead of purging")
+    parser.add_argument("--restart-replica", action="store_true",
+                        help="warm-restart chaos A/B: kill the only "
+                             "replica mid-run and respawn it cold vs "
+                             "spill-adopting; exit 1 unless greedy "
+                             "outputs are bit-exact and the warm arm "
+                             "beats cold on hit rate and mean TTFT")
     parser.add_argument("--trace-out", metavar="DIR",
                         help="enable distributed tracing and dump the "
                              "slowest requests' span trees as Chrome "
@@ -1652,18 +1912,61 @@ def main(argv: Optional[List[str]] = None) -> int:
               f"{report.avg_replicas:.2f} replicas = "
               f"{report.goodput_per_replica:.2f} req/s/replica")
         return 1 if (report.lost or report.timeouts) else 0
+    if args.restart_replica:
+        cold, warm = run_restart_ab(n_requests=args.requests
+                                    if args.requests != 40 else 18,
+                                    seed=args.seed)
+        for label, report in (("cold", cold), ("warm", warm)):
+            stats = report.server_stats or {}
+            mean_ttft = (statistics.fmean(report.ttfts_ms)
+                         if report.ttfts_ms else 0.0)
+            print(f"{label}: hit_rate={report.prefix_hit_rate}, "
+                  f"mean TTFT={mean_ttft:.1f}ms, "
+                  f"recovery={stats.get('restart_recovery_ms')}ms, "
+                  f"batch hit rates="
+                  f"{stats.get('restart_hit_rates')}, "
+                  f"adopted={stats.get('kv_adopted_chains')}, "
+                  f"disk restores={stats.get('kv_disk_restores')}")
+        cold_ttft = statistics.fmean(cold.ttfts_ms or [0.0])
+        warm_ttft = statistics.fmean(warm.ttfts_ms or [0.0])
+        ok = (not cold.lost and not warm.lost
+              and not cold.timeouts and not warm.timeouts
+              and (warm.prefix_hit_rate or 0.0)
+              > (cold.prefix_hit_rate or 0.0)
+              and warm_ttft < cold_ttft)
+        if not ok:
+            print(f"RESTART A/B FAIL (seed={args.seed}): warm must "
+                  f"beat cold on hit rate and mean TTFT with zero "
+                  f"lost")
+            return 1
+        print(f"RESTART A/B OK (seed={args.seed}): bit-exact, warm "
+              f"restart adopted the spill tier and recovered first")
+        return 0
     if args.workload == "longtail":
+        import contextlib
+        import tempfile
+
         host_blocks = 0 if args.tier_off else args.host_blocks
-        report = run_longtail(
-            n_requests=args.requests, rate_hz=args.rate_hz,
-            n_prefixes=args.prefixes, prefix_len=args.prefix_len,
-            total_blocks=args.hbm_blocks,
-            host_tier_blocks=host_blocks, seed=args.seed)
+        with contextlib.ExitStack() as stack:
+            spill_dir = None
+            if args.disk_blocks:
+                spill_dir = os.path.join(stack.enter_context(
+                    tempfile.TemporaryDirectory(prefix="kvspill-")),
+                    "spill")
+            report = run_longtail(
+                n_requests=args.requests, rate_hz=args.rate_hz,
+                n_prefixes=args.prefixes, prefix_len=args.prefix_len,
+                total_blocks=args.hbm_blocks,
+                host_tier_blocks=host_blocks, seed=args.seed,
+                spill_dir=spill_dir,
+                spill_blocks=args.disk_blocks or 1024)
         print(report)
         print(report.phase_table())
         print(f"fleet counters: {report.server_stats}")
         tier = f"host tier {host_blocks} blocks" if host_blocks \
             else "host tier OFF"
+        if args.disk_blocks:
+            tier += f" + disk tier {args.disk_blocks} blocks"
         mean_ttft = (statistics.fmean(report.ttfts_ms)
                      if report.ttfts_ms else 0.0)
         print(f"longtail ({args.prefixes} prefixes x "

@@ -1614,22 +1614,28 @@ def bench_kv_transfer(prefix_lens=(512, 2048, 8192),
 
 
 def bench_kv_tier(chain_tokens=2048, longtail_requests=36,
-                  longtail_warmup=12):
+                  longtail_warmup=12, restart_requests=12):
     """Tiered KV cache numbers: (1) HBM→host demotion and host→HBM
     restore bandwidth per pool dtype (pure data movement over
     :func:`~aiko_services_tpu.kvstore.seed_chain`-registered chains,
     no model compiles); (2) TTFT at the longtail working point for
-    the three ways an admission can resolve — HBM prefix hit, host
-    restore, full recompute — the crossover that decides when the
-    tier pays; (3) the longtail overflow A/B itself: tier-on vs
-    tier-off prefix hit rate and mean TTFT at the SAME HBM pool."""
+    the FOUR ways an admission can resolve — HBM prefix hit, host
+    restore, SSD disk restore, full recompute — the crossover ladder
+    that decides when each tier pays; (3) the longtail overflow A/B
+    itself: tier-on vs tier-off prefix hit rate and mean TTFT at the
+    SAME HBM pool; (4) the warm-restart A/B: kill-and-respawn cold
+    (empty spill dir) vs warm (adopting the dead replica's), time to
+    recovered hit rate and measured-phase TTFT."""
+    import tempfile
+
     import numpy as np
     from aiko_services_tpu.kvstore import seed_chain
     from aiko_services_tpu.orchestration.continuous import \
         DecodeRequest
     from aiko_services_tpu.orchestration.paged import \
         PagedContinuousServer
-    from aiko_services_tpu.tools.loadgen import run_longtail
+    from aiko_services_tpu.tools.loadgen import (run_longtail,
+                                                 run_restart_ab)
 
     results = {}
 
@@ -1683,29 +1689,50 @@ def bench_kv_tier(chain_tokens=2048, longtail_requests=36,
     prompt = rng.randint(1, 1024, size=392).astype(np.int32)
     other = rng.randint(1, 1024, size=392).astype(np.int32)
 
-    def run_one(tokens, request_id):
+    def run_one(on, tokens, request_id):
         t0 = time.perf_counter()
-        server.submit(DecodeRequest(request_id=request_id,
-                                    prompt=tokens, max_new_tokens=1))
-        finished = server.run_until_drained()
+        on.submit(DecodeRequest(request_id=request_id,
+                                prompt=tokens, max_new_tokens=1))
+        finished = on.run_until_drained()
         assert [r.request_id for r in finished] == [request_id]
         return (time.perf_counter() - t0) * 1e3
 
-    run_one(prompt, "compile_miss")         # compiles the miss shapes
-    run_one(prompt, "compile_hit")          # compiles the hit shapes
-    hit_ms = run_one(prompt, "hit")
+    run_one(server, prompt, "compile_miss")  # compiles the miss shapes
+    run_one(server, prompt, "compile_hit")   # compiles the hit shapes
+    hit_ms = run_one(server, prompt, "hit")
     while server._evict_one():              # compiles demote/restore
         pass
-    run_one(prompt, "compile_restore")
+    run_one(server, prompt, "compile_restore")
     while server._evict_one():
         pass
-    restore_ms = run_one(prompt, "restore")
-    recompute_ms = run_one(other, "recompute")   # miss shapes warm
+    restore_ms = run_one(server, prompt, "restore")
+    recompute_ms = run_one(server, other, "recompute")  # shapes warm
+    # Disk rung of the same ladder: host tier OFF so every eviction
+    # spills straight to SSD; the timed run restores the whole chain
+    # from CRC-checked files through the same batched scatter.
+    with tempfile.TemporaryDirectory(prefix="kvspill-bench-") as root:
+        disk = PagedContinuousServer(
+            config_name="tiny", slots=2, max_seq=416, chunk_steps=4,
+            seed=0, enable_prefix_cache=True, chunk_prefill_tokens=64,
+            total_blocks=96, host_tier_blocks=0,
+            restore_blocks_per_step=24,
+            spill_dir=os.path.join(root, "spill"))
+        run_one(disk, prompt, "disk_compile_miss")
+        run_one(disk, prompt, "disk_compile_hit")
+        while disk._evict_one():            # spill + compile restore
+            pass
+        run_one(disk, prompt, "disk_compile_restore")
+        while disk._evict_one():
+            pass
+        disk_ms = run_one(disk, prompt, "disk_restore")
+        assert disk.kv_disk_restores and not disk.kv_checksum_failures
     results["kv_tier_ttft_hbm_hit_ms"] = round(hit_ms, 2)
     results["kv_tier_ttft_host_restore_ms"] = round(restore_ms, 2)
+    results["kv_tier_ttft_disk_restore_ms"] = round(disk_ms, 2)
     results["kv_tier_ttft_recompute_ms"] = round(recompute_ms, 2)
     log(f"kv_tier[ttft]: hbm hit {hit_ms:.1f} / host restore "
-        f"{restore_ms:.1f} / recompute {recompute_ms:.1f} ms")
+        f"{restore_ms:.1f} / disk restore {disk_ms:.1f} / recompute "
+        f"{recompute_ms:.1f} ms")
 
     # (3) Longtail overflow A/B: 52-block HBM pool vs a ~144-block
     # working set; only host_tier_blocks differs between the arms.
@@ -1730,6 +1757,29 @@ def bench_kv_tier(chain_tokens=2048, longtail_requests=36,
         log(f"kv_tier[{label}]: prefix hit "
             f"{(report.prefix_hit_rate or 0.0):.0%}, ttft mean "
             f"{mean_ttft:.1f} / p95 {report.ttft_p95_ms:.1f} ms")
+
+    # (4) Warm-restart A/B: the replica is killed mid-run and
+    # respawned — cold (empty spill dir) vs warm (adopting the dead
+    # replica's).  Both arms run the identical seeded longtail; the
+    # headline number is time from respawn to recovered hit rate.
+    cold, warm = run_restart_ab(n_requests=restart_requests, seed=0)
+    for label, report in (("cold", cold), ("warm", warm)):
+        stats = report.server_stats or {}
+        mean_ttft = (statistics.fmean(report.ttfts_ms)
+                     if report.ttfts_ms else 0.0)
+        recovery = stats.get("restart_recovery_ms")
+        results[f"kv_restart_{label}_hit_rate"] = round(
+            report.prefix_hit_rate or 0.0, 3)
+        results[f"kv_restart_{label}_ttft_mean_ms"] = round(
+            mean_ttft, 1)
+        results[f"kv_restart_{label}_recovery_ms"] = recovery
+        log(f"kv_tier[restart_{label}]: hit "
+            f"{(report.prefix_hit_rate or 0.0):.0%}, ttft mean "
+            f"{mean_ttft:.1f} ms, recovery {recovery} ms")
+    results["kv_restart_adopted_chains"] = \
+        (warm.server_stats or {}).get("kv_adopted_chains", 0)
+    results["kv_restart_disk_restores"] = \
+        (warm.server_stats or {}).get("kv_disk_restores", 0)
     return results
 
 
@@ -2401,12 +2451,13 @@ SECTIONS = [
                                 routed_rate_hz=10.0))
      if SMOKE else bench_kv_transfer),
     # Tiered KV cache: demote/restore bandwidth (host-side data
-    # movement, no compiles), per-path TTFT crossover, and the
-    # longtail overflow A/B through the live rig (tiny model,
-    # CPU-capable like kv_transfer).
-    ("kv_tier", 600,
+    # movement, no compiles), four-way TTFT crossover (HBM / host /
+    # disk / recompute), the longtail overflow A/B, and the
+    # warm-restart A/B through the live rig (tiny model, CPU-capable
+    # like kv_transfer).
+    ("kv_tier", 900,
      (lambda: bench_kv_tier(chain_tokens=256, longtail_requests=10,
-                            longtail_warmup=6))
+                            longtail_warmup=6, restart_requests=8))
      if SMOKE else bench_kv_tier),
     # Tensor-parallel replica serving: TP degree sweep on the paged
     # server (virtual CPU mesh off-TPU, real mesh on TPU) + the

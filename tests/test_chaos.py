@@ -107,6 +107,59 @@ def test_chaos_kill_mid_restore_tier_matches_flat():
             == flat.final_tokens[request_id], request_id
 
 
+def test_chaos_kill_mid_spill_durable_and_exact(tmp_path):
+    """The SSD spill tier composed into the fault plan: host tier OFF
+    and a tiny HBM pool, so every demotion is a disk write group —
+    the seeded kill lands among fsync/rename groups mid-run.  Gates:
+    zero lost requests; BIT-EXACT outputs against the same seeded run
+    without a spill tier (corrupt KV never surfaces as tokens); and
+    the dead replica's directory stays ADOPTABLE — a fresh server
+    re-adopts it, sweeping any torn group exactly once (a second
+    adoption finds zero new corruption, the crash-consistency
+    property of the write-temp/fsync/rename protocol)."""
+    from aiko_services_tpu.orchestration.paged import \
+        PagedContinuousServer
+    from aiko_services_tpu.tools.loadgen import run_chaos
+
+    spilled = run_chaos(seed=1, n_requests=24, rate_hz=200.0,
+                        total_blocks=8, host_tier_blocks=0,
+                        restore_blocks_per_step=1,
+                        spill_dir=str(tmp_path))
+    assert spilled.lost == 0, spilled
+    assert spilled.timeouts == 0, spilled
+    stats = spilled.server_stats
+    assert stats["replica_deaths_observed"] == 1
+    assert stats["kv_spills"] > 0           # the tier really churned
+    assert stats["restore_queue_depth"] == 0
+
+    flat = run_chaos(seed=1, n_requests=24, rate_hz=200.0,
+                     total_blocks=8)
+    assert flat.lost == 0 and flat.timeouts == 0
+    both = set(spilled.final_tokens) & set(flat.final_tokens)
+    assert both
+    for request_id in both:
+        assert spilled.final_tokens[request_id] \
+            == flat.final_tokens[request_id], request_id
+
+    # Post-mortem adoption of the KILLED replica's directory (the
+    # schedule kills replica_a): the first adoption may sweep a torn
+    # group from the crash; the second must find nothing left to
+    # sweep and adopt the same chains.
+    def adopt():
+        server = PagedContinuousServer(
+            config_name="tiny", slots=2, chunk_steps=4, seed=0,
+            enable_prefix_cache=True, host_tier_blocks=0,
+            spill_dir=str(tmp_path / "replica_a"))
+        stats = server.stats()
+        return stats["kv_adopted_chains"], stats["kv_disk_blocks"], \
+            stats["kv_checksum_failures"]
+
+    chains_1, blocks_1, _ = adopt()
+    chains_2, blocks_2, corrupt_2 = adopt()
+    assert corrupt_2 == 0                   # swept exactly once
+    assert (chains_2, blocks_2) == (chains_1, blocks_1)
+
+
 def test_lease_expiry_on_demoted_chain_is_graceful(engine):
     """A replica death mid-transfer can leave an import lease racing
     pool pressure: the pins are shed (slot teardown decrements refs

@@ -103,6 +103,7 @@ _INJECTION_MODULES = (
     PKG / "orchestration" / "continuous.py",
     PKG / "runtime" / "process.py",
     PKG / "runtime" / "lease.py",
+    PKG / "kvstore" / "spill.py",
 )
 _JIT_MODULES = (
     PKG / "models" / "llama.py",

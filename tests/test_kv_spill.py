@@ -1,0 +1,500 @@
+"""Crash-durable SSD spill tier: corruption-safe restore, warm restart.
+
+The gates of ARCHITECTURE invariant 13:
+
+* **Durability** — host-RAM overflow spills CRC-sealed block files
+  instead of purging; a chain restored from disk produces greedy
+  decode BITWISE equal to the never-evicted chain (bf16 and int8
+  pools, single-chip and TP meshes, and spliced into cross-replica
+  exports).
+* **Warm restart** — a fresh server pointed at a dead replica's spill
+  directory re-adopts every intact rooted chain with its identity
+  (depth / parent / hits / eviction clock) and advertises tier 2; a
+  restart is a warm start.
+* **Corruption safety** — a failed checksum NEVER surfaces KV bytes:
+  torn writes are caught at scan, bit-flips at read, both degrade to
+  recompute with the damage visible in ``kv_checksum_failures``.
+  Foreign-version files are skipped, never deleted.
+* **Degradation** — a full or dying disk disables the tier (writes
+  stop, reads continue); serving never stalls and never errs.
+"""
+
+import ast
+import os
+import pathlib
+
+import numpy as np
+import pytest
+
+from aiko_services_tpu.kvstore import (chain_keys_hex, digest_decode,
+                                       digest_encode)
+from aiko_services_tpu.kvstore.directory import PrefixDirectory
+from aiko_services_tpu.kvstore.spill import SUFFIX
+from aiko_services_tpu.orchestration.paged import PagedContinuousServer
+from aiko_services_tpu.parallel.mesh import ReplicaMesh
+from aiko_services_tpu.pipeline.codec import decode_swag, encode_swag
+from aiko_services_tpu.runtime import faults
+from aiko_services_tpu.utils.sexpr import generate
+
+from .test_kvstore import _router_rig, _warm, make_server
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+PKG = REPO / "aiko_services_tpu"
+
+BOTH_DTYPES = pytest.mark.parametrize("quantize_kv", [False, True],
+                                      ids=["bf16", "int8"])
+
+PROMPT = np.arange(1, 50, dtype=np.int32)           # 3 shareable blocks
+
+
+def spill_server(tmp_path, **kwargs):
+    """A paged server whose evictions land straight on disk: host
+    tier OFF, spill tier on ``tmp_path/spill``."""
+    defaults = dict(host_tier_blocks=0,
+                    spill_dir=str(tmp_path / "spill"))
+    defaults.update(kwargs)
+    return make_server(**defaults)
+
+
+def _spill_all(server):
+    """Evict every zero-ref cached block; with the host tier off each
+    demotion overflows straight to the spill store."""
+    before = server.kv_spills
+    while server._evict_one():
+        pass
+    return server.kv_spills - before
+
+
+def _files(tmp_path):
+    root = tmp_path / "spill"
+    return sorted(p for p in root.iterdir()
+                  if p.name.endswith(SUFFIX)) if root.exists() else []
+
+
+# ---------------------------------------------------------------- #
+# Bit-exactness: disk-restored chain == never-evicted chain
+# ---------------------------------------------------------------- #
+
+@BOTH_DTYPES
+def test_spilled_chain_greedy_bit_exact(tmp_path, quantize_kv):
+    server = spill_server(tmp_path, quantize_kv=quantize_kv)
+    want = _warm(server, PROMPT)
+
+    assert _spill_all(server) == 3
+    stats = server.stats()
+    assert stats["kv_disk_blocks"] == 3
+    assert stats["kv_disk_bytes"] > 0
+    assert stats["prefix_evictions"] == 0           # spilled, not lost
+    assert len(_files(tmp_path)) == 3
+
+    got = _warm(server, PROMPT)
+    stats = server.stats()
+    assert got == want
+    assert stats["kv_disk_restores"] == 3
+    assert stats["kv_checksum_failures"] == 0
+    assert stats["kv_disk_blocks"] == 0             # promoted back
+    assert not _files(tmp_path)                     # single-residency
+
+    cold = make_server(quantize_kv=quantize_kv)
+    assert got == _warm(cold, PROMPT)
+
+
+@BOTH_DTYPES
+def test_warm_restart_adopts_and_serves_bit_exact(tmp_path,
+                                                  quantize_kv):
+    first = spill_server(tmp_path, quantize_kv=quantize_kv)
+    want = _warm(first, PROMPT)
+    assert _spill_all(first) == 3
+    del first                                       # the "crash"
+
+    second = spill_server(tmp_path, quantize_kv=quantize_kv)
+    stats = second.stats()
+    assert stats["kv_adopted_chains"] == 1
+    assert stats["kv_disk_blocks"] == 3
+    assert stats["kv_checksum_failures"] == 0
+
+    entries = digest_decode(second.prefix_digest())[2]
+    assert {entry[4] for entry in entries} == {2}   # tier 2 = disk
+    assert {entry[5] for entry in entries} == {1}   # adopted flag
+
+    assert _warm(second, PROMPT) == want
+    assert second.stats()["kv_disk_restores"] == 3
+
+
+def test_adoption_preserves_chain_identity_and_clock(tmp_path):
+    first = spill_server(tmp_path)
+    _warm(first, PROMPT)
+    depths = dict(first._depth)
+    parents = dict(first._parent)
+    _spill_all(first)
+    clock = first._evict_clock
+    assert clock >= 3                               # stamped per demote
+
+    second = spill_server(tmp_path)
+    for key, depth in depths.items():
+        assert second._depth[key] == depth
+        if key in parents:
+            assert second._parent.get(key) == parents[key]
+    # The shared eviction clock survives the restart: adopted blocks
+    # keep their overflow ordering relative to future demotions.
+    assert second._evict_clock >= clock
+
+
+def test_adoption_is_rerunnable_after_interrupted_start(tmp_path):
+    """Kill-mid-adopt: adoption only reads and registers — a server
+    that adopts and dies before serving leaves the directory intact,
+    and the NEXT start adopts the same chains."""
+    first = spill_server(tmp_path)
+    want = _warm(first, PROMPT)
+    assert _spill_all(first) == 3
+    del first
+
+    interrupted = spill_server(tmp_path)            # adopts, then dies
+    assert interrupted.stats()["kv_adopted_chains"] == 1
+    del interrupted
+
+    assert len(_files(tmp_path)) == 3               # nothing consumed
+    third = spill_server(tmp_path)
+    assert third.stats()["kv_adopted_chains"] == 1
+    assert _warm(third, PROMPT) == want
+
+
+@pytest.mark.multichip
+@BOTH_DTYPES
+def test_tp4_spill_adopt_bit_exact(virtual_mesh_devices, tmp_path,
+                                   quantize_kv):
+    """Spill + warm-restart through the TP gather/re-pin paths: the
+    full-width host rows round-trip through disk files and a fresh
+    TP server's adoption — greedy equals the TP never-evicted run and
+    the single-chip run."""
+    prompt = np.arange(1, 66, dtype=np.int32)       # 4 shareable blocks
+
+    def run(tp, root):
+        kw = dict(config_name="tiny_tp", slots=2, max_seq=128,
+                  chunk_steps=3, seed=5, block_size=16,
+                  enable_prefix_cache=True, chunk_prefill_tokens=32,
+                  quantize_kv=quantize_kv, host_tier_blocks=0,
+                  restore_blocks_per_step=2, spill_dir=str(root))
+        if tp:
+            kw["replica_mesh"] = ReplicaMesh(tp=tp)
+        first = PagedContinuousServer(**kw)
+        resident = _warm(first, prompt)
+        assert _spill_all(first) == 4
+        del first
+        second = PagedContinuousServer(**kw)
+        assert second.stats()["kv_adopted_chains"] == 1
+        restored = _warm(second, prompt)
+        assert second.stats()["kv_disk_restores"] == 4
+        assert second.stats()["kv_checksum_failures"] == 0
+        return resident, restored
+
+    tp_resident, tp_restored = run(4, tmp_path / "tp")
+    chip_resident, chip_restored = run(None, tmp_path / "chip")
+    assert tp_restored == tp_resident
+    assert tp_restored == chip_restored == chip_resident
+
+
+# ---------------------------------------------------------------- #
+# Corruption safety: checksum trips degrade, never serve
+# ---------------------------------------------------------------- #
+
+def test_bit_flip_degrades_to_recompute_and_counts(tmp_path):
+    server = spill_server(tmp_path)
+    want = _warm(server, PROMPT)
+    assert _spill_all(server) == 3
+
+    victim = _files(tmp_path)[0]
+    blob = victim.read_bytes()
+    victim.write_bytes(blob[:-1] + bytes([blob[-1] ^ 0xFF]))
+
+    got = _warm(server, PROMPT)                     # hits, then trips
+    stats = server.stats()
+    assert got == want                              # NEVER wrong tokens
+    assert stats["kv_checksum_failures"] >= 1
+    assert not victim.exists()                      # deleted, not retried
+
+
+def test_torn_write_skipped_at_adoption(tmp_path):
+    first = spill_server(tmp_path)
+    want = _warm(first, PROMPT)
+    assert _spill_all(first) == 3
+    del first
+
+    victim = _files(tmp_path)[-1]
+    victim.write_bytes(victim.read_bytes()[:40])    # torn mid-payload
+
+    second = spill_server(tmp_path)
+    stats = second.stats()
+    assert stats["kv_checksum_failures"] == 1
+    # Depending on the torn block's depth the rooted prefix above it
+    # (0-2 blocks) survives; everything below is discarded with it.
+    assert stats["kv_disk_blocks"] in (0, 1, 2)
+    assert not victim.exists()                      # swept, not re-tripped
+    assert _warm(second, PROMPT) == want            # degraded, exact
+
+
+def test_foreign_version_skipped_never_deleted(tmp_path):
+    first = spill_server(tmp_path)
+    _warm(first, PROMPT)
+    assert _spill_all(first) == 3
+    del first
+
+    alien = _files(tmp_path)[0]
+    blob = bytearray(alien.read_bytes())
+    blob[7] ^= 0x7F                                 # bump version byte
+    alien.write_bytes(bytes(blob))
+
+    second = spill_server(tmp_path)
+    stats = second.stats()
+    assert stats["kv_checksum_failures"] == 0       # not corruption
+    assert alien.exists()                           # left for its owner
+
+
+def test_foreign_pool_signature_not_adopted(tmp_path):
+    first = spill_server(tmp_path, quantize_kv=False)
+    _warm(first, PROMPT)
+    assert _spill_all(first) == 3
+    del first
+
+    other = spill_server(tmp_path, quantize_kv=True)  # different layout
+    stats = other.stats()
+    assert stats["kv_adopted_chains"] == 0
+    assert stats["kv_checksum_failures"] == 0
+    assert len(_files(tmp_path)) == 3               # untouched
+
+
+def test_rootless_chain_discarded_at_adoption(tmp_path):
+    """A chain whose depth-1 file is missing cannot be admitted (the
+    walk starts at the root) — adoption discards the orphan files
+    instead of advertising blocks it can never serve."""
+    first = spill_server(tmp_path)
+    _warm(first, PROMPT)
+    assert _spill_all(first) == 3
+    metas, _ = first.spill.scan()                   # header inventory
+    del first
+
+    by_depth = {}
+    for name in os.listdir(tmp_path / "spill"):
+        hex_key = name[:-len(SUFFIX)]
+        meta = next(m for m in metas if m["key"] == hex_key)
+        by_depth[meta["depth"]] = name
+    os.unlink(tmp_path / "spill" / by_depth[1])     # drop the root
+
+    second = spill_server(tmp_path)
+    stats = second.stats()
+    assert stats["kv_adopted_chains"] == 0
+    assert stats["kv_disk_blocks"] == 0
+    assert not _files(tmp_path)                     # orphans discarded
+
+
+# ---------------------------------------------------------------- #
+# Fault points: deterministic disk failure injection
+# ---------------------------------------------------------------- #
+
+def test_corrupt_disk_block_fault_never_wrong_tokens(tmp_path):
+    server = spill_server(tmp_path)
+    want = _warm(server, PROMPT)
+    faults.install(faults.FaultPlan(seed=0)
+                   .add("corrupt_disk_block", nth=1))
+    try:
+        assert _spill_all(server) == 3
+        assert faults.PLAN.fires("corrupt_disk_block") == 1
+        got = _warm(server, PROMPT)
+    finally:
+        faults.uninstall()
+    assert got == want
+    assert server.stats()["kv_checksum_failures"] == 1
+
+
+def test_disk_full_disables_tier_serving_continues(tmp_path):
+    server = spill_server(tmp_path)
+    want = _warm(server, PROMPT)
+    faults.install(faults.FaultPlan(seed=0).add("disk_full", nth=1))
+    try:
+        _spill_all(server)
+    finally:
+        faults.uninstall()
+    assert not server.spill.enabled
+    assert "disk_full" in server.spill.disabled_reason \
+        or "28" in server.spill.disabled_reason
+    assert server.stats()["kv_disk_blocks"] == 0
+
+    got = _warm(server, PROMPT)                     # plain recompute
+    assert got == want
+    # Further eviction pressure must not re-enable or stall anything.
+    _spill_all(server)
+    assert server.stats()["kv_disk_blocks"] == 0
+
+
+def test_slow_disk_fault_stalls_write_not_serving(tmp_path):
+    server = spill_server(tmp_path)
+    want = _warm(server, PROMPT)
+    faults.install(faults.FaultPlan(seed=0)
+                   .add("slow_disk", nth=1, ms=30))
+    try:
+        assert _spill_all(server) == 3
+        assert faults.PLAN.fires("slow_disk") == 1
+    finally:
+        faults.uninstall()
+    assert _warm(server, PROMPT) == want
+    assert server.stats()["kv_checksum_failures"] == 0
+
+
+# ---------------------------------------------------------------- #
+# Export splicing and prefetch promotion
+# ---------------------------------------------------------------- #
+
+@BOTH_DTYPES
+def test_export_splices_spill_source(tmp_path, quantize_kv):
+    owner = spill_server(tmp_path, quantize_kv=quantize_kv)
+    want = _warm(owner, PROMPT)
+    assert _spill_all(owner) == 3
+
+    payload = owner.kv_export_payload(owner.prefix_keys_hex(PROMPT), 0)
+    assert payload is not None and len(payload["kv_keys"]) == 3
+    stats = owner.stats()
+    assert stats["kv_disk_blocks"] == 3             # NOT consumed
+    assert stats["kv_disk_restores"] == 0
+
+    importer = make_server(quantize_kv=quantize_kv)
+    assert importer.kv_import_payload(
+        decode_swag(encode_swag(payload))) == 3
+    got = _warm(importer, PROMPT)
+    cold = make_server(quantize_kv=quantize_kv)
+    assert got == want == _warm(cold, PROMPT)
+
+
+def test_prefetch_promote_starts_restore_before_admission(tmp_path):
+    server = spill_server(tmp_path)
+    want = _warm(server, PROMPT)
+    assert _spill_all(server) == 3
+
+    assert server.prefetch_promote(PROMPT)          # starts the restore
+    assert server.stats()["kv_prefetch_promotions"] == 1
+    assert not server.prefetch_promote(PROMPT)      # already in flight
+    while server._restoring:
+        server._advance_restores()
+    assert not server.prefetch_promote(PROMPT)      # fully resident
+    assert server.stats()["kv_prefetch_promotions"] == 1
+    assert _warm(server, PROMPT) == want
+    assert server.stats()["kv_disk_restores"] == 3
+
+
+# ---------------------------------------------------------------- #
+# Directory + router: disk tier priced below host, above recompute
+# ---------------------------------------------------------------- #
+
+def test_matched_tiers_counts_disk_blocks():
+    directory = PrefixDirectory(lease_s=30.0)
+    keys = [f"{i:016x}" for i in range(4)]
+    entries = [(key, depth + 1, 0, 1,
+                0 if depth == 0 else (1 if depth == 1 else 2),
+                1 if depth >= 2 else 0)
+               for depth, key in enumerate(keys)]
+    directory.update("ra", digest_encode(16, "decode", entries),
+                     now=0.0)
+    assert directory.matched_tiers("ra", keys, now=1.0) == (4, 1, 2)
+    assert directory.matched_detail("ra", keys, now=1.0) == (4, 1)
+    assert directory.matched_tiers("ra", keys[:2], now=1.0) == (2, 1, 0)
+
+
+def test_router_prices_disk_below_host_above_nothing(engine):
+    router, topics, pr = _router_rig(engine, "kvspill")
+    keys = chain_keys_hex(PROMPT, 16)
+
+    def advertise(topic, tier):
+        entries = [(key, depth + 1, 0, 1, tier, 1 if tier == 2 else 0)
+                   for depth, key in enumerate(keys)]
+        pr.message.publish(
+            f"{topic}/state",
+            generate("update", ["kv_prefixes",
+                                digest_encode(16, "decode", entries)]))
+
+    advertise(topics[0], tier=2)                    # disk copy
+    advertise(topics[1], tier=1)                    # host copy
+    engine.drain()
+
+    payload = encode_swag({"tokens": PROMPT})
+    assert router.route("m1", "test/resp", dict(payload))
+    assert router._inflight["m1"]["replica"] == topics[1]  # host wins
+    engine.drain()
+    assert router.counters["prefix_routed_host"] == 1
+    assert router.counters.get("prefix_routed_disk", 0) == 0
+    assert router.counters["kv_tier_hints"] == 1    # hinted either way
+
+    # Host owner gone: the disk owner still beats a recompute.
+    pr.message.publish(f"{topics[1]}/state",
+                       generate("update", ["lifecycle", "unhealthy"]))
+    engine.drain()
+    assert router.route("m2", "test/resp", dict(payload))
+    assert router._inflight["m2"]["replica"] == topics[0]
+    engine.drain()
+    assert router.counters["prefix_routed_disk"] == 1
+
+
+# ---------------------------------------------------------------- #
+# Invariant 7: the disk tier never touches traced programs
+# ---------------------------------------------------------------- #
+
+def test_no_spill_references_in_traced_modules():
+    banned = ("spill", "disk", "adopt", "checksum")
+    for directory in ("models", "ops"):
+        for path in sorted((PKG / directory).glob("*.py")):
+            tree = ast.parse(path.read_text())
+            for node in ast.walk(tree):
+                name = getattr(node, "id", None) \
+                    or getattr(node, "attr", None)
+                if isinstance(name, str):
+                    assert not any(word in name.lower()
+                                   for word in banned), \
+                        f"{path.name}:{node.lineno}: {name}"
+
+
+def test_spill_does_not_change_serve_chunk_jaxpr(tmp_path):
+    import jax
+
+    from aiko_services_tpu.models import llama
+
+    server = spill_server(tmp_path)
+    _warm(server, PROMPT)
+
+    def trace():
+        return str(jax.make_jaxpr(
+            lambda state, pool: llama.serve_chunk_paged(
+                server.params, state, pool, 2, server.config,
+                eos_id=-1, sampled=False))(server._state, server.pool))
+
+    clean = trace()
+    _spill_all(server)
+    assert trace() == clean
+    _warm(server, PROMPT)                           # disk restores
+    assert server.stats()["kv_disk_restores"] == 3
+    assert trace() == clean
+
+
+# ---------------------------------------------------------------- #
+# Warm-restart A/B gate (slow): warm beats cold after a crash
+# ---------------------------------------------------------------- #
+
+def test_restart_warm_beats_cold_gate():
+    """The acceptance gate: kill the only replica mid-run, respawn it
+    cold (empty spill dir) vs warm (adopting the dead replica's).
+    Warm must win on measured-phase hit rate AND mean TTFT, bit-exact
+    request for request (asserted inside run_restart_ab)."""
+    import statistics
+
+    from aiko_services_tpu.tools.loadgen import run_restart_ab
+
+    cold, warm = run_restart_ab(seed=0)
+    for report in (cold, warm):
+        assert report.lost == 0 and report.timeouts == 0
+
+    assert (warm.prefix_hit_rate or 0.0) \
+        > (cold.prefix_hit_rate or 0.0)
+    assert statistics.fmean(warm.ttfts_ms) \
+        < statistics.fmean(cold.ttfts_ms)
+    stats = warm.server_stats
+    assert stats["kv_adopted_chains"] > 0
+    assert stats["kv_disk_restores"] > 0
+    assert stats["kv_checksum_failures"] == 0
+    assert cold.server_stats["kv_adopted_chains"] == 0

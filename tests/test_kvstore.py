@@ -77,12 +77,12 @@ def test_shareable_blocks_excludes_admission_seed_block():
 
 def test_digest_roundtrip_and_malformed():
     # 4-field entries stay valid wire (pre-tier replicas); decode
-    # always returns 5-tuples with tier 0 appended.
+    # always returns 6-tuples with tier/adopted 0 appended.
     entries = [("ab12cd34ef567890", 3, 1, 7),
                ("ffee001122334455", 2, 0, 1)]
     text = digest_encode(16, "decode", entries)
     assert digest_decode(text) == (
-        16, "decode", [entry + (0,) for entry in entries])
+        16, "decode", [entry + (0, 0) for entry in entries])
     # Host-tier entries carry a 5th field; tier 0 encodes 4-field
     # (the wire only grows where the tier is actually in play).
     tiered = [("ab12cd34ef567890", 3, 1, 7, 0),
@@ -91,14 +91,22 @@ def test_digest_roundtrip_and_malformed():
     assert "ab12cd34ef567890/3/1/7," in text     # tier 0 stays 4-field
     assert text.endswith("/2/0/1/1")             # tier 1 appends
     assert digest_decode(text) == (
-        16, "decode", [("ab12cd34ef567890", 3, 1, 7, 0),
-                       ("ffee001122334455", 2, 0, 1, 1)])
+        16, "decode", [("ab12cd34ef567890", 3, 1, 7, 0, 0),
+                       ("ffee001122334455", 2, 0, 1, 1, 0)])
+    # Spilled entries carry the adopted 6th field; a zero flag keeps
+    # the 5-field tier wire (same back-compat move tier made).
+    spilled = [("ab12cd34ef567890", 3, 1, 7, 2, 0),
+               ("ffee001122334455", 2, 0, 1, 2, 1)]
+    text = digest_encode(16, "decode", spilled)
+    assert "ab12cd34ef567890/3/1/7/2," in text   # adopted 0: 5-field
+    assert text.endswith("/2/0/1/2/1")           # adopted 1 appends
+    assert digest_decode(text) == (16, "decode", spilled)
     # S-expression safe: survives the EC-share broadcast wire.
     command, params = parse(generate("update", ["kv_prefixes", text]))
     assert (command, params[1]) == ("update", text)
     for bad in ("", "16;decode", "x;decode;a/1/2/3",
                 "16;decode;nodepth", None, "16;d;a/b/c/d",
-                "16;decode;ab/1/2/3/4/5"):
+                "16;decode;ab/1/2/3/4/5/6/7"):
         assert digest_decode(bad) is None
 
 
