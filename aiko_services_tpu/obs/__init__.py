@@ -19,12 +19,27 @@ Three pillars (ISSUE 6):
   at the router and dashboard; exported through EC shares and the
   ``(metrics …)`` Prometheus-text actor command.
 
+Two more pillars make the layer *active* (ISSUE 13):
+
+* :mod:`.flight` — per-process flight recorder: a bounded window of
+  recent spans, step-log rows, and counter values, dumped as a
+  self-contained capture bundle on a trigger (watchdog trip, SLO
+  breach streak, fault fire, p95 drift, process exit, operator
+  ``(capture)``), every section stamped with one shared trace id so
+  bundles from different processes join into a fleet-wide record.
+  Also home of :class:`~.flight.P95DriftDetector`, the router's
+  delta-histogram anomaly detector.
+* :mod:`.attrib` — step-time attribution: turns the step log + a
+  device-time sample into a per-step tax budget table whose rows sum
+  to measured wall time, naming the levers behind the
+  engine-vs-raw-decode gap.
+
 Import discipline: ``obs`` modules import nothing from the rest of the
 package (stdlib only; ``jax`` strictly lazily), so every layer —
 transport, runtime, orchestration, tools — may depend on them without
 cycles, and ``ops/`` + ``models/`` must not import them at all.
 """
 
-from . import metrics, steplog, trace  # noqa: F401
+from . import attrib, flight, metrics, steplog, trace  # noqa: F401
 
-__all__ = ["metrics", "steplog", "trace"]
+__all__ = ["attrib", "flight", "metrics", "steplog", "trace"]

@@ -48,12 +48,13 @@ from __future__ import annotations
 import dataclasses
 from typing import Callable, Dict, List, Optional, Tuple
 
+from ..obs import flight
 from ..obs.metrics import CounterDict
 from ..registry.services_cache import services_cache_create_singleton
 from ..runtime import faults
 from ..runtime.actor import Actor
 from ..runtime.service import ServiceFilter
-from ..utils.sexpr import parse
+from ..utils.sexpr import generate, parse
 
 __all__ = [
     "AUTOSCALER_PROTOCOL", "AutoscalerPolicy", "ReplicaView",
@@ -755,7 +756,9 @@ class FleetAutoscaler(Actor):
         self._check_draining(now)
         snapshot = self.snapshot()
         before = dict(self.state.targets)
+        streak_before = self.state.breach_streak
         actions, self.state = decide(snapshot, self.policy, self.state)
+        self._maybe_flight_capture(snapshot, streak_before)
         for role, target in self.state.targets.items():
             if before.get(role) != target:
                 self._bump("scale_out" if target > before.get(role, 0)
@@ -769,6 +772,34 @@ class FleetAutoscaler(Actor):
             self._execute(action, now)
         self._publish_fleet_state(snapshot, now)
         self._last_tick = now
+
+    def _maybe_flight_capture(self, snapshot: FleetSnapshot,
+                              streak_before: int) -> None:
+        """SLO-breach flight trigger: fires at the tick the breach
+        streak CROSSES ``policy.breach_windows`` — the same streak
+        ``decide()`` scales out on (which resets it to 0 when it
+        does) — capturing local forensics and asking the router to
+        fan one fleet-wide capture out around a shared trace id.
+        The scale-out fixes the symptom; the bundle records why."""
+        breach = ((snapshot.ttft_p95_ms is not None
+                   and snapshot.ttft_p95_ms > self.policy.ttft_slo_ms)
+                  or snapshot.shed_delta > self.policy.shed_tolerance)
+        streak = self.state.breach_streak
+        crossed = breach and (
+            streak == self.policy.breach_windows
+            or (streak == 0
+                and streak_before == self.policy.breach_windows - 1))
+        if not crossed:
+            return
+        reason = (f"slo breach streak={streak_before + 1} "
+                  f"ttft_p95={snapshot.ttft_p95_ms} "
+                  f"shed_delta={snapshot.shed_delta}")
+        if flight.FLIGHT is not None:
+            flight.FLIGHT.capture("slo_breach", reason=reason)
+        if self._router_topic is not None:
+            self.process.message.publish(
+                f"{self._router_topic}/in",
+                generate("capture", ["", "", "slo_breach", reason]))
 
     def _execute(self, action: Action, now: float) -> None:
         if action.kind == "spawn":

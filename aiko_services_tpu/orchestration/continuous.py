@@ -68,8 +68,8 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
-from ..obs import steplog, trace
-from ..obs.metrics import CounterDict, Histogram
+from ..obs import flight, steplog, trace
+from ..obs.metrics import CounterDict, Histogram, REGISTRY
 from ..runtime import faults
 from ..runtime.actor import Actor
 from ..utils.sexpr import generate, parse
@@ -417,9 +417,13 @@ class ContinuousBatchingServer:
         # Per-phase latency histograms — FIXED log-spaced buckets, so
         # the router/loadgen can merge them across replicas exactly
         # (they ride EC shares as ``hist.<phase>`` encoded strings).
+        # Registry-created, so the (metrics …) scrape renders them as
+        # proper ``_bucket``/``_sum``/``_count`` series too.
         self.latency_hists: Dict[str, Histogram] = {
-            phase: Histogram(name=f"aiko_latency_{phase}_ms",
-                             labels=self._metrics_labels)
+            phase: REGISTRY.histogram(
+                f"aiko_latency_{phase}_ms",
+                help=f"Per-request {phase} latency (ms).",
+                labels=self._metrics_labels)
             for phase in ("ttft", "total", "queue", "prefill",
                           "decode", "kv_restore")}
         self._serve_started: Optional[float] = None
@@ -1490,6 +1494,17 @@ class ContinuousBatchingServer:
         self._watchdog_tripped = True
         self.healthy = False
         self.counters["watchdog_trips"] += 1
+        if flight.FLIGHT is not None:
+            # Forensics around the stall: correlate the bundle with
+            # whichever request's trace context is in flight (if any),
+            # so the fleet-wide dump joins on one trace id.
+            carrier = next((r.trace_ctx for r in self._requests
+                            if r is not None and r.trace_ctx), "")
+            context = trace.extract(carrier)
+            flight.FLIGHT.capture(
+                "watchdog",
+                trace_id=context.trace_id if context else None,
+                reason=f"ring sync stalled past {self.watchdog_s:g}s")
 
     def _drain_ring(self) -> None:
         while self._ring:
@@ -1784,6 +1799,15 @@ class ContinuousReplica(Actor):
                     f"{phase}={value}" for phase, value
                     in sorted(breakdown.items()))
                 for total_ms, request_id, breakdown in self._slow)
+        if flight.FLIGHT is not None and flight.FLIGHT.captures:
+            # Recent flight-recorder triggers, newest last — the
+            # dashboard's recent-triggers pane reads this.
+            updates["flight_captures"] = flight.FLIGHT.captures
+            recent = flight.FLIGHT.recent()
+            if recent:
+                updates["last_capture"] = " ".join(
+                    f"{entry['trigger']}@{entry['ts']:.0f}"
+                    for entry in recent[-3:])
         if self._retiring and not self.server.busy \
                 and not self._kv_pending:
             # Drain complete: every queued/active request reached a

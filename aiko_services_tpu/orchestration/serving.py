@@ -38,7 +38,7 @@ from typing import Callable, Dict, List, Optional
 
 import numpy as np
 
-from ..obs import trace
+from ..obs import flight, trace
 from ..obs.metrics import CounterDict, Histogram
 from ..pipeline.codec import decode_swag, decode_value, encode_swag
 from ..registry.services_cache import services_cache_create_singleton
@@ -186,7 +186,8 @@ class ReplicaRouter(Actor):
                  disk_prefix_weight: float = 0.25,
                  kv_transfer: bool = False,
                  disaggregate: bool = False,
-                 directory_lease_s: float = 30.0):
+                 directory_lease_s: float = 30.0,
+                 anomaly_interval_s: float = 2.0):
         context.protocol = context.protocol or ROUTER_PROTOCOL
         super().__init__(context, process)
         self._replicas: List[str] = []   # replica topic paths, stable order
@@ -263,7 +264,8 @@ class ReplicaRouter(Actor):
             redispatches=0, replica_deaths_observed=0, shed=0,
             deadline_exceeded=0, cancel_unrouted=0,
             prefix_routed=0, prefix_routed_host=0,
-            prefix_routed_disk=0, kv_tier_hints=0, kv_remote_hints=0),
+            prefix_routed_disk=0, kv_tier_hints=0, kv_remote_hints=0,
+            anomaly_flags=0, fleet_captures=0),
             prefix="router", labels={"actor": self.name})
         self.share["replicas"] = 0
         self.share["replicas_retiring"] = 0
@@ -278,6 +280,17 @@ class ReplicaRouter(Actor):
         self._cache.add_handler(
             ServiceFilter(protocol=replica_protocol),
             self._replica_added, self._replica_removed)
+        #: Per-window p95 drift over the EXACT fleet merges — delta
+        #: histograms (element-wise count subtraction) flag drift
+        #: BEFORE the autoscaler's SLO hard-trip.  0 disables the
+        #: timer entirely.
+        self.anomaly_interval_s = float(anomaly_interval_s)
+        self._drift = flight.P95DriftDetector()
+        self._anomaly_phases = ("ttft", "total")
+        self.share["last_anomaly"] = ""
+        if self.anomaly_interval_s > 0:
+            self.process.event.add_timer_handler(
+                self._anomaly_tick, self.anomaly_interval_s)
 
     # -- membership & health ---------------------------------------- #
 
@@ -441,6 +454,50 @@ class ReplicaRouter(Actor):
             self.share[key] = value
             if self.ec_producer is not None:
                 self.ec_producer.update_if_changed(key, value)
+
+    # -- anomaly detection & fleet capture ---------------------------- #
+
+    def _anomaly_tick(self):
+        """Per-window p95 drift check over the fleet merges.  A flag
+        bumps ``anomaly_flags``, lands in the share for the dashboard,
+        and fans a flight capture out fleet-wide — the early-warning
+        record EXISTS by the time the SLO hard-trips."""
+        for phase in self._anomaly_phases:
+            merged = self.fleet_histogram(phase)
+            if not merged.count:
+                continue
+            drift = self._drift.observe(phase, merged)
+            if drift is None:
+                continue
+            self._bump("anomaly_flags")
+            note = (f"{phase}: p95 {drift['p95_ms']:g}ms vs baseline "
+                    f"{drift['baseline_ms']:g}ms "
+                    f"({drift['ratio']:g}x, n={drift['window_count']})")
+            self.share["last_anomaly"] = note
+            if self.ec_producer is not None:
+                self.ec_producer.update_if_changed("last_anomaly", note)
+            self.logger.warning("%s: p95 drift — %s", self.name, note)
+            self.capture(trigger="anomaly", reason=note)
+
+    def capture(self, trace_id: str = "", response_topic: str = "",
+                trigger: str = "operator", reason: str = ""):
+        """Router override of the actor built-in: capture locally AND
+        fan the command out to every live replica with ONE shared
+        trace id, so one anomaly (or one operator ``(capture)``)
+        yields one fleet-wide bundle set that ``tools/doctor.py``
+        groups back together."""
+        trace_id = str(trace_id) or flight.new_trace_id()
+        super().capture(trace_id=trace_id,
+                        response_topic=response_topic,
+                        trigger=trigger, reason=reason)
+        for replica in list(self._replicas):
+            self.process.message.publish(
+                f"{replica}/in",
+                generate("capture", [trace_id, str(response_topic),
+                                     str(trigger),
+                                     str(reason)
+                                     or f"fleet capture via {self.name}"]))
+        self._bump("fleet_captures")
 
     # -- tracing ------------------------------------------------------ #
 

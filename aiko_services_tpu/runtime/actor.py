@@ -155,6 +155,29 @@ class Actor(Service):
         self.process.message.publish(
             topic, generate("metrics_response", [self.name, text]))
 
+    def capture(self, trace_id: str = "", response_topic: str = "",
+                trigger: str = "operator", reason: str = ""):
+        """Dump a flight-recorder capture bundle:
+        ``(capture [trace_id] [response_topic])`` →
+        ``(capture_response <name> <path|uninstalled|suppressed>)``.
+        Every actor answers, so an operator (or the router's fleet
+        fan-out) can ask any process to dump forensics around one
+        shared trace id.  No recorder installed → reply says so;
+        never an error."""
+        from ..obs import flight
+        if flight.FLIGHT is not None:
+            path = flight.FLIGHT.capture(
+                str(trigger) or "operator",
+                trace_id=str(trace_id) or None,
+                reason=str(reason) or f"(capture) on {self.name}")
+            result = path or "suppressed"
+        else:
+            result = "uninstalled"
+        if response_topic:
+            self.process.message.publish(
+                str(response_topic),
+                generate("capture_response", [self.name, result]))
+
     def terminate(self):
         self.stop()
 

@@ -150,6 +150,15 @@ class FaultPlan:
             if fire:
                 rule.fires += 1
                 self.fired.append((point, key, rule.describe()))
+                # A firing fault is exactly the moment forensics are
+                # cheap and valuable: snapshot the process around it.
+                # Lazy import keeps the fault layer import-light; the
+                # recorder rate-limits, so a prob= storm cannot turn
+                # this into an IO hazard.
+                from ..obs import flight
+                if flight.FLIGHT is not None:
+                    flight.FLIGHT.capture(
+                        "fault", reason=f"{rule.describe()} key={key}")
                 return dict(rule.params)
         return None
 

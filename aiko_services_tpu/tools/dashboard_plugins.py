@@ -339,6 +339,11 @@ def model_replica_plugin(fields, variables) -> List[str]:
     if any(value not in (None, "-", 0) for _, value in rejected):
         lines.append("  rejected:  " + ", ".join(
             f"{value or 0} {label}" for label, value in rejected))
+    captures = _get(variables, "flight_captures", default=None)
+    if captures not in (None, "-", 0):
+        lines.append(
+            f"  flight:    {captures} capture bundles, recent: "
+            f"{_get(variables, 'last_capture', default='-')}")
     return lines
 
 
@@ -386,6 +391,15 @@ def replica_router_plugin(fields, variables) -> List[str]:
     if fleet_lines:
         lines += ["", "  fleet latency (ms, merged across replicas):"]
         lines += fleet_lines
+    anomalies = _get(variables, "anomaly_flags", default=None)
+    if anomalies not in (None, "-", 0):
+        lines.append(
+            f"  anomaly:    {anomalies} p95-drift flags, "
+            f"{_get(variables, 'fleet_captures', default=0)}"
+            f" fleet captures")
+        last = _get(variables, "last_anomaly", default=None)
+        if last not in (None, "-", ""):
+            lines.append(f"    last: {last}")
     return lines
 
 

@@ -1,0 +1,261 @@
+"""Capture-bundle doctor: one readable report per flight recording.
+
+``obs/flight.py`` dumps self-contained JSON capture bundles (span
+window + step-log slice + counter snapshot + manifest, all stamped
+with one trace id).  This CLI is the consumer: it loads one or more
+bundles — files, directories, or a whole fleet's worth — and renders
+each as a single report:
+
+- the **manifest** header (trigger, reason, trace id, service, time);
+- the **span tree**, indented parent→child with durations, filtered
+  to the bundle's trace id when spans match it;
+- the **tax table** — the step-log slice run through
+  :func:`obs.attrib.attribute_steps`, so a watchdog bundle directly
+  shows where the stalled step's time went;
+- **counter diffs** against the recorder's install-time baseline
+  (what moved since the process started flying).
+
+Bundles sharing a trace id (the router's fleet fan-out) group into
+one fleet section, so "one slow request" reads as one record across
+every process that touched it.
+
+Usage::
+
+    python -m aiko_services_tpu.tools.doctor /tmp/flight/           # dir
+    python -m aiko_services_tpu.tools.doctor capture_watchdog_*.json
+
+Host-side, stdlib + ``obs`` only — running the doctor never imports
+a backend.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+from typing import Dict, Iterable, List, Optional
+
+from ..obs import attrib
+from ..obs.flight import FORMAT_VERSION
+
+__all__ = ["load_bundle", "collect_paths", "span_tree_lines",
+           "counter_diff_lines", "render_report", "render_fleet",
+           "main"]
+
+
+def load_bundle(path: str) -> Dict:
+    """Parse + validate one bundle file.  Raises ``ValueError`` on a
+    bundle the doctor cannot read (wrong shape / future format)."""
+    with open(path) as handle:
+        bundle = json.load(handle)
+    manifest = bundle.get("manifest")
+    if not isinstance(manifest, dict):
+        raise ValueError(f"{path}: not a capture bundle (no manifest)")
+    version = manifest.get("format")
+    if version != FORMAT_VERSION:
+        raise ValueError(f"{path}: bundle format {version!r}, "
+                         f"this doctor reads {FORMAT_VERSION}")
+    bundle["_path"] = path
+    return bundle
+
+
+def collect_paths(arguments: Iterable[str]) -> List[str]:
+    """Expand files / directories / globs into bundle file paths."""
+    paths: List[str] = []
+    for argument in arguments:
+        if os.path.isdir(argument):
+            paths.extend(sorted(
+                glob.glob(os.path.join(argument, "capture_*.json"))))
+        elif os.path.exists(argument):
+            paths.append(argument)
+        else:
+            paths.extend(sorted(glob.glob(argument)))
+    return paths
+
+
+# -- span tree ---------------------------------------------------------------- #
+
+def span_tree_lines(span_dicts: List[Dict]) -> List[str]:
+    """Indented parent→child rendering of span dicts (the
+    ``Span.to_dict`` form).  Orphans (parent outside the window)
+    render as roots — a bounded ring legitimately loses ancestors."""
+    by_id = {span["sid"]: span for span in span_dicts
+             if isinstance(span, dict) and "sid" in span}
+    children: Dict[str, List[Dict]] = {}
+    roots: List[Dict] = []
+    for span in by_id.values():
+        parent = span.get("pid")
+        if parent and parent in by_id:
+            children.setdefault(parent, []).append(span)
+        else:
+            roots.append(span)
+    lines: List[str] = []
+
+    def walk(span: Dict, depth: int):
+        duration_ms = (span.get("t1", span["t0"]) - span["t0"]) * 1e3
+        marks = span.get("marks") or []
+        note = (" [" + ", ".join(name for name, _ in marks) + "]"
+                if marks else "")
+        lines.append(f"  {'  ' * depth}{span.get('name', '?'):<24} "
+                     f"{duration_ms:>9.2f} ms  "
+                     f"({span.get('svc', '?')}){note}")
+        for child in sorted(children.get(span["sid"], []),
+                            key=lambda s: s.get("t0", 0.0)):
+            walk(child, depth + 1)
+
+    for root in sorted(roots, key=lambda s: s.get("t0", 0.0)):
+        walk(root, 0)
+    return lines
+
+
+# -- counters ----------------------------------------------------------------- #
+
+def _fmt(value) -> str:
+    return f"{value:g}" if isinstance(value, (int, float)) \
+        else str(value)
+
+
+def counter_diff_lines(counters: Dict, limit: int = 40) -> List[str]:
+    """What moved between the recorder's install-time baseline and the
+    capture — the "what was the process doing" section."""
+    current = counters.get("metrics", {}) or {}
+    baseline = counters.get("baseline", {}) or {}
+    lines: List[str] = []
+    for key in sorted(current):
+        now_value, then_value = current[key], baseline.get(key)
+        if now_value == then_value:
+            continue
+        if isinstance(now_value, dict):
+            # Histogram snapshot entries: diff on the sample count.
+            now_count = now_value.get("count", 0)
+            then_count = (then_value or {}).get("count", 0) \
+                if isinstance(then_value, dict) else 0
+            if now_count == then_count:
+                continue
+            lines.append(
+                f"  {key:<56} n {then_count} -> {now_count} "
+                f"(p95 {now_value.get('p95', 0):g} ms)")
+        else:
+            lines.append(
+                f"  {key:<56} "
+                f"{_fmt(then_value if then_value is not None else 0)}"
+                f" -> {_fmt(now_value)}")
+    if len(lines) > limit:
+        lines = lines[:limit] + [f"  … {len(lines) - limit} more"]
+    return lines
+
+
+# -- report ------------------------------------------------------------------- #
+
+def render_report(bundle: Dict) -> str:
+    manifest = bundle["manifest"]
+    lines = [
+        "=" * 72,
+        f"capture: {manifest.get('trigger', '?')} — "
+        f"{manifest.get('reason') or '(no reason recorded)'}",
+        f"  trace_id: {manifest.get('trace_id', '?')}",
+        f"  service:  {manifest.get('service', '?')} "
+        f"(pid {manifest.get('pid', '?')})  "
+        f"at {manifest.get('captured', '?')}",
+    ]
+    if bundle.get("_path"):
+        lines.append(f"  bundle:   {bundle['_path']}")
+
+    spans = (bundle.get("spans") or {}).get("spans") or []
+    lines.append("")
+    if spans:
+        matched = (bundle.get("spans") or {}).get("matched")
+        lines.append(f"span tree ({len(spans)} spans"
+                     + (", matched trace" if matched else "") + "):")
+        lines.extend(span_tree_lines(spans))
+    else:
+        lines.append("span tree: (no spans in the window)")
+
+    steplog = bundle.get("steplog") or {}
+    events = steplog.get("events") or []
+    lines.append("")
+    if len(events) >= 2:
+        table = attrib.attribute_steps(
+            [(row[0], row[1], row[2]) for row in events])
+        lines.append(table.render())
+        if steplog.get("dropped"):
+            lines.append(f"  (ring dropped {steplog['dropped']} "
+                         f"older rows)")
+    else:
+        lines.append("step log: (empty — no engine loop in this "
+                     "process, or recorder off)")
+
+    diff = counter_diff_lines(bundle.get("counters") or {})
+    lines.append("")
+    if diff:
+        lines.append("counters (baseline -> capture):")
+        lines.extend(diff)
+    else:
+        lines.append("counters: (nothing moved since baseline)")
+
+    providers = ((bundle.get("counters") or {}).get("providers")
+                 or {})
+    for name, payload in sorted(providers.items()):
+        interesting = {key: value for key, value in payload.items()
+                       if isinstance(value, (int, float)) and value}
+        if interesting:
+            lines.append(f"  provider {name}: " + ", ".join(
+                f"{key}={value:g}" for key, value
+                in sorted(interesting.items())[:12]))
+    return "\n".join(lines)
+
+
+def render_fleet(bundles: List[Dict]) -> str:
+    """Group bundles by trace id: the router fan-out makes one
+    incident → N bundles → ONE fleet section here."""
+    groups: Dict[str, List[Dict]] = {}
+    for bundle in bundles:
+        groups.setdefault(
+            bundle["manifest"].get("trace_id", "?"), []).append(bundle)
+    sections: List[str] = []
+    for trace_id, group in sorted(
+            groups.items(),
+            key=lambda item: item[1][0]["manifest"].get(
+                "captured_unix", 0.0)):
+        if len(group) > 1:
+            services = ", ".join(sorted(
+                b["manifest"].get("service", "?") for b in group))
+            sections.append(f"\n### fleet capture {trace_id} "
+                            f"({len(group)} processes: {services})")
+        for bundle in sorted(
+                group, key=lambda b: b["manifest"].get(
+                    "captured_unix", 0.0)):
+            sections.append(render_report(bundle))
+    return "\n".join(sections)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m aiko_services_tpu.tools.doctor",
+        description="Render flight-recorder capture bundles as "
+                    "readable reports (grouped by trace id).")
+    parser.add_argument("paths", nargs="+",
+                        help="bundle files, globs, or directories")
+    arguments = parser.parse_args(argv)
+    paths = collect_paths(arguments.paths)
+    if not paths:
+        print("doctor: no capture bundles found", file=sys.stderr)
+        return 1
+    bundles: List[Dict] = []
+    failed = 0
+    for path in paths:
+        try:
+            bundles.append(load_bundle(path))
+        except (OSError, ValueError, json.JSONDecodeError) as error:
+            print(f"doctor: skipping {path}: {error}", file=sys.stderr)
+            failed += 1
+    if not bundles:
+        return 1
+    print(render_fleet(bundles))
+    return 0 if not failed else 2
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

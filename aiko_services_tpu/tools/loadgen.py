@@ -313,7 +313,12 @@ class LoadGenerator:
         if command != "infer_response" or not params:
             return
         request_id = str(params[0])
-        started = self._sent_at.pop(request_id, None)
+        # Look up (don't pop yet): the drain loop in run_trace exits
+        # the moment _sent_at goes empty, so the request must stay in
+        # it until its latency/error is recorded — popping first lets
+        # the report snapshot race ahead of the append and under-count
+        # completions.  The pop happens at the end of this handler.
+        started = self._sent_at.get(request_id)
         if started is None:
             if request_id in self._completed_ids:
                 # A second FINAL for a finished request: the
@@ -372,6 +377,9 @@ class LoadGenerator:
                             float(decode_value(outputs[f"{phase}_ms"])))
                     except Exception:  # noqa: BLE001 - telemetry only
                         pass
+        # Everything recorded — only now mark the request finished so
+        # run_trace cannot observe "done" before the stats landed.
+        self._sent_at.pop(request_id, None)
 
     def _on_partial(self, request_id: str, outputs) -> None:
         """Accumulate a streaming increment (chaos tests assert the

@@ -1924,6 +1924,91 @@ def bench_serving_tp(degrees=(1, 2, 4), slots=4, prompt_len=32,
     return results
 
 
+def bench_step_attribution(slots=4, prompt_len=32, max_new=64,
+                           n_requests=8, config_name="small",
+                           chunk_steps=8):
+    """Step-time tax budget (PR 13): run the paged production engine
+    with the step recorder on, attribute the measured wall time to
+    NAMED components via ``obs.attrib``, and print the engine-vs-raw
+    ratio next to the table — so the standing 0.42–0.51 ROADMAP gap
+    reads as a worklist of levers instead of a single opaque number.
+    The acceptance gate is the table adding up: rows must sum to
+    within 10% of the measured wall."""
+    from aiko_services_tpu.obs import attrib, steplog
+    from aiko_services_tpu.orchestration.continuous import (
+        DecodeRequest, _bucket,
+    )
+    from aiko_services_tpu.orchestration.paged import (
+        PagedContinuousServer,
+    )
+
+    block_size = 16
+    max_seq = _bucket(prompt_len) + max_new + chunk_steps
+    max_seq += -max_seq % block_size
+    server = PagedContinuousServer(
+        config_name=config_name, slots=slots, max_seq=max_seq,
+        chunk_steps=chunk_steps, block_size=block_size,
+        quantize_kv=True, seed=7)
+    rng = np.random.default_rng(0)
+
+    def submit_batch(count, tag):
+        for i in range(count):
+            server.submit(DecodeRequest(
+                request_id=f"{tag}{i}",
+                prompt=rng.integers(1, server.config.vocab_size,
+                                    prompt_len).astype(np.int32),
+                max_new_tokens=max_new))
+
+    log("step_attr: warmup (compile prefill waves + chunk)...")
+    submit_batch(slots, "warm")
+    server.run_until_drained()
+
+    # Device-time denominator: bare chained decode at full occupancy
+    # on the SAME shapes — per-step device ms for the sync_wait split
+    # and raw tok/s for the engine-vs-raw ratio.
+    raw_tps = _raw_decode_tps(config_name, slots, max_seq, block_size,
+                              chunk_steps, quantize_kv=True)
+    device_step_ms = slots / max(raw_tps, 1e-9) * 1e3
+
+    steplog.install()
+    try:
+        submit_batch(n_requests, "r")
+        started = time.perf_counter()
+        finished = server.run_until_drained()
+        wall_ms = (time.perf_counter() - started) * 1e3
+        table = attrib.attribute_steps(steplog.RECORDER.events(),
+                                       wall_ms=wall_ms,
+                                       device_step_ms=device_step_ms)
+    finally:
+        steplog.uninstall()
+    done = [r for r in finished if r.error is None]
+    engine_tps = sum(len(r.tokens) for r in done) / (wall_ms / 1e3)
+
+    for line in table.render().splitlines():
+        log(f"step_attr: {line}")
+    ratio = engine_tps / max(raw_tps, 1e-9)
+    log(f"step_attr: engine-vs-raw {engine_tps:.0f}/{raw_tps:.0f} "
+        f"= {ratio:.2f} (target >= 0.50); device step "
+        f"{device_step_ms:.2f} ms; attribution "
+        f"{'adds up' if table.within(0.10) else 'DOES NOT add up'} "
+        f"(rows {table.total_ms:.0f} ms vs wall {table.wall_ms:.0f} "
+        "ms)")
+    results = {
+        "step_attr_wall_ms": round(table.wall_ms, 1),
+        "step_attr_covered_ms": round(table.covered_ms, 1),
+        "step_attr_steps": table.steps,
+        "step_attr_within_10pct": int(table.within(0.10)),
+        "step_attr_engine_vs_raw_ratio": round(ratio, 3),
+        "step_attr_raw_decode_tokens_per_sec": round(raw_tps),
+        "step_attr_engine_tokens_per_sec": round(engine_tps),
+        "step_attr_device_step_ms": round(device_step_ms, 3),
+    }
+    for row in table.rows:
+        key = f"step_attr_{row.component}_ms"
+        results[key] = round(row.ms, 1)
+    return results
+
+
 def bench_sexpr_codec(n_messages=20_000):
     """Control-plane wire codec: µs per parse / generate over
     representative protocol payloads, native C codec vs the pure-Python
@@ -2469,6 +2554,16 @@ SECTIONS = [
                                max_new=8, n_requests=4,
                                chunk_steps=4))
      if SMOKE else bench_serving_tp),
+    # Step-time tax budget (PR 13): the engine-vs-raw gap attributed
+    # to named ROADMAP levers via the step log + a device-time probe;
+    # the section's gate is the table summing to the measured wall
+    # within 10%.  Paged production path, tiny model in SMOKE,
+    # CPU-capable.
+    ("step_attribution", 420,
+     (lambda: bench_step_attribution(
+         slots=2, prompt_len=16, max_new=8, n_requests=4,
+         config_name="tiny", chunk_steps=4))
+     if SMOKE else bench_step_attribution),
     # Serving at REALISTIC scale (VERDICT r4 #5): the 8B int8+int8-KV
     # weight stream through the serving stack, lookahead head-to-head
     # + TTFT p50.  Uses only established 8B compile paths (bucketed

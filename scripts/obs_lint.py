@@ -1,0 +1,157 @@
+#!/usr/bin/env python
+"""Static observability lint: the invariant-7/14 AST sweeps as a tool.
+
+Two checks, factored out of ``tests/test_obs.py`` so they run three
+ways — in tier-1 (the tests import this module and assert on its
+results), standalone / pre-commit (``python scripts/obs_lint.py``
+exits non-zero with file:line offenders), and for any new module an
+author wants to vet before wiring it in:
+
+1. **Guarded switchboard sites** — every access THROUGH an
+   observability switchboard (``trace.TRACER.…``,
+   ``steplog.RECORDER.…``, ``flight.FLIGHT.…``) in the site modules
+   must sit under the zero-cost ``X is not None`` guard, so disabled
+   observability costs one attribute load + identity test and
+   nothing else.
+2. **No obs in jitted modules** — ``ops/`` and ``models/`` must not
+   import ANY ``obs`` symbol (trace, steplog, metrics, flight,
+   attrib): observability can never reach a traced program.
+
+Stdlib-only on purpose: the lint must run in a bare pre-commit
+environment without importing the package (or jax) at all.
+"""
+
+from __future__ import annotations
+
+import ast
+import pathlib
+import sys
+from typing import Iterable, List, Tuple
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+PKG = REPO / "aiko_services_tpu"
+
+#: module alias → switchboard attribute (the nullable singletons).
+SWITCHBOARDS = {"trace": "TRACER", "steplog": "RECORDER",
+                "flight": "FLIGHT"}
+
+#: Guarded-site modules: every switchboard access in these files must
+#: sit under the ``is not None`` guard.
+SITE_MODULES: Tuple[pathlib.Path, ...] = (
+    PKG / "orchestration" / "continuous.py",
+    PKG / "orchestration" / "paged.py",
+    PKG / "orchestration" / "serving.py",
+    PKG / "orchestration" / "client.py",
+    PKG / "orchestration" / "autoscaler.py",
+    PKG / "runtime" / "actor.py",
+    PKG / "runtime" / "faults.py",
+    PKG / "tools" / "loadgen.py",
+)
+
+#: Jitted modules: no obs import at all (architecture invariant 7).
+JIT_DIRS: Tuple[pathlib.Path, ...] = (PKG / "ops", PKG / "models")
+
+#: obs submodule names a jitted module must never import directly.
+OBS_MODULE_NAMES = ("trace", "steplog", "metrics", "flight", "attrib")
+
+
+def is_switchboard_usage(node) -> bool:
+    """Matches ``trace.TRACER.<anything>`` / ``steplog.RECORDER.<…>``
+    / ``flight.FLIGHT.<…>`` — an attribute access THROUGH a
+    switchboard (module helpers like ``trace.inject`` and the guard
+    compare itself don't count)."""
+    return (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Attribute)
+            and isinstance(node.value.value, ast.Name)
+            and SWITCHBOARDS.get(node.value.value.id)
+            == node.value.attr)
+
+
+def has_guard(test) -> bool:
+    """The ``X.TRACER is not None`` compare anywhere in an if-test
+    (plain or inside an ``and`` conjunction)."""
+    for node in ast.walk(test):
+        if (isinstance(node, ast.Compare)
+                and isinstance(node.ops[0], ast.IsNot)
+                and isinstance(node.left, ast.Attribute)
+                and node.left.attr in SWITCHBOARDS.values()):
+            return True
+    return False
+
+
+def check_guarded_sites(
+        paths: Iterable[pathlib.Path] = SITE_MODULES,
+) -> Tuple[List[str], int]:
+    """Returns ``(offenders, total_sites)`` — offenders are
+    ``file:line`` strings for unguarded switchboard accesses."""
+    offenders: List[str] = []
+    sites = 0
+    for path in paths:
+        tree = ast.parse(path.read_text())
+        guarded = set()
+        for node in ast.walk(tree):
+            if isinstance(node, ast.If) and has_guard(node.test):
+                for sub in ast.walk(node):
+                    if is_switchboard_usage(sub):
+                        guarded.add(id(sub))
+        for node in ast.walk(tree):
+            if is_switchboard_usage(node):
+                sites += 1
+                if id(node) not in guarded:
+                    offenders.append(f"{path.name}:{node.lineno}")
+    return offenders, sites
+
+
+def check_jit_dirs(
+        directories: Iterable[pathlib.Path] = JIT_DIRS,
+) -> List[str]:
+    """``file:line`` offenders for any obs import inside ops/ or
+    models/."""
+    offenders: List[str] = []
+    for directory in directories:
+        for path in sorted(directory.glob("*.py")):
+            tree = ast.parse(path.read_text())
+            for node in ast.walk(tree):
+                if isinstance(node, ast.ImportFrom):
+                    module = node.module or ""
+                    names = [alias.name for alias in node.names]
+                    if "obs" in module.split("."):
+                        offenders.append(f"{path.name}:{node.lineno}")
+                    elif any(name in OBS_MODULE_NAMES
+                             and module.endswith("obs")
+                             for name in names):
+                        offenders.append(f"{path.name}:{node.lineno}")
+                elif isinstance(node, ast.Import):
+                    for alias in node.names:
+                        if ".obs" in alias.name \
+                                or alias.name.startswith("obs"):
+                            offenders.append(
+                                f"{path.name}:{node.lineno}")
+    return offenders
+
+
+def main(argv=None) -> int:
+    del argv
+    failures = 0
+    offenders, sites = check_guarded_sites()
+    if offenders:
+        failures += 1
+        print("obs_lint: UNGUARDED switchboard sites "
+              "(need `X is not None`):", file=sys.stderr)
+        for offender in offenders:
+            print(f"  {offender}", file=sys.stderr)
+    jit_offenders = check_jit_dirs()
+    if jit_offenders:
+        failures += 1
+        print("obs_lint: obs imports inside jitted modules "
+              "(invariant 7):", file=sys.stderr)
+        for offender in jit_offenders:
+            print(f"  {offender}", file=sys.stderr)
+    if not failures:
+        print(f"obs_lint: OK — {sites} guarded switchboard sites, "
+              f"{len(list(JIT_DIRS))} jit dirs clean")
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

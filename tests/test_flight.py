@@ -1,0 +1,574 @@
+"""Flight recorder + step-time attribution (the PR-13 tentpole).
+
+Four layers, matching the design:
+
+* :class:`~aiko_services_tpu.obs.flight.FlightRecorder` unit tests —
+  one self-contained bundle per trigger, every section stamped with
+  the SAME trace id, per-trigger rate limiting (operator exempt),
+  bounded bundle files, never-raise capture.
+* :class:`~aiko_services_tpu.obs.flight.P95DriftDetector` and
+  :mod:`~aiko_services_tpu.obs.attrib` pure-logic tests — exact delta
+  histograms, re-baseline on replica churn, tax-budget rows that sum
+  to the measured wall within tolerance (the acceptance gate).
+* Trigger integration: a REAL watchdog trip on the tiny CPU engine,
+  a fault-injection fire, the SLO-breach streak crossing in the
+  autoscaler, the operator ``(capture)`` wire command, and the
+  router's fleet fan-out (one shared trace id across every bundle).
+* ``tools/doctor.py`` renders every bundle produced above without
+  error and groups fleet bundles back into one record.
+"""
+
+import json
+import os
+import pathlib
+import time
+
+import numpy as np
+import pytest
+
+from aiko_services_tpu.obs import attrib, flight, metrics, steplog, trace
+from aiko_services_tpu.utils.sexpr import generate, parse
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_obs():
+    """Never let an installed recorder escape the test that armed it."""
+    yield
+    flight.uninstall()
+    steplog.uninstall()
+    trace.uninstall()
+
+
+def _bundles(directory) -> list:
+    return sorted(str(p) for p in pathlib.Path(directory).glob(
+        "capture_*.json"))
+
+
+def _load(path) -> dict:
+    with open(path) as handle:
+        return json.load(handle)
+
+
+# ---------------------------------------------------------------- #
+# FlightRecorder: the bundle itself
+# ---------------------------------------------------------------- #
+
+def test_bundle_sections_share_one_trace_id(tmp_path):
+    tracer = trace.install(service="svc_a")
+    steplog.install()
+    with tracer.span("engine_step") as span:
+        with tracer.span("decode_chunk"):
+            pass
+    steplog.RECORDER.record("dispatch", ring=1)
+    steplog.RECORDER.record("sync", wait_ms=2.0, steps=2)
+    recorder = flight.install(out_dir=str(tmp_path), service="svc_a")
+    recorder.attach("server", lambda: {"slots": 2, "queued": 0})
+
+    path = recorder.capture("operator", reason="smoke")
+    assert path and os.path.exists(path)
+    bundle = _load(path)
+
+    manifest = bundle["manifest"]
+    assert manifest["format"] == flight.FORMAT_VERSION
+    assert manifest["trigger"] == "operator"
+    assert manifest["reason"] == "smoke"
+    assert manifest["service"] == "svc_a"
+    tid = manifest["trace_id"]
+    # Every section joins on the SAME trace id — this is what lets
+    # the doctor stitch fleet bundles into one record.
+    assert bundle["spans"]["trace_id"] == tid
+    assert bundle["steplog"]["trace_id"] == tid
+    assert bundle["counters"]["trace_id"] == tid
+    # The newest finished span's trace won the id election, so the
+    # span window matched it.
+    assert bundle["spans"]["matched"] is True
+    assert {s["name"] for s in bundle["spans"]["spans"]} == \
+        {"engine_step", "decode_chunk"}
+    assert all(s["tid"] == tid for s in bundle["spans"]["spans"])
+    assert bundle["spans"]["chrome"]          # chrome events rendered
+    assert span.trace_id == tid
+    # Step-log slice and counts rode along.
+    assert [row[1] for row in bundle["steplog"]["events"]] == \
+        ["dispatch", "sync"]
+    assert bundle["steplog"]["counts"] == {"dispatch": 1, "sync": 1}
+    # Provider dict landed under counters.providers.
+    assert bundle["counters"]["providers"]["server"] == \
+        {"slots": 2, "queued": 0}
+    # The capture counter moved (and is visible in the snapshot).
+    key = 'aiko_flight_captures_total{trigger="operator"}'
+    assert bundle["counters"]["metrics"].get(key, 0) >= 0  # pre-inc
+    assert metrics.REGISTRY.snapshot()[key] >= 1
+
+
+def test_explicit_trace_id_beats_span_election(tmp_path):
+    recorder = flight.install(out_dir=str(tmp_path))
+    recorder.note_spans([{"tid": "aaa", "sid": "1", "name": "x",
+                          "svc": "s", "t0": 0.0, "t1": 0.1}])
+    path = recorder.capture("operator", trace_id="fleet123")
+    bundle = _load(path)
+    assert bundle["manifest"]["trace_id"] == "fleet123"
+    # No span matches the fleet id: the window ships unfiltered.
+    assert bundle["spans"]["matched"] is False
+    assert len(bundle["spans"]["spans"]) == 1
+
+
+def test_rate_limit_suppresses_but_operator_is_exempt(tmp_path):
+    recorder = flight.install(out_dir=str(tmp_path),
+                              min_interval_s=60.0)
+    assert recorder.capture("watchdog") is not None
+    assert recorder.capture("watchdog") is None       # suppressed
+    assert recorder.capture("fault") is not None      # separate budget
+    assert recorder.capture("operator") is not None   # humans exempt
+    assert recorder.capture("operator") is not None
+    assert len(_bundles(tmp_path)) == 4
+    assert recorder.captures == 4
+    triggers = [entry["trigger"] for entry in recorder.recent()]
+    assert triggers == ["watchdog", "fault", "operator", "operator"]
+
+
+def test_max_bundles_deletes_oldest_files(tmp_path):
+    recorder = flight.install(out_dir=str(tmp_path), max_bundles=2,
+                              min_interval_s=0.0)
+    paths = [recorder.capture("operator") for _ in range(4)]
+    remaining = _bundles(tmp_path)
+    assert len(remaining) == 2
+    assert set(remaining) == set(paths[-2:])
+
+
+def test_capture_never_raises_on_io_failure(tmp_path):
+    blocker = tmp_path / "not_a_dir"
+    blocker.write_text("file where the out_dir should go")
+    recorder = flight.install(out_dir=str(blocker))
+    assert recorder.capture("watchdog") is None       # swallowed
+
+
+def test_provider_bugs_stay_local(tmp_path):
+    recorder = flight.install(out_dir=str(tmp_path))
+
+    def bad_provider():
+        raise RuntimeError("boom")
+
+    recorder.attach("bad", bad_provider)
+    recorder.attach("good", lambda: {"ok": 1})
+    bundle = _load(recorder.capture("operator"))
+    assert bundle["counters"]["providers"]["bad"] == \
+        {"error": "provider raised"}
+    assert bundle["counters"]["providers"]["good"] == {"ok": 1}
+
+
+def test_exit_capture_only_fires_while_installed(tmp_path):
+    recorder = flight.install(out_dir=str(tmp_path),
+                              capture_on_exit=True)
+    flight.uninstall()
+    recorder._atexit_capture()                # stale atexit: no-op
+    assert _bundles(tmp_path) == []
+    flight.install(recorder=recorder)
+    recorder._atexit_capture()
+    bundles = _bundles(tmp_path)
+    assert len(bundles) == 1
+    assert _load(bundles[0])["manifest"]["trigger"] == "exit"
+
+
+# ---------------------------------------------------------------- #
+# P95DriftDetector: exact delta histograms
+# ---------------------------------------------------------------- #
+
+def _hist(values, base=None):
+    hist = base or metrics.Histogram("fleet_ttft")
+    for value in values:
+        hist.observe(value)
+    return hist
+
+
+def test_drift_detector_flags_a_p95_shift():
+    detector = flight.P95DriftDetector(ratio=1.5, min_count=20)
+    hist = _hist([1.0] * 30)
+    assert detector.observe("ttft", hist) is None     # first: baseline
+    hist = _hist([1.0] * 30, base=hist)
+    assert detector.observe("ttft", hist) is None     # forms the EMA
+    hist = _hist([100.0] * 30, base=hist)
+    flag = detector.observe("ttft", hist)
+    assert flag is not None
+    assert flag["phase"] == "ttft"
+    assert flag["p95_ms"] > flag["baseline_ms"] * 1.5
+    assert flag["window_count"] == 30
+
+
+def test_drift_detector_ignores_thin_windows():
+    detector = flight.P95DriftDetector(min_count=20)
+    hist = _hist([1.0] * 30)
+    detector.observe("ttft", hist)
+    detector.observe("ttft", _hist([1.0] * 30, base=hist))
+    spiky = _hist([500.0] * 5, base=hist)     # only 5 new samples
+    assert detector.observe("ttft", spiky) is None
+
+
+def test_drift_detector_rebaselines_on_replica_churn():
+    detector = flight.P95DriftDetector(min_count=5)
+    hist = _hist([1.0] * 10)
+    detector.observe("ttft", hist)
+    detector.observe("ttft", _hist([1.0] * 10, base=hist))
+    # Replica churn: the merged fleet histogram SHRANK.  A negative
+    # delta must re-baseline, not flag (or crash on negative counts).
+    shrunk = _hist([1.0] * 5)
+    assert detector.observe("ttft", shrunk) is None
+    grown = _hist([1.0] * 10, base=shrunk)
+    assert detector.observe("ttft", grown) is None    # clean restart
+
+
+# ---------------------------------------------------------------- #
+# attrib: the tax budget table
+# ---------------------------------------------------------------- #
+
+def _synthetic_steps():
+    """Hand-built step-log rows: 3 decode iterations of
+    dispatch → sync(wait) → token_dispatch → commit, 10 ms apart."""
+    events, t = [], 100.0
+    for _ in range(3):
+        t += 0.001
+        events.append((t, "dispatch", {"ring": 1}))
+        t += 0.004
+        events.append((t, "sync", {"wait_ms": 3.0, "steps": 2}))
+        t += 0.003
+        events.append((t, "token_dispatch",
+                       {"slots": 2, "tokens": 2, "ms": 2.0}))
+        t += 0.002
+        events.append((t, "commit", {"tokens": 2}))
+    return events
+
+
+def test_attribution_rows_sum_to_wall():
+    events = _synthetic_steps()
+    covered = (events[-1][0] - events[0][0]) * 1e3
+    wall = covered + 2.0                      # loop ran a bit longer
+    table = attrib.attribute_steps(events, wall_ms=wall)
+    assert table.within(0.10)
+    assert abs(table.total_ms - wall) < 1e-6  # exact by construction
+    assert table.steps == 6                   # 3 syncs × steps=2
+    by_name = {row.component: row for row in table.rows}
+    # The embedded durations went to their own components...
+    assert by_name["sync_wait"].ms == pytest.approx(9.0)
+    assert by_name["token_dispatch"].ms == pytest.approx(6.0 + 3.0)
+    # ...and the residual landed honestly in `uninstrumented`.
+    assert by_name["uninstrumented"].ms == pytest.approx(2.0)
+    assert by_name["uninstrumented"].events == 0
+    # Every row names its ROADMAP lever.
+    assert by_name["sync_wait"].lever == "wider in-flight ring"
+    assert by_name["token_dispatch"].lever == \
+        "batched host-side token dispatch"
+    assert all(row.lever for row in table.rows)
+    # Shares sum to ~1 because the rows sum to the wall.
+    assert sum(row.share for row in table.rows) == pytest.approx(1.0)
+
+
+def test_attribution_device_split():
+    table = attrib.attribute_steps(_synthetic_steps(),
+                                   device_step_ms=1.0)
+    by_name = {row.component: row for row in table.rows}
+    # 6 device steps × 1 ms out of the 9 ms sync_wait pool.
+    assert "sync_wait" not in by_name
+    assert by_name["device_compute"].ms == pytest.approx(6.0)
+    assert by_name["sync_excess"].ms == pytest.approx(3.0)
+    assert by_name["device_compute"].lever == \
+        "(device time — not host tax)"
+    assert table.within(0.10)                 # the split is zero-sum
+
+
+def test_attribution_degenerate_inputs():
+    empty = attrib.attribute_steps([])
+    assert empty.rows == [] and not empty.within()
+    lone = attrib.attribute_steps([(1.0, "sync", {})], wall_ms=5.0)
+    assert [row.component for row in lone.rows] == ["uninstrumented"]
+    assert lone.within(0.10)
+    # Junk embedded fields must not crash the budget.
+    junk = attrib.attribute_steps(
+        [(1.0, "dispatch", {}), (1.01, "sync", {"wait_ms": "bogus"})])
+    assert junk.total_ms == pytest.approx(junk.covered_ms)
+
+
+# ---------------------------------------------------------------- #
+# Triggers on the real engine (CPU smoke shape)
+# ---------------------------------------------------------------- #
+
+def _server(**kwargs):
+    from aiko_services_tpu.orchestration.continuous import (
+        ContinuousBatchingServer,
+    )
+    kwargs.setdefault("config_name", "tiny")
+    kwargs.setdefault("slots", 2)
+    kwargs.setdefault("max_seq", 64)
+    kwargs.setdefault("chunk_steps", 2)
+    return ContinuousBatchingServer(**kwargs)
+
+
+def _request(request_id, max_new=4, **kwargs):
+    from aiko_services_tpu.orchestration.continuous import DecodeRequest
+    return DecodeRequest(request_id=request_id,
+                         prompt=np.arange(1, 6, dtype=np.int32),
+                         max_new_tokens=max_new, **kwargs)
+
+
+def test_watchdog_trip_dumps_a_bundle(tmp_path, capsys):
+    """The acceptance chaos run: a stalled ring sync trips the
+    watchdog, the guarded site dumps ONE bundle whose sections share a
+    trace id, and the doctor renders it without error."""
+    from aiko_services_tpu.runtime import faults
+    from aiko_services_tpu.tools import doctor
+
+    steplog.install()
+    flight.install(out_dir=str(tmp_path), service="replica_w",
+                   min_interval_s=0.0)
+    server = _server(slots=1, watchdog_s=0.01)
+    faults.install(faults.FaultPlan().add("stall_step", nth=1, ms=60))
+    victim = _request("w1", max_new=8)
+    server.submit(victim)
+    done = []
+    deadline = time.time() + 30
+    while not done and time.time() < deadline:
+        done.extend(server.step())
+    assert victim.error == "watchdog_stalled"
+
+    paths = _bundles(tmp_path)
+    watchdog = [p for p in paths if "capture_watchdog_" in p]
+    assert len(watchdog) == 1
+    bundle = _load(watchdog[0])
+    manifest = bundle["manifest"]
+    assert manifest["trigger"] == "watchdog"
+    assert "stalled" in manifest["reason"]
+    tid = manifest["trace_id"]
+    assert bundle["spans"]["trace_id"] == tid
+    assert bundle["steplog"]["trace_id"] == tid
+    assert bundle["counters"]["trace_id"] == tid
+    # The step log rode along: the stalled window is attributable.
+    assert len(bundle["steplog"]["events"]) >= 2
+    names = {row[1] for row in bundle["steplog"]["events"]}
+    assert "dispatch" in names
+    # Watchdog trips moved between baseline and capture.
+    snap = bundle["counters"]["metrics"]
+    base = bundle["counters"]["baseline"]
+    moved = {k for k in snap if snap[k] != base.get(k)}
+    assert moved
+
+    assert doctor.main([str(tmp_path)]) == 0
+    report = capsys.readouterr().out
+    assert "capture: watchdog" in report
+    assert tid in report
+    assert "step-time tax budget" in report
+
+
+def test_fault_fire_dumps_a_bundle(tmp_path):
+    from aiko_services_tpu.runtime import faults
+
+    flight.install(out_dir=str(tmp_path), min_interval_s=0.0)
+    plan = faults.FaultPlan().add("stall_step", nth=1, ms=5)
+    assert plan.check("stall_step") == {"ms": 5}
+    paths = _bundles(tmp_path)
+    assert len(paths) == 1
+    manifest = _load(paths[0])["manifest"]
+    assert manifest["trigger"] == "fault"
+    assert "stall_step" in manifest["reason"]
+
+
+def test_attribution_within_tolerance_on_smoke_shape(tmp_path):
+    """Acceptance gate: on the CPU smoke shape the tax-budget rows sum
+    to within 10% of the measured step-loop wall time, with the
+    engine's real step-log rows (not synthetic ones)."""
+    server = _server()
+    # Warm the compiled programs so the measured wall is decode work,
+    # not XLA compilation.
+    warm = _request("warm", max_new=2)
+    server.submit(warm)
+    while not server.step():
+        pass
+    steplog.install()
+    request = _request("r1", max_new=12)
+    server.submit(request)
+    t0 = time.perf_counter()
+    done = []
+    while not done:
+        done.extend(server.step())
+    wall_ms = (time.perf_counter() - t0) * 1e3
+    table = attrib.attribute_steps(steplog.RECORDER.events(),
+                                   wall_ms=wall_ms)
+    assert table.steps > 0
+    assert table.rows
+    assert table.within(0.10), table.render()
+    assert "step-time tax budget" in table.render()
+
+
+# ---------------------------------------------------------------- #
+# SLO-breach streak crossing (autoscaler trigger)
+# ---------------------------------------------------------------- #
+
+def _make_autoscaler(engine, policy, broker="flasc"):
+    from aiko_services_tpu.orchestration.autoscaler import (
+        FleetAutoscaler,
+    )
+    from aiko_services_tpu.runtime import (
+        Process, actor_args, compose_instance,
+    )
+    process = Process(namespace="flasc", hostname="h", pid="1",
+                      engine=engine, broker=broker)
+    return compose_instance(
+        FleetAutoscaler, actor_args("autoscaler"), process=process,
+        spawner=lambda slot, role: None, policy=policy, tick_s=0.05)
+
+
+def test_slo_breach_streak_dumps_and_fans_out(tmp_path, engine):
+    """The breach streak crossing ``breach_windows`` captures local
+    forensics AND asks the router for a fleet-wide capture."""
+    from aiko_services_tpu.orchestration.autoscaler import (
+        AutoscalerPolicy, FleetSnapshot,
+    )
+
+    policy = AutoscalerPolicy(ttft_slo_ms=100.0, breach_windows=2,
+                              cooldown_s=10 ** 6)
+    autoscaler = _make_autoscaler(engine, policy)
+    autoscaler._router_topic = "flasc/router"
+    flight.install(out_dir=str(tmp_path), min_interval_s=0.0)
+    fanned = []
+
+    def handler(_topic, payload):
+        fanned.append(parse(payload))
+
+    autoscaler.process.add_message_handler(handler, "flasc/router/in")
+    breach = FleetSnapshot(now=1.0, ttft_p95_ms=400.0)
+
+    # One breach tick: streak 0 → 1, below the window — no capture.
+    autoscaler.state.breach_streak = 1
+    autoscaler._maybe_flight_capture(breach, streak_before=0)
+    assert _bundles(tmp_path) == []
+
+    # Second breach tick: the streak CROSSES breach_windows.
+    autoscaler.state.breach_streak = 2
+    autoscaler._maybe_flight_capture(breach, streak_before=1)
+    paths = _bundles(tmp_path)
+    assert len(paths) == 1
+    manifest = _load(paths[0])["manifest"]
+    assert manifest["trigger"] == "slo_breach"
+    assert "ttft_p95=400.0" in manifest["reason"]
+    engine.drain()
+    assert len(fanned) == 1
+    command, params = fanned[0]
+    assert command == "capture"
+    assert params[2] == "slo_breach"
+
+    # Third breach tick past the crossing: no re-capture storm.
+    autoscaler.state.breach_streak = 3
+    autoscaler._maybe_flight_capture(breach, streak_before=2)
+    assert len(_bundles(tmp_path)) == 1
+
+
+# ---------------------------------------------------------------- #
+# Operator (capture) wire command + router fleet fan-out
+# ---------------------------------------------------------------- #
+
+def test_operator_capture_wire_command(tmp_path, engine):
+    from aiko_services_tpu.runtime import (
+        Actor, Process, actor_args, compose_instance,
+    )
+
+    process = Process(namespace="fl", hostname="h", pid="7",
+                      engine=engine, broker="flcap")
+    actor = compose_instance(Actor, actor_args("svc_c"),
+                             process=process)
+    replies = []
+
+    def handler(_topic, payload):
+        command, params = parse(payload)
+        if command == "capture_response":
+            replies.append(params)
+
+    process.add_message_handler(handler, "fl/cap_reply")
+
+    # Uninstalled recorder: the command answers honestly.
+    process.message.publish(
+        actor.topic_in, generate("capture", ["", "fl/cap_reply"]))
+    engine.drain()
+    assert replies == [["svc_c", "uninstalled"]]
+
+    flight.install(out_dir=str(tmp_path), service="svc_c")
+    process.message.publish(
+        actor.topic_in,
+        generate("capture", ["", "fl/cap_reply", "operator",
+                             "p95 drift ttft"]))
+    engine.drain()
+    assert len(replies) == 2
+    name, path = replies[1]
+    assert name == "svc_c" and os.path.exists(path)
+    manifest = _load(path)["manifest"]
+    assert manifest["trigger"] == "operator"
+    assert manifest["reason"] == "p95 drift ttft"
+
+
+def test_router_capture_fans_out_one_trace_id(tmp_path, engine,
+                                              capsys):
+    """One ``(capture)`` at the router → a bundle from the router AND
+    every replica, all joined on ONE minted trace id — and the doctor
+    groups them back into a single fleet record."""
+    from aiko_services_tpu.orchestration.serving import ReplicaRouter
+    from aiko_services_tpu.runtime import (
+        Actor, Process, actor_args, compose_instance,
+    )
+    from aiko_services_tpu.tools import doctor
+
+    process = Process(namespace="fl", hostname="h", pid="9",
+                      engine=engine, broker="flfan")
+    router = compose_instance(ReplicaRouter, actor_args("router"),
+                              process=process)
+    replicas = [compose_instance(Actor, actor_args(f"rep{i}"),
+                                 process=process) for i in (1, 2)]
+    router._replicas = [replica.topic_path for replica in replicas]
+    flight.install(out_dir=str(tmp_path), service="fleet")
+
+    process.message.publish(
+        router.topic_in, generate("capture", ["", "", "operator",
+                                              "fleet smoke"]))
+    engine.drain()
+
+    paths = _bundles(tmp_path)
+    assert len(paths) == 3                    # router + 2 replicas
+    trace_ids = {_load(p)["manifest"]["trace_id"] for p in paths}
+    assert len(trace_ids) == 1                # ONE minted id
+    assert router.counters["fleet_captures"] == 1
+
+    assert doctor.main([str(tmp_path)]) == 0
+    report = capsys.readouterr().out
+    assert f"fleet capture {trace_ids.pop()} (3 processes" in report
+
+
+def test_router_anomaly_tick_flags_and_captures(tmp_path, engine):
+    """Fleet p95 drift (exact delta histograms over the replica EC
+    merges) bumps the counter, lands in the share, and triggers a
+    fleet capture — BEFORE the autoscaler's SLO hard-trip."""
+    from aiko_services_tpu.orchestration.serving import ReplicaRouter
+    from aiko_services_tpu.runtime import (
+        Process, actor_args, compose_instance,
+    )
+
+    process = Process(namespace="fl", hostname="h", pid="11",
+                      engine=engine, broker="flanom")
+    router = compose_instance(ReplicaRouter, actor_args("router"),
+                              process=process)
+    flight.install(out_dir=str(tmp_path), min_interval_s=0.0)
+
+    hist = _hist([2.0] * 40)
+    router._replica_hists["fl/rep1"] = {"ttft": hist.encode()}
+    router._anomaly_tick()                    # snapshot 1: baseline
+    hist = _hist([2.0] * 40, base=hist)
+    router._replica_hists["fl/rep1"] = {"ttft": hist.encode()}
+    router._anomaly_tick()                    # snapshot 2: forms EMA
+    assert router.counters["anomaly_flags"] == 0
+    hist = _hist([250.0] * 40, base=hist)
+    router._replica_hists["fl/rep1"] = {"ttft": hist.encode()}
+    router._anomaly_tick()                    # snapshot 3: drift
+    assert router.counters["anomaly_flags"] == 1
+    assert "ttft: p95" in router.share["last_anomaly"]
+
+    paths = _bundles(tmp_path)
+    assert len(paths) == 1
+    manifest = _load(paths[0])["manifest"]
+    assert manifest["trigger"] == "anomaly"
+    assert "ttft" in manifest["reason"]

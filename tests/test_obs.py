@@ -17,7 +17,6 @@ Pins the four contracts OBSERVABILITY.md promises:
   every actor answers ``(metrics …)`` with Prometheus text.
 """
 
-import ast
 import json
 import logging
 import pathlib
@@ -26,7 +25,7 @@ import time
 import numpy as np
 import pytest
 
-from aiko_services_tpu.obs import steplog, trace
+from aiko_services_tpu.obs import flight, steplog, trace
 from aiko_services_tpu.obs.metrics import (
     DEFAULT_BOUNDS, CounterDict, Histogram, MetricsRegistry, REGISTRY,
 )
@@ -34,18 +33,6 @@ from aiko_services_tpu.utils.sexpr import generate, parse
 
 REPO = pathlib.Path(__file__).resolve().parent.parent
 PKG = REPO / "aiko_services_tpu"
-
-#: Guarded-site modules: every TRACER/RECORDER access in these files
-#: must sit under the zero-cost ``is not None`` guard.
-_OBS_SITE_MODULES = (
-    PKG / "orchestration" / "continuous.py",
-    PKG / "orchestration" / "paged.py",
-    PKG / "orchestration" / "serving.py",
-    PKG / "orchestration" / "client.py",
-    PKG / "tools" / "loadgen.py",
-)
-#: Jitted modules: no obs import at all (architecture invariant 7).
-_JIT_DIRS = (PKG / "ops", PKG / "models")
 
 #: One bucket spans 10^(1/8) ≈ 1.334× — the quantile error bound.
 BUCKET_RATIO = 10.0 ** (1.0 / 8.0)
@@ -57,6 +44,7 @@ def _no_leaked_obs():
     yield
     trace.uninstall()
     steplog.uninstall()
+    flight.uninstall()
 
 
 # ---------------------------------------------------------------- #
@@ -304,50 +292,40 @@ def test_steplog_install_switchboard():
 # Zero-cost discipline: AST guards + jaxpr pinning
 # ---------------------------------------------------------------- #
 
-def _is_obs_usage(node) -> bool:
-    """Matches ``trace.TRACER.<anything>`` / ``steplog.RECORDER.<…>``
-    — an attribute access THROUGH the switchboard (calls like
-    ``trace.inject`` or the guard compare itself don't count)."""
-    return (isinstance(node, ast.Attribute)
-            and isinstance(node.value, ast.Attribute)
-            and node.value.attr in ("TRACER", "RECORDER")
-            and isinstance(node.value.value, ast.Name)
-            and node.value.value.id in ("trace", "steplog"))
-
-
-def _has_obs_guard(test) -> bool:
-    """The ``X.TRACER is not None`` compare anywhere in an if-test
-    (plain or inside an ``and`` conjunction)."""
-    for node in ast.walk(test):
-        if (isinstance(node, ast.Compare)
-                and isinstance(node.ops[0], ast.IsNot)
-                and isinstance(node.left, ast.Attribute)
-                and node.left.attr in ("TRACER", "RECORDER")):
-            return True
-    return False
+def _load_obs_lint():
+    """The AST sweeps live in ``scripts/obs_lint.py`` (standalone /
+    pre-commit tool); tier-1 runs the SAME code via this loader so
+    the lint and the tests can never drift apart."""
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "obs_lint", REPO / "scripts" / "obs_lint.py")
+    module = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(module)
+    return module
 
 
 def test_every_obs_site_is_guarded():
-    offenders, sites = [], 0
-    for path in _OBS_SITE_MODULES:
-        tree = ast.parse(path.read_text())
-        guarded = set()
-        for node in ast.walk(tree):
-            if isinstance(node, ast.If) and _has_obs_guard(node.test):
-                for sub in ast.walk(node):
-                    if _is_obs_usage(sub):
-                        guarded.add(id(sub))
-        for node in ast.walk(tree):
-            if _is_obs_usage(node):
-                sites += 1
-                if id(node) not in guarded:
-                    offenders.append(f"{path.name}:{node.lineno}")
+    obs_lint = _load_obs_lint()
+    offenders, sites = obs_lint.check_guarded_sites()
     assert not offenders, \
-        f"unguarded TRACER/RECORDER sites: {offenders}"
+        f"unguarded TRACER/RECORDER/FLIGHT sites: {offenders}"
     # The instrumentation is real, not vestigial: the engine has the
     # dispatch/sync/commit/admission/state_upload/sampling sites plus
-    # the tracing sites in router/client/loadgen.
-    assert sites >= 15
+    # the tracing sites in router/client/loadgen and the flight
+    # trigger sites in watchdog/faults/autoscaler/actor.
+    assert sites >= 20
+
+
+def test_obs_lint_covers_the_new_modules():
+    """The lint's site list includes every module that gained a
+    flight trigger — a new trigger site added without lint coverage
+    is the regression this pins against."""
+    obs_lint = _load_obs_lint()
+    names = {path.name for path in obs_lint.SITE_MODULES}
+    assert {"continuous.py", "serving.py", "autoscaler.py",
+            "actor.py", "faults.py"} <= names
+    assert obs_lint.SWITCHBOARDS["flight"] == "FLIGHT"
+    assert obs_lint.main([]) == 0
 
 
 def test_steplog_covers_the_engine_step_events():
@@ -362,28 +340,15 @@ def test_steplog_covers_the_engine_step_events():
 def test_no_obs_code_in_jitted_modules():
     """ops/ and models/ must not import ANY obs symbol — invariant 7:
     observability cannot reach a traced program."""
-    for directory in _JIT_DIRS:
-        for path in sorted(directory.glob("*.py")):
-            tree = ast.parse(path.read_text())
-            for node in ast.walk(tree):
-                if isinstance(node, ast.ImportFrom):
-                    module = node.module or ""
-                    names = [alias.name for alias in node.names]
-                    assert "obs" not in module.split("."), \
-                        f"{path.name}:{node.lineno} imports obs"
-                    assert not any(name in ("trace", "steplog")
-                                   and "obs" in module
-                                   for name in names)
-                elif isinstance(node, ast.Import):
-                    for alias in node.names:
-                        assert ".obs" not in alias.name and \
-                            not alias.name.startswith("obs"), \
-                            f"{path.name}:{node.lineno} imports obs"
+    obs_lint = _load_obs_lint()
+    offenders = obs_lint.check_jit_dirs()
+    assert not offenders, f"obs imports in jitted modules: {offenders}"
 
 
-def test_installed_obs_does_not_change_jaxpr():
-    """Tracer + step recorder installed vs not: the serve-chunk traced
-    program is byte-identical — all observability is host-side."""
+def test_installed_obs_does_not_change_jaxpr(tmp_path):
+    """Tracer + step recorder + FLIGHT RECORDER installed vs not: the
+    serve-chunk traced program is byte-identical — all observability,
+    passive and active, is host-side (invariants 7 and 14)."""
     import jax
 
     from aiko_services_tpu.models import llama
@@ -403,11 +368,13 @@ def test_installed_obs_does_not_change_jaxpr():
     clean = traced()
     trace.install(service="test")
     steplog.install()
+    flight.install(out_dir=str(tmp_path), service="test")
     try:
         assert traced() == clean
     finally:
         trace.uninstall()
         steplog.uninstall()
+        flight.uninstall()
 
 
 # ---------------------------------------------------------------- #
@@ -493,6 +460,48 @@ def test_actor_metrics_command(engine):
     assert name == "scraped"
     assert "aiko_obs_scrape_probe_total" in text
     assert "# TYPE" in text
+
+
+def test_metrics_scrape_includes_latency_histograms(engine):
+    """The replica latency histograms are REGISTRY-created, so the
+    wire scrape renders them as proper Prometheus histogram series —
+    ``_bucket``/``_sum``/``_count`` with ``# HELP``/``# TYPE`` — not
+    just the counter/gauge mirror."""
+    from aiko_services_tpu.orchestration.continuous import (
+        ContinuousBatchingServer,
+    )
+    from aiko_services_tpu.runtime import (
+        Actor, Process, actor_args, compose_instance,
+    )
+    server = ContinuousBatchingServer(config_name="tiny", slots=2,
+                                      max_seq=32, chunk_steps=2)
+    server.latency_hists["ttft"].observe(42.0)
+    server.latency_hists["total"].observe(99.0)
+    process = Process(namespace="test", hostname="h", pid="42",
+                      engine=engine, broker="obs")
+    actor = compose_instance(Actor, actor_args("scraped_h"),
+                             process=process)
+    replies = []
+
+    def handler(_topic, payload):
+        command, params = parse(payload)
+        if command == "metrics_response":
+            replies.append(params)
+
+    process.add_message_handler(handler, "test/obs/metrics_h")
+    process.message.publish(
+        actor.topic_in, generate("metrics", ["test/obs/metrics_h"]))
+    engine.drain()
+    assert len(replies) == 1
+    text = str(replies[0][1])
+    assert "# TYPE aiko_latency_ttft_ms histogram" in text
+    assert "# HELP aiko_latency_ttft_ms" in text
+    instance = server._metrics_labels["instance"]
+    assert f'aiko_latency_ttft_ms_count{{instance="{instance}"}} 1' \
+        in text
+    assert 'le="+Inf"' in text
+    assert f'aiko_latency_total_ms_sum{{instance="{instance}"}} 99' \
+        in text
 
 
 # ---------------------------------------------------------------- #

@@ -215,6 +215,92 @@ def test_profiler_actor_commands(engine, tmp_path):
     engine.advance(0.1)
 
 
+def test_profiler_status_and_reset_commands(engine, tmp_path):
+    """(profile_status) echoes running/idle + the trace dir on
+    topic_out; (profile_reset) force-clears an orphaned session and is
+    safe to fire when nothing is running."""
+    from aiko_services_tpu.tools import ProfilerActor
+    from aiko_services_tpu.runtime import (
+        Process, actor_args, compose_instance,
+    )
+    from aiko_services_tpu.utils.sexpr import generate, parse
+
+    process = Process(namespace="test", hostname="h", pid="78",
+                      engine=engine, broker="profstat")
+    actor = compose_instance(ProfilerActor, actor_args("prof1"),
+                             process=process)
+    statuses = []
+
+    def handler(_topic, payload):
+        command, params = parse(payload)
+        if command == "profile_status":
+            statuses.append(params)
+
+    process.add_message_handler(handler, actor.topic_out)
+
+    process.message.publish(actor.topic_in, generate("profile_status"))
+    engine.advance(0.1)
+    assert statuses == [["idle", ""]]
+
+    trace_dir = str(tmp_path / "trace")
+    process.message.publish(actor.topic_in,
+                            generate("profile_start", [trace_dir]))
+    engine.advance(0.1)
+    process.message.publish(actor.topic_in, generate("profile_status"))
+    engine.advance(0.1)
+    assert statuses[1] == ["running", trace_dir]
+
+    # Reset while a capture is live: the process-global session is
+    # force-stopped and the actor's state clears — the next start
+    # owns a fresh session instead of warning "already running".
+    process.message.publish(actor.topic_in, generate("profile_reset"))
+    engine.advance(0.1)
+    assert actor._trace_dir is None
+    assert actor.share["profiling"] is False
+    process.message.publish(actor.topic_in, generate("profile_status"))
+    engine.advance(0.1)
+    assert statuses[2][0] == "idle"
+
+    # Reset with nothing running: safe no-op (stop_trace raises
+    # internally and is swallowed).
+    process.message.publish(actor.topic_in, generate("profile_reset"))
+    engine.advance(0.1)
+    assert actor.share["profiling"] is False
+
+    # After the reset the profiler is usable again end to end.
+    redo_dir = str(tmp_path / "trace2")
+    process.message.publish(actor.topic_in,
+                            generate("profile_start", [redo_dir]))
+    engine.advance(0.1)
+    assert actor.share["profiling"] is True
+    process.message.publish(actor.topic_in, generate("profile_stop"))
+    engine.advance(0.1)
+    assert actor.share["last_trace_dir"] == redo_dir
+
+
+def test_profiler_mixin_adopts_commands_on_any_actor(engine):
+    """ProfilerMixin wires the four profile_* commands into an
+    arbitrary Actor subclass via _init_profiler."""
+    from aiko_services_tpu.tools.profiler import ProfilerMixin
+    from aiko_services_tpu.runtime import (
+        Actor, Process, actor_args, compose_instance,
+    )
+
+    class Worker(ProfilerMixin, Actor):
+        def __init__(self, context, process=None):
+            super().__init__(context, process)
+            self._init_profiler()
+
+    process = Process(namespace="test", hostname="h", pid="79",
+                      engine=engine, broker="profmix")
+    worker = compose_instance(Worker, actor_args("worker0"),
+                              process=process)
+    for command in ("profile_start", "profile_stop",
+                    "profile_status", "profile_reset"):
+        assert command in worker._command_handlers
+    assert worker.share["profiling"] is False
+
+
 def test_trainer_plugin_view_and_actions():
     from types import SimpleNamespace
     from aiko_services_tpu.tools.dashboard_plugins import (
