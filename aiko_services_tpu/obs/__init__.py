@@ -34,12 +34,29 @@ Two more pillars make the layer *active* (ISSUE 13):
   to measured wall time, naming the levers behind the
   engine-vs-raw-decode gap.
 
+Two more close the loop on the DEVICE side (ISSUE 14):
+
+* :mod:`.compiles` — the compile ledger: every XLA compilation
+  observed via ``jax.monitoring`` (program label, shape-bucket
+  signature, wall ms), a steady-state compile detector that turns any
+  post-warmup compile into an anomaly + flight capture, and the
+  persistent-compilation-cache wiring (hit/miss/saved-ms counters)
+  behind the ``compilation_cache_dir`` engine kwarg.
+* :mod:`.profiler` — on-demand device profiling: the ``(profile N)``
+  operator command brackets N engine steps in
+  ``jax.profiler.start_trace/stop_trace``, yielding REAL per-step
+  device ms for :mod:`.attrib` (replacing the probe) and a
+  TensorBoard-loadable artifact whose manifest rides the next flight
+  bundle.
+
 Import discipline: ``obs`` modules import nothing from the rest of the
 package (stdlib only; ``jax`` strictly lazily), so every layer —
 transport, runtime, orchestration, tools — may depend on them without
 cycles, and ``ops/`` + ``models/`` must not import them at all.
 """
 
-from . import attrib, flight, metrics, steplog, trace  # noqa: F401
+from . import (attrib, compiles, flight, metrics, profiler,  # noqa: F401
+               steplog, trace)
 
-__all__ = ["attrib", "flight", "metrics", "steplog", "trace"]
+__all__ = ["attrib", "compiles", "flight", "metrics", "profiler",
+           "steplog", "trace"]

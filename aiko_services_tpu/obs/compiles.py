@@ -1,0 +1,365 @@
+"""Compile ledger: every XLA compilation, observed and attributed.
+
+The whole serving stack leans on one unmeasured invariant: pow2 shape
+bucketing keeps compile counts log-bounded because "every compile is a
+relay risk" (``orchestration/continuous.py`` prefill loop,
+``kvstore/transfer.py``).  This module makes that invariant observable
+at runtime instead of only in jaxpr tests:
+
+* :class:`CompileLedger` subscribes to ``jax.monitoring`` compilation
+  events and records every XLA compile — program label, shape-bucket
+  signature, wall ms, cumulative count — into REGISTRY
+  counters/histograms, engine ``stats()`` (and from there EC shares,
+  the dashboard pane, and ``LoadReport``).
+* A **steady-state compile detector**: once the harness drops the
+  warmup fence (:meth:`CompileLedger.fence`), ANY further real compile
+  is a bucket-discipline regression — the ledger bumps
+  ``aiko_compiles_steady_state_total`` and fires a flight capture
+  (trigger ``"compile"``) with the ledger attached, so the pathology
+  is caught in production, not just in tests.
+* :func:`enable_persistent_cache` wires JAX's persistent compilation
+  cache to a per-replica directory so a warm restart skips
+  recompilation entirely; the ledger's hit/miss/saved-ms counters
+  quantify it (``tools/loadgen.run_compile_cache_ab`` gates on it).
+
+Event semantics (measured, jax 0.4.x): ``jax.monitoring`` events carry
+NO program name (empty kwargs), so attribution uses a **per-thread
+label** set by the engine at each dispatch site
+(:func:`label` / :func:`set_label`).  On a persistent-cache HIT the
+``…/backend_compile_duration`` event STILL fires (it times the ~ms
+cache retrieval, not a real compile) — the ledger pairs a same-thread
+preceding ``cache_hits`` event with the next duration event and books
+it as a retrieval, never as a compile.  ``compile_time_saved_sec`` can
+be NEGATIVE for tiny programs (estimated compile time minus retrieval
+time); the ledger accumulates the raw signed sum.
+
+Switchboard discipline (swept by ``scripts/obs_lint.py``): module
+default ``LEDGER = None``; every call site outside this module guards
+with ``compiles.LEDGER is not None``.  Listeners are registered ONCE
+per process and forward to whatever ``LEDGER`` currently is — JAX has
+no public listener-unregister API, so :func:`uninstall` simply nulls
+the switchboard and the resident listeners become no-ops.  Invariant
+15 (ARCHITECTURE.md): nothing here touches traced values — jaxprs are
+byte-identical with the ledger installed or absent.
+
+Stdlib-only at import time; ``jax`` strictly lazily (``obs`` package
+discipline).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional, Tuple
+
+from .metrics import REGISTRY
+
+__all__ = ["CompileLedger", "LEDGER", "install", "uninstall",
+           "enable_persistent_cache", "disable_persistent_cache",
+           "set_label", "clear_label", "current_label", "label"]
+
+#: Process-wide switchboard.  ``None`` (the default) means compile
+#: observability is OFF and every guarded call site is a pointer test.
+LEDGER: Optional["CompileLedger"] = None
+
+#: Whether the process-global jax.monitoring listeners have been
+#: registered (once, lazily, at first install — never unregistered).
+_LISTENERS_REGISTERED = False
+
+_TLS = threading.local()
+
+
+# --------------------------------------------------------------------------- #
+# Per-thread program labels — jax.monitoring events are anonymous, so the
+# engine names the work before dispatching it.
+# --------------------------------------------------------------------------- #
+
+def set_label(program: str, signature: str = ""):
+    """Name subsequent compiles on THIS thread (engine dispatch sites)."""
+    _TLS.label = (str(program), str(signature))
+
+
+def clear_label():
+    _TLS.label = None
+
+
+def current_label() -> Tuple[str, str]:
+    got = getattr(_TLS, "label", None)
+    return got if got else ("unlabeled", "")
+
+
+@contextlib.contextmanager
+def label(program: str, signature: str = ""):
+    """Scoped :func:`set_label` (tests and one-shot call sites)."""
+    previous = getattr(_TLS, "label", None)
+    set_label(program, signature)
+    try:
+        yield
+    finally:
+        _TLS.label = previous
+
+
+class CompileLedger:
+    """Record of every XLA compile seen by this process.
+
+    Thread-safe; listener callbacks arrive on whichever thread ran the
+    jit.  ``max_records`` bounds the per-compile detail ring (counters
+    are unbounded monotonic).
+    """
+
+    def __init__(self, service: str = "", max_records: int = 256,
+                 registry=None):
+        self.service = service or f"pid{os.getpid()}"
+        self.registry = registry or REGISTRY
+        self._lock = threading.Lock()
+        self.compiles = 0                 # real compiles (cache misses incl.)
+        self.steady_compiles = 0          # real compiles AFTER the fence
+        self.cache_hits = 0
+        self.cache_misses = 0
+        self.cache_saved_ms = 0.0         # signed (see module docstring)
+        self.total_ms = 0.0
+        self.fenced = False
+        self.records: deque = deque(maxlen=max(1, int(max_records)))
+        self._counter_compiles = self.registry.counter(
+            "aiko_compiles_total", "XLA compiles observed by the ledger")
+        self._counter_steady = self.registry.counter(
+            "aiko_compiles_steady_state_total",
+            "compiles after the warmup fence (bucket-discipline breaches)")
+        self._counter_hits = self.registry.counter(
+            "aiko_compile_cache_hits_total",
+            "persistent compilation cache hits")
+        self._counter_misses = self.registry.counter(
+            "aiko_compile_cache_misses_total",
+            "persistent compilation cache misses")
+        self._gauge_saved = self.registry.gauge(
+            "aiko_compile_cache_saved_ms",
+            "signed cumulative compile ms saved by the persistent cache")
+        self._hist_wall = self.registry.histogram(
+            "aiko_compile_wall_ms", "per-compile wall time (ms)")
+
+    # -- warmup fence -------------------------------------------------------- #
+
+    def fence(self):
+        """Drop the warmup fence: from now on every real compile is a
+        steady-state anomaly (bumps the counter and fires a flight
+        capture).  Idempotent."""
+        with self._lock:
+            self.fenced = True
+
+    def lift_fence(self):
+        """Re-enter warmup (e.g. before an intentional reconfigure)."""
+        with self._lock:
+            self.fenced = False
+
+    # -- event sinks (called by the module listeners or the wrapped-jit
+    #    fallback entry point) ----------------------------------------------- #
+
+    def on_cache_hit(self):
+        with self._lock:
+            self.cache_hits += 1
+            self._counter_hits.inc()
+        _TLS.pending_hit = True
+
+    def on_cache_miss(self):
+        with self._lock:
+            self.cache_misses += 1
+            self._counter_misses.inc()
+        _TLS.pending_hit = False
+
+    def on_saved(self, saved_ms: float):
+        with self._lock:
+            self.cache_saved_ms += float(saved_ms)
+            self._gauge_saved.inc(float(saved_ms))
+
+    def record_compile(self, wall_ms: float, program: str = "",
+                       signature: str = "", cache_hit: bool = False):
+        """Book one backend-compile duration.  Public so engines without
+        ``jax.monitoring`` can wrap their jit entry points and call this
+        directly (the documented fallback path)."""
+        if not program:
+            program, default_sig = current_label()
+            signature = signature or default_sig
+        steady = False
+        with self._lock:
+            entry = {"program": program, "signature": signature,
+                     "wall_ms": round(float(wall_ms), 3),
+                     "cache_hit": bool(cache_hit),
+                     "steady": False, "ts": time.time()}
+            if not cache_hit:
+                self.compiles += 1
+                self.total_ms += float(wall_ms)
+                self._counter_compiles.inc()
+                self._hist_wall.observe(float(wall_ms))
+                if self.fenced:
+                    steady = True
+                    entry["steady"] = True
+                    self.steady_compiles += 1
+                    self._counter_steady.inc()
+            self.records.append(entry)
+        if steady:
+            self._fire_steady_capture(entry)
+
+    def _fire_steady_capture(self, entry: Dict):
+        # Lazy import: flight imports THIS module at top level for its
+        # bundle section, so the dependency must stay one-way at import
+        # time.  Never let a capture failure leak into the compile path.
+        try:
+            from . import flight
+            if flight.FLIGHT is not None:
+                flight.FLIGHT.capture(
+                    "compile",
+                    reason=(f"steady-state compile: "
+                            f"{entry['program']}"
+                            f"[{entry['signature']}] "
+                            f"{entry['wall_ms']:.1f}ms"))
+        except Exception:  # noqa: BLE001 - observability must stay passive
+            pass
+
+    # -- distinct signatures (the log-bound check reads this) ---------------- #
+
+    def signatures(self, program: Optional[str] = None) -> List[Tuple[str, str]]:
+        """Distinct (program, signature) pairs among retained records
+        of REAL compiles, optionally filtered by program."""
+        with self._lock:
+            seen = []
+            for entry in self.records:
+                if entry["cache_hit"]:
+                    continue
+                key = (entry["program"], entry["signature"])
+                if program is not None and key[0] != program:
+                    continue
+                if key not in seen:
+                    seen.append(key)
+            return seen
+
+    # -- export --------------------------------------------------------------- #
+
+    def snapshot(self) -> Dict:
+        """Flight-bundle / doctor section: counters + recent records."""
+        with self._lock:
+            return {
+                "service": self.service,
+                "compiles": self.compiles,
+                "compiles_steady_state": self.steady_compiles,
+                "cache_hits": self.cache_hits,
+                "cache_misses": self.cache_misses,
+                "cache_saved_ms": round(self.cache_saved_ms, 3),
+                "compile_wall_ms_total": round(self.total_ms, 3),
+                "fenced": self.fenced,
+                "records": [dict(entry) for entry in self.records],
+            }
+
+
+# --------------------------------------------------------------------------- #
+# jax.monitoring listeners — registered once, forward to LEDGER if any.
+# --------------------------------------------------------------------------- #
+
+def _on_event(event: str, **kwargs):  # noqa: ARG001 - kwargs are empty
+    ledger = LEDGER
+    if ledger is None:
+        return
+    if "cache_hit" in event:
+        ledger.on_cache_hit()
+    elif "cache_miss" in event:
+        ledger.on_cache_miss()
+
+
+def _on_duration(event: str, duration_secs: float, **kwargs):  # noqa: ARG001
+    ledger = LEDGER
+    if ledger is None:
+        return
+    if "backend_compile" in event:
+        # A persistent-cache hit still fires this event for the ~ms
+        # retrieval; the same-thread pending-hit flag (set by the hit
+        # event that immediately precedes it) reclassifies it.
+        pending = getattr(_TLS, "pending_hit", False)
+        _TLS.pending_hit = False
+        ledger.record_compile(duration_secs * 1e3, cache_hit=pending)
+    elif "compile_time_saved" in event:
+        ledger.on_saved(duration_secs * 1e3)
+
+
+def _register_listeners() -> bool:
+    global _LISTENERS_REGISTERED
+    if _LISTENERS_REGISTERED:
+        return True
+    try:
+        from jax import monitoring
+        monitoring.register_event_listener(_on_event)
+        monitoring.register_event_duration_secs_listener(_on_duration)
+    except Exception:  # noqa: BLE001 - fallback: wrapped-jit entry points
+        return False
+    _LISTENERS_REGISTERED = True
+    return True
+
+
+def install(service: str = "", max_records: int = 256,
+            ledger: Optional[CompileLedger] = None) -> CompileLedger:
+    """Turn the ledger on (idempotent; returns the active ledger).
+
+    When ``jax.monitoring`` is unavailable the ledger still installs —
+    engines then attribute compiles through the
+    :meth:`CompileLedger.record_compile` fallback entry point.
+    """
+    global LEDGER
+    if LEDGER is None:
+        LEDGER = ledger or CompileLedger(service=service,
+                                         max_records=max_records)
+        _register_listeners()
+    return LEDGER
+
+
+def uninstall():
+    """Null the switchboard; resident listeners become no-ops."""
+    global LEDGER
+    LEDGER = None
+
+
+# --------------------------------------------------------------------------- #
+# Persistent compilation cache wiring.
+# --------------------------------------------------------------------------- #
+
+def enable_persistent_cache(cache_dir: str,
+                            min_compile_time_secs: float = 0.0,
+                            min_entry_size_bytes: int = -1) -> str:
+    """Point JAX's persistent compilation cache at ``cache_dir``.
+
+    Per-replica opt-in (the ``compilation_cache_dir`` engine kwarg
+    routes here).  The aggressive thresholds default to "cache
+    everything" because serving programs are few and warm-restart
+    time-to-first-compiled-step is the metric that matters
+    (``SERVING.md`` warm-restart story; the loadgen A/B gates on it).
+    Returns the directory (created if missing).
+    """
+    cache_dir = str(cache_dir)
+    os.makedirs(cache_dir, exist_ok=True)
+    import jax
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                      float(min_compile_time_secs))
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes",
+                      int(min_entry_size_bytes))
+    try:
+        # jax initializes its cache singleton on first compile and
+        # ignores later config changes; reset so a mid-process enable
+        # (replica constructed after other engines compiled) works.
+        from jax.experimental.compilation_cache import compilation_cache
+        compilation_cache.reset_cache()
+    except Exception:  # noqa: BLE001 - older jax: dir read per compile
+        pass
+    return cache_dir
+
+
+def disable_persistent_cache():
+    """Un-configure the persistent cache (harness cleanup: a temp
+    cache directory must not stay configured after it is deleted)."""
+    import jax
+    jax.config.update("jax_compilation_cache_dir", None)
+    try:
+        from jax.experimental.compilation_cache import compilation_cache
+        compilation_cache.reset_cache()
+    except Exception:  # noqa: BLE001 - see enable_persistent_cache
+        pass

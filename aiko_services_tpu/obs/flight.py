@@ -16,7 +16,12 @@ self-contained **capture bundle** — a single JSON file that
   count;
 - ``counters``  — the metrics registry snapshot, the baseline snapshot
   taken at install time (so the doctor diffs them), and any attached
-  provider dicts (e.g. a server's ``stats()``).
+  provider dicts (e.g. a server's ``stats()``);
+- ``compiles``  — the compile-ledger snapshot when a ledger is
+  installed (PR 14: program/signature/wall-ms records plus cache
+  hit/miss/saved counters);
+- ``profile``   — the most recent device-profile manifest when one
+  exists (artifact paths, per-chunk device ms, annotation scheme).
 
 Every section is stamped with the SAME trace id, so bundles from
 different processes join into one fleet-wide forensic record: the
@@ -51,7 +56,7 @@ import time
 from collections import deque
 from typing import Callable, Dict, List, Optional
 
-from . import metrics, steplog, trace
+from . import compiles, metrics, profiler, steplog, trace
 
 __all__ = ["FlightRecorder", "P95DriftDetector", "FLIGHT", "install",
            "uninstall", "new_trace_id", "FORMAT_VERSION"]
@@ -204,6 +209,11 @@ class FlightRecorder:
                 "providers": providers,
             },
         }
+        if compiles.LEDGER is not None:
+            bundle["compiles"] = dict(compiles.LEDGER.snapshot(),
+                                      trace_id=trace_id)
+        if profiler.LAST is not None:
+            bundle["profile"] = dict(profiler.LAST)
 
         os.makedirs(self.out_dir, exist_ok=True)
         name = f"capture_{trigger}_{seq:04d}_{os.getpid()}.json"

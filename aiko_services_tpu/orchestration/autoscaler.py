@@ -48,7 +48,7 @@ from __future__ import annotations
 import dataclasses
 from typing import Callable, Dict, List, Optional, Tuple
 
-from ..obs import flight
+from ..obs import compiles, flight
 from ..obs.metrics import CounterDict
 from ..registry.services_cache import services_cache_create_singleton
 from ..runtime import faults
@@ -794,6 +794,12 @@ class FleetAutoscaler(Actor):
         reason = (f"slo breach streak={streak_before + 1} "
                   f"ttft_p95={snapshot.ttft_p95_ms} "
                   f"shed_delta={snapshot.shed_delta}")
+        if compiles.LEDGER is not None \
+                and compiles.LEDGER.steady_compiles:
+            # A steady-state compile storm stalls steps fleet-wide —
+            # name the prime TTFT-breach suspect in the bundle reason.
+            reason += (" steady_compiles="
+                       f"{compiles.LEDGER.steady_compiles}")
         if flight.FLIGHT is not None:
             flight.FLIGHT.capture("slo_breach", reason=reason)
         if self._router_topic is not None:

@@ -68,7 +68,7 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
-from ..obs import flight, steplog, trace
+from ..obs import compiles, flight, profiler, steplog, trace
 from ..obs.metrics import CounterDict, Histogram, REGISTRY
 from ..runtime import faults
 from ..runtime.actor import Actor
@@ -156,7 +156,8 @@ class ContinuousBatchingServer:
                  draft_params=None, spec_k: int = 4,
                  draft_quantize: bool = False, params=None,
                  max_queue: Optional[int] = None,
-                 watchdog_s: float = 0.0, replica_mesh=None):
+                 watchdog_s: float = 0.0, replica_mesh=None,
+                 compilation_cache_dir: Optional[str] = None):
         import jax
         import jax.numpy as jnp
         from ..models import llama
@@ -164,6 +165,14 @@ class ContinuousBatchingServer:
         self._jax = jax
         self._jnp = jnp
         self._llama = llama
+        # Persistent compilation cache (PR 14): opt-in per replica,
+        # wired BEFORE any jit below so the very first prefill/serve
+        # compiles land in (or load from) the cache — a warm restart
+        # then skips recompilation entirely (SERVING.md warm-restart;
+        # loadgen.run_compile_cache_ab gates cold vs warm).
+        self.compilation_cache_dir = compilation_cache_dir
+        if compilation_cache_dir:
+            compiles.enable_persistent_cache(compilation_cache_dir)
         self.config = llama.CONFIGS[config_name]
         if params is not None:
             # Caller-built weights (trained, imported, or
@@ -440,6 +449,12 @@ class ContinuousBatchingServer:
         self.watchdog_s = float(watchdog_s)
         self.healthy = True
         self._watchdog_tripped = False
+        # ---- on-demand device profiling (PR 14) ---------------------- #
+        #: measured per-step device ms from the last (profile) bracket
+        #: (None until one ran; replaces attrib's probe estimate).
+        self._device_step_ms: Optional[float] = None
+        self._profiles = 0
+        self._profile_idle = 0
 
         @jax.jit
         def merge_state(state, host_state, mask):
@@ -493,6 +508,8 @@ class ContinuousBatchingServer:
         if steplog.RECORDER is not None:
             steplog.RECORDER.record("state_upload",
                                     rows=int(self._dirty.sum()))
+        if compiles.LEDGER is not None:
+            compiles.set_label("merge_state")
         snapshot = {key: np.array(value)
                     for key, value in self._host_state().items()}
         self._state = self._merge_state(self._state, snapshot,
@@ -816,6 +833,12 @@ class ContinuousBatchingServer:
                 slots = [slot for slot, _, _ in sub]
                 prompts = np.concatenate([p for _, p, _ in sub],
                                          axis=0)
+                if compiles.LEDGER is not None:
+                    # Shape-bucket signature: any compile with a
+                    # signature OUTSIDE the pow2 grid is a bucket-
+                    # discipline breach (the ledger's log-bound test).
+                    compiles.set_label("prefill",
+                                       f"b{padded}x{len(sub)}")
                 # The prompt KV must be built under the SAME adapter
                 # the decode chunks will run (None for all-base).
                 lora = self._make_lora([aid for _, _, aid in sub])
@@ -862,6 +885,9 @@ class ContinuousBatchingServer:
 
             draft["insert"] = draft_insert
         padded = prompts.shape[1]
+        if compiles.LEDGER is not None:
+            compiles.set_label("draft_prefill",
+                               f"b{padded}x{len(slots_list)}")
         bucket = self._llama.init_cache(draft["config"],
                                         len(slots_list), padded)
         _, bucket = self._llama.prefill(
@@ -1118,6 +1144,20 @@ class ContinuousBatchingServer:
         self._evict_expired()
         self._admit()
         self._advance_prefills()
+        if profiler.PROFILER is not None \
+                and profiler.PROFILER.wants(id(self)):
+            # On-demand device profiling: the FIRST engine whose step
+            # loop sees a pending session claims it (jax.profiler is
+            # process-global) and runs its next N steps synchronously
+            # inside the trace bracket — the one step mode where we
+            # deliberately give up double-buffering, because the
+            # timed dispatch→sync window is the real device ms the
+            # attribution table wants.
+            self._profiled_step()
+            if self._watchdog_tripped:
+                self._fail_all("watchdog_stalled")
+            done, self.completed = self.completed, []
+            return done
         depth = max(2, self.lookahead)
         dispatched = False
         while len(self._ring) < depth and self._dispatch_round():
@@ -1227,6 +1267,8 @@ class ContinuousBatchingServer:
         # computed while its lane was still a scratch row, silently
         # retiring it with zero tokens.
         serial = self._slot_serial.copy()
+        if compiles.LEDGER is not None:
+            compiles.set_label("serve_chunk", f"s{steps}")
         tokens_d, counts_d, self._state = self._serve_chunk(
             self._state, steps,
             -1 if self.eos_id is None else int(self.eos_id),
@@ -1284,6 +1326,8 @@ class ContinuousBatchingServer:
         jnp, llama, draft = self._jnp, self._llama, self._draft
         k = draft["k"]
         self._sync_dirty()
+        if compiles.LEDGER is not None:
+            compiles.set_label("spec_round", f"k{k}")
         st = self._state
         lora_shared = self._serve_lora()
         lora = (dict(lora_shared, ids=st["adapter_ids"])
@@ -1510,6 +1554,74 @@ class ContinuousBatchingServer:
         while self._ring:
             self._consume_one()
 
+    # ---- on-demand device profiling (PR 14) -------------------------- #
+
+    def request_profile(self, steps: int = 4, reason: str = "",
+                        trace_id: str = "", out_dir=None) -> bool:
+        """Ask for a ``(profile)`` bracket around this process's next
+        ``steps`` engine steps.  Returns False when a session is
+        already pending (one bracket at a time per process —
+        ``jax.profiler`` is process-global)."""
+        session = profiler.request(
+            out_dir=out_dir, steps=steps, reason=reason,
+            trace_id=trace_id,
+            service=f"srv{id(self) & 0xffff:x}")
+        return session is not None
+
+    def _profiled_step(self) -> None:
+        """One SYNCHRONOUS timed chunk inside the profiler bracket:
+        drain the ring, start the trace (first pass), dispatch one
+        round and sync it, and book the dispatch→sync wall ms as that
+        chunk's device time — on a saturated device the host does
+        nothing else in that window, which is exactly the number the
+        attribution table wants in place of the probe estimate.  An
+        idle engine (nothing live to dispatch) finishes the session
+        after a bounded number of empty passes rather than holding the
+        process-global profiler hostage."""
+        session = None
+        if profiler.PROFILER is not None:
+            session = profiler.PROFILER
+        if session is None:
+            return
+        self._drain_ring()
+        if not session.ensure_started():
+            return                       # start failed; session closed
+        steps_before = self.counters["decode_steps"]
+        began = time.monotonic()
+        dispatched = self._dispatch_round()
+        self._drain_ring()
+        if dispatched:
+            self._profile_idle = 0
+            session.chunk_done(
+                (time.monotonic() - began) * 1e3,
+                int(self.counters["decode_steps"] - steps_before))
+        else:
+            self._profile_idle += 1
+        if session.remaining == 0 or self._profile_idle >= 50:
+            self._finish_profile(session)
+
+    def _finish_profile(self, session) -> None:
+        self._profile_idle = 0
+        live_ids = []
+        for request in self._requests:
+            if request is not None and request.trace_ctx:
+                context = trace.extract(request.trace_ctx)
+                if context:
+                    live_ids.append(context.trace_id)
+        manifest = session.finish(live_trace_ids=live_ids)
+        if manifest.get("steps"):
+            self._device_step_ms = manifest["device_step_ms"]
+        self._profiles += 1
+        if flight.FLIGHT is not None:
+            # Park the manifest in a bundle immediately: the artifact
+            # dir is outside the bundle ring, but the manifest (and
+            # the ledger section) ride the ring like any capture.
+            flight.FLIGHT.capture(
+                "profile",
+                trace_id=session.trace_id or None,
+                reason=manifest.get("reason", "")
+                or f"profile bracket: {manifest.get('steps', 0)} steps")
+
     def stats(self) -> Dict:
         """Serving perf counters + derived rates (dashboard payloads,
         bench sections, smoke assertions)."""
@@ -1552,6 +1664,21 @@ class ContinuousBatchingServer:
                 spec_tokens_per_target_pass=round(
                     self.spec_stats.tokens_per_target_pass, 4),
                 spec_rollback_blocks=self.spec_stats.rollback_blocks)
+        if compiles.LEDGER is not None:
+            # Compile-ledger view (PR 14): rides EC shares via
+            # TELEMETRY_KEYS so the router's steady-compile watch and
+            # the dashboard pane see it without extra plumbing.  The
+            # ledger is process-wide; a multi-engine process reports
+            # the same numbers from each engine (documented).
+            out.update(
+                compiles=compiles.LEDGER.compiles,
+                compiles_steady_state=compiles.LEDGER.steady_compiles,
+                compile_cache_hits=compiles.LEDGER.cache_hits,
+                compile_cache_misses=compiles.LEDGER.cache_misses,
+                compile_wall_ms=round(compiles.LEDGER.total_ms, 1))
+        if self._device_step_ms is not None:
+            out.update(device_step_ms=round(self._device_step_ms, 3),
+                       profiles=self._profiles)
         return out
 
     def run_until_drained(self, max_chunks: int = 10_000):

@@ -65,7 +65,7 @@ import numpy as np
 
 from ..kvstore import directory as _kvdir
 from ..kvstore import transfer as _kvxfer
-from ..obs import steplog
+from ..obs import compiles, steplog
 from ..runtime.lease import Lease
 from .continuous import ContinuousBatchingServer
 
@@ -112,7 +112,8 @@ class PagedContinuousServer(ContinuousBatchingServer):
                  spill_adopt: bool = True,
                  draft_config_name: Optional[str] = None,
                  draft_params=None, spec_k: int = 4,
-                 draft_quantize: bool = False):
+                 draft_quantize: bool = False,
+                 compilation_cache_dir: Optional[str] = None):
         self.block_size = block_size
         self._requested_blocks = total_blocks
         self.enable_prefix_cache = enable_prefix_cache
@@ -151,7 +152,8 @@ class PagedContinuousServer(ContinuousBatchingServer):
                          replica_mesh=replica_mesh,
                          draft_config_name=draft_config_name,
                          draft_params=draft_params, spec_k=spec_k,
-                         draft_quantize=draft_quantize)
+                         draft_quantize=draft_quantize,
+                         compilation_cache_dir=compilation_cache_dir)
 
     # ------------------------------------------------------------- #
     # Layout hooks
@@ -1169,6 +1171,10 @@ class PagedContinuousServer(ContinuousBatchingServer):
             size = 1 << (remaining.bit_length() - 1)
             width = size * block_size
             chunk = prompt_padded[:, start:start + width]
+            if compiles.LEDGER is not None:
+                # pow2 piece widths ⇒ log-many prefill signatures per
+                # bucket; any other width in the ledger is a breach.
+                compiles.set_label("paged_prefill", f"w{width}")
             if self._tp_engine is not None:
                 _, self.pool = self._tp_engine.prefill_append_paged(
                     self.params, jnp.asarray(chunk), self.pool,

@@ -84,6 +84,11 @@ TELEMETRY_KEYS = (
     "spec_k", "spec_rounds", "spec_proposed", "spec_accepted",
     "spec_acceptance_rate", "spec_tokens_per_target_pass",
     "spec_rollback_blocks",
+    # Compile ledger + device profiling (PR 14; present only when a
+    # CompileLedger is installed / a profile bracket ran)
+    "compiles", "compiles_steady_state", "compile_cache_hits",
+    "compile_cache_misses", "compile_wall_ms",
+    "device_step_ms", "profiles",
 )
 
 
@@ -265,8 +270,13 @@ class ReplicaRouter(Actor):
             deadline_exceeded=0, cancel_unrouted=0,
             prefix_routed=0, prefix_routed_host=0,
             prefix_routed_disk=0, kv_tier_hints=0, kv_remote_hints=0,
-            anomaly_flags=0, fleet_captures=0),
+            anomaly_flags=0, fleet_captures=0, fleet_profiles=0,
+            fleet_steady_compiles=0),
             prefix="router", labels={"actor": self.name})
+        #: replica topic path -> last compiles_steady_state broadcast;
+        #: a DELTA is a bucket-discipline breach somewhere in the
+        #: fleet — flagged as an anomaly + fleet capture (PR 14).
+        self._steady_compiles: Dict[str, int] = {}
         self.share["replicas"] = 0
         self.share["replicas_retiring"] = 0
         self.share["requests_routed"] = 0
@@ -314,6 +324,7 @@ class ReplicaRouter(Actor):
                 self._replica_state, f"{fields.topic_path}/state")
             self._loads.pop(fields.topic_path, None)
             self._replica_hists.pop(fields.topic_path, None)
+            self._steady_compiles.pop(fields.topic_path, None)
             self._unhealthy.discard(fields.topic_path)
             self._set_retiring(fields.topic_path, False)
             # A dead owner's advertised prefixes must stop attracting
@@ -354,6 +365,8 @@ class ReplicaRouter(Actor):
             self._replica_hists.setdefault(
                 replica, {})[key[len("hist."):]] = str(value)
             self._publish_fleet_latency(key[len("hist."):])
+        elif key == "compiles_steady_state":
+            self._watch_steady_compiles(replica, value)
         elif key == "healthy":
             self._set_health(replica, str(value) not in ("0", "False"))
         elif key == "lifecycle":
@@ -479,6 +492,31 @@ class ReplicaRouter(Actor):
             self.logger.warning("%s: p95 drift — %s", self.name, note)
             self.capture(trigger="anomaly", reason=note)
 
+    def _watch_steady_compiles(self, replica: str, value):
+        """Steady-state compile watch (PR 14): a replica's broadcast
+        ``compiles_steady_state`` counter MOVING means XLA compiled
+        something after that replica's warmup fence — a pow2
+        bucket-discipline regression in production.  Treated exactly
+        like p95 drift: anomaly flag, share note, fleet capture (the
+        breaching replica's bundle carries its compile ledger)."""
+        try:
+            count = int(value)
+        except (TypeError, ValueError):
+            return
+        previous = self._steady_compiles.get(replica, 0)
+        self._steady_compiles[replica] = count
+        if count <= previous:
+            return
+        self._bump("anomaly_flags")
+        self._bump("fleet_steady_compiles", by=count - previous)
+        note = (f"steady-state compile on {replica.rsplit('/', 1)[-1]}: "
+                f"+{count - previous} (total {count})")
+        self.share["last_anomaly"] = note
+        if self.ec_producer is not None:
+            self.ec_producer.update_if_changed("last_anomaly", note)
+        self.logger.warning("%s: %s", self.name, note)
+        self.capture(trigger="compile", reason=note)
+
     def capture(self, trace_id: str = "", response_topic: str = "",
                 trigger: str = "operator", reason: str = ""):
         """Router override of the actor built-in: capture locally AND
@@ -498,6 +536,26 @@ class ReplicaRouter(Actor):
                                      str(reason)
                                      or f"fleet capture via {self.name}"]))
         self._bump("fleet_captures")
+
+    def profile(self, steps: int = 4, trace_id: str = "",
+                response_topic: str = "", reason: str = ""):
+        """Router override of the ``(profile …)`` built-in: fan the
+        bracket request out to every live replica with ONE shared
+        trace id (the router itself carries no engine, so the local
+        built-in answers ``unsupported`` — the fan-out is the point).
+        Each replica's bracket finishes into its own flight bundle;
+        ``doctor`` groups the set by the shared trace id."""
+        trace_id = str(trace_id) or flight.new_trace_id()
+        super().profile(steps=steps, trace_id=trace_id,
+                        response_topic=response_topic, reason=reason)
+        for replica in list(self._replicas):
+            self.process.message.publish(
+                f"{replica}/in",
+                generate("profile", [str(steps), trace_id,
+                                     str(response_topic),
+                                     str(reason)
+                                     or f"fleet profile via {self.name}"]))
+        self._bump("fleet_profiles")
 
     # -- tracing ------------------------------------------------------ #
 

@@ -178,6 +178,33 @@ class Actor(Service):
                 str(response_topic),
                 generate("capture_response", [self.name, result]))
 
+    def profile(self, steps: int = 4, trace_id: str = "",
+                response_topic: str = "", reason: str = ""):
+        """Request an on-demand device-profile bracket:
+        ``(profile [steps] [trace_id] [response_topic] [reason])`` →
+        ``(profile_response <name> <started|busy|unsupported>)``.
+        Every actor answers; only actors carrying an engine with
+        :meth:`request_profile` (ContinuousReplica) can actually run
+        the bracket — others reply ``unsupported`` instead of
+        dropping the command (the router's fleet fan-out expects one
+        reply per process)."""
+        server = getattr(self, "server", None)
+        if server is None or not hasattr(server, "request_profile"):
+            result = "unsupported"
+        else:
+            try:
+                steps = max(1, int(steps))
+            except (TypeError, ValueError):
+                steps = 4
+            started = server.request_profile(
+                steps=steps, trace_id=str(trace_id),
+                reason=str(reason) or f"(profile) on {self.name}")
+            result = "started" if started else "busy"
+        if response_topic:
+            self.process.message.publish(
+                str(response_topic),
+                generate("profile_response", [self.name, result]))
+
     def terminate(self):
         self.stop()
 

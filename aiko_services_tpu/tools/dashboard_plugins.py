@@ -344,6 +344,23 @@ def model_replica_plugin(fields, variables) -> List[str]:
         lines.append(
             f"  flight:    {captures} capture bundles, recent: "
             f"{_get(variables, 'last_capture', default='-')}")
+    compiles = _get(variables, "compiles", default=None)
+    if compiles not in (None, "-"):
+        steady = _get(variables, "compiles_steady_state", default=0)
+        steady_note = (f", {steady} STEADY-STATE"
+                       if steady not in (None, "-", 0, "0") else "")
+        lines.append(
+            f"  compiles:  {compiles} total "
+            f"({_get(variables, 'compile_wall_ms', default=0)} ms)"
+            f"{steady_note}, cache "
+            f"{_get(variables, 'compile_cache_hits', default=0)} hit/"
+            f"{_get(variables, 'compile_cache_misses', default=0)}"
+            f" miss")
+    device_ms = _get(variables, "device_step_ms", default=None)
+    if device_ms not in (None, "-"):
+        lines.append(
+            f"  profile:   device step {device_ms} ms measured "
+            f"({_get(variables, 'profiles', default=0)} brackets)")
     return lines
 
 
@@ -394,12 +411,20 @@ def replica_router_plugin(fields, variables) -> List[str]:
     anomalies = _get(variables, "anomaly_flags", default=None)
     if anomalies not in (None, "-", 0):
         lines.append(
-            f"  anomaly:    {anomalies} p95-drift flags, "
+            f"  anomaly:    {anomalies} anomaly flags "
+            f"(p95 drift + steady-state compiles), "
             f"{_get(variables, 'fleet_captures', default=0)}"
             f" fleet captures")
         last = _get(variables, "last_anomaly", default=None)
         if last not in (None, "-", ""):
             lines.append(f"    last: {last}")
+    steady = _get(variables, "fleet_steady_compiles", default=None)
+    profiles = _get(variables, "fleet_profiles", default=None)
+    if any(value not in (None, "-", 0) for value in (steady,
+                                                     profiles)):
+        lines.append(
+            f"  compiles:   {steady or 0} steady-state across fleet, "
+            f"{profiles or 0} fleet profile fan-outs")
     return lines
 
 

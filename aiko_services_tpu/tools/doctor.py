@@ -10,8 +10,16 @@ each as a single report:
 - the **span tree**, indented parent→child with durations, filtered
   to the bundle's trace id when spans match it;
 - the **tax table** — the step-log slice run through
-  :func:`obs.attrib.attribute_steps`, so a watchdog bundle directly
-  shows where the stalled step's time went;
+  :func:`obs.attrib.attribute_steps`; when the bundle carries a
+  device-profile manifest its MEASURED ``device_step_ms`` feeds the
+  attribution (the probe estimate is only a fallback), so a watchdog
+  bundle directly shows where the stalled step's time went;
+- the **compile ledger** section (PR 14) — compile counts, cache
+  hit/miss/saved-ms, and the recent per-compile records with their
+  shape-bucket signatures (steady-state compiles flagged);
+- the **profile manifest** (PR 14) — artifact paths + sizes,
+  per-chunk device ms, and the span-annotation scheme that stitches
+  device kernels to the request span tree;
 - **counter diffs** against the recorder's install-time baseline
   (what moved since the process started flying).
 
@@ -19,10 +27,16 @@ Bundles sharing a trace id (the router's fleet fan-out) group into
 one fleet section, so "one slow request" reads as one record across
 every process that touched it.
 
+``--json`` renders the same content machine-readable: one summary
+object per bundle under a pinned schema (:data:`JSON_FORMAT`,
+``tests/test_compiles.py`` pins the keys) — the CI/scripting face of
+the same reports.
+
 Usage::
 
     python -m aiko_services_tpu.tools.doctor /tmp/flight/           # dir
     python -m aiko_services_tpu.tools.doctor capture_watchdog_*.json
+    python -m aiko_services_tpu.tools.doctor --json /tmp/flight/
 
 Host-side, stdlib + ``obs`` only — running the doctor never imports
 a backend.
@@ -42,7 +56,10 @@ from ..obs.flight import FORMAT_VERSION
 
 __all__ = ["load_bundle", "collect_paths", "span_tree_lines",
            "counter_diff_lines", "render_report", "render_fleet",
-           "main"]
+           "bundle_summary", "JSON_FORMAT", "main"]
+
+#: ``--json`` output schema version — tests pin the per-bundle keys.
+JSON_FORMAT = 1
 
 
 def load_bundle(path: str) -> Dict:
@@ -175,17 +192,61 @@ def render_report(bundle: Dict) -> str:
 
     steplog = bundle.get("steplog") or {}
     events = steplog.get("events") or []
+    profile = bundle.get("profile") or {}
+    device_step_ms = profile.get("device_step_ms") or None
     lines.append("")
     if len(events) >= 2:
         table = attrib.attribute_steps(
-            [(row[0], row[1], row[2]) for row in events])
+            [(row[0], row[1], row[2]) for row in events],
+            device_step_ms=device_step_ms)
         lines.append(table.render())
+        if device_step_ms:
+            lines.append(f"  (device_step_ms {device_step_ms:g} "
+                         f"MEASURED by the profile bracket below)")
         if steplog.get("dropped"):
             lines.append(f"  (ring dropped {steplog['dropped']} "
                          f"older rows)")
     else:
         lines.append("step log: (empty — no engine loop in this "
                      "process, or recorder off)")
+
+    compiles = bundle.get("compiles") or {}
+    if compiles:
+        lines.append("")
+        lines.append(
+            f"compile ledger: {compiles.get('compiles', 0)} compiles "
+            f"({compiles.get('compiles_steady_state', 0)} steady-state)"
+            f", cache {compiles.get('cache_hits', 0)} hit / "
+            f"{compiles.get('cache_misses', 0)} miss, "
+            f"saved {compiles.get('cache_saved_ms', 0):g} ms"
+            + (", FENCED" if compiles.get("fenced") else ""))
+        for record in (compiles.get("records") or [])[-12:]:
+            flag = "  << STEADY-STATE" if record.get("steady") else (
+                "  (cache hit)" if record.get("cache_hit") else "")
+            lines.append(
+                f"  {record.get('program', '?'):<16} "
+                f"{record.get('signature', '') or '-':<12} "
+                f"{record.get('wall_ms', 0):>9.2f} ms{flag}")
+
+    if profile:
+        lines.append("")
+        status = "ok" if profile.get("ok") else \
+            f"FAILED: {profile.get('error', '?')}"
+        lines.append(
+            f"device profile ({status}): {profile.get('steps', 0)} "
+            f"steps bracketed, device_step_ms "
+            f"{profile.get('device_step_ms', 0):g}"
+            + (f" — {profile.get('reason')}" if profile.get("reason")
+               else ""))
+        lines.append(f"  trace_dir: {profile.get('trace_dir', '?')}  "
+                     f"(annotations: "
+                     f"{profile.get('annotation_scheme', '?')})")
+        for artifact in (profile.get("artifacts") or [])[:8]:
+            lines.append(f"  artifact: {artifact.get('path', '?')} "
+                         f"({artifact.get('bytes', 0)} bytes)")
+        if profile.get("live_trace_ids"):
+            lines.append("  live requests during bracket: "
+                         + ", ".join(profile["live_trace_ids"][:6]))
 
     diff = counter_diff_lines(bundle.get("counters") or {})
     lines.append("")
@@ -205,6 +266,62 @@ def render_report(bundle: Dict) -> str:
                 f"{key}={value:g}" for key, value
                 in sorted(interesting.items())[:12]))
     return "\n".join(lines)
+
+
+def bundle_summary(bundle: Dict) -> Dict:
+    """Machine-readable per-bundle summary — the ``--json`` schema
+    (version :data:`JSON_FORMAT`; tests pin these keys)."""
+    manifest = bundle.get("manifest") or {}
+    spans = bundle.get("spans") or {}
+    steplog = bundle.get("steplog") or {}
+    events = steplog.get("events") or []
+    profile = bundle.get("profile") or {}
+    compiles = bundle.get("compiles") or {}
+    tax = None
+    if len(events) >= 2:
+        tax = attrib.attribute_steps(
+            [(row[0], row[1], row[2]) for row in events],
+            device_step_ms=profile.get("device_step_ms") or None
+        ).to_dict()
+    summary = {
+        "path": bundle.get("_path", ""),
+        "trigger": manifest.get("trigger", ""),
+        "reason": manifest.get("reason", ""),
+        "trace_id": manifest.get("trace_id", ""),
+        "service": manifest.get("service", ""),
+        "pid": manifest.get("pid", 0),
+        "captured_unix": manifest.get("captured_unix", 0.0),
+        "spans": {"count": len(spans.get("spans") or []),
+                  "matched": bool(spans.get("matched"))},
+        "steplog": {"events": len(events),
+                    "dropped": steplog.get("dropped", 0)},
+        "tax_table": tax,
+        "counters_moved": len(
+            counter_diff_lines(bundle.get("counters") or {},
+                               limit=10_000)),
+        "compiles": None,
+        "profile": None,
+    }
+    if compiles:
+        summary["compiles"] = {
+            "compiles": compiles.get("compiles", 0),
+            "compiles_steady_state":
+                compiles.get("compiles_steady_state", 0),
+            "cache_hits": compiles.get("cache_hits", 0),
+            "cache_misses": compiles.get("cache_misses", 0),
+            "cache_saved_ms": compiles.get("cache_saved_ms", 0.0),
+            "fenced": bool(compiles.get("fenced")),
+            "records": len(compiles.get("records") or []),
+        }
+    if profile:
+        summary["profile"] = {
+            "ok": bool(profile.get("ok")),
+            "steps": profile.get("steps", 0),
+            "device_step_ms": profile.get("device_step_ms", 0.0),
+            "trace_dir": profile.get("trace_dir", ""),
+            "artifacts": len(profile.get("artifacts") or []),
+        }
+    return summary
 
 
 def render_fleet(bundles: List[Dict]) -> str:
@@ -238,6 +355,9 @@ def main(argv: Optional[List[str]] = None) -> int:
                     "readable reports (grouped by trace id).")
     parser.add_argument("paths", nargs="+",
                         help="bundle files, globs, or directories")
+    parser.add_argument("--json", action="store_true",
+                        help="machine-readable summaries (pinned "
+                             "schema) instead of the text report")
     arguments = parser.parse_args(argv)
     paths = collect_paths(arguments.paths)
     if not paths:
@@ -253,7 +373,13 @@ def main(argv: Optional[List[str]] = None) -> int:
             failed += 1
     if not bundles:
         return 1
-    print(render_fleet(bundles))
+    if arguments.json:
+        print(json.dumps(
+            {"format": JSON_FORMAT,
+             "bundles": [bundle_summary(b) for b in bundles]},
+            indent=1, sort_keys=True))
+    else:
+        print(render_fleet(bundles))
     return 0 if not failed else 2
 
 

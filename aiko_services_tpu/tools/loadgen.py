@@ -35,7 +35,7 @@ __all__ = ["LoadGenerator", "LoadReport", "service_scale_sweep",
            "run_shared_prefix", "fleet_latency", "diurnal_trace",
            "elastic_chaos_schedule", "run_elastic",
            "run_elastic_chaos", "run_longtail", "run_restart",
-           "run_restart_ab", "main"]
+           "run_restart_ab", "run_compile_cache_ab", "main"]
 
 #: Per-phase latency keys the replicas stamp on responses, in report
 #: order (``kv_restore`` is the cross-replica transfer phase).
@@ -118,6 +118,23 @@ class LoadReport:
     #: Fleet speculative counters (Σ over replicas of the server
     #: ``spec_*`` stats); None when no replica runs a draft.
     spec_stats: Optional[Dict] = None
+    #: Warmup-vs-steady split (PR 14): the first-step compile tax
+    #: reported SEPARATELY from steady throughput.  ``warmup_s`` is
+    #: the harness-measured window before the compile ledger's fence
+    #: dropped; ``warmup_compiles`` is what XLA compiled inside it;
+    #: ``compiles_steady_state`` is what compiled AFTER it — the chaos
+    #: gate asserts this stays ZERO on the paged path (any steady
+    #: compile is a pow2 bucket-discipline regression).
+    warmup_s: float = 0.0
+    warmup_compiles: int = 0
+    compiles_steady_state: int = 0
+    #: Tokens/s measured over the steady window only (completed-token
+    #: throughput with the warmup window excluded from the clock);
+    #: 0.0 when the harness ran no ledger.
+    steady_tokens_per_sec: float = 0.0
+    #: Persistent compilation-cache counters over the run
+    #: (hits/misses/saved_ms; None when no ledger was installed).
+    compile_cache: Optional[Dict] = None
 
     @property
     def lost(self) -> int:
@@ -247,13 +264,25 @@ class LoadReport:
                 goodput += (f", {self.goodput_per_replica:.2f} "
                             f"req/s/replica (avg "
                             f"{self.avg_replicas:.2f})")
+        compile_note = ""
+        if self.warmup_compiles or self.compiles_steady_state:
+            compile_note = (
+                f", compiles={self.warmup_compiles} warmup"
+                f"/{self.compiles_steady_state} steady"
+                f" (warmup {self.warmup_s:.1f}s")
+            if self.steady_tokens_per_sec:
+                compile_note += (f", steady "
+                                 f"{self.steady_tokens_per_sec:.1f} "
+                                 f"tok/s")
+            compile_note += ")"
         return (f"LoadReport(sent={self.sent}, done={self.completed}, "
                 f"errors={self.errors}{kinds}, "
                 f"timeouts={self.timeouts}, "
                 f"{self.throughput_rps:.1f} req/s, "
                 f"{self.throughput_tps:.1f} tok/s, "
                 f"p50={self.p50_ms:.1f} ms, p99={self.p99_ms:.1f} ms"
-                f"{ttft}{goodput}{prefix}{kv}{tp}{attn})")
+                f"{ttft}{goodput}{prefix}{kv}{tp}{attn}"
+                f"{compile_note})")
 
 
 class LoadGenerator:
@@ -1217,6 +1246,111 @@ def run_restart_ab(n_requests: int = 18, rate_hz: float = 25.0,
     return cold, warm
 
 
+def run_compile_cache_ab(cache_dir: Optional[str] = None,
+                         prompt_len: int = 24,
+                         max_new_tokens: int = 4, seed: int = 0,
+                         config_name: str = "tiny"
+                         ) -> Tuple[LoadReport, LoadReport]:
+    """Persistent-compilation-cache A/B gate — the PR-12 warm-restart
+    gate extended to COMPILE time.  The same single-request greedy
+    decode through two freshly constructed paged engines sharing ONE
+    persistent cache directory: arm 1 COLD (empty directory — every
+    program really compiles and populates the cache), then
+    ``jax.clear_caches()`` drops the in-memory jit caches (the honest
+    in-process stand-in for a process restart), arm 2 WARM (same
+    directory — every lookup should retrieve instead of compile).
+    Asserts the warm arm strictly beats the cold arm on
+    time-to-first-compiled-step, saw > 0 persistent-cache hits, and
+    produced bit-exact greedy tokens.  Returns ``(cold, warm)``
+    LoadReports whose ``compile_cache`` dict carries the per-arm
+    ledger deltas; ``elapsed_s`` IS the time-to-first-compiled-step.
+
+    ``cache_dir=None`` (the default) uses a fresh temp directory —
+    pass a directory only if you can guarantee it starts empty, or
+    the cold arm is not cold and the gate proves nothing."""
+    import tempfile
+
+    import jax
+    import numpy as np
+
+    from ..obs import compiles
+    from ..orchestration.continuous import DecodeRequest
+    from ..orchestration.paged import PagedContinuousServer
+
+    ledger_owned = compiles.LEDGER is None
+    ledger = compiles.install(service="cache-ab")
+    rng = np.random.RandomState(seed)
+    prompt = rng.randint(1, 256, size=prompt_len).astype(np.int32)
+    tmp = None
+    if cache_dir is None:
+        tmp = tempfile.TemporaryDirectory(prefix="compile-cache-ab-")
+        cache_dir = tmp.name
+    reports = []
+    try:
+        for arm in ("cold", "warm"):
+            jax.clear_caches()
+            base = ledger.snapshot()
+            began = time.monotonic()
+            server = PagedContinuousServer(
+                config_name=config_name, slots=2, chunk_steps=4,
+                seed=0, compilation_cache_dir=cache_dir)
+            server.submit(DecodeRequest(
+                request_id=f"ab_{arm}", prompt=prompt,
+                max_new_tokens=max_new_tokens))
+            done = []
+            for _ in range(512):
+                done.extend(server.step())
+                if done:
+                    break
+            else:
+                raise AssertionError(
+                    f"cache A/B: {arm} arm request never completed")
+            ttfs_s = time.monotonic() - began
+            if done[0].error is not None:
+                raise AssertionError(
+                    f"cache A/B: {arm} arm errored: {done[0].error}")
+            after = ledger.snapshot()
+            delta = {key: after[key] - base[key]
+                     for key in ("compiles", "cache_hits",
+                                 "cache_misses")}
+            delta["cache_saved_ms"] = round(
+                after["cache_saved_ms"] - base["cache_saved_ms"], 3)
+            delta["time_to_first_step_s"] = round(ttfs_s, 4)
+            report = LoadReport(
+                sent=1, completed=1, errors=0, timeouts=0,
+                elapsed_s=ttfs_s, latencies_ms=[ttfs_s * 1e3],
+                tokens_total=len(done[0].tokens or []),
+                compile_cache=delta)
+            report.final_tokens = {
+                done[0].request_id:
+                [int(t) for t in (done[0].tokens or [])]}
+            reports.append(report)
+    finally:
+        if ledger_owned:
+            compiles.uninstall()
+        compiles.disable_persistent_cache()
+        if tmp is not None:
+            tmp.cleanup()
+    cold, warm = reports
+    cold_tokens = next(iter(cold.final_tokens.values()))
+    warm_tokens = next(iter(warm.final_tokens.values()))
+    if cold_tokens != warm_tokens:
+        raise AssertionError(
+            f"cache A/B not bit-exact (seed={seed}): a cached program "
+            f"may never change a token — cold {cold_tokens} vs warm "
+            f"{warm_tokens}")
+    if warm.compile_cache["cache_hits"] <= 0:
+        raise AssertionError(
+            "cache A/B: warm arm saw ZERO persistent-cache hits — the "
+            "cache directory wiring is dead")
+    if not warm.elapsed_s < cold.elapsed_s:
+        raise AssertionError(
+            f"cache A/B: warm restart must strictly beat cold on "
+            f"time-to-first-compiled-step, got cold "
+            f"{cold.elapsed_s:.3f}s vs warm {warm.elapsed_s:.3f}s")
+    return cold, warm
+
+
 def chaos_schedule(seed: int):
     """The canonical seeded fault schedule for ``loadgen --chaos``:
     one replica death mid-decode, streaming-increment message drops,
@@ -1248,7 +1382,9 @@ def run_chaos(seed: int = 0, n_requests: int = 40,
               restore_blocks_per_step: int = 2,
               spill_dir: Optional[str] = None,
               spill_blocks: int = 1024,
-              spec_k: int = 0) -> LoadReport:
+              spec_k: int = 0,
+              compile_gate: bool = False,
+              warmup_requests: Optional[int] = None) -> LoadReport:
     """Run an in-process 2-replica serving rig (loopback broker, real
     event engine, Registrar + router) under :func:`chaos_schedule` and
     return the LoadReport.  The invariant a chaos run checks:
@@ -1269,7 +1405,28 @@ def run_chaos(seed: int = 0, n_requests: int = 40,
     signature/lease story is per-replica), so a chaos kill lands
     mid-spill: the crash gate in tests/test_chaos.py asserts zero
     lost requests AND that a fresh server adopting the dead replica's
-    directory serves bit-exact tokens — torn writes never surface."""
+    directory serves bit-exact tokens — torn writes never surface.
+
+    ``compile_gate=True`` adds the compile-ledger steady-state gate:
+    a warmup wave of ``warmup_requests`` (default 12 = one full
+    period of the shared-prefix payload cycle, so every distinct
+    prompt shape the measured wave will send compiles once) runs
+    BEFORE the fault plan is armed, the ledger's warmup fence drops,
+    and the measured chaos wave must then record ZERO steady-state
+    compiles — a replica dying mid-decode and re-dispatching its work
+    may never cost the fleet a recompile.  Two mechanisms make that
+    true together: pow2 bucketing keeps the survivor's shapes a
+    subset of the warmed set, and the replicas SHARE one persistent
+    compilation cache directory — prefix-aware routing concentrates
+    warmup on the prefix owner, so the failover target can be
+    compile-COLD when the kill lands, and its first-touch programs
+    must come back as ~ms cache retrievals (booked as hits, never as
+    steady compiles).  The report carries the warmup/steady split
+    (``warmup_s``, ``warmup_compiles``, ``compiles_steady_state``,
+    ``steady_tokens_per_sec``)."""
+    import tempfile
+
+    from ..obs import compiles
     from ..orchestration.continuous import ContinuousReplica
     from ..orchestration.paged import PagedContinuousServer
     from ..orchestration.serving import ReplicaRouter
@@ -1285,7 +1442,20 @@ def run_chaos(seed: int = 0, n_requests: int = 40,
                 raise TimeoutError(f"chaos rig: {what}")
             time.sleep(0.02)
 
-    plan = faults.install(chaos_schedule(seed))
+    warmup_began = time.time()
+    ledger = None
+    ledger_owned = False
+    cache_tmp = None
+    if compile_gate:
+        ledger_owned = compiles.LEDGER is None
+        ledger = compiles.install(service="chaos-gate")
+        cache_tmp = tempfile.TemporaryDirectory(
+            prefix="chaos-compile-cache-")
+    # The fault plan arms AFTER the warmup wave when gating compiles —
+    # warmup pumps must not consume the schedule's nth counters, or
+    # the kill would land mid-warmup instead of mid-measured-decode.
+    plan = faults.install(chaos_schedule(seed)) \
+        if not compile_gate else None
     engine = EventEngine()
     thread = engine.run_in_thread()
     broker = f"chaos-{uuid.uuid4().hex[:6]}"
@@ -1317,7 +1487,9 @@ def run_chaos(seed: int = 0, n_requests: int = 40,
                            if spill_dir else None),
                 spill_blocks=spill_blocks,
                 draft_config_name="tiny" if spec_k else None,
-                spec_k=spec_k or 4)
+                spec_k=spec_k or 4,
+                compilation_cache_dir=(cache_tmp.name if cache_tmp
+                                       else None))
             if spec_k:
                 # Kill-mid-spec-round coverage: greedy determinism +
                 # idempotent replay must hold through rejected-tail
@@ -1343,6 +1515,18 @@ def run_chaos(seed: int = 0, n_requests: int = 40,
                 n_conversations=3, turns=4, system_len=32,
                 seed=seed),
             rate_hz=rate_hz)
+        warmup_s = 0.0
+        warmup_compiles = 0
+        if compile_gate:
+            # One full payload period: every distinct prompt the
+            # measured wave will send compiles (or cache-hits) here.
+            generator.run(12 if warmup_requests is None
+                          else int(warmup_requests),
+                          drain_timeout_s=drain_timeout_s)
+            warmup_compiles = ledger.compiles
+            ledger.fence()
+            warmup_s = time.time() - warmup_began
+            plan = faults.install(chaos_schedule(seed))
         report = generator.run(n_requests,
                                drain_timeout_s=drain_timeout_s)
         totals = _fleet_kv_stats(servers)
@@ -1355,6 +1539,22 @@ def run_chaos(seed: int = 0, n_requests: int = 40,
             router.counters, **totals,
             replicas_live=router.share["replicas"],
             faults_fired=len(plan.fired))
+        if compile_gate:
+            report.warmup_s = round(warmup_s, 3)
+            report.warmup_compiles = warmup_compiles
+            report.compiles_steady_state = ledger.steady_compiles
+            if report.elapsed_s > 0:
+                report.steady_tokens_per_sec = round(
+                    report.tokens_total / report.elapsed_s, 2)
+            if ledger.steady_compiles:
+                offenders = sorted({
+                    (entry["program"], entry["signature"])
+                    for entry in ledger.snapshot()["records"]
+                    if entry["steady"]})
+                raise AssertionError(
+                    f"chaos compile gate: {ledger.steady_compiles} "
+                    f"steady-state compile(s) after the warmup fence "
+                    f"— pow2 bucket discipline regressed: {offenders}")
         return report
     finally:
         faults.uninstall()
@@ -1367,6 +1567,13 @@ def run_chaos(seed: int = 0, n_requests: int = 40,
                 pass           # already killed this process
         engine.terminate()
         thread.join(timeout=5)
+        if ledger is not None:
+            ledger.lift_fence()
+            if ledger_owned:
+                compiles.uninstall()
+        if cache_tmp is not None:
+            compiles.disable_persistent_cache()
+            cache_tmp.cleanup()
 
 
 def run_spec_ab(spec_k: int = 4, n_requests: int = 24,

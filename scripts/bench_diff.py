@@ -1,0 +1,263 @@
+#!/usr/bin/env python
+"""Diff two bench-section captures; gate CI on regressions.
+
+``bench.py --sections --jsonl`` appends one JSON object per section to
+a ``BENCH_SECTIONS_*.jsonl`` capture::
+
+    {"section": "kv_transfer", "ok": true,
+     "result": {"kv_transfer_bf16_512_mb_per_sec": 77.9, ...},
+     "elapsed_s": 12.3, "ts": 1722800000.0}
+
+This tool compares two such captures metric by metric so a perf change
+is a REVIEWABLE diff instead of two walls of numbers::
+
+    python scripts/bench_diff.py BENCH_SECTIONS_r06.jsonl new.jsonl
+    python scripts/bench_diff.py old.jsonl new.jsonl --fail-on-regress 10
+
+Rules (deliberately boring):
+
+* Last entry per section wins — a capture may re-run a section
+  (``serving_tp`` appears 4x in the r06 capture); the re-run is the
+  one the author kept.
+* Metric DIRECTION is inferred from the name: throughput-ish names
+  (``*_per_sec``, ``*_rps``, ``*tok_s*``, ``*hit_rate*``, …) are
+  higher-is-better; latency/overhead-ish names (``*_ms``, ``*_s``,
+  ``*ratio*``, ``*overhead*``, …) are lower-is-better; anything else
+  (sizes, counts) is informational and can never fail the gate.
+* ``--fail-on-regress PCT`` exits 1 when any directional metric moved
+  the WRONG way by more than ``PCT`` percent, or a section that was
+  ``ok`` in the old capture is failed/missing in the new one.
+  Improvements and new sections/metrics never fail the gate.
+* ``--check-schema`` is the CI self-test (``scripts/ci_checks.sh``):
+  validates the checked-in captures parse and conform, then asserts a
+  capture diffed against itself reports zero regressions.
+
+Stdlib-only on purpose — runs in a bare pre-commit environment.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import sys
+from typing import Dict, List, Optional, Tuple
+
+#: Substrings marking a metric higher-is-better.  Checked BEFORE the
+#: lower-is-better suffixes so ``..._per_sec`` is not caught by ``_s``.
+HIGHER_BETTER = ("per_sec", "_rps", "tok_s", "tokens_per", "hit_rate",
+                 "hits", "accept", "throughput", "speedup",
+                 "mb_per", "gb_per")
+
+#: Suffix/substring cues for lower-is-better metrics.
+LOWER_BETTER_SUFFIX = ("_ms", "_s", "_us", "_ns")
+LOWER_BETTER_SUBSTR = ("ratio", "overhead", "p50", "p95", "p99",
+                       "latency", "stall", "_miss")
+
+#: Relative moves under this are treated as noise, not a verdict.
+NOISE_FLOOR_PCT = 1.0
+
+
+def metric_direction(name: str) -> int:
+    """+1 higher-is-better, -1 lower-is-better, 0 informational."""
+    lowered = name.lower()
+    if any(cue in lowered for cue in HIGHER_BETTER):
+        return 1
+    if lowered.endswith(LOWER_BETTER_SUFFIX) \
+            or any(cue in lowered for cue in LOWER_BETTER_SUBSTR):
+        return -1
+    return 0
+
+
+def load_sections(path: pathlib.Path) -> Dict[str, Dict]:
+    """section name -> last entry (the re-run wins)."""
+    sections: Dict[str, Dict] = {}
+    for lineno, line in enumerate(
+            path.read_text().splitlines(), start=1):
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            entry = json.loads(line)
+        except json.JSONDecodeError as error:
+            raise SystemExit(
+                f"{path}:{lineno}: not JSON: {error}") from error
+        if not isinstance(entry, dict) or "section" not in entry:
+            raise SystemExit(
+                f"{path}:{lineno}: entry without a 'section' key")
+        sections[str(entry["section"])] = entry
+    return sections
+
+
+def numeric_result(entry: Dict) -> Dict[str, float]:
+    result = entry.get("result")
+    if not isinstance(result, dict):
+        return {}
+    return {key: float(value) for key, value in result.items()
+            if isinstance(value, (int, float))
+            and not isinstance(value, bool)}
+
+
+class Delta:
+    """One metric's movement between captures."""
+
+    __slots__ = ("section", "metric", "old", "new", "pct", "direction")
+
+    def __init__(self, section: str, metric: str,
+                 old: float, new: float):
+        self.section = section
+        self.metric = metric
+        self.old = old
+        self.new = new
+        self.pct: Optional[float] = (
+            (new - old) / abs(old) * 100.0 if old else None)
+        self.direction = metric_direction(metric)
+
+    @property
+    def verdict(self) -> str:
+        if self.direction == 0 or self.pct is None:
+            return "info"
+        if abs(self.pct) < NOISE_FLOOR_PCT:
+            return "~"
+        improved = (self.pct > 0) == (self.direction > 0)
+        return "improved" if improved else "REGRESSED"
+
+    def regressed_by(self) -> float:
+        """Magnitude (pct) of the wrong-way move; 0.0 otherwise."""
+        return abs(self.pct) if self.verdict == "REGRESSED" else 0.0
+
+
+def diff_captures(old: Dict[str, Dict], new: Dict[str, Dict],
+                  only: Optional[List[str]] = None
+                  ) -> Tuple[List[Delta], List[str]]:
+    """Returns ``(metric deltas, section-level problems)``."""
+    deltas: List[Delta] = []
+    problems: List[str] = []
+    for section in sorted(old):
+        if only and section not in only:
+            continue
+        if not old[section].get("ok"):
+            continue       # a failed baseline proves nothing
+        if section not in new:
+            problems.append(f"section {section!r}: ok in old capture, "
+                            f"MISSING from new capture")
+            continue
+        if not new[section].get("ok"):
+            problems.append(
+                f"section {section!r}: ok in old capture, FAILED in "
+                f"new: {new[section].get('error', '?')}")
+            continue
+        old_metrics = numeric_result(old[section])
+        new_metrics = numeric_result(new[section])
+        for metric in sorted(old_metrics):
+            if metric in new_metrics:
+                deltas.append(Delta(section, metric,
+                                    old_metrics[metric],
+                                    new_metrics[metric]))
+    return deltas, problems
+
+
+def render(deltas: List[Delta], problems: List[str],
+           regress_only: bool = False) -> str:
+    lines = []
+    for problem in problems:
+        lines.append(f"!! {problem}")
+    section = None
+    for delta in deltas:
+        if regress_only and delta.verdict != "REGRESSED":
+            continue
+        if delta.section != section:
+            section = delta.section
+            lines.append(f"[{section}]")
+        pct = ("     n/a" if delta.pct is None
+               else f"{delta.pct:+8.1f}%")
+        lines.append(f"  {delta.metric:<48} {delta.old:>12g} ->"
+                     f" {delta.new:>12g}  {pct}  {delta.verdict}")
+    if not lines:
+        lines.append("(no overlapping metrics)")
+    return "\n".join(lines)
+
+
+def check_schema(paths: List[pathlib.Path]) -> int:
+    """CI self-test: captures parse, conform, and self-diff clean."""
+    if not paths:
+        repo = pathlib.Path(__file__).resolve().parent.parent
+        paths = sorted(repo.glob("BENCH_SECTIONS_*.jsonl"))
+    if not paths:
+        print("bench_diff --check-schema: no captures found",
+              file=sys.stderr)
+        return 1
+    for path in paths:
+        sections = load_sections(path)
+        for name, entry in sections.items():
+            if "ok" not in entry:
+                print(f"{path}: section {name!r} has no 'ok' key",
+                      file=sys.stderr)
+                return 1
+            if entry["ok"] and not isinstance(entry.get("result"),
+                                              dict):
+                print(f"{path}: ok section {name!r} has no result "
+                      f"dict", file=sys.stderr)
+                return 1
+        deltas, problems = diff_captures(sections, sections)
+        regressed = [d for d in deltas if d.regressed_by() > 0]
+        if problems or regressed:
+            print(f"{path}: self-diff not clean: "
+                  f"{problems or regressed}", file=sys.stderr)
+            return 1
+        print(f"bench_diff: {path.name}: {len(sections)} sections, "
+              f"{len(deltas)} metrics, self-diff clean")
+    return 0
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        description="Diff two BENCH_SECTIONS_*.jsonl captures")
+    parser.add_argument("old", nargs="?", help="baseline capture")
+    parser.add_argument("new", nargs="?", help="candidate capture")
+    parser.add_argument("--section", action="append", default=None,
+                        help="restrict to SECTION (repeatable)")
+    parser.add_argument("--fail-on-regress", type=float, default=None,
+                        metavar="PCT",
+                        help="exit 1 when any directional metric "
+                             "regresses by more than PCT percent")
+    parser.add_argument("--regress-only", action="store_true",
+                        help="print only regressed metrics")
+    parser.add_argument("--check-schema", action="store_true",
+                        help="validate checked-in captures instead of "
+                             "diffing (CI self-test)")
+    args = parser.parse_args(argv)
+
+    if args.check_schema:
+        paths = [pathlib.Path(p) for p in
+                 filter(None, (args.old, args.new))]
+        return check_schema(paths)
+    if not args.old or not args.new:
+        parser.error("need OLD and NEW captures (or --check-schema)")
+    old = load_sections(pathlib.Path(args.old))
+    new = load_sections(pathlib.Path(args.new))
+    deltas, problems = diff_captures(old, new, only=args.section)
+    print(render(deltas, problems, regress_only=args.regress_only))
+    worst = max([d.regressed_by() for d in deltas], default=0.0)
+    regressed = [d for d in deltas if d.regressed_by() > 0]
+    print(f"-- {len(deltas)} metrics compared, "
+          f"{len(regressed)} regressed (worst {worst:.1f}%), "
+          f"{len(problems)} section problem(s)")
+    if args.fail_on_regress is not None:
+        over = [d for d in deltas
+                if d.regressed_by() > args.fail_on_regress]
+        if problems or over:
+            for delta in over:
+                print(f"FAIL: {delta.section}.{delta.metric} "
+                      f"regressed {delta.regressed_by():.1f}% "
+                      f"(> {args.fail_on_regress:g}%)",
+                      file=sys.stderr)
+            return 1
+    return 0
+
+
+if __name__ == "__main__":
+    try:
+        raise SystemExit(main())
+    except BrokenPipeError:      # `bench_diff ... | head` is fine
+        raise SystemExit(0) from None

@@ -1,0 +1,30 @@
+#!/usr/bin/env bash
+# Fast pre-merge checks: the static sweeps plus the observability
+# tier-1 guards.  Cheap by construction (~a minute on CPU) — the full
+# tier-1 run stays `python -m pytest tests/ -q -m 'not slow'`
+# (ROADMAP.md); this script is what a pre-commit hook or a PR bot can
+# afford to run on every push.
+#
+#   scripts/ci_checks.sh            # everything
+#   scripts/ci_checks.sh --static   # AST sweeps + schema only (no jax)
+set -euo pipefail
+
+cd "$(dirname "$0")/.."
+
+echo "== obs_lint: switchboard guards + jit-dir purity =="
+python scripts/obs_lint.py
+
+echo "== bench_diff: checked-in capture schema self-test =="
+python scripts/bench_diff.py --check-schema
+
+if [[ "${1:-}" == "--static" ]]; then
+    echo "ci_checks: static checks OK (skipped pytest guards)"
+    exit 0
+fi
+
+echo "== tier-1 obs guards (jaxpr purity, ledger, flight, doctor) =="
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m pytest -q \
+    -m 'not slow' -p no:cacheprovider \
+    tests/test_obs.py tests/test_compiles.py tests/test_flight.py
+
+echo "ci_checks: OK"

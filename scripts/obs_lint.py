@@ -33,7 +33,8 @@ PKG = REPO / "aiko_services_tpu"
 
 #: module alias → switchboard attribute (the nullable singletons).
 SWITCHBOARDS = {"trace": "TRACER", "steplog": "RECORDER",
-                "flight": "FLIGHT"}
+                "flight": "FLIGHT", "compiles": "LEDGER",
+                "profiler": "PROFILER"}
 
 #: Guarded-site modules: every switchboard access in these files must
 #: sit under the ``is not None`` guard.
@@ -52,7 +53,8 @@ SITE_MODULES: Tuple[pathlib.Path, ...] = (
 JIT_DIRS: Tuple[pathlib.Path, ...] = (PKG / "ops", PKG / "models")
 
 #: obs submodule names a jitted module must never import directly.
-OBS_MODULE_NAMES = ("trace", "steplog", "metrics", "flight", "attrib")
+OBS_MODULE_NAMES = ("trace", "steplog", "metrics", "flight", "attrib",
+                    "compiles", "profiler")
 
 
 def is_switchboard_usage(node) -> bool:
