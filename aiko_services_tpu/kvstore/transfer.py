@@ -95,6 +95,7 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
+from ..obs import pool_audit
 from .directory import HEX_KEY_CHARS, chain_keys, shareable_blocks
 
 __all__ = ["pool_signature", "export_payload", "import_payload",
@@ -674,6 +675,11 @@ def import_payload(server, payload: Dict, engine=None,
     if needed > len(server._free):
         return 0
     blocks = [server._free.pop() for _ in range(needed)]
+    if pool_audit.AUDITOR is not None:
+        # The accountant's HBM inflow for imported blocks — their
+        # tier-out happened on the exporting peer, not here.
+        pool_audit.AUDITOR.flow("alloc", needed,
+                                needed * server._block_nbytes())
     queue_async = bool(async_import) and engine is not None \
         and hasattr(server, "_queue_import")
     if not queue_async:
@@ -760,6 +766,9 @@ def seed_chain(server, tokens, adapter_id: int = 0) -> int:
         if not server._free:
             break
         block = server._free.pop()
+        if pool_audit.AUDITOR is not None:
+            pool_audit.AUDITOR.flow("alloc", 1,
+                                    server._block_nbytes())
         server._index[key] = block
         server._block_key[block] = key
         server._refs[block] = 0

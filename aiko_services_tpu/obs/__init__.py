@@ -49,14 +49,24 @@ Two more close the loop on the DEVICE side (ISSUE 14):
   TensorBoard-loadable artifact whose manifest rides the next flight
   bundle.
 
+One closes the loop on pool MEMORY (ISSUE 15):
+
+* :mod:`.pool_audit` — the KV memory accountant + online cross-tier
+  auditor: byte-exact per-chain, per-tier attribution of every paged
+  pool block (``aiko_kv_bytes{tier=}`` gauges, integrable tier-flow
+  counters) and a sweep that reconciles the pool's internal
+  accounting against ground truth, firing a ``pool_audit`` flight
+  capture on any violation.  Feeds the ``(census)`` operator command
+  and the fleet memory pane.
+
 Import discipline: ``obs`` modules import nothing from the rest of the
 package (stdlib only; ``jax`` strictly lazily), so every layer —
 transport, runtime, orchestration, tools — may depend on them without
 cycles, and ``ops/`` + ``models/`` must not import them at all.
 """
 
-from . import (attrib, compiles, flight, metrics, profiler,  # noqa: F401
-               steplog, trace)
+from . import (attrib, compiles, flight, metrics,  # noqa: F401
+               pool_audit, profiler, steplog, trace)
 
-__all__ = ["attrib", "compiles", "flight", "metrics", "profiler",
-           "steplog", "trace"]
+__all__ = ["attrib", "compiles", "flight", "metrics", "pool_audit",
+           "profiler", "steplog", "trace"]

@@ -21,7 +21,9 @@ self-contained **capture bundle** — a single JSON file that
   installed (PR 14: program/signature/wall-ms records plus cache
   hit/miss/saved counters);
 - ``profile``   — the most recent device-profile manifest when one
-  exists (artifact paths, per-chunk device ms, annotation scheme).
+  exists (artifact paths, per-chunk device ms, annotation scheme);
+- ``census``    — the pool auditor's snapshot when one is installed
+  (PR 15: per-tier KV census, flow integrals, audit violations).
 
 Every section is stamped with the SAME trace id, so bundles from
 different processes join into one fleet-wide forensic record: the
@@ -31,9 +33,10 @@ with a shared trace id, and each process dumps *around* it.
 Triggers wired elsewhere in the stack (all guarded, invariant 7/14):
 watchdog trip (`continuous._trip_watchdog`), SLO-breach streak
 (`autoscaler._tick`), fault-injection fire (`faults.FaultPlan.check`),
-process exit (``capture_on_exit``), operator ``(capture …)`` command
-(an `Actor` built-in), and the router's p95-drift anomaly detector
-(:class:`P95DriftDetector` below).
+process exit (``capture_on_exit``), operator ``(capture …)`` and
+``(census …)`` commands (`Actor` built-ins), pool-audit violations
+(`pool_audit.PoolAuditor.sweep`), and the router's p95-drift anomaly
+detector (:class:`P95DriftDetector` below).
 
 **Zero-cost discipline**: module-level :data:`FLIGHT` is ``None`` by
 default; every call site guards with ``flight.FLIGHT is not None``
@@ -56,7 +59,7 @@ import time
 from collections import deque
 from typing import Callable, Dict, List, Optional
 
-from . import compiles, metrics, profiler, steplog, trace
+from . import compiles, metrics, pool_audit, profiler, steplog, trace
 
 __all__ = ["FlightRecorder", "P95DriftDetector", "FLIGHT", "install",
            "uninstall", "new_trace_id", "FORMAT_VERSION"]
@@ -84,8 +87,8 @@ class FlightRecorder:
     ``service``        name stamped into the manifest (defaults to
                        ``pid<pid>`` like the tracer);
     ``max_bundles``    oldest bundle files beyond this are deleted;
-    ``min_interval_s`` per-trigger rate limit (operator captures are
-                       exempt — a human asked);
+    ``min_interval_s`` per-trigger rate limit (operator and census
+                       captures are exempt — a human asked);
     ``capture_on_exit`` register an ``atexit`` "exit" capture.
     """
 
@@ -138,7 +141,8 @@ class FlightRecorder:
         now_mono = time.monotonic()
         with self._lock:
             last = self._last_capture.get(trigger)
-            if (trigger != "operator" and last is not None
+            if (trigger not in ("operator", "census")
+                    and last is not None
                     and now_mono - last < self.min_interval_s):
                 return None
             self._last_capture[trigger] = now_mono
@@ -214,6 +218,9 @@ class FlightRecorder:
                                       trace_id=trace_id)
         if profiler.LAST is not None:
             bundle["profile"] = dict(profiler.LAST)
+        if pool_audit.AUDITOR is not None:
+            bundle["census"] = dict(pool_audit.AUDITOR.snapshot(),
+                                    trace_id=trace_id)
 
         os.makedirs(self.out_dir, exist_ok=True)
         name = f"capture_{trigger}_{seq:04d}_{os.getpid()}.json"

@@ -65,7 +65,8 @@ import numpy as np
 
 from ..kvstore import directory as _kvdir
 from ..kvstore import transfer as _kvxfer
-from ..obs import compiles, steplog
+from ..obs import compiles, pool_audit, steplog
+from ..runtime import faults as _faults
 from ..runtime.lease import Lease
 from .continuous import ContinuousBatchingServer
 
@@ -299,6 +300,8 @@ class PagedContinuousServer(ContinuousBatchingServer):
         self._spill: "OrderedDict[bytes, dict]" = OrderedDict()
         self._adopted_keys: set = set()
         self._evict_clock = 0
+        #: Cached per-block HBM byte size (obs/pool_audit.py census).
+        self._block_bytes_cache: Optional[int] = None
         self.kv_spills = 0
         self.kv_disk_bytes = 0
         self.kv_disk_restores = 0
@@ -360,8 +363,136 @@ class PagedContinuousServer(ContinuousBatchingServer):
             kv_prefetch_promotions=self.kv_prefetch_promotions,
             free_blocks=self.free_blocks,
             total_blocks=self.total_blocks,
+            kv_hbm_blocks=self.total_blocks - len(self._free),
+            kv_hbm_bytes=(self.total_blocks - len(self._free))
+            * self._block_nbytes(),
         )
+        if pool_audit.AUDITOR is not None:
+            out.update(
+                kv_audit_sweeps=pool_audit.AUDITOR.sweeps,
+                kv_audit_violations=pool_audit.AUDITOR
+                .violations_total,
+            )
         return out
+
+    # ------------------------------------------------------------- #
+    # Memory accountant (obs/pool_audit.py): ground-truth census +
+    # tier-flow hooks.  ALL host-side bookkeeping; nothing below
+    # runs inside, or changes, a traced program (jaxpr + AST pinned
+    # in tests/test_pool_audit.py).
+
+    def _block_nbytes(self) -> int:
+        """HBM bytes one pool block holds across every layer field.
+        Host rows are gathered at full kv-head width in the pool's
+        native dtype, so a demoted block's ``nbytes`` equals this —
+        the equality the census's per-tier byte math leans on."""
+        if self._block_bytes_cache is None:
+            self._block_bytes_cache = sum(
+                row_bytes for _field, _shape, _dtype, row_bytes
+                in _kvxfer._field_layout(self))
+        return self._block_bytes_cache
+
+    def _flow(self, name: str, blocks: int,
+              nbytes: Optional[int] = None) -> None:
+        """Book one tier flow with the accountant (no-op pointer test
+        when the auditor is uninstalled).  ``nbytes`` defaults to the
+        HBM block size — host/disk sites pass their entry's bytes."""
+        if pool_audit.AUDITOR is not None:
+            if nbytes is None:
+                nbytes = int(blocks) * self._block_nbytes()
+            pool_audit.AUDITOR.flow(name, int(blocks), int(nbytes))
+
+    def pool_census(self, max_records: int = 64) -> dict:
+        """Byte-exact ground-truth pool census across the tier tower
+        (the memory accountant's source of truth).  A host-side dict
+        walk only — no device sync, safe to call from the ``(census)``
+        wire command while the engine serves.  ``blocks`` carries up
+        to ``max_records`` per-block attribution records (owner chain
+        key, depth, tier, bytes, refcount, pin/producing/RESTORING
+        state, adapter-seeded flag); the tier and state totals are
+        always exact regardless of the cap."""
+        block_bytes = self._block_nbytes()
+        used = self.total_blocks - len(self._free)
+        producing = restoring = 0
+        for owner in self._producing.values():
+            if owner == RESTORING:
+                restoring += 1
+            else:
+                producing += 1
+        pinned = evictable = 0
+        for block in self._block_key:
+            if block in self._producing:
+                continue
+            if self._refs.get(block, 0):
+                pinned += 1
+            else:
+                evictable += 1
+        private = sum(1 for blocks in self._owned for block in blocks
+                      if block not in self._block_key)
+        records = []
+        for block, key in self._block_key.items():
+            if len(records) >= max_records:
+                break
+            owner = self._producing.get(block)
+            state = ("restoring" if owner == RESTORING
+                     else "producing" if owner is not None
+                     else "pinned" if self._refs.get(block, 0)
+                     else "evictable")
+            records.append(dict(
+                block=block, tier="hbm",
+                key=key.hex()[:_kvdir.HEX_KEY_CHARS],
+                depth=self._depth.get(key, 0), bytes=block_bytes,
+                refs=self._refs.get(block, 0), state=state,
+                adapter=bool(self._key_seed.get(key, 0))))
+        for tier, store in (("host", self._host),
+                            ("disk", self._spill)):
+            for key, entry in store.items():
+                if len(records) >= max_records:
+                    break
+                records.append(dict(
+                    tier=tier, key=key.hex()[:_kvdir.HEX_KEY_CHARS],
+                    depth=self._depth.get(key, 0),
+                    bytes=int(entry["nbytes"]), refs=0, state=tier,
+                    clock=int(entry.get("clock", 0)),
+                    adopted=key in self._adopted_keys,
+                    adapter=bool(self._key_seed.get(key, 0))))
+        try:
+            dtype = next(iter(_kvxfer._field_layout(self)))[2].name
+        except StopIteration:
+            dtype = ""
+        return dict(
+            ts=time.time(), dtype=dtype, block_bytes=block_bytes,
+            total_blocks=self.total_blocks,
+            evict_clock=self._evict_clock,
+            restore_queue_depth=len(self._restoring),
+            adopted_chains=len(self._adopted_keys),
+            tiers=dict(
+                hbm=dict(blocks=used, bytes=used * block_bytes),
+                host=dict(blocks=len(self._host),
+                          bytes=int(self.kv_host_bytes)),
+                disk=dict(blocks=len(self._spill),
+                          bytes=int(self.kv_disk_bytes))),
+            states=dict(free=len(self._free), private=private,
+                        producing=producing, restoring=restoring,
+                        pinned=pinned, evictable=evictable,
+                        host=len(self._host), disk=len(self._spill)),
+            blocks=records)
+
+    def _pool_fault_check(self) -> None:
+        """Pool-accounting corruption faults (``leak_block`` /
+        ``skew_refcount``): deliberately unbalance the bookkeeping
+        WITHOUT touching any row a request reads — serving stays
+        bit-exact, and only the pool auditor can tell anything
+        happened (the detection tests lean on exactly that)."""
+        params = _faults.PLAN.check("leak_block", key="paged_pool")
+        if params is not None and self._free:
+            self._free.pop()          # no owner registered: a leak
+        params = _faults.PLAN.check("skew_refcount", key="paged_pool")
+        if params is not None:
+            for block in self._block_key:
+                self._refs[block] = self._refs.get(block, 0) \
+                    + int(params.get("by", 2))
+                break
 
     def _attention_blocks(self):
         # Real pool geometry: the kernel walks the slot's block table.
@@ -439,6 +570,7 @@ class PagedContinuousServer(ContinuousBatchingServer):
                 del self._children[parent]
         self._children.pop(key, None)
         self._free.append(block)
+        self._flow("free", 1)
 
     def _evict_one(self) -> bool:
         """Evict ONE zero-ref cached block: the least-recently-used
@@ -514,6 +646,7 @@ class PagedContinuousServer(ContinuousBatchingServer):
         self._host[key] = entry
         self.kv_demotions += 1
         self.kv_host_bytes += entry["nbytes"]
+        self._flow("demote", 1, entry["nbytes"])
         self._host_overflow()
 
     def _host_overflow(self) -> None:
@@ -535,10 +668,12 @@ class PagedContinuousServer(ContinuousBatchingServer):
              and self._key_seed.get(key, 0) == 0])
         for key, entry in excess:
             if key in spilled:
-                self._spill[key] = {"nbytes": entry["nbytes"]}
+                self._spill[key] = {"nbytes": entry["nbytes"],
+                                    "clock": entry.get("clock", 0)}
                 self.kv_host_bytes -= entry["nbytes"]
                 self.kv_spills += 1
                 self.kv_disk_bytes += entry["nbytes"]
+                self._flow("spill", 1, entry["nbytes"])
             else:
                 self._purge_host_entry(key, entry)
         while len(self._spill) > self.spill_blocks:
@@ -571,6 +706,7 @@ class PagedContinuousServer(ContinuousBatchingServer):
         is the true eviction the tier deferred."""
         self.kv_host_bytes -= entry["nbytes"]
         self.prefix_evictions += 1
+        self._flow("purge_host", 1, entry["nbytes"])
         self._purge_tier_identity(key)
 
     def _purge_spill_entry(self, key, meta) -> None:
@@ -582,6 +718,7 @@ class PagedContinuousServer(ContinuousBatchingServer):
         self.kv_disk_bytes -= meta["nbytes"]
         self._adopted_keys.discard(key)
         self.prefix_evictions += 1
+        self._flow("purge_disk", 1, meta["nbytes"])
         self._purge_tier_identity(key)
 
     def _purge_tier_identity(self, key) -> None:
@@ -605,12 +742,14 @@ class PagedContinuousServer(ContinuousBatchingServer):
         entry = self._host.pop(key, None)
         if entry is not None:
             self.kv_host_bytes -= entry["nbytes"]
+            self._flow("discard_host", 1, entry["nbytes"])
         meta = self._spill.pop(key, None)
         if meta is not None:
             self.kv_disk_bytes -= meta["nbytes"]
             self._adopted_keys.discard(key)
             if self.spill is not None:
                 self.spill.discard(key.hex())
+            self._flow("discard_disk", 1, meta["nbytes"])
 
     def _spill_rows(self, key) -> Optional[Dict]:
         """Checksum-verified rows of a spilled block, reconstructed in
@@ -663,6 +802,9 @@ class PagedContinuousServer(ContinuousBatchingServer):
         self.kv_disk_bytes -= meta["nbytes"]
         self._adopted_keys.discard(key)
         self.spill.discard(key.hex())
+        # No flow booked here: the destination decides it —
+        # _begin_restore books disk_restore (landed in HBM) or
+        # disk_to_host (promotion could not fit).
         return {"rows": rows, "nbytes": meta["nbytes"]}
 
     def _adopt_spill(self) -> None:
@@ -706,9 +848,11 @@ class PagedContinuousServer(ContinuousBatchingServer):
             if parent_hex in adopted:
                 self._parent[key] = bytes.fromhex(parent_hex)
             nbytes = int(meta.get("nbytes", 0))
-            self._spill[key] = {"nbytes": nbytes}
+            self._spill[key] = {"nbytes": nbytes,
+                                "clock": int(meta.get("clock", 0))}
             self.kv_disk_bytes += nbytes
             self._adopted_keys.add(key)
+            self._flow("adopt", 1, nbytes)
             self._evict_clock = max(self._evict_clock,
                                     int(meta.get("clock", 0)))
             if depth == 1:
@@ -791,18 +935,28 @@ class PagedContinuousServer(ContinuousBatchingServer):
         fits = needed <= len(self._free)
         blocks = [self._free.pop() for _ in range(needed)] \
             if fits else []
+        if fits:
+            self._flow("alloc", needed)
         for block in shared:
             self._refs[block] -= 1
             if self._refs[block] == 0:
                 self._evictable[self._block_key[block]] = block
         if not fits:
             for position, key, entry in segment:
+                # A failed promotion re-enters the host tier WARM (it
+                # was just requested): a fresh clock tick both defers
+                # its next overflow and keeps host insertion order
+                # clock-ascending (the auditor's tower-monotonicity
+                # check leans on that ordering).
+                self._evict_clock += 1
+                entry["clock"] = self._evict_clock
                 self._host[key] = entry
                 if entry.pop("src", None) == "disk":
                     # The disk bytes were consumed by _take_spill: the
                     # rows now live in the host tier instead (and may
                     # re-spill on its next overflow).
                     self.kv_host_bytes += entry["nbytes"]
+                    self._flow("disk_to_host", 1, entry["nbytes"])
             self._host_overflow()
             return False
         for (position, key, entry), block in zip(segment, blocks):
@@ -818,6 +972,9 @@ class PagedContinuousServer(ContinuousBatchingServer):
             src = entry.get("src")
             if src != "disk":
                 self.kv_host_bytes -= entry["nbytes"]
+                self._flow("restore", 1, entry["nbytes"])
+            else:
+                self._flow("disk_restore", 1, entry["nbytes"])
             self._restoring.append(dict(key=key, block=block,
                                         rows=entry["rows"],
                                         group=None, src=src))
@@ -886,7 +1043,14 @@ class PagedContinuousServer(ContinuousBatchingServer):
         # Restores land BEFORE admission so a deferred head request
         # adopts freshly landed chains this very step.
         self._advance_restores()
-        return super().step()
+        if _faults.PLAN is not None:
+            self._pool_fault_check()
+        out = super().step()
+        # Audit sweep AFTER the dispatch: the auditor reads a settled
+        # post-step pool (host-side only; see obs/pool_audit.py).
+        if pool_audit.AUDITOR is not None:
+            pool_audit.AUDITOR.maybe_sweep(self)
+        return out
 
     def _select_victims(self, want: int) -> List:
         """Leaf-first LRU victim selection WITHOUT touching the
@@ -1027,6 +1191,8 @@ class PagedContinuousServer(ContinuousBatchingServer):
             return False
         self._evict_until(private_needed)
         private = [self._free.pop() for _ in range(private_needed)]
+        if private:
+            self._flow("alloc", len(private))
         blocks = shared + private
         self._owned[slot] = blocks
         self._pending_shared[slot] = len(shared)
@@ -1299,10 +1465,12 @@ class PagedContinuousServer(ContinuousBatchingServer):
                     self._purge_cached(key, block)
                 else:
                     self._free.append(block)
+                    self._flow("free", 1)
                 continue
             key = self._block_key.get(block)
             if key is None:
                 self._free.append(block)        # plain private block
+                self._flow("free", 1)
                 continue
             self._refs[block] -= 1
             if self._refs[block] == 0:

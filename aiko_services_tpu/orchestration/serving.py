@@ -89,6 +89,10 @@ TELEMETRY_KEYS = (
     "compiles", "compiles_steady_state", "compile_cache_hits",
     "compile_cache_misses", "compile_wall_ms",
     "device_step_ms", "profiles",
+    # Memory accountant + pool auditor (PR 15; kv_hbm_* always on a
+    # paged server, audit counters only when an AUDITOR is installed)
+    "kv_hbm_blocks", "kv_hbm_bytes",
+    "kv_audit_sweeps", "kv_audit_violations",
 )
 
 
@@ -271,12 +275,23 @@ class ReplicaRouter(Actor):
             prefix_routed=0, prefix_routed_host=0,
             prefix_routed_disk=0, kv_tier_hints=0, kv_remote_hints=0,
             anomaly_flags=0, fleet_captures=0, fleet_profiles=0,
-            fleet_steady_compiles=0),
+            fleet_steady_compiles=0, fleet_censuses=0,
+            fleet_audit_violations=0),
             prefix="router", labels={"actor": self.name})
         #: replica topic path -> last compiles_steady_state broadcast;
         #: a DELTA is a bucket-discipline breach somewhere in the
         #: fleet — flagged as an anomaly + fleet capture (PR 14).
         self._steady_compiles: Dict[str, int] = {}
+        #: replica topic path -> {kv_hbm_bytes, kv_host_bytes,
+        #: kv_disk_bytes} parsed off EC broadcasts; folded into
+        #: ``fleet_kv_<tier>_bytes`` share keys for the dashboard's
+        #: fleet memory pane (PR 15).
+        self._replica_memory: Dict[str, Dict[str, int]] = {}
+        #: replica topic path -> last kv_audit_violations broadcast;
+        #: a DELTA means a replica's pool auditor caught the
+        #: accountant disagreeing with ground truth — anomaly + fleet
+        #: capture, exactly like a steady-state compile.
+        self._audit_violations: Dict[str, int] = {}
         self.share["replicas"] = 0
         self.share["replicas_retiring"] = 0
         self.share["requests_routed"] = 0
@@ -325,6 +340,9 @@ class ReplicaRouter(Actor):
             self._loads.pop(fields.topic_path, None)
             self._replica_hists.pop(fields.topic_path, None)
             self._steady_compiles.pop(fields.topic_path, None)
+            self._audit_violations.pop(fields.topic_path, None)
+            if self._replica_memory.pop(fields.topic_path, None):
+                self._publish_fleet_memory()
             self._unhealthy.discard(fields.topic_path)
             self._set_retiring(fields.topic_path, False)
             # A dead owner's advertised prefixes must stop attracting
@@ -367,6 +385,15 @@ class ReplicaRouter(Actor):
             self._publish_fleet_latency(key[len("hist."):])
         elif key == "compiles_steady_state":
             self._watch_steady_compiles(replica, value)
+        elif key in ("kv_hbm_bytes", "kv_host_bytes", "kv_disk_bytes"):
+            try:
+                self._replica_memory.setdefault(
+                    replica, {})[key] = int(value)
+            except (TypeError, ValueError):
+                return
+            self._publish_fleet_memory()
+        elif key == "kv_audit_violations":
+            self._watch_audit_violations(replica, value)
         elif key == "healthy":
             self._set_health(replica, str(value) not in ("0", "False"))
         elif key == "lifecycle":
@@ -468,6 +495,24 @@ class ReplicaRouter(Actor):
             if self.ec_producer is not None:
                 self.ec_producer.update_if_changed(key, value)
 
+    # -- fleet memory (merged replica census digests) ----------------- #
+
+    def _publish_fleet_memory(self):
+        """Fold every replica's broadcast per-tier KV byte counters
+        into ``fleet_kv_<tier>_bytes`` share keys — the live fleet
+        memory pane.  Sums are exact because each replica's numbers
+        come from its memory accountant (PR 15), not a sample."""
+        totals = {"kv_hbm_bytes": 0, "kv_host_bytes": 0,
+                  "kv_disk_bytes": 0}
+        for digest in self._replica_memory.values():
+            for key in totals:
+                totals[key] += int(digest.get(key, 0))
+        for key, value in totals.items():
+            share_key = f"fleet_{key}"
+            self.share[share_key] = value
+            if self.ec_producer is not None:
+                self.ec_producer.update_if_changed(share_key, value)
+
     # -- anomaly detection & fleet capture ---------------------------- #
 
     def _anomaly_tick(self):
@@ -517,6 +562,32 @@ class ReplicaRouter(Actor):
         self.logger.warning("%s: %s", self.name, note)
         self.capture(trigger="compile", reason=note)
 
+    def _watch_audit_violations(self, replica: str, value):
+        """Pool-audit watch (PR 15): a replica's broadcast
+        ``kv_audit_violations`` counter MOVING means its online
+        auditor caught the memory accountant disagreeing with pool
+        ground truth — invariant 16 held (serving is unaffected) but
+        the books are wrong somewhere.  Treated exactly like p95
+        drift: anomaly flag, share note, fleet capture (the breaching
+        replica's bundle carries its full census)."""
+        try:
+            count = int(value)
+        except (TypeError, ValueError):
+            return
+        previous = self._audit_violations.get(replica, 0)
+        self._audit_violations[replica] = count
+        if count <= previous:
+            return
+        self._bump("anomaly_flags")
+        self._bump("fleet_audit_violations", by=count - previous)
+        note = (f"pool audit violation on {replica.rsplit('/', 1)[-1]}: "
+                f"+{count - previous} (total {count})")
+        self.share["last_anomaly"] = note
+        if self.ec_producer is not None:
+            self.ec_producer.update_if_changed("last_anomaly", note)
+        self.logger.warning("%s: %s", self.name, note)
+        self.capture(trigger="pool_audit", reason=note)
+
     def capture(self, trace_id: str = "", response_topic: str = "",
                 trigger: str = "operator", reason: str = ""):
         """Router override of the actor built-in: capture locally AND
@@ -556,6 +627,25 @@ class ReplicaRouter(Actor):
                                      str(reason)
                                      or f"fleet profile via {self.name}"]))
         self._bump("fleet_profiles")
+
+    def census(self, trace_id: str = "", response_topic: str = "",
+               reason: str = ""):
+        """Router override of the ``(census …)`` built-in: snapshot
+        locally (the router carries no pool, so its bundle documents
+        the fleet counters) AND fan the command out to every live
+        replica with ONE shared trace id — each replica dumps its
+        pool census into its own bundle, and ``tools/doctor.py``
+        groups the set back into one fleet memory report."""
+        trace_id = str(trace_id) or flight.new_trace_id()
+        super().census(trace_id=trace_id,
+                       response_topic=response_topic, reason=reason)
+        for replica in list(self._replicas):
+            self.process.message.publish(
+                f"{replica}/in",
+                generate("census", [trace_id, str(response_topic),
+                                    str(reason)
+                                    or f"fleet census via {self.name}"]))
+        self._bump("fleet_censuses")
 
     # -- tracing ------------------------------------------------------ #
 

@@ -205,6 +205,40 @@ class Actor(Service):
                 str(response_topic),
                 generate("profile_response", [self.name, result]))
 
+    def census(self, trace_id: str = "", response_topic: str = "",
+               reason: str = ""):
+        """Dump a KV pool census into a flight capture bundle:
+        ``(census [trace_id] [response_topic] [reason])`` →
+        ``(census_response <name> <path|uninstalled|suppressed>)``.
+        Every actor answers — a process with a paged engine snapshots
+        its pool (``pool_census``) into the auditor's accountant
+        first, so the bundle's ``census`` section carries byte-exact
+        per-tier attribution; processes without one (the router
+        itself) still dump a bundle on the shared trace id, keeping
+        the fleet fan-out one-reply-per-process like ``(capture)``.
+        No recorder installed → reply says so; never an error."""
+        from ..obs import flight, pool_audit
+        server = getattr(self, "server", None)
+        if pool_audit.AUDITOR is not None and server is not None \
+                and hasattr(server, "pool_census"):
+            try:
+                pool_audit.AUDITOR.observe_census(
+                    server.pool_census())
+            except Exception:  # noqa: BLE001 - census stays passive
+                self.logger.exception("%s: pool census failed",
+                                      self.name)
+        if flight.FLIGHT is not None:
+            path = flight.FLIGHT.capture(
+                "census", trace_id=str(trace_id) or None,
+                reason=str(reason) or f"(census) on {self.name}")
+            result = path or "suppressed"
+        else:
+            result = "uninstalled"
+        if response_topic:
+            self.process.message.publish(
+                str(response_topic),
+                generate("census_response", [self.name, result]))
+
     def terminate(self):
         self.stop()
 

@@ -49,6 +49,17 @@ point               effect at the wired site
 ``slow_disk``       ...sleeps ``ms=`` milliseconds inside the spill
                     write/read path — a saturated or dying device; the
                     admission walk must keep deferring, not block.
+``leak_block``      :class:`~..orchestration.paged
+                    .PagedContinuousServer` pops one block off the
+                    free list with NO owner registered — a classic
+                    pool leak.  Serving is untouched (the block just
+                    goes missing); the pool auditor's partition check
+                    must catch it within one sweep.
+``skew_refcount``   ...bumps one cached block's refcount by ``by=``
+                    (default 2) without a matching owner — the
+                    use-after-free precursor.  Again invisible to
+                    serving; the auditor's reachable-readers check
+                    is what must trip.
 ==================  =====================================================
 
 Zero-cost when disabled: every site guards with ``if faults.PLAN is
@@ -87,7 +98,8 @@ __all__ = ["FaultPlan", "FAULT_POINTS", "PLAN", "install", "uninstall",
 FAULT_POINTS = ("kill_replica", "drop_message", "delay_message",
                 "stall_step", "expire_lease", "corrupt_response",
                 "fail_spawn", "slow_start", "corrupt_disk_block",
-                "disk_full", "slow_disk")
+                "disk_full", "slow_disk", "leak_block",
+                "skew_refcount")
 
 
 @dataclasses.dataclass

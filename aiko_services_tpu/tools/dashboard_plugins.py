@@ -90,6 +90,59 @@ def _get_hist(variables: Dict, phase: str):
         return None
 
 
+def _fmt_bytes(value) -> str:
+    try:
+        nbytes = int(value)
+    except (TypeError, ValueError):
+        return str(value)
+    if nbytes >= 1024 * 1024:
+        return f"{nbytes / (1024 * 1024):.2f} MiB"
+    if nbytes >= 1024:
+        return f"{nbytes / 1024:.1f} KiB"
+    return f"{nbytes} B"
+
+
+def _memory_pane(variables: Dict) -> List[str]:
+    """The replica's KV memory pane — ONE table for all three tiers,
+    fed by the memory accountant's per-tier counters (PR 15; the
+    scattered ``kv tier:`` / ``kv disk:`` lines folded here).  Renders
+    only when tiering telemetry is present at all."""
+    host_blocks = _get(variables, "kv_host_blocks", default=None)
+    hbm_blocks = _get(variables, "kv_hbm_blocks", default=None)
+    if host_blocks in (None, "-") and hbm_blocks in (None, "-"):
+        return []
+    lines = ["", "  kv memory (accountant):",
+             f"    {'tier':<6} {'blocks':>8} {'bytes':>12}  flows"]
+    lines.append(
+        f"    {'hbm':<6} {hbm_blocks if hbm_blocks not in (None, '-') else 0:>8} "
+        f"{_fmt_bytes(_get(variables, 'kv_hbm_bytes', default=0)):>12}")
+    lines.append(
+        f"    {'host':<6} {host_blocks or 0:>8} "
+        f"{_fmt_bytes(_get(variables, 'kv_host_bytes', default=0)):>12}"
+        f"  {_get(variables, 'kv_demotions', default=0)} demoted / "
+        f"{_get(variables, 'kv_restores', default=0)} restored, "
+        f"{_get(variables, 'restore_queue_depth', default=0)}"
+        f" restoring, "
+        f"{_get(variables, 'prefix_hits_host', default=0)} hits")
+    lines.append(
+        f"    {'disk':<6} "
+        f"{_get(variables, 'kv_disk_blocks', default=0):>8} "
+        f"{_fmt_bytes(_get(variables, 'kv_disk_bytes', default=0)):>12}"
+        f"  {_get(variables, 'kv_spills', default=0)} spilled / "
+        f"{_get(variables, 'kv_disk_restores', default=0)} restored, "
+        f"{_get(variables, 'kv_adopted_chains', default=0)} adopted, "
+        f"{_get(variables, 'kv_checksum_failures', default=0)}"
+        f" checksum fails")
+    sweeps = _get(variables, "kv_audit_sweeps", default=None)
+    if sweeps not in (None, "-"):
+        violations = _get(variables, "kv_audit_violations", default=0)
+        flag = "  << VIOLATIONS" if violations not in (
+            None, "-", 0, "0") else ""
+        lines.append(f"    audit: {sweeps} sweeps, "
+                     f"{violations} violations{flag}")
+    return lines
+
+
 #: Bar width for the slowest-requests phase breakdown.
 _BAR_CELLS = 20
 _PHASE_ORDER = ("queue", "kv_restore", "prefill", "decode")
@@ -253,34 +306,7 @@ def model_replica_plugin(fields, variables) -> List[str]:
                 f"{_get(variables, 'kv_transfer_ms', default=0)} ms, "
                 f"{_get(variables, 'kv_transfer_failures', default=0)}"
                 f" failed")
-        host_blocks = _get(variables, "kv_host_blocks", default=None)
-        demotions = _get(variables, "kv_demotions", default=None)
-        if host_blocks not in (None, "-") or \
-                demotions not in (None, "-", 0):
-            lines.append(
-                f"  kv tier:   {host_blocks or 0} host blocks "
-                f"({_get(variables, 'kv_host_bytes', default=0)} B), "
-                f"{demotions or 0} demoted / "
-                f"{_get(variables, 'kv_restores', default=0)}"
-                f" restored, "
-                f"{_get(variables, 'restore_queue_depth', default=0)}"
-                f" restoring, "
-                f"{_get(variables, 'prefix_hits_host', default=0)}"
-                f" host hits")
-        disk_blocks = _get(variables, "kv_disk_blocks", default=None)
-        spills = _get(variables, "kv_spills", default=None)
-        if disk_blocks not in (None, "-") or \
-                spills not in (None, "-", 0):
-            lines.append(
-                f"  kv disk:   {disk_blocks or 0} blocks "
-                f"({_get(variables, 'kv_disk_bytes', default=0)} B), "
-                f"{spills or 0} spilled / "
-                f"{_get(variables, 'kv_disk_restores', default=0)}"
-                f" restored, "
-                f"{_get(variables, 'kv_adopted_chains', default=0)}"
-                f" adopted, "
-                f"{_get(variables, 'kv_checksum_failures', default=0)}"
-                f" checksum fails")
+        lines += _memory_pane(variables)
         spec_rounds = _get(variables, "spec_rounds", default=None)
         if spec_rounds not in (None, "-"):
             lines.append(
@@ -384,18 +410,39 @@ def replica_router_plugin(fields, variables) -> List[str]:
         lines.append(f"  cancels:    {unrouted} unrouted")
     directory = _get(variables, "kv_directory_size", default=None)
     if directory not in (None, "-"):
-        routed_host = _get(variables, "prefix_routed_host", default=0)
-        routed = _get(variables, "prefix_routed", default=0)
-        try:
-            hbm_routed = int(routed) - int(routed_host)
-        except (TypeError, ValueError):
-            hbm_routed = routed
         lines.append(
             f"  kv dir:     {directory} advertised blocks, "
-            f"{routed}"
-            f" prefix-routed ({hbm_routed} hbm / {routed_host} host), "
             f"{_get(variables, 'kv_remote_hints', default=0)}"
             f" transfer hints")
+    # Fleet memory pane (PR 15): per-tier byte totals folded from
+    # every replica's accountant broadcast, plus the prefix-routing
+    # hbm/host split that used to live on the kv dir line.
+    fleet_hbm = _get(variables, "fleet_kv_hbm_bytes", default=None)
+    routed = _get(variables, "prefix_routed", default=None)
+    if fleet_hbm not in (None, "-") or routed not in (None, "-", 0):
+        lines += ["", "  fleet kv memory (summed accountants):"]
+        lines.append(
+            f"    hbm {_fmt_bytes(_get(variables, 'fleet_kv_hbm_bytes', default=0))}"
+            f" / host "
+            f"{_fmt_bytes(_get(variables, 'fleet_kv_host_bytes', default=0))}"
+            f" / disk "
+            f"{_fmt_bytes(_get(variables, 'fleet_kv_disk_bytes', default=0))}")
+        routed_host = _get(variables, "prefix_routed_host", default=0)
+        try:
+            hbm_routed = int(routed or 0) - int(routed_host)
+        except (TypeError, ValueError):
+            hbm_routed = routed or 0
+        lines.append(
+            f"    routed: {routed or 0} prefix-routed "
+            f"({hbm_routed} hbm / {routed_host} host / "
+            f"{_get(variables, 'prefix_routed_disk', default=0)} disk)")
+        censuses = _get(variables, "fleet_censuses", default=None)
+        audit = _get(variables, "fleet_audit_violations", default=None)
+        if censuses not in (None, "-", 0) or \
+                audit not in (None, "-", 0):
+            lines.append(
+                f"    audit:  {censuses or 0} census fan-outs, "
+                f"{audit or 0} fleet audit violations")
     fleet_lines = []
     for phase in ("ttft", "total") + _PHASE_ORDER:
         p50 = _get(variables, f"fleet_{phase}_p50_ms", default=None)
@@ -412,7 +459,7 @@ def replica_router_plugin(fields, variables) -> List[str]:
     if anomalies not in (None, "-", 0):
         lines.append(
             f"  anomaly:    {anomalies} anomaly flags "
-            f"(p95 drift + steady-state compiles), "
+            f"(p95 drift, steady compiles, pool audits), "
             f"{_get(variables, 'fleet_captures', default=0)}"
             f" fleet captures")
         last = _get(variables, "last_anomaly", default=None)

@@ -20,12 +20,17 @@ each as a single report:
 - the **profile manifest** (PR 14) — artifact paths + sizes,
   per-chunk device ms, and the span-annotation scheme that stitches
   device kernels to the request span tree;
+- the **pool census** (PR 15) — the memory accountant's per-tier
+  block/byte table, flow integrals, audit sweep/violation counters,
+  and the auditor's last violation list, so a ``pool_audit`` capture
+  reads as "what the books said vs what the pool held";
 - **counter diffs** against the recorder's install-time baseline
   (what moved since the process started flying).
 
 Bundles sharing a trace id (the router's fleet fan-out) group into
 one fleet section, so "one slow request" reads as one record across
-every process that touched it.
+every process that touched it; census-carrying fleet groups get a
+fleet memory total line summing every process's tiers.
 
 ``--json`` renders the same content machine-readable: one summary
 object per bundle under a pinned schema (:data:`JSON_FORMAT`,
@@ -55,8 +60,8 @@ from ..obs import attrib
 from ..obs.flight import FORMAT_VERSION
 
 __all__ = ["load_bundle", "collect_paths", "span_tree_lines",
-           "counter_diff_lines", "render_report", "render_fleet",
-           "bundle_summary", "JSON_FORMAT", "main"]
+           "counter_diff_lines", "census_lines", "render_report",
+           "render_fleet", "bundle_summary", "JSON_FORMAT", "main"]
 
 #: ``--json`` output schema version — tests pin the per-bundle keys.
 JSON_FORMAT = 1
@@ -164,6 +169,48 @@ def counter_diff_lines(counters: Dict, limit: int = 40) -> List[str]:
     return lines
 
 
+# -- pool census -------------------------------------------------------------- #
+
+def _mib(nbytes) -> str:
+    return f"{int(nbytes) / (1024 * 1024):.2f} MiB"
+
+
+def census_lines(census: Dict) -> List[str]:
+    """Render one bundle's ``census`` section (the auditor snapshot):
+    per-tier occupancy table, flow integrals, state histogram, audit
+    counters, and the most recent violations."""
+    lines = [
+        f"pool census: {census.get('sweeps', 0)} audit sweeps, "
+        f"{census.get('violations_total', 0)} violations"]
+    snap = census.get("census") or {}
+    tiers = snap.get("tiers") or {}
+    integrated = census.get("integrated_bytes") or {}
+    if tiers:
+        lines.append(f"  {'tier':<6} {'blocks':>8} {'bytes':>14} "
+                     f"{'flow integral':>14}")
+        for tier in ("hbm", "host", "disk"):
+            info = tiers.get(tier, {})
+            lines.append(
+                f"  {tier:<6} {int(info.get('blocks', 0)):>8} "
+                f"{_mib(info.get('bytes', 0)):>14} "
+                f"{_mib(integrated.get(tier, 0)):>14}")
+    states = snap.get("states") or {}
+    if states:
+        lines.append("  states: " + ", ".join(
+            f"{state}={count}" for state, count
+            in sorted(states.items()) if count))
+    flows = census.get("flows") or {}
+    moved = {name: entry for name, entry in flows.items()
+             if entry.get("blocks")}
+    if moved:
+        lines.append("  flows:  " + ", ".join(
+            f"{name}={entry['blocks']}" for name, entry
+            in sorted(moved.items())))
+    for violation in (census.get("last_violations") or [])[:8]:
+        lines.append(f"  VIOLATION: {violation}")
+    return lines
+
+
 # -- report ------------------------------------------------------------------- #
 
 def render_report(bundle: Dict) -> str:
@@ -248,6 +295,11 @@ def render_report(bundle: Dict) -> str:
             lines.append("  live requests during bracket: "
                          + ", ".join(profile["live_trace_ids"][:6]))
 
+    census = bundle.get("census") or {}
+    if census:
+        lines.append("")
+        lines.extend(census_lines(census))
+
     diff = counter_diff_lines(bundle.get("counters") or {})
     lines.append("")
     if diff:
@@ -301,6 +353,7 @@ def bundle_summary(bundle: Dict) -> Dict:
                                limit=10_000)),
         "compiles": None,
         "profile": None,
+        "census": None,
     }
     if compiles:
         summary["compiles"] = {
@@ -320,6 +373,17 @@ def bundle_summary(bundle: Dict) -> Dict:
             "device_step_ms": profile.get("device_step_ms", 0.0),
             "trace_dir": profile.get("trace_dir", ""),
             "artifacts": len(profile.get("artifacts") or []),
+        }
+    census = bundle.get("census") or {}
+    if census:
+        snap = census.get("census") or {}
+        summary["census"] = {
+            "sweeps": census.get("sweeps", 0),
+            "violations_total": census.get("violations_total", 0),
+            "last_violations": len(census.get("last_violations")
+                                   or []),
+            "tiers": {tier: dict(info) for tier, info
+                      in (snap.get("tiers") or {}).items()},
         }
     return summary
 
@@ -341,6 +405,21 @@ def render_fleet(bundles: List[Dict]) -> str:
                 b["manifest"].get("service", "?") for b in group))
             sections.append(f"\n### fleet capture {trace_id} "
                             f"({len(group)} processes: {services})")
+            totals = {"hbm": 0, "host": 0, "disk": 0}
+            carrying = 0
+            for bundle in group:
+                tiers = ((bundle.get("census") or {}).get("census")
+                         or {}).get("tiers") or {}
+                if tiers:
+                    carrying += 1
+                    for tier in totals:
+                        totals[tier] += int(
+                            tiers.get(tier, {}).get("bytes", 0))
+            if carrying:
+                sections.append(
+                    f"fleet memory ({carrying} censuses): " + ", ".join(
+                        f"{tier} {_mib(totals[tier])}"
+                        for tier in ("hbm", "host", "disk")))
         for bundle in sorted(
                 group, key=lambda b: b["manifest"].get(
                     "captured_unix", 0.0)):

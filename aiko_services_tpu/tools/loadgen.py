@@ -135,6 +135,37 @@ class LoadReport:
     #: Persistent compilation-cache counters over the run
     #: (hits/misses/saved_ms; None when no ledger was installed).
     compile_cache: Optional[Dict] = None
+    #: tier -> high-water-mark bytes over the run, from the memory
+    #: accountant's flow-integrated occupancy (PR 15) — a TRUE peak,
+    #: not an end-of-run sample.  Empty when no ``pool_audit.AUDITOR``
+    #: was installed for the run.
+    peak_kv_bytes: Dict[str, int] = dataclasses.field(
+        default_factory=dict)
+    #: End-of-run pool census from the (first paged) server —
+    #: ``PagedContinuousServer.pool_census()``; None on non-paged
+    #: fleets.  :meth:`pool_census` renders it.
+    census: Optional[Dict] = None
+
+    def pool_census(self) -> str:
+        """Readable end-of-run memory summary: per-tier blocks/bytes
+        (with the run's peak when the accountant tracked one) plus the
+        pool state histogram."""
+        if not self.census:
+            return "(no pool census attached)"
+        lines = [f"{'tier':<6}{'blocks':>9}{'bytes':>13}{'peak':>13}"]
+        for tier in ("hbm", "host", "disk"):
+            info = self.census.get("tiers", {}).get(tier, {})
+            peak = self.peak_kv_bytes.get(tier)
+            lines.append(
+                f"{tier:<6}{int(info.get('blocks', 0)):>9}"
+                f"{int(info.get('bytes', 0)):>13}"
+                f"{peak if peak is not None else '-':>13}")
+        states = self.census.get("states", {})
+        if states:
+            lines.append("states: " + ", ".join(
+                f"{state}={count}" for state, count
+                in sorted(states.items()) if count))
+        return "\n".join(lines)
 
     @property
     def lost(self) -> int:
@@ -730,6 +761,24 @@ def _attach_kv_rates(report: LoadReport, totals: Dict) -> None:
     report.kv_transfer_bytes = totals["kv_transfer_bytes"]
 
 
+def _attach_pool_census(report: LoadReport, servers) -> None:
+    """Attach the end-of-run pool census (first paged server) and,
+    when a memory accountant is installed, the flow-integrated per-tier
+    peak bytes (PR 15)."""
+    for server in servers:
+        if hasattr(server, "pool_census"):
+            try:
+                report.census = server.pool_census()
+            except Exception:  # noqa: BLE001 - census is best-effort
+                pass
+            break
+    from ..obs import pool_audit
+    if pool_audit.AUDITOR is not None:
+        report.peak_kv_bytes = {
+            tier: entry["bytes"] for tier, entry
+            in pool_audit.AUDITOR.accountant.peak.items()}
+
+
 def _fleet_spec_stats(servers) -> Optional[Dict]:
     """Σ the per-replica speculative counters (None when no replica
     runs a draft).  Rates are recomputed from the summed raw counts —
@@ -852,6 +901,7 @@ def run_shared_prefix(n_requests: int = 24, rate_hz: float = 50.0,
                                drain_timeout_s=drain_timeout_s)
         totals = _fleet_kv_stats(servers)
         _attach_kv_rates(report, totals)
+        _attach_pool_census(report, servers)
         report.fleet_latency_ms = fleet_latency(servers)
         report.final_tokens = dict(generator.final_tokens)
         report.spec_stats = _fleet_spec_stats(servers)
@@ -1008,6 +1058,7 @@ def run_longtail(n_requests: int = 36, rate_hz: float = 25.0,
                                drain_timeout_s=drain_timeout_s)
         totals = _fleet_kv_stats([server])
         _attach_kv_rates(report, totals)
+        _attach_pool_census(report, [server])
         report.fleet_latency_ms = fleet_latency([server])
         report.server_stats = dict(router.counters, **totals)
         return report

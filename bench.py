@@ -1783,6 +1783,88 @@ def bench_kv_tier(chain_tokens=2048, longtail_requests=36,
     return results
 
 
+def bench_kv_census(block_counts=(1_000, 10_000), chain_tokens=256,
+                    fill=0.6, iters=5):
+    """Memory-accountant observability cost (PR 15): the census
+    snapshot walk and the auditor's full reconciliation sweep at 1k
+    and 10k live pool blocks.  Host-side dict walks only — no model
+    compiles — so the numbers bound what a ``(census)`` wire command
+    or a background sweep costs a serving engine.  Gates: every sweep
+    reconciles with ZERO violations, and the accountant's
+    flow-integrated occupancy equals the live census exactly."""
+    import numpy as np
+    from aiko_services_tpu.kvstore import seed_chain
+    from aiko_services_tpu.obs import pool_audit
+    from aiko_services_tpu.orchestration.paged import \
+        PagedContinuousServer
+
+    results = {}
+    blocks_per_chain = chain_tokens // 16
+    max_seq = -(-(chain_tokens + 64) // 16) * 16
+    for total in block_counts:
+        label = (f"{total // 1000}k" if total % 1000 == 0
+                 else str(total))
+        installed = pool_audit.AUDITOR is None
+        auditor = pool_audit.install(
+            service=f"bench_census_{label}") if installed \
+            else pool_audit.AUDITOR
+        try:
+            server = PagedContinuousServer(
+                config_name="tiny", slots=2, max_seq=max_seq,
+                enable_prefix_cache=True, total_blocks=total,
+                host_tier_blocks=total // 4,
+                restore_blocks_per_step=16)
+            rng = np.random.RandomState(0)
+            chains = max(1, int(total * fill) // blocks_per_chain)
+            for index in range(chains):
+                tokens = rng.randint(
+                    1, 1024, size=chain_tokens + 1).astype(np.int32)
+                seed_chain(server, tokens)
+            # Demote a slice so the census covers the host tier too.
+            while len(server._host) < total // 10 \
+                    and server._evict_one():
+                pass
+            used = server.total_blocks - len(server._free)
+
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                census = server.pool_census()
+            snapshot_ms = (time.perf_counter() - t0) * 1e3 / iters
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                server.pool_census(max_records=total)
+            full_ms = (time.perf_counter() - t0) * 1e3 / iters
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                violations = auditor.sweep(server)
+            sweep_ms = (time.perf_counter() - t0) * 1e3 / iters
+            assert not violations, violations
+            if installed:
+                # Accountant live since before server construction:
+                # the flow integral must equal the census exactly.
+                integrated = \
+                    auditor.accountant.occupancy_from_flows("blocks")
+                assert integrated["hbm"] == \
+                    census["tiers"]["hbm"]["blocks"], \
+                    (integrated, census["tiers"])
+
+            results[f"kv_census_{label}_blocks"] = used
+            results[f"kv_census_{label}_snapshot_ms"] = round(
+                snapshot_ms, 3)
+            results[f"kv_census_{label}_snapshot_full_ms"] = round(
+                full_ms, 3)
+            results[f"kv_census_{label}_sweep_ms"] = round(sweep_ms, 3)
+            results[f"kv_census_{label}_violations"] = len(
+                violations or [])
+            log(f"kv_census[{label}]: {used} blocks, snapshot "
+                f"{snapshot_ms:.2f} ms (full {full_ms:.2f} ms), "
+                f"sweep {sweep_ms:.2f} ms")
+        finally:
+            if installed:
+                pool_audit.uninstall()
+    return results
+
+
 def _raw_decode_tps(config_name, slots, max_seq, block_size,
                     chunk_steps, quantize_kv, n_chunks=8):
     """Bare paged decode throughput: ``serve_chunk_paged`` chained
@@ -2614,6 +2696,13 @@ SECTIONS = [
      (lambda: bench_kv_tier(chain_tokens=256, longtail_requests=10,
                             longtail_warmup=6, restart_requests=8))
      if SMOKE else bench_kv_tier),
+    # Memory-accountant observability cost (PR 15): census snapshot +
+    # full audit sweep at 1k/10k live blocks, with the zero-violation
+    # and flow-integration-exactness gates inline.  Pure host-side
+    # dict walks (no model compiles), CPU-capable.
+    ("kv_census", 300,
+     (lambda: bench_kv_census(block_counts=(1_000,), iters=2))
+     if SMOKE else bench_kv_census),
     # Tensor-parallel replica serving: TP degree sweep on the paged
     # server (virtual CPU mesh off-TPU, real mesh on TPU) + the
     # cross-degree greedy exactness bit + engine-vs-raw-decode ratio.

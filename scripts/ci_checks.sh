@@ -25,6 +25,7 @@ fi
 echo "== tier-1 obs guards (jaxpr purity, ledger, flight, doctor) =="
 JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m pytest -q \
     -m 'not slow' -p no:cacheprovider \
-    tests/test_obs.py tests/test_compiles.py tests/test_flight.py
+    tests/test_obs.py tests/test_compiles.py tests/test_flight.py \
+    tests/test_pool_audit.py
 
 echo "ci_checks: OK"

@@ -34,7 +34,7 @@ PKG = REPO / "aiko_services_tpu"
 #: module alias → switchboard attribute (the nullable singletons).
 SWITCHBOARDS = {"trace": "TRACER", "steplog": "RECORDER",
                 "flight": "FLIGHT", "compiles": "LEDGER",
-                "profiler": "PROFILER"}
+                "profiler": "PROFILER", "pool_audit": "AUDITOR"}
 
 #: Guarded-site modules: every switchboard access in these files must
 #: sit under the ``is not None`` guard.
@@ -47,6 +47,7 @@ SITE_MODULES: Tuple[pathlib.Path, ...] = (
     PKG / "runtime" / "actor.py",
     PKG / "runtime" / "faults.py",
     PKG / "tools" / "loadgen.py",
+    PKG / "kvstore" / "transfer.py",
 )
 
 #: Jitted modules: no obs import at all (architecture invariant 7).
@@ -54,7 +55,7 @@ JIT_DIRS: Tuple[pathlib.Path, ...] = (PKG / "ops", PKG / "models")
 
 #: obs submodule names a jitted module must never import directly.
 OBS_MODULE_NAMES = ("trace", "steplog", "metrics", "flight", "attrib",
-                    "compiles", "profiler")
+                    "compiles", "profiler", "pool_audit")
 
 
 def is_switchboard_usage(node) -> bool:

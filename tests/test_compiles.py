@@ -286,7 +286,7 @@ def test_profile_bracket_measures_device_ms_and_lands_in_doctor(
     assert set(summary) == {
         "path", "trigger", "reason", "trace_id", "service", "pid",
         "captured_unix", "spans", "steplog", "tax_table",
-        "counters_moved", "compiles", "profile"}
+        "counters_moved", "compiles", "profile", "census"}
     assert summary["profile"]["ok"] is True
     assert summary["profile"]["device_step_ms"] > 0
     assert summary["compiles"] is not None

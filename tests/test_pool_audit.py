@@ -1,0 +1,381 @@
+"""KV memory accountant + online cross-tier pool auditor (PR 15).
+
+The five gates of ARCHITECTURE invariant 16:
+
+* **Exactness** — on a live paged engine driving all three tiers
+  (demotion, spill, async restore), the census equals ground truth
+  recomputed from the raw pool structures, AND per-tier occupancy
+  integrated from the flow counters alone equals the census — blocks
+  and bytes, with zero audit violations across every in-flight state.
+* **Passivity** — the serve-chunk jaxpr is byte-identical with the
+  auditor installed (invariant 7/14/15 discipline), and no audit code
+  exists under ``models/`` or ``ops/``.
+* **Scrapeability** — the gauges/counters are REGISTRY-created, so the
+  ``(metrics)`` Prometheus scrape carries HELP/TYPE for every series.
+* **Detection** — injected pool-accounting corruption (``leak_block``,
+  ``skew_refcount``) is caught within ONE sweep, fires exactly one
+  rate-limited ``pool_audit`` flight capture with the census attached,
+  and the served tokens stay bit-exact (the auditor observes, never
+  repairs).
+* **Fleet** — one ``(census)`` at the router fans out to every
+  replica on ONE minted trace id; ``tools/doctor.py`` renders each
+  bundle's tier table and folds the group into a fleet memory total.
+"""
+
+import ast
+import json
+import pathlib
+
+import numpy as np
+import pytest
+
+from aiko_services_tpu.obs import flight, metrics, pool_audit
+from aiko_services_tpu.orchestration.continuous import DecodeRequest
+from aiko_services_tpu.runtime import faults
+from aiko_services_tpu.utils.sexpr import generate, parse
+
+from .test_kvstore import _warm, make_server
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+PKG = REPO / "aiko_services_tpu"
+
+PROMPT = np.arange(1, 50, dtype=np.int32)           # 3 shareable blocks
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_auditor():
+    """Never let an installed auditor or recorder escape its test."""
+    yield
+    pool_audit.uninstall()
+    flight.uninstall()
+
+
+def _bundles(directory, trigger="*") -> list:
+    return sorted(str(p) for p in pathlib.Path(directory).glob(
+        f"capture_{trigger}_*.json"))
+
+
+def _load(path) -> dict:
+    with open(path) as handle:
+        return json.load(handle)
+
+
+# ---------------------------------------------------------------- #
+# Flow integration: the pure identity
+# ---------------------------------------------------------------- #
+
+def test_flow_integration_identity_and_peaks():
+    accountant = pool_audit.PoolAccountant(
+        service="unit", registry=metrics.MetricsRegistry())
+    accountant.flow("alloc", 4, 4096)
+    accountant.flow("demote", 1, 1024)               # hbm -> host
+    accountant.flow("spill", 1, 1024)                # host -> disk
+    accountant.flow("disk_restore", 1, 1024)         # disk -> (alloc)
+
+    assert accountant.occupancy_from_flows("blocks") == \
+        {"hbm": 3, "host": 0, "disk": 0}
+    assert accountant.occupancy_from_flows("bytes") == \
+        {"hbm": 3072, "host": 0, "disk": 0}
+    # The running occupancy mirrors the integral at every transition,
+    # and the peak is the true high-water mark (host and disk each
+    # briefly held the block).
+    assert accountant.occupancy["hbm"] == {"blocks": 3, "bytes": 3072}
+    assert accountant.peak["hbm"] == {"blocks": 4, "bytes": 4096}
+    assert accountant.peak["host"] == {"blocks": 1, "bytes": 1024}
+    assert accountant.peak["disk"] == {"blocks": 1, "bytes": 1024}
+
+    # A typo'd flow name must fail loudly — a silently dropped flow
+    # would unbalance the integration identity forever.
+    with pytest.raises(KeyError):
+        accountant.flow("teleport", 1, 1)
+
+
+# ---------------------------------------------------------------- #
+# Exactness: census == ground truth == flow integral, live engine
+# ---------------------------------------------------------------- #
+
+def _ground_truth(server):
+    block_bytes = server._block_nbytes()
+    used = server.total_blocks - len(server._free)
+    return {
+        "blocks": {"hbm": used, "host": len(server._host),
+                   "disk": len(server._spill)},
+        "bytes": {"hbm": used * block_bytes,
+                  "host": sum(int(entry["nbytes"])
+                              for entry in server._host.values()),
+                  "disk": sum(int(meta["nbytes"])
+                              for meta in server._spill.values())},
+    }
+
+
+def _assert_reconciled(auditor, server):
+    census = server.pool_census()
+    truth = _ground_truth(server)
+    for tier in pool_audit.TIERS:
+        assert census["tiers"][tier]["blocks"] == \
+            truth["blocks"][tier], tier
+        assert census["tiers"][tier]["bytes"] == \
+            truth["bytes"][tier], tier
+    # Occupancy integrated from the monotonic flow counters ALONE
+    # equals the live census — the accountant was installed before
+    # engine construction, so the integral is exact from block zero.
+    accountant = auditor.accountant
+    assert accountant.occupancy_from_flows("blocks") == truth["blocks"]
+    assert accountant.occupancy_from_flows("bytes") == truth["bytes"]
+    for tier in pool_audit.TIERS:
+        assert accountant.occupancy[tier]["blocks"] == \
+            truth["blocks"][tier]
+        assert accountant.occupancy[tier]["bytes"] == \
+            truth["bytes"][tier]
+    # The census states partition the pool exactly.
+    states = census["states"]
+    assert states["free"] + states["private"] + states["producing"] \
+        + states["restoring"] + states["pinned"] \
+        + states["evictable"] == census["total_blocks"]
+    # And a full reconciliation sweep finds nothing to complain about.
+    assert auditor.sweep(server) == []
+
+
+def test_census_reconciles_exactly_on_live_tiered_engine(tmp_path):
+    auditor = pool_audit.install(service="census_exact",
+                                 sweep_every=1)
+    # All three tiers live: host cap 2 forces one demoted block to
+    # overflow onto disk; 1-block-per-step restores keep the async
+    # RESTORING sentinel in flight across several audited steps.
+    server = make_server(host_tier_blocks=2,
+                         spill_dir=str(tmp_path / "spill"),
+                         restore_blocks_per_step=1)
+    want = _warm(server, PROMPT)
+    _assert_reconciled(auditor, server)
+
+    while server._evict_one():                       # demote the chain
+        pass
+    assert len(server._host) == 2 and len(server._spill) == 1
+    _assert_reconciled(auditor, server)
+
+    # Prefix hit on the demoted chain: async restore promotes blocks
+    # back one per step while decode continues; with sweep_every=1
+    # the auditor reconciled EVERY intermediate state.
+    got = _warm(server, PROMPT)
+    assert got == want
+    stats = server.stats()
+    assert stats["kv_restores"] + stats["kv_disk_restores"] == 3
+    assert stats["restore_queue_depth"] == 0
+    _assert_reconciled(auditor, server)
+
+    assert auditor.sweeps > 3                        # swept live, per step
+    assert auditor.violations_total == 0
+    # Peaks are true high-water marks over the whole run.
+    for tier in pool_audit.TIERS:
+        assert auditor.accountant.peak[tier]["blocks"] >= \
+            auditor.accountant.occupancy[tier]["blocks"]
+    assert auditor.accountant.peak["hbm"]["blocks"] > 0
+    assert auditor.accountant.peak["disk"]["blocks"] == 1
+    # Per-block attribution records carry owner identity.
+    record = server.pool_census()["blocks"][0]
+    assert {"tier", "key", "depth", "bytes", "refs",
+            "state"} <= set(record)
+
+
+# ---------------------------------------------------------------- #
+# Passivity: jaxpr byte-identical, zero audit code in traced modules
+# ---------------------------------------------------------------- #
+
+def test_auditor_does_not_change_serve_chunk_jaxpr():
+    import jax
+
+    from aiko_services_tpu.models import llama
+
+    server = make_server(host_tier_blocks=4)
+    _warm(server, PROMPT)
+
+    def trace():
+        return str(jax.make_jaxpr(
+            lambda state, pool: llama.serve_chunk_paged(
+                server.params, state, pool, 2, server.config,
+                eos_id=-1, sampled=False))(server._state, server.pool))
+
+    clean = trace()
+    auditor = pool_audit.install(service="jaxpr_pin", sweep_every=1)
+    assert trace() == clean
+    _warm(server, PROMPT)                            # audited steps
+    assert auditor.sweeps > 0
+    assert trace() == clean
+
+
+def test_no_audit_references_in_traced_modules():
+    """models/ and ops/ build the jitted programs; the accountant and
+    auditor are orchestration-side bookkeeping and must never leak in
+    (the same sweep scripts/obs_lint.py runs in CI)."""
+    banned = ("pool_audit", "AUDITOR", "pool_census", "PoolAccountant")
+    for directory in ("models", "ops"):
+        for path in sorted((PKG / directory).glob("*.py")):
+            tree = ast.parse(path.read_text())
+            for node in ast.walk(tree):
+                name = getattr(node, "id", None) \
+                    or getattr(node, "attr", None)
+                if isinstance(name, str):
+                    assert not any(word in name for word in banned), \
+                        f"{path.name}:{node.lineno}: {name}"
+
+
+# ---------------------------------------------------------------- #
+# Scrapeability: REGISTRY-created series with HELP/TYPE
+# ---------------------------------------------------------------- #
+
+def test_metrics_scrape_emits_help_and_type(engine):
+    from aiko_services_tpu.runtime import (
+        Actor, Process, actor_args, compose_instance,
+    )
+
+    auditor = pool_audit.install(service="prom", sweep_every=1)
+    server = make_server()
+    _warm(server, PROMPT)
+    assert auditor.sweep(server) == []
+
+    # The real scrape surface: the (metrics) wire command.
+    process = Process(namespace="pa", hostname="h", pid="1",
+                      engine=engine, broker="pamet")
+    actor = compose_instance(Actor, actor_args("svc_m"),
+                             process=process)
+    scraped = []
+
+    def handler(_topic, payload):
+        command, params = parse(payload)
+        if command == "metrics_response":
+            scraped.append(params[1])
+
+    process.add_message_handler(handler, "pa/met_reply")
+    process.message.publish(actor.topic_in,
+                            generate("metrics", ["pa/met_reply"]))
+    engine.drain()
+    assert len(scraped) == 1
+    text = scraped[0]
+    assert "# HELP aiko_kv_bytes KV pool bytes resident per tier" \
+        in text
+    assert "# TYPE aiko_kv_bytes gauge" in text
+    assert "# TYPE aiko_kv_blocks gauge" in text
+    assert "# TYPE aiko_kv_blocks_by_state gauge" in text
+    assert "# TYPE aiko_kv_flow_blocks_total counter" in text
+    assert "# TYPE aiko_kv_flow_bytes_total counter" in text
+    assert "# TYPE aiko_kv_audit_sweeps_total counter" in text
+    assert "# TYPE aiko_kv_audit_violations_total counter" in text
+    for tier in pool_audit.TIERS:
+        assert f'aiko_kv_bytes{{tier="{tier}"}}' in text
+    assert 'aiko_kv_flow_blocks_total{flow="alloc"}' in text
+    assert 'aiko_kv_blocks_by_state{state="free"}' in text
+
+
+# ---------------------------------------------------------------- #
+# Detection: injected corruption caught in ONE sweep, serving exact
+# ---------------------------------------------------------------- #
+
+@pytest.mark.parametrize("point,needle", [
+    ("leak_block", "unattributed"),
+    ("skew_refcount", "refcount skew"),
+], ids=["leak_block", "skew_refcount"])
+def test_pool_fault_caught_in_one_sweep_serving_bit_exact(
+        tmp_path, point, needle):
+    want = _warm(make_server(), PROMPT)              # clean reference
+
+    auditor = pool_audit.install(service="faulted", sweep_every=1)
+    flight.install(out_dir=str(tmp_path), service="faulted",
+                   min_interval_s=60.0)
+    server = make_server()
+    _warm(server, PROMPT)                            # blocks now cached
+    assert auditor.violations_total == 0
+
+    faults.install(faults.FaultPlan().add(point, nth=1))
+    server.submit(DecodeRequest(request_id="probe", prompt=PROMPT,
+                                max_new_tokens=4))
+    # The fault fires inside THIS step's bookkeeping; the sweep at the
+    # end of the SAME step (sweep_every=1) must already catch it.
+    server.step()
+    assert auditor.violations_total > 0
+    assert any(needle in violation
+               for violation in auditor.last_violations), \
+        auditor.last_violations
+
+    # The corruption is bookkeeping-only: serving stays bit-exact.
+    finished = server.run_until_drained()
+    assert [r.request_id for r in finished] == ["probe"]
+    assert finished[0].tokens == want
+
+    # Exactly ONE rate-limited pool_audit capture despite the
+    # violation persisting across every subsequent sweep.
+    paths = _bundles(tmp_path, "pool_audit")
+    assert len(paths) == 1
+    bundle = _load(paths[0])
+    assert bundle["manifest"]["trigger"] == "pool_audit"
+    assert needle in bundle["manifest"]["reason"]
+    # The census section rode along with the violation inventory.
+    assert bundle["census"]["violations_total"] >= 1
+    assert any(needle in violation
+               for violation in bundle["census"]["last_violations"])
+    assert metrics.REGISTRY.snapshot()[
+        "aiko_kv_audit_violations_total"] >= 1
+
+
+# ---------------------------------------------------------------- #
+# Fleet: (census) router fan-out on one trace id + doctor folding
+# ---------------------------------------------------------------- #
+
+def test_router_census_fans_out_one_trace_id(tmp_path, engine,
+                                             capsys):
+    """One ``(census)`` at the router → a bundle from the router AND
+    every replica, all joined on ONE minted trace id, each answering
+    on the reply topic — and the doctor folds the group into a fleet
+    memory total."""
+    from aiko_services_tpu.orchestration.serving import ReplicaRouter
+    from aiko_services_tpu.runtime import (
+        Actor, Process, actor_args, compose_instance,
+    )
+    from aiko_services_tpu.tools import doctor
+
+    process = Process(namespace="fl", hostname="h", pid="15",
+                      engine=engine, broker="flcensus")
+    router = compose_instance(ReplicaRouter, actor_args("router"),
+                              process=process)
+    replicas = [compose_instance(Actor, actor_args(f"rep{i}"),
+                                 process=process) for i in (1, 2)]
+    router._replicas = [replica.topic_path for replica in replicas]
+
+    auditor = pool_audit.install(service="fleet", sweep_every=4)
+    replicas[0].server = make_server()               # one paged engine
+    _warm(replicas[0].server, PROMPT)
+    flight.install(out_dir=str(tmp_path), service="fleet")
+    replies = []
+
+    def handler(_topic, payload):
+        command, params = parse(payload)
+        if command == "census_response":
+            replies.append(params)
+
+    process.add_message_handler(handler, "fl/census_reply")
+    process.message.publish(
+        router.topic_in,
+        generate("census", ["", "fl/census_reply", "fleet smoke"]))
+    engine.drain()
+
+    paths = _bundles(tmp_path)
+    assert len(paths) == 3                           # router + 2 replicas
+    bundles = [_load(path) for path in paths]
+    trace_ids = {b["manifest"]["trace_id"] for b in bundles}
+    assert len(trace_ids) == 1                       # ONE minted id
+    assert all(b["manifest"]["trigger"] == "census" for b in bundles)
+    assert router.counters["fleet_censuses"] == 1
+    assert sorted(name for name, _ in replies) == \
+        ["rep1", "rep2", "router"]
+    # rep1's engine census landed in the accountant before its dump.
+    assert auditor.accountant.last_census is not None
+    assert auditor.accountant.last_census[
+        "tiers"]["hbm"]["blocks"] > 0
+
+    assert doctor.main([str(tmp_path)]) == 0
+    report = capsys.readouterr().out
+    tid = trace_ids.pop()
+    assert f"fleet capture {tid} (3 processes" in report
+    # The router dumps BEFORE any replica census lands, so its own
+    # bundle carries no tiers; both post-fan-out bundles do.
+    assert "fleet memory (2 censuses): hbm" in report
+    assert "pool census:" in report                  # per-bundle table
