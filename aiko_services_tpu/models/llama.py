@@ -1381,6 +1381,28 @@ def _serve_scan(step_core, state, cache_state, num_steps, eos_id,
     return tokens_out.T, counts, new_state, cache_state
 
 
+@jax.jit
+def scatter_state_rows(state, rows, packet):
+    """Compact host→device merge for the serving loop's dirty slots:
+    write ``packet`` — the gathered rows of ONLY the slots an
+    admission/retirement/sampling-edit actually touched — into
+    ``state`` at ``rows``.  Upload cost is O(dirty rows), not
+    O(slots): a fleet-sized server admitting one request no longer
+    snapshots and re-merges every mirror.
+
+    The caller pads ``rows``/``packet`` to a pow2 bucket by REPEATING
+    the last dirty row, so compile shapes stay log-bounded under the
+    steady-state-zero-compiles gate; duplicate indices are benign
+    because every duplicate carries an identical payload — the scatter
+    result is the same whichever write lands last.
+
+    Nothing is donated: the state dict is a small immutable chain the
+    host may hold references into (the in-flight ring)."""
+    def scatter(dev, host):
+        return dev.at[rows].set(host.astype(dev.dtype))
+    return jax.tree.map(scatter, state, packet)
+
+
 @functools.partial(jax.jit,
                    static_argnames=("config", "num_steps", "eos_id",
                                     "sampled"),

@@ -63,7 +63,8 @@ from . import llama
 from .llama import LlamaConfig
 
 __all__ = ["TPEngine", "tp_param_specs", "tp_pool_specs",
-           "shard_params", "shard_pool", "replicate"]
+           "shard_params", "shard_pool", "replicate",
+           "scatter_state_rows"]
 
 
 # --------------------------------------------------------------------------- #
@@ -114,6 +115,19 @@ def replicate(tree, mesh: Mesh):
     sharding = NamedSharding(mesh, P())
     return jax.tree.map(lambda leaf: jax.device_put(leaf, sharding),
                         tree)
+
+
+def scatter_state_rows(state, rows, packet, mesh: Mesh):
+    """TP twin of :func:`.llama.scatter_state_rows`: the compact
+    dirty-row packet (tiny numpy rows) is explicitly replicated onto
+    the replica mesh before the jitted scatter, so the merged decode
+    state stays a replicated ``jax.Array`` that shard_map's ``P()``
+    in_specs accept — same contract as :func:`replicate`."""
+    sharding = NamedSharding(mesh, P())
+    rows = jax.device_put(rows, sharding)
+    packet = jax.tree.map(
+        lambda leaf: jax.device_put(leaf, sharding), packet)
+    return llama.scatter_state_rows(state, rows, packet)
 
 
 # --------------------------------------------------------------------------- #

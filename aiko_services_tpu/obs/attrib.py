@@ -44,7 +44,7 @@ from dataclasses import dataclass, field
 from typing import Dict, Iterable, List, Optional, Tuple
 
 __all__ = ["TaxRow", "TaxTable", "attribute_steps", "probe_device_ms",
-           "LEVERS"]
+           "LEVERS", "ADMISSION_COMPONENTS"]
 
 #: Component → the ROADMAP lever that would shrink it.
 LEVERS: Dict[str, str] = {
@@ -59,8 +59,17 @@ LEVERS: Dict[str, str] = {
     "commit": "batched host-side token dispatch",
     "admission": "(per-request admission cost)",
     "paged_prefill": "(prefill — not decode-loop tax)",
+    "post_admission_dispatch":
+        "(prefill compute absorbed by the wave's first dispatch "
+        "on a throttled backend — not decode-loop tax)",
     "uninstrumented": "(outside the step log's window)",
 }
+
+#: Components that belong to ADMISSION (prompt intake + prefill), not
+#: the steady-state decode loop — the split behind the decode-loop
+#: engine-vs-raw ratio (``bench.py --section step_attribution``).
+ADMISSION_COMPONENTS = ("admission", "paged_prefill", "sampling_edit",
+                        "post_admission_dispatch")
 
 #: event name → (field carrying an embedded duration, component name).
 _EMBEDDED: Dict[str, Tuple[str, str]] = {
@@ -170,9 +179,13 @@ def attribute_steps(events: Iterable[Tuple[float, str, Dict]],
             if embedded_component != event:
                 hits[embedded_component] = \
                     hits.get(embedded_component, 0) + 1
-        hits[event] = hits.get(event, 0) + 1
+        component = event
+        if event == "dispatch" and fields.get("after_admission"):
+            component = "post_admission_dispatch"
+        hits[component] = hits.get(component, 0) + 1
         # The rest of the gap is host work ending at this row.
-        ms_of[event] = ms_of.get(event, 0.0) + gap_ms - embedded_ms
+        ms_of[component] = ms_of.get(component, 0.0) \
+            + gap_ms - embedded_ms
         if event == "sync":
             syncs += int(fields.get("steps", 1) or 1)
 

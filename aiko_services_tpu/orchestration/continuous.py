@@ -157,7 +157,9 @@ class ContinuousBatchingServer:
                  draft_quantize: bool = False, params=None,
                  max_queue: Optional[int] = None,
                  watchdog_s: float = 0.0, replica_mesh=None,
-                 compilation_cache_dir: Optional[str] = None):
+                 compilation_cache_dir: Optional[str] = None,
+                 compact_upload: bool = True,
+                 ring_max: Optional[int] = None):
         import jax
         import jax.numpy as jnp
         from ..models import llama
@@ -395,11 +397,37 @@ class ContinuousBatchingServer:
             self._state = self._llama_tp.replicate(self._state,
                                                    self._mesh)
         # In-flight ring: results of dispatched-but-unconsumed chunks.
-        # Depth max(2, lookahead) double-buffers by default: step t+1
+        # Depth max(2, lookahead) double-buffers at minimum: step t+1
         # launches while step t's tiny (tokens, counts, active) result
         # is still in flight, and np.asarray happens only at consume.
+        # The depth is ADAPTIVE between ``ring_min`` and ``ring_max``:
+        # ``_ring_policy`` widens while the device is starved (ring
+        # syncs return instantly AND the ring keeps running dry
+        # between host passes) and shrinks back while the device is
+        # saturated (syncs dwarf dispatch cost) — extra depth then
+        # only delays retire/admit decisions by more chunks.
         from collections import deque
         self._ring = deque()
+        self.ring_min = max(2, self.lookahead)
+        self.ring_max = (int(ring_max) if ring_max is not None
+                         else max(4, 2 * self.ring_min))
+        if self.ring_max < self.ring_min:
+            raise ValueError(
+                f"ring_max {self.ring_max} below the double-buffer "
+                f"floor max(2, lookahead) = {self.ring_min}")
+        self._ring_depth = self.ring_min
+        self._ema_wait_ms: Optional[float] = None
+        self._ema_dispatch_ms: Optional[float] = None
+        self._starved_streak = 0
+        #: the next dispatch follows an admission wave whose last
+        #: prefill may still be in flight (steplog classification only)
+        self._post_admission = False
+        #: compact dirty-row uploads (default): ``_sync_dirty``
+        #: gathers ONLY the dirty mirror rows into a pow2-bucketed
+        #: packet and row-scatters it into the resident state.  False
+        #: = the legacy full-mirror masked merge — kept as the parity
+        #: reference the compact path is tested bitwise against.
+        self.compact_upload = bool(compact_upload)
         #: per-slot admission generation: an in-flight entry only
         #: applies to a slot whose serial still matches the entry's
         #: snapshot, so a retire-then-readmit can never credit a stale
@@ -411,6 +439,9 @@ class ContinuousBatchingServer:
         self._inflight_sched = np.zeros(slots, np.int64)
         #: slots whose host mirror changed since the last dispatch.
         self._dirty = np.zeros(slots, bool)
+        #: slots with a live sampling-param edit pending (uploads ONLY
+        #: the sampling leaves — the slot may have chunks in flight).
+        self._dirty_sampling = np.zeros(slots, bool)
         # Registry-mirrored engine counters: the dict API is unchanged
         # (tests and stats() read it directly) while every write also
         # lands in the process metrics registry under
@@ -419,7 +450,8 @@ class ContinuousBatchingServer:
         self.counters: Dict = CounterDict(dict(
             dispatches=0, decode_steps=0, tokens_committed=0,
             host_syncs=0, sync_wait_ms=0.0, sync_elements=0,
-            state_uploads=0, max_in_flight=0, admission_deferred=0,
+            state_uploads=0, dirty_rows_uploaded=0, max_in_flight=0,
+            ring_starved_steps=0, admission_deferred=0,
             decode_blocks_read=0, prefill_tokens=0,
             deadline_exceeded=0, shed=0, watchdog_trips=0),
             prefix="server", labels=self._metrics_labels)
@@ -498,24 +530,103 @@ class ContinuousBatchingServer:
         — the ONLY host→device path for decode state.  No admissions or
         retirements since the last dispatch ⇒ no upload at all.
 
+        Compact path (default): gather ONLY the dirty rows into a
+        small ``(n_dirty, …)`` packet, pad to a pow2 bucket (repeating
+        the last row — idempotent under the duplicate scatter), and
+        row-scatter it into the resident state via
+        :func:`~..models.llama.scatter_state_rows` (its
+        :mod:`~..models.llama_tp` twin under a replica mesh).  Upload
+        cost is O(dirty), not O(slots), and compile shapes stay
+        log-bounded in the fleet size.
+
         The mirrors are SNAPSHOTTED (copied) here: the CPU backend may
         alias a numpy argument zero-copy into the async computation,
         and the host keeps mutating the mirrors (consume, retire)
         before the merge actually reads them — without the copy the
-        merge races its own inputs."""
-        if not self._dirty.any():
+        merge races its own inputs.  The compact packet is race-safe
+        by construction (fancy indexing always copies); the legacy
+        masked-merge fallback keeps the full-shape operand its mask
+        needs but copies live data for the DIRTY rows only.
+
+        Two dirty classes.  STRUCTURAL rows (``_dirty``: admission,
+        retirement, budget rebase) upload every leaf — valid only
+        because such a slot has no live in-flight entries (the serial
+        bump / ring drain guarantees it), so the mirrors equal the
+        resident truth.  SAMPLING rows (``_dirty_sampling``: live
+        ``update_sampling`` edits) may have chunks in flight whose
+        progress leaves (``token``/``positions``/``remaining``) the
+        host cannot know yet — those rows scatter ONLY the sampling
+        leaves, never the progress leaves."""
+        structural = self._dirty
+        sampling = self._dirty_sampling & ~structural
+        if not (structural.any() or sampling.any()):
             return
+        rows = np.nonzero(structural)[0].astype(np.int32)
+        sampling_rows = np.nonzero(sampling)[0].astype(np.int32)
+        n_dirty = len(rows) + len(sampling_rows)
         if steplog.RECORDER is not None:
-            steplog.RECORDER.record("state_upload",
-                                    rows=int(self._dirty.sum()))
-        if compiles.LEDGER is not None:
-            compiles.set_label("merge_state")
-        snapshot = {key: np.array(value)
-                    for key, value in self._host_state().items()}
-        self._state = self._merge_state(self._state, snapshot,
-                                        self._dirty.copy())
+            steplog.RECORDER.record("state_upload", rows=n_dirty)
+        if not self.compact_upload:
+            # Legacy merge has no per-leaf mask; update_sampling
+            # settles the ring before marking on this path, so every
+            # dirty row is safe to merge wholesale.
+            mask = structural | sampling
+            merge_rows = np.nonzero(mask)[0]
+            if compiles.LEDGER is not None:
+                compiles.set_label("merge_state")
+            snapshot = {}
+            for key, value in self._host_state().items():
+                buffer = np.zeros_like(value)
+                buffer[merge_rows] = value[merge_rows]
+                snapshot[key] = buffer
+            self._state = self._merge_state(self._state, snapshot,
+                                            mask.copy())
+        else:
+            if len(rows):
+                padded = self._pow2_rows(rows)
+                packet = {key: value[padded]
+                          for key, value in self._host_state().items()}
+                if compiles.LEDGER is not None:
+                    compiles.set_label("scatter_rows",
+                                       f"r{len(padded)}")
+                self._state = self._scatter_rows(self._state, padded,
+                                                 packet)
+            if len(sampling_rows):
+                padded = self._pow2_rows(sampling_rows)
+                packet = {"temps": self._temperatures[padded],
+                          "tops": self._top_ps[padded]}
+                if compiles.LEDGER is not None:
+                    compiles.set_label("scatter_sampling",
+                                       f"r{len(padded)}")
+                sub = {key: self._state[key] for key in packet}
+                merged = self._scatter_rows(sub, padded, packet)
+                self._state = {**self._state, **merged}
         self._dirty[:] = False
+        self._dirty_sampling[:] = False
         self.counters["state_uploads"] += 1
+        self.counters["dirty_rows_uploaded"] += n_dirty
+
+    def _pow2_rows(self, rows: np.ndarray) -> np.ndarray:
+        """Pad a dirty-row index vector to its pow2 bucket (clamped to
+        the fleet size) by repeating the LAST row — duplicate indices
+        scatter identical payloads, so the merge stays exact while the
+        compile-shape count stays log-bounded."""
+        bucket = 1
+        while bucket < len(rows):
+            bucket *= 2
+        bucket = min(bucket, self.slots)
+        padded = np.empty(bucket, np.int32)
+        padded[:len(rows)] = rows
+        padded[len(rows):] = rows[-1]
+        return padded
+
+    def _scatter_rows(self, state, padded, packet):
+        """Route the row scatter to the single-chip kernel or its TP
+        twin (which re-replicates the packet onto the replica mesh)."""
+        if self._mesh is not None:
+            return self._llama_tp.scatter_state_rows(
+                state, padded, packet, self._mesh)
+        return self._llama.scatter_state_rows(state, padded, packet)
 
     def _attention_blocks(self):
         """``(block_size, total_blocks_per_row)`` of the decode-
@@ -725,6 +836,11 @@ class ContinuousBatchingServer:
         for slot, request, prompt_padded, prompt_len in admissions:
             self._activate_slot(slot, request, prompt_padded,
                                 prompt_len)
+        # The wave's LAST prefill is still in flight here (nothing
+        # blocks on it); on a one-in-flight backend the next decode
+        # dispatch absorbs its compute.  Flag it so attribution can
+        # file that gap under admission, not the decode loop.
+        self._post_admission = True
 
     def _activate_slot(self, slot: int, request, prompt_padded,
                        prompt_len: int) -> None:
@@ -1097,6 +1213,85 @@ class ContinuousBatchingServer:
         self._top_ps[slot] = 1.0
         self._any_sampled = bool((self._temperatures > 0).any())
 
+    def update_sampling(self, request_id: str,
+                        temperature: Optional[float] = None,
+                        top_p: Optional[float] = None,
+                        max_new_tokens: Optional[int] = None) -> bool:
+        """Edit a live (or still-queued) request's sampling params /
+        decode budget in place — DEVICE-RESIDENT: for a live slot the
+        edit updates the host mirrors, marks the slot sampling-dirty,
+        and rides the next dispatch's compact packet — uploading ONLY
+        the sampling leaves, because the slot may have chunks in
+        flight whose progress leaves the host cannot mirror yet.  No
+        full-mirror upload, no dedicated round trip.  Edits take
+        effect from the next dispatched chunk (chunks already in
+        flight keep the params they were dispatched with).
+
+        Budget edits additionally drain the in-flight ring first: the
+        device's resident ``remaining`` counter must be rebased
+        against a settled ``emitted`` count, and an in-flight chunk
+        retiring the lane under the OLD budget while the packet
+        revives it would strand the slot.  A new budget at or below
+        the tokens already emitted retires the request immediately
+        (finished, no error).  Returns False for an unknown id."""
+        for request in self._queue:
+            if request.request_id == request_id:
+                if temperature is not None:
+                    request.temperature = float(temperature)
+                if top_p is not None:
+                    request.top_p = float(top_p)
+                if max_new_tokens is not None:
+                    request.max_new_tokens = int(max_new_tokens)
+                return True
+        for slot in range(self.slots):
+            request = self._requests[slot]
+            if request is None or request.request_id != request_id:
+                continue
+            if max_new_tokens is not None:
+                self._drain_ring()
+                if self._requests[slot] is not request:
+                    return True    # finished naturally while draining
+                request.max_new_tokens = int(max_new_tokens)
+                if request.max_new_tokens <= self._emitted[slot]:
+                    self._prefilling.pop(slot, None)
+                    self._retire(slot)
+                    return True
+                self._remaining[slot] = (request.max_new_tokens
+                                         - self._emitted[slot])
+            if temperature is not None:
+                request.temperature = float(temperature)
+                self._temperatures[slot] = max(
+                    0.0, float(temperature))
+            if top_p is not None:
+                request.top_p = float(top_p)
+                self._top_ps[slot] = float(top_p)
+            if max_new_tokens is not None:
+                # The ring is drained (above): the mirrors are exact,
+                # so the full-row structural upload is safe — and the
+                # rebased ``remaining`` must reach the device.
+                self._dirty[slot] = True
+            elif self.compact_upload:
+                # Sampling-only edit on a slot that may have chunks in
+                # flight: a full-row upload would stomp the device's
+                # progress leaves (token/positions/remaining) with
+                # stale mirrors — ride the sampling-leaf scatter.
+                self._dirty_sampling[slot] = True
+            else:
+                # Legacy full-mirror merge has no per-leaf mask:
+                # settle the ring so the mirrors are exact first.
+                self._drain_ring()
+                if self._requests[slot] is not request:
+                    return True     # finished while settling
+                self._dirty[slot] = True
+            self._any_sampled = bool((self._temperatures > 0).any())
+            if steplog.RECORDER is not None:
+                steplog.RECORDER.record(
+                    "sampling_edit", slot=slot,
+                    temperature=float(self._temperatures[slot]),
+                    top_p=float(self._top_ps[slot]))
+            return True
+        return False
+
     def cancel(self, request_id: str) -> bool:
         """Cancel by id, wherever the request currently lives: queued
         (dropped), chunk-prefilling (admission aborted, slot freed), or
@@ -1134,11 +1329,13 @@ class ContinuousBatchingServer:
         retire finished slots.  Returns (and clears) the completed
         list.
 
-        Async double-buffering: dispatch fills the ring to ``max(2,
-        lookahead)`` entries, then consume drains it to depth-1 — so in
-        steady state every ``step()`` launches the next chunk BEFORE
-        blocking on the previous one's (tiny) result, and the device
-        never idles on host bookkeeping.  When nothing can be
+        Async double-buffering: dispatch fills the ring to the
+        adaptive depth (``ring_min = max(2, lookahead)`` floor, widened
+        toward ``ring_max`` by ``_ring_policy`` while the device runs
+        dry), then consume drains it to depth-1 in ONE batched pass —
+        so in steady state every ``step()`` launches the next chunk
+        BEFORE blocking on the previous one's (tiny) result, and the
+        device never idles on host bookkeeping.  When nothing can be
         dispatched (all budgets scheduled, or no live slot) the ring is
         drained completely so results are never stranded."""
         self._evict_expired()
@@ -1158,13 +1355,24 @@ class ContinuousBatchingServer:
                 self._fail_all("watchdog_stalled")
             done, self.completed = self.completed, []
             return done
-        depth = max(2, self.lookahead)
+        if self.slots_active and not self._ring:
+            # The device drained everything we ever handed it before
+            # this host pass came back — a starvation marker the ring
+            # controller turns into extra depth.
+            self.counters["ring_starved_steps"] += 1
+            self._starved_streak += 1
+        else:
+            self._starved_streak = 0
+        depth = self._ring_depth
         dispatched = False
         while len(self._ring) < depth and self._dispatch_round():
             dispatched = True
         target = depth - 1 if dispatched else 0
-        while len(self._ring) > target:
-            self._consume_one()
+        if len(self._ring) > target:
+            self._consume_ready(len(self._ring) - target)
+        self._ring_depth = self._ring_policy(
+            depth, self.ring_min, self.ring_max, self._ema_wait_ms,
+            self._ema_dispatch_ms, self._starved_streak)
         if self._watchdog_tripped:
             # A stalled device step already failed this batch's
             # guarantees — fail everything live/queued with the
@@ -1238,13 +1446,48 @@ class ContinuousBatchingServer:
                           - self._inflight_sched[slot])
         return plan
 
+    @staticmethod
+    def _ring_policy(depth: int, ring_min: int, ring_max: int,
+                     wait_ema, dispatch_ema, starved_streak: int) -> int:
+        """Adaptive ring-depth decision (pure, unit-tested): widen
+        while the DEVICE is starved, shrink under HOST backlog, clamp
+        to ``[ring_min, ring_max]``.
+
+        Signals: ``wait_ema``/``dispatch_ema`` are EMAs of the ms the
+        host blocked in a ring sync vs the ms a dispatch call took;
+        ``starved_streak`` counts consecutive host passes that found
+        the ring already empty with live slots.  Syncs returning
+        near-instantly WHILE the ring keeps running dry means the
+        device finished everything between host passes — queue more
+        chunks ahead.  Syncs dwarfing dispatch cost means the device
+        is saturated — extra depth buys nothing and delays every
+        retire/admit decision by more in-flight chunks, so decay back
+        toward the double-buffer floor."""
+        if wait_ema is not None and dispatch_ema is not None \
+                and dispatch_ema > 0.0:
+            if starved_streak >= 2 and wait_ema < 0.25 * dispatch_ema:
+                depth += 1
+            elif wait_ema > 2.0 * dispatch_ema:
+                depth -= 1
+        return max(ring_min, min(ring_max, depth))
+
     def _dispatch_round(self) -> bool:
         """Launch one decode chunk (or speculative round) against the
         resident device state WITHOUT waiting for its result.  Returns
-        False when no slot needs scheduling."""
+        False when no slot needs scheduling.  The call's duration
+        feeds the dispatch-tax EMA the ring controller weighs sync
+        waits against."""
+        began = time.monotonic()
         if self._draft is not None:
-            return self._dispatch_spec_round()
-        return self._dispatch_chunk()
+            dispatched = self._dispatch_spec_round()
+        else:
+            dispatched = self._dispatch_chunk()
+        if dispatched:
+            elapsed_ms = (time.monotonic() - began) * 1e3
+            self._ema_dispatch_ms = (
+                elapsed_ms if self._ema_dispatch_ms is None
+                else 0.25 * elapsed_ms + 0.75 * self._ema_dispatch_ms)
+        return dispatched
 
     def _dispatch_chunk(self) -> bool:
         plan = self._plan_remaining()
@@ -1409,7 +1652,12 @@ class ContinuousBatchingServer:
         self.counters["max_in_flight"] = max(
             self.counters["max_in_flight"], len(self._ring))
         if steplog.RECORDER is not None:
-            steplog.RECORDER.record("dispatch", ring=len(self._ring))
+            if self._post_admission:
+                steplog.RECORDER.record("dispatch", ring=len(self._ring),
+                                        after_admission=1)
+            else:
+                steplog.RECORDER.record("dispatch", ring=len(self._ring))
+        self._post_admission = False
 
     def _note_prefill(self, tokens: int) -> None:
         """Count prompt tokens dispatched to prefill (any path:
@@ -1422,12 +1670,30 @@ class ContinuousBatchingServer:
         self.counters["prefill_tokens"] += int(tokens)
 
     def _consume_one(self) -> None:
-        """Apply the OLDEST in-flight entry's results to host
-        bookkeeping: deliver tokens, advance mirrors, retire lanes the
-        device deactivated.  This is the only device→host transfer on
-        the serving path — (slots × steps) token ids plus two
-        slots-sized vectors, never logits."""
-        entry = self._ring.popleft()
+        """Apply the OLDEST in-flight entry's results (see
+        :meth:`_consume_ready` — the batched form this delegates
+        to)."""
+        self._consume_ready(1)
+
+    def _consume_ready(self, max_entries: int) -> None:
+        """Apply the oldest ``max_entries`` in-flight entries' results
+        to host bookkeeping in ONE pass: deliver tokens, advance
+        mirrors, retire lanes the device deactivated.  This is the
+        only device→host transfer on the serving path — per entry,
+        (slots × steps) token ids plus two slots-sized vectors, never
+        logits.
+
+        Batching is the drain-tail optimisation: one watchdog window,
+        one sync-wait measurement, one vectorized live-mask sweep and
+        ONE steplog sync/token-dispatch/commit record cover the whole
+        batch, instead of paying the fixed host cost per entry.
+        Per-slot delivery still walks entries oldest-first, so
+        streaming order — and the router's token-offset dedup
+        contract — is exactly the sequential path's."""
+        count = min(int(max_entries), len(self._ring))
+        if count <= 0:
+            return
+        entries = [self._ring.popleft() for _ in range(count)]
         wait_start = time.monotonic()
         if faults.PLAN is not None:
             stall = faults.PLAN.check("stall_step")
@@ -1446,87 +1712,119 @@ class ContinuousBatchingServer:
                                     self._trip_watchdog)
             alarm.daemon = True
             alarm.start()
-        tokens = np.asarray(entry["tokens"])
-        counts = np.asarray(entry["counts"])
-        active_after = np.asarray(entry["active_after"])
+        # Entries were dispatched in program order on one device
+        # stream, so materializing them oldest-first never waits on
+        # work younger than the entry being read.
+        elements = 0
+        for entry in entries:
+            entry["tokens"] = np.asarray(entry["tokens"])
+            entry["counts"] = np.asarray(entry["counts"])
+            entry["active_after"] = np.asarray(entry["active_after"])
+            elements += (entry["tokens"].size + entry["counts"].size
+                         + entry["active_after"].size)
+            if entry["kind"] == "spec":
+                entry["counts_full"] = np.asarray(entry["counts_full"])
         if alarm is not None:
             alarm.cancel()
             if time.monotonic() - wait_start > self.watchdog_s:
                 self._trip_watchdog()
-        spec = entry["kind"] == "spec"
-        if spec:
-            counts_full = np.asarray(entry["counts_full"])
-            self.spec_stats.target_passes += 1
-            self.spec_stats.drafted += int(np.asarray(entry["drafted"]))
-            self.spec_stats.accepted += int(
-                np.asarray(entry["accepted"]))
         now = time.monotonic()
+        wait_ms = (now - wait_start) * 1e3
+        self._ema_wait_ms = (wait_ms if self._ema_wait_ms is None
+                             else 0.25 * wait_ms
+                             + 0.75 * self._ema_wait_ms)
+        batch_steps = sum(int(entry["steps"]) for entry in entries)
         self.counters["host_syncs"] += 1
-        self.counters["sync_wait_ms"] += (now - wait_start) * 1e3
-        self.counters["sync_elements"] += (tokens.size + counts.size
-                                           + active_after.size)
-        self.counters["decode_steps"] += entry["steps"]
+        self.counters["sync_wait_ms"] += wait_ms
+        self.counters["sync_elements"] += elements
+        self.counters["decode_steps"] += batch_steps
         if steplog.RECORDER is not None:
             steplog.RECORDER.record(
-                "sync", wait_ms=round((now - wait_start) * 1e3, 3),
-                steps=int(entry["steps"]))
-        # Batched token dispatch: one tolist() per result field turns
-        # the step's whole token matrix into Python ints up front and
-        # the walk touches only live lanes — no per-token numpy
-        # scalar boxing, no per-slot ndarray indexing (the host-path
-        # tax the step log attributed to token delivery).
+                "sync", wait_ms=round(wait_ms, 3), steps=batch_steps,
+                entries=count)
+        # ONE vectorized live-mask sweep across the whole batch: an
+        # entry's lane is live iff its dispatch-time serial still
+        # matches, the slot is active and occupied.  Serials only
+        # change mid-batch via _retire below (admission never runs
+        # inside consume), so rows retired while walking entry i are
+        # explicitly cleared from the younger entries' masks — the
+        # exact effect the per-entry serial recheck had.
         dispatch_start = time.monotonic()
-        live = ((np.asarray(entry["serial"]) == self._slot_serial)
-                & self.active
-                & np.fromiter((request is not None
-                               for request in self._requests),
-                              bool, self.slots))
-        sched = np.asarray(entry["sched"])
-        self._inflight_sched[live] -= sched[live]
-        token_rows = tokens.tolist()
-        count_list = counts.tolist()
-        full_list = counts_full.tolist() if spec else count_list
-        active_list = active_after.tolist()
+        serials = np.stack([np.asarray(entry["serial"])
+                            for entry in entries])
+        batch_live = ((serials == self._slot_serial) & self.active
+                      & np.fromiter((request is not None
+                                     for request in self._requests),
+                                    bool, self.slots))
         delivered = 0
-        live_slots = [int(slot) for slot in np.nonzero(live)[0]]
-        for slot in live_slots:
-            request = self._requests[slot]
-            count = count_list[slot]
-            if count:
-                if request.first_token_ts is None:
-                    request.first_token_ts = now
-                request.tokens.extend(token_rows[slot][:count])
-                self._emitted[slot] += count
-                self._remaining[slot] = (request.max_new_tokens
-                                         - self._emitted[slot])
-                # Mirrors advance by what the device WROTE: the full
-                # committed window for spec rounds (cache rows exist
-                # past the emit caps), the emitted prefix for chunks.
-                advance = full_list[slot]
-                if spec:
-                    # Pre-advance mirror position = the window's first
-                    # written row; the layout hook turns the rejected
-                    # tail into its block-rollback accounting.
-                    self._note_spec_rollback(slot, advance,
-                                             self._draft["k"] + 1)
-                    if request.spec_accepted_rounds is None:
-                        request.spec_accepted_rounds = []
-                    request.spec_accepted_rounds.append(advance - 1)
-                self.positions[slot] += advance
-                self.tokens[slot, 0] = token_rows[slot][advance - 1] \
-                    if spec else token_rows[slot][count - 1]
-                delivered += count
-            if not active_list[slot]:
-                self._retire(slot)
+        committed_upper = 0
+        touched_slots = set()
+        for index, entry in enumerate(entries):
+            spec = entry["kind"] == "spec"
+            if spec:
+                self.spec_stats.target_passes += 1
+                self.spec_stats.drafted += int(
+                    np.asarray(entry["drafted"]))
+                self.spec_stats.accepted += int(
+                    np.asarray(entry["accepted"]))
+            live = batch_live[index]
+            sched = np.asarray(entry["sched"])
+            self._inflight_sched[live] -= sched[live]
+            # Batched token dispatch: one tolist() per result field
+            # turns the entry's whole token matrix into Python ints up
+            # front and the walk touches only live lanes — no
+            # per-token numpy scalar boxing, no per-slot ndarray
+            # indexing (the host-path tax the step log attributed to
+            # token delivery).
+            token_rows = entry["tokens"].tolist()
+            count_list = entry["counts"].tolist()
+            full_list = (entry["counts_full"].tolist() if spec
+                         else count_list)
+            active_list = entry["active_after"].tolist()
+            committed_upper += int(entry["counts"].sum())
+            for slot in np.nonzero(live)[0]:
+                slot = int(slot)
+                touched_slots.add(slot)
+                request = self._requests[slot]
+                count = count_list[slot]
+                if count:
+                    if request.first_token_ts is None:
+                        request.first_token_ts = now
+                    request.tokens.extend(token_rows[slot][:count])
+                    self._emitted[slot] += count
+                    self._remaining[slot] = (request.max_new_tokens
+                                             - self._emitted[slot])
+                    # Mirrors advance by what the device WROTE: the
+                    # full committed window for spec rounds (cache
+                    # rows exist past the emit caps), the emitted
+                    # prefix for chunks.
+                    advance = full_list[slot]
+                    if spec:
+                        # Pre-advance mirror position = the window's
+                        # first written row; the layout hook turns the
+                        # rejected tail into its block-rollback
+                        # accounting.
+                        self._note_spec_rollback(slot, advance,
+                                                 self._draft["k"] + 1)
+                        if request.spec_accepted_rounds is None:
+                            request.spec_accepted_rounds = []
+                        request.spec_accepted_rounds.append(advance - 1)
+                    self.positions[slot] += advance
+                    self.tokens[slot, 0] = token_rows[slot][advance - 1] \
+                        if spec else token_rows[slot][count - 1]
+                    delivered += count
+                if not active_list[slot]:
+                    self._retire(slot)
+                    batch_live[index + 1:, slot] = False
         self.counters["tokens_committed"] += delivered
         if steplog.RECORDER is not None:
             steplog.RECORDER.record(
-                "token_dispatch", slots=len(live_slots),
+                "token_dispatch", slots=len(touched_slots),
                 tokens=delivered,
                 ms=round((time.monotonic() - dispatch_start) * 1e3, 3))
             # Device-reported emit counts: stale-serial lanes may be
             # excluded above, so this is an upper bound on committed.
-            steplog.RECORDER.record("commit", tokens=int(counts.sum()))
+            steplog.RECORDER.record("commit", tokens=committed_upper)
 
     def _trip_watchdog(self) -> None:
         """Mark the replica wedged (idempotent; callable from the
@@ -1552,7 +1850,7 @@ class ContinuousBatchingServer:
 
     def _drain_ring(self) -> None:
         while self._ring:
-            self._consume_one()
+            self._consume_ready(len(self._ring))
 
     # ---- on-demand device profiling (PR 14) -------------------------- #
 
@@ -1631,6 +1929,7 @@ class ContinuousBatchingServer:
         out = dict(
             self.counters,
             in_flight=len(self._ring),
+            ring_depth=self._ring_depth,
             queue_depth=self.queue_depth,
             slots_active=self.slots_active,
             free_slots=self.slots - self.slots_active,

@@ -114,7 +114,9 @@ class PagedContinuousServer(ContinuousBatchingServer):
                  draft_config_name: Optional[str] = None,
                  draft_params=None, spec_k: int = 4,
                  draft_quantize: bool = False,
-                 compilation_cache_dir: Optional[str] = None):
+                 compilation_cache_dir: Optional[str] = None,
+                 compact_upload: bool = True,
+                 ring_max: Optional[int] = None):
         self.block_size = block_size
         self._requested_blocks = total_blocks
         self.enable_prefix_cache = enable_prefix_cache
@@ -154,7 +156,9 @@ class PagedContinuousServer(ContinuousBatchingServer):
                          draft_config_name=draft_config_name,
                          draft_params=draft_params, spec_k=spec_k,
                          draft_quantize=draft_quantize,
-                         compilation_cache_dir=compilation_cache_dir)
+                         compilation_cache_dir=compilation_cache_dir,
+                         compact_upload=compact_upload,
+                         ring_max=ring_max)
 
     # ------------------------------------------------------------- #
     # Layout hooks
@@ -1321,10 +1325,6 @@ class PagedContinuousServer(ContinuousBatchingServer):
         tail runs as descending power-of-two pieces so arbitrary
         prefix lengths reuse log-many program shapes per bucket."""
         llama, jnp = self._llama, self._jnp
-        if steplog.RECORDER is not None:
-            steplog.RECORDER.record(
-                "paged_prefill", slot=slot, shared_blocks=n_shared,
-                total_blocks=prompt_padded.shape[1] // self.block_size)
         self._pending_shared[slot] = 0
         block_size = self.block_size
         padded = prompt_padded.shape[1]
@@ -1353,6 +1353,17 @@ class PagedContinuousServer(ContinuousBatchingServer):
             self._note_prefill(width)
             start += width
             remaining -= size
+        # Recorded AFTER the dispatch loop: gap-based attribution
+        # charges each gap to the event that ends it, so the event
+        # must close the window that held this prefill's enqueue (and,
+        # on a throttled backend, the previous piece's compute block).
+        # Recording up front pushed prefill compute into whatever host
+        # phase ran next — the table blamed ``sampling_edit`` for
+        # device work.
+        if steplog.RECORDER is not None:
+            steplog.RECORDER.record(
+                "paged_prefill", slot=slot, shared_blocks=n_shared,
+                total_blocks=prompt_padded.shape[1] // self.block_size)
         if self._draft is not None:
             # Draft prompt KV for this slot's contiguous draft cache —
             # ALWAYS the whole padded prompt: the draft has no pool

@@ -66,6 +66,9 @@ TELEMETRY_KEYS = (
     "slots_active", "queue_depth", "in_flight",
     "decode_steps_per_sec", "sync_stalls_per_100_steps",
     "admission_deferred", "state_uploads", "tokens_committed",
+    # Host-tax levers (PR 16): the adaptive dispatch ring and the
+    # compact dirty-row upload path
+    "ring_depth", "ring_starved_steps", "dirty_rows_uploaded",
     "prefix_hits", "prefix_misses", "prefix_evictions",
     "prefix_remote_hits", "kv_transfer_bytes", "kv_transfer_ms",
     "kv_transfer_failures", "kv_demotions", "kv_restores",

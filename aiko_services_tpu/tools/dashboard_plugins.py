@@ -267,6 +267,14 @@ def model_replica_plugin(fields, variables) -> List[str]:
                 f"{_get(variables, 'sync_stalls_per_100_steps', default=0)}"
                 f" stalls/100, "
                 f"{_get(variables, 'in_flight', default=0)} in flight")
+        ring_depth = _get(variables, "ring_depth", default=None)
+        if ring_depth not in (None, "-"):
+            lines.append(
+                f"  ring:      depth {ring_depth}, "
+                f"{_get(variables, 'ring_starved_steps', default=0)}"
+                f" starved steps, "
+                f"{_get(variables, 'dirty_rows_uploaded', default=0)}"
+                f" dirty rows up")
         deferred = _get(variables, "admission_deferred", default=None)
         if deferred not in (None, "-", 0):
             lines.append(f"  deferred:  {deferred} admissions")

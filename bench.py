@@ -1469,67 +1469,81 @@ def bench_kv_transfer(prefix_lens=(512, 2048, 8192),
                 kvxfer.export_payload(owner, keys, 0, fused=False)
                 assert kvxfer.import_payload(
                     fresh(), warm_wire, fused=False) == len(keys)
-            # Best-of-5 per leg: one-shot host timings at the small
-            # shapes are dominated by allocator/GC noise, not the
-            # datapath under test.
+            # Best-of-5 per leg, each rep a BURST of 4 back-to-back
+            # transfers timed together (per-transfer = burst / 4):
+            # a single ~1 ms leg preempted once by the scheduler
+            # reads 20% slow, but one preemption across a 4-leg
+            # burst costs ~5% — burst-averaging plus min-of-reps is
+            # what makes a 10% regression gate meaningful on a
+            # loaded (or 1-core) host.
             import gc
+            burst = 4
             export_ms = wire_ms = import_ms = float("inf")
             ratio = float("inf")
             for _rep in range(5):
-                importer = fresh()
+                importers = [fresh() for _ in range(burst)]
                 gc.collect()
                 t0 = time.perf_counter()
-                payload = owner.kv_export_payload(keys, 0)
-                rep_export = (time.perf_counter() - t0) * 1e3
+                for _b in range(burst):
+                    payload = owner.kv_export_payload(keys, 0)
+                rep_export = (time.perf_counter() - t0) * 1e3 / burst
                 assert payload is not None, \
                     f"kv_transfer[{tag}/{length}]: export " \
                     f"resolved nothing"
                 nbytes = payload_bytes(payload)
                 t0 = time.perf_counter()
-                wire = decode_swag(encode_swag(payload))
-                rep_wire = (time.perf_counter() - t0) * 1e3
+                for _b in range(burst):
+                    wire = decode_swag(encode_swag(payload))
+                rep_wire = (time.perf_counter() - t0) * 1e3 / burst
                 t0 = time.perf_counter()
-                imported = importer.kv_import_payload(wire)
-                rep_import = (time.perf_counter() - t0) * 1e3
+                for importer in importers:
+                    imported = importer.kv_import_payload(wire)
+                rep_import = (time.perf_counter() - t0) * 1e3 / burst
                 assert imported == len(keys), \
                     f"kv_transfer[{tag}/{length}]: " \
                     f"{imported}/{len(keys)}"
                 export_ms = min(export_ms, rep_export)
                 wire_ms = min(wire_ms, rep_wire)
                 import_ms = min(import_ms, rep_import)
-                # Ratio is scored WITHIN a rep (all three legs under
-                # the same CPU-contention weather), best rep wins.
-                if rep_wire:
-                    ratio = min(ratio, (rep_export + rep_import)
-                                / rep_wire)
+            # Ratio derives from the burst-min legs: with bursts
+            # amortising preemption the per-leg mins are the stable
+            # estimates, and a ratio of stable numbers is stable —
+            # within-rep scoring rode whatever weather that rep got.
+            if wire_ms:
+                ratio = (export_ms + import_ms) / wire_ms
             total_ms = export_ms + wire_ms + import_ms
             mbps = nbytes / 1e6 / (total_ms / 1e3) if total_ms else 0.0
             # Legacy per-layer A/B: the pre-fusion datapath on the
             # SAME payload (fresh importer so eviction state
-            # matches), best-of-3 like the fused pass.
+            # matches), burst-of-4 best-of-5 like the fused pass.
             legacy_export_ms = legacy_import_ms = float("inf")
+            legacy_wire_ms = float("inf")
             legacy_ratio = float("inf")
             for _rep in range(5):
+                legacy_importers = [fresh() for _ in range(burst)]
                 gc.collect()
                 t0 = time.perf_counter()
-                legacy_payload = kvxfer.export_payload(
-                    owner, keys, 0, fused=False)
-                rep_export = (time.perf_counter() - t0) * 1e3
-                legacy_importer = fresh()
+                for _b in range(burst):
+                    legacy_payload = kvxfer.export_payload(
+                        owner, keys, 0, fused=False)
+                rep_export = (time.perf_counter() - t0) * 1e3 / burst
                 t0 = time.perf_counter()
-                legacy_wire = decode_swag(encode_swag(legacy_payload))
-                rep_wire = (time.perf_counter() - t0) * 1e3
+                for _b in range(burst):
+                    legacy_wire = decode_swag(
+                        encode_swag(legacy_payload))
+                rep_wire = (time.perf_counter() - t0) * 1e3 / burst
                 t0 = time.perf_counter()
-                assert kvxfer.import_payload(
-                    legacy_importer, legacy_wire,
-                    fused=False) == len(keys)
-                rep_import = (time.perf_counter() - t0) * 1e3
+                for legacy_importer in legacy_importers:
+                    assert kvxfer.import_payload(
+                        legacy_importer, legacy_wire,
+                        fused=False) == len(keys)
+                rep_import = (time.perf_counter() - t0) * 1e3 / burst
+                legacy_wire_ms = min(legacy_wire_ms, rep_wire)
                 legacy_export_ms = min(legacy_export_ms, rep_export)
                 legacy_import_ms = min(legacy_import_ms, rep_import)
-                if rep_wire:
-                    legacy_ratio = min(
-                        legacy_ratio,
-                        (rep_export + rep_import) / rep_wire)
+            if legacy_wire_ms:
+                legacy_ratio = ((legacy_export_ms + legacy_import_ms)
+                                / legacy_wire_ms)
             prefix = f"kv_transfer_{tag}_{length}"
             results[f"{prefix}_bytes"] = nbytes
             results[f"{prefix}_export_ms"] = round(export_ms, 2)
@@ -1593,23 +1607,40 @@ def bench_kv_transfer(prefix_lens=(512, 2048, 8192),
 
     # Routed vs load-only TTFT on the shared-prefix workload (full
     # wire rig both times; only the router's scoring differs).
+    # 3 rig runs per mode with the raw TTFT samples POOLED before
+    # taking percentiles: the rig is wall-clock-paced real threads,
+    # so on a loaded (or 1-core) host a single run's p50 is a
+    # scheduling lottery — a percentile over 3x the samples is the
+    # variance fix (min-of-run-p50s still rode single-rig jitter).
+    import statistics
+    # One untimed warmup rig first: the process's first rig pays
+    # thread-pool/replica spin-up and shows 5-8x TTFT outliers that
+    # would land straight in the pooled p95.
+    run_shared_prefix(n_requests=min(routed_requests, 4),
+                      rate_hz=routed_rate_hz, prefix_routing=True)
     for label, routing in (("routed", True), ("load_only", False)):
-        report = run_shared_prefix(
-            n_requests=routed_requests, rate_hz=routed_rate_hz,
-            prefix_routing=routing)
-        assert report.lost == 0 and report.timeouts == 0, \
-            f"kv_transfer[{label}]: {report!r}"
-        results[f"kv_routing_{label}_ttft_p50_ms"] = \
-            round(report.ttft_p50_ms, 1)
-        results[f"kv_routing_{label}_ttft_p95_ms"] = \
-            round(report.ttft_p95_ms, 1)
-        if report.prefix_hit_rate is not None:
+        samples = []
+        hit_rate = None
+        for _rig in range(3):
+            report = run_shared_prefix(
+                n_requests=routed_requests, rate_hz=routed_rate_hz,
+                prefix_routing=routing)
+            assert report.lost == 0 and report.timeouts == 0, \
+                f"kv_transfer[{label}]: {report!r}"
+            samples.extend(report.ttfts_ms)
+            if report.prefix_hit_rate is not None:
+                hit_rate = max(hit_rate or 0.0,
+                               report.prefix_hit_rate)
+        p50 = statistics.median(samples) if samples else 0.0
+        p95 = report._quantile(samples, 0.95)
+        results[f"kv_routing_{label}_ttft_p50_ms"] = round(p50, 1)
+        results[f"kv_routing_{label}_ttft_p95_ms"] = round(p95, 1)
+        if hit_rate is not None:
             results[f"kv_routing_{label}_prefix_hit_rate"] = \
-                round(report.prefix_hit_rate, 3)
+                round(hit_rate, 3)
         log(f"kv_routing[{label}]: ttft p50 "
-            f"{report.ttft_p50_ms:.1f} / p95 "
-            f"{report.ttft_p95_ms:.1f} ms, prefix hit "
-            f"{report.prefix_hit_rate if report.prefix_hit_rate is not None else 0:.0%}")
+            f"{p50:.1f} / p95 {p95:.1f} ms, prefix hit "
+            f"{hit_rate if hit_rate is not None else 0:.0%}")
     return results
 
 
@@ -1903,11 +1934,18 @@ def _raw_decode_tps(config_name, slots, max_seq, block_size,
 
     state, pool = chunk(state, pool)              # compile
     np.asarray(state["positions"])
-    started = time.perf_counter()
-    for _ in range(n_chunks):
-        state, pool = chunk(state, pool)
-    np.asarray(state["positions"])                # sync
-    elapsed = time.perf_counter() - started
+    # Best-of-3, mirroring the engine phases: single-shot walls at
+    # these shapes carry ±20% machine noise, and an asymmetric noise
+    # treatment (robust numerator, noisy denominator) makes the
+    # engine-vs-raw ratio a lottery.
+    elapsed = None
+    for _ in range(3):
+        started = time.perf_counter()
+        for _ in range(n_chunks):
+            state, pool = chunk(state, pool)
+        np.asarray(state["positions"])            # sync
+        wall = time.perf_counter() - started
+        elapsed = wall if elapsed is None else min(elapsed, wall)
     return slots * chunk_steps * n_chunks / elapsed
 
 
@@ -2040,10 +2078,16 @@ def bench_step_attribution(slots=4, prompt_len=32, max_new=64,
     max_seq += -max_seq % block_size
     ledger_owned = compiles.LEDGER is None
     ledger = compiles.install(service="bench-step-attr")
+    # Pool sized for FULL slot occupancy, same as the raw probe
+    # (`_raw_decode_tps` uses slots*max_blocks+1): this section
+    # measures host tax, and the default break-even pool sizing
+    # (half of slots x max_seq) starves admission at smoke shapes,
+    # which would charge single-lane decode compute to the ratio.
     server = PagedContinuousServer(
         config_name=config_name, slots=slots, max_seq=max_seq,
         chunk_steps=chunk_steps, block_size=block_size,
-        quantize_kv=True, seed=7)
+        quantize_kv=True, seed=7,
+        total_blocks=slots * (max_seq // block_size) + 1)
     rng = np.random.default_rng(0)
 
     def submit_batch(count, tag):
@@ -2077,34 +2121,63 @@ def bench_step_attribution(slots=4, prompt_len=32, max_new=64,
             if measured:
                 device_step_ms = float(measured)
                 device_source = "profile"
+    # Rinse wave: the first dispatches after jax.profiler teardown run
+    # measurably slower than steady state; the timed phase wants the
+    # steady loop, not the profiler's wake.
+    submit_batch(slots, "rinse")
+    server.run_until_drained()
 
     try:
         ledger.fence()     # the timed phase may not compile ANYTHING
-        steplog.install()
-        try:
-            submit_batch(n_requests, "r")
-            started = time.perf_counter()
-            finished = server.run_until_drained()
-            wall_ms = (time.perf_counter() - started) * 1e3
-            table = attrib.attribute_steps(
-                steplog.RECORDER.events(), wall_ms=wall_ms,
-                device_step_ms=device_step_ms)
-        finally:
-            steplog.uninstall()
+        # Best-of-3: a single ~10 ms CPU-smoke wall is ±20% machine
+        # noise; min-of-N is the standard noise-robust estimator, and
+        # the attribution table is taken from the SAME phase the
+        # ratio is, so rows and wall stay consistent.
+        best = None
+        for attempt in range(3):
+            steplog.install()
+            try:
+                submit_batch(n_requests, f"r{attempt}")
+                started = time.perf_counter()
+                finished = server.run_until_drained()
+                wall_ms = (time.perf_counter() - started) * 1e3
+                events = steplog.RECORDER.events()
+            finally:
+                steplog.uninstall()
+            done = [r for r in finished if r.error is None]
+            tokens = sum(len(r.tokens) for r in done)
+            if best is None or wall_ms < best[0]:
+                best = (wall_ms, tokens, events)
+        wall_ms, tokens, events = best
+        table = attrib.attribute_steps(
+            events, wall_ms=wall_ms, device_step_ms=device_step_ms)
         steady_compiles = ledger.steady_compiles
         warmup_compiles = ledger.compiles - steady_compiles
     finally:
         ledger.lift_fence()
         if ledger_owned:
             compiles.uninstall()
-    done = [r for r in finished if r.error is None]
-    engine_tps = sum(len(r.tokens) for r in done) / (wall_ms / 1e3)
+    engine_tps = tokens / (wall_ms / 1e3)
 
     for line in table.render().splitlines():
         log(f"step_attr: {line}")
-    ratio = engine_tps / max(raw_tps, 1e-9)
-    log(f"step_attr: engine-vs-raw {engine_tps:.0f}/{raw_tps:.0f} "
-        f"= {ratio:.2f} (target >= 0.50); device step "
+    # Two ratios.  GROSS divides total wall (admission + prefill +
+    # decode) by pure-decode throughput — it conflates prompt compute
+    # with host tax, and at smoke shapes (8 new tokens per request)
+    # admission dominates.  The headline DECODE-LOOP ratio removes the
+    # admission-side rows the table already classifies as not
+    # decode-loop tax, so it measures what it names: the steady-state
+    # decode hot loop against bare chained decode.
+    admission_ms = sum(row.ms for row in table.rows
+                       if row.component in attrib.ADMISSION_COMPONENTS)
+    decode_wall_ms = max(wall_ms - admission_ms, 1e-9)
+    decode_tps = tokens / (decode_wall_ms / 1e3)
+    gross = engine_tps / max(raw_tps, 1e-9)
+    ratio = decode_tps / max(raw_tps, 1e-9)
+    log(f"step_attr: decode-loop engine-vs-raw {decode_tps:.0f}"
+        f"/{raw_tps:.0f} = {ratio:.2f} (target >= 0.60; gross incl. "
+        f"admission {gross:.2f}, admission-side {admission_ms:.1f} ms "
+        f"of {wall_ms:.1f} ms wall); device step "
         f"{device_step_ms:.2f} ms ({device_source}; probe "
         f"{probe_step_ms:.2f} ms); compiles {warmup_compiles} warmup"
         f"/{steady_compiles} steady; attribution "
@@ -2117,6 +2190,9 @@ def bench_step_attribution(slots=4, prompt_len=32, max_new=64,
         "step_attr_steps": table.steps,
         "step_attr_within_10pct": int(table.within(0.10)),
         "step_attr_engine_vs_raw_ratio": round(ratio, 3),
+        "step_attr_engine_vs_raw_gross_ratio": round(gross, 3),
+        "step_attr_admission_side_ms": round(admission_ms, 1),
+        "step_attr_decode_wall_ms": round(decode_wall_ms, 1),
         "step_attr_raw_decode_tokens_per_sec": round(raw_tps),
         "step_attr_engine_tokens_per_sec": round(engine_tps),
         "step_attr_device_step_ms": round(device_step_ms, 3),
@@ -2684,7 +2760,7 @@ SECTIONS = [
     # (tiny model, CPU-capable like serving_faults).
     ("kv_transfer", 600,
      (lambda: bench_kv_transfer(prefix_lens=(512,),
-                                routed_requests=6,
+                                routed_requests=12,
                                 routed_rate_hz=10.0))
      if SMOKE else bench_kv_transfer),
     # Tiered KV cache: demote/restore bandwidth (host-side data

@@ -47,7 +47,11 @@ from typing import Dict, List, Optional, Tuple
 #: lower-is-better suffixes so ``..._per_sec`` is not caught by ``_s``.
 HIGHER_BETTER = ("per_sec", "_rps", "tok_s", "tokens_per", "hit_rate",
                  "hits", "accept", "throughput", "speedup",
-                 "mb_per", "gb_per")
+                 "mb_per", "gb_per",
+                 # engine-vs-raw decode ratios: an efficiency fraction
+                 # of raw throughput — up is good (checked before the
+                 # generic lower-is-better "ratio" cue below).
+                 "vs_raw_ratio")
 
 #: Suffix/substring cues for lower-is-better metrics.
 LOWER_BETTER_SUFFIX = ("_ms", "_s", "_us", "_ns")

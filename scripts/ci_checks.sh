@@ -28,4 +28,21 @@ JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m pytest -q \
     tests/test_obs.py tests/test_compiles.py tests/test_flight.py \
     tests/test_pool_audit.py
 
+echo "== step-attribution smoke: the tax table must add up =="
+# SMOKE step_attribution end-to-end: the attribution rows must sum to
+# within 10% of the measured wall (TaxTable.within(0.10)) and the
+# timed phase must run with zero steady-state compiles — the same
+# numbers BENCH_SECTIONS_r*.jsonl captures, exercised on every push.
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" BENCH_SMOKE=1 python - <<'EOF'
+import bench
+
+results = bench.bench_step_attribution(
+    slots=2, prompt_len=16, max_new=8, n_requests=4,
+    config_name="tiny", chunk_steps=4)
+assert results["step_attr_within_10pct"] == 1, \
+    "attribution rows do not sum to the measured wall (>10% off)"
+assert results["step_attr_compiles_steady"] == 0, \
+    "the timed decode phase compiled (shape leak past the fence)"
+EOF
+
 echo "ci_checks: OK"
