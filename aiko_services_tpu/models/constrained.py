@@ -35,7 +35,8 @@ import numpy as np
 from . import llama
 
 __all__ = ["TokenAutomaton", "automaton_from_rules",
-           "constrained_generate"]
+           "constrained_generate", "AutomatonTable", "stack_automata",
+           "constrained_accept_batch"]
 
 
 @dataclasses.dataclass(frozen=True)
@@ -150,3 +151,155 @@ def constrained_generate(params, first_logits, cache, start_index,
         jnp.arange(num_steps - 1, dtype=jnp.int32))
     tokens = jnp.concatenate([first_token[None], rest], axis=0).T
     return tokens, states, cache
+
+
+# ------------------------------------------------------------------- #
+# Serving-side: stacked automaton registry + jump-forward walker.
+
+
+class AutomatonTable:
+    """A registry of named automata STACKED into one dense table so the
+    serving tier ships a single ``(total_states, vocab)`` allowed-mask
+    array to the device regardless of how many grammars are registered.
+    Per-slot automaton state is then one GLOBAL int (start offset of
+    the request's grammar + its local state).
+
+    Host-side navigation (jump-forward segment walking, per-token
+    advance, terminal detection) lives here; only ``allowed`` crosses
+    to the device (once, at server construction) for logit masking in
+    :func:`constrained_accept_batch`."""
+
+    def __init__(self, automata: Dict[str, TokenAutomaton]):
+        if not automata:
+            raise ValueError("AutomatonTable needs >= 1 automaton")
+        vocabs = {a.vocab for a in automata.values()}
+        if len(vocabs) != 1:
+            raise ValueError(
+                f"automata disagree on vocab size: {sorted(vocabs)}")
+        self.vocab = vocabs.pop()
+        self.names: Tuple[str, ...] = tuple(automata)
+        self.offsets: Dict[str, int] = {}
+        allowed_parts, next_parts, accept_parts = [], [], []
+        offset = 0
+        for name in self.names:
+            auto = automata[name]
+            self.offsets[name] = offset
+            allowed_parts.append(np.asarray(auto.allowed, bool))
+            # Remap local next-state ids to global ids.  Disallowed
+            # entries remap too — harmless, ``advance`` checks
+            # ``allowed`` first and never follows them.
+            next_parts.append(
+                np.asarray(auto.next_state, np.int64) + offset)
+            accept_parts.append(np.asarray(auto.accepting, bool))
+            offset += auto.n_states
+        self.n_states = offset
+        self.allowed = np.concatenate(allowed_parts, axis=0)
+        self.next_state = np.concatenate(next_parts, axis=0).astype(
+            np.int32)
+        self.accepting = np.concatenate(accept_parts, axis=0)
+        # Jump-forward precompute: states admitting EXACTLY one token
+        # are deterministic — record that token (else -1).
+        n_allowed = self.allowed.sum(axis=-1)
+        self._forced_token = np.where(
+            n_allowed == 1,
+            self.allowed.argmax(axis=-1), -1).astype(np.int32)
+
+    def start(self, name: str) -> int:
+        """Global start state for the named grammar."""
+        return self.offsets[name]
+
+    def is_terminal(self, state: int) -> bool:
+        """No legal continuation — the request must stop here."""
+        return not bool(self.allowed[state].any())
+
+    def advance(self, state: int, token: int) -> int:
+        """Consume one generated token; -1 if the token is illegal in
+        ``state`` (a masked server can only produce this through a
+        bug — callers treat it as a hard error)."""
+        if not self.allowed[state, token]:
+            return -1
+        return int(self.next_state[state, token])
+
+    def deterministic_segment(self, state: int, max_len: int
+                              ) -> Tuple[list, int]:
+        """Walk the forced chain from ``state``: while the current
+        state admits exactly one token, that token is the ONLY output
+        a masked decode could produce, so it needs no model pass at
+        all — it becomes a jump-forward speculation window verified
+        (and cache-written) through the target's verify pass.  Returns
+        ``(tokens, end_state)`` with ``len(tokens) <= max_len``."""
+        tokens = []
+        while len(tokens) < max_len:
+            forced = int(self._forced_token[state])
+            if forced < 0:
+                break
+            tokens.append(forced)
+            state = int(self.next_state[state, forced])
+        return tokens, state
+
+
+def stack_automata(automata: Dict[str, TokenAutomaton]
+                   ) -> AutomatonTable:
+    """Stack a named-automata registry into one :class:`AutomatonTable`
+    (the serving tier's construction entry point)."""
+    return AutomatonTable(automata)
+
+
+@jax.jit
+def constrained_accept_batch(target_logits, base_window, base_counts,
+                             forced, forced_counts, states, cons_mask,
+                             allowed, temperatures, top_ps, key):
+    """Merge grammar-constrained rows into one speculative round's
+    accepted window.  For a constrained row the window is: the forced
+    jump-forward prefix committed UNCONDITIONALLY (each forced token is
+    the only string the grammar admits — the verify pass only ran to
+    write its KV rows), then ONE free token chosen from the target's
+    logits at the first non-deterministic position, masked to the
+    automaton's allowed set (argmax for greedy rows, the shared
+    temperature/top-p sampler otherwise).  Rows whose free-position
+    state is TERMINAL (no legal continuation) commit the forced prefix
+    only — the host retires them.
+
+    Inputs: ``target_logits`` (slots, k+1, vocab) from the verify
+    pass; ``base_window``/``base_counts`` the unconstrained acceptance
+    result (constrained rows overwrite it); ``forced`` (slots, k)
+    zero-padded forced proposals with ``forced_counts`` (slots,) valid
+    lengths; ``states`` (slots,) GLOBAL automaton state at the free
+    position (host-known at dispatch — the forced chain is
+    deterministic); ``cons_mask`` (slots,) selects constrained rows;
+    ``allowed`` the stacked (total_states, vocab) mask.
+
+    Returns ``(window (slots, k+1), counts (slots,))`` under the same
+    committed-token-count contract as ``greedy_accept_batch``."""
+    slots, k1 = target_logits.shape[:2]
+    fc = forced_counts.astype(jnp.int32)
+    free_logits = jnp.take_along_axis(
+        target_logits, fc[:, None, None], axis=1)[:, 0]
+    mask = allowed[states]                              # (slots, vocab)
+    has_free = mask.any(axis=-1)
+    masked = jnp.where(mask, free_logits.astype(jnp.float32),
+                       -jnp.inf)
+    # Terminal rows would feed all--inf rows to argmax/softmax (NaNs);
+    # their choice is discarded below, so give them the raw logits.
+    safe = jnp.where(has_free[:, None], masked,
+                     free_logits.astype(jnp.float32))
+    greedy_tok = safe.argmax(-1).astype(jnp.int32)
+    probs = llama.sampling_probs(safe, temperatures[:, None],
+                                 top_ps[:, None])
+    sampled_tok = jax.random.categorical(
+        key, jnp.log(jnp.maximum(probs, 1e-30))).astype(jnp.int32)
+    free_tok = jnp.where(temperatures > 0, sampled_tok, greedy_tok)
+
+    pos = jnp.arange(k1)[None, :]
+    forced_pad = jnp.concatenate(
+        [forced.astype(jnp.int32),
+         jnp.zeros((slots, 1), jnp.int32)], axis=1)
+    cons_window = jnp.where(pos < fc[:, None], forced_pad, 0)
+    cons_window = jnp.where(
+        (pos == fc[:, None]) & has_free[:, None],
+        free_tok[:, None], cons_window)
+    cons_counts = fc + has_free.astype(jnp.int32)
+
+    window = jnp.where(cons_mask[:, None], cons_window, base_window)
+    counts = jnp.where(cons_mask, cons_counts, base_counts)
+    return window, counts

@@ -990,19 +990,23 @@ def _chunk_scan(step_core, tokens, positions, cache_state, active,
 
 
 @functools.partial(jax.jit,
-                   static_argnames=("config", "num_steps"),
+                   static_argnames=("config", "num_steps",
+                                    "return_logits"),
                    donate_argnames=("pool",))
 def decode_chunk_paged(params, tokens, pool, tables, positions, active,
                        num_steps, config: LlamaConfig,
                        temperatures=None, top_ps=None, rng_key=None,
-                       lora=None):
+                       lora=None, return_logits: bool = False):
     """Paged twin of :func:`decode_chunk_ragged`: one compiled scan of
     ``num_steps`` steps over the block pool.  Inactive slots write into
     scratch block 0 at their slot offset (blocked from live tables by
     the allocator) and do not advance.
 
     Returns (tokens_out (slots, num_steps), last_token, positions,
-    pool)."""
+    pool) — ``return_logits=True`` inserts the per-step next-token
+    logits after ``tokens_out``, same contract as
+    :func:`decode_chunk_ragged` (paged DRAFT runs for speculative
+    serving)."""
     block_size = pool[0]["k"].shape[1]
     slots = tokens.shape[0]
     scratch_tables = jnp.zeros_like(tables)
@@ -1017,7 +1021,8 @@ def decode_chunk_paged(params, tokens, pool, tables, positions, active,
                                   write_pos, config, lora=lora)
 
     return _chunk_scan(step_core, tokens, positions, pool, active,
-                       num_steps, temperatures, top_ps, rng_key)
+                       num_steps, temperatures, top_ps, rng_key,
+                       collect_logits=return_logits)
 
 
 @functools.partial(jax.jit, donate_argnames=("pool",))

@@ -34,7 +34,8 @@ from . import llama
 
 __all__ = ["speculative_generate", "speculative_generate_sampled",
            "SpecStats", "mrs_accept_batch", "greedy_accept_batch",
-           "spec_commit"]
+           "spec_commit", "ngram_propose", "merge_forced",
+           "delta_draft_logits"]
 
 
 class SpecStats:
@@ -49,6 +50,14 @@ class SpecStats:
         #: worst-case reservation keeps the blocks owned, the stale
         #: rows are unattendable and rewritten before reachable.
         self.rollback_blocks = 0
+        #: Grammar-forced tokens committed through jump-forward
+        #: windows (deterministic automaton segments — committed
+        #: unconditionally, the verify pass only writes their KV).
+        self.jump_forward_tokens = 0
+        #: Rounds-slots where the n-gram proposer found a suffix
+        #: match in the slot's own history (a "hit" measures proposal
+        #: COVERAGE; acceptance still decides what commits).
+        self.ngram_hits = 0
 
     @property
     def acceptance_rate(self) -> float:
@@ -68,7 +77,7 @@ class SpecStats:
 
 @jax.jit
 def mrs_accept_batch(target_logits, draft_logits, proposals,
-                     temperatures, top_ps, key):
+                     temperatures, top_ps, key, caps=None):
     """Vectorized modified rejection sampling (Leviathan et al.) for a
     SLOT BATCH, entirely on device — the acceptance kernel of sampled
     speculative continuous batching.
@@ -79,6 +88,15 @@ def mrs_accept_batch(target_logits, draft_logits, proposals,
     (slots, k)``, per-slot ``temperatures``/``top_ps``.  Rows with
     temperature 0 use exact GREEDY acceptance (argmax-prefix match +
     the target's correction/bonus) — one kernel serves mixed batches.
+
+    ``caps`` (slots,) int32, optional: the adaptive controller's
+    per-slot k.  Row i behaves exactly as if its window were
+    ``caps[i]`` wide — proposals past the cap are never considered
+    and a row that accepts its whole cap draws its final token from
+    the target's OWN distribution (the bonus-token branch), so the
+    committed tokens stay exactly target-distributed at every cap.
+    ``caps = 0`` degrades the row to plain target sampling.  ``None``
+    (trace-time) compiles the fixed-k program with no cap math.
 
     Returns ``(tokens (slots, k+1), counts (slots,))``: the first
     ``counts[i]`` entries of row i are that slot's committed tokens
@@ -116,6 +134,8 @@ def mrs_accept_batch(target_logits, draft_logits, proposals,
     sampled_row = temperatures > 0
     accept = jnp.where(sampled_row[:, None], sampled_accept,
                        greedy_accept)
+    if caps is not None:
+        accept = accept & (jnp.arange(k)[None, :] < caps[:, None])
     prefix = jnp.cumprod(accept.astype(jnp.int32), axis=-1)
     counts = prefix.sum(-1)                       # accepted proposals
     # Final token at window position ``counts``: MRS residual on
@@ -132,8 +152,10 @@ def mrs_accept_batch(target_logits, draft_logits, proposals,
                               residual / jnp.maximum(residual_mass,
                                                      1e-30),
                               p_sel)
-    final_dist = jnp.where((counts == k)[:, None], p_sel,
-                           rejected_dist)
+    # "Full accept" = the row kept its whole WINDOW — the configured
+    # k, or the row's own cap under adaptive per-slot k.
+    full = counts == (k if caps is None else caps)
+    final_dist = jnp.where(full[:, None], p_sel, rejected_dist)
     sampled_final = jax.random.categorical(
         final_key, jnp.log(jnp.maximum(final_dist, 1e-30))
     ).astype(jnp.int32)
@@ -153,7 +175,7 @@ def mrs_accept_batch(target_logits, draft_logits, proposals,
 
 
 @jax.jit
-def greedy_accept_batch(target_logits, proposals):
+def greedy_accept_batch(target_logits, proposals, caps=None):
     """Greedy twin of :func:`mrs_accept_batch`, entirely on device: the
     accepted prefix is the longest argmax-match between proposals and
     the verify pass, the final token is the target's own argmax at the
@@ -162,11 +184,21 @@ def greedy_accept_batch(target_logits, proposals):
     to run on fetched logits — moved in-jit so speculative serving
     never downloads a logit.
 
+    ``caps`` (slots,) int32, optional per-slot k from the adaptive
+    controller: proposals past a row's cap are force-rejected, so the
+    row commits at most ``caps[i] + 1`` tokens.  Bitwise-greedy safety
+    is structural — every committed token still equals the target's
+    argmax given its prefix (an accepted proposal IS that argmax), so
+    any cap yields a prefix of the identical plain-greedy stream.
+    ``caps = 0`` rows commit exactly the plain-decode next token.
+
     Returns ``(tokens (slots, k+1), counts (slots,))`` with the same
     read-``counts``-entries contract as :func:`mrs_accept_batch`."""
     slots, k = proposals.shape
     target_greedy = target_logits.argmax(-1).astype(jnp.int32)
     accept = proposals == target_greedy[:, :k]
+    if caps is not None:
+        accept = accept & (jnp.arange(k)[None, :] < caps[:, None])
     prefix = jnp.cumprod(accept.astype(jnp.int32), axis=-1)
     counts = prefix.sum(-1)
     final_token = jnp.take_along_axis(
@@ -234,6 +266,66 @@ def spec_commit(state, window, counts_raw, eos_id: int = -1):
         jnp.int32)
     return (jnp.where(valid, window, 0), emit_counts, drafted,
             accepted, resync, new_state)
+
+
+def ngram_propose(history, k: int, max_ngram: int = 3,
+                  min_ngram: int = 1) -> Tuple[np.ndarray, bool]:
+    """Model-free n-gram / prompt-lookup proposal (the vLLM-lineage
+    self-draft): suffix-match the last ``n``-gram of ``history``
+    (longest ``n`` first, ``max_ngram`` down to ``min_ngram``) against
+    an EARLIER occurrence in the same history and propose the ``k``
+    tokens that followed the MOST RECENT match.  Pure host-side numpy
+    — proposal quality never affects correctness (greedy acceptance
+    only commits exact target-argmax matches), so a stale or absent
+    match costs acceptance, not exactness.
+
+    Returns ``(proposals (k,) int32 zero-padded, hit)``; ``hit`` is
+    False when no suffix recurs (the proposals are then zeros, which
+    verify rejects — the adaptive controller reads the resulting
+    acceptance and parks the slot at a narrower rung)."""
+    history = np.asarray(history, np.int64).reshape(-1)
+    proposals = np.zeros(k, np.int32)
+    n_hist = history.shape[0]
+    for n in range(min(max_ngram, n_hist - 1), min_ngram - 1, -1):
+        pattern = history[n_hist - n:]
+        # Candidate END positions of earlier matches (exclusive), most
+        # recent first; the suffix occurrence itself is excluded.
+        windows = np.lib.stride_tricks.sliding_window_view(
+            history[:n_hist - 1], n)
+        matches = np.nonzero((windows == pattern).all(axis=1))[0]
+        if matches.size == 0:
+            continue
+        start = int(matches[-1]) + n          # continuation start
+        continuation = history[start:start + k]
+        proposals[:continuation.shape[0]] = continuation.astype(
+            np.int32)
+        return proposals, True
+    return proposals, False
+
+
+@jax.jit
+def merge_forced(proposals, forced, forced_mask):
+    """Overlay grammar-forced windows onto a round's proposals:
+    rows with ``forced_mask`` take their ``forced`` tokens verbatim
+    (jump-forward segments), other rows keep the draft/ngram
+    proposals.  One tiny fused kernel instead of an eager per-round
+    ``jnp.where`` chain."""
+    return jnp.where(forced_mask[:, None], forced, proposals)
+
+
+@functools.partial(jax.jit, static_argnames=("vocab",))
+def delta_draft_logits(proposals, vocab: int):
+    """Synthesize draft logits for a DETERMINISTIC proposer (n-gram
+    lookup): a near-delta distribution on each proposed token.  MRS
+    acceptance with ``q = δ(proposal)`` stays exactly
+    target-distributed — ``q(proposal) = 1`` so acceptance probability
+    is ``min(1, p(proposal))`` and the residual is ``max(0, p - δ·p)``
+    renormalized, which is the textbook rejection-sampling
+    decomposition of ``p`` — so sampled slots compose with the
+    self-draft mode through the SAME :func:`mrs_accept_batch`
+    kernel."""
+    return jax.nn.one_hot(proposals, vocab,
+                          dtype=jnp.float32) * 1e4
 
 
 def _setup(target_params, draft_params, prompt, num_new, target_config,

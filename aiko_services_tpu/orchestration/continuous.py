@@ -98,6 +98,12 @@ class DecodeRequest:
     #: base weight stream is paid once for all of them (SLoRA-style;
     #: server must be constructed with ``adapters=``).
     adapter: Optional[str] = None
+    #: Named grammar from the server's ``automata`` registry: output
+    #: is masked to the automaton's allowed sets and deterministic
+    #: segments commit as jump-forward speculation windows.  None =
+    #: unconstrained (the automaton applies to GENERATED tokens only,
+    #: never the prompt).
+    automaton: Optional[str] = None
     #: Absolute host-monotonic deadline (``deadline_ms`` on the wire
     #: travels as a RELATIVE budget — clocks never cross processes).
     #: Expired requests are rejected at admission and evicted from
@@ -154,7 +160,10 @@ class ContinuousBatchingServer:
                  lora_config=None, chunk_prefill_tokens: int = 0,
                  draft_config_name: Optional[str] = None,
                  draft_params=None, spec_k: int = 4,
-                 draft_quantize: bool = False, params=None,
+                 draft_quantize: bool = False,
+                 draft_mode: str = "auto", spec_ladder=None,
+                 spec_adaptive: bool = False, automata=None,
+                 params=None,
                  max_queue: Optional[int] = None,
                  watchdog_s: float = 0.0, replica_mesh=None,
                  compilation_cache_dir: Optional[str] = None,
@@ -291,11 +300,12 @@ class ContinuousBatchingServer:
                     "(GSPMD megatron sharding): draft placement is "
                     "only defined for replica_mesh= (shard_map TP, "
                     "draft replicated) — or pass no mesh")
-            if spec_k + 1 > 16:        # the prompt bucket floor
-                raise ValueError(
-                    f"spec_k {spec_k} too large: k+1 must be <= the "
-                    "prompt bucket floor (16) so admission prefill "
-                    "rewrites inactive-slot verify rows")
+            # NOTE the verify-window width guard (k+1 vs the prompt
+            # bucket floor) moved to validate_ladder below: the paged
+            # layout may RAISE the floor to block_size, so the check
+            # must run after _init_layout — and it now names the
+            # whole LADDER, the thing actually bounding compiled
+            # shapes under adaptive k.
             draft_config = llama.CONFIGS[draft_config_name]
             if draft_config.vocab_size != self.config.vocab_size:
                 raise ValueError("draft and target must share a "
@@ -322,12 +332,18 @@ class ContinuousBatchingServer:
                     self._draft["params"], self._mesh)
                 self._draft["cache"] = self._llama_tp.replicate(
                     self._draft["cache"], self._mesh)
-            from ..models.speculative import SpecStats
-            self.spec_stats = SpecStats()
         self.eos_id = eos_id
         self.quantize_kv = quantize_kv
         self._bucket_minimum = 16
+        #: Speculation policy (set after _init_layout — the ladder
+        #: validates against the FINAL bucket floor).  None = plain
+        #: decode; _draft above is only the model-mode proposer.
+        self._spec = None
+        self._automata = None
+        self._autostates = None
         self._init_layout()
+        self._init_spec(draft_mode, spec_k, spec_ladder, spec_adaptive,
+                        automata)
         # Decode-attention dispatch tag ("kernel" = Pallas paged
         # decode kernel, "reference" = jnp oracle) + the block
         # geometry of the attention view — decided once at init, so
@@ -696,6 +712,78 @@ class ContinuousBatchingServer:
 
         self._insert_slots = insert_slots
 
+    def _init_spec(self, draft_mode: str, spec_k: int, spec_ladder,
+                   spec_adaptive: bool, automata) -> None:
+        """Speculation v2 policy wiring (after ``_init_layout`` — the
+        ladder validates against the FINAL prompt-bucket floor, which
+        the paged layout raises to ``block_size``).  Three proposers
+        share one verify/accept/commit path:
+
+        * ``model`` — the PR-10 paired draft (``draft_config_name``);
+        * ``ngram`` — model-free self-drafting: suffix-match proposals
+          from each slot's own committed history, assembled host-side;
+        * grammar jump-forward — ``automata`` registers named
+          :class:`~..models.constrained.TokenAutomaton` grammars;
+          requests naming one get masked free tokens and deterministic
+          segments committed as speculation windows.
+
+        ``draft_mode="auto"`` resolves to ``model`` when a draft is
+        configured, else ``ngram``; speculation is OFF only when no
+        draft, no explicit ngram, and no automata are given."""
+        spec_on = (self._draft is not None
+                   or draft_mode in ("ngram", "model")
+                   or bool(automata))
+        if not spec_on:
+            if draft_mode not in ("auto", "model", "ngram"):
+                raise ValueError(
+                    f"draft_mode must be 'model', 'ngram' or 'auto', "
+                    f"got {draft_mode!r}")
+            return
+        mode = draft_mode
+        if mode == "auto":
+            mode = "model" if self._draft is not None else "ngram"
+        if mode not in ("model", "ngram"):
+            raise ValueError(
+                f"draft_mode must be 'model', 'ngram' or 'auto', got "
+                f"{draft_mode!r}")
+        if mode == "model" and self._draft is None:
+            raise ValueError(
+                "draft_mode='model' requires draft_config_name=")
+        if mode == "ngram" and self._draft is not None:
+            raise ValueError(
+                "draft_mode='ngram' does not take draft_config_name= "
+                "(the slot's own committed history is the draft)")
+        from .spec_control import (SpecController, default_ladder,
+                                   validate_ladder)
+        ladder = (tuple(int(k) for k in spec_ladder)
+                  if spec_ladder is not None
+                  else default_ladder(int(spec_k)))
+        ladder = validate_ladder(ladder, self._bucket_minimum)
+        if ladder[-1] < 1:
+            raise ValueError(
+                f"spec ladder {ladder} has no usable rung: the top "
+                "rung must be >= 1 (k=0 alone is just plain decode)")
+        controller = (SpecController(self.slots, ladder)
+                      if spec_adaptive else None)
+        self._spec = dict(mode=mode, k=int(ladder[-1]), ladder=ladder,
+                          controller=controller,
+                          adaptive=bool(spec_adaptive))
+        from ..models.speculative import SpecStats
+        self.spec_stats = SpecStats()
+        if automata:
+            from ..models.constrained import stack_automata
+            table = stack_automata(dict(automata))
+            if table.vocab != self.config.vocab_size:
+                raise ValueError(
+                    f"automata vocab {table.vocab} != model vocab "
+                    f"{self.config.vocab_size}")
+            allowed = self._jnp.asarray(table.allowed)
+            if self._mesh is not None:
+                allowed = self._llama_tp.replicate(allowed, self._mesh)
+            self._automata = dict(table=table, allowed=allowed)
+            #: per-slot GLOBAL automaton state; -1 = unconstrained.
+            self._autostates = np.full(self.slots, -1, np.int64)
+
     # ------------------------------------------------------------- #
 
     def submit(self, request: DecodeRequest) -> None:
@@ -760,12 +848,18 @@ class ContinuousBatchingServer:
         if request.adapter is not None \
                 and request.adapter not in self._adapter_index:
             return "unknown_adapter"
-        if self._draft is not None:
+        if request.automaton is not None \
+                and (self._automata is None
+                     or request.automaton
+                     not in self._automata["table"].offsets):
+            return "unknown_automaton"
+        if self._spec is not None:
             if prompt_len + request.max_new_tokens \
-                    + self._draft["k"] + 1 > self.max_seq:
+                    + self._spec["k"] + 1 > self.max_seq:
                 # Speculation writes k rows past the live position;
                 # without this headroom the verify slab's clamped
-                # write would corrupt committed rows.
+                # write would corrupt committed rows.  Bounded by the
+                # ladder TOP — adaptivity can only narrow.
                 return "prompt_too_long"
         return None
 
@@ -862,6 +956,16 @@ class ContinuousBatchingServer:
         self._slot_serial[slot] += 1
         self._dirty[slot] = True
         self._any_sampled = bool((self._temperatures > 0).any())
+        if self._spec is not None \
+                and self._spec["controller"] is not None:
+            # New occupant: forget the previous request's acceptance
+            # history (optimistic start at the ladder top).
+            self._spec["controller"].reset(slot)
+        if self._automata is not None:
+            name = request.automaton
+            self._autostates[slot] = (
+                self._automata["table"].start(name)
+                if name is not None else -1)
         if steplog.RECORDER is not None:
             steplog.RECORDER.record(
                 "sampling_edit", slot=slot,
@@ -1207,6 +1311,8 @@ class ContinuousBatchingServer:
         # data for this slot is now stale and will be skipped.
         self._slot_serial[slot] += 1
         self._dirty[slot] = True
+        if self._autostates is not None:
+            self._autostates[slot] = -1
         # Reset sampling state so an all-greedy batch returns to the
         # pure-greedy compiled program (no sort/softmax per step).
         self._temperatures[slot] = 0.0
@@ -1478,7 +1584,7 @@ class ContinuousBatchingServer:
         feeds the dispatch-tax EMA the ring controller weighs sync
         waits against."""
         began = time.monotonic()
-        if self._draft is not None:
+        if self._spec is not None:
             dispatched = self._dispatch_spec_round()
         else:
             dispatched = self._dispatch_chunk()
@@ -1551,23 +1657,63 @@ class ContinuousBatchingServer:
 
     def _dispatch_spec_round(self) -> bool:
         """ONE per-slot speculative round, dispatched entirely on
-        device: draft proposes ``k`` tokens from the resident state,
-        ONE target :func:`~..models.llama.verify_chunk_ragged` pass
-        scores them, the acceptance kernel (greedy argmax-prefix or
-        MRS) picks each slot's committed window, and
-        :func:`~..models.speculative.spec_commit` applies EOS/budget
-        caps and advances the resident state in-jit.  The draft then
-        replays committed[:-1] to re-sync its cache — still zero host
-        syncs; results flow through the same in-flight ring as plain
-        chunks.  Greedy outputs are exactly the plain server's;
-        sampled slots commit tokens distributed exactly as target-only
-        sampling (the MRS kernel, tested)."""
+        device: a proposer fills each live slot's ``k``-token window
+        (paired draft model, or host-assembled n-gram/prompt-lookup
+        continuations, or grammar jump-forward segments), ONE target
+        verify pass scores it, the acceptance kernel (greedy
+        argmax-prefix or MRS — per-slot ``caps`` from the adaptive
+        controller narrow individual rows) picks each slot's committed
+        window, and :func:`~..models.speculative.spec_commit` applies
+        EOS/budget caps and advances the resident state in-jit.
+        Results flow through the same in-flight ring as plain chunks.
+        Greedy outputs are exactly the plain server's under EVERY
+        proposer/cap combination (invariants 11 + 18); sampled slots
+        commit tokens distributed exactly as target-only sampling (MRS
+        for model drafts, its delta-draft degenerate form for ngram).
+
+        Adaptive rounds run at ``round_k`` = the max controller rung
+        over live slots — always a ladder member, so the compiled
+        shape set stays bounded (warm_spec_ladder pre-compiles it).
+        ``round_k == 0`` (every live slot degraded) delegates to the
+        plain chunk program."""
         plan = self._plan_remaining()
         live = plan > 0
         if not live.any():
             return False
-        jnp, llama, draft = self._jnp, self._llama, self._draft
-        k = draft["k"]
+        jnp, spec = self._jnp, self._spec
+        mode = spec["mode"]
+        controller = spec["controller"]
+        cons_live = None
+        if self._autostates is not None:
+            cons_live = live & (self._autostates >= 0)
+            if not cons_live.any():
+                cons_live = None
+        if (mode == "ngram" or cons_live is not None) and self._ring:
+            # Host-fed proposers need SETTLED host mirrors: with
+            # entries in flight, ngram would propose from stale
+            # history (quality loss only) and — worse — grammar
+            # jump-forward would walk forced segments from a stale
+            # automaton state (committed unconditionally: a
+            # correctness bug).  Serialize: consume first, dispatch
+            # on the next pass.
+            return False
+        k = spec["k"]
+        caps_host = None
+        if controller is not None:
+            k = controller.round_k(live)
+            if cons_live is not None:
+                # Grammar rows always get the full window: forced
+                # jump-forward segments want width, and the masked
+                # free token is cap-independent.
+                k = spec["k"]
+            caps_host = controller.caps(live)
+            controller.note_dispatch(live)
+            if k == 0:
+                # Every live slot parked at k=0: run the ordinary
+                # multi-step chunk program — the ladder's "plain
+                # decode" rung — and tick the re-probe counters.
+                controller.tick_cold_round(live)
+                return self._dispatch_chunk()
         self._sync_dirty()
         if compiles.LEDGER is not None:
             compiles.set_label("spec_round", f"k{k}")
@@ -1575,44 +1721,98 @@ class ContinuousBatchingServer:
         lora_shared = self._serve_lora()
         lora = (dict(lora_shared, ids=st["adapter_ids"])
                 if lora_shared is not None else None)
+        from ..models.speculative import (delta_draft_logits,
+                                          greedy_accept_batch,
+                                          merge_forced,
+                                          mrs_accept_batch,
+                                          ngram_propose, spec_commit)
+        draft_key = accept_key = cons_key = None
         if self._any_sampled:
-            self._rng, draft_key, accept_key = \
-                self._jax.random.split(self._rng, 3)
-            proposals, draft_logits, _, _, draft["cache"] = \
-                llama.decode_chunk_ragged(
-                    draft["params"], st["token"], draft["cache"],
-                    st["positions"], st["active"], k, draft["config"],
-                    temperatures=st["temps"], top_ps=st["tops"],
-                    rng_key=draft_key, return_logits=True)
+            self._rng, draft_key, accept_key, cons_key = \
+                self._jax.random.split(self._rng, 4)
+        draft_logits = None
+        if mode == "model":
+            proposals, draft_logits = self._draft_propose(st, k,
+                                                          draft_key)
         else:
-            proposals, _, _, draft["cache"] = llama.decode_chunk_ragged(
-                draft["params"], st["token"], draft["cache"],
-                st["positions"], st["active"], k, draft["config"])
+            # Self-draft: suffix-match each live slot's own committed
+            # history (prompt + delivered tokens — settled, see the
+            # serialization gate above).  Host numpy only; proposals
+            # ride the dispatch as one tiny (slots, k) upload.
+            props = np.zeros((self.slots, k), np.int32)
+            hits = 0
+            for slot in np.nonzero(live)[0]:
+                request = self._requests[int(slot)]
+                history = list(request.prompt) + list(request.tokens)
+                row, hit = ngram_propose(history, k)
+                props[slot] = row
+                hits += int(hit)
+            self.spec_stats.ngram_hits += hits
+            proposals = jnp.asarray(props)
+            if self._any_sampled:
+                # Delta-draft MRS: q = point mass at the proposal, so
+                # accept w.p. min(1, p(prop)) and the residual is the
+                # target's own distribution with the proposal's mass
+                # removed — the textbook rejection decomposition of p.
+                # Committed tokens stay EXACTLY target-distributed
+                # with no draft model in sight.
+                draft_logits = delta_draft_logits(
+                    proposals, self.config.vocab_size)
+        forced_counts = None
+        cons_states = None
+        if cons_live is not None:
+            # Grammar jump-forward: while a slot's automaton state
+            # admits exactly one token, that token is the ONLY output
+            # a masked decode could produce — emit the whole forced
+            # chain as its proposal window (committed via the same
+            # verify pass, which writes its KV rows).
+            table = self._automata["table"]
+            forced_host = np.zeros((self.slots, k), np.int32)
+            forced_counts = np.zeros(self.slots, np.int32)
+            cons_states = np.zeros(self.slots, np.int32)
+            for slot in np.nonzero(cons_live)[0]:
+                slot = int(slot)
+                segment, end_state = table.deterministic_segment(
+                    int(self._autostates[slot]), k)
+                forced_host[slot, :len(segment)] = segment
+                forced_counts[slot] = len(segment)
+                cons_states[slot] = end_state
+            proposals = merge_forced(proposals,
+                                     jnp.asarray(forced_host),
+                                     jnp.asarray(cons_live))
         chunk = jnp.concatenate([st["token"], proposals], axis=1)
         logits = self._spec_verify(st, chunk, lora)
-        from ..models.speculative import (greedy_accept_batch,
-                                          mrs_accept_batch, spec_commit)
+        caps_dev = (jnp.asarray(caps_host)
+                    if caps_host is not None else None)
         if self._any_sampled:
             window, counts_raw = mrs_accept_batch(
                 logits, draft_logits, proposals, st["temps"],
-                st["tops"], accept_key)
+                st["tops"], accept_key, caps=caps_dev)
         else:
-            window, counts_raw = greedy_accept_batch(logits, proposals)
+            window, counts_raw = greedy_accept_batch(
+                logits, proposals, caps=caps_dev)
+        if cons_live is not None:
+            from ..models.constrained import constrained_accept_batch
+            if cons_key is None:
+                cons_key = self._jax.random.PRNGKey(0)
+            window, counts_raw = constrained_accept_batch(
+                logits, window, counts_raw,
+                jnp.asarray(forced_host), jnp.asarray(forced_counts),
+                jnp.asarray(cons_states), jnp.asarray(cons_live),
+                self._automata["allowed"], st["temps"], st["tops"],
+                cons_key)
         prev_positions, prev_active = st["positions"], st["active"]
         (emit_tokens, emit_counts, drafted, accepted, resync,
          self._state) = spec_commit(
             st, window, counts_raw,
             eos_id=-1 if self.eos_id is None else int(self.eos_id))
-        # Draft-cache resync: committed[:-1] spans positions+1 onward
-        # (fixed k width, zero-padded; idempotent rewrites, stale pad
-        # rows rewritten before they become attendable — the same
-        # policy as models.speculative._resync_draft).
-        _, draft["cache"] = llama.verify_chunk_ragged(
-            draft["params"], resync, draft["cache"],
-            prev_positions + 1, prev_active, draft["config"])
+        if mode == "model":
+            self._draft_resync(st, resync, prev_positions, prev_active)
         # A round commits AT LEAST one token per live lane, so 1 is
         # the safe in-flight schedule increment (over-dispatch is
         # harmless: exhausted lanes go inactive in-jit and emit 0).
+        # (A terminal-state grammar lane can commit 0 — the consume
+        # pass retires it immediately, settling the over-count.)
         sched = np.where(live, 1, 0)
         self._inflight_sched += sched
         self._ring.append(dict(
@@ -1620,9 +1820,50 @@ class ContinuousBatchingServer:
             counts_full=jnp.where(prev_active, counts_raw, 0),
             drafted=drafted, accepted=accepted,
             active_after=self._state["active"], steps=1, sched=sched,
-            serial=self._slot_serial.copy()))
+            serial=self._slot_serial.copy(), width=k + 1,
+            caps=caps_host,
+            drafted_host=(int(caps_host[live].sum())
+                          if caps_host is not None else None),
+            cons=(cons_live.copy() if cons_live is not None else None),
+            forced=(forced_counts.copy()
+                    if forced_counts is not None else None)))
         self._note_dispatch()
         return True
+
+    def _draft_propose(self, st, k: int, draft_key):
+        """Model-mode proposer hook (cache-layout strategy): run the
+        paired draft ``k`` ragged decode steps from the resident
+        state.  Contiguous layout decodes against the draft's own
+        (slots, max_seq) cache; the paged server overrides this with
+        the pool-resident draft (``decode_chunk_paged`` over the
+        target's block tables).  Returns ``(proposals (slots, k),
+        draft_logits | None)``."""
+        draft, llama = self._draft, self._llama
+        if draft_key is not None:
+            proposals, draft_logits, _, _, draft["cache"] = \
+                llama.decode_chunk_ragged(
+                    draft["params"], st["token"], draft["cache"],
+                    st["positions"], st["active"], k, draft["config"],
+                    temperatures=st["temps"], top_ps=st["tops"],
+                    rng_key=draft_key, return_logits=True)
+            return proposals, draft_logits
+        proposals, _, _, draft["cache"] = llama.decode_chunk_ragged(
+            draft["params"], st["token"], draft["cache"],
+            st["positions"], st["active"], k, draft["config"])
+        return proposals, None
+
+    def _draft_resync(self, st, resync, prev_positions,
+                      prev_active) -> None:
+        """Draft-cache resync hook: replay committed[:-1] so the
+        draft's KV matches the target's committed history before the
+        next round (spans positions+1 onward, zero-padded; idempotent
+        rewrites — stale pad rows are rewritten before they become
+        attendable, the same policy as
+        models.speculative._resync_draft)."""
+        draft = self._draft
+        _, draft["cache"] = self._llama.verify_chunk_ragged(
+            draft["params"], resync, draft["cache"],
+            prev_positions + 1, prev_active, draft["config"])
 
     def _spec_verify(self, st, chunk, lora):
         """Target-verify dispatch hook (cache-layout strategy): score
@@ -1763,8 +2004,14 @@ class ContinuousBatchingServer:
             spec = entry["kind"] == "spec"
             if spec:
                 self.spec_stats.target_passes += 1
-                self.spec_stats.drafted += int(
-                    np.asarray(entry["drafted"]))
+                # Adaptive rounds proposed each slot only its CAP, not
+                # the window width the device program sees — the host
+                # snapshot is the truthful "drafted" count.
+                if entry.get("drafted_host") is not None:
+                    self.spec_stats.drafted += entry["drafted_host"]
+                else:
+                    self.spec_stats.drafted += int(
+                        np.asarray(entry["drafted"]))
                 self.spec_stats.accepted += int(
                     np.asarray(entry["accepted"]))
             live = batch_live[index]
@@ -1782,11 +2029,17 @@ class ContinuousBatchingServer:
                          else count_list)
             active_list = entry["active_after"].tolist()
             committed_upper += int(entry["counts"].sum())
+            cons_mask = entry.get("cons") if spec else None
+            forced_ct = entry.get("forced") if spec else None
+            caps_snap = entry.get("caps") if spec else None
             for slot in np.nonzero(live)[0]:
                 slot = int(slot)
                 touched_slots.add(slot)
                 request = self._requests[slot]
                 count = count_list[slot]
+                constrained = (cons_mask is not None
+                               and bool(cons_mask[slot]))
+                must_retire = not active_list[slot]
                 if count:
                     if request.first_token_ts is None:
                         request.first_token_ts = now
@@ -1805,15 +2058,43 @@ class ContinuousBatchingServer:
                         # rejected tail into its block-rollback
                         # accounting.
                         self._note_spec_rollback(slot, advance,
-                                                 self._draft["k"] + 1)
+                                                 entry["width"])
                         if request.spec_accepted_rounds is None:
                             request.spec_accepted_rounds = []
                         request.spec_accepted_rounds.append(advance - 1)
+                        if constrained:
+                            self.spec_stats.jump_forward_tokens += min(
+                                int(forced_ct[slot]), count)
                     self.positions[slot] += advance
                     self.tokens[slot, 0] = token_rows[slot][advance - 1] \
                         if spec else token_rows[slot][count - 1]
                     delivered += count
-                if not active_list[slot]:
+                if spec and caps_snap is not None and not constrained \
+                        and self._spec is not None \
+                        and self._spec["controller"] is not None:
+                    # Acceptance feedback at the cap the round ran
+                    # under for THIS slot (k=0 ticks the re-probe
+                    # counter instead).  Grammar rows are excluded:
+                    # their acceptance is the grammar's, not the
+                    # request's predictability.
+                    self._spec["controller"].observe(
+                        slot, int(caps_snap[slot]),
+                        (full_list[slot] - 1) if count else 0)
+                if constrained and self._autostates[slot] >= 0:
+                    # Advance the host automaton over the DELIVERED
+                    # tokens; a terminal state (no legal continuation)
+                    # ends the request — grammar rounds serialize, so
+                    # nothing else is in flight for this lane.
+                    table = self._automata["table"]
+                    state = int(self._autostates[slot])
+                    for tok in token_rows[slot][:count]:
+                        state = table.advance(state, int(tok))
+                        if state < 0:
+                            break
+                    self._autostates[slot] = state
+                    if state < 0 or table.is_terminal(state):
+                        must_retire = True
+                if must_retire:
                     self._retire(slot)
                     batch_live[index + 1:, slot] = False
         self.counters["tokens_committed"] += delivered
@@ -1950,11 +2231,12 @@ class ContinuousBatchingServer:
             sync_stalls_per_100_steps=(
                 round(100.0 * self.counters["host_syncs"] / steps, 2)
                 if steps else 0.0))
-        if self._draft is not None:
+        if self._spec is not None:
             # Speculation counters (host-side SpecStats increments in
             # _consume_one — never traced, invariant 7).
+            controller = self._spec["controller"]
             out.update(
-                spec_k=self._draft["k"],
+                spec_k=self._spec["k"],
                 spec_rounds=self.spec_stats.target_passes,
                 spec_proposed=self.spec_stats.drafted,
                 spec_accepted=self.spec_stats.accepted,
@@ -1962,7 +2244,13 @@ class ContinuousBatchingServer:
                     self.spec_stats.acceptance_rate, 4),
                 spec_tokens_per_target_pass=round(
                     self.spec_stats.tokens_per_target_pass, 4),
-                spec_rollback_blocks=self.spec_stats.rollback_blocks)
+                spec_rollback_blocks=self.spec_stats.rollback_blocks,
+                spec_draft_mode=self._spec["mode"],
+                spec_k_effective=(controller.hist_string()
+                                  if controller is not None else "-"),
+                spec_jump_forward_tokens=(
+                    self.spec_stats.jump_forward_tokens),
+                spec_ngram_hits=self.spec_stats.ngram_hits)
         if compiles.LEDGER is not None:
             # Compile-ledger view (PR 14): rides EC shares via
             # TELEMETRY_KEYS so the router's steady-compile watch and
@@ -1979,6 +2267,65 @@ class ContinuousBatchingServer:
             out.update(device_step_ms=round(self._device_step_ms, 3),
                        profiles=self._profiles)
         return out
+
+    def warm_spec_ladder(self, sampled: bool = False) -> None:
+        """Pre-compile every spec-round program shape the ladder can
+        reach — call while the engine is IDLE (no live slots, empty
+        ring): each rung's proposer/verify/accept/commit programs run
+        once against the real all-inactive resident state (inactive
+        rows write the scratch row/block and the commit is a masked
+        no-op, so state content is unchanged).  After this, adaptive k
+        can wander the whole ladder without a single steady-state
+        compile — the PR-14 ledger gate
+        (``aiko_compiles_steady_state_total == 0``) survives
+        adaptivity by construction.  ``sampled=True`` additionally
+        warms the MRS/sampled-draft variants."""
+        if self._spec is None:
+            return
+        if self.slots_active or self._ring:
+            raise RuntimeError(
+                "warm_spec_ladder must run on an idle engine")
+        jnp, jax = self._jnp, self._jax
+        from ..models.speculative import (delta_draft_logits,
+                                          greedy_accept_batch,
+                                          mrs_accept_batch,
+                                          spec_commit)
+        adaptive = self._spec["controller"] is not None
+        for k in self._spec["ladder"]:
+            if k == 0:
+                continue       # the plain chunk program; warmed by
+                               # ordinary traffic/warmup
+            if compiles.LEDGER is not None:
+                compiles.set_label("spec_round", f"k{k}")
+            st = self._state
+            draft_key = (jax.random.PRNGKey(0) if sampled else None)
+            if self._spec["mode"] == "model":
+                proposals, draft_logits = self._draft_propose(
+                    st, k, draft_key)
+            else:
+                proposals = jnp.zeros((self.slots, k), jnp.int32)
+                draft_logits = (delta_draft_logits(
+                    proposals, self.config.vocab_size)
+                    if sampled else None)
+            chunk = jnp.concatenate([st["token"], proposals], axis=1)
+            logits = self._spec_verify(st, chunk, None)
+            caps = (jnp.zeros((self.slots,), jnp.int32)
+                    if adaptive else None)
+            if sampled:
+                window, counts_raw = mrs_accept_batch(
+                    logits, draft_logits, proposals, st["temps"],
+                    st["tops"], jax.random.PRNGKey(1), caps=caps)
+            else:
+                window, counts_raw = greedy_accept_batch(
+                    logits, proposals, caps=caps)
+            prev_positions = st["positions"]
+            prev_active = st["active"]
+            _, _, _, _, resync, self._state = spec_commit(
+                st, window, counts_raw,
+                eos_id=-1 if self.eos_id is None else int(self.eos_id))
+            if self._spec["mode"] == "model":
+                self._draft_resync(st, resync, prev_positions,
+                                   prev_active)
 
     def run_until_drained(self, max_chunks: int = 10_000):
         """Synchronous helper (tests / batch jobs): pump until every
@@ -2092,6 +2439,8 @@ class ContinuousReplica(Actor):
                 int(np.asarray(inputs.get("stream", 0))))
             adapter = inputs.get("adapter")
             request.adapter = str(adapter) if adapter else None
+            automaton = inputs.get("automaton")
+            request.automaton = str(automaton) if automaton else None
             deadline_ms = inputs.get("deadline_ms")
             if deadline_ms is not None:
                 # Relative budget → local monotonic deadline (wall

@@ -114,6 +114,8 @@ class PagedContinuousServer(ContinuousBatchingServer):
                  draft_config_name: Optional[str] = None,
                  draft_params=None, spec_k: int = 4,
                  draft_quantize: bool = False,
+                 draft_mode: str = "auto", spec_ladder=None,
+                 spec_adaptive: bool = False, automata=None,
                  compilation_cache_dir: Optional[str] = None,
                  compact_upload: bool = True,
                  ring_max: Optional[int] = None):
@@ -156,6 +158,8 @@ class PagedContinuousServer(ContinuousBatchingServer):
                          draft_config_name=draft_config_name,
                          draft_params=draft_params, spec_k=spec_k,
                          draft_quantize=draft_quantize,
+                         draft_mode=draft_mode, spec_ladder=spec_ladder,
+                         spec_adaptive=spec_adaptive, automata=automata,
                          compilation_cache_dir=compilation_cache_dir,
                          compact_upload=compact_upload,
                          ring_max=ring_max)
@@ -208,6 +212,30 @@ class PagedContinuousServer(ContinuousBatchingServer):
             self._tp_engine = self._llama_tp.TPEngine(
                 self.config, self._mesh, self.params, self.pool,
                 axis=self.replica_mesh.axis)
+        if self._draft is not None:
+            # Draft KV lives IN the paged tier (PR 17): its own pool
+            # with the target's exact geometry (usable+1 blocks of
+            # block_size), NAVIGATED BY THE TARGET'S BLOCK TABLES —
+            # zero extra allocator bookkeeping, and the memory is
+            # census-visible (``draft`` section of pool_census)
+            # instead of a hidden slots×max_seq contiguous slab.
+            # Sharing tables is safe because draft KV only ever
+            # affects PROPOSAL QUALITY, never committed output
+            # (acceptance always verifies against the target):
+            # prefix-cache-shared blocks get identical draft content
+            # (same tokens ⇒ same prefill), and any block-reuse
+            # staleness costs at most a rejected proposal.
+            self._draft.pop("cache", None)
+            draft_pool = self._llama.init_paged_cache(
+                self._draft["config"], usable + 1, block_size)
+            if self._mesh is not None:
+                # Replicated on the mesh (the draft runs the plain
+                # jitted paged programs on every chip — no
+                # collectives, identical proposal streams: the same
+                # TP-parity argument as the contiguous draft cache).
+                draft_pool = self._llama_tp.replicate(draft_pool,
+                                                      self._mesh)
+            self._draft["pool"] = draft_pool
         self.tables = np.zeros((self.slots, max_blocks), np.int32)
         self.total_blocks = usable
         self._free: List[int] = list(range(1, usable + 1))
@@ -464,12 +492,25 @@ class PagedContinuousServer(ContinuousBatchingServer):
             dtype = next(iter(_kvxfer._field_layout(self)))[2].name
         except StopIteration:
             dtype = ""
+        # Pool-resident draft KV (speculation v2, model mode): its own
+        # SECTION, not a tier — the draft pool shadows the target's
+        # block tables 1:1 (used count mirrors the target's) and never
+        # participates in the prefix-cache/host/disk tier flows the
+        # auditor balances, so the tier equations stay exact.
+        draft_section = None
+        draft_block_bytes = self._draft_block_nbytes()
+        if draft_block_bytes:
+            draft_section = dict(
+                block_bytes=draft_block_bytes,
+                total_blocks=self.total_blocks,
+                blocks=used, bytes=used * draft_block_bytes)
         return dict(
             ts=time.time(), dtype=dtype, block_bytes=block_bytes,
             total_blocks=self.total_blocks,
             evict_clock=self._evict_clock,
             restore_queue_depth=len(self._restoring),
             adopted_chains=len(self._adopted_keys),
+            draft=draft_section,
             tiers=dict(
                 hbm=dict(blocks=used, bytes=used * block_bytes),
                 host=dict(blocks=len(self._host),
@@ -510,8 +551,9 @@ class PagedContinuousServer(ContinuousBatchingServer):
         the (k+1)-token window lands at ``[pos, pos + k + 1)``, so a
         spec-enabled reservation covers k+1 rows beyond the plain
         worst case (the admission check already bounds prompt + new +
-        k + 1 by max_seq, so this never overflows a table)."""
-        return self._draft["k"] + 1 if self._draft is not None else 0
+        k + 1 by max_seq, so this never overflows a table).  Sized by
+        the LADDER TOP — adaptive rounds can only narrow."""
+        return self._spec["k"] + 1 if self._spec is not None else 0
 
     def _worst_case_blocks(self, prompt_len: int, max_new: int) -> int:
         from .continuous import _bucket
@@ -1418,11 +1460,12 @@ class PagedContinuousServer(ContinuousBatchingServer):
         Only when no decode can be scheduled do slices run standalone,
         one per prefilling slot per step.  SPECULATIVE rounds never
         run the mixed step (the verify chunk is its own program), so
-        with a draft configured the slices always advance standalone —
-        interleaved between spec rounds, one slice per step."""
+        with speculation enabled — any draft mode — the slices always
+        advance standalone, interleaved between spec rounds, one
+        slice per step."""
         if not self._prefilling:
             return
-        if self._draft is None and (self._plan_remaining() > 0).any():
+        if self._spec is None and (self._plan_remaining() > 0).any():
             return
         llama, jnp = self._llama, self._jnp
         for slot in list(self._prefilling):
@@ -1582,6 +1625,70 @@ class PagedContinuousServer(ContinuousBatchingServer):
         last_committed = (pos + advance - 1) // block_size
         self.spec_stats.rollback_blocks += max(
             0, last_written - last_committed)
+
+    def _prefill_draft_rows(self, slots_list, prompts) -> None:
+        """Pool-resident draft admission: prefill the whole padded
+        prompt into a batch-sized contiguous bucket (the draft is
+        small — one dispatch), then scatter each row into the slot's
+        TARGET-table-resolved draft-pool blocks.  Bucket sizes are
+        block multiples by construction (the paged bucket floor is
+        ``block_size``), so the insert is exact."""
+        draft, jnp = self._draft, self._jnp
+        padded = prompts.shape[1]
+        if compiles.LEDGER is not None:
+            compiles.set_label("draft_prefill",
+                               f"b{padded}x{len(slots_list)}")
+        bucket = self._llama.init_cache(draft["config"],
+                                        len(slots_list), padded)
+        _, bucket = self._llama.prefill(
+            draft["params"], jnp.asarray(prompts), bucket,
+            draft["config"])
+        tables = jnp.asarray(self.tables)
+        for index, slot in enumerate(slots_list):
+            row = [{key: buf[index:index + 1]
+                    for key, buf in layer.items()} for layer in bucket]
+            draft["pool"] = self._llama.paged_insert_prefix(
+                draft["pool"], tables, row, jnp.int32(slot))
+
+    def _draft_propose(self, st, k: int, draft_key):
+        """Paged draft proposer: ``decode_chunk_paged`` against the
+        draft pool, navigating the TARGET'S resident block tables
+        (same geometry — see _init_layout).  Plain jitted even under
+        a replica mesh: the draft is replicated, every chip computes
+        the identical proposal stream (no collectives), so TP spec
+        greedy stays bitwise the single-chip server's."""
+        draft, llama = self._draft, self._llama
+        if draft_key is not None:
+            proposals, draft_logits, _, _, draft["pool"] = \
+                llama.decode_chunk_paged(
+                    draft["params"], st["token"], draft["pool"],
+                    st["tables"], st["positions"], st["active"], k,
+                    draft["config"], temperatures=st["temps"],
+                    top_ps=st["tops"], rng_key=draft_key,
+                    return_logits=True)
+            return proposals, draft_logits
+        proposals, _, _, draft["pool"] = llama.decode_chunk_paged(
+            draft["params"], st["token"], draft["pool"], st["tables"],
+            st["positions"], st["active"], k, draft["config"])
+        return proposals, None
+
+    def _draft_resync(self, st, resync, prev_positions,
+                      prev_active) -> None:
+        draft = self._draft
+        _, draft["pool"] = self._llama.verify_chunk_paged(
+            draft["params"], resync, draft["pool"], st["tables"],
+            prev_positions + 1, prev_active, draft["config"])
+
+    def _draft_block_nbytes(self) -> int:
+        """HBM bytes one DRAFT-pool block holds across every layer
+        field (0 without a pool-resident draft)."""
+        if self._draft is None or "pool" not in self._draft:
+            return 0
+        total = 0
+        for layer in self._draft["pool"]:
+            for buf in layer.values():
+                total += buf.nbytes // buf.shape[0]
+        return int(total)
 
     # ------------------------------------------------------------- #
     # Distributed KV cache (kvstore subsystem) — ALL host-side: none

@@ -87,6 +87,11 @@ TELEMETRY_KEYS = (
     "spec_k", "spec_rounds", "spec_proposed", "spec_accepted",
     "spec_acceptance_rate", "spec_tokens_per_target_pass",
     "spec_rollback_blocks",
+    # Speculation v2 (PR 17): draft mode, per-slot effective-k
+    # histogram (adaptive controller), grammar jump-forward and
+    # n-gram self-draft counters
+    "spec_draft_mode", "spec_k_effective",
+    "spec_jump_forward_tokens", "spec_ngram_hits",
     # Compile ledger + device profiling (PR 14; present only when a
     # CompileLedger is installed / a profile bracket ran)
     "compiles", "compiles_steady_state", "compile_cache_hits",

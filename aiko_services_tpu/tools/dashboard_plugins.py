@@ -329,6 +329,15 @@ def model_replica_plugin(fields, variables) -> List[str]:
                 f" tok/pass, "
                 f"{_get(variables, 'spec_rollback_blocks', default=0)}"
                 f" rollback blocks")
+            mode = _get(variables, "spec_draft_mode", default=None)
+            if mode not in (None, "-"):
+                lines.append(
+                    f"  spec v2:   mode={mode}, "
+                    f"k_eff {_get(variables, 'spec_k_effective', default='-')}, "
+                    f"{_get(variables, 'spec_jump_forward_tokens', default=0)}"
+                    f" jump-forward tok, "
+                    f"{_get(variables, 'spec_ngram_hits', default=0)}"
+                    f" ngram hits")
     adapters = _get(variables, "adapters", default=None)
     if adapters not in (None, "-", ""):
         lines.append(f"  adapters:  {adapters}")

@@ -784,15 +784,24 @@ def _fleet_spec_stats(servers) -> Optional[Dict]:
     runs a draft).  Rates are recomputed from the summed raw counts —
     averaging per-replica rates would weight idle replicas equally."""
     totals: Dict[str, float] = {}
+    modes: set = set()
+    k_effs: list = []
     for server in servers:
         stats = server.stats()
         if "spec_rounds" not in stats:
             continue
         for key in ("spec_rounds", "spec_proposed", "spec_accepted",
-                    "spec_rollback_blocks"):
-            totals[key] = totals.get(key, 0) + int(stats[key])
+                    "spec_rollback_blocks", "spec_jump_forward_tokens",
+                    "spec_ngram_hits"):
+            totals[key] = totals.get(key, 0) + int(stats.get(key, 0))
+        modes.add(str(stats.get("spec_draft_mode", "model")))
+        k_eff = stats.get("spec_k_effective", "-")
+        if k_eff not in (None, "-"):
+            k_effs.append(str(k_eff))
     if not totals:
         return None
+    totals["spec_draft_mode"] = "|".join(sorted(modes))
+    totals["spec_k_effective"] = ";".join(k_effs) if k_effs else "-"
     proposed = totals["spec_proposed"]
     rounds = totals["spec_rounds"]
     totals["spec_acceptance_rate"] = round(
@@ -1675,6 +1684,197 @@ def run_spec_ab(spec_k: int = 4, n_requests: int = 24,
     return base, spec
 
 
+def command_automaton(vocab: int = 1024):
+    """Token grammar for the structured workload's agentic "tool
+    call" — a JSON-shaped command ``{ "action" : VERB , "args" : [
+    ARG{0..2} ] }`` where every skeleton token (braces, key names,
+    colons, commas) is the SOLE legal token in its state.  Those
+    single-token states chain into deterministic segments the
+    jump-forward path drafts for free: of the 8-11 generated tokens
+    only the verb and args are model choices."""
+    from ..models.constrained import automaton_from_rules
+
+    LBRACE, KEY_ACTION, COLON, COMMA = 10, 11, 12, 13
+    KEY_ARGS, LBRACK, RBRACK, RBRACE = 14, 15, 16, 17
+    VERBS, ARGS = (3, 4, 5), (6, 7, 8, 9)
+    return automaton_from_rules(
+        vocab=vocab,
+        rules={
+            0: [((LBRACE,), 1)],
+            1: [((KEY_ACTION,), 2)],      # ── forced: "action"
+            2: [((COLON,), 3)],           # ── forced: :
+            3: [(VERBS, 4)],              #    model picks the verb
+            4: [((COMMA,), 5)],           # ── forced: ,
+            5: [((KEY_ARGS,), 6)],        # ── forced: "args"
+            6: [((COLON,), 7)],           # ── forced: :
+            7: [((LBRACK,), 8)],          # ── forced: [
+            8: [(ARGS, 9), ((RBRACK,), 10)],
+            9: [(ARGS, 11), ((RBRACK,), 10)],
+            11: [((RBRACK,), 10)],        # ── forced: ] (args capped)
+            10: [((RBRACE,), 12)],        # ── forced: }
+            12: [],                       # terminal
+        },
+        accepting=[12])
+
+
+def structured_payloads(n_contexts: int = 3, context_len: int = 32,
+                        tail_len: int = 8, max_new_tokens: int = 16,
+                        vocab: int = 1024, seed: int = 0,
+                        constrained: bool = True
+                        ) -> Callable[[int], Dict]:
+    """Agentic structured-output traffic: ``n_contexts`` shared "tool
+    context" prefixes (the agent scaffold every turn re-sends — prefix
+    cache food) each followed by a fresh per-request observation tail,
+    answered with a grammar-constrained command (``automaton="cmd"``).
+    Greedy on purpose: the constrained-vs-unconstrained A/B compares
+    goodput over IDENTICAL deterministic payloads.  ``constrained=
+    False`` emits the same sequence without the automaton field — the
+    B side of the goodput A/B."""
+    import numpy as np
+
+    rng = np.random.RandomState(seed)
+    contexts = [rng.randint(1, vocab, size=context_len)
+                .astype(np.int32) for _ in range(n_contexts)]
+
+    def payload_fn(index: int) -> Dict:
+        context = contexts[index % n_contexts]
+        tail = np.asarray(
+            [1 + (7451 * (index + 1) + 17 * position) % (vocab - 1)
+             for position in range(tail_len)], np.int32)
+        payload = {"tokens": np.concatenate([context, tail]),
+                   "max_new_tokens": max_new_tokens,
+                   "temperature": 0.0}
+        if constrained:
+            payload["automaton"] = "cmd"
+        return payload
+
+    return payload_fn
+
+
+def run_structured(n_requests: int = 24, rate_hz: float = 50.0,
+                   spec_k: int = 4, draft_mode: str = "ngram",
+                   chaos: bool = False,
+                   drain_timeout_s: float = 90.0,
+                   seed: int = 0
+                   ) -> Tuple[LoadReport, LoadReport]:
+    """Structured-output workload gate: the SAME seeded agentic
+    payload sequence through an automaton-equipped 2-replica paged
+    rig, once grammar-constrained and once free-running, returning
+    ``(constrained_report, unconstrained_report)``.  Three checks ride
+    on it: every constrained final is accepted by the grammar (chaos
+    replays included — half-committed automaton state leaking across a
+    re-dispatch would surface here as an ungrammatical final), the
+    fleet counters carry non-zero ``spec_jump_forward_tokens`` (the
+    skeleton segments really were drafted, not decoded), and the pair
+    of reports gives the constrained-vs-unconstrained goodput A/B
+    (``tokens_total / elapsed_s``; constrained wins when jump-forward
+    commits the skeleton in bulk).  ``chaos=True`` arms the standard
+    :func:`chaos_schedule` for BOTH sides.  ``draft_mode="ngram"``
+    (default) runs model-free — the structured gate composes with
+    self-drafting and needs no second model."""
+    from ..orchestration.continuous import ContinuousReplica
+    from ..orchestration.paged import PagedContinuousServer
+    from ..orchestration.serving import ReplicaRouter
+    from ..registry import Registrar
+    from ..runtime import (Process, actor_args, compose_instance,
+                           faults)
+    from ..runtime.event import EventEngine
+
+    automaton = command_automaton()
+
+    def one_pass(constrained: bool) -> LoadReport:
+        def wait_for(predicate, timeout_s: float, what: str):
+            deadline = time.time() + timeout_s
+            while not predicate():
+                if time.time() > deadline:
+                    raise TimeoutError(f"structured rig: {what}")
+                time.sleep(0.02)
+
+        plan = faults.install(chaos_schedule(seed)) if chaos else None
+        engine = EventEngine()
+        thread = engine.run_in_thread()
+        broker = f"structured-{uuid.uuid4().hex[:6]}"
+        processes = []
+
+        def make_process(pid):
+            process = Process(namespace="structured", hostname="h",
+                              pid=str(pid), engine=engine,
+                              broker=broker)
+            processes.append(process)
+            return process
+
+        generator = None
+        servers = []
+        try:
+            registrar = Registrar(process=make_process(1))
+            wait_for(lambda: registrar.state == "primary", 10,
+                     "registrar primary")
+            for index, name in enumerate(("replica_a", "replica_b")):
+                server = PagedContinuousServer(
+                    config_name="tiny", slots=2, chunk_steps=4,
+                    seed=0, enable_prefix_cache=True, max_queue=256,
+                    watchdog_s=5.0,
+                    draft_mode=draft_mode,
+                    draft_config_name=("tiny" if draft_mode == "model"
+                                       else None),
+                    spec_k=spec_k,
+                    automata={"cmd": automaton})
+                if draft_mode == "model":
+                    _enable_paired_draft(server, spec_k)
+                servers.append(server)
+                compose_instance(ContinuousReplica, actor_args(name),
+                                 process=make_process(2 + index),
+                                 server=server)
+            router = compose_instance(
+                ReplicaRouter, actor_args("router"),
+                process=make_process(8), kv_transfer=True)
+            wait_for(lambda: router.share["replicas"] == 2, 30,
+                     "router discovery")
+            generator = LoadGenerator(
+                make_process(9), f"{router.topic_path}/in",
+                payload_fn=structured_payloads(
+                    seed=seed, constrained=constrained),
+                rate_hz=rate_hz)
+            report = generator.run(n_requests,
+                                   drain_timeout_s=drain_timeout_s)
+            report.final_tokens = dict(generator.final_tokens)
+            report.fleet_latency_ms = fleet_latency(servers)
+            report.spec_stats = _fleet_spec_stats(servers)
+            report.spec_accept_hist = dict(generator.spec_accept_hist)
+            report.server_stats = dict(router.counters)
+            if plan is not None:
+                report.server_stats["faults_fired"] = len(plan.fired)
+            return report
+        finally:
+            if chaos:
+                faults.uninstall()
+            if generator is not None:
+                generator.close()
+            for process in reversed(processes):
+                try:
+                    process.terminate()
+                except Exception:  # noqa: BLE001 - teardown (chaos may
+                    pass           # have killed this process already)
+            engine.terminate()
+            thread.join(timeout=5)
+
+    cons = one_pass(constrained=True)
+    free = one_pass(constrained=False)
+    bad = [request_id for request_id, tokens
+           in sorted(cons.final_tokens.items())
+           if not automaton.accepts(list(tokens))]
+    if bad:
+        raise AssertionError(
+            f"structured workload: {len(bad)}/{len(cons.final_tokens)}"
+            f" constrained finals ungrammatical (seed={seed}, "
+            f"chaos={chaos}), first {bad[0]}")
+    if not cons.final_tokens:
+        raise AssertionError(
+            "structured workload: zero constrained finals — the "
+            "grammar gate proved nothing")
+    return cons, free
+
+
 def diurnal_trace(duration_s: float, base_hz: float = 2.0,
                   peak_hz: float = 12.0, period_s: float = 8.0,
                   burst_hz: float = 0.0, burst_every_s: float = 0.0,
@@ -2043,8 +2243,13 @@ def main(argv: Optional[List[str]] = None) -> int:
                              "lost/duplicated and converged)")
     parser.add_argument("--workload",
                         choices=["shared_prefix", "diurnal",
-                                 "longtail"],
+                                 "longtail", "structured"],
                         help="named workload profile (in-process rig)")
+    parser.add_argument("--draft-mode", default="ngram",
+                        choices=["ngram", "model"],
+                        help="structured workload: proposer for the "
+                             "speculative path (ngram = model-free "
+                             "self-drafting)")
     parser.add_argument("--seed", type=int, default=0)
     parser.add_argument("--requests", type=int, default=40)
     parser.add_argument("--rate-hz", type=float, default=100.0)
@@ -2114,6 +2319,40 @@ def main(argv: Optional[List[str]] = None) -> int:
                              "(composes with --chaos: both sides run "
                              "the fault schedule)")
     args = parser.parse_args(argv)
+    if args.workload == "structured":
+        cons, free = run_structured(
+            n_requests=args.requests, rate_hz=args.rate_hz,
+            spec_k=args.spec_k or 4, draft_mode=args.draft_mode,
+            chaos=args.chaos, seed=args.seed)
+        print("constrained:  ", cons)
+        print("unconstrained:", free)
+        stats = cons.spec_stats or {}
+        cons_tps = (cons.tokens_total / cons.elapsed_s
+                    if cons.elapsed_s else 0.0)
+        free_tps = (free.tokens_total / free.elapsed_s
+                    if free.elapsed_s else 0.0)
+        print(f"fleet spec counters: {stats}")
+        print(f"goodput A/B: constrained {cons_tps:.1f} tok/s "
+              f"({stats.get('spec_jump_forward_tokens', 0)} "
+              f"jump-forward tok) vs unconstrained {free_tps:.1f} "
+              f"tok/s")
+        failed = (cons.lost or cons.timeouts or free.lost
+                  or free.timeouts
+                  or (args.chaos and (cons.duplicate_finals
+                                      or free.duplicate_finals)))
+        if failed:
+            print(f"STRUCTURED FAIL (seed={args.seed}): "
+                  f"{cons.lost}+{free.lost} lost, "
+                  f"{cons.timeouts}+{free.timeouts} hung, "
+                  f"{cons.duplicate_finals}+{free.duplicate_finals} "
+                  f"duplicated")
+            return 1
+        mode = "chaos" if args.chaos else "steady"
+        print(f"STRUCTURED OK ({mode}, seed={args.seed}): all "
+              f"constrained finals grammatical, "
+              f"{stats.get('spec_jump_forward_tokens', 0)} skeleton "
+              f"tokens jump-forwarded")
+        return 0
     if args.spec_k:
         base, spec = run_spec_ab(
             spec_k=args.spec_k, n_requests=args.requests,

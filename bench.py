@@ -1407,6 +1407,208 @@ def bench_serving_spec(slots=4, prompt_len=64, max_new=64,
     return out
 
 
+def bench_spec_v2(slots=4, prompt_len=24, hot_new=96, cold_new=224,
+                  config_name="tiny", chunk_steps=4, spec_k=4):
+    """Speculation v2 cells: model-free n-gram self-drafting, the
+    adaptive per-slot-k controller, grammar jump-forward, the
+    compile-ledger fence across the whole k ladder, and the pool
+    auditor with the draft KV living in the paged pool.
+
+    The MIXED-ACCEPTANCE trace drives the adaptive-vs-fixed A/B: half
+    the requests are greedy continuations of short repeated cycles
+    (the n-gram proposer's food — acceptance climbs as the output
+    cycles) and half are temperature-1 sampled traffic (over a 1k
+    vocab the output ~never repeats an n-gram, so acceptance pins at
+    ~0 forever) running on ~2.3x longer, i.e. ALONE at the tail.  A
+    fixed k keeps paying full-width verify rounds for the sampled
+    stragglers; the controller demotes them to k=0 (plain decode) and
+    keeps k high only where acceptance lives — so adaptive must come
+    out ≥ fixed on tokens/target-pass, and the n-gram proposer alone
+    (no draft model anywhere) must clear 1.0.  Greedy rows stay
+    bitwise-identical to the plain server in every cell."""
+    from aiko_services_tpu.obs import compiles, pool_audit
+    from aiko_services_tpu.orchestration.continuous import (
+        DecodeRequest,
+    )
+    from aiko_services_tpu.orchestration.paged import (
+        PagedContinuousServer,
+    )
+    from aiko_services_tpu.tools.loadgen import command_automaton
+
+    def mixed_trace(vocab, seed=7):
+        rng = np.random.default_rng(seed)
+        trace = []
+        for index in range(max(4, slots)):
+            if index % 2 == 0:
+                cycle = rng.integers(1, vocab, 4)
+                prompt = np.tile(cycle, prompt_len // 4 + 1)
+                trace.append((prompt[:prompt_len].astype(np.int32),
+                              hot_new, 0.0))
+            else:
+                trace.append((rng.integers(1, vocab, prompt_len)
+                              .astype(np.int32), cold_new, 1.0))
+        return trace
+
+    def run_trace(server, tag):
+        requests = [DecodeRequest(
+            request_id=f"{tag}{index}", prompt=prompt,
+            max_new_tokens=max_new, temperature=temperature)
+            for index, (prompt, max_new, temperature)
+            in enumerate(mixed_trace(server.config.vocab_size))]
+        for request in requests:
+            server.submit(request)
+        started = time.perf_counter()
+        server.run_until_drained()
+        elapsed = time.perf_counter() - started
+        tokens = sum(len(r.tokens) for r in requests)
+        greedy = {index: list(r.tokens) for index, r
+                  in enumerate(requests) if index % 2 == 0}
+        return greedy, tokens / elapsed, server.stats()
+
+    def build(**kwargs):
+        return PagedContinuousServer(
+            config_name=config_name, slots=slots,
+            chunk_steps=chunk_steps, seed=7, **kwargs)
+
+    out = {}
+    # ── adaptive-k / n-gram A/B on the mixed-acceptance trace ─────
+    greedy_plain, plain_tps, _ = run_trace(build(), "p")
+    out["spec_v2_plain_tokens_per_sec"] = round(plain_tps)
+    log(f"spec_v2 plain: {plain_tps:.0f} tok/s")
+    cells = {}
+    for tag, kwargs in (
+            ("ngram", dict(draft_mode="ngram", spec_k=spec_k)),
+            ("adaptive", dict(draft_mode="ngram", spec_k=spec_k,
+                              spec_adaptive=True))):
+        greedy, tps, stats = run_trace(build(**kwargs), tag[0])
+        if greedy != greedy_plain:
+            raise AssertionError(
+                f"spec_v2: {tag} greedy rows diverged from plain — "
+                f"the bitwise invariant is broken")
+        cells[tag] = stats
+        out[f"spec_v2_{tag}_tokens_per_sec"] = round(tps)
+        out[f"spec_v2_{tag}_tokens_per_target_pass"] = \
+            stats["spec_tokens_per_target_pass"]
+        out[f"spec_v2_{tag}_ngram_hits"] = stats["spec_ngram_hits"]
+        log(f"spec_v2 {tag}: {tps:.0f} tok/s, "
+            f"{stats['spec_tokens_per_target_pass']} tok/target-pass,"
+            f" {stats['spec_ngram_hits']} ngram hits, k_eff "
+            f"{stats['spec_k_effective']} — greedy rows exact")
+    if cells["ngram"]["spec_tokens_per_target_pass"] <= 1.0:
+        raise AssertionError(
+            "spec_v2: n-gram self-drafting did not clear 1.0 "
+            "tokens/target-pass — the model-free proposer never "
+            "had a proposal accepted")
+    if cells["adaptive"]["spec_tokens_per_target_pass"] \
+            < cells["ngram"]["spec_tokens_per_target_pass"]:
+        raise AssertionError(
+            f"spec_v2: adaptive k "
+            f"({cells['adaptive']['spec_tokens_per_target_pass']}) "
+            f"lost to fixed k "
+            f"({cells['ngram']['spec_tokens_per_target_pass']}) on "
+            f"tokens/target-pass over the mixed-acceptance trace — "
+            f"the controller is demoting the wrong slots")
+
+    # ── grammar jump-forward through the paged verify path ────────
+    automaton = command_automaton()
+    server = build(draft_mode="ngram", spec_k=spec_k,
+                   automata={"cmd": automaton})
+    rng = np.random.default_rng(7)
+    requests = [DecodeRequest(
+        request_id=f"j{index}",
+        prompt=rng.integers(1, server.config.vocab_size,
+                            prompt_len).astype(np.int32),
+        max_new_tokens=16, automaton="cmd")
+        for index in range(max(4, slots))]
+    for request in requests:
+        server.submit(request)
+    started = time.perf_counter()
+    server.run_until_drained()
+    structured_tps = sum(len(r.tokens) for r in requests) \
+        / (time.perf_counter() - started)
+    for request in requests:
+        if not automaton.accepts(list(request.tokens)):
+            raise AssertionError(
+                f"spec_v2: constrained output {request.request_id} "
+                f"is not grammatical: {list(request.tokens)}")
+    stats = server.stats()
+    if not stats["spec_jump_forward_tokens"]:
+        raise AssertionError(
+            "spec_v2: zero jump-forward tokens — the deterministic "
+            "grammar segments were decoded, not drafted")
+    out["spec_v2_structured_tokens_per_sec"] = round(structured_tps)
+    out["spec_v2_structured_jump_forward_tokens"] = \
+        stats["spec_jump_forward_tokens"]
+    out["spec_v2_structured_tokens_per_target_pass"] = \
+        stats["spec_tokens_per_target_pass"]
+    log(f"spec_v2 structured: {structured_tps:.0f} tok/s, "
+        f"{stats['spec_jump_forward_tokens']} jump-forward tokens, "
+        f"{stats['spec_tokens_per_target_pass']} tok/target-pass — "
+        f"all finals grammatical")
+
+    # ── compile-ledger fence across the whole ladder ──────────────
+    ledger_owned = compiles.LEDGER is None
+    ledger = compiles.install(service="bench-spec-v2")
+    try:
+        server = build(draft_mode="ngram", spec_k=spec_k,
+                       spec_adaptive=True)
+        run_trace(server, "w")          # warm every trace shape
+        server.warm_spec_ladder()       # …and every rung, greedy
+        server.warm_spec_ladder(sampled=True)  # …and MRS accept
+        warmup_compiles = ledger.compiles
+        ledger.fence()
+        _, fenced_tps, stats = run_trace(server, "f")
+        steady = ledger.steady_compiles
+        if steady:
+            offenders = sorted({
+                (entry["program"], entry["signature"])
+                for entry in ledger.snapshot()["records"]
+                if entry["steady"]})
+            raise AssertionError(
+                f"spec_v2: {steady} steady-state compile(s) while "
+                f"the controller walked the ladder — the fixed-rung "
+                f"shape discipline regressed: {offenders}")
+        out["spec_v2_ladder_warmup_compiles"] = warmup_compiles
+        out["spec_v2_ladder_steady_compiles"] = steady
+        out["spec_v2_fenced_tokens_per_sec"] = round(fenced_tps)
+        log(f"spec_v2 ladder fence: {warmup_compiles} warmup "
+            f"compiles, 0 steady across k_eff "
+            f"{stats['spec_k_effective']}, {fenced_tps:.0f} tok/s")
+    finally:
+        ledger.lift_fence()
+        if ledger_owned:
+            compiles.uninstall()
+
+    # ── pool audit with the draft KV inside the paged pool ────────
+    installed = pool_audit.AUDITOR is None
+    auditor = pool_audit.install(service="bench-spec-v2") \
+        if installed else pool_audit.AUDITOR
+    try:
+        server = build(draft_config_name=config_name, spec_k=spec_k)
+        server._draft["params"] = server.params
+        server._draft["config"] = server.config
+        run_trace(server, "a")
+        violations = auditor.sweep(server)
+        if violations:
+            raise AssertionError(
+                f"spec_v2: pool audit violations with the draft KV "
+                f"in the paged pool: {violations}")
+        census = server.pool_census()
+        draft = census.get("draft") or {}
+        # Census runs post-drain (blocks all freed), so report the
+        # pool's census-visible CAPACITY, not the momentary usage.
+        out["spec_v2_draft_pool_blocks"] = draft.get("total_blocks", 0)
+        out["spec_v2_draft_block_bytes"] = draft.get("block_bytes", 0)
+        out["spec_v2_audit_violations"] = len(violations or [])
+        log(f"spec_v2 draft pool: {draft.get('total_blocks', 0)} "
+            f"blocks x {draft.get('block_bytes', 0)} B "
+            f"census-visible, audit clean")
+    finally:
+        if installed:
+            pool_audit.uninstall()
+    return out
+
+
 def bench_kv_transfer(prefix_lens=(512, 2048, 8192),
                       routed_requests=16, routed_rate_hz=30.0):
     """Distributed KV-cache numbers: (1) cross-replica block
@@ -2755,6 +2957,16 @@ SECTIONS = [
          slots=2, prompt_len=24, max_new=8, n_requests=4,
          config_name="tiny", chunk_steps=4, ks=(4,)))
      if SMOKE else bench_serving_spec),
+    # Speculation v2: adaptive per-slot k vs fixed on a mixed-
+    # acceptance trace, model-free n-gram self-drafting (> 1.0
+    # tok/target-pass with no draft model), grammar jump-forward
+    # (all finals grammatical), the compile fence across the whole
+    # ladder, and the pool audit with draft KV in the paged pool.
+    ("spec_v2", 600,
+     (lambda: bench_spec_v2(
+         slots=2, prompt_len=24, hot_new=48, cold_new=112,
+         config_name="tiny", chunk_steps=4))
+     if SMOKE else bench_spec_v2),
     # Distributed KV cache: host-side transfer bandwidth (no device,
     # no compile) + routed-vs-load-only TTFT through the live rig
     # (tiny model, CPU-capable like serving_faults).

@@ -50,8 +50,13 @@ HIGHER_BETTER = ("per_sec", "_rps", "tok_s", "tokens_per", "hit_rate",
                  "mb_per", "gb_per",
                  # engine-vs-raw decode ratios: an efficiency fraction
                  # of raw throughput — up is good (checked before the
-                 # generic lower-is-better "ratio" cue below).
-                 "vs_raw_ratio")
+                 # generic lower-is-better "ratio" cue below).  The
+                 # bare "vs_raw" substring covers the net AND gross
+                 # variants ("..._vs_raw_gross_ratio" has no
+                 # "vs_raw_ratio" run, so the narrower cue missed it
+                 # and the generic "ratio" cue flagged improvements
+                 # as regressions).
+                 "vs_raw")
 
 #: Suffix/substring cues for lower-is-better metrics.
 LOWER_BETTER_SUFFIX = ("_ms", "_s", "_us", "_ns")

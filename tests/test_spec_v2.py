@@ -1,0 +1,554 @@
+"""Speculation v2: the adaptive per-slot-k controller, model-free
+n-gram self-drafting, and grammar jump-forward through the paged
+verify path.  Invariant 18: adaptive k, n-gram proposals, and grammar
+constraints are all LATENCY policy, never approximation — greedy
+outputs stay bitwise the plain server's (constrained slots: bitwise
+the masked-argmax oracle's), under every composition (int8 KV,
+chunked admission, prefix cache, TP=4)."""
+
+import ast
+import pathlib
+
+import numpy as np
+import pytest
+
+from aiko_services_tpu.models import llama
+from aiko_services_tpu.models.constrained import automaton_from_rules
+from aiko_services_tpu.models.speculative import ngram_propose
+from aiko_services_tpu.orchestration.continuous import DecodeRequest
+from aiko_services_tpu.orchestration.paged import PagedContinuousServer
+from aiko_services_tpu.orchestration.spec_control import (
+    SpecController, default_ladder, validate_ladder,
+)
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+PKG = REPO / "aiko_services_tpu"
+
+#: Mixed prompt lengths/budgets through 2 slots: queueing, slot reuse,
+#: ragged per-slot progress.
+SHAPES = [(5, 12), (11, 9), (3, 14), (17, 8)]
+
+LP, RP = 1, 2
+VERBS, ARGS = (3, 4, 5), (6, 7, 8, 9)
+
+
+@pytest.fixture
+def sexpr_automaton():
+    return automaton_from_rules(
+        vocab=1024,
+        rules={
+            0: [((LP,), 1)],
+            1: [(VERBS, 2)],
+            2: [(ARGS, 4), ((RP,), 3)],
+            4: [(ARGS, 5), ((RP,), 3)],
+            5: [(ARGS, 6), ((RP,), 3)],
+            6: [((RP,), 3)],
+            3: [],
+        },
+        accepting=[3])
+
+
+def _server(**kwargs):
+    defaults = dict(config_name="tiny", slots=2, max_seq=96,
+                    chunk_steps=4, block_size=16, seed=3)
+    defaults.update(kwargs)
+    return PagedContinuousServer(**defaults)
+
+
+def _drain(server, spec, seed=0, **request_kwargs):
+    rng = np.random.default_rng(seed)
+    requests = [DecodeRequest(
+        f"r{i}", rng.integers(1, server.config.vocab_size,
+                              plen).astype(np.int32),
+        new, **request_kwargs) for i, (plen, new) in enumerate(spec)]
+    for request in requests:
+        server.submit(request)
+    server.run_until_drained()
+    return requests
+
+
+def _outputs(requests):
+    return {r.request_id: list(r.tokens) for r in requests}
+
+
+# --------------------------------------------------------------------------- #
+# Controller units — pure host policy, no server, no jax.
+
+
+def test_default_ladder_pow2_buckets():
+    assert default_ladder(8) == (0, 2, 4, 8)
+    assert default_ladder(6) == (0, 2, 4, 6)   # ceiling always joins
+    assert default_ladder(4) == (0, 2, 4)
+    assert default_ladder(1) == (0, 1)
+
+
+def test_validate_ladder_names_the_ladder():
+    assert validate_ladder((0, 2, 4), bucket_floor=16) == (0, 2, 4)
+    with pytest.raises(ValueError, match=r"\(0, 2, 31\)"):
+        validate_ladder((0, 2, 31), bucket_floor=16)
+    with pytest.raises(ValueError, match="strictly increasing"):
+        validate_ladder((0, 4, 4), bucket_floor=16)
+    with pytest.raises(ValueError, match=">= 0"):
+        validate_ladder((-1, 2), bucket_floor=16)
+    with pytest.raises(ValueError, match="empty"):
+        validate_ladder((), bucket_floor=16)
+
+
+def test_controller_ema_convergence():
+    controller = SpecController(1, (0, 2, 4), ema_alpha=0.3)
+    controller.observe(0, k=4, accepted=2)
+    assert controller.ema[0] == 0.5          # first sample, no decay
+    for _ in range(60):
+        controller.observe(0, k=4, accepted=4)
+    assert controller.ema[0] == pytest.approx(1.0, abs=1e-6)
+    for _ in range(60):
+        controller.observe(0, k=4, accepted=0)
+    assert controller.ema[0] == pytest.approx(0.0, abs=1e-6)
+
+
+def test_controller_hysteresis_damps_single_rounds():
+    controller = SpecController(1, (0, 2, 4, 8), hysteresis=2)
+    controller.rung[0] = 1                   # parked at k=2
+    controller.observe(0, k=2, accepted=2)   # one hot round
+    assert controller.k_for(0) == 2          # ...does not promote
+    controller.observe(0, k=2, accepted=2)   # second consecutive
+    assert controller.k_for(0) == 4          # ...does
+    # One unlucky round never demotes either.
+    controller = SpecController(1, (0, 2, 4), hysteresis=2)
+    for _ in range(30):                      # drive EMA hot at top
+        controller.observe(0, k=4, accepted=4)
+    assert controller.k_for(0) == 4
+    controller.observe(0, k=4, accepted=0)
+    assert controller.k_for(0) == 4
+
+
+def test_controller_degrades_to_zero_and_probes_back():
+    controller = SpecController(1, (0, 2, 4), hysteresis=1,
+                                probe_every=3)
+    live = np.asarray([True])
+    for _ in range(10):
+        controller.observe(0, k=controller.k_for(0) or 1, accepted=0)
+    assert controller.k_for(0) == 0          # full degradation
+    assert controller.round_k(live) == 0     # round becomes plain
+    assert controller.caps(live)[0] == 0
+    # k=0 rounds carry no acceptance evidence — they tick the probe
+    # counter; after probe_every of them the slot re-probes the first
+    # non-zero rung with a clean EMA.
+    for _ in range(2):
+        controller.tick_cold_round(live)
+        assert controller.k_for(0) == 0
+    controller.tick_cold_round(live)
+    assert controller.k_for(0) == 2
+    assert np.isnan(controller.ema[0])
+
+
+def test_controller_round_k_is_max_live_rung_and_reset():
+    controller = SpecController(3, (0, 2, 4), hysteresis=1)
+    for _ in range(10):
+        controller.observe(0, k=4, accepted=0)   # slot 0 -> k=0
+    for _ in range(10):
+        controller.observe(1, k=4, accepted=1)   # slot 1 -> demotes
+    assert controller.k_for(0) == 0
+    assert controller.round_k(np.asarray([True, False, False])) == 0
+    assert controller.round_k(np.asarray([True, True, True])) == 4
+    # Dead lanes never contribute: slot 2 (untouched, top rung) off.
+    assert controller.round_k(np.asarray([True, True, False])) == \
+        controller.k_for(1)
+    controller.reset(0)                      # new request: optimistic
+    assert controller.k_for(0) == 4
+    assert np.isnan(controller.ema[0])
+
+
+def test_controller_hist_string():
+    controller = SpecController(2, (0, 2, 4))
+    assert controller.hist_string() == "-"
+    controller.note_dispatch(np.asarray([True, True]))
+    assert controller.hist_string() == "4:2"
+    controller.rung[0] = 0
+    controller.note_dispatch(np.asarray([True, False]))
+    assert controller.hist_string() == "0:1|4:2"
+
+
+# --------------------------------------------------------------------------- #
+# Satellite: construction-time ladder clamping (the old spec_k+1 > 16
+# ValueError, now bucket-floor-aware and naming the ladder).
+
+
+def test_construction_clamps_ladder_to_bucket_floor():
+    with pytest.raises(ValueError) as excinfo:
+        _server(draft_mode="ngram", spec_k=16)
+    message = str(excinfo.value)
+    assert "ladder" in message and "(0, 2, 4, 8, 16)" in message
+    assert "bucket floor" in message
+    # k+1 == block-size floor is the widest legal window.
+    server = _server(draft_mode="ngram", spec_k=15)
+    assert server.stats()["spec_k"] == 15
+    with pytest.raises(ValueError, match=r"\(0, 2, 31\)"):
+        _server(draft_mode="ngram", spec_k=4, spec_ladder=(0, 2, 31))
+
+
+def test_draft_mode_validation():
+    with pytest.raises(ValueError, match="draft_mode"):
+        _server(draft_mode="banana", spec_k=4)
+    with pytest.raises(ValueError, match="model"):
+        _server(draft_mode="model", spec_k=4)      # no draft config
+    with pytest.raises(ValueError, match="ngram"):
+        _server(draft_mode="ngram", draft_config_name="tiny",
+                spec_k=4)                          # contradictory
+    auto = _server(draft_mode="auto", draft_config_name="tiny",
+                   spec_k=4)
+    assert auto.stats()["spec_draft_mode"] == "model"
+    assert _server(draft_mode="auto").stats().get(
+        "spec_draft_mode") is None                 # auto + no draft
+
+
+# --------------------------------------------------------------------------- #
+# n-gram proposer: oracle parity against a direct python reference.
+
+
+def _ngram_oracle(history, k, max_ngram=3, min_ngram=1):
+    history = [int(t) for t in history]
+    n = len(history)
+    for ngram in range(min(max_ngram, n - 1), min_ngram - 1, -1):
+        pattern = history[n - ngram:]
+        matches = [start for start in range(n - ngram)
+                   if history[start:start + ngram] == pattern]
+        if not matches:
+            continue
+        continuation = history[matches[-1] + ngram:][:k]
+        return continuation + [0] * (k - len(continuation)), True
+    return [0] * k, False
+
+
+def test_ngram_propose_matches_oracle():
+    rng = np.random.default_rng(0)
+    checked_hits = 0
+    for trial in range(300):
+        vocab = int(rng.integers(2, 8))      # tiny vocab forces reuse
+        length = int(rng.integers(2, 40))
+        k = int(rng.integers(1, 6))
+        history = rng.integers(0, vocab, length)
+        proposals, hit = ngram_propose(history, k)
+        oracle, oracle_hit = _ngram_oracle(history, k)
+        assert hit == oracle_hit, (history, k)
+        assert proposals.tolist() == oracle, (history, k)
+        checked_hits += int(hit)
+    assert checked_hits > 100                # the sweep saw real hits
+
+
+def test_ngram_propose_prefers_longest_then_most_recent():
+    #                     0  1  2  3  4  5  6  7
+    history = np.asarray([7, 8, 9, 5, 7, 8, 3, 8])
+    # Suffix 1-gram (8,) recurs at 1 and 5 -> most recent match is 5,
+    # continuation starts at 6.
+    proposals, hit = ngram_propose(history, 3, max_ngram=1)
+    assert hit and proposals.tolist() == [3, 8, 0]
+    #                     0  1  2  3  4  5  6
+    history = np.asarray([4, 5, 6, 1, 4, 5, 6])
+    # 3-gram (4,5,6) beats the 1-gram match even though a 1-gram
+    # match exists later in the history.
+    proposals, hit = ngram_propose(history, 2)
+    assert hit and proposals.tolist() == [1, 4]
+
+
+# --------------------------------------------------------------------------- #
+# Bitwise gates: every v2 mode vs the plain paged server, with int8 KV
+# + chunked admission + prefix cache composed.
+
+
+COMPOSED = dict(enable_prefix_cache=True, quantize_kv=True,
+                chunk_prefill_tokens=16, total_blocks=24)
+
+
+def test_ngram_server_bitwise_composed():
+    base = _server(**COMPOSED)
+    base_requests = _drain(base, SHAPES)
+    server = _server(draft_mode="ngram", spec_k=4, **COMPOSED)
+    requests = _drain(server, SHAPES)
+    assert _outputs(requests) == _outputs(base_requests)
+    stats = server.stats()
+    assert stats["spec_draft_mode"] == "ngram"
+    assert stats["spec_rounds"] > 0
+    assert stats["spec_ngram_hits"] >= 0     # counter present + sane
+
+
+def test_adaptive_server_bitwise_composed():
+    base = _server(**COMPOSED)
+    base_requests = _drain(base, SHAPES)
+    server = _server(draft_config_name="tiny", spec_k=4,
+                     spec_adaptive=True, **COMPOSED)
+    server._draft["params"] = server.params  # paired: high acceptance
+    server._draft["config"] = server.config
+    requests = _drain(server, SHAPES)
+    assert _outputs(requests) == _outputs(base_requests)
+    stats = server.stats()
+    assert stats["spec_k_effective"] != "-"
+    assert stats["spec_tokens_per_target_pass"] > 1.0
+
+
+def test_adaptive_degraded_draft_bitwise_and_degrades():
+    """A never-accepting draft: the controller must park every slot at
+    k=0 (plain decode) and outputs stay bitwise plain."""
+    shapes = [(5, 24), (9, 24)]
+    base = _server()
+    base_requests = _drain(base, shapes)
+    server = _server(draft_config_name="tiny", spec_k=4,
+                     spec_adaptive=True)     # unpaired: acceptance ~0
+    requests = _drain(server, shapes)
+    assert _outputs(requests) == _outputs(base_requests)
+    hist = server.stats()["spec_k_effective"]
+    assert hist.startswith("0:"), hist       # k=0 rounds dominate
+
+
+def test_tp4_spec_v2_bitwise(virtual_mesh_devices):
+    """TP=4: the n-gram proposer (host-side) and the adaptive
+    controller compose with the TP paged engine — outputs bitwise the
+    SINGLE-CHIP plain server's under the full composition."""
+    from aiko_services_tpu.parallel.mesh import ReplicaMesh
+    shapes = [(5, 10), (11, 8), (3, 12), (17, 6)]
+    kwargs = dict(config_name="tiny_tp", slots=2, max_seq=96,
+                  chunk_steps=3, block_size=16, seed=5, **COMPOSED)
+    base = PagedContinuousServer(**kwargs)
+    base_requests = _drain(base, shapes)
+    ngram = PagedContinuousServer(replica_mesh=ReplicaMesh(tp=4),
+                                  draft_mode="ngram", spec_k=3,
+                                  **kwargs)
+    ngram_requests = _drain(ngram, shapes)
+    assert _outputs(ngram_requests) == _outputs(base_requests)
+    adaptive = PagedContinuousServer(replica_mesh=ReplicaMesh(tp=4),
+                                     draft_config_name="tiny_tp",
+                                     spec_k=3, spec_adaptive=True,
+                                     **kwargs)
+    adaptive._draft["params"] = adaptive.params
+    adaptive._draft["config"] = adaptive.config
+    adaptive_requests = _drain(adaptive, shapes)
+    assert _outputs(adaptive_requests) == _outputs(base_requests)
+    assert adaptive.stats()["spec_tokens_per_target_pass"] > 1.0
+
+
+# --------------------------------------------------------------------------- #
+# Grammar jump-forward: constrained greedy == the masked-argmax oracle.
+
+
+def _constrained_oracle(server, prompt, automaton, max_new):
+    """Host reference: batch-1 prefill, then step-by-step greedy with
+    the automaton masking each step's logits (argmax over allowed
+    tokens), stopping at an accepting state — what "unconstrained
+    greedy filtered through the automaton" means operationally."""
+    import jax
+    import jax.numpy as jnp
+    config = server.config
+    prompt = jnp.asarray(np.asarray(prompt, np.int32))[None, :]
+    position = prompt.shape[1]
+    cache = llama.init_cache(config, 1, server.max_seq)
+    logits, cache = llama.prefill(server.params, prompt, cache, config)
+    logits = logits[:, -1]
+    state, tokens = 0, []
+    for _ in range(max_new):
+        masked = np.where(automaton.allowed[state],
+                          np.asarray(logits[0], np.float32), -np.inf)
+        token = int(masked.argmax())
+        tokens.append(token)
+        state = int(automaton.next_state[state, token])
+        if automaton.accepting[state] \
+                and not automaton.allowed[state].any():
+            break
+        logits, cache = llama._decode_core(
+            server.params, jnp.asarray([[token]], jnp.int32), cache,
+            jnp.int32(position), config)
+        logits = logits[:, -1]
+        position += 1
+    return tokens
+
+
+@pytest.mark.parametrize("mode_kwargs", [
+    dict(draft_mode="ngram", spec_k=4),
+    dict(draft_config_name="tiny", spec_k=4, spec_adaptive=True),
+], ids=["ngram", "model-adaptive"])
+def test_constrained_greedy_matches_masked_oracle(sexpr_automaton,
+                                                  mode_kwargs):
+    server = _server(automata={"sexpr": sexpr_automaton},
+                     **mode_kwargs)
+    requests = _drain(server, [(5, 16), (11, 16), (3, 16), (7, 16)],
+                      automaton="sexpr")
+    rng = np.random.default_rng(0)
+    for request, (plen, new) in zip(requests,
+                                    [(5, 16), (11, 16), (3, 16),
+                                     (7, 16)]):
+        prompt = rng.integers(1, server.config.vocab_size,
+                              plen).astype(np.int32)
+        oracle = _constrained_oracle(server, prompt, sexpr_automaton,
+                                     new)
+        assert list(request.tokens) == oracle, request.request_id
+        assert sexpr_automaton.accepts(list(request.tokens))
+    stats = server.stats()
+    assert stats["spec_jump_forward_tokens"] > 0
+
+
+def test_constrained_terminal_retires_early(sexpr_automaton):
+    """Reaching the accepting terminal state retires the request even
+    with generation budget left — the server must not loop forever on
+    a state with no legal token."""
+    server = _server(draft_mode="ngram", spec_k=4,
+                     automata={"sexpr": sexpr_automaton})
+    requests = _drain(server, [(5, 64), (9, 64)], automaton="sexpr")
+    for request in requests:
+        assert 0 < len(request.tokens) < 64
+        assert sexpr_automaton.accepts(list(request.tokens))
+
+
+def test_constrained_sampled_stays_grammatical(sexpr_automaton):
+    server = _server(draft_mode="ngram", spec_k=4,
+                     automata={"sexpr": sexpr_automaton})
+    requests = _drain(server, [(5, 24), (9, 24), (3, 24), (7, 24)],
+                      automaton="sexpr", temperature=0.9, top_p=0.95)
+    for request in requests:
+        assert sexpr_automaton.accepts(list(request.tokens))
+
+
+def test_mixed_constrained_unconstrained_batch(sexpr_automaton):
+    """Constrained and free slots share rounds: free rows stay bitwise
+    plain, constrained rows stay grammatical."""
+    base = _server()
+    base_requests = _drain(base, SHAPES)
+    server = _server(draft_config_name="tiny", spec_k=4,
+                     automata={"sexpr": sexpr_automaton})
+    rng = np.random.default_rng(0)
+    requests = []
+    for index, (plen, new) in enumerate(SHAPES):
+        prompt = rng.integers(1, server.config.vocab_size,
+                              plen).astype(np.int32)
+        requests.append(DecodeRequest(
+            f"r{index}", prompt, new,
+            automaton="sexpr" if index % 2 else None))
+    for request in requests:
+        server.submit(request)
+    server.run_until_drained()
+    for index, request in enumerate(requests):
+        if index % 2:
+            assert sexpr_automaton.accepts(list(request.tokens))
+        else:
+            assert list(request.tokens) == \
+                list(base_requests[index].tokens)
+
+
+def test_unknown_automaton_rejected(sexpr_automaton):
+    server = _server(draft_mode="ngram", spec_k=4,
+                     automata={"sexpr": sexpr_automaton})
+    request = DecodeRequest("r0", np.asarray([5, 6, 7], np.int32), 4,
+                            automaton="nope")
+    server.submit(request)
+    server.run_until_drained()
+    assert request.error == "unknown_automaton"
+    # No automata registered at all: same rejection.
+    bare = _server(draft_mode="ngram", spec_k=4)
+    request = DecodeRequest("r1", np.asarray([5, 6, 7], np.int32), 4,
+                            automaton="sexpr")
+    bare.submit(request)
+    bare.run_until_drained()
+    assert request.error == "unknown_automaton"
+
+
+# --------------------------------------------------------------------------- #
+# Compile discipline: the ladder is the whole shape space.
+
+
+def test_warm_spec_ladder_requires_idle():
+    server = _server(draft_mode="ngram", spec_k=4)
+    server.submit(DecodeRequest(
+        "r0", np.asarray([5, 6, 7], np.int32), 8))
+    server.step()
+    with pytest.raises(RuntimeError, match="idle"):
+        server.warm_spec_ladder()
+    server.run_until_drained()
+    server.warm_spec_ladder()                # idle again: fine
+
+
+def test_adaptive_ladder_zero_steady_compiles():
+    """warm_spec_ladder + one warm trace wave, then the fence drops:
+    the controller walking rungs mid-serve may not compile anything."""
+    from aiko_services_tpu.obs import compiles
+    shapes = [(5, 16), (9, 16)]
+    server = _server(draft_config_name="tiny", spec_k=4,
+                     spec_adaptive=True)
+    ledger_owned = compiles.LEDGER is None
+    ledger = compiles.install(service="test-spec-v2")
+    try:
+        _drain(server, shapes, seed=0)       # warm trace shapes
+        server.warm_spec_ladder()            # warm every rung
+        ledger.fence()
+        _drain(server, shapes, seed=1)       # adaptive walk, fenced
+        assert ledger.steady_compiles == 0, [
+            (entry["program"], entry["signature"])
+            for entry in ledger.snapshot()["records"]
+            if entry["steady"]]
+    finally:
+        ledger.lift_fence()
+        if ledger_owned:
+            compiles.uninstall()
+
+
+# --------------------------------------------------------------------------- #
+# Host/device discipline: controller + automaton tables never reach a
+# traced module (invariant 7 extended to v2).
+
+
+def test_no_controller_or_automaton_in_jitted_modules():
+    banned = ("SpecController", "spec_control", "AutomatonTable",
+              "stack_automata", "k_hist", "_autostates",
+              "ngram_propose", "hist_string")
+    targets = [PKG / "models" / "llama.py",
+               PKG / "models" / "llama_tp.py",
+               *sorted((PKG / "ops").glob("*.py"))]
+    assert len(targets) > 2
+    for path in targets:
+        tree = ast.parse(path.read_text())
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Name):
+                name = node.id
+            elif isinstance(node, ast.Attribute):
+                name = node.attr
+            elif isinstance(node, (ast.Import, ast.ImportFrom)):
+                name = " ".join(
+                    alias.name for alias in node.names) + " " + (
+                        getattr(node, "module", "") or "")
+            else:
+                continue
+            assert not any(word in name for word in banned), (
+                f"{path.name}: traced module references host-side "
+                f"speculation-control symbol {name!r}")
+
+
+# --------------------------------------------------------------------------- #
+# Telemetry: the v2 counters flow stats -> TELEMETRY_KEYS -> dashboard.
+
+
+def test_spec_v2_telemetry_flows_to_dashboard(sexpr_automaton):
+    from aiko_services_tpu.orchestration.serving import (
+        TELEMETRY_KEYS, serving_telemetry,
+    )
+    from aiko_services_tpu.tools.dashboard_plugins import (
+        model_replica_plugin,
+    )
+
+    server = _server(draft_mode="ngram", spec_k=4, spec_adaptive=True,
+                     automata={"sexpr": sexpr_automaton})
+    _drain(server, [(5, 12), (9, 12)], automaton="sexpr")
+    stats = server.stats()
+    for key in ("spec_draft_mode", "spec_k_effective",
+                "spec_jump_forward_tokens", "spec_ngram_hits"):
+        assert key in stats and key in TELEMETRY_KEYS
+    telemetry = serving_telemetry(stats)
+    assert telemetry["spec_draft_mode"] == "ngram"
+    assert telemetry["spec_jump_forward_tokens"] > 0
+
+    class Fields:
+        name, topic_path = "replica_x", "t/replica_x"
+        protocol = "model_replica"
+
+    variables = {key: str(value) for key, value in telemetry.items()}
+    variables.update(slots="2", prefix_hits="0")
+    lines = "\n".join(model_replica_plugin(Fields, variables))
+    assert "spec v2:" in lines
+    assert "mode=ngram" in lines
+    assert "jump-forward" in lines
