@@ -389,6 +389,11 @@ def _scatter_staged(server, blocks: List[int], layout,
         # The scatter of a replicated staging must not leave a
         # gathered pool copy behind: re-pin each written buffer to
         # the pool's kv-head sharding (async dispatch, no sync).
+        # Mesh-RANK-agnostic by construction: the recorded per-buffer
+        # sharding carries whatever the pool was pinned to — 1-D tp
+        # or a 2-D tp × sp/ep mesh (kv-heads sharded on tp,
+        # replicated on the second axis) — so 2-D replicas import
+        # wire blocks with no extra plumbing.
         for layer, buffers in enumerate(server.pool):
             server.pool[layer] = {
                 name: server._jax.device_put(

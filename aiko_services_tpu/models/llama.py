@@ -123,6 +123,12 @@ CONFIGS: Dict[str, LlamaConfig] = {
     "moe_tiny": LlamaConfig(vocab_size=1024, d_model=128, n_layers=2,
                             n_heads=4, n_kv_heads=2, d_ff=352,
                             max_seq_len=512, n_experts=4),
+    # 8-expert test config: exercises every tp × ep ReplicaMesh on the
+    # virtual 8-device mesh (ep up to 8); cf=4.0 = E/k, drop-free.
+    "moe_tiny8": LlamaConfig(vocab_size=1024, d_model=128, n_layers=2,
+                             n_heads=4, n_kv_heads=2, d_ff=352,
+                             max_seq_len=512, n_experts=8,
+                             moe_capacity_factor=4.0),
     # Single-chip MoE bench config (~0.6 B params, int8 ≈ 0.6 GB);
     # cf=4.0 = E/k keeps decode drop-free (see moe_capacity_factor).
     "moe_small": LlamaConfig(vocab_size=32_000, d_model=1024,

@@ -38,8 +38,31 @@ shard_map mirrors of the paged serving entry points in
   byte-identical to the single-chip server, and the tiny per-step
   (tokens, counts) sync stays tiny.
 
-LoRA adapters and MoE configs are rejected under TP (adapter factors
-and expert weights don't fit the 2-D output-axis rule yet).
+* SECOND mesh axis (2-D ReplicaMesh).  ``sp`` (sequence parallel):
+  one chunked-admission dispatch carries ``sp`` consecutive prompt
+  chunks, sharded over the axis — each shard prefills its own chunk
+  at its own absolute offset, all-gathers the window's K/V over
+  ``sp`` (pure data movement) and writes the FULL window into its
+  pool copy, so the pool stays sharded on ``tp`` and bitwise
+  REPLICATED on ``sp``.  Attention for chunk ``j`` runs with
+  ``cached_lens = start + j*W`` — exactly the sequential chunk-``j``
+  program — so sp-sharded prefill is bitwise the single-chip chunked
+  admission (invariant 19).  ``ep`` (expert parallel): MoE expert
+  weights shard ``P(ep, None, tp)``; the dispatch/combine einsums run
+  on exact expert/feature slices and only all-gathers recombine them,
+  so MoE TP/EP greedy decode equals single-chip bit for bit — the old
+  blanket MoE rejection is gone.  Decode runs replicated over the
+  second axis (every sp/ep row computes identical tokens).
+
+LoRA adapters are still rejected under TP (adapter factors don't fit
+the 2-D output-axis rule yet).
+
+``overlap=True`` (opt-in, bench-only) routes the dense-MLP
+down-projection through :func:`..parallel.collective_matmul.
+matmul_reducescatter` — the row-parallel lossy-LAYOUT path whose
+ring partial sums reorder float addition vs single-chip, trading
+exactness for ICI/compute overlap on real hardware.  Off by default;
+every exactness test pins the exact all-gather path.
 """
 
 from __future__ import annotations
@@ -71,15 +94,46 @@ __all__ = ["TPEngine", "tp_param_specs", "tp_pool_specs",
 # Sharding layout
 
 
-def tp_param_specs(params, axis: str = "tp"):
+def tp_param_specs(params, axis: str = "tp", ep_axis=None,
+                   overlap: bool = False):
     """Output-axis PartitionSpecs for an ACTUAL parameter tree (dense
     or quantized): every 2-D leaf shards its last axis, everything
     else (1-D norm vectors) replicates.  Operating on the real tree —
     not the config — means one rule serves bf16, int8 and int4
-    layouts identically."""
-    return jax.tree.map(
+    layouts identically.
+
+    Two structured exceptions to the generic rule:
+
+    * MoE expert weights (3-D ``(E, d, f)`` / ``(E, f, d)`` leaves
+      under ``layers[i]["moe"]``) shard ``P(ep_axis, None, axis)`` AT
+      REST — experts over the second mesh axis (replicated when
+      ``ep_axis`` is None), per-expert features over ``tp`` — and are
+      all-gathered per layer by :func:`_tp_moe_block` (weight-gathered
+      EP).  The router REPLICATES (``moe_param_specs``): the gathered
+      forward runs the exact single-chip ``moe_ffn`` program, which
+      needs the full router resident.
+    * ``overlap=True`` re-lays the dense MLP ``w_down`` row-parallel
+      (``P(axis, None)`` on its contraction dim) for the
+      reduce-scatter overlap path — lossy layout, bench-only.
+    """
+    specs = jax.tree.map(
         lambda leaf: P(None, axis) if getattr(leaf, "ndim", 0) == 2
         else P(), params)
+    for layer, layer_specs in zip(params.get("layers", ()),
+                                  specs.get("layers", ())):
+        if "moe" in layer:
+            from .moe import moe_param_specs
+            moe_specs = moe_param_specs(ep_axis=ep_axis,
+                                        feature_axis=axis)
+            for name, leaf in layer["moe"].items():
+                spec = moe_specs.get(name, P())
+                # A quantized router is a {"q","s"} subtree — every
+                # leaf under the name takes the same spec.
+                layer_specs["moe"][name] = jax.tree.map(
+                    lambda _leaf: spec, leaf)
+        if overlap and getattr(layer.get("w_down"), "ndim", 0) == 2:
+            layer_specs["w_down"] = P(axis, None)
+    return specs
 
 
 def tp_pool_specs(pool, axis: str = "tp"):
@@ -92,13 +146,15 @@ def tp_pool_specs(pool, axis: str = "tp"):
         else P(None, None, axis), pool)
 
 
-def shard_params(params, mesh: Mesh, axis: str = "tp"):
+def shard_params(params, mesh: Mesh, axis: str = "tp", ep_axis=None,
+                 overlap: bool = False):
     """Lay a parameter tree out over the replica mesh (global arrays,
-    output axis sharded)."""
+    output axis sharded; MoE experts over ``ep_axis`` when given)."""
     return jax.tree.map(
         lambda leaf, spec: jax.device_put(leaf,
                                           NamedSharding(mesh, spec)),
-        params, tp_param_specs(params, axis))
+        params, tp_param_specs(params, axis, ep_axis=ep_axis,
+                               overlap=overlap))
 
 
 def shard_pool(pool, mesh: Mesh, axis: str = "tp"):
@@ -160,13 +216,65 @@ def _tp_lm_head(params, config: LlamaConfig, axis: str, x):
     return _gather_cols(logits, axis)
 
 
-def _tp_mlp_block(layer, config: LlamaConfig, axis: str, x):
+def _tp_mlp_block(layer, config: LlamaConfig, axis: str, x,
+                  ep_axis=None, ep: int = 1, overlap: bool = False):
+    if "moe" in layer:
+        return _tp_moe_block(layer, config, axis, x, ep_axis, ep)
     normed = llama.rms_norm(x, layer["mlp_norm"], config.norm_eps)
     gate = jax.nn.silu(
         llama._matmul(normed, layer["w_gate"]).astype(jnp.float32))
     up = llama._matmul(normed, layer["w_up"]).astype(jnp.float32)
+    if overlap:
+        # Opt-in lossy-layout path: w_down is laid out row-parallel
+        # (P(axis, None) — its CONTRACTION rows match the act columns
+        # this shard already holds), so the down-projection skips the
+        # act gather entirely and reduce-scatters ring partial sums
+        # behind the matmuls.  Partial-sum float order differs from
+        # the single-chip program — bench-only, never the default.
+        from ..parallel.collective_matmul import matmul_reducescatter
+        act = (gate * up).astype(x.dtype)
+        b, s, fl = act.shape
+        down = matmul_reducescatter(act.reshape(b * s, fl),
+                                    layer["w_down"], axis)
+        return x + _gather_cols(down, axis).reshape(x.shape)
     act = _gather_cols((gate * up).astype(x.dtype), axis)
     return x + _gather_cols(llama._matmul(act, layer["w_down"]), axis)
+
+
+def _tp_moe_block(layer, config: LlamaConfig, axis: str, x,
+                  ep_axis=None, ep: int = 1):
+    """Shard-local mirror of ``llama._mlp_block``'s MoE branch:
+    WEIGHT-GATHERED expert parallelism.
+
+    The 3-D expert weights live SHARDED at rest — experts over the
+    ``ep`` mesh axis, per-expert feature columns over ``tp`` (that is
+    the HBM-capacity win: each chip holds ``E/ep`` experts' columns).
+    The forward pass all-gathers the expert tree (tiled all-gathers =
+    pure data movement) and then runs the EXACT single-chip
+    :func:`..models.moe.moe_ffn` program on the full tree, replicated.
+
+    Why not compute-sharded dispatch (all-to-all)?  Exactness.  The
+    XLA backend does not guarantee the same bits for a re-decomposed
+    MoE graph — measured on CPU, even an op-by-op re-statement of
+    ``moe_ffn``'s own einsums (barriered, same shapes) diverges from
+    the fused single-chip program in the last bf16 ulp.  Running the
+    same traced ``moe_ffn`` on identical full inputs is the only
+    layout for which 2-D greedy ≡ single-chip holds BITWISE
+    (invariants 9 + 19); compute-sharded token dispatch is a
+    documented lossy-layout future step, same bucket as the
+    ``overlap`` matmul path."""
+    from .moe import moe_ffn
+    moe, mcfg = layer["moe"], config.moe_config
+    normed = llama.rms_norm(x, layer["mlp_norm"], config.norm_eps)
+    full = dict(moe)
+    for name in ("w_gate", "w_up", "w_down"):
+        w = moe[name]
+        if ep > 1:
+            w = jax.lax.all_gather(w, ep_axis, axis=0, tiled=True)
+        # Feature columns gather over tp (axis size 1 is a no-op).
+        full[name] = jax.lax.all_gather(w, axis, axis=2, tiled=True)
+    out = moe_ffn(full, normed, mcfg)
+    return x + out.astype(x.dtype)
 
 
 def _tp_attention_decode_paged(layer, config: LlamaConfig, tp: int,
@@ -206,7 +314,9 @@ def _tp_attention_decode_paged(layer, config: LlamaConfig, tp: int,
 
 
 def _tp_decode_core_paged(params, token, pool, tables, positions,
-                          config: LlamaConfig, tp: int, axis: str):
+                          config: LlamaConfig, tp: int, axis: str,
+                          ep_axis=None, ep: int = 1,
+                          overlap: bool = False):
     positions_2d = positions[:, None]
     cos, sin = llama._rope_freqs(config, positions_2d)
     x = _tp_embed(params, token, config, axis)
@@ -216,7 +326,8 @@ def _tp_decode_core_paged(params, token, pool, tables, positions,
             layer, config, tp, axis, x, cos, sin, pool_layer, tables,
             positions)
         new_pool.append(layer_pool)
-        x = _tp_mlp_block(layer, config, axis, x)
+        x = _tp_mlp_block(layer, config, axis, x, ep_axis=ep_axis,
+                          ep=ep, overlap=overlap)
     logits = _tp_lm_head(params, config, axis, x)
     return logits, new_pool
 
@@ -224,7 +335,9 @@ def _tp_decode_core_paged(params, token, pool, tables, positions,
 def _tp_prefill_append_core(params, tokens, pool, tables, start_index,
                             config: LlamaConfig, tp: int, axis: str,
                             kv_limit=None,
-                            compute_logits: bool = False):
+                            compute_logits: bool = False,
+                            ep_axis=None, ep: int = 1,
+                            overlap: bool = False):
     """Shard-local mirror of ``llama._prefill_append_core``: the
     chunk's K/V land in the LOCAL pool slice, append attention runs
     per local kv head, activations gather after each projection."""
@@ -264,15 +377,92 @@ def _tp_prefill_append_core(params, tokens, pool, tables, start_index,
         out = _gather_cols(out.reshape(batch, K, h * hd), axis)
         x = x + _gather_cols(llama._matmul(out, layer["wo"]),
                              axis).astype(x.dtype)
-        x = _tp_mlp_block(layer, config, axis, x)
+        x = _tp_mlp_block(layer, config, axis, x, ep_axis=ep_axis,
+                          ep=ep, overlap=overlap)
     if not compute_logits:
         return None, new_pool
     return _tp_lm_head(params, config, axis, x), new_pool
 
 
+def _tp_sp_prefill_core(params, tokens, pool, tables, start_index,
+                        config: LlamaConfig, tp: int, axis: str,
+                        sp_axis: str, sp: int, kv_limit=None,
+                        ep_axis=None, ep: int = 1,
+                        overlap: bool = False):
+    """Sequence-parallel chunked-prefill core: the dispatch window
+    ``(batch, sp*W)`` arrives sharded over ``sp_axis`` — this shard
+    holds chunk ``j = axis_index(sp_axis)`` of width ``W`` at absolute
+    start ``start_index + j*W``.  Per layer:
+
+    * project this chunk's q/k/v (tp-local heads), rope at the chunk's
+      own absolute positions;
+    * all-gather the WINDOW's K/V over ``sp`` (pure data movement) and
+      slab-write all ``sp`` chunks into the local pool copy — the pool
+      is sharded on ``tp`` and replicated on ``sp``, and every copy
+      receives bitwise the same rows, so the replicas never diverge;
+    * run the SAME append attention as the sequential core with
+      ``cached_lens = start_index + j*W``: rows of later chunks sit
+      beyond the absolute-position mask / cached-length bound, so
+      chunk ``j``'s math is bitwise the sequential chunk-``j``
+      dispatch of the single-chip server (invariant 19) — the
+      sp window just runs all ``sp`` chunk programs at once.
+
+    The in-kernel int8 writer is bit-identical to the aligned slab
+    writer's per-row absmax (see ops/paged_prefill), so the kernel
+    path re-writing this shard's own chunk leaves every sp copy
+    byte-identical too."""
+    batch, W = tokens.shape
+    h, kv = config.n_heads // tp, config.n_kv_heads // tp
+    hd = config.head_dim
+    start_index = jnp.asarray(start_index, jnp.int32)
+    j = jax.lax.axis_index(sp_axis).astype(jnp.int32)
+    my_start = start_index + j * W
+    positions_b = jnp.broadcast_to(
+        my_start + jnp.arange(W, dtype=jnp.int32), (batch, W))
+    win_positions = jnp.broadcast_to(
+        start_index + jnp.arange(sp * W, dtype=jnp.int32),
+        (batch, sp * W))
+    cached_lens = jnp.broadcast_to(my_start, (batch,))
+    chunk_lens = jnp.full((batch,), W, jnp.int32)
+    cos, sin = llama._rope_freqs(config, positions_b)
+    x = _tp_embed(params, tokens, config, axis)
+    use_kernel, interpret = llama.prefill_kernel_mode()
+    new_pool = []
+    for layer, pool_layer in zip(params["layers"], pool):
+        normed = llama.rms_norm(x, layer["attn_norm"], config.norm_eps)
+        q = llama._matmul(normed, layer["wq"]).reshape(batch, W, h, hd)
+        k = llama._matmul(normed, layer["wk"]).reshape(batch, W, kv, hd)
+        v = llama._matmul(normed, layer["wv"]).reshape(batch, W, kv, hd)
+        q = llama.apply_rope(q, cos, sin)
+        k = llama.apply_rope(k, cos, sin)
+        k_win = jax.lax.all_gather(k, sp_axis, axis=1, tiled=True)
+        v_win = jax.lax.all_gather(v, sp_axis, axis=1, tiled=True)
+        pool_layer = llama._paged_write_slab(pool_layer, k_win, v_win,
+                                             tables, win_positions)
+        q_g = q.reshape(batch, W, kv, h // kv, hd)
+        if use_kernel:
+            out, pool_layer = paged_prefill_attention(
+                q_g, k, v, pool_layer, tables, cached_lens, chunk_lens,
+                window=config.sliding_window, interpret=interpret,
+                kv_limit=kv_limit)
+        else:
+            gathered = llama._paged_gather(pool_layer, tables)
+            out = llama._cached_gqa_attention(
+                q_g, gathered, positions_b, hd,
+                window=config.sliding_window)
+        new_pool.append(pool_layer)
+        out = _gather_cols(out.reshape(batch, W, h * hd), axis)
+        x = x + _gather_cols(llama._matmul(out, layer["wo"]),
+                             axis).astype(x.dtype)
+        x = _tp_mlp_block(layer, config, axis, x, ep_axis=ep_axis,
+                          ep=ep, overlap=overlap)
+    return new_pool
+
+
 def _tp_verify_core(params, tokens, pool, tables, positions, active,
                     config: LlamaConfig, tp: int, axis: str,
-                    kv_limit=None):
+                    kv_limit=None, ep_axis=None, ep: int = 1,
+                    overlap: bool = False):
     """Shard-local mirror of ``llama._verify_append_core`` (the
     speculative verify): every row at its OWN absolute start position,
     the window's K/V appended into the LOCAL kv-head slice of the
@@ -318,7 +508,8 @@ def _tp_verify_core(params, tokens, pool, tables, positions, active,
         out = _gather_cols(out.reshape(batch, K, h * hd), axis)
         x = x + _gather_cols(llama._matmul(out, layer["wo"]),
                              axis).astype(x.dtype)
-        x = _tp_mlp_block(layer, config, axis, x)
+        x = _tp_mlp_block(layer, config, axis, x, ep_axis=ep_axis,
+                          ep=ep, overlap=overlap)
     return _tp_lm_head(params, config, axis, x), new_pool
 
 
@@ -338,12 +529,16 @@ class TPEngine:
 
     * :meth:`serve_chunk_paged` — decode chunk (pool donated)
     * :meth:`serve_chunk_mixed` — chunked-prefill slice + decode chunk
+      (``sp_shard=True`` runs the slice as an sp-sharded window)
     * :meth:`prefill_append_paged` — standalone prefill append
+    * :meth:`prefill_append_sp` — standalone sp-window prefill
     * :meth:`verify_chunk_paged` — speculative verify window
     """
 
     def __init__(self, config: LlamaConfig, mesh: Mesh, params, pool,
-                 axis: str = "tp"):
+                 axis: str = "tp", sp_axis: Optional[str] = None,
+                 ep_axis: Optional[str] = None,
+                 overlap: bool = False):
         if axis not in mesh.axis_names:
             raise ValueError(
                 f"mesh has no '{axis}' axis: {mesh.axis_names}")
@@ -351,11 +546,31 @@ class TPEngine:
         self.mesh = mesh
         self.axis = axis
         self.tp = mesh.shape[axis]
+        # Second mesh axis (at most one): sp shards prefill windows,
+        # ep shards MoE experts.  Size 1 ⇔ absent.
+        self.sp_axis = sp_axis if (sp_axis in mesh.axis_names) else None
+        self.ep_axis = ep_axis if (ep_axis in mesh.axis_names) else None
+        self.sp = mesh.shape[self.sp_axis] if self.sp_axis else 1
+        self.ep = mesh.shape[self.ep_axis] if self.ep_axis else 1
+        self.overlap = bool(overlap)
         if config.n_kv_heads % self.tp or config.n_heads % self.tp:
             raise ValueError(
                 f"tp={self.tp} must divide n_kv_heads="
                 f"{config.n_kv_heads} and n_heads={config.n_heads}")
-        self._param_specs = tp_param_specs(params, axis)
+        if config.n_experts and config.n_experts % self.ep:
+            raise ValueError(
+                f"ep={self.ep} must divide n_experts="
+                f"{config.n_experts}")
+        if self.overlap:
+            for layer in params.get("layers", ()):
+                if getattr(layer.get("w_down"), "ndim", 0) != 2:
+                    raise ValueError(
+                        "overlap mode needs dense (unquantized) MLP "
+                        "weights: w_down re-lays row-parallel for the "
+                        "reduce-scatter path")
+        self._param_specs = tp_param_specs(params, axis,
+                                           ep_axis=self.ep_axis,
+                                           overlap=self.overlap)
         self._pool_specs = tp_pool_specs(pool, axis)
         self._cache: Dict[Any, Any] = {}
 
@@ -364,6 +579,12 @@ class TPEngine:
     def _shard_map(self, body, in_specs, out_specs):
         return shard_map(body, mesh=self.mesh, in_specs=in_specs,
                          out_specs=out_specs, check_rep=False)
+
+    def _core_kwargs(self):
+        """Second-axis / overlap context threaded into every mirror
+        core (inert on a 1-D exact-path mesh)."""
+        return dict(ep_axis=self.ep_axis, ep=self.ep,
+                    overlap=self.overlap)
 
     # -- decode chunk -------------------------------------------------- #
 
@@ -385,6 +606,7 @@ class TPEngine:
 
     def _build_serve(self, num_steps, eos_id, sampled, has_rng):
         config, tp, axis = self.config, self.tp, self.axis
+        core_kwargs = self._core_kwargs()
 
         def body(params, state, pool, rng_key=None):
             block_size = pool[0]["k"].shape[1]
@@ -401,7 +623,8 @@ class TPEngine:
                                       scratch_positions)
                 return _tp_decode_core_paged(params, token, pool,
                                              write_tables, write_pos,
-                                             config, tp, axis)
+                                             config, tp, axis,
+                                             **core_kwargs)
 
             return llama._serve_scan(step_core, state, pool, num_steps,
                                      eos_id, sampled, rng_key)
@@ -418,16 +641,25 @@ class TPEngine:
     def serve_chunk_mixed(self, params, state, pool, prefill_tokens,
                           prefill_row, prefill_start, num_steps,
                           eos_id: int = -1, sampled: bool = False,
-                          rng_key=None, prefill_kv_limit=None):
-        """TP twin of :func:`llama.serve_chunk_mixed` (no LoRA)."""
+                          rng_key=None, prefill_kv_limit=None,
+                          sp_shard: bool = False):
+        """TP twin of :func:`llama.serve_chunk_mixed` (no LoRA).
+
+        ``sp_shard=True`` (needs an sp mesh axis): the prefill slice is
+        an sp-WINDOW — ``sp`` consecutive chunks in one dispatch,
+        sharded over the sp axis through
+        :func:`_tp_sp_prefill_core` — while the decode part runs
+        replicated over sp exactly as before."""
         num_steps = int(num_steps)
+        if sp_shard and self.sp <= 1:
+            raise ValueError("sp_shard needs an sp mesh axis > 1")
         key = ("mixed", num_steps, int(eos_id), bool(sampled),
-               rng_key is not None, prefill_kv_limit)
+               rng_key is not None, prefill_kv_limit, bool(sp_shard))
         fn = self._cache.get(key)
         if fn is None:
             fn = self._build_mixed(num_steps, int(eos_id),
                                    bool(sampled), rng_key is not None,
-                                   prefill_kv_limit)
+                                   prefill_kv_limit, bool(sp_shard))
             self._cache[key] = fn
         args = (params, state, pool, prefill_tokens,
                 jnp.asarray(prefill_row, jnp.int32),
@@ -436,8 +668,10 @@ class TPEngine:
         return fn(*args)
 
     def _build_mixed(self, num_steps, eos_id, sampled, has_rng,
-                     prefill_kv_limit):
+                     prefill_kv_limit, sp_shard=False):
         config, tp, axis = self.config, self.tp, self.axis
+        sp_axis, sp = self.sp_axis, self.sp
+        core_kwargs = self._core_kwargs()
 
         def body(params, state, pool, prefill_tokens, prefill_row,
                  prefill_start, rng_key=None):
@@ -446,10 +680,17 @@ class TPEngine:
             slots = tables.shape[0]
             tables_row = jax.lax.dynamic_slice_in_dim(
                 tables, prefill_row, 1, axis=0)
-            _, pool = _tp_prefill_append_core(
-                params, prefill_tokens, pool, tables_row,
-                prefill_start, config, tp, axis,
-                kv_limit=prefill_kv_limit, compute_logits=False)
+            if sp_shard:
+                pool = _tp_sp_prefill_core(
+                    params, prefill_tokens, pool, tables_row,
+                    prefill_start, config, tp, axis, sp_axis, sp,
+                    kv_limit=prefill_kv_limit, **core_kwargs)
+            else:
+                _, pool = _tp_prefill_append_core(
+                    params, prefill_tokens, pool, tables_row,
+                    prefill_start, config, tp, axis,
+                    kv_limit=prefill_kv_limit, compute_logits=False,
+                    **core_kwargs)
             scratch_tables = jnp.zeros_like(tables)
             scratch_positions = (jnp.arange(slots, dtype=jnp.int32)
                                  % block_size)
@@ -461,13 +702,15 @@ class TPEngine:
                                       scratch_positions)
                 return _tp_decode_core_paged(params, token, pool,
                                              write_tables, write_pos,
-                                             config, tp, axis)
+                                             config, tp, axis,
+                                             **core_kwargs)
 
             return llama._serve_scan(step_core, state, pool, num_steps,
                                      eos_id, sampled, rng_key)
 
+        prefill_spec = P(None, sp_axis) if sp_shard else P()
         in_specs = (self._param_specs, P(), self._pool_specs,
-                    P(), P(), P())
+                    prefill_spec, P(), P())
         if has_rng:
             in_specs += (P(),)
         out_specs = (P(), P(), P(), self._pool_specs)
@@ -494,11 +737,13 @@ class TPEngine:
 
     def _build_verify(self, kv_limit):
         config, tp, axis = self.config, self.tp, self.axis
+        core_kwargs = self._core_kwargs()
 
         def body(params, tokens, pool, tables, positions, active):
             return _tp_verify_core(params, tokens, pool, tables,
                                    positions, active, config, tp,
-                                   axis, kv_limit=kv_limit)
+                                   axis, kv_limit=kv_limit,
+                                   **core_kwargs)
 
         in_specs = (self._param_specs, P(), self._pool_specs,
                     P(), P(), P())
@@ -529,14 +774,57 @@ class TPEngine:
 
     def _build_prefill(self, kv_limit):
         config, tp, axis = self.config, self.tp, self.axis
+        core_kwargs = self._core_kwargs()
 
         def body(params, tokens, pool, tables, start_index):
             _, new_pool = _tp_prefill_append_core(
                 params, tokens, pool, tables, start_index, config, tp,
-                axis, kv_limit=kv_limit, compute_logits=False)
+                axis, kv_limit=kv_limit, compute_logits=False,
+                **core_kwargs)
             return new_pool
 
         in_specs = (self._param_specs, P(), self._pool_specs, P(), P())
+        out_specs = self._pool_specs
+        return jax.jit(self._shard_map(body, in_specs, out_specs),
+                       donate_argnums=(2,))
+
+    # -- sequence-parallel prefill window ------------------------------ #
+
+    def prefill_append_sp(self, params, tokens, pool, tables,
+                          start_index, kv_limit=None):
+        """Standalone sp-window prefill: ``tokens (1, sp*W)`` is
+        ``sp`` consecutive chunks of one prompt, sharded over the sp
+        axis — each shard appends its own chunk at its own absolute
+        offset and every pool copy receives the full window (see
+        :func:`_tp_sp_prefill_core`).  Returns ``(None, new_pool)``
+        to match the ``prefill_append_paged`` call-site unpacking."""
+        if self.sp <= 1:
+            raise ValueError("prefill_append_sp needs an sp mesh "
+                             "axis > 1")
+        if tokens.shape[1] % self.sp:
+            raise ValueError(
+                f"sp window width {tokens.shape[1]} must divide by "
+                f"sp={self.sp}")
+        key = ("prefill_sp", kv_limit)
+        fn = self._cache.get(key)
+        if fn is None:
+            fn = self._build_prefill_sp(kv_limit)
+            self._cache[key] = fn
+        return None, fn(params, tokens, pool, tables,
+                        jnp.asarray(start_index, jnp.int32))
+
+    def _build_prefill_sp(self, kv_limit):
+        config, tp, axis = self.config, self.tp, self.axis
+        sp_axis, sp = self.sp_axis, self.sp
+        core_kwargs = self._core_kwargs()
+
+        def body(params, tokens, pool, tables, start_index):
+            return _tp_sp_prefill_core(
+                params, tokens, pool, tables, start_index, config, tp,
+                axis, sp_axis, sp, kv_limit=kv_limit, **core_kwargs)
+
+        in_specs = (self._param_specs, P(None, sp_axis),
+                    self._pool_specs, P(), P())
         out_specs = self._pool_specs
         return jax.jit(self._shard_map(body, in_specs, out_specs),
                        donate_argnums=(2,))

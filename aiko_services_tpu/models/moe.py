@@ -59,13 +59,18 @@ def init_moe_params(config: MoEConfig, key) -> Dict:
     }
 
 
-def moe_param_specs() -> Dict:
-    """Experts shard over the ``ep`` mesh axis; the router replicates."""
+def moe_param_specs(ep_axis: str = "ep", feature_axis=None) -> Dict:
+    """Experts shard over the ``ep`` mesh axis — and their per-expert
+    feature dim over ``feature_axis`` when given (the TP engine passes
+    its tensor axis, so experts shard over BOTH axes of a 2-D
+    tp × ep ReplicaMesh).  The router entry here replicates; the TP
+    engine's generic output-axis rule shards it instead (both layouts
+    are exact — router logits all-gather either way)."""
     return {
         "router": P(),
-        "w_gate": P("ep", None, None),
-        "w_up": P("ep", None, None),
-        "w_down": P("ep", None, None),
+        "w_gate": P(ep_axis, None, feature_axis),
+        "w_up": P(ep_axis, None, feature_axis),
+        "w_down": P(ep_axis, None, feature_axis),
     }
 
 

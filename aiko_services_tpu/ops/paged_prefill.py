@@ -35,8 +35,14 @@ full gather.  The append kernel here removes both copies:
 
 ``cached_lens`` must be block-aligned (multiples of ``block_size``):
 shared prefixes are whole blocks and chunk widths are powers of two,
-so every caller satisfies this by construction.  Layout contract and
-dispatch rules are documented in docs/KERNELS.md.
+so every caller satisfies this by construction.  The sequence-parallel
+prefill window (``models/llama_tp._tp_sp_prefill_core``) dispatches
+through this same path per sp shard — shard ``j`` appends chunk ``j``
+with ``cached_lens = start + j·cap`` (cap is the admission cap, a pow2
+multiple of ``block_size``, so alignment holds per shard) and the
+window's K/V is all-gathered so every sp pool replica lands identical
+bytes.  Layout contract and dispatch rules are documented in
+docs/KERNELS.md.
 """
 
 from __future__ import annotations

@@ -160,6 +160,21 @@ class AutoscalerPolicy:
     crash_loop_window_s: float = 60.0
     quarantine_s: float = 300.0
 
+    #: Per-role TP degree for spawned replicas (the DistServe
+    #: argument: prefill is compute-bound and wants wide TP, decode is
+    #: memory-bandwidth-bound and wants narrow TP × more replicas).
+    #: The spawner builds ``ReplicaMesh(tp=role_tp(role))``; cross-
+    #: degree KV transfer between the roles is exact (the pool's host
+    #: view is degree-agnostic, tested in test_kvstore).
+    decode_tp: int = 1
+    #: 0 = same as ``decode_tp`` (homogeneous fleet, the default).
+    prefill_tp: int = 0
+
+    def role_tp(self, role: str) -> int:
+        if role == "prefill" and self.prefill_tp:
+            return int(self.prefill_tp)
+        return max(1, int(self.decode_tp))
+
     def role_bounds(self, role: str) -> Tuple[int, int]:
         if role == "prefill":
             return self.prefill_min, self.prefill_max
@@ -226,6 +241,10 @@ class Action:
     slot: str
     role: str = "decode"
     reason: str = ""
+    #: chips the spawned replica should occupy (``spawn`` only):
+    #: the policy's per-role TP degree, for the spawner to build the
+    #: matching ReplicaMesh.
+    tp_degree: int = 1
 
     def describe(self) -> str:
         return f"{self.kind}:{self.slot}" + \
@@ -388,11 +407,16 @@ def decide(snapshot: FleetSnapshot, policy: AutoscalerPolicy,
         for slot in down:
             if now >= state.backoff_until.get(slot, 0.0):
                 actions.append(Action("spawn", slot, role=role,
-                                      reason="replace"))
+                                      reason="replace",
+                                      tp_degree=policy.role_tp(role)))
+                state.chips[slot] = policy.role_tp(role)
 
-        # New capacity up to the target.  The sequence number skips
-        # names already owned — adopted replicas may squat on them.
-        for _ in range(target - eventual):
+        # New capacity up to the target, counted in CHIPS: a policy
+        # with role_tp(role) = k closes a k-chip gap with ONE spawn
+        # (with every degree 1 this is exactly the old replica loop).
+        # The sequence number skips names already owned — adopted
+        # replicas may squat on them.
+        while eventual < target:
             state.spawn_seq += 1
             slot = f"{role}{state.spawn_seq}"
             while slot in state.slots or slot in state.quarantined:
@@ -400,7 +424,10 @@ def decide(snapshot: FleetSnapshot, policy: AutoscalerPolicy,
                 slot = f"{role}{state.spawn_seq}"
             state.slots[slot] = role
             actions.append(Action("spawn", slot, role=role,
-                                  reason="scale_out"))
+                                  reason="scale_out",
+                                  tp_degree=policy.role_tp(role)))
+            state.chips[slot] = policy.role_tp(role)
+            eventual += policy.role_tp(role)
 
         # Surplus: drain the idlest live replica.  One per tick per
         # role — drains are deliberate, not avalanches.  A

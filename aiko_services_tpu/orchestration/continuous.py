@@ -220,6 +220,8 @@ class ContinuousBatchingServer:
         self.replica_mesh = replica_mesh
         self._mesh = None
         self.tp_degree = 1
+        self.sp_degree = 1
+        self.ep_degree = 1
         self.mesh_shape = ""
         if replica_mesh is not None:
             if mesh is not None:
@@ -236,9 +238,19 @@ class ContinuousBatchingServer:
             self._llama_tp = llama_tp
             self._mesh = replica_mesh.build()
             self.tp_degree = int(replica_mesh.tp)
+            self.sp_degree = int(replica_mesh.sp)
+            self.ep_degree = int(replica_mesh.ep)
             self.mesh_shape = f"{replica_mesh.axis}={self.tp_degree}"
+            second = replica_mesh.second_axis
+            if second is not None:
+                n2 = self.sp_degree if replica_mesh.sp > 1 \
+                    else self.ep_degree
+                self.mesh_shape += f",{second}={n2}"
             self.params = llama_tp.shard_params(
-                self.params, self._mesh, replica_mesh.axis)
+                self.params, self._mesh, replica_mesh.axis,
+                ep_axis=(replica_mesh.ep_axis
+                         if replica_mesh.ep > 1 else None),
+                overlap=replica_mesh.overlap)
         self.slots = slots
         # Row max_seq-1 is the inactive-slot scratch row (see
         # decode_chunk_ragged); a live request may use at most
@@ -469,6 +481,7 @@ class ContinuousBatchingServer:
             state_uploads=0, dirty_rows_uploaded=0, max_in_flight=0,
             ring_starved_steps=0, admission_deferred=0,
             decode_blocks_read=0, prefill_tokens=0,
+            sp_prefill_dispatches=0,
             deadline_exceeded=0, shed=0, watchdog_trips=0),
             prefix="server", labels=self._metrics_labels)
         # Per-phase latency histograms — FIXED log-spaced buckets, so
@@ -2216,6 +2229,8 @@ class ContinuousBatchingServer:
             free_slots=self.slots - self.slots_active,
             healthy=int(self.healthy),
             tp_degree=self.tp_degree,
+            sp_degree=self.sp_degree,
+            ep_degree=self.ep_degree,
             mesh_shape=self.mesh_shape,
             decode_attention_path=self.decode_attention_path,
             prefill_attention_path=self.prefill_attention_path,

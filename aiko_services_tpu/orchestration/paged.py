@@ -209,9 +209,13 @@ class PagedContinuousServer(ContinuousBatchingServer):
             # full-width global view — jax resolves per-shard slices.
             self.pool = self._llama_tp.shard_pool(
                 self.pool, self._mesh, self.replica_mesh.axis)
+            rm = self.replica_mesh
             self._tp_engine = self._llama_tp.TPEngine(
                 self.config, self._mesh, self.params, self.pool,
-                axis=self.replica_mesh.axis)
+                axis=rm.axis,
+                sp_axis=rm.sp_axis if rm.sp > 1 else None,
+                ep_axis=rm.ep_axis if rm.ep > 1 else None,
+                overlap=rm.overlap)
         if self._draft is not None:
             # Draft KV lives IN the paged tier (PR 17): its own pool
             # with the target's exact geometry (usable+1 blocks of
@@ -1453,6 +1457,32 @@ class PagedContinuousServer(ContinuousBatchingServer):
         cap = self.chunk_prefill_tokens // block_size
         return min(cap, 1 << (remaining.bit_length() - 1)) * block_size
 
+    def _sp_window_width(self, prefill) -> int:
+        """Sequence-parallel prefill window (2-D replica mesh): when
+        the engine has an ``sp`` axis and the remaining un-prefilled
+        prompt covers ``sp`` FULL ``chunk_prefill_tokens`` slices, one
+        dispatch carries all ``sp`` slices — each shard prefills its
+        own chunk, the window's K/V all-gathers over sp so every pool
+        copy receives the full window (pool stays replicated on sp).
+
+        Returns the window width in tokens, or 0 for "use the
+        sequential ladder".  The window only ever replaces ``sp``
+        consecutive EXACTLY-cap slices (cap is a power of two, so the
+        pow2 ladder would emit cap for each of them), which keeps the
+        slice sequence — and therefore the bitwise output — identical
+        to the single-chip chunked admission; any shorter tail falls
+        back to the ladder."""
+        engine = self._tp_engine
+        if engine is None or getattr(engine, "sp", 1) <= 1:
+            return 0
+        cap = self.chunk_prefill_tokens
+        if not cap:
+            return 0
+        remaining = (prefill["prompt_padded"].shape[1]
+                     - prefill["start"])
+        window = engine.sp * cap
+        return window if remaining >= window else 0
+
     def _advance_prefills(self) -> None:
         """With live decode work, chunked prefills ride the MIXED
         dispatch (one slice per chunk, inside the same jitted program
@@ -1471,10 +1501,23 @@ class PagedContinuousServer(ContinuousBatchingServer):
         for slot in list(self._prefilling):
             state = self._prefilling[slot]
             start = state["start"]
-            width = self._next_slice_width(state)
+            sp_width = self._sp_window_width(state)
+            width = sp_width or self._next_slice_width(state)
             chunk = state["prompt_padded"][:, start:start + width]
             tables_row = jnp.asarray(self.tables[slot:slot + 1])
-            if self._tp_engine is not None:
+            if sp_width:
+                if compiles.LEDGER is not None:
+                    # ONE window shape per (sp, cap) — the sp ladder
+                    # adds a single signature, not one per offset.
+                    compiles.set_label(
+                        "paged_prefill",
+                        f"sp{self._tp_engine.sp}w{width}")
+                _, self.pool = self._tp_engine.prefill_append_sp(
+                    self.params, jnp.asarray(chunk), self.pool,
+                    tables_row, jnp.int32(start),
+                    kv_limit=state["kv_limit"])
+                self.counters["sp_prefill_dispatches"] += 1
+            elif self._tp_engine is not None:
                 _, self.pool = self._tp_engine.prefill_append_paged(
                     self.params, jnp.asarray(chunk), self.pool,
                     tables_row, jnp.int32(start),
@@ -1505,6 +1548,73 @@ class PagedContinuousServer(ContinuousBatchingServer):
         self._activate_slot(slot, state["request"],
                             state["prompt_padded"],
                             state["prompt_len"])
+
+    def warm_prefill_ladder(self, buckets=None) -> int:
+        """Pre-compile the chunked-prefill slice ladder: every pow2
+        slice width up to ``chunk_prefill_tokens`` — plus the sp
+        WINDOW width on a 2-D (tp × sp) replica mesh — for every
+        prompt bucket's ``kv_limit``, dispatched once each against
+        the scratch block (zero tables row, masked writes land in
+        block 0), so a prefix-cache hit at an arbitrary offset or the
+        first long-prompt admission never compiles mid-traffic and
+        the ledger's steady-state-zero gate survives the multiplied
+        2-D signature space.  The MIXED prefill+decode programs are
+        warmed by ordinary warmup traffic (they need live decode
+        state) — this walks only the standalone ladder, the shapes
+        adaptive offsets can reach that a warmup wave may not.
+        Returns the number of programs dispatched."""
+        if self.slots_active or self._ring or self._prefilling:
+            raise RuntimeError(
+                "warm_prefill_ladder must run on an idle engine")
+        if not self.chunk_prefill_tokens:
+            return 0
+        jnp = self._jnp
+        block_size = self.block_size
+        cap = self.chunk_prefill_tokens
+        if buckets is None:
+            buckets, b = [], self._bucket_minimum
+            while b <= self.max_seq:
+                buckets.append(b)
+                b *= 2
+        sp = getattr(self._tp_engine, "sp", 1) \
+            if self._tp_engine is not None else 1
+        dispatched = 0
+        tables_row = jnp.zeros((1, self.max_seq // block_size),
+                               jnp.int32)
+        for bucket in buckets:
+            kv_limit = bucket // block_size
+            widths = []
+            w = block_size
+            while w <= min(cap, bucket):
+                widths.append(w)
+                w *= 2
+            if sp > 1 and sp * cap <= bucket:
+                widths.append(sp * cap)
+            for width in widths:
+                is_window = width > cap
+                tokens = jnp.zeros((1, width), jnp.int32)
+                if compiles.LEDGER is not None:
+                    compiles.set_label(
+                        "paged_prefill",
+                        f"sp{sp}w{width}" if is_window
+                        else f"w{width}")
+                if is_window:
+                    _, self.pool = self._tp_engine.prefill_append_sp(
+                        self.params, tokens, self.pool, tables_row,
+                        jnp.int32(0), kv_limit=kv_limit)
+                elif self._tp_engine is not None:
+                    _, self.pool = \
+                        self._tp_engine.prefill_append_paged(
+                            self.params, tokens, self.pool,
+                            tables_row, jnp.int32(0),
+                            kv_limit=kv_limit)
+                else:
+                    _, self.pool = self._llama.prefill_append_paged(
+                        self.params, tokens, self.pool, tables_row,
+                        jnp.int32(0), self.config, kv_limit=kv_limit,
+                        compute_logits=False)
+                dispatched += 1
+        return dispatched
 
     def _release_slot(self, slot: int) -> None:
         for block in self._owned[slot]:
@@ -1561,9 +1671,26 @@ class PagedContinuousServer(ContinuousBatchingServer):
             return tokens_d, counts_d, new_state
         prefill = self._prefilling[slot]
         start = prefill["start"]
-        width = self._next_slice_width(prefill)
+        sp_width = self._sp_window_width(prefill)
+        width = sp_width or self._next_slice_width(prefill)
         chunk = prefill["prompt_padded"][:, start:start + width]
-        if self._tp_engine is not None:
+        if sp_width:
+            # Mixed step with the slice run as an sp-sharded window:
+            # sp chunks of this prompt prefill in ONE dispatch while
+            # the decode part runs replicated over sp as usual.
+            if compiles.LEDGER is not None:
+                compiles.set_label(
+                    "serve_chunk",
+                    f"s{steps}sp{self._tp_engine.sp}w{width}")
+            tokens_d, counts_d, new_state, self.pool = \
+                self._tp_engine.serve_chunk_mixed(
+                    self.params, state, self.pool, jnp.asarray(chunk),
+                    jnp.int32(slot), jnp.int32(start), steps,
+                    eos_id=eos_id, sampled=sampled, rng_key=rng_key,
+                    prefill_kv_limit=prefill["kv_limit"],
+                    sp_shard=True)
+            self.counters["sp_prefill_dispatches"] += 1
+        elif self._tp_engine is not None:
             tokens_d, counts_d, new_state, self.pool = \
                 self._tp_engine.serve_chunk_mixed(
                     self.params, state, self.pool, jnp.asarray(chunk),

@@ -83,6 +83,9 @@ TELEMETRY_KEYS = (
     "prefill_attention_path",
     "deadline_exceeded", "shed", "watchdog_trips", "free_slots",
     "healthy", "tp_degree", "mesh_shape",
+    # 2-D replica meshes (PR 18): second-axis degrees and the count of
+    # admission dispatches that went through the sp-sharded window path
+    "sp_degree", "ep_degree", "sp_prefill_dispatches",
     # Speculative decoding (present only when a draft is configured)
     "spec_k", "spec_rounds", "spec_proposed", "spec_accepted",
     "spec_acceptance_rate", "spec_tokens_per_target_pass",

@@ -79,59 +79,120 @@ def make_mesh(devices: Optional[Sequence] = None, **axes: int) -> Mesh:
 
 @dataclasses.dataclass(frozen=True)
 class ReplicaMesh:
-    """One serving replica's device mesh: ``tp`` chips, one named
-    axis.  The serving tier's unit of capacity changes from "one chip"
-    to "one mesh" — the paged KV pool shards along the kv-head
-    dimension over ``axis``, model weights shard on their output
-    feature axis, and the per-slot decode state stays replicated so
-    the host-side admission/commit protocol is mesh-agnostic.
+    """One serving replica's device mesh: ``tp`` chips on the tensor
+    axis, optionally × a SECOND axis (``sp`` sequence-parallel OR
+    ``ep`` expert-parallel).  The serving tier's unit of capacity
+    changes from "one chip" to "one mesh" — the paged KV pool shards
+    along the kv-head dimension over ``axis`` (and REPLICATES over the
+    second axis), model weights shard on their output feature axis,
+    and the per-slot decode state stays replicated so the host-side
+    admission/commit protocol is mesh-agnostic.
+
+    Second-axis roles:
+
+    * ``sp`` — sequence-parallel chunked prefill: one admission
+      dispatch carries ``sp`` prompt chunks, each shard prefills its
+      own chunk and all-gathers the window's K/V so every pool copy
+      stays identical.  Decode runs replicated over ``sp`` (prefill
+      TTFT is what the axis buys).
+    * ``ep`` — expert-parallel MoE: the 3-D expert weights shard
+      ``P(ep, None, tp)`` and every collective stays an all-gather, so
+      MoE serving is exact (the old blanket MoE rejection is gone).
 
     A speculative DRAFT model rides the same mesh fully REPLICATED
     (params + its contiguous cache): draft passes run collective-free
     on every chip, identical by construction, and only the target's
     verify/decode programs shard — so TP spec serving stays bitwise
-    equal to single-chip (ARCHITECTURE invariants 9 + 11).
+    equal to single-chip (ARCHITECTURE invariants 9 + 11 + 19).
 
-    ``tp=1`` degenerates to the single-chip layout (a 1-device mesh).
+    ``tp=1`` (and ``sp=ep=1``) degenerates to the single-chip layout.
+    ``overlap=True`` opts the MLP down-projection into the
+    :mod:`..parallel.collective_matmul` reduce-scatter layout — a
+    LOSSY-layout bandwidth trade (partial-sum float order differs from
+    single-chip), bench-only, off by default.
     """
 
     tp: int = 1
     axis: str = "tp"
+    sp: int = 1
+    ep: int = 1
+    sp_axis: str = "sp"
+    ep_axis: str = "ep"
+    overlap: bool = False
+
+    @property
+    def size(self) -> int:
+        return self.tp * self.sp * self.ep
+
+    @property
+    def second_axis(self) -> Optional[str]:
+        """Name of the active second axis, or None for a 1-D mesh."""
+        if self.sp > 1:
+            return self.sp_axis
+        if self.ep > 1:
+            return self.ep_axis
+        return None
 
     def build(self, devices: Optional[Sequence] = None) -> Mesh:
         devices = list(devices if devices is not None
                        else jax.devices())
         if self.tp < 1:
             raise ValueError(f"tp must be >= 1, got {self.tp}")
-        if len(devices) < self.tp:
+        if self.sp < 1 or self.ep < 1:
             raise ValueError(
-                f"ReplicaMesh(tp={self.tp}) needs {self.tp} devices, "
+                f"sp/ep must be >= 1, got sp={self.sp} ep={self.ep}")
+        if self.sp > 1 and self.ep > 1:
+            raise ValueError(
+                "ReplicaMesh is at most 2-D: pick ONE second axis "
+                f"(got sp={self.sp} AND ep={self.ep})")
+        need = self.size
+        if len(devices) < need:
+            raise ValueError(
+                f"ReplicaMesh(tp={self.tp}, sp={self.sp}, "
+                f"ep={self.ep}) needs {need} devices, "
                 f"have {len(devices)} (tests: set XLA_FLAGS="
                 "--xla_force_host_platform_device_count=8)")
-        array = np.asarray(devices[: self.tp])
-        return Mesh(array, (self.axis,))
+        second = self.second_axis
+        if second is None:
+            return Mesh(np.asarray(devices[: self.tp]), (self.axis,))
+        n2 = self.sp if self.sp > 1 else self.ep
+        array = np.asarray(devices[:need]).reshape(self.tp, n2)
+        return Mesh(array, (self.axis, second))
 
     def validate(self, config) -> None:
         """Fail fast on layouts the TP engine cannot shard exactly.
 
-        Every sharded dimension must divide by ``tp``: kv heads (the
-        paged pool + attention grid), query heads (contiguous q-head
-        ranges must cover whole kv-head groups), d_model / d_ff /
-        vocab (output-axis weight sharding).  MoE expert weights are
-        3-D and stay outside the 2-D sharding rule, so MoE configs are
-        rejected outright."""
-        if getattr(config, "n_experts", 0):
+        Every tensor-sharded dimension must divide by ``tp``: kv heads
+        (the paged pool + attention grid), query heads (contiguous
+        q-head ranges must cover whole kv-head groups), d_model / d_ff
+        / vocab (output-axis weight sharding).  MoE configs shard
+        their expert weights over the second (``ep``) axis — so
+        ``n_experts`` must divide by ``ep`` — and their per-expert
+        feature dims fall under the same ``tp`` rule."""
+        if self.sp > 1 and self.ep > 1:
             raise ValueError(
-                "ReplicaMesh does not support MoE configs: expert "
-                "weights are 3-D and outside the output-axis sharding "
-                "rule")
+                "ReplicaMesh is at most 2-D: pick ONE second axis "
+                f"(got sp={self.sp} AND ep={self.ep})")
+        n_experts = getattr(config, "n_experts", 0)
+        if n_experts and n_experts % self.ep:
+            raise ValueError(
+                f"ReplicaMesh(ep={self.ep}): config.n_experts="
+                f"{n_experts} is not divisible by the 'ep' axis size "
+                f"{self.ep} (MoE expert weights shard over the "
+                "second, expert-parallel mesh axis)")
+        if self.ep > 1 and not n_experts:
+            raise ValueError(
+                f"ReplicaMesh(ep={self.ep}): the 'ep' axis shards MoE "
+                "expert weights, but config.n_experts=0 (dense "
+                "config) — use sp for a dense second axis")
         for name in ("n_kv_heads", "n_heads", "d_model", "d_ff",
                      "vocab_size"):
             value = getattr(config, name)
             if value % self.tp:
                 raise ValueError(
                     f"ReplicaMesh(tp={self.tp}): config.{name}="
-                    f"{value} is not divisible by tp")
+                    f"{value} is not divisible by the 'tp' axis size "
+                    f"{self.tp}")
 
 
 def named_sharding(mesh: Mesh, *spec) -> NamedSharding:

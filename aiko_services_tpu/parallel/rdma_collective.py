@@ -33,6 +33,7 @@ DMA, and an unvalidated Mosaic compile wedges the relay.
 from __future__ import annotations
 
 import functools
+import inspect
 
 import jax
 import jax.numpy as jnp
@@ -44,6 +45,23 @@ try:
     from jax import shard_map  # jax >= 0.8
 except ImportError:  # pragma: no cover
     from jax.experimental.shard_map import shard_map
+
+if "check_vma" not in inspect.signature(shard_map).parameters:
+    # Older jax spells the replication check ``check_rep``.  Accept the
+    # new-style kwarg everywhere in this module and translate.
+    _shard_map_new = shard_map
+
+    def shard_map(*args, check_vma=None, **kwargs):  # noqa: F811
+        if check_vma is not None and "check_rep" in inspect.signature(
+                _shard_map_new).parameters:
+            kwargs.setdefault("check_rep", check_vma)
+        return _shard_map_new(*args, **kwargs)
+
+from .collective_matmul import _axis_size
+
+# Renamed TPUCompilerParams -> CompilerParams in newer pallas.
+_CompilerParams = getattr(pltpu, "CompilerParams",
+                          getattr(pltpu, "TPUCompilerParams", None))
 
 __all__ = ["rdma_allgather_matmul", "rdma_matmul_reducescatter",
            "rdma_allgather_matmul_sharded",
@@ -57,7 +75,7 @@ _RS_COLLECTIVE_ID = 12
 
 def _neighbors(axis_name):
     my_id = jax.lax.axis_index(axis_name)
-    num = jax.lax.axis_size(axis_name)
+    num = _axis_size(axis_name)
     right = jax.lax.rem(my_id + 1, num)
     left = jax.lax.rem(my_id + num - 1, num)
     return my_id, num, right, left
@@ -155,7 +173,7 @@ def rdma_allgather_matmul(x_shard, w_shard, axis_name: str,
     ``(m_local * axis_size, n_local)``."""
     m_local, k = x_shard.shape
     n_local = w_shard.shape[1]
-    size = jax.lax.axis_size(axis_name)
+    size = _axis_size(axis_name)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=0,
         in_specs=[pl.BlockSpec(memory_space=pl.ANY),
@@ -176,7 +194,7 @@ def rdma_allgather_matmul(x_shard, w_shard, axis_name: str,
                                        x_shard.dtype),
         grid_spec=grid_spec,
         interpret=interpret,
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             collective_id=_AG_COLLECTIVE_ID),
     )(x_shard, w_shard)
 
@@ -254,7 +272,7 @@ def rdma_matmul_reducescatter(x_shard, w_shard, axis_name: str,
     ``(m, n // axis_size)``."""
     m = x_shard.shape[0]
     n = w_shard.shape[1]
-    size = jax.lax.axis_size(axis_name)
+    size = _axis_size(axis_name)
     n_local = n // size
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=0,
@@ -276,7 +294,7 @@ def rdma_matmul_reducescatter(x_shard, w_shard, axis_name: str,
         out_shape=jax.ShapeDtypeStruct((m, n_local), x_shard.dtype),
         grid_spec=grid_spec,
         interpret=interpret,
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             collective_id=_RS_COLLECTIVE_ID),
     )(x_shard, w_shard)
 

@@ -2151,6 +2151,27 @@ def _raw_decode_tps(config_name, slots, max_seq, block_size,
     return slots * chunk_steps * n_chunks / elapsed
 
 
+def _ensure_virtual_mesh():
+    """Give the CPU backend 8 virtual devices for the mesh sections.
+    XLA reads ``--xla_force_host_platform_device_count`` at backend
+    INIT, not at jax import — so this still works in SMOKE children
+    (which import jax early to pin the platform) as long as nothing
+    has touched a device yet; once the backend is up the sections
+    just filter their degree lists to what exists."""
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "host_platform_device_count" in flags:
+        return
+    if "jax" in sys.modules:
+        try:
+            from jax._src import xla_bridge
+            if xla_bridge.backends_are_initialized():
+                return
+        except Exception:  # noqa: BLE001 - version drift: stay safe
+            return
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+
 def bench_serving_tp(degrees=(1, 2, 4), slots=4, prompt_len=32,
                      max_new=96, n_requests=8, config_name="tiny_tp",
                      chunk_steps=8):
@@ -2164,15 +2185,7 @@ def bench_serving_tp(degrees=(1, 2, 4), slots=4, prompt_len=32,
     section becomes the TP scaling sweep.  Also captures the
     engine-vs-raw-decode ratio at TP=1 (full serving stack over bare
     ``serve_chunk_paged`` at the same shapes)."""
-    # The virtual mesh flag must precede jax's backend init; when jax
-    # is already up (SMOKE children import it early) the degree list
-    # just filters down to what the backend actually has.
-    if "jax" not in sys.modules:
-        flags = os.environ.get("XLA_FLAGS", "")
-        if "host_platform_device_count" not in flags:
-            os.environ["XLA_FLAGS"] = (
-                flags
-                + " --xla_force_host_platform_device_count=8").strip()
+    _ensure_virtual_mesh()
     import jax
     from aiko_services_tpu.orchestration.continuous import (
         DecodeRequest, _bucket,
@@ -2233,6 +2246,42 @@ def bench_serving_tp(degrees=(1, 2, 4), slots=4, prompt_len=32,
     if not exact:
         log("serving_tp: EXACTNESS VIOLATION — TP degrees disagree "
             "on greedy outputs")
+    # Opt-in collective-matmul overlap on the widest degree: the
+    # reduce-scatter down-projection (LOSSY layout — partial-sum
+    # order differs from single chip, so it is a bench column, never
+    # the serving default; the exactness row above is pinned to the
+    # exact all-gather path).  Needs dense MLP weights.
+    overlap_tp = max((d for d in degrees if d > 1), default=0)
+    if overlap_tp:
+        server = PagedContinuousServer(
+            config_name=config_name, slots=slots, max_seq=max_seq,
+            chunk_steps=chunk_steps, block_size=block_size,
+            enable_prefix_cache=True, quantize=False,
+            quantize_kv=True, seed=7,
+            replica_mesh=ReplicaMesh(tp=overlap_tp, overlap=True))
+        rng = np.random.default_rng(0)
+
+        def submit_overlap(count, tag):
+            for i in range(count):
+                prompt = rng.integers(
+                    1, server.config.vocab_size,
+                    prompt_len).astype(np.int32)
+                server.submit(DecodeRequest(request_id=f"{tag}{i}",
+                                            prompt=prompt,
+                                            max_new_tokens=max_new))
+
+        submit_overlap(slots, "warm")
+        server.run_until_drained()
+        submit_overlap(n_requests, "r")
+        started = time.perf_counter()
+        finished = server.run_until_drained()
+        elapsed = time.perf_counter() - started
+        done = [r for r in finished if r.error is None]
+        tps = sum(len(r.tokens) for r in done) / elapsed
+        results["serving_tp_overlap_degree"] = overlap_tp
+        results["serving_tp_overlap_tokens_per_sec"] = round(tps)
+        log(f"serving_tp[tp={overlap_tp} overlap]: {tps:.0f} tok/s "
+            "(lossy-layout reduce-scatter down-proj, bench-only)")
     raw_tps = _raw_decode_tps(config_name, slots, max_seq, block_size,
                               chunk_steps, quantize_kv=True)
     engine_tps = results.get("serving_tp1_tokens_per_sec", 0)
@@ -2243,6 +2292,135 @@ def bench_serving_tp(degrees=(1, 2, 4), slots=4, prompt_len=32,
         log(f"serving_tp: engine-vs-raw {engine_tps}/{raw_tps:.0f} "
             f"= {engine_tps / raw_tps:.2f} (target >= 0.50; engine "
             "side includes admission + prefill, raw is pure decode)")
+    return results
+
+
+def bench_serving_mesh2d(sp_degrees=(1, 2, 4),
+                         prompt_lens=(8192, 32768), cap=256,
+                         max_new=8, config_name="tiny_tp",
+                         moe_config="moe_tiny", moe_requests=6,
+                         moe_prompt_len=32, moe_new=32):
+    """2-D replica meshes (ISSUE 18): the sequence-parallel prefill
+    sweep and the expert-parallel MoE decode cell.
+
+    * sp sweep: one long prompt per (prompt_len, sp) on a tp=2 × sp
+      mesh, shapes pre-warmed through ``warm_prefill_ladder`` so the
+      measured wall is prefill work, not compiles.  The sp window
+      admits ``sp`` admission-cap chunks per dispatch — ``sp×`` fewer
+      host dispatches per prompt — which is the lever that shows up
+      even on the shared-core virtual mesh (and becomes real chip
+      parallelism on TPU).  The greedy tokens across every degree
+      must be IDENTICAL (invariant 19 exactness bit).
+    * ep cell: an ``n_experts`` MoE config serving decode on a
+      tp × ep mesh vs single chip, with its own exactness bit (the
+      expert tree is weight-gathered into the identical single-chip
+      ``moe_ffn`` program).
+    """
+    _ensure_virtual_mesh()
+    import jax
+    from aiko_services_tpu.orchestration.continuous import (
+        DecodeRequest,
+    )
+    from aiko_services_tpu.orchestration.paged import (
+        PagedContinuousServer,
+    )
+    from aiko_services_tpu.parallel.mesh import ReplicaMesh
+
+    block_size = 16
+    sp_degrees = [sp for sp in sp_degrees
+                  if 2 * sp <= jax.device_count()]
+    results = {}
+    rng = np.random.default_rng(3)
+    prompts = {plen: rng.integers(1, 1024, plen).astype(np.int32)
+               for plen in prompt_lens}
+    tokens_by_degree = {}
+    for plen in prompt_lens:
+        label = (f"{plen // 1024}k" if plen % 1024 == 0
+                 else str(plen))
+        max_seq = plen + max_new + block_size
+        max_seq += -max_seq % block_size
+        for sp in sp_degrees:
+            mesh = (ReplicaMesh(tp=2, sp=sp) if sp > 1
+                    else ReplicaMesh(tp=2))
+            server = PagedContinuousServer(
+                config_name=config_name, slots=1, max_seq=max_seq,
+                chunk_steps=2, block_size=block_size,
+                chunk_prefill_tokens=cap, quantize_kv=True, seed=7,
+                replica_mesh=mesh)
+            warmed = server.warm_prefill_ladder()
+            server.submit(DecodeRequest(
+                request_id="p", prompt=prompts[plen],
+                max_new_tokens=max_new))
+            started = time.perf_counter()
+            finished = server.run_until_drained()
+            wall_ms = (time.perf_counter() - started) * 1e3
+            tokens_by_degree.setdefault(plen, {})[sp] = \
+                finished[0].tokens
+            results[f"mesh2d_sp{sp}_prefill_ms_{label}"] = \
+                round(wall_ms, 1)
+            log(f"serving_mesh2d[sp={sp}, {label}]: "
+                f"{wall_ms:.0f} ms wall ({warmed} ladder shapes "
+                f"warmed, {server.counters['sp_prefill_dispatches']}"
+                " sp dispatches)")
+        if len(prompt_lens) and plen == max(prompt_lens) \
+                and 1 in sp_degrees and 4 in sp_degrees:
+            base = results[f"mesh2d_sp1_prefill_ms_{label}"]
+            best = results[f"mesh2d_sp4_prefill_ms_{label}"]
+            results[f"mesh2d_sp4_speedup_{label}"] = round(
+                base / best, 3)
+            log(f"serving_mesh2d: sp=4 vs sp=1 at {label}: "
+                f"{base / best:.2f}x"
+                + ("" if best < base else
+                   "  (NO WIN — expected sp4 strictly below sp1)"))
+    sp_exact = all(
+        tokens_by_degree[plen][sp] == tokens_by_degree[plen][
+            sp_degrees[0]]
+        for plen in prompt_lens for sp in sp_degrees)
+    results["mesh2d_sp_degrees"] = list(sp_degrees)
+    results["mesh2d_sp_exact_across_degrees"] = int(sp_exact)
+    if not sp_exact:
+        log("serving_mesh2d: EXACTNESS VIOLATION — sp degrees "
+            "disagree on greedy outputs")
+
+    # -- expert-parallel MoE decode cell ---------------------------- #
+    moe_outputs = {}
+    for name, mesh in (("single", None),
+                       ("tp2ep2", ReplicaMesh(tp=2, ep=2))):
+        if mesh is not None and mesh.size > jax.device_count():
+            continue
+        server = PagedContinuousServer(
+            config_name=moe_config, slots=2, max_seq=128,
+            chunk_steps=4, block_size=block_size, quantize_kv=True,
+            seed=7, replica_mesh=mesh)
+        rng = np.random.default_rng(0)
+
+        def submit_moe(count, tag):
+            for i in range(count):
+                prompt = rng.integers(
+                    1, server.config.vocab_size,
+                    moe_prompt_len).astype(np.int32)
+                server.submit(DecodeRequest(request_id=f"{tag}{i}",
+                                            prompt=prompt,
+                                            max_new_tokens=moe_new))
+
+        submit_moe(2, "warm")
+        server.run_until_drained()
+        submit_moe(moe_requests, "r")
+        started = time.perf_counter()
+        finished = server.run_until_drained()
+        elapsed = time.perf_counter() - started
+        done = [r for r in finished if r.error is None]
+        moe_outputs[name] = {r.request_id: r.tokens for r in done}
+        tps = sum(len(r.tokens) for r in done) / elapsed
+        results[f"mesh2d_moe_{name}_tokens_per_sec"] = round(tps)
+        log(f"serving_mesh2d[moe {name}]: {tps:.0f} tok/s "
+            f"(mesh={server.mesh_shape or 'single'})")
+    ep_exact = all(out == moe_outputs["single"]
+                   for out in moe_outputs.values())
+    results["mesh2d_ep_exact_vs_single_chip"] = int(ep_exact)
+    if not ep_exact:
+        log("serving_mesh2d: EXACTNESS VIOLATION — ep mesh disagrees "
+            "with single chip")
     return results
 
 
@@ -3001,6 +3179,16 @@ SECTIONS = [
                                max_new=8, n_requests=4,
                                chunk_steps=4))
      if SMOKE else bench_serving_tp),
+    # 2-D replica meshes (ISSUE 18): sequence-parallel prefill sweep
+    # (sp-window admission, ladder-warmed) + the expert-parallel MoE
+    # decode cell, each with its exactness bit.  Established compile
+    # paths (shard_map around the jitted cores), CPU-capable.
+    ("serving_mesh2d", 900,
+     (lambda: bench_serving_mesh2d(sp_degrees=(1, 4),
+                                   prompt_lens=(1024,), cap=64,
+                                   max_new=4, moe_requests=3,
+                                   moe_new=8))
+     if SMOKE else bench_serving_mesh2d),
     # Step-time tax budget (PR 13): the engine-vs-raw gap attributed
     # to named ROADMAP levers via the step log + a device-time probe;
     # the section's gate is the table summing to the measured wall

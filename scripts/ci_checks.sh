@@ -45,4 +45,61 @@ assert results["step_attr_compiles_steady"] == 0, \
     "the timed decode phase compiled (shape leak past the fence)"
 EOF
 
+echo "== 2-D mesh smoke: tp=2 x sp=2 prefill parity + zero steady compiles =="
+# The invariant-19 gate on every push: a tp=2 x sp=2 replica on the
+# virtual 8-device CPU mesh must emit BITWISE single-chip greedy
+# tokens through the sp-window prefill path, with the whole shape
+# ladder pre-warmed so the steady phase compiles NOTHING.
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8" python - <<'EOF2'
+import numpy as np
+from aiko_services_tpu.obs import compiles
+from aiko_services_tpu.orchestration.continuous import DecodeRequest
+from aiko_services_tpu.orchestration.paged import PagedContinuousServer
+from aiko_services_tpu.parallel.mesh import ReplicaMesh
+
+
+def serve(mesh):
+    server = PagedContinuousServer(
+        config_name="tiny_tp", slots=2, max_seq=256, chunk_steps=3,
+        seed=5, block_size=16, chunk_prefill_tokens=32,
+        quantize_kv=True, replica_mesh=mesh)
+    rng = np.random.default_rng(9)
+    for i, (plen, new) in enumerate(((150, 5), (40, 4))):
+        server.submit(DecodeRequest(
+            request_id=f"r{i}",
+            prompt=rng.integers(1, 1024, plen).astype(np.int32),
+            max_new_tokens=new))
+    return server, {r.request_id: r.tokens
+                    for r in server.run_until_drained()}
+
+
+_, want = serve(None)
+ledger = compiles.install(service="ci-mesh2d")
+server = PagedContinuousServer(
+    config_name="tiny_tp", slots=2, max_seq=256, chunk_steps=3,
+    seed=5, block_size=16, chunk_prefill_tokens=32,
+    quantize_kv=True, replica_mesh=ReplicaMesh(tp=2, sp=2))
+assert server.warm_prefill_ladder() > 0
+rng = np.random.default_rng(9)
+requests = [(150, 5), (40, 4)]
+for i, (plen, new) in enumerate(requests):
+    server.submit(DecodeRequest(
+        request_id=f"r{i}",
+        prompt=rng.integers(1, 1024, plen).astype(np.int32),
+        max_new_tokens=new))
+got = {r.request_id: r.tokens for r in server.run_until_drained()}
+assert got == want, "tp=2 x sp=2 diverged from single chip"
+assert server.counters["sp_prefill_dispatches"] > 0,     "sp window never fired"
+ledger.fence()
+rng = np.random.default_rng(9)
+for i, (plen, new) in enumerate(requests):
+    server.submit(DecodeRequest(
+        request_id=f"s{i}",
+        prompt=rng.integers(1, 1024, plen).astype(np.int32),
+        max_new_tokens=new))
+server.run_until_drained()
+assert ledger.steady_compiles == 0,     f"{ledger.steady_compiles} steady-state compiles on the 2-D mesh"
+print("mesh2d smoke: parity OK, zero steady compiles")
+EOF2
+
 echo "ci_checks: OK"

@@ -403,3 +403,72 @@ def test_chaos_compile_gate_zero_steady_compiles():
     assert report.warmup_s > 0
     assert report.steady_tokens_per_sec > 0
     assert "steady" in repr(report)
+
+
+@pytest.mark.multichip
+def test_mesh2d_sp_compiles_log_bounded_and_steady_clean(
+        virtual_mesh_devices):
+    """The pow2 bucket discipline survives the 2-D mesh: on tp=2 ×
+    sp=2 the sp-window path adds ONE prefill signature per admission
+    cap (not one per offset), so distinct prefill shapes stay
+    log-bounded; the ladder pre-warm + a ragged warmup wave cover the
+    whole shape space, and after the fence an identical second wave —
+    including a mid-flight cancel + resubmit, the failover shape of
+    work — compiles NOTHING."""
+    from aiko_services_tpu.orchestration.continuous import (
+        DecodeRequest,
+    )
+    from aiko_services_tpu.orchestration.paged import (
+        PagedContinuousServer,
+    )
+    from aiko_services_tpu.parallel.mesh import ReplicaMesh
+
+    ledger = compiles.install(service="mesh2d")
+    server = PagedContinuousServer(config_name="tiny_tp", slots=2,
+                                   chunk_steps=3, seed=0,
+                                   block_size=16, max_seq=256,
+                                   chunk_prefill_tokens=32,
+                                   replica_mesh=ReplicaMesh(tp=2,
+                                                            sp=2))
+    assert server.warm_prefill_ladder() > 0       # sp-chunk ladder walk
+    rng = np.random.RandomState(0)
+
+    def wave(tag):
+        # ragged lengths straddling bucket edges, two long enough
+        # that the sp window (sp * cap = 64 tokens) fires
+        for index, plen in enumerate((5, 24, 40, 90, 150)):
+            server.submit(DecodeRequest(
+                request_id=f"{tag}{index}",
+                prompt=rng.randint(
+                    1, 64, size=plen).astype(np.int32),
+                max_new_tokens=4))
+        server.run_until_drained()
+
+    wave("a")
+    assert server.counters["sp_prefill_dispatches"] > 0
+    distinct = ledger.signatures("paged_prefill")
+    # pow2 ladder + the single sp-window shape: log-bounded in sp
+    # chunk count, NOT multiplied by it.
+    bound = int(math.log2(server.max_seq)) + 2
+    assert 0 < len(distinct) <= bound, \
+        f"{len(distinct)} prefill shapes vs bound {bound}: {distinct}"
+    assert any(sig.startswith("sp2") for _, sig in distinct), distinct
+    compiles_after_wave_a = ledger.compiles
+    ledger.fence()
+    wave("b")      # identical shape population: NOTHING may compile
+    # kill/failover-shaped churn: cancel a request mid-prefill and
+    # resubmit it — the redispatch must land on warmed programs
+    victim = DecodeRequest(
+        request_id="kill", prompt=rng.randint(
+            1, 64, size=150).astype(np.int32), max_new_tokens=4)
+    server.submit(victim)
+    server.step()
+    assert server.cancel("kill")
+    server.submit(DecodeRequest(request_id="kill2",
+                                prompt=victim.prompt,
+                                max_new_tokens=4))
+    server.run_until_drained()
+    assert ledger.compiles == compiles_after_wave_a
+    assert ledger.steady_compiles == 0
+    stats = server.stats()
+    assert stats["compiles_steady_state"] == 0

@@ -583,6 +583,77 @@ def test_disaggregated_prefill_decode_exact_over_wire(engine):
     assert router.counters["kv_remote_hints"] == 1
 
 
+@pytest.mark.multichip
+def test_disaggregated_per_role_tp_degrees_exact(engine,
+                                                 virtual_mesh_devices):
+    """DistServe's per-role parallelism argument end to end: a TP=4
+    prefill replica paired with a TP=2 decode replica through the
+    disaggregated router.  The KV wire format is degree-agnostic
+    (full kv-head width), so the cross-degree handoff is exact —
+    client tokens equal the single-chip greedy oracle."""
+    from aiko_services_tpu.orchestration.serving import ReplicaRouter
+    from aiko_services_tpu.parallel.mesh import ReplicaMesh
+
+    broker = "xdegree"
+    p0 = make_process(engine, 1, broker)
+    Registrar(process=p0)
+    engine.advance(4.0)
+
+    def tp_replica(pid, name, tp, **kwargs):
+        process = make_process(engine, pid, broker)
+        server = PagedContinuousServer(
+            config_name="tiny_tp", slots=2, max_seq=96, chunk_steps=4,
+            seed=0, block_size=16, enable_prefix_cache=True,
+            replica_mesh=ReplicaMesh(tp=tp))
+        replica = compose_instance(ContinuousReplica, actor_args(name),
+                                   process=process, server=server,
+                                   **kwargs)
+        return process, server, replica
+
+    pp, server_p, replica_p = tp_replica(2, "prefiller4", 4,
+                                         prefill_only=True)
+    pd, server_d, replica_d = tp_replica(3, "decoder2", 2)
+    pr = make_process(engine, 99, broker)
+    router = compose_instance(ReplicaRouter, actor_args("router"),
+                              process=pr, kv_transfer=True,
+                              disaggregate=True)
+    engine.drain()
+    assert router.share["replicas"] == 2
+    engine.advance(6.0)
+    engine.drain()
+    assert router.directory.role(replica_p.topic_path) == "prefill"
+    assert router.directory.role(replica_d.topic_path) == "decode"
+    assert server_p.stats()["tp_degree"] == 4
+    assert server_d.stats()["tp_degree"] == 2
+
+    responses = []
+
+    def handler(_topic, payload):
+        command, params = parse(payload)
+        if command == "infer_response":
+            responses.append(decode_swag(params[1]))
+
+    pr.add_message_handler(handler, "test/xdegree/resp")
+    prompt = np.arange(1, 41, dtype=np.int32)
+    pr.message.publish(
+        f"{router.topic_path}/in",
+        generate("infer", ["x1", "test/xdegree/resp",
+                           encode_swag({"tokens": prompt,
+                                        "max_new_tokens": 5})]))
+    _drive(engine, lambda: bool(responses))
+    # Oracle from a SINGLE-CHIP server with the same seed/config —
+    # the cross-degree pair must be bitwise equal to one chip.
+    single = PagedContinuousServer(config_name="tiny_tp", slots=2,
+                                   max_seq=96, chunk_steps=4, seed=0,
+                                   block_size=16)
+    want = reference_greedy(single, prompt, 5)
+    assert list(responses[0]["tokens_out"]) == want
+    # The TP=2 decoder really imported the TP=4 prefiller's blocks.
+    assert server_d.prefix_remote_hits == 1
+    assert server_d.kv_transfer_bytes > 0
+    assert server_p.stats()["dispatches"] == 1
+
+
 # ---------------------------------------------------------------- #
 # Chaos: killing an advertised prefix owner loses nothing
 # ---------------------------------------------------------------- #

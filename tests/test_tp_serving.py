@@ -311,3 +311,40 @@ def test_autoscaler_tp1_ledger_unchanged():
     bootstrap to target spawns exactly target replicas."""
     actions, state = decide(FleetSnapshot(now=0.0), _policy(target=2))
     assert [a.kind for a in actions] == ["spawn", "spawn"]
+
+
+def test_autoscaler_per_role_tp_degrees_flow_into_spawns():
+    """DistServe's per-role parallelism argument as config wiring:
+    ``prefill_tp=4, decode_tp=2`` makes every spawn action carry its
+    role's degree, books it in the chip ledger, and closes chip
+    targets with the RIGHT number of replicas — a 4-chip decode
+    target takes two TP=2 spawns, a 4-chip prefill target one TP=4
+    spawn."""
+    policy = _policy(target=4, decode_tp=2,
+                     prefill_target=4, prefill_tp=4)
+    assert policy.role_tp("decode") == 2
+    assert policy.role_tp("prefill") == 4
+    actions, state = decide(FleetSnapshot(now=0.0), policy)
+    spawns = [a for a in actions if a.kind == "spawn"]
+    decode = [a for a in spawns if a.role == "decode"]
+    prefill = [a for a in spawns if a.role == "prefill"]
+    assert [a.tp_degree for a in decode] == [2, 2]
+    assert [a.tp_degree for a in prefill] == [4]
+    assert sorted(state.chips[a.slot] for a in spawns) == [2, 2, 4]
+
+
+def test_autoscaler_respawn_carries_role_degree():
+    """A dead slot's replacement spawn re-carries the policy degree
+    (the chips entry was dropped with the death)."""
+    policy = _policy(target=2, decode_tp=2, backoff_base_s=0.0)
+    actions, state = decide(FleetSnapshot(now=0.0), policy)
+    slot = actions[0].slot
+    view = ReplicaView(slot=slot, tp_degree=2)
+    _, state = decide(FleetSnapshot(now=1.0, replicas=(view,)),
+                      policy, state)
+    # the replica dies: no live view, respawn after backoff
+    actions, state = decide(FleetSnapshot(now=60.0), policy, state)
+    respawns = [a for a in actions
+                if a.kind == "spawn" and a.reason == "replace"]
+    assert respawns and respawns[0].tp_degree == 2
+    assert state.chips[respawns[0].slot] == 2
