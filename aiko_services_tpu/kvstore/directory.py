@@ -12,7 +12,7 @@ Digest wire format (the value of the ``kv_prefixes`` EC-share key,
 published on the replica's state topic):
 
     <block_size>;<role>;<entry>,<entry>,...
-    entry = <hex16>/<depth>/<refs>/<hotness>[/<tier>[/<adopted>]]
+    entry = <hex16>/<depth>/<refs>/<hotness>[/<tier>[/<adopted>[/<migrating>]]]
 
 ``hex16`` is the first 8 bytes of the chain key (64 collision bits —
 ample for directory routing; the replica re-verifies full keys at
@@ -26,7 +26,15 @@ so the router prices it below an HBM hit but above a recompute),
 ``adopted`` marks a tier-2 entry re-adopted from the spill directory
 by a warm replica restart (0 omitted on the wire — the 5-field tier
 format stays valid byte-for-byte, same back-compat move the ``tier``
-field made on the 4-field format).  The format is S-expression-safe
+field made on the 4-field format).  ``migrating`` marks the replica
+as the SOURCE of an in-flight live migration: its cache is about to
+move, so routers must stop scoring it for NEW prefix placement (the
+blocks stay exportable — peers may still pull them).  A zero flag is
+omitted, cascading like tier/adopted; when set, encode writes the
+FULL entry (tier and adopted included even at 0 — the fields are
+positional).  Decoders accept 4/5/6/7-field entries, so old routers
+parse a migrating digest and simply ignore the flag.  The format is
+S-expression-safe
 by construction: hex, digits, ``;,/`` only — no spaces or parens.
 
 Staleness is LEASE-based: each replica's advertisement expires
@@ -96,34 +104,44 @@ def shareable_blocks(prompt_len: int, block_size: int) -> int:
 
 
 def digest_encode(block_size: int, role: str,
-                  entries: Sequence[Tuple]) -> str:
-    """``entries`` = [(hex16, depth, refs, hotness[, tier[,
-    adopted]])] — already selected/ordered by the replica (hottest,
+                  entries: Sequence[Tuple],
+                  migrating: int = 0) -> str:
+    """``entries`` = [(hex16, depth, refs, hotness[, tier[, adopted[,
+    migrating]]])] — already selected/ordered by the replica (hottest,
     deepest first).  A missing or zero tier (HBM) is omitted on the
     wire, so untiered replicas keep emitting the 4-field format
     byte-for-byte; likewise a zero adopted flag keeps the 5-field
-    tier format."""
+    tier format and a zero migrating flag the 6-field one.  A SET
+    migrating flag forces the full 7-field entry (fields are
+    positional — tier/adopted are written even at 0).  The
+    ``migrating`` kwarg ORs into every entry: the flag is a property
+    of the advertising replica, so the publisher passes it once
+    instead of rewriting its entry tuples."""
     parts = []
+    migrating = int(bool(migrating))
     for entry in entries:
         hex_key, depth, refs, hot = entry[:4]
         tier = entry[4] if len(entry) > 4 else 0
         adopted = entry[5] if len(entry) > 5 else 0
+        moving = migrating or (entry[6] if len(entry) > 6 else 0)
         item = f"{hex_key}/{depth}/{refs}/{hot}"
-        if tier or adopted:
+        if tier or adopted or moving:
             item += f"/{int(tier)}"
-        if adopted:
+        if adopted or moving:
             item += f"/{int(adopted)}"
+        if moving:
+            item += f"/{int(moving)}"
         parts.append(item)
     return f"{block_size};{role};{','.join(parts)}"
 
 
 def digest_decode(text: str):
-    """Returns ``(block_size, role, entries)`` with 6-tuple entries
-    ``(hex16, depth, refs, hotness, tier, adopted)`` — tier/adopted
-    default to 0 for the shorter (pre-tier, pre-spill) formats — or
-    ``None`` on any malformed input (directory updates are
-    best-effort: a corrupt advertisement is dropped, never raises
-    into the router)."""
+    """Returns ``(block_size, role, entries)`` with 7-tuple entries
+    ``(hex16, depth, refs, hotness, tier, adopted, migrating)`` —
+    tier/adopted/migrating default to 0 for the shorter (pre-tier,
+    pre-spill, pre-migration) formats — or ``None`` on any malformed
+    input (directory updates are best-effort: a corrupt advertisement
+    is dropped, never raises into the router)."""
     try:
         block_text, role, body = str(text).split(";", 2)
         block_size = int(block_text)
@@ -131,13 +149,14 @@ def digest_decode(text: str):
         if body:
             for item in body.split(","):
                 fields = item.split("/")
-                if len(fields) not in (4, 5, 6):
+                if len(fields) not in (4, 5, 6, 7):
                     return None
                 tier = int(fields[4]) if len(fields) > 4 else 0
                 adopted = int(fields[5]) if len(fields) > 5 else 0
+                migrating = int(fields[6]) if len(fields) > 6 else 0
                 entries.append((fields[0], int(fields[1]),
                                 int(fields[2]), int(fields[3]),
-                                tier, adopted))
+                                tier, adopted, migrating))
         return block_size, role, entries
     except (TypeError, ValueError):
         return None
@@ -163,6 +182,10 @@ class PrefixDirectory:
         self._expiry: Dict[str, float] = {}
         self._block_size: Dict[str, int] = {}
         self._role: Dict[str, str] = {}
+        # Replica-level migrating flag (any advertised entry carries
+        # it): the source of an in-flight live migration keeps its
+        # blocks exportable but must stop attracting NEW placements.
+        self._migrating: Dict[str, bool] = {}
 
     # -- ingest ---------------------------------------------------- #
 
@@ -176,7 +199,10 @@ class PrefixDirectory:
         block_size, role, entries = decoded
         self._by_replica[replica] = {
             hex_key: (depth, refs, hot, tier, adopted)
-            for hex_key, depth, refs, hot, tier, adopted in entries}
+            for hex_key, depth, refs, hot, tier, adopted, _migr
+            in entries}
+        self._migrating[replica] = any(
+            entry[6] for entry in entries)
         self._block_size[replica] = block_size
         self._role[replica] = role
         self._expiry[replica] = now + self.lease_s
@@ -187,6 +213,7 @@ class PrefixDirectory:
         self._expiry.pop(replica, None)
         self._block_size.pop(replica, None)
         self._role.pop(replica, None)
+        self._migrating.pop(replica, None)
 
     def purge_expired(self, now: float) -> None:
         for replica in [r for r, t in self._expiry.items()
@@ -203,6 +230,13 @@ class PrefixDirectory:
 
     def role(self, replica: str) -> Optional[str]:
         return self._role.get(replica)
+
+    def migrating(self, replica: str) -> bool:
+        """True while the replica's last advertisement carried the
+        migrating flag: its cache is mid-flight, so prefix-affinity
+        scoring for NEW placements should skip it (the router still
+        routes the requests it already holds)."""
+        return self._migrating.get(replica, False)
 
     def replicas(self) -> List[str]:
         return list(self._by_replica)

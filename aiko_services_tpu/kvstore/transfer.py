@@ -99,7 +99,8 @@ from ..obs import pool_audit
 from .directory import HEX_KEY_CHARS, chain_keys, shareable_blocks
 
 __all__ = ["pool_signature", "export_payload", "import_payload",
-           "payload_bytes", "seed_chain", "gather_block_rows",
+           "payload_bytes", "drop_one_block", "seed_chain",
+           "gather_block_rows",
            "scatter_block_rows", "scatter_block_row_dicts",
            "gather_block_rows_legacy", "scatter_block_rows_legacy"]
 
@@ -121,6 +122,26 @@ def payload_bytes(payload: Dict) -> int:
     framing overhead excluded by convention)."""
     return sum(int(value.nbytes) for value in payload.values()
                if isinstance(value, np.ndarray))
+
+
+def drop_one_block(payload: Dict) -> Optional[Dict]:
+    """Chaos helper (the ``drop_migration_block`` fault point): trim
+    the LAST block off an export payload — keys and every per-layer
+    row stack — so the chain stays contiguous but arrives one block
+    short.  The importer registers what it got and the resume's
+    admission walk recomputes the missing tail: strictly a
+    degradation, never a correctness hazard.  Returns ``None`` when
+    the payload held a single block (nothing left to ship — the
+    caller degrades to the ``kv_prefix_gone`` cold path)."""
+    keys = list(payload.get("kv_keys", []))
+    if len(keys) <= 1:
+        return None
+    trimmed = dict(payload)
+    trimmed["kv_keys"] = keys[:-1]
+    for field, value in payload.items():
+        if field.startswith("kv_l") and isinstance(value, np.ndarray):
+            trimmed[field] = value[:-1]
+    return trimmed
 
 
 def _pack(array: np.ndarray) -> np.ndarray:

@@ -170,6 +170,20 @@ class AutoscalerPolicy:
     #: 0 = same as ``decode_tp`` (homogeneous fleet, the default).
     prefill_tp: int = 0
 
+    #: Drain-free scale-in: emit ``migrate`` instead of ``drain`` for
+    #: surplus capacity.  The executor live-migrates the victim's
+    #: in-flight population to the rest of the fleet before retiring
+    #: it, so scale-in (and resharding, below) opens no goodput hole
+    #: waiting for long-tail requests to finish on a retiring replica.
+    migrate_drains: bool = False
+    #: In-place TP resharding: when a live replica's chip weight no
+    #: longer matches ``role_tp(role)`` (the operator changed
+    #: ``decode_tp``/``prefill_tp`` under a running fleet), spawn a
+    #: replacement at the new degree and migrate the old-degree
+    #: replica out — one replacement in flight at a time per role.
+    #: Requires ``migrate_drains`` to be drain-free end to end.
+    reshard_tp: bool = False
+
     def role_tp(self, role: str) -> int:
         if role == "prefill" and self.prefill_tp:
             return int(self.prefill_tp)
@@ -235,7 +249,9 @@ class ControllerState:
 @dataclasses.dataclass(frozen=True)
 class Action:
     """One controller decision.  ``spawn`` (new slot or respawn into
-    an existing one), ``drain`` (begin graceful retire), ``quarantine``
+    an existing one), ``drain`` (begin graceful retire), ``migrate``
+    (drain-free retire: live-migrate the in-flight population to the
+    rest of the fleet — or to ``dest`` — THEN retire), ``quarantine``
     (stop respawning a crash-looper)."""
     kind: str
     slot: str
@@ -245,9 +261,13 @@ class Action:
     #: the policy's per-role TP degree, for the spawner to build the
     #: matching ReplicaMesh.
     tp_degree: int = 1
+    #: migration destination SLOT (``migrate`` only; empty = let the
+    #: router pick a destination per request).
+    dest: str = ""
 
     def describe(self) -> str:
         return f"{self.kind}:{self.slot}" + \
+            (f"->{self.dest}" if self.dest else "") + \
             (f" ({self.reason})" if self.reason else "")
 
 
@@ -442,11 +462,41 @@ def decide(snapshot: FleetSnapshot, policy: AutoscalerPolicy,
         if surplus > 0 and live:
             fitting = [slot for slot in live
                        if weight(slot) <= surplus] or live
+            # Under resharding, surplus exists BECAUSE a new-degree
+            # replacement came up: evict mismatched-degree replicas
+            # first so the fleet converges on the policy degree.
             idlest = min(fitting, key=lambda slot: (
+                (weight(slot) == policy.role_tp(role))
+                if policy.reshard_tp else False,
                 alive[slot].queue_depth, alive[slot].slots_active,
                 slot))
-            actions.append(Action("drain", idlest, role=role,
+            kind = "migrate" if policy.migrate_drains else "drain"
+            actions.append(Action(kind, idlest, role=role,
                                   reason="scale_in"))
+
+        # In-place TP resharding: with the fleet stable at target and
+        # nothing pending, replace ONE mismatched-degree live replica
+        # per tick by spawning its new-degree successor.  The spawn
+        # overshoots the chip target; next tick's surplus branch
+        # (migrate, per the mismatched-first victim preference above)
+        # evicts old-degree capacity until the ledger re-balances —
+        # repeat until every replica matches ``role_tp(role)``.
+        elif (policy.reshard_tp and surplus == 0 and live
+              and not pending):
+            mismatched = [slot for slot in live
+                          if weight(slot) != policy.role_tp(role)]
+            if mismatched:
+                state.spawn_seq += 1
+                slot = f"{role}{state.spawn_seq}"
+                while slot in state.slots or slot in state.quarantined:
+                    state.spawn_seq += 1
+                    slot = f"{role}{state.spawn_seq}"
+                state.slots[slot] = role
+                actions.append(Action(
+                    "spawn", slot, role=role,
+                    reason=f"reshard:{sorted(mismatched)[0]}",
+                    tp_degree=policy.role_tp(role)))
+                state.chips[slot] = policy.role_tp(role)
 
     return actions, state
 
@@ -496,7 +546,10 @@ class FleetAutoscaler(Actor):
 
     Operator commands: ``(scale_target N)`` / ``(scale_target role N)``
     pins a role's target; ``(clear_quarantine slot)`` lifts a
-    quarantine and resets the slot's death history."""
+    quarantine and resets the slot's death history;
+    ``(rolling_upgrade)`` / ``(rolling_upgrade role)`` replaces every
+    live replica one at a time with the in-flight population
+    live-migrated across (zero-downtime weight/version upgrade)."""
 
     def __init__(self, context, process=None,
                  spawner: Optional[Callable] = None,
@@ -517,6 +570,14 @@ class FleetAutoscaler(Actor):
         self._command_handlers["scale_target"] = self._wire_scale_target
         self._command_handlers["clear_quarantine"] = \
             self._wire_clear_quarantine
+        self._command_handlers["rolling_upgrade"] = \
+            self._wire_rolling_upgrade
+
+        #: rolling upgrade: sources awaiting replacement, FIFO.
+        self._upgrade_queue: List[str] = []
+        #: replacement slot -> source slot it supersedes.
+        self._upgrade_pairs: Dict[str, str] = {}
+        self._upgrade_seq = 0
 
         #: slot -> latest telemetry parsed off the replica state topic.
         self._telemetry: Dict[str, Dict] = {}
@@ -543,6 +604,7 @@ class FleetAutoscaler(Actor):
         self.counters: Dict[str, int] = CounterDict(dict(
             spawns=0, respawns=0, spawn_failures=0, slow_starts=0,
             drains=0, drain_completed=0, drain_timeouts=0,
+            migrates=0, upgrades_started=0, upgrades_completed=0,
             scale_out=0, scale_in=0, quarantines=0,
             deaths_observed=0),
             prefix="autoscaler", labels={"actor": self.name})
@@ -580,6 +642,9 @@ class FleetAutoscaler(Actor):
             self._replica_state, f"{fields.topic_path}/state")
         self.logger.info("%s: replica %s announced (%s)", self.name,
                          slot, fields.topic_path)
+        source = self._upgrade_pairs.pop(slot, None)
+        if source is not None:
+            self._complete_upgrade(source, slot)
 
     def _replica_removed(self, fields):
         slot = fields.name
@@ -632,7 +697,7 @@ class FleetAutoscaler(Actor):
         key, value = str(params[0]), params[1]
         telemetry = self._telemetry.setdefault(slot, {})
         if key in ("queue_depth", "slots_active", "deadline_exceeded",
-                   "drained"):
+                   "drained", "tp_degree"):
             try:
                 telemetry[key] = int(value)
             except (TypeError, ValueError):
@@ -781,6 +846,7 @@ class FleetAutoscaler(Actor):
         now = self.process.event.now()
         self._check_pending(now)
         self._check_draining(now)
+        self._check_upgrades(now)
         snapshot = self.snapshot()
         before = dict(self.state.targets)
         streak_before = self.state.breach_streak
@@ -839,6 +905,8 @@ class FleetAutoscaler(Actor):
             self._begin_spawn(action, now)
         elif action.kind == "drain":
             self._begin_drain(action, now)
+        elif action.kind == "migrate":
+            self._begin_migrate(action, now)
         elif action.kind == "quarantine":
             self._bump("quarantines")
             self._set_share("quarantine", " ".join(
@@ -904,6 +972,132 @@ class FleetAutoscaler(Actor):
         self._set_share("last_action", action.describe())
         self.logger.info("%s: draining %s (%s)", self.name, slot,
                          action.reason)
+        self.process.message.publish(f"{topic}/in", "(retire)")
+
+    def _begin_migrate(self, action: Action, now: float) -> None:
+        """Drain-free retire: ask the router to live-migrate the
+        victim's in-flight population away (to ``action.dest`` when
+        set, else router's choice per request), then retire it.  The
+        retire lands with the population already moving, so the slot
+        reports ``drained`` as soon as the cutovers finish instead of
+        after its longest request does."""
+        slot = action.slot
+        topic = self._topics.get(slot)
+        if topic is None or slot in self._draining:
+            return
+        if self._router_topic is not None:
+            params = [topic]
+            dest_topic = self._topics.get(action.dest)
+            if dest_topic:
+                params.append(dest_topic)
+            self.process.message.publish(
+                f"{self._router_topic}/in",
+                generate("migrate", params))
+        self._draining[slot] = now + self.policy.drain_timeout_s
+        self._bump("migrates")
+        self._set_share("last_action", action.describe())
+        self.logger.info("%s: migrating %s away (%s)", self.name,
+                         slot, action.reason)
+        self.process.message.publish(f"{topic}/in", "(retire)")
+
+    # -- rolling upgrades ---------------------------------------------- #
+
+    def _wire_rolling_upgrade(self, *params):
+        """``(rolling_upgrade)`` / ``(rolling_upgrade role)``: replace
+        every live replica (of one role, or all) one at a time —
+        spawn a successor, live-migrate the in-flight population onto
+        it at announce, retire the predecessor — so a weight/version
+        upgrade rolls through the fleet with zero downtime and the
+        population carried across."""
+        role_filter = str(params[0]) if params else ""
+        added = 0
+        for slot in sorted(self._topics):
+            if role_filter and \
+                    self.state.slots.get(slot, "decode") != role_filter:
+                continue
+            if slot in self._draining or slot in self._upgrade_queue \
+                    or slot in self._upgrade_pairs.values():
+                continue
+            self._upgrade_queue.append(slot)
+            added += 1
+        self._set_share("last_action",
+                        f"rolling_upgrade:{added} queued")
+        self.logger.info("%s: rolling upgrade queued for %d replicas",
+                         self.name, added)
+
+    def _check_upgrades(self, now: float) -> None:
+        # A replacement that died before announcing (spawn failure,
+        # instant crash): abort that leg and requeue the source so a
+        # later attempt still replaces it.
+        for new_slot, source in list(self._upgrade_pairs.items()):
+            if new_slot in self._pending or new_slot in self._topics:
+                continue
+            self._upgrade_pairs.pop(new_slot, None)
+            self._draining.pop(source, None)
+            self.logger.warning(
+                "%s: upgrade replacement %s for %s died before "
+                "announcing — requeueing the source", self.name,
+                new_slot, source)
+            if source in self._topics:
+                self._upgrade_queue.insert(0, source)
+        # One replacement in flight at a time: the fleet never dips
+        # below (or spikes above) target by more than one replica.
+        if self._upgrade_pairs or self._pending \
+                or not self._upgrade_queue:
+            return
+        while self._upgrade_queue:
+            source = self._upgrade_queue.pop(0)
+            if source in self._topics \
+                    and source not in self._draining:
+                break
+        else:
+            return
+        role = self.state.slots.get(source, "decode")
+        self._upgrade_seq += 1
+        new_slot = f"{role}u{self._upgrade_seq}"
+        while new_slot in self.state.slots \
+                or new_slot in self.state.quarantined:
+            self._upgrade_seq += 1
+            new_slot = f"{role}u{self._upgrade_seq}"
+        tp = int(self.state.chips.get(source, 0)) \
+            or self.policy.role_tp(role)
+        # Register the successor in the ledger AND mark the source
+        # draining now: the chip total stays at target through the
+        # handoff, so decide() never drains a healthy bystander to
+        # shed the temporary overlap.  The generous deadline covers
+        # the spawn; it tightens once the retire actually goes out.
+        self.state.slots[new_slot] = role
+        self.state.chips[new_slot] = tp
+        self._upgrade_pairs[new_slot] = source
+        self._draining[source] = now + self.policy.spawn_timeout_s \
+            + self.policy.drain_timeout_s
+        self._bump("upgrades_started")
+        self._begin_spawn(Action(
+            "spawn", new_slot, role=role,
+            reason=f"upgrade:{source}", tp_degree=tp), now)
+
+    def _complete_upgrade(self, source: str, dest: str) -> None:
+        """The upgrade successor announced: hand the source's live
+        population to it and retire the source.  With
+        ``policy.migrate_drains`` off this degrades to the drain-based
+        replacement (retire and wait out the in-flight tail) — the
+        A/B control the bench compares against."""
+        topic = self._topics.get(source)
+        if topic is None:
+            self._bump("upgrades_completed")
+            return
+        if self._router_topic is not None and \
+                self.policy.migrate_drains:
+            self.process.message.publish(
+                f"{self._router_topic}/in",
+                generate("migrate", [topic, self._topics[dest]]))
+            self._bump("migrates")
+        self._draining[source] = self.process.event.now() \
+            + self.policy.drain_timeout_s
+        self._bump("upgrades_completed")
+        self._set_share("last_action", f"upgrade:{source}->{dest}")
+        self.logger.info("%s: upgrade handoff %s -> %s", self.name,
+                         source, dest)
         self.process.message.publish(f"{topic}/in", "(retire)")
 
     def _check_pending(self, now: float) -> None:

@@ -2400,6 +2400,8 @@ class ContinuousReplica(Actor):
         self._command_handlers["infer_cancel"] = self._wire_cancel
         self._command_handlers["kv_export"] = self._wire_kv_export
         self._command_handlers["retire"] = self._wire_retire
+        self._command_handlers["migrate_prepare"] = \
+            self._wire_migrate_prepare
         self.share["slots"] = self.server.slots
         self.share["tp_degree"] = getattr(self.server, "tp_degree", 1)
         self.share["mesh_shape"] = getattr(self.server, "mesh_shape",
@@ -2413,6 +2415,13 @@ class ContinuousReplica(Actor):
         #: Keyed by object identity, not request_id: the client owns
         #: that string and may reuse it across concurrent requests.
         self._stream_sent: Dict[int, int] = {}
+        #: request ids a router is live-migrating AWAY from this
+        #: replica: while non-empty the prefix digest carries the
+        #: ``/migrating`` flag (routers stop scoring this replica for
+        #: NEW prefix placement) and the shared lifecycle reads
+        #: ``migrating``.  Ids clear when their request terminates
+        #: here (usually via the post-cutover cancel).
+        self._migrating_ids: set = set()
         #: slowest completed requests — ``(total_ms, request_id,
         #: {phase: ms})`` kept sorted descending; surfaces in the EC
         #: share as ``slow_requests`` for the dashboard pane.
@@ -2468,6 +2477,8 @@ class ContinuousReplica(Actor):
                 request.trace_ctx = str(carrier)
             kv_source = inputs.get("kv_source")
             kv_tier_hint = inputs.get("kv_tier_hint")
+            kv_migrate = bool(
+                int(np.asarray(inputs.get("kv_migrate", 0))))
             if self.prefill_only or inputs.get("prefill_only"):
                 # Dedicated prefill: the admission seed IS the one
                 # generated token; the prompt's blocks stay cached
@@ -2482,7 +2493,8 @@ class ContinuousReplica(Actor):
             return
         if kv_source and self._kv_capable() \
                 and request.adapter is None:
-            if self._begin_kv_fetch(request, str(kv_source)):
+            if self._begin_kv_fetch(request, str(kv_source),
+                                    migrate=kv_migrate):
                 return        # parked until import or timeout
         if kv_tier_hint and request.adapter is None \
                 and hasattr(self.server, "prefetch_promote"):
@@ -2517,6 +2529,64 @@ class ContinuousReplica(Actor):
         if self.server.busy:
             self._ensure_pumping()
 
+    def _wire_migrate_prepare(self, request_id, response_topic,
+                              payload=None):
+        """``(migrate_prepare mid reply swag{request_id})`` — a router
+        is live-migrating one of our requests away.  Register the
+        request's LIVE chain (prompt + committed tokens) in the prefix
+        index so ``kv_export`` can serve it, mark the request
+        migrating (digest flag + ``migrating`` lifecycle), and answer
+        ``(migrate_ready mid swag{request_id, blocks, tokens})`` — or
+        an error swag the router degrades on (cold resume, or abort
+        when the request is simply gone).  We KEEP serving the
+        request: the double-delivery window is the whole point."""
+        from ..pipeline.codec import decode_swag, encode_swag
+        mid = str(request_id)
+        try:
+            target_id = str(decode_swag(payload or {})["request_id"])
+        except Exception:  # noqa: BLE001 - malformed → router aborts
+            target_id = ""
+        request = next(
+            (r for r in self.server.live_requests()
+             if r.request_id == target_id), None)
+        if request is None:
+            outputs: Dict = {"request_id": target_id,
+                             "error": "migrate_unknown_request"}
+        elif not self._kv_capable() \
+                or not hasattr(self.server, "publish_live_chain"):
+            outputs = {"request_id": target_id,
+                       "error": "migrate_unsupported"}
+        else:
+            try:
+                blocks = int(self.server.publish_live_chain(request))
+            except Exception:  # noqa: BLE001 - degrade to cold resume
+                self.logger.exception(
+                    "%s: publish_live_chain failed for %s",
+                    self.name, target_id)
+                blocks = -1
+            if blocks < 0:
+                outputs = {"request_id": target_id,
+                           "error": "migrate_export_failed"}
+            else:
+                outputs = {"request_id": target_id, "blocks": blocks,
+                           "tokens": len(request.tokens or [])}
+                self._migrating_ids.add(target_id)
+                updates = {}
+                if self.share.get("lifecycle") == "ready":
+                    updates["lifecycle"] = "migrating"
+                # Push the flagged digest NOW — routers must stop
+                # scoring us for new prefix placement before the
+                # transfer traffic starts, not at the next pump.
+                updates["kv_prefixes"] = self.server.prefix_digest(
+                    role=self.kv_role, migrating=True)
+                self.share.update(updates)
+                if self.ec_producer is not None:
+                    for key, value in updates.items():
+                        self.ec_producer.update(key, value)
+        self.process.message.publish(
+            str(response_topic),
+            generate("migrate_ready", [mid, encode_swag(outputs)]))
+
     def _ensure_pumping(self):
         if not self._pumping:
             self._pumping = True
@@ -2544,6 +2614,24 @@ class ContinuousReplica(Actor):
                     import os
                     os._exit(13)
                 return
+            if self._migrating_ids:
+                hit = faults.PLAN.check("kill_source_mid_migration",
+                                        key=self.name)
+                if hit is not None:
+                    # Die as the SOURCE of an in-flight migration —
+                    # the router must promote the destination when
+                    # the resume was dispatched, else fall back to
+                    # the plain re-dispatch replay.  Same LWT path
+                    # as kill_replica.
+                    self.logger.warning(
+                        "%s: fault kill_source_mid_migration firing",
+                        self.name)
+                    self._pumping = False
+                    self.process.kill()
+                    if hit.get("hard"):
+                        import os
+                        os._exit(13)
+                    return
         finished = self.server.step()
         self._stream_partials()
         for request in finished:
@@ -2568,8 +2656,9 @@ class ContinuousReplica(Actor):
         from .serving import serving_telemetry
         updates = serving_telemetry(self.server.stats())
         if self._kv_capable():
-            updates["kv_prefixes"] = \
-                self.server.prefix_digest(role=self.kv_role)
+            updates["kv_prefixes"] = self.server.prefix_digest(
+                role=self.kv_role,
+                migrating=bool(self._migrating_ids))
         hists = self.server.latency_hists
         if hists["ttft"].count:
             updates["ttft_p50_ms"] = round(hists["ttft"].quantile(0.5), 1)
@@ -2629,7 +2718,8 @@ class ContinuousReplica(Actor):
         and catches routers that subscribed after the last change."""
         if not self._kv_capable():
             return
-        digest = self.server.prefix_digest(role=self.kv_role)
+        digest = self.server.prefix_digest(
+            role=self.kv_role, migrating=bool(self._migrating_ids))
         self.share["kv_prefixes"] = digest
         if self.ec_producer is not None:
             self.ec_producer.update("kv_prefixes", digest)
@@ -2653,6 +2743,21 @@ class ContinuousReplica(Actor):
                 exported = self.server.kv_export_payload(
                     keys,
                     int(np.asarray(inputs.get("kv_start_depth", 0))))
+                if faults.PLAN is not None:
+                    if exported is not None \
+                            and inputs.get("kv_migrate") \
+                            and faults.PLAN.check(
+                                "drop_migration_block",
+                                key=str(request_id)) is not None:
+                        # Ship the migration chain one block short:
+                        # the destination's import comes up short and
+                        # its admission walk recomputes the tail —
+                        # colder, never wrong.
+                        from ..kvstore.transfer import drop_one_block
+                        self.logger.warning(
+                            "%s: fault drop_migration_block firing",
+                            self.name)
+                        exported = drop_one_block(exported)
                 outputs = exported if exported is not None \
                     else {"error": "kv_prefix_gone"}
             except Exception:  # noqa: BLE001 - RPC must answer
@@ -2672,7 +2777,8 @@ class ContinuousReplica(Actor):
                      [str(request_id), encode_swag(outputs)]))
 
     def _begin_kv_fetch(self, request: DecodeRequest,
-                        kv_source: str) -> bool:
+                        kv_source: str,
+                        migrate: bool = False) -> bool:
         """Warm start: request the prompt's missing prefix blocks
         from the owner the router named.  Returns False when there is
         nothing worth fetching (prompt too short, already cached
@@ -2692,6 +2798,11 @@ class ContinuousReplica(Actor):
         self._kv_pending[token] = request
         self._kv_started[token] = time.monotonic()
         swag = {"kv_keys": keys[local:], "kv_start_depth": local}
+        if migrate:
+            # Marks the export as a live-migration transfer: the
+            # source tags its accountant flows and the
+            # ``drop_migration_block`` fault point keys off it.
+            swag["kv_migrate"] = 1
         if request.trace_ctx:
             # The owner answers with its "kv_export" span under the
             # SAME trace — the transfer source joins the request tree.
@@ -2868,6 +2979,16 @@ class ContinuousReplica(Actor):
         # partials always equal the final sequence.
         self._emit_partial(request)
         self._stream_sent.pop(id(request), None)
+        if request.request_id in self._migrating_ids:
+            # The migrated-away request reached a terminal state here
+            # (usually the post-cutover cancel): this replica is no
+            # longer anyone's migration source.
+            self._migrating_ids.discard(request.request_id)
+            if not self._migrating_ids \
+                    and self.share.get("lifecycle") == "migrating":
+                self.share["lifecycle"] = "ready"
+                if self.ec_producer is not None:
+                    self.ec_producer.update("lifecycle", "ready")
         self.share["requests_served"] += 1
         if self.ec_producer is not None:
             self.ec_producer.update("requests_served",

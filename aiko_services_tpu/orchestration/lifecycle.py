@@ -60,6 +60,8 @@ class LifeCycleManager(Actor):
         self._deletion_time = deletion_lease_time
         self._handshake_leases: Dict[str, Lease] = {}
         self._deletion_leases: Dict[str, Lease] = {}
+        # successor id -> predecessor id (replace_client handoffs).
+        self._replacements: Dict[str, str] = {}
         # Clients handshake on the manager's control topic (reference
         # lifecycle.py _lcm_topic_control_handler); this coexists with the
         # ECProducer's handler on the same topic.
@@ -108,6 +110,19 @@ class LifeCycleManager(Actor):
             lease_expired_handler=self._deletion_expired,
             engine=self.process.event)
 
+    def replace_client(self, client_id, new_client_id) -> None:
+        """Zero-downtime replacement: spawn ``new_client_id`` now and
+        delete ``client_id`` only once the successor completes its
+        handshake — the lifecycle-layer analogue of the autoscaler's
+        rolling upgrade (the fleet never dips below size during the
+        swap).  If the successor misses its handshake lease, the
+        predecessor is kept and the replacement is dropped."""
+        client_id, new_client_id = str(client_id), str(new_client_id)
+        if client_id not in self.clients:
+            raise ValueError(f"Unknown client: {client_id}")
+        self._replacements[new_client_id] = client_id
+        self.create_client(new_client_id)
+
     def client_count(self, ready_only: bool = False) -> int:
         if ready_only:
             return sum(1 for tp in self.clients.values() if tp)
@@ -127,6 +142,9 @@ class LifeCycleManager(Actor):
             lease.terminate()
         if self._client_ready_handler:
             self._client_ready_handler(client_id, str(client_topic_path))
+        predecessor = self._replacements.pop(client_id, None)
+        if predecessor is not None:
+            self.delete_client(predecessor)
 
     def remove_client(self, client_id):
         """Client announced clean exit: ``(remove_client id)``."""
@@ -158,6 +176,7 @@ class LifeCycleManager(Actor):
                 lease.terminate()
         existed = client_id in self.clients
         self.clients.pop(client_id, None)
+        self._replacements.pop(client_id, None)
         if existed and self._client_exit_handler:
             self._client_exit_handler(client_id)
 

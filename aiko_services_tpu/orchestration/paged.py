@@ -1823,7 +1823,8 @@ class PagedContinuousServer(ContinuousBatchingServer):
     # (jaxpr + AST guards in tests/test_kvstore.py).
 
     def prefix_digest(self, role: str = "decode",
-                      max_entries: int = 64) -> str:
+                      max_entries: int = 64,
+                      migrating: bool = False) -> str:
         """Compact advertisement of this replica's cached prefix
         blocks for the cluster directory: content-complete (not
         producing), base-adapter chains only, hottest + deepest first,
@@ -1854,7 +1855,69 @@ class PagedContinuousServer(ContinuousBatchingServer):
                             1 if key in self._adopted_keys else 0))
         entries.sort(key=lambda e: (-e[3], -e[1], e[0]))
         return _kvdir.digest_encode(self.block_size, role,
-                                    entries[:max_entries])
+                                    entries[:max_entries],
+                                    migrating=int(migrating))
+
+    def publish_live_chain(self, request) -> int:
+        """Live-migration prepare: register a HELD request's chain —
+        prompt plus every committed generated token, bounded by
+        ``_shareable_blocks`` so the decode frontier's rewritten row
+        never ships — in the prefix index, making it resolvable by
+        ``kv_export`` exactly like a retired chain.  Returns the
+        number of exportable blocks (0 = nothing shippable: cache
+        off, adapter-seeded, or the chain is shorter than one block;
+        the migration proceeds cold).  Registered blocks carry the
+        slot's ref like any admission-registered key, so
+        ``_release_slot`` at the request's (post-cutover) retirement
+        leaves them cached-evictable — no new lifecycle."""
+        if not self.enable_prefix_cache:
+            return 0
+        adapter_id = self._adapter_id(request)
+        if adapter_id != 0:
+            return 0        # adapter chains never cross replicas
+        # Settle the in-flight ring so ``request.tokens`` (and the
+        # pool rows behind it) are final before we advertise them.
+        self._drain_ring()
+        try:
+            slot = self._requests.index(request)
+        except ValueError:
+            return 0        # finished while the ring drained
+        full = np.concatenate(
+            [np.asarray(request.prompt, np.int32).reshape(-1),
+             np.asarray(request.tokens or [], np.int32)])
+        keys = self._chain_keys(full)[
+            :self._shareable_blocks(len(full))]
+        owned = self._owned[slot]
+        total = 0
+        for position, key in enumerate(keys):
+            existing = self._index.get(key)
+            if existing is not None:
+                if existing in self._producing:
+                    break          # not content-complete yet
+                total = position + 1
+                continue           # already advertised (shared chain)
+            if position >= len(owned):
+                break
+            block = owned[position]
+            if block in self._producing:
+                break
+            # Same registration idiom as _reserve_slot: the slot's
+            # hold IS the one ref; _release_slot's decrement parks
+            # the block evictable when the request retires.
+            self._host_discard(key)
+            self._index[key] = block
+            self._block_key[block] = key
+            self._refs[block] = 1
+            self._key_seed[key] = 0
+            self._depth[key] = position + 1
+            self._hex_key[key.hex()[:_kvdir.HEX_KEY_CHARS]] = key
+            if position > 0:
+                parent = keys[position - 1]
+                self._parent[key] = parent
+                self._children[parent] = \
+                    self._children.get(parent, 0) + 1
+            total = position + 1
+        return total
 
     def prefix_keys_hex(self, prompt) -> List[str]:
         """Directory-width keys for a prompt's shareable blocks

@@ -287,7 +287,9 @@ class ReplicaRouter(Actor):
             prefix_routed_disk=0, kv_tier_hints=0, kv_remote_hints=0,
             anomaly_flags=0, fleet_captures=0, fleet_profiles=0,
             fleet_steady_compiles=0, fleet_censuses=0,
-            fleet_audit_violations=0),
+            fleet_audit_violations=0,
+            migrations_started=0, migrations_completed=0,
+            migrations_aborted=0, migration_blocks_streamed=0),
             prefix="router", labels={"actor": self.name})
         #: replica topic path -> last compiles_steady_state broadcast;
         #: a DELTA is a bucket-discipline breach somewhere in the
@@ -312,6 +314,17 @@ class ReplicaRouter(Actor):
         self.topic_reply = f"{self.topic_path}/reply"
         self.process.add_message_handler(self._on_reply,
                                          self.topic_reply)
+        #: Live-migration machinery (drain-free replica replacement):
+        #: destination replies arrive on a DISTINCT topic keyed by
+        #: migration id, which is what attributes them during the
+        #: double-delivery window.
+        from .migration import MigrationController
+        self.migration = MigrationController(self)
+        self.topic_migrate = f"{self.topic_path}/migrate"
+        self.process.add_message_handler(self._on_migrate_reply,
+                                         self.topic_migrate)
+        self._command_handlers["migrate"] = self._wire_migrate
+        self.share["migration_cutover_ms"] = 0.0
         self._cache = services_cache_create_singleton(self.process)
         self._cache.add_handler(
             ServiceFilter(protocol=replica_protocol),
@@ -764,6 +777,12 @@ class ReplicaRouter(Actor):
         matched, host, disk = {}, {}, {}
         for replica in candidates:
             keys = keys_by_bs.get(self.directory.block_size(replica))
+            # A replica mid-migration (digest ``/migrating`` flag) is
+            # on its way OUT: plain P2C may still use it, but scoring
+            # it for NEW prefix placement would anchor fresh chains to
+            # a replica about to retire.
+            if keys and self.directory.migrating(replica):
+                keys = None
             matched[replica], host[replica], disk[replica] = \
                 self.directory.matched_tiers(replica, keys, now) \
                 if keys else (0, 0, 0)
@@ -909,6 +928,9 @@ class ReplicaRouter(Actor):
             replica_sent=0, routed_at=self.process.event.now(),
             deadline_ts=-1.0,    # -1 = not yet resolved from payload
             phase=phase, route_span=route_span,
+            # Every token delivered to the client, in order — the
+            # migration resume's carried context (len == delivered).
+            tokens=[], migration=None,
             spans=[route_span] if route_span is not None else None)
         while len(self._inflight) > self._inflight_limit:
             dropped_id, _ = self._inflight.popitem(last=False)
@@ -950,6 +972,15 @@ class ReplicaRouter(Actor):
             error = outputs.get("error")
         except Exception:
             error = None  # corrupt swag: client resolves corrupt_response
+        if entry.get("migration") is not None \
+                and self.migration.absorb_source_final(str(params[0]),
+                                                       entry):
+            # Post-cutover: the destination owns the stream now — the
+            # source's terminal (cancel ack or a racing finish) is the
+            # double-delivery window's tail and must not reach the
+            # client.  Pre-cutover the call aborted the migration and
+            # returned False: the terminal proceeds normally below.
+            return
         if error is not None and str(error) in RETRIABLE_ERRORS \
                 and entry["attempts"] < self.max_redispatch:
             # The REPLICA failed, not the request — move the work.
@@ -1032,6 +1063,7 @@ class ReplicaRouter(Actor):
         if not fresh:
             return
         entry["delivered"] += len(fresh)
+        entry["tokens"].extend(fresh)
         self.process.message.publish(
             entry["client_topic"],
             generate("infer_partial",
@@ -1039,13 +1071,88 @@ class ReplicaRouter(Actor):
                       encode_swag({"tokens_out":
                                    np.asarray(fresh, np.int32)})]))
 
+    # -- live migration (drain-free replica replacement) -------------- #
+
+    def migrate_request(self, request_id: str,
+                        dest: Optional[str] = None) -> bool:
+        """Migrate ONE in-flight request to ``dest`` (default: best
+        live candidate that is not the source).  Returns False when
+        the request is unknown or unmigratable — the original stream
+        is untouched either way."""
+        request_id = str(request_id)
+        entry = self._inflight.get(request_id)
+        if entry is None:
+            return False
+        source = entry.get("replica")
+        if dest is None:
+            others = [r for r in self._candidates() if r != source]
+            if not others:
+                return False
+            dest = self._pick(self._decode_candidates(others))
+        return self.migration.start(request_id, entry, str(dest))
+
+    def migrate_replica(self, source: str,
+                        dest: Optional[str] = None) -> int:
+        """Drain-free evacuation: migrate every eligible in-flight
+        request off ``source``.  Returns the number of migrations
+        started (requests that cannot migrate — grammar-constrained,
+        prefill-leg, unknown budget — stay put and finish in place,
+        exactly like a graceful drain)."""
+        source = str(source)
+        started = 0
+        for request_id, entry in list(self._inflight.items()):
+            if entry.get("replica") == source:
+                started += self.migrate_request(
+                    request_id, dest=dest)
+        return started
+
+    def _wire_migrate(self, source, dest=None, response_topic=None):
+        """Wire command ``(migrate source [dest] [reply_topic])`` —
+        the autoscaler's migrate action and operators use this to
+        evacuate a replica without a drain hole."""
+        started = self.migrate_replica(
+            str(source), dest=None if dest in (None, "", "-")
+            else str(dest))
+        if response_topic:
+            self.process.message.publish(
+                str(response_topic),
+                generate("migrate_response",
+                         [str(source),
+                          encode_swag({"started": started})]))
+
+    def _on_migrate_reply(self, _topic: str, payload: str):
+        """Migration side-channel: source ``migrate_ready`` acks and
+        the DESTINATION's resume stream (partials + terminal), all
+        keyed by migration id."""
+        try:
+            command, params = parse(payload)
+        except Exception:
+            return
+        if len(params) < 2:
+            return
+        mid = str(params[0])
+        if command == "migrate_ready":
+            self.migration.on_ready(mid, params[1])
+        elif command == "infer_partial":
+            self.migration.on_dest_partial(mid, params[1])
+        elif command == "infer_response":
+            self.migration.on_dest_final(mid, params[1])
+
     # -- re-dispatch -------------------------------------------------- #
 
     def _drain_replica(self, replica: str):
         """Re-dispatch every in-flight request the dead/unhealthy
-        replica holds."""
+        replica holds.  Migration-aware: a migration whose DESTINATION
+        died aborts (the source never stopped serving); one whose
+        SOURCE died mid-transfer promotes the destination instead of
+        replaying."""
+        self.migration.on_replica_down(replica)
         for request_id, entry in list(self._inflight.items()):
             if entry["replica"] == replica:
+                if entry.get("migration") is not None \
+                        and self.migration.on_owner_lost(
+                            request_id, entry, replica):
+                    continue     # destination promoted — no replay
                 self._schedule_redispatch(request_id, entry)
 
     def _schedule_redispatch(self, request_id: str, entry: Dict):
@@ -1053,6 +1160,11 @@ class ReplicaRouter(Actor):
         jitter (0.5–1.5×): failures are correlated — a thundering herd
         of instant retries onto the one survivor is how cascades
         start."""
+        if entry.get("migration") is not None:
+            # Replay supersedes any in-flight migration: the new
+            # replica regenerates everything, so the half-moved chain
+            # is worthless — tear it down (idempotent).
+            self.migration.abort(request_id, entry, "redispatch")
         entry["replica"] = None
         delay = min(self.backoff_cap_s,
                     self.backoff_base_s * (2 ** entry["attempts"]))
@@ -1165,6 +1277,10 @@ class ReplicaRouter(Actor):
                               encode_swag({"error":
                                            "cancel_unrouted"})]))
             return
+        if entry is not None and entry.get("migration") is not None:
+            # Both legs of a migrating request must die — the
+            # destination's resume runs under the migration id.
+            self.migration.cancel_dest(entry)
         self.process.message.publish(
             f"{target}/in",
             generate("infer_cancel", [request_id]))

@@ -60,6 +60,22 @@ point               effect at the wired site
                     use-after-free precursor.  Again invisible to
                     serving; the auditor's reachable-readers check
                     is what must trip.
+``drop_migration_block``  the SOURCE replica drops the last block out
+                    of the exported KV payload during a migration
+                    transfer — the destination's import comes up
+                    short and its resume recomputes the missing tail:
+                    the migration still completes bit-exact, just
+                    colder (zero lost tokens either way).
+``stall_cutover``   ...sleeps ``ms=`` milliseconds inside the cutover
+                    phase, between the destination resume dispatch
+                    and the source cancel — the double-delivery
+                    window the router's token-offset dedup must
+                    absorb without a duplicate.
+``kill_source_mid_migration``  the SOURCE replica kills its own
+                    Process while a migration it serves is in flight
+                    (same LWT path as ``kill_replica``) — the router
+                    must promote the destination if the cutover was
+                    dispatched, else fall back to plain redispatch.
 ==================  =====================================================
 
 Zero-cost when disabled: every site guards with ``if faults.PLAN is
@@ -99,7 +115,8 @@ FAULT_POINTS = ("kill_replica", "drop_message", "delay_message",
                 "stall_step", "expire_lease", "corrupt_response",
                 "fail_spawn", "slow_start", "corrupt_disk_block",
                 "disk_full", "slow_disk", "leak_block",
-                "skew_refcount")
+                "skew_refcount", "drop_migration_block",
+                "stall_cutover", "kill_source_mid_migration")
 
 
 @dataclasses.dataclass

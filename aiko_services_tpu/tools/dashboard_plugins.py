@@ -425,6 +425,18 @@ def replica_router_plugin(fields, variables) -> List[str]:
     unrouted = _get(variables, "cancel_unrouted", default=None)
     if unrouted not in (None, "-", 0):
         lines.append(f"  cancels:    {unrouted} unrouted")
+    # Live-migration pane (PR 19): drain-free mid-decode handoffs.
+    migrations = _get(variables, "migrations_started", default=None)
+    if migrations not in (None, "-", 0):
+        lines.append(
+            f"  migrate:    {migrations} started, "
+            f"{_get(variables, 'migrations_completed', default=0)}"
+            f" cut over / "
+            f"{_get(variables, 'migrations_aborted', default=0)}"
+            f" aborted, "
+            f"{_get(variables, 'migration_blocks_streamed', default=0)}"
+            f" blocks streamed, last cutover "
+            f"{_get(variables, 'migration_cutover_ms', default=0)} ms")
     directory = _get(variables, "kv_directory_size", default=None)
     if directory not in (None, "-"):
         lines.append(
@@ -518,6 +530,14 @@ def autoscaler_plugin(fields, variables) -> List[str]:
         f"{_get(variables, 'drain_completed', default=0)} completed, "
         f"{_get(variables, 'drain_timeouts', default=0)} timed out",
     ]
+    migrates = _get(variables, "migrates", default=None)
+    upgrades = _get(variables, "upgrades_started", default=None)
+    if any(value not in (None, "-", 0) for value in (migrates,
+                                                     upgrades)):
+        lines.append(
+            f"  migrate:    {migrates or 0} live-migrations asked, "
+            f"{_get(variables, 'upgrades_completed', default=0)}"
+            f"/{upgrades or 0} rolling upgrades done")
     quarantine = _get(variables, "quarantine", default="")
     if quarantine not in ("", "-", None):
         lines.append(f"  QUARANTINE: {quarantine} "

@@ -1973,6 +1973,7 @@ def run_elastic(duration_s: float = 10.0, seed: int = 0,
                 drain_timeout_s: float = 90.0,
                 fault_plan=None,
                 scale_script: Tuple[Tuple[float, int], ...] = (),
+                command_script: Tuple[Tuple[float, str], ...] = (),
                 converge_s: float = 0.0,
                 warmup: int = 0) -> LoadReport:
     """In-process ELASTIC serving rig: a :class:`FleetAutoscaler`
@@ -1988,7 +1989,9 @@ def run_elastic(duration_s: float = 10.0, seed: int = 0,
 
     ``scale_script`` is a sequence of ``(delay_s, target)`` operator
     ``(scale_target …)`` commands fired mid-run (the chaos gate's
-    scripted scale-down); ``fault_plan`` installs a
+    scripted scale-down); ``command_script`` fires arbitrary raw
+    operator s-exprs at the autoscaler (e.g. ``(rolling_upgrade)``
+    for the zero-downtime upgrade rig); ``fault_plan`` installs a
     :mod:`~..runtime.faults` plan for the run; ``converge_s`` waits
     after the load for the fleet to settle (live == target, nothing
     pending or draining) and records ``converged`` in
@@ -2109,13 +2112,16 @@ def run_elastic(duration_s: float = 10.0, seed: int = 0,
                                 drain_timeout_s=30.0)
         if fault_plan is not None:
             faults.install(fault_plan)
-        for delay_s, target in (scale_script if autoscaler is not None
-                                else ()):
+        commands = [(delay_s, f"(scale_target {target})")
+                    for delay_s, target in scale_script]
+        commands += [(delay_s, command)
+                     for delay_s, command in command_script]
+        for delay_s, command in (commands if autoscaler is not None
+                                 else ()):
             timer = threading.Timer(
                 delay_s,
-                lambda t=target: autoscaler.process.message.publish(
-                    f"{autoscaler.topic_path}/in",
-                    f"(scale_target {t})"))
+                lambda c=command: autoscaler.process.message.publish(
+                    f"{autoscaler.topic_path}/in", c))
             timer.daemon = True
             timer.start()
             timers.append(timer)
@@ -2161,6 +2167,13 @@ def run_elastic(duration_s: float = 10.0, seed: int = 0,
                 autoscaler.stats(),
                 router_shed=router.counters["shed"],
                 redispatches=router.counters["redispatches"],
+                migrations_started=router.counters[
+                    "migrations_started"],
+                migrations_completed=router.counters[
+                    "migrations_completed"],
+                migrations_aborted=router.counters[
+                    "migrations_aborted"],
+                migration_cutover_ms=list(router.migration.cutover_ms),
                 stream_mismatches=stream_mismatches,
                 faults_fired=(len(fault_plan.fired)
                               if fault_plan is not None else 0))
@@ -2221,6 +2234,219 @@ def run_elastic_chaos(seed: int = 0, duration_s: float = 8.0,
                        **kwargs)
 
 
+def migration_chaos_schedule(seed: int, phase: str = "none"):
+    """Seeded fault schedule for the live-migration chaos gate — one
+    fault class per ``phase`` so a run exercises exactly one migration
+    failure point (each phase is a separate loadgen invocation / test
+    parametrization):
+
+    * ``transfer``  — ``drop_migration_block``: the source drops the
+      last exported KV block; the destination resumes one block colder
+      and recomputes the tail (still bit-exact).
+    * ``cutover``   — ``stall_cutover``: wedge the router inside the
+      double-delivery window, forcing the token-offset dedup to earn
+      its keep.
+    * ``source``    — ``kill_source_mid_migration``: the source
+      replica dies while migrations are in flight; TRANSFER-phase
+      requests promote to the destination, earlier phases abort into
+      the normal re-dispatch path.
+    * ``none``      — no faults: the clean-migration control.
+    """
+    from ..runtime import faults
+    plan = faults.FaultPlan(seed=seed)
+    if phase == "transfer":
+        plan.add("drop_migration_block", nth=1)
+    elif phase == "cutover":
+        plan.add("stall_cutover", nth=1, ms=60)
+    elif phase == "source":
+        plan.add("kill_source_mid_migration", nth=2,
+                 match="replica_a")
+    elif phase != "none":
+        raise ValueError(f"unknown migration chaos phase: {phase}")
+    return plan
+
+
+def run_migration_chaos(seed: int = 0, n_requests: int = 10,
+                        rate_hz: float = 60.0,
+                        phase: str = "none",
+                        migrate_delay_s: float = 0.05,
+                        max_new_tokens: int = 48,
+                        drain_timeout_s: float = 90.0
+                        ) -> Tuple[LoadReport, LoadReport]:
+    """Drain-free live-migration chaos gate: an in-process 2-replica
+    rig streams requests while a mid-run ``(migrate replica_a)``
+    operator command evacuates replica_a's whole in-flight population
+    to replica_b mid-decode, under the :func:`migration_chaos_schedule`
+    fault ``phase``.  Returns ``(control, migrated)`` where control is
+    the identical seeded run WITHOUT the migration.
+
+    The invariants (asserted by tests/test_migration.py and the CLI):
+    zero lost, zero hung, zero duplicated finals, zero stream
+    mismatches (concatenated partials == final sequence, i.e. the
+    double-delivery window deduped exactly), and BIT-EXACT final
+    tokens against the unmigrated control for every request both runs
+    completed — migration must be invisible to the token stream."""
+
+    from ..orchestration.continuous import ContinuousReplica
+    from ..orchestration.paged import PagedContinuousServer
+    from ..orchestration.serving import ReplicaRouter
+    from ..registry import Registrar
+    from ..runtime import (Process, actor_args, compose_instance,
+                           faults)
+    from ..runtime.event import EventEngine
+
+    import threading
+
+    def wait_for(predicate, timeout_s: float, what: str):
+        deadline = time.time() + timeout_s
+        while not predicate():
+            if time.time() > deadline:
+                raise TimeoutError(f"migration rig: {what}")
+            time.sleep(0.02)
+
+    def one_run(migrate: bool) -> LoadReport:
+        plan = None
+        engine = EventEngine()
+        thread = engine.run_in_thread()
+        broker = f"migrate-{uuid.uuid4().hex[:6]}"
+        processes = []
+
+        def make_process(pid):
+            process = Process(namespace="migrate", hostname="h",
+                              pid=str(pid), engine=engine,
+                              broker=broker)
+            processes.append(process)
+            return process
+
+        generator = None
+        timer = None
+        try:
+            registrar = Registrar(process=make_process(1))
+            wait_for(lambda: registrar.state == "primary", 10,
+                     "registrar primary")
+            replicas = {}
+            for index, name in enumerate(("replica_a", "replica_b")):
+                # Same config+seed: greedy decode is replica-
+                # independent, so a migrated request's destination
+                # continues the exact sequence the source started.
+                server = PagedContinuousServer(
+                    config_name="tiny", slots=4, chunk_steps=2,
+                    seed=0, enable_prefix_cache=True, max_queue=256,
+                    watchdog_s=5.0)
+                replicas[name] = compose_instance(
+                    ContinuousReplica, actor_args(name),
+                    process=make_process(2 + index), server=server,
+                    kv_fetch_timeout_s=2.0)
+            router = compose_instance(
+                ReplicaRouter, actor_args("router"),
+                process=make_process(8), kv_transfer=True)
+            wait_for(lambda: router.share["replicas"] == 2, 30,
+                     "router discovery")
+            generator = LoadGenerator(
+                make_process(9), f"{router.topic_path}/in",
+                payload_fn=_elastic_payloads(
+                    seed=seed, prompt_len=18,
+                    max_new_tokens=max_new_tokens, stream=True),
+                rate_hz=rate_hz)
+            # Warm the decode programs first (both arms identically):
+            # the measured wave then runs at steady speed, so the
+            # migration trigger really lands mid-decode instead of
+            # after a compile-stretched drain.
+            generator.run(2, drain_timeout_s=drain_timeout_s)
+            if migrate:
+                plan = faults.install(migration_chaos_schedule(
+                    seed, phase))
+                source_topic = replicas["replica_a"].topic_path
+
+                def fire_when_mid_decode():
+                    # Deterministic trigger: wait until the source
+                    # owns a request that has already streamed at
+                    # least one token, then evacuate the source.
+                    deadline = time.time() + migrate_delay_s + 30.0
+                    time.sleep(migrate_delay_s)
+                    while time.time() < deadline:
+                        inflight = list(router._inflight.values())
+                        if any(entry.get("replica") == source_topic
+                               and entry.get("delivered", 0) > 0
+                               for entry in inflight):
+                            router.process.message.publish(
+                                f"{router.topic_path}/in",
+                                f"(migrate {source_topic})")
+                            return
+                        time.sleep(0.002)
+
+                timer = threading.Thread(target=fire_when_mid_decode,
+                                         daemon=True)
+                timer.start()
+            report = generator.run(n_requests,
+                                   drain_timeout_s=drain_timeout_s)
+            report.final_tokens = dict(generator.final_tokens)
+            stream_mismatches = sum(
+                1 for request_id, partials
+                in generator.partial_tokens.items()
+                if request_id in generator.final_tokens
+                and partials != generator.final_tokens[request_id])
+            report.server_stats = dict(
+                router.counters,
+                stream_mismatches=stream_mismatches,
+                migration_cutover_ms=list(
+                    router.migration.cutover_ms),
+                faults_fired=(len(plan.fired) if plan else 0),
+                replicas_live=router.share["replicas"])
+            return report
+        finally:
+            faults.uninstall()
+            if generator is not None:
+                generator.close()
+            for process in reversed(processes):
+                try:
+                    process.terminate()
+                except Exception:  # noqa: BLE001 - the kill phase may
+                    pass           # have taken this process already
+            engine.terminate()
+            thread.join(timeout=5)
+
+    control = one_run(migrate=False)
+    migrated = one_run(migrate=True)
+    return control, migrated
+
+
+def run_rolling_upgrade(duration_s: float = 10.0, seed: int = 0,
+                        replicas: int = 4,
+                        drain_based: bool = False,
+                        **kwargs) -> LoadReport:
+    """Zero-downtime rolling upgrade goodput trace: a ``replicas``-
+    strong autoscaled fleet under streaming diurnal load receives a
+    mid-run ``(rolling_upgrade)`` — every replica is replaced one at a
+    time with its in-flight population LIVE-MIGRATED onto the
+    successor.  ``drain_based=True`` is the A/B control: the same
+    replacement loop but each predecessor drains its tail instead of
+    migrating it (``policy.migrate_drains`` off).  The bench section
+    compares goodput and total upgrade wall-time between the two."""
+    from ..orchestration.autoscaler import AutoscalerPolicy
+
+    policy = AutoscalerPolicy(
+        target=replicas, min_replicas=1, max_replicas=replicas + 2,
+        breach_windows=10 ** 6, clear_windows=10 ** 6,
+        cooldown_s=3600.0, spawn_timeout_s=60.0,
+        drain_timeout_s=20.0,
+        migrate_drains=not drain_based)
+    kwargs.setdefault("command_script",
+                      ((max(0.6, duration_s * 0.15),
+                        "(rolling_upgrade)"),))
+    kwargs.setdefault("converge_s", 60.0)
+    kwargs.setdefault("stream", True)
+    kwargs.setdefault("warmup", 6)
+    # Dense enough that every replica holds live streams at any
+    # instant: each handoff then really carries an in-flight
+    # population instead of landing in a gap between requests.
+    kwargs.setdefault("base_hz", 8.0)
+    kwargs.setdefault("peak_hz", 12.0)
+    kwargs.setdefault("max_new_tokens", 48)
+    return run_elastic(duration_s=duration_s, seed=seed,
+                       policy=policy, **kwargs)
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     """``python -m aiko_services_tpu.tools.loadgen --chaos`` (seeded
     fault schedule; exit 1 if any request was lost or hung) or
@@ -2241,6 +2467,23 @@ def main(argv: Optional[List[str]] = None) -> int:
                              "(drain + kill-during-drain + failed "
                              "replacement spawn; exit 1 unless zero "
                              "lost/duplicated and converged)")
+    parser.add_argument("--migrate-mid-stream", action="store_true",
+                        help="live-migration chaos gate: evacuate one "
+                             "replica's in-flight population to the "
+                             "other mid-decode under a seeded fault "
+                             "phase; exit 1 unless zero lost/"
+                             "duplicated/mismatched and bit-exact vs "
+                             "the unmigrated control")
+    parser.add_argument("--migration-phase", default="none",
+                        choices=["none", "transfer", "cutover",
+                                 "source"],
+                        help="--migrate-mid-stream: which migration "
+                             "phase the seeded fault hits")
+    parser.add_argument("--rolling-upgrade", action="store_true",
+                        help="zero-downtime rolling upgrade trace: "
+                             "replace every replica one at a time "
+                             "with live migration, vs the drain-based "
+                             "control")
     parser.add_argument("--workload",
                         choices=["shared_prefix", "diurnal",
                                  "longtail", "structured"],
@@ -2319,6 +2562,68 @@ def main(argv: Optional[List[str]] = None) -> int:
                              "(composes with --chaos: both sides run "
                              "the fault schedule)")
     args = parser.parse_args(argv)
+    if args.migrate_mid_stream:
+        control, migrated = run_migration_chaos(
+            seed=args.seed,
+            n_requests=args.requests if args.requests != 40 else 10,
+            rate_hz=args.rate_hz, phase=args.migration_phase)
+        print("control: ", control)
+        print("migrated:", migrated)
+        stats = migrated.server_stats
+        print(f"router counters: {stats}")
+        both = set(control.final_tokens) & set(migrated.final_tokens)
+        mismatched = [request_id for request_id in both
+                      if control.final_tokens[request_id]
+                      != migrated.final_tokens[request_id]]
+        ok = (not migrated.lost and not migrated.timeouts
+              and not migrated.duplicate_finals
+              and not stats.get("stream_mismatches")
+              and stats.get("migrations_started", 0) > 0
+              and not mismatched and both)
+        if not ok:
+            print(f"MIGRATION CHAOS FAIL (seed={args.seed}, "
+                  f"phase={args.migration_phase}): {migrated.lost} "
+                  f"lost, {migrated.timeouts} hung, "
+                  f"{migrated.duplicate_finals} duplicated, "
+                  f"{stats.get('stream_mismatches')} stream "
+                  f"mismatches, {len(mismatched)} diverged vs "
+                  f"control")
+            return 1
+        cutovers = stats.get("migration_cutover_ms", [])
+        print(f"MIGRATION CHAOS OK (seed={args.seed}, "
+              f"phase={args.migration_phase}): "
+              f"{stats.get('migrations_completed')} migrated / "
+              f"{stats.get('migrations_aborted')} aborted, "
+              f"{len(cutovers)} cutovers, bit-exact vs control")
+        return 0
+    if args.rolling_upgrade:
+        migrated = run_rolling_upgrade(duration_s=args.duration,
+                                       seed=args.seed)
+        drained = run_rolling_upgrade(duration_s=args.duration,
+                                      seed=args.seed,
+                                      drain_based=True)
+        for label, report in (("live-migrated", migrated),
+                              ("drain-based ", drained)):
+            stats = report.server_stats
+            print(f"{label}: goodput={report.goodput_rps:.2f} req/s, "
+                  f"upgrades={stats.get('upgrades_completed')}, "
+                  f"migrations={stats.get('migrations_completed')}, "
+                  f"lost={report.lost}")
+        stats = migrated.server_stats
+        ok = (not migrated.lost and not migrated.timeouts
+              and not migrated.duplicate_finals
+              and not stats.get("stream_mismatches")
+              and stats.get("upgrades_completed", 0) > 0
+              and stats.get("converged"))
+        if not ok:
+            print(f"ROLLING UPGRADE FAIL (seed={args.seed}): "
+                  f"{migrated.lost} lost, {migrated.timeouts} hung, "
+                  f"{migrated.duplicate_finals} duplicated, "
+                  f"converged={stats.get('converged')}")
+            return 1
+        print(f"ROLLING UPGRADE OK (seed={args.seed}): fleet "
+              f"replaced with zero lost/duplicated tokens")
+        return 0
     if args.workload == "structured":
         cons, free = run_structured(
             n_requests=args.requests, rate_hz=args.rate_hz,

@@ -839,6 +839,77 @@ def bench_serving_autoscale(duration_s=16.0, base_hz=1.0, peak_hz=8.0,
                 autoscaled.server_stats.get("drains", 0)}
 
 
+def bench_serving_migration(trials=3, n_requests=6, rate_hz=60.0,
+                            upgrade_duration_s=10.0,
+                            upgrade_replicas=2):
+    """Drain-free live migration: (1) exact-cutover latency — the
+    router-side window between dispatching the resume and the
+    destination's first verified token, p50/p95 over ``trials``
+    mid-decode evacuations (each rig also asserts the invariant-20
+    bundle: zero lost/duplicated/mismatched, bit-exact vs the
+    unmigrated control); (2) the rolling-upgrade A/B — replace the
+    whole fleet mid-trace with live migration vs the drain-based
+    replacement loop, comparing goodput through the upgrade window.
+    Tiny config, CPU-capable like serving_faults."""
+    from aiko_services_tpu.tools.loadgen import (
+        run_migration_chaos, run_rolling_upgrade,
+    )
+
+    cutovers = []
+    for trial in range(trials):
+        control, migrated = run_migration_chaos(
+            seed=trial, n_requests=n_requests, rate_hz=rate_hz,
+            phase="none")
+        stats = migrated.server_stats
+        assert migrated.lost == 0 and migrated.timeouts == 0, migrated
+        assert migrated.duplicate_finals == 0, stats
+        assert stats["stream_mismatches"] == 0, stats
+        assert stats["migrations_completed"] >= 1, stats
+        for request_id in (set(control.final_tokens)
+                           & set(migrated.final_tokens)):
+            assert control.final_tokens[request_id] \
+                == migrated.final_tokens[request_id], request_id
+        cutovers.extend(stats["migration_cutover_ms"])
+
+    ordered = sorted(cutovers) or [0.0]
+
+    def quantile(fraction):
+        return ordered[min(len(ordered) - 1,
+                           int(fraction * len(ordered)))]
+
+    migrated_up = run_rolling_upgrade(duration_s=upgrade_duration_s,
+                                      replicas=upgrade_replicas)
+    drained_up = run_rolling_upgrade(duration_s=upgrade_duration_s,
+                                     replicas=upgrade_replicas,
+                                     drain_based=True)
+    for label, report in (("live", migrated_up),
+                          ("drain", drained_up)):
+        assert report.lost == 0 and report.timeouts == 0, \
+            (label, report)
+        assert report.duplicate_finals == 0, (label, report)
+        assert report.server_stats.get("upgrades_completed", 0) \
+            >= upgrade_replicas, (label, report.server_stats)
+
+    log(f"serving[migration] cutover over {len(cutovers)} "
+        f"migrations: p50 {quantile(0.5):.0f} ms, "
+        f"p95 {quantile(0.95):.0f} ms; rolling upgrade "
+        f"goodput live {migrated_up.goodput_rps:.2f} vs drain "
+        f"{drained_up.goodput_rps:.2f} req/s "
+        f"({migrated_up.server_stats.get('migrations_completed', 0)} "
+        f"live migrations)")
+    return {"serving_migration_cutover_p50_ms":
+                round(quantile(0.5), 1),
+            "serving_migration_cutover_p95_ms":
+                round(quantile(0.95), 1),
+            "serving_migration_count": len(cutovers),
+            "serving_migration_rolling_goodput_rps":
+                round(migrated_up.goodput_rps, 2),
+            "serving_migration_rolling_drain_goodput_rps":
+                round(drained_up.goodput_rps, 2),
+            "serving_migration_rolling_upgrades":
+                migrated_up.server_stats.get("upgrades_completed", 0)}
+
+
 def bench_serving_8b(paged=False, slots=16, prompt_len=128,
                      max_new=128, n_requests=32, chunk_steps=8,
                      lookahead=4, config_name="llama3_8b",
@@ -3122,6 +3193,13 @@ SECTIONS = [
      (lambda: bench_serving_autoscale(duration_s=8.0, peak_hz=5.0,
                                       warmup=2))
      if SMOKE else bench_serving_autoscale),
+    # Drain-free live migration: exact-cutover latency percentiles +
+    # the rolling-upgrade goodput A/B vs the drain-based replacement
+    # loop (tiny model, CPU-capable like serving_faults).
+    ("serving_migration", 700,
+     (lambda: bench_serving_migration(trials=1, n_requests=4,
+                                      upgrade_duration_s=8.0))
+     if SMOKE else bench_serving_migration),
     ("serving_paged", 420,
      (lambda: bench_serving_paged(
          slots=2, prompt_len=24, max_new=8, n_requests=4,

@@ -102,4 +102,118 @@ assert ledger.steady_compiles == 0,     f"{ledger.steady_compiles} steady-state 
 print("mesh2d smoke: parity OK, zero steady compiles")
 EOF2
 
+echo "== migration smoke: in-process live migrate + zero steady compiles post-cutover =="
+# Drain-free live migration on every push: two mid-decode migrations
+# through a 2-replica rig.  The first warms the whole migration path
+# (prepare, KV export/import, resume admission, post-cutover decode);
+# after the fence, the second must cut over EXACTLY (concatenated
+# partials == final, no lost/duplicated tokens) while compiling
+# NOTHING — the destination's first post-cutover step rides the
+# warmed ladder.
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python - <<'EOF3'
+import time
+import uuid
+
+import numpy as np
+
+from aiko_services_tpu.obs import compiles
+from aiko_services_tpu.orchestration.client import InferClient
+from aiko_services_tpu.orchestration.continuous import ContinuousReplica
+from aiko_services_tpu.orchestration.paged import PagedContinuousServer
+from aiko_services_tpu.orchestration.serving import ReplicaRouter
+from aiko_services_tpu.registry import Registrar
+from aiko_services_tpu.runtime import (
+    Process, actor_args, compose_instance,
+)
+from aiko_services_tpu.runtime.event import EventEngine
+
+
+def wait(predicate, timeout_s, what):
+    deadline = time.time() + timeout_s
+    while not predicate():
+        if time.time() > deadline:
+            raise TimeoutError(what)
+        time.sleep(0.01)
+
+
+ledger = compiles.install(service="ci-migration")
+engine = EventEngine()
+thread = engine.run_in_thread()
+broker = f"ci-mig-{uuid.uuid4().hex[:6]}"
+processes = []
+
+
+def make_process(pid):
+    process = Process(namespace="cimig", hostname="h", pid=str(pid),
+                      engine=engine, broker=broker)
+    processes.append(process)
+    return process
+
+
+try:
+    registrar = Registrar(process=make_process(1))
+    wait(lambda: registrar.state == "primary", 10, "registrar")
+    replicas = [
+        compose_instance(
+            ContinuousReplica, actor_args(f"replica_{i}"),
+            process=make_process(2 + i),
+            server=PagedContinuousServer(
+                config_name="tiny", slots=4, chunk_steps=2, seed=0,
+                enable_prefix_cache=True, max_queue=64),
+            kv_fetch_timeout_s=2.0)
+        for i in range(2)]
+    router = compose_instance(ReplicaRouter, actor_args("router"),
+                              process=make_process(8),
+                              kv_transfer=True)
+    wait(lambda: router.share["replicas"] == 2, 30, "discovery")
+    client = InferClient(make_process(9), f"{router.topic_path}/in")
+    rng = np.random.default_rng(3)
+
+    def migrated_request(tag):
+        prompt = rng.integers(1, 1024, 18).astype(np.int32)
+        future = client.submit(prompt, max_new_tokens=32, stream=True)
+        wait(lambda: len(future.partial_tokens) >= 3 or future.done,
+             120, f"{tag}: first tokens")
+        assert not future.done, f"{tag}: finished before migrate"
+        source = router._inflight[future.request_id]["replica"]
+        dest = next(r.topic_path for r in replicas
+                    if r.topic_path != source)
+        router.process.message.publish(f"{router.topic_path}/in",
+                                       f"(migrate {source} {dest})")
+        client.wait(future, timeout=120.0)
+        assert future.error is None, (tag, future.error)
+        assert future.partial_tokens == future.tokens, tag
+        return future
+
+    # Warm both replicas' programs AND the whole migration path
+    # (export, wire, import, resume admission, post-cutover decode).
+    for replica in replicas:
+        assert replica.server.warm_prefill_ladder() > 0
+        warm_client = InferClient(replica.process, replica.topic_in)
+        warm = warm_client.submit(
+            rng.integers(1, 1024, 18).astype(np.int32),
+            max_new_tokens=12)
+        warm_client.wait(warm, timeout=120.0)
+        assert warm.error is None, warm.error
+    migrated_request("warmup-migration")
+    assert router.counters["migrations_completed"] == 1, \
+        dict(router.counters)
+
+    ledger.fence()
+    migrated_request("steady-migration")
+    assert router.counters["migrations_completed"] == 2, \
+        dict(router.counters)
+    assert ledger.steady_compiles == 0, \
+        f"{ledger.steady_compiles} steady-state compiles after cutover"
+    print("migration smoke: 2 exact cutovers, zero steady compiles")
+finally:
+    for process in reversed(processes):
+        try:
+            process.terminate()
+        except Exception:  # noqa: BLE001
+            pass
+    engine.terminate()
+    thread.join(timeout=5)
+EOF3
+
 echo "ci_checks: OK"

@@ -101,6 +101,7 @@ def test_env_bootstrap_installs_plan():
 _INJECTION_MODULES = (
     PKG / "orchestration" / "autoscaler.py",
     PKG / "orchestration" / "continuous.py",
+    PKG / "orchestration" / "migration.py",
     PKG / "runtime" / "process.py",
     PKG / "runtime" / "lease.py",
     PKG / "kvstore" / "spill.py",

@@ -77,12 +77,12 @@ def test_shareable_blocks_excludes_admission_seed_block():
 
 def test_digest_roundtrip_and_malformed():
     # 4-field entries stay valid wire (pre-tier replicas); decode
-    # always returns 6-tuples with tier/adopted 0 appended.
+    # always returns 7-tuples with tier/adopted/migrating 0 appended.
     entries = [("ab12cd34ef567890", 3, 1, 7),
                ("ffee001122334455", 2, 0, 1)]
     text = digest_encode(16, "decode", entries)
     assert digest_decode(text) == (
-        16, "decode", [entry + (0, 0) for entry in entries])
+        16, "decode", [entry + (0, 0, 0) for entry in entries])
     # Host-tier entries carry a 5th field; tier 0 encodes 4-field
     # (the wire only grows where the tier is actually in play).
     tiered = [("ab12cd34ef567890", 3, 1, 7, 0),
@@ -91,8 +91,8 @@ def test_digest_roundtrip_and_malformed():
     assert "ab12cd34ef567890/3/1/7," in text     # tier 0 stays 4-field
     assert text.endswith("/2/0/1/1")             # tier 1 appends
     assert digest_decode(text) == (
-        16, "decode", [("ab12cd34ef567890", 3, 1, 7, 0, 0),
-                       ("ffee001122334455", 2, 0, 1, 1, 0)])
+        16, "decode", [("ab12cd34ef567890", 3, 1, 7, 0, 0, 0),
+                       ("ffee001122334455", 2, 0, 1, 1, 0, 0)])
     # Spilled entries carry the adopted 6th field; a zero flag keeps
     # the 5-field tier wire (same back-compat move tier made).
     spilled = [("ab12cd34ef567890", 3, 1, 7, 2, 0),
@@ -100,14 +100,77 @@ def test_digest_roundtrip_and_malformed():
     text = digest_encode(16, "decode", spilled)
     assert "ab12cd34ef567890/3/1/7/2," in text   # adopted 0: 5-field
     assert text.endswith("/2/0/1/2/1")           # adopted 1 appends
-    assert digest_decode(text) == (16, "decode", spilled)
+    assert digest_decode(text) == (
+        16, "decode", [entry + (0,) for entry in spilled])
     # S-expression safe: survives the EC-share broadcast wire.
     command, params = parse(generate("update", ["kv_prefixes", text]))
     assert (command, params[1]) == ("update", text)
     for bad in ("", "16;decode", "x;decode;a/1/2/3",
                 "16;decode;nodepth", None, "16;d;a/b/c/d",
-                "16;decode;ab/1/2/3/4/5/6/7"):
+                "16;decode;ab/1/2/3/4/5/6/7/8"):
         assert digest_decode(bad) is None
+
+
+def test_digest_migrating_flag_back_compat_matrix():
+    """The 7th (``migrating``) field composes with every older wire
+    format: a zero flag leaves the 4/5/6-field encodings byte-for-
+    byte unchanged (pre-migration routers parse them untouched), a
+    set flag forces the full positional 7-field entry, and the
+    publisher-level ``migrating=1`` kwarg ORs into every entry."""
+    four = ("ab12cd34ef567890", 3, 1, 7)
+    five = ("ffee001122334455", 2, 0, 1, 1)
+    six = ("0123456789abcdef", 1, 0, 2, 2, 1)
+    # Zero flag: encodings identical to the pre-migration wire.
+    assert digest_encode(16, "decode", [four + (0, 0, 0)]) \
+        == digest_encode(16, "decode", [four])
+    assert digest_encode(16, "decode", [five + (0, 0)]) \
+        == digest_encode(16, "decode", [five])
+    assert digest_encode(16, "decode", [six + (0,)]) \
+        == digest_encode(16, "decode", [six])
+    # Set flag: the full 7-field entry, zeros written positionally.
+    text = digest_encode(16, "decode", [four + (0, 0, 1)])
+    assert text.endswith("/3/1/7/0/0/1")
+    assert digest_decode(text) == (16, "decode", [four + (0, 0, 1)])
+    # Publisher-level flag ORs into every entry, whatever its arity.
+    text = digest_encode(16, "decode", [four, five, six], migrating=1)
+    _, _, decoded = digest_decode(text)
+    assert [entry[6] for entry in decoded] == [1, 1, 1]
+    assert decoded[1][:5] == five                # payload untouched
+    # Decode matrix: every arity 4..7 parses to the padded 7-tuple.
+    for arity, wire in ((4, "aa" * 8 + "/3/1/7"),
+                        (5, "aa" * 8 + "/3/1/7/1"),
+                        (6, "aa" * 8 + "/3/1/7/1/1"),
+                        (7, "aa" * 8 + "/3/1/7/1/1/1")):
+        decoded = digest_decode(f"16;decode;{wire}")
+        assert decoded is not None, arity
+        entry = decoded[2][0]
+        assert len(entry) == 7
+        assert entry[:4] == ("aa" * 8, 3, 1, 7)
+
+
+def test_directory_migrating_flag_tracks_advertisements():
+    """``PrefixDirectory.migrating`` follows the replica's LAST
+    advertisement (set -> cleared across updates) and eviction."""
+    directory = PrefixDirectory(lease_s=30.0)
+    entries = [("aa" * 8, 1, 0, 3)]
+    directory.update("ra", digest_encode(16, "decode", entries),
+                     now=0.0)
+    assert not directory.migrating("ra")
+    directory.update(
+        "ra", digest_encode(16, "decode", entries, migrating=1),
+        now=1.0)
+    assert directory.migrating("ra")
+    # The blocks stay matchable while migrating (the source must
+    # remain exportable mid-flight).
+    assert directory.matched_blocks("ra", ["aa" * 8], now=2.0) == 1
+    directory.update("ra", digest_encode(16, "decode", entries),
+                     now=3.0)
+    assert not directory.migrating("ra")         # flag clears
+    directory.update(
+        "ra", digest_encode(16, "decode", entries, migrating=1),
+        now=4.0)
+    directory.evict_replica("ra")
+    assert not directory.migrating("ra")         # unknown -> False
 
 
 def test_directory_lease_matching_and_eviction():

@@ -1,0 +1,365 @@
+"""Drain-free live migration (ARCHITECTURE invariant 20).
+
+Three layers, mirroring the autoscaler tests:
+
+* :func:`~aiko_services_tpu.orchestration.autoscaler.decide` is pure —
+  the unit tests replay snapshots and pin the exact ``migrate`` /
+  reshard-spawn action sequences (drain-free scale-in, the in-place
+  TP-resharding convergence loop).
+* The in-process migration gate runs
+  :func:`~aiko_services_tpu.tools.loadgen.run_migration_chaos`: a
+  mid-decode ``(migrate replica_a)`` evacuates a live streaming
+  population to the other replica with ZERO lost / duplicated /
+  mismatched tokens and BIT-EXACT finals vs the unmigrated control.
+* The slow gates (``slow_tests.txt``) add the seeded fault phases
+  (dropped transfer block, stalled cutover, source killed mid-
+  migration), cross-TP-degree mid-decode migration (TP=2 -> TP=4 and
+  TP=4 -> single chip, int8 KV + chunked prefill + prefix cache
+  composed), and the zero-downtime rolling-upgrade rig.
+"""
+
+import time
+import uuid
+
+import numpy as np
+import pytest
+
+from aiko_services_tpu.orchestration.autoscaler import (
+    Action, AutoscalerPolicy, FleetSnapshot, ReplicaView, decide,
+)
+
+
+def _policy(**overrides) -> AutoscalerPolicy:
+    """SLO scaling frozen (huge windows): only ledger reconciliation
+    moves the fleet, so action sequences are exact."""
+    defaults = dict(target=1, min_replicas=1, max_replicas=16,
+                    backoff_base_s=1.0, backoff_cap_s=8.0,
+                    cooldown_s=10.0,
+                    breach_windows=10 ** 6, clear_windows=10 ** 6)
+    defaults.update(overrides)
+    return AutoscalerPolicy(**defaults)
+
+
+def _live(slot, **kw) -> ReplicaView:
+    return ReplicaView(slot=slot, **kw)
+
+
+# ---------------------------------------------------------------- #
+# decide(): the migrate action
+# ---------------------------------------------------------------- #
+
+def test_surplus_emits_migrate_when_enabled():
+    """``migrate_drains=True`` turns the scale-in drain into a
+    drain-free migrate of the same victim (idlest live replica)."""
+    snapshot = FleetSnapshot(now=0.0, replicas=(
+        _live("decode1", queue_depth=3),
+        _live("decode2", queue_depth=0)))
+    actions, _ = decide(snapshot, _policy(migrate_drains=True))
+    assert actions == [Action("migrate", "decode2", role="decode",
+                              reason="scale_in")]
+
+
+def test_surplus_still_drains_by_default():
+    """Without the opt-in the surplus path is byte-for-byte the old
+    drain behavior."""
+    snapshot = FleetSnapshot(now=0.0, replicas=(
+        _live("decode1"), _live("decode2")))
+    actions, _ = decide(snapshot, _policy())
+    assert [a.kind for a in actions] == ["drain"]
+
+
+def test_migrate_action_carries_destination():
+    action = Action("migrate", "decode1", dest="decode2")
+    assert "->decode2" in action.describe()
+
+
+def test_reshard_converges_tp2_fleet_to_tp4():
+    """In-place TP resharding replay: a 4x TP=2 fleet (8 chips, at
+    target) under ``decode_tp=4, reshard_tp=True`` converges to
+    2x TP=4 through alternating reshard-spawn / migrate-evict ticks,
+    never dropping below the chip target, and goes quiet once the
+    fleet is homogeneous at the new degree."""
+    from aiko_services_tpu.orchestration.autoscaler import DeathEvent
+    policy = _policy(target=8, decode_tp=4, reshard_tp=True,
+                     migrate_drains=True, max_replicas=16)
+    fleet = {f"decode{i}": 2 for i in range(1, 5)}   # slot -> degree
+    state = None
+    transcript = []
+    exits = []
+    for tick in range(1, 13):
+        views = tuple(_live(slot, tp_degree=degree)
+                      for slot, degree in sorted(fleet.items()))
+        actions, state = decide(
+            FleetSnapshot(now=float(tick), replicas=views,
+                          deaths=tuple(exits)),
+            policy, state)
+        exits = []
+        transcript.extend((a.kind, a.slot) for a in actions)
+        for action in actions:
+            assert action.kind in ("spawn", "migrate"), action
+            if action.kind == "spawn":
+                # The replacement announces at the policy degree
+                # before the next tick.
+                assert action.tp_degree == 4
+                assert action.reason.startswith("reshard:")
+                fleet[action.slot] = 4
+            else:
+                # Executor live-migrates then retires: by the next
+                # tick the victim has exited cleanly (expected death,
+                # as the real drain-completion path reports).
+                assert action.reason == "scale_in"
+                assert fleet[action.slot] == 2, \
+                    "resharding must evict OLD-degree replicas"
+                fleet.pop(action.slot)
+                exits.append(DeathEvent(action.slot, ts=float(tick),
+                                        expected=True))
+        if not actions and all(d == 4 for d in fleet.values()):
+            break
+    assert sorted(fleet.values()) == [4, 4], (fleet, transcript)
+    assert sum(fleet.values()) == 8                    # chip target
+    spawns = [slot for kind, slot in transcript if kind == "spawn"]
+    migrates = [slot for kind, slot in transcript if kind == "migrate"]
+    assert len(spawns) == 2                            # 2 new TP=4
+    assert sorted(migrates) == [f"decode{i}" for i in range(1, 5)]
+    # Quiescence: one more tick at the converged fleet does nothing.
+    views = tuple(_live(slot, tp_degree=4) for slot in sorted(fleet))
+    actions, _ = decide(FleetSnapshot(now=99.0, replicas=views),
+                        policy, state)
+    assert actions == []
+
+
+def test_reshard_waits_for_pending_spawns():
+    """Only one resharding replacement in flight: while the spawn is
+    pending the reshard branch stays quiet (no avalanche of
+    overshooting spawns) — though the ledger already counts the
+    pending capacity, so the surplus branch may start evicting
+    old-degree replicas (drain-free, so no goodput hole either
+    way)."""
+    from aiko_services_tpu.orchestration.autoscaler import PendingView
+    policy = _policy(target=4, decode_tp=4, reshard_tp=True,
+                     migrate_drains=True)
+    views = (_live("decode1", tp_degree=2),
+             _live("decode2", tp_degree=2))
+    actions, state = decide(
+        FleetSnapshot(now=0.0, replicas=views), policy)
+    assert [a.kind for a in actions] == ["spawn"]
+    pending = (PendingView(slot=actions[0].slot, due=30.0),)
+    actions, state = decide(
+        FleetSnapshot(now=1.0, replicas=views, pending=pending),
+        policy, state)
+    assert [a.kind for a in actions] == ["migrate"]
+    assert actions[0].slot in ("decode1", "decode2")
+
+
+# ---------------------------------------------------------------- #
+# The in-process migration gate (clean phase: tier-1)
+# ---------------------------------------------------------------- #
+
+def _assert_migration_invariants(control, migrated,
+                                 require_completed: bool = True):
+    """The invariant-20 bundle every migration run must satisfy."""
+    stats = migrated.server_stats
+    assert migrated.lost == 0, (migrated, stats)
+    assert migrated.timeouts == 0, (migrated, stats)
+    assert migrated.duplicate_finals == 0, stats
+    assert stats["stream_mismatches"] == 0, stats
+    assert stats["migrations_started"] >= 1, stats
+    if require_completed:
+        assert stats["migrations_completed"] >= 1, stats
+        assert stats["migration_cutover_ms"], stats
+    # Bit-exact greedy finals vs the unmigrated control at the same
+    # seed — migration is invisible to the token stream.
+    both = set(control.final_tokens) & set(migrated.final_tokens)
+    assert both, (control.final_tokens, migrated.final_tokens)
+    for request_id in both:
+        assert control.final_tokens[request_id] \
+            == migrated.final_tokens[request_id], request_id
+
+
+def test_live_migration_clean_bit_exact():
+    """Mid-decode evacuation with no faults: the migrated run matches
+    the unmigrated control token for token, with zero lost /
+    duplicated / mismatched streams and at least one exact cutover."""
+    from aiko_services_tpu.tools.loadgen import run_migration_chaos
+
+    control, migrated = run_migration_chaos(
+        seed=0, n_requests=5, rate_hz=60.0, phase="none",
+        max_new_tokens=32)
+    _assert_migration_invariants(control, migrated)
+
+
+@pytest.mark.parametrize("phase", ["transfer", "cutover", "source"])
+def test_live_migration_chaos_phases(phase):
+    """Chaos kill/stall/drop at each migration phase: dropped KV
+    block on the wire (destination recomputes the tail), stalled
+    cutover (the double-delivery window earns its dedup), source
+    killed mid-migration (TRANSFER promotes the destination, earlier
+    phases abort into re-dispatch).  The invariant bundle holds in
+    every phase; faults that abort the migration may leave
+    ``migrations_completed`` at zero, but tokens stay exact."""
+    from aiko_services_tpu.tools.loadgen import run_migration_chaos
+
+    control, migrated = run_migration_chaos(
+        seed=0, n_requests=6, rate_hz=60.0, phase=phase)
+    _assert_migration_invariants(control, migrated,
+                                 require_completed=False)
+    assert migrated.server_stats["faults_fired"] >= 1, \
+        migrated.server_stats
+
+
+# ---------------------------------------------------------------- #
+# Cross-degree mid-decode migration (TP=2 -> TP=4, TP=4 -> 1 chip)
+# ---------------------------------------------------------------- #
+
+def _tp_server(tp):
+    from aiko_services_tpu.orchestration.paged import (
+        PagedContinuousServer,
+    )
+    from aiko_services_tpu.parallel.mesh import ReplicaMesh
+    kw = dict(config_name="tiny_tp", slots=2, max_seq=128,
+              chunk_steps=3, seed=5, block_size=16,
+              enable_prefix_cache=True, chunk_prefill_tokens=32,
+              quantize=True, quantize_kv=True)
+    if tp:
+        kw["replica_mesh"] = ReplicaMesh(tp=tp)
+    return PagedContinuousServer(**kw)
+
+
+def _wait(predicate, timeout_s: float, what: str):
+    deadline = time.time() + timeout_s
+    while not predicate():
+        if time.time() > deadline:
+            raise TimeoutError(what)
+        time.sleep(0.02)
+
+
+@pytest.mark.multichip
+@pytest.mark.parametrize("src_tp,dst_tp", [(2, 4), (4, None)],
+                         ids=["tp2_to_tp4", "tp4_to_single"])
+def test_cross_degree_mid_decode_migration(virtual_mesh_devices,
+                                           src_tp, dst_tp):
+    """A streaming request starts on a TP=src mesh, and after at
+    least 4 tokens have been delivered its live KV chain migrates to
+    a replica of a DIFFERENT degree (the full-head-width wire makes
+    the pool's host view degree-agnostic) — with int8 KV, chunked
+    prefill and the prefix cache composed.  The stream must continue
+    seamlessly (concatenated partials == final) and the final tokens
+    must equal the single-chip greedy oracle bitwise."""
+    from aiko_services_tpu.orchestration.client import InferClient
+    from aiko_services_tpu.orchestration.continuous import (
+        ContinuousReplica, DecodeRequest,
+    )
+    from aiko_services_tpu.orchestration.serving import ReplicaRouter
+    from aiko_services_tpu.registry import Registrar
+    from aiko_services_tpu.runtime import (
+        Process, actor_args, compose_instance,
+    )
+    from aiko_services_tpu.runtime.event import EventEngine
+
+    max_new = 48
+    rng = np.random.default_rng(17)
+    vocab = _tp_server(None).config.vocab_size
+    prompt = rng.integers(1, vocab, 40).astype(np.int32)
+    warm_prompt = rng.integers(1, vocab, 40).astype(np.int32)
+
+    # Single-chip greedy oracle (invariant 9 anchors both degrees).
+    oracle_server = _tp_server(None)
+    oracle_server.submit(DecodeRequest(request_id="oracle",
+                                       prompt=prompt,
+                                       max_new_tokens=max_new))
+    oracle = list(oracle_server.run_until_drained()[0].tokens)
+
+    engine = EventEngine()
+    thread = engine.run_in_thread()
+    broker = f"xdeg-{uuid.uuid4().hex[:6]}"
+    processes = []
+
+    def make_process(pid):
+        process = Process(namespace="xdeg", hostname="h",
+                          pid=str(pid), engine=engine, broker=broker)
+        processes.append(process)
+        return process
+
+    try:
+        registrar = Registrar(process=make_process(1))
+        _wait(lambda: registrar.state == "primary", 10,
+              "registrar primary")
+        replicas = [
+            compose_instance(
+                ContinuousReplica, actor_args(f"replica_{index}"),
+                process=make_process(2 + index),
+                server=_tp_server(tp), kv_fetch_timeout_s=2.0)
+            for index, tp in enumerate((src_tp, dst_tp))]
+        router = compose_instance(
+            ReplicaRouter, actor_args("router"),
+            process=make_process(8), kv_transfer=True)
+        _wait(lambda: router.share["replicas"] == 2, 60,
+              "router discovery")
+
+        client = InferClient(make_process(9),
+                             f"{router.topic_path}/in")
+        # Warm BOTH degrees' prefill/decode programs directly (same
+        # shape bucket, different prompt), so the measured request
+        # streams at steady speed and the destination's resume is not
+        # a compile-stretched stall that lets the source finish first.
+        for replica in replicas:
+            warm_client = InferClient(replica.process,
+                                      replica.topic_in)
+            warm = warm_client.submit(warm_prompt, max_new_tokens=8)
+            warm_client.wait(warm, timeout=240.0)
+            assert warm.error is None, warm.error
+
+        future = client.submit(prompt, max_new_tokens=max_new,
+                               stream=True)
+        # Genuinely mid-decode: at least 4 streamed tokens before the
+        # migrate command goes out.
+        _wait(lambda: len(future.partial_tokens) >= 4 or future.done,
+              180, "first streamed tokens")
+        assert not future.done, "decode finished before migration"
+        entry = router._inflight[future.request_id]
+        source = entry["replica"]
+        by_topic = {r.topic_path: r for r in replicas}
+        assert source in by_topic
+        dest = next(t for t in by_topic if t != source)
+        router.process.message.publish(
+            f"{router.topic_path}/in",
+            f"(migrate {source} {dest})")
+
+        client.wait(future, timeout=240.0)
+        assert future.done and future.error is None, future.error
+        assert list(future.tokens) == oracle            # bit-exact
+        assert future.partial_tokens == future.tokens   # deduped
+        assert router.counters["migrations_completed"] == 1, \
+            dict(router.counters)
+        assert router.migration.cutover_ms
+        _wait(lambda: not router._inflight, 30, "inflight drained")
+    finally:
+        for process in reversed(processes):
+            try:
+                process.terminate()
+            except Exception:  # noqa: BLE001
+                pass
+        engine.terminate()
+        thread.join(timeout=5)
+
+
+# ---------------------------------------------------------------- #
+# Rolling upgrade: replace the whole fleet with zero downtime
+# ---------------------------------------------------------------- #
+
+def test_rolling_upgrade_zero_downtime():
+    """``(rolling_upgrade)`` replaces every replica one at a time,
+    live-migrating each predecessor's in-flight population onto its
+    successor: the fleet converges back to target with every replica
+    swapped, zero lost/duplicated tokens and clean streams."""
+    from aiko_services_tpu.tools.loadgen import run_rolling_upgrade
+
+    report = run_rolling_upgrade(duration_s=10.0, seed=0, replicas=2)
+    stats = report.server_stats
+    assert report.lost == 0, (report, stats)
+    assert report.timeouts == 0, (report, stats)
+    assert report.duplicate_finals == 0, stats
+    assert stats["stream_mismatches"] == 0, stats
+    assert stats["upgrades_completed"] >= 2, stats
+    assert stats["migrations_started"] >= 1, stats
+    assert stats["converged"], stats
