@@ -107,16 +107,18 @@ def digest_encode(block_size: int, role: str,
                   entries: Sequence[Tuple],
                   migrating: int = 0) -> str:
     """``entries`` = [(hex16, depth, refs, hotness[, tier[, adopted[,
-    migrating]]])] — already selected/ordered by the replica (hottest,
-    deepest first).  A missing or zero tier (HBM) is omitted on the
-    wire, so untiered replicas keep emitting the 4-field format
-    byte-for-byte; likewise a zero adopted flag keeps the 5-field
-    tier format and a zero migrating flag the 6-field one.  A SET
-    migrating flag forces the full 7-field entry (fields are
-    positional — tier/adopted are written even at 0).  The
-    ``migrating`` kwarg ORs into every entry: the flag is a property
-    of the advertising replica, so the publisher passes it once
-    instead of rewriting its entry tuples."""
+    migrating[, adapter]]]])] — already selected/ordered by the
+    replica (hottest, deepest first).  A missing or zero tier (HBM)
+    is omitted on the wire, so untiered replicas keep emitting the
+    4-field format byte-for-byte; likewise a zero adopted flag keeps
+    the 5-field tier format, a zero migrating flag the 6-field one,
+    and a zero adapter flag the 7-field one.  A SET adapter flag
+    (the entry is an adapter weight-page root, not a KV prefix)
+    forces the full 8-field entry (fields are positional —
+    tier/adopted/migrating are written even at 0).  The ``migrating``
+    kwarg ORs into every entry: the flag is a property of the
+    advertising replica, so the publisher passes it once instead of
+    rewriting its entry tuples."""
     parts = []
     migrating = int(bool(migrating))
     for entry in entries:
@@ -124,24 +126,28 @@ def digest_encode(block_size: int, role: str,
         tier = entry[4] if len(entry) > 4 else 0
         adopted = entry[5] if len(entry) > 5 else 0
         moving = migrating or (entry[6] if len(entry) > 6 else 0)
+        adapter = entry[7] if len(entry) > 7 else 0
         item = f"{hex_key}/{depth}/{refs}/{hot}"
-        if tier or adopted or moving:
+        if tier or adopted or moving or adapter:
             item += f"/{int(tier)}"
-        if adopted or moving:
+        if adopted or moving or adapter:
             item += f"/{int(adopted)}"
-        if moving:
+        if moving or adapter:
             item += f"/{int(moving)}"
+        if adapter:
+            item += f"/{int(adapter)}"
         parts.append(item)
     return f"{block_size};{role};{','.join(parts)}"
 
 
 def digest_decode(text: str):
-    """Returns ``(block_size, role, entries)`` with 7-tuple entries
-    ``(hex16, depth, refs, hotness, tier, adopted, migrating)`` —
-    tier/adopted/migrating default to 0 for the shorter (pre-tier,
-    pre-spill, pre-migration) formats — or ``None`` on any malformed
-    input (directory updates are best-effort: a corrupt advertisement
-    is dropped, never raises into the router)."""
+    """Returns ``(block_size, role, entries)`` with 8-tuple entries
+    ``(hex16, depth, refs, hotness, tier, adopted, migrating,
+    adapter)`` — tier/adopted/migrating/adapter default to 0 for the
+    shorter (pre-tier, pre-spill, pre-migration, pre-multitenant)
+    formats — or ``None`` on any malformed input (directory updates
+    are best-effort: a corrupt advertisement is dropped, never raises
+    into the router)."""
     try:
         block_text, role, body = str(text).split(";", 2)
         block_size = int(block_text)
@@ -149,14 +155,15 @@ def digest_decode(text: str):
         if body:
             for item in body.split(","):
                 fields = item.split("/")
-                if len(fields) not in (4, 5, 6, 7):
+                if len(fields) not in (4, 5, 6, 7, 8):
                     return None
                 tier = int(fields[4]) if len(fields) > 4 else 0
                 adopted = int(fields[5]) if len(fields) > 5 else 0
                 migrating = int(fields[6]) if len(fields) > 6 else 0
+                adapter = int(fields[7]) if len(fields) > 7 else 0
                 entries.append((fields[0], int(fields[1]),
                                 int(fields[2]), int(fields[3]),
-                                tier, adopted, migrating))
+                                tier, adopted, migrating, adapter))
         return block_size, role, entries
     except (TypeError, ValueError):
         return None
@@ -176,9 +183,10 @@ class PrefixDirectory:
 
     def __init__(self, lease_s: float = 30.0):
         self.lease_s = lease_s
-        #: replica -> {hex16 -> (depth, refs, hotness, tier, adopted)}
-        self._by_replica: \
-            Dict[str, Dict[str, Tuple[int, int, int, int, int]]] = {}
+        #: replica -> {hex16 -> (depth, refs, hotness, tier, adopted,
+        #: adapter)}
+        self._by_replica: Dict[str, Dict[
+            str, Tuple[int, int, int, int, int, int]]] = {}
         self._expiry: Dict[str, float] = {}
         self._block_size: Dict[str, int] = {}
         self._role: Dict[str, str] = {}
@@ -198,9 +206,9 @@ class PrefixDirectory:
             return False
         block_size, role, entries = decoded
         self._by_replica[replica] = {
-            hex_key: (depth, refs, hot, tier, adopted)
-            for hex_key, depth, refs, hot, tier, adopted, _migr
-            in entries}
+            hex_key: (depth, refs, hot, tier, adopted, adapter)
+            for hex_key, depth, refs, hot, tier, adopted, _migr,
+            adapter in entries}
         self._migrating[replica] = any(
             entry[6] for entry in entries)
         self._block_size[replica] = block_size
@@ -286,6 +294,36 @@ class PrefixDirectory:
             elif tier == 2:
                 disk += 1
         return depth, host, disk
+
+    def adapter_tier(self, replica: str, adapter_hex: str,
+                     now: float) -> Optional[int]:
+        """Tier at which ``replica`` advertises the adapter whose
+        root-page hex is ``adapter_hex`` (0=HBM, 1=host, 2=disk), or
+        None when it is not advertised warm there.  Adapter locality
+        is scored exactly like prefix locality — the digest entry is
+        just flagged so a KV prefix never masquerades as an
+        adapter."""
+        if not self.alive(replica, now):
+            return None
+        entry = self._by_replica.get(replica, {}).get(adapter_hex)
+        if entry is None or len(entry) < 6 or not entry[5]:
+            return None
+        return int(entry[3])
+
+    def adapter_owners(self, adapter_hex: str, now: float,
+                       exclude=()) -> List[Tuple[str, int]]:
+        """Every unexpired replica advertising the adapter warm, as
+        ``(replica, tier)`` sorted warmest tier first (replica order
+        breaks ties for determinism)."""
+        owners = []
+        for replica in sorted(self._by_replica):
+            if replica in exclude:
+                continue
+            tier = self.adapter_tier(replica, adapter_hex, now)
+            if tier is not None:
+                owners.append((replica, tier))
+        owners.sort(key=lambda pair: (pair[1], pair[0]))
+        return owners
 
     def best_owner(self, keys_hex: Sequence[str], now: float,
                    exclude=()) -> Tuple[Optional[str], int]:

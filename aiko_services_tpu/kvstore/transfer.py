@@ -546,10 +546,15 @@ def export_payload(server, keys_hex: List[str], start_depth: int,
             source = block
         if server._depth.get(key) != start_depth + offset + 1:
             break                      # not the chain we advertised
-        if server._key_seed.get(key, 0) != 0:
-            break                      # adapter-local: never exported
+        if server._key_seed.get(key, 0) > 0:
+            break    # per-request adapter KV: replica-local, never
+            #          exported.  ADAPTER_SEED weight pages DO export
+            #          (cross-replica adapter fetch) — flagged below.
         if resolved and server._parent.get(key) != resolved[-1]:
             break                      # chain discontinuity
+        if resolved and server._key_seed.get(key, 0) \
+                != server._key_seed.get(resolved[0], 0):
+            break                      # KV / adapter pages never mix
         resolved.append(key)
         sources.append(source)
     if not resolved:
@@ -563,6 +568,8 @@ def export_payload(server, keys_hex: List[str], start_depth: int,
         "kv_sig": pool_signature(server),
         "kv_dtype": np.dtype(server.pool[0]["k"].dtype).name,
     }
+    if server._key_seed.get(resolved[0], 0):
+        payload["kv_adapter"] = 1
     # The wire format is always the full kv-head width (TP-agnostic);
     # HBM rows gather through the fused staging buffer, host rows
     # splice in verbatim — both are the owner's pool bytes.
@@ -719,6 +726,11 @@ def import_payload(server, payload: Dict, engine=None,
                 for field, value in rows.items()})
 
     discard_host = getattr(server, "_host_discard", None)
+    # Adapter weight pages import under their sentinel seed so the
+    # importer can warm-load the adapter from them (and they keep
+    # demoting/advertising as adapter pages, never as base KV).
+    from .adapters import ADAPTER_SEED
+    key_seed = ADAPTER_SEED if payload.get("kv_adapter") else 0
     imported: List[bytes] = []
     for index, key in enumerate(fresh):
         block = blocks[index]
@@ -731,7 +743,7 @@ def import_payload(server, payload: Dict, engine=None,
         server._index[key] = block
         server._block_key[block] = key
         server._refs[block] = 1
-        server._key_seed[key] = 0
+        server._key_seed[key] = key_seed
         server._depth[key] = depth
         server._hex_key[key.hex()[:HEX_KEY_CHARS]] = key
         server._imported_keys.add(key)

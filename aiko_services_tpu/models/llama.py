@@ -373,11 +373,18 @@ def _matmul(x, w):
 def _lora_delta(x, factors, ids, scale):
     """Per-row low-rank delta: ``x`` (batch, q, d_in) through row
     ``i``'s own (A, B) = (factors["a"][ids[i]], factors["b"][ids[i]]).
-    Computed in f32 (rank-r intermediates are tiny) and cast back."""
+    Computed in f32 (rank-r intermediates are tiny) and cast back.
+
+    Two PINNED einsums, never one 3-operand contraction: the rank-r
+    hidden ``x@A`` depends only on the replicated inputs, and each
+    output column of ``hidden@B`` is an independent dot over r — so a
+    TP shard holding a column slice of B computes exactly its slice of
+    this delta, bitwise (llama_tp threads the same two einsums with B
+    column-sharded; the all-gather is then pure data movement)."""
     a = factors["a"][ids].astype(jnp.float32)     # (batch, d_in, r)
     b = factors["b"][ids].astype(jnp.float32)     # (batch, r, d_out)
-    delta = jnp.einsum("bqd,bdr,bro->bqo", x.astype(jnp.float32),
-                       a, b)
+    hidden = jnp.einsum("bqd,bdr->bqr", x.astype(jnp.float32), a)
+    delta = jnp.einsum("bqr,bro->bqo", hidden, b)
     return (scale * delta).astype(x.dtype)
 
 

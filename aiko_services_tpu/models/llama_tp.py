@@ -54,8 +54,17 @@ shard_map mirrors of the paged serving entry points in
   blanket MoE rejection is gone.  Decode runs replicated over the
   second axis (every sp/ep row computes identical tokens).
 
-LoRA adapters are still rejected under TP (adapter factors don't fit
-the 2-D output-axis rule yet).
+* LoRA adapters compose with TP (multi-tenant serving): the stacked
+  factor tree shards by the SAME output-column rule as the base
+  weights — A factors and the scale replicate, B factors column-shard
+  on d_out (:func:`tp_lora_specs` / :func:`shard_lora`).  Because
+  :func:`.llama._lora_delta` is two PINNED einsums, the rank-r hidden
+  ``x@A`` is computed identically on every shard and each output
+  column of ``hidden@B`` is an independent r-dot — a shard's local
+  delta is bitwise the column slice of the single-chip delta, added
+  before the same all-gather the base matmul takes.  TP LoRA greedy
+  decode is therefore token-identical to single-chip LoRA serving
+  (tests/test_multi_lora.py TP gates).
 
 ``overlap=True`` (opt-in, bench-only) routes the dense-MLP
 down-projection through :func:`..parallel.collective_matmul.
@@ -86,8 +95,8 @@ from . import llama
 from .llama import LlamaConfig
 
 __all__ = ["TPEngine", "tp_param_specs", "tp_pool_specs",
-           "shard_params", "shard_pool", "replicate",
-           "scatter_state_rows"]
+           "tp_lora_specs", "shard_params", "shard_pool", "shard_lora",
+           "replicate", "scatter_state_rows"]
 
 
 # --------------------------------------------------------------------------- #
@@ -157,6 +166,43 @@ def shard_params(params, mesh: Mesh, axis: str = "tp", ep_axis=None,
                                overlap=overlap))
 
 
+def tp_lora_specs(lora, axis: str = "tp"):
+    """PartitionSpecs for a stacked-adapter tree
+    (:func:`.lora.stack_adapters` layout): A factors
+    ``(n_adapters, d_in, r)`` and the scalar scale REPLICATE — the
+    rank-r hidden ``x@A`` must be computed identically on every shard
+    — while B factors ``(n_adapters, r, d_out)`` column-shard their
+    output axis exactly like the base weight they adapt, so the local
+    delta columns line up with the local base-matmul columns.  An
+    ``ids`` leaf (per-row adapter indices, present on the verify /
+    standalone-prefill call shapes) replicates like the rest of the
+    decode state."""
+    specs = {
+        "scale": P(),
+        "layers": [{target: {"a": P(), "b": P(None, None, axis)}
+                    for target in layer}
+                   for layer in lora["layers"]],
+    }
+    if "ids" in lora:
+        specs["ids"] = P()
+    return specs
+
+
+def shard_lora(lora_shared, mesh: Mesh, axis: str = "tp"):
+    """Lay a stacked-adapter tree out over the replica mesh (A + scale
+    replicated, B output-column-sharded).  The python-float scale stays
+    host-side — jit traces it as the same weak-typed scalar the
+    single-chip program folds in."""
+    specs = tp_lora_specs(lora_shared, axis)
+
+    def put(leaf, spec):
+        if isinstance(leaf, (int, float)):
+            return leaf
+        return jax.device_put(leaf, NamedSharding(mesh, spec))
+
+    return jax.tree.map(put, lora_shared, specs)
+
+
 def shard_pool(pool, mesh: Mesh, axis: str = "tp"):
     """Lay a paged pool out over the replica mesh (global arrays,
     kv-head axis sharded)."""
@@ -191,12 +237,18 @@ def scatter_state_rows(state, rows, packet, mesh: Mesh):
 #
 # These mirror llama's paged decode/prefill cores LINE FOR LINE, with
 # three mechanical changes: head counts become shard-local
-# (h/tp, kv/tp), LoRA plumbing is dropped (rejected under TP), and an
-# output-column all_gather follows each matmul whose result the next
-# (replicated-input) op needs in full.  f32 cast discipline is kept
-# exactly where the originals cast — every gathered value is bitwise
-# the concatenation of per-shard values, so the math matches the
-# single-chip program bit for bit.
+# (h/tp, kv/tp), LoRA factors ride the SAME column sharding as the
+# base weight they adapt (A + scale replicated, B column-sharded —
+# see :func:`tp_lora_specs`), and an output-column all_gather follows
+# each matmul whose result the next (replicated-input) op needs in
+# full.  f32 cast discipline is kept exactly where the originals cast
+# — every gathered value is bitwise the concatenation of per-shard
+# values, so the math matches the single-chip program bit for bit.
+# LoRA exactness leans on llama._lora_delta's two pinned einsums: the
+# rank-r hidden x@A depends only on replicated inputs (identical on
+# every shard), and each output column of hidden@B is an independent
+# r-length dot — a shard holding B's column slice computes exactly its
+# column slice of the single-chip delta, added BEFORE the gather.
 
 
 def _gather_cols(x, axis_name: str):
@@ -279,19 +331,26 @@ def _tp_moe_block(layer, config: LlamaConfig, axis: str, x,
 
 def _tp_attention_decode_paged(layer, config: LlamaConfig, tp: int,
                                axis: str, x, cos, sin, pool_layer,
-                               tables, positions):
+                               tables, positions, lora=None,
+                               lora_layer=None):
     """Shard-local mirror of ``llama._attention_decode_paged``:
     projections produce this shard's contiguous head range, the pool
     write and the attention kernel/reference run on the LOCAL kv-head
     slice, and only the attention output's feature columns gather
-    before the output projection."""
+    before the output projection.  ``lora_layer`` holds this shard's
+    column slice of the stacked B factors (A replicated), so each
+    ``_lora_matmul`` delta lands on exactly the local output columns
+    — added BEFORE the gather, like the base matmul's columns."""
     batch, seq = x.shape[:2]
     h, kv = config.n_heads // tp, config.n_kv_heads // tp
     hd = config.head_dim
     normed = llama.rms_norm(x, layer["attn_norm"], config.norm_eps)
-    q = llama._matmul(normed, layer["wq"]).reshape(batch, seq, h, hd)
-    k = llama._matmul(normed, layer["wk"]).reshape(batch, seq, kv, hd)
-    v = llama._matmul(normed, layer["wv"]).reshape(batch, seq, kv, hd)
+    q = llama._lora_matmul(normed, layer["wq"], lora_layer, "wq",
+                           lora).reshape(batch, seq, h, hd)
+    k = llama._lora_matmul(normed, layer["wk"], lora_layer, "wk",
+                           lora).reshape(batch, seq, kv, hd)
+    v = llama._lora_matmul(normed, layer["wv"], lora_layer, "wv",
+                           lora).reshape(batch, seq, kv, hd)
     q = llama.apply_rope(q, cos, sin)
     k = llama.apply_rope(k, cos, sin)
     new_pool = llama._paged_write_rows(pool_layer, k, v, tables,
@@ -309,22 +368,31 @@ def _tp_attention_decode_paged(layer, config: LlamaConfig, tp: int,
                                           positions[:, None], hd,
                                           window=config.sliding_window)
     out = _gather_cols(out.reshape(batch, seq, h * hd), axis)
-    attn = _gather_cols(llama._matmul(out, layer["wo"]), axis)
+    attn = _gather_cols(
+        llama._lora_matmul(out, layer["wo"], lora_layer, "wo", lora),
+        axis)
     return x + attn.astype(x.dtype), new_pool
+
+
+def _lora_layers(lora, n_layers: int):
+    """Per-layer factor dicts (or Nones) matching llama's iteration."""
+    return lora["layers"] if lora else [None] * n_layers
 
 
 def _tp_decode_core_paged(params, token, pool, tables, positions,
                           config: LlamaConfig, tp: int, axis: str,
-                          ep_axis=None, ep: int = 1,
+                          lora=None, ep_axis=None, ep: int = 1,
                           overlap: bool = False):
     positions_2d = positions[:, None]
     cos, sin = llama._rope_freqs(config, positions_2d)
     x = _tp_embed(params, token, config, axis)
     new_pool = []
-    for layer, pool_layer in zip(params["layers"], pool):
+    lora_layers = _lora_layers(lora, len(pool))
+    for layer, pool_layer, lora_layer in zip(params["layers"], pool,
+                                             lora_layers):
         x, layer_pool = _tp_attention_decode_paged(
             layer, config, tp, axis, x, cos, sin, pool_layer, tables,
-            positions)
+            positions, lora=lora, lora_layer=lora_layer)
         new_pool.append(layer_pool)
         x = _tp_mlp_block(layer, config, axis, x, ep_axis=ep_axis,
                           ep=ep, overlap=overlap)
@@ -334,7 +402,7 @@ def _tp_decode_core_paged(params, token, pool, tables, positions,
 
 def _tp_prefill_append_core(params, tokens, pool, tables, start_index,
                             config: LlamaConfig, tp: int, axis: str,
-                            kv_limit=None,
+                            lora=None, kv_limit=None,
                             compute_logits: bool = False,
                             ep_axis=None, ep: int = 1,
                             overlap: bool = False):
@@ -353,11 +421,16 @@ def _tp_prefill_append_core(params, tokens, pool, tables, start_index,
     x = _tp_embed(params, tokens, config, axis)
     use_kernel, interpret = llama.prefill_kernel_mode()
     new_pool = []
-    for layer, pool_layer in zip(params["layers"], pool):
+    lora_layers = _lora_layers(lora, len(pool))
+    for layer, pool_layer, lora_layer in zip(params["layers"], pool,
+                                             lora_layers):
         normed = llama.rms_norm(x, layer["attn_norm"], config.norm_eps)
-        q = llama._matmul(normed, layer["wq"]).reshape(batch, K, h, hd)
-        k = llama._matmul(normed, layer["wk"]).reshape(batch, K, kv, hd)
-        v = llama._matmul(normed, layer["wv"]).reshape(batch, K, kv, hd)
+        q = llama._lora_matmul(normed, layer["wq"], lora_layer, "wq",
+                               lora).reshape(batch, K, h, hd)
+        k = llama._lora_matmul(normed, layer["wk"], lora_layer, "wk",
+                               lora).reshape(batch, K, kv, hd)
+        v = llama._lora_matmul(normed, layer["wv"], lora_layer, "wv",
+                               lora).reshape(batch, K, kv, hd)
         q = llama.apply_rope(q, cos, sin)
         k = llama.apply_rope(k, cos, sin)
         q_g = q.reshape(batch, K, kv, h // kv, hd)
@@ -375,8 +448,9 @@ def _tp_prefill_append_core(params, tokens, pool, tables, start_index,
                 window=config.sliding_window)
         new_pool.append(pool_layer)
         out = _gather_cols(out.reshape(batch, K, h * hd), axis)
-        x = x + _gather_cols(llama._matmul(out, layer["wo"]),
-                             axis).astype(x.dtype)
+        x = x + _gather_cols(
+            llama._lora_matmul(out, layer["wo"], lora_layer, "wo",
+                               lora), axis).astype(x.dtype)
         x = _tp_mlp_block(layer, config, axis, x, ep_axis=ep_axis,
                           ep=ep, overlap=overlap)
     if not compute_logits:
@@ -386,7 +460,7 @@ def _tp_prefill_append_core(params, tokens, pool, tables, start_index,
 
 def _tp_sp_prefill_core(params, tokens, pool, tables, start_index,
                         config: LlamaConfig, tp: int, axis: str,
-                        sp_axis: str, sp: int, kv_limit=None,
+                        sp_axis: str, sp: int, lora=None, kv_limit=None,
                         ep_axis=None, ep: int = 1,
                         overlap: bool = False):
     """Sequence-parallel chunked-prefill core: the dispatch window
@@ -428,11 +502,16 @@ def _tp_sp_prefill_core(params, tokens, pool, tables, start_index,
     x = _tp_embed(params, tokens, config, axis)
     use_kernel, interpret = llama.prefill_kernel_mode()
     new_pool = []
-    for layer, pool_layer in zip(params["layers"], pool):
+    lora_layers = _lora_layers(lora, len(pool))
+    for layer, pool_layer, lora_layer in zip(params["layers"], pool,
+                                             lora_layers):
         normed = llama.rms_norm(x, layer["attn_norm"], config.norm_eps)
-        q = llama._matmul(normed, layer["wq"]).reshape(batch, W, h, hd)
-        k = llama._matmul(normed, layer["wk"]).reshape(batch, W, kv, hd)
-        v = llama._matmul(normed, layer["wv"]).reshape(batch, W, kv, hd)
+        q = llama._lora_matmul(normed, layer["wq"], lora_layer, "wq",
+                               lora).reshape(batch, W, h, hd)
+        k = llama._lora_matmul(normed, layer["wk"], lora_layer, "wk",
+                               lora).reshape(batch, W, kv, hd)
+        v = llama._lora_matmul(normed, layer["wv"], lora_layer, "wv",
+                               lora).reshape(batch, W, kv, hd)
         q = llama.apply_rope(q, cos, sin)
         k = llama.apply_rope(k, cos, sin)
         k_win = jax.lax.all_gather(k, sp_axis, axis=1, tiled=True)
@@ -452,8 +531,9 @@ def _tp_sp_prefill_core(params, tokens, pool, tables, start_index,
                 window=config.sliding_window)
         new_pool.append(pool_layer)
         out = _gather_cols(out.reshape(batch, W, h * hd), axis)
-        x = x + _gather_cols(llama._matmul(out, layer["wo"]),
-                             axis).astype(x.dtype)
+        x = x + _gather_cols(
+            llama._lora_matmul(out, layer["wo"], lora_layer, "wo",
+                               lora), axis).astype(x.dtype)
         x = _tp_mlp_block(layer, config, axis, x, ep_axis=ep_axis,
                           ep=ep, overlap=overlap)
     return new_pool
@@ -461,7 +541,7 @@ def _tp_sp_prefill_core(params, tokens, pool, tables, start_index,
 
 def _tp_verify_core(params, tokens, pool, tables, positions, active,
                     config: LlamaConfig, tp: int, axis: str,
-                    kv_limit=None, ep_axis=None, ep: int = 1,
+                    lora=None, kv_limit=None, ep_axis=None, ep: int = 1,
                     overlap: bool = False):
     """Shard-local mirror of ``llama._verify_append_core`` (the
     speculative verify): every row at its OWN absolute start position,
@@ -483,11 +563,16 @@ def _tp_verify_core(params, tokens, pool, tables, positions, active,
     x = _tp_embed(params, tokens, config, axis)
     use_kernel, interpret = llama.prefill_kernel_mode()
     new_pool = []
-    for layer, pool_layer in zip(params["layers"], pool):
+    lora_layers = _lora_layers(lora, len(pool))
+    for layer, pool_layer, lora_layer in zip(params["layers"], pool,
+                                             lora_layers):
         normed = llama.rms_norm(x, layer["attn_norm"], config.norm_eps)
-        q = llama._matmul(normed, layer["wq"]).reshape(batch, K, h, hd)
-        k = llama._matmul(normed, layer["wk"]).reshape(batch, K, kv, hd)
-        v = llama._matmul(normed, layer["wv"]).reshape(batch, K, kv, hd)
+        q = llama._lora_matmul(normed, layer["wq"], lora_layer, "wq",
+                               lora).reshape(batch, K, h, hd)
+        k = llama._lora_matmul(normed, layer["wk"], lora_layer, "wk",
+                               lora).reshape(batch, K, kv, hd)
+        v = llama._lora_matmul(normed, layer["wv"], lora_layer, "wv",
+                               lora).reshape(batch, K, kv, hd)
         q = llama.apply_rope(q, cos, sin)
         k = llama.apply_rope(k, cos, sin)
         q_g = q.reshape(batch, K, kv, h // kv, hd)
@@ -506,8 +591,9 @@ def _tp_verify_core(params, tokens, pool, tables, positions, active,
                 window=config.sliding_window)
         new_pool.append(pool_layer)
         out = _gather_cols(out.reshape(batch, K, h * hd), axis)
-        x = x + _gather_cols(llama._matmul(out, layer["wo"]),
-                             axis).astype(x.dtype)
+        x = x + _gather_cols(
+            llama._lora_matmul(out, layer["wo"], lora_layer, "wo",
+                               lora), axis).astype(x.dtype)
         x = _tp_mlp_block(layer, config, axis, x, ep_axis=ep_axis,
                           ep=ep, overlap=overlap)
     return _tp_lm_head(params, config, axis, x), new_pool
@@ -588,33 +674,46 @@ class TPEngine:
 
     # -- decode chunk -------------------------------------------------- #
 
+    def _lora_specs(self, lora):
+        """Spec tree for a stacked-adapter operand (or None)."""
+        return (tp_lora_specs(lora, self.axis)
+                if lora is not None else None)
+
     def serve_chunk_paged(self, params, state, pool, num_steps,
                           eos_id: int = -1, sampled: bool = False,
-                          rng_key=None):
-        """TP twin of :func:`llama.serve_chunk_paged` (no LoRA)."""
+                          rng_key=None, lora_shared=None):
+        """TP twin of :func:`llama.serve_chunk_paged`.  ``lora_shared``
+        is the stacked adapter tree laid out by :func:`shard_lora`
+        (A + scale replicated, B column-sharded); per-row ids come from
+        ``state["adapter_ids"]`` exactly like the single-chip twin."""
         num_steps = int(num_steps)
         key = ("serve", num_steps, int(eos_id), bool(sampled),
-               rng_key is not None)
+               rng_key is not None, lora_shared is not None)
         fn = self._cache.get(key)
         if fn is None:
             fn = self._build_serve(num_steps, int(eos_id),
-                                   bool(sampled), rng_key is not None)
+                                   bool(sampled), rng_key is not None,
+                                   self._lora_specs(lora_shared))
             self._cache[key] = fn
         args = (params, state, pool) + (
-            (rng_key,) if rng_key is not None else ())
+            (rng_key,) if rng_key is not None else ()) + (
+            (lora_shared,) if lora_shared is not None else ())
         return fn(*args)
 
-    def _build_serve(self, num_steps, eos_id, sampled, has_rng):
+    def _build_serve(self, num_steps, eos_id, sampled, has_rng,
+                     lora_specs=None):
         config, tp, axis = self.config, self.tp, self.axis
         core_kwargs = self._core_kwargs()
 
-        def body(params, state, pool, rng_key=None):
+        def body(params, state, pool, rng_key=None, lora_shared=None):
             block_size = pool[0]["k"].shape[1]
             tables = state["tables"]
             slots = tables.shape[0]
             scratch_tables = jnp.zeros_like(tables)
             scratch_positions = (jnp.arange(slots, dtype=jnp.int32)
                                  % block_size)
+            lora = (dict(lora_shared, ids=state["adapter_ids"])
+                    if lora_shared is not None else None)
 
             def step_core(token, pool, positions, active):
                 write_tables = jnp.where(active[:, None], tables,
@@ -624,16 +723,28 @@ class TPEngine:
                 return _tp_decode_core_paged(params, token, pool,
                                              write_tables, write_pos,
                                              config, tp, axis,
-                                             **core_kwargs)
+                                             lora=lora, **core_kwargs)
 
             return llama._serve_scan(step_core, state, pool, num_steps,
                                      eos_id, sampled, rng_key)
 
+        if lora_specs is not None:
+            if has_rng:
+                def wrapped(params, state, pool, rng_key, lora_shared):
+                    return body(params, state, pool, rng_key,
+                                lora_shared)
+            else:
+                def wrapped(params, state, pool, lora_shared):
+                    return body(params, state, pool, None, lora_shared)
+        else:
+            wrapped = body
         in_specs = (self._param_specs, P(), self._pool_specs)
         if has_rng:
             in_specs += (P(),)
+        if lora_specs is not None:
+            in_specs += (lora_specs,)
         out_specs = (P(), P(), P(), self._pool_specs)
-        return jax.jit(self._shard_map(body, in_specs, out_specs),
+        return jax.jit(self._shard_map(wrapped, in_specs, out_specs),
                        donate_argnums=(2,))
 
     # -- mixed prefill/decode chunk ------------------------------------ #
@@ -641,9 +752,12 @@ class TPEngine:
     def serve_chunk_mixed(self, params, state, pool, prefill_tokens,
                           prefill_row, prefill_start, num_steps,
                           eos_id: int = -1, sampled: bool = False,
-                          rng_key=None, prefill_kv_limit=None,
+                          rng_key=None, lora_shared=None,
+                          prefill_kv_limit=None,
                           sp_shard: bool = False):
-        """TP twin of :func:`llama.serve_chunk_mixed` (no LoRA).
+        """TP twin of :func:`llama.serve_chunk_mixed` — the admitting
+        slot's adapter id is dynamically sliced out of the resident
+        state for the prefill leg, exactly like the single-chip twin.
 
         ``sp_shard=True`` (needs an sp mesh axis): the prefill slice is
         an sp-WINDOW — ``sp`` consecutive chunks in one dispatch,
@@ -654,41 +768,53 @@ class TPEngine:
         if sp_shard and self.sp <= 1:
             raise ValueError("sp_shard needs an sp mesh axis > 1")
         key = ("mixed", num_steps, int(eos_id), bool(sampled),
-               rng_key is not None, prefill_kv_limit, bool(sp_shard))
+               rng_key is not None, prefill_kv_limit, bool(sp_shard),
+               lora_shared is not None)
         fn = self._cache.get(key)
         if fn is None:
             fn = self._build_mixed(num_steps, int(eos_id),
                                    bool(sampled), rng_key is not None,
-                                   prefill_kv_limit, bool(sp_shard))
+                                   prefill_kv_limit, bool(sp_shard),
+                                   self._lora_specs(lora_shared))
             self._cache[key] = fn
         args = (params, state, pool, prefill_tokens,
                 jnp.asarray(prefill_row, jnp.int32),
                 jnp.asarray(prefill_start, jnp.int32)) + (
-            (rng_key,) if rng_key is not None else ())
+            (rng_key,) if rng_key is not None else ()) + (
+            (lora_shared,) if lora_shared is not None else ())
         return fn(*args)
 
     def _build_mixed(self, num_steps, eos_id, sampled, has_rng,
-                     prefill_kv_limit, sp_shard=False):
+                     prefill_kv_limit, sp_shard=False,
+                     lora_specs=None):
         config, tp, axis = self.config, self.tp, self.axis
         sp_axis, sp = self.sp_axis, self.sp
         core_kwargs = self._core_kwargs()
 
         def body(params, state, pool, prefill_tokens, prefill_row,
-                 prefill_start, rng_key=None):
+                 prefill_start, rng_key=None, lora_shared=None):
             block_size = pool[0]["k"].shape[1]
             tables = state["tables"]
             slots = tables.shape[0]
             tables_row = jax.lax.dynamic_slice_in_dim(
                 tables, prefill_row, 1, axis=0)
+            if lora_shared is not None:
+                row_ids = jax.lax.dynamic_slice_in_dim(
+                    state["adapter_ids"], prefill_row, 1, axis=0)
+                prefill_lora = dict(lora_shared, ids=row_ids)
+                lora = dict(lora_shared, ids=state["adapter_ids"])
+            else:
+                prefill_lora = lora = None
             if sp_shard:
                 pool = _tp_sp_prefill_core(
                     params, prefill_tokens, pool, tables_row,
                     prefill_start, config, tp, axis, sp_axis, sp,
-                    kv_limit=prefill_kv_limit, **core_kwargs)
+                    lora=prefill_lora, kv_limit=prefill_kv_limit,
+                    **core_kwargs)
             else:
                 _, pool = _tp_prefill_append_core(
                     params, prefill_tokens, pool, tables_row,
-                    prefill_start, config, tp, axis,
+                    prefill_start, config, tp, axis, lora=prefill_lora,
                     kv_limit=prefill_kv_limit, compute_logits=False,
                     **core_kwargs)
             scratch_tables = jnp.zeros_like(tables)
@@ -703,50 +829,75 @@ class TPEngine:
                 return _tp_decode_core_paged(params, token, pool,
                                              write_tables, write_pos,
                                              config, tp, axis,
-                                             **core_kwargs)
+                                             lora=lora, **core_kwargs)
 
             return llama._serve_scan(step_core, state, pool, num_steps,
                                      eos_id, sampled, rng_key)
 
+        if lora_specs is not None:
+            if has_rng:
+                def wrapped(params, state, pool, prefill_tokens,
+                            prefill_row, prefill_start, rng_key,
+                            lora_shared):
+                    return body(params, state, pool, prefill_tokens,
+                                prefill_row, prefill_start, rng_key,
+                                lora_shared)
+            else:
+                def wrapped(params, state, pool, prefill_tokens,
+                            prefill_row, prefill_start, lora_shared):
+                    return body(params, state, pool, prefill_tokens,
+                                prefill_row, prefill_start, None,
+                                lora_shared)
+        else:
+            wrapped = body
         prefill_spec = P(None, sp_axis) if sp_shard else P()
         in_specs = (self._param_specs, P(), self._pool_specs,
                     prefill_spec, P(), P())
         if has_rng:
             in_specs += (P(),)
+        if lora_specs is not None:
+            in_specs += (lora_specs,)
         out_specs = (P(), P(), P(), self._pool_specs)
-        return jax.jit(self._shard_map(body, in_specs, out_specs),
+        return jax.jit(self._shard_map(wrapped, in_specs, out_specs),
                        donate_argnums=(2,))
 
     # -- speculative verify window ------------------------------------- #
 
     def verify_chunk_paged(self, params, tokens, pool, tables,
-                           positions, active, kv_limit=None):
-        """TP twin of :func:`llama.verify_chunk_paged` (no LoRA):
-        score a (slots, k+1) speculative window against the sharded
-        pool, each row at its own absolute position.  Returns
-        ``(logits (slots, k+1, vocab), pool)`` with the pool donated —
-        bitwise equal to the single-chip verify (all-gather is the
-        only collective)."""
+                           positions, active, lora=None,
+                           kv_limit=None):
+        """TP twin of :func:`llama.verify_chunk_paged`: score a
+        (slots, k+1) speculative window against the sharded pool, each
+        row at its own absolute position.  ``lora`` is the full dict
+        WITH per-row ids (the llama signature).  Returns ``(logits
+        (slots, k+1, vocab), pool)`` with the pool donated — bitwise
+        equal to the single-chip verify (all-gather is the only
+        collective)."""
         K = int(tokens.shape[1])
-        key = ("verify", K, kv_limit)
+        key = ("verify", K, kv_limit, lora is not None)
         fn = self._cache.get(key)
         if fn is None:
-            fn = self._build_verify(kv_limit)
+            fn = self._build_verify(kv_limit, self._lora_specs(lora))
             self._cache[key] = fn
-        return fn(params, tokens, pool, tables, positions, active)
+        args = (params, tokens, pool, tables, positions, active) + (
+            (lora,) if lora is not None else ())
+        return fn(*args)
 
-    def _build_verify(self, kv_limit):
+    def _build_verify(self, kv_limit, lora_specs=None):
         config, tp, axis = self.config, self.tp, self.axis
         core_kwargs = self._core_kwargs()
 
-        def body(params, tokens, pool, tables, positions, active):
+        def body(params, tokens, pool, tables, positions, active,
+                 lora=None):
             return _tp_verify_core(params, tokens, pool, tables,
                                    positions, active, config, tp,
-                                   axis, kv_limit=kv_limit,
+                                   axis, lora=lora, kv_limit=kv_limit,
                                    **core_kwargs)
 
         in_specs = (self._param_specs, P(), self._pool_specs,
                     P(), P(), P())
+        if lora_specs is not None:
+            in_specs += (lora_specs,)
         out_specs = (P(), self._pool_specs)
         return jax.jit(self._shard_map(body, in_specs, out_specs),
                        donate_argnums=(2,))
@@ -754,36 +905,41 @@ class TPEngine:
     # -- standalone prefill append ------------------------------------- #
 
     def prefill_append_paged(self, params, tokens, pool, tables,
-                             start_index, kv_limit=None,
+                             start_index, lora=None, kv_limit=None,
                              compute_logits: bool = False):
-        """TP twin of :func:`llama.prefill_append_paged` (no LoRA).
-        Always dispatched with ``compute_logits=False`` by the paged
-        server (the mixed step owns logits); returns ``(None,
-        new_pool)`` to match the llama call-site unpacking."""
+        """TP twin of :func:`llama.prefill_append_paged` — ``lora`` is
+        the full dict WITH per-row ids (the llama signature).  Always
+        dispatched with ``compute_logits=False`` by the paged server
+        (the mixed step owns logits); returns ``(None, new_pool)`` to
+        match the llama call-site unpacking."""
         if compute_logits:
             raise NotImplementedError(
                 "TP prefill_append_paged serves the paged admission "
                 "path, which never reads prefill logits")
-        key = ("prefill", kv_limit)
+        key = ("prefill", kv_limit, lora is not None)
         fn = self._cache.get(key)
         if fn is None:
-            fn = self._build_prefill(kv_limit)
+            fn = self._build_prefill(kv_limit, self._lora_specs(lora))
             self._cache[key] = fn
-        return None, fn(params, tokens, pool, tables,
-                        jnp.asarray(start_index, jnp.int32))
+        args = (params, tokens, pool, tables,
+                jnp.asarray(start_index, jnp.int32)) + (
+            (lora,) if lora is not None else ())
+        return None, fn(*args)
 
-    def _build_prefill(self, kv_limit):
+    def _build_prefill(self, kv_limit, lora_specs=None):
         config, tp, axis = self.config, self.tp, self.axis
         core_kwargs = self._core_kwargs()
 
-        def body(params, tokens, pool, tables, start_index):
+        def body(params, tokens, pool, tables, start_index, lora=None):
             _, new_pool = _tp_prefill_append_core(
                 params, tokens, pool, tables, start_index, config, tp,
-                axis, kv_limit=kv_limit, compute_logits=False,
-                **core_kwargs)
+                axis, lora=lora, kv_limit=kv_limit,
+                compute_logits=False, **core_kwargs)
             return new_pool
 
         in_specs = (self._param_specs, P(), self._pool_specs, P(), P())
+        if lora_specs is not None:
+            in_specs += (lora_specs,)
         out_specs = self._pool_specs
         return jax.jit(self._shard_map(body, in_specs, out_specs),
                        donate_argnums=(2,))
@@ -791,7 +947,7 @@ class TPEngine:
     # -- sequence-parallel prefill window ------------------------------ #
 
     def prefill_append_sp(self, params, tokens, pool, tables,
-                          start_index, kv_limit=None):
+                          start_index, lora=None, kv_limit=None):
         """Standalone sp-window prefill: ``tokens (1, sp*W)`` is
         ``sp`` consecutive chunks of one prompt, sharded over the sp
         axis — each shard appends its own chunk at its own absolute
@@ -805,26 +961,32 @@ class TPEngine:
             raise ValueError(
                 f"sp window width {tokens.shape[1]} must divide by "
                 f"sp={self.sp}")
-        key = ("prefill_sp", kv_limit)
+        key = ("prefill_sp", kv_limit, lora is not None)
         fn = self._cache.get(key)
         if fn is None:
-            fn = self._build_prefill_sp(kv_limit)
+            fn = self._build_prefill_sp(kv_limit,
+                                        self._lora_specs(lora))
             self._cache[key] = fn
-        return None, fn(params, tokens, pool, tables,
-                        jnp.asarray(start_index, jnp.int32))
+        args = (params, tokens, pool, tables,
+                jnp.asarray(start_index, jnp.int32)) + (
+            (lora,) if lora is not None else ())
+        return None, fn(*args)
 
-    def _build_prefill_sp(self, kv_limit):
+    def _build_prefill_sp(self, kv_limit, lora_specs=None):
         config, tp, axis = self.config, self.tp, self.axis
         sp_axis, sp = self.sp_axis, self.sp
         core_kwargs = self._core_kwargs()
 
-        def body(params, tokens, pool, tables, start_index):
+        def body(params, tokens, pool, tables, start_index, lora=None):
             return _tp_sp_prefill_core(
                 params, tokens, pool, tables, start_index, config, tp,
-                axis, sp_axis, sp, kv_limit=kv_limit, **core_kwargs)
+                axis, sp_axis, sp, lora=lora, kv_limit=kv_limit,
+                **core_kwargs)
 
         in_specs = (self._param_specs, P(None, sp_axis),
                     self._pool_specs, P(), P())
+        if lora_specs is not None:
+            in_specs += (lora_specs,)
         out_specs = self._pool_specs
         return jax.jit(self._shard_map(body, in_specs, out_specs),
                        donate_argnums=(2,))

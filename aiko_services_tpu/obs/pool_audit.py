@@ -174,6 +174,12 @@ class PoolAccountant:
                 "KV pool byte movements by flow",
                 labels={"flow": name}) for name in FLOWS}
         self._state_gauges: Dict[str, object] = {}
+        #: Multi-tenant adapter paging: factor-page residency per tier
+        #: and per-adapter slot occupancy, mirrored from the census's
+        #: ``adapters`` section (lazily — base-model pools never
+        #: create the series).
+        self._adapter_page_gauges: Dict[str, object] = {}
+        self._adapter_slot_gauges: Dict[str, object] = {}
 
     # -- hot-path hook (one dict update + two counter incs) ---------------- #
 
@@ -215,6 +221,27 @@ class PoolAccountant:
                     labels={"state": state})
                 self._state_gauges[state] = gauge
             gauge.set(int(count))
+        adapters = census.get("adapters") or {}
+        for tier, pages in adapters.get("pages", {}).items():
+            gauge = self._adapter_page_gauges.get(tier)
+            if gauge is None:
+                gauge = self.registry.gauge(
+                    "aiko_adapter_pages",
+                    "paged LoRA adapter factor pages resident per "
+                    "tier (same pool as KV; see kvstore/adapters.py)",
+                    labels={"tier": tier})
+                self._adapter_page_gauges[tier] = gauge
+            gauge.set(int(pages))
+        for name, slots in adapters.get("slots", {}).items():
+            gauge = self._adapter_slot_gauges.get(name)
+            if gauge is None:
+                gauge = self.registry.gauge(
+                    "aiko_adapter_slots",
+                    "decode slots currently pinned to each loaded "
+                    "adapter",
+                    labels={"adapter": name})
+                self._adapter_slot_gauges[name] = gauge
+            gauge.set(int(slots))
 
     def occupancy_from_flows(self, field: str = "blocks") \
             -> Dict[str, int]:

@@ -62,6 +62,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import itertools
 import threading
 import time
 from typing import Dict, List, Optional
@@ -76,6 +77,9 @@ from ..utils.sexpr import generate, parse
 
 __all__ = ["ContinuousBatchingServer", "ContinuousReplica",
            "DecodeRequest"]
+
+#: Distinct ``instance=`` metric label per server in this process.
+_SERVER_INSTANCE_IDS = itertools.count()
 
 
 @dataclasses.dataclass
@@ -229,10 +233,6 @@ class ContinuousBatchingServer:
                     "mesh= (GSPMD megatron sharding) and replica_mesh= "
                     "(shard_map TP engine) are distinct parallel "
                     "paths; pass one")
-            if adapters or lora_config is not None:
-                raise ValueError(
-                    "replica_mesh does not compose with LoRA adapters "
-                    "yet: per-slot factor gathers are not sharded")
             replica_mesh.validate(self.config)
             from ..models import llama_tp
             self._llama_tp = llama_tp
@@ -391,10 +391,15 @@ class ContinuousBatchingServer:
             names = list(adapters)
             self._adapter_index = {name: i + 1
                                    for i, name in enumerate(names)}
-            self._lora_shared = lora_mod.stack_adapters(
-                self.config, lora_config,
-                [adapters[name] for name in names])
+            self._lora_shared = self._place_lora(
+                lora_mod.stack_adapters(
+                    self.config, lora_config,
+                    [adapters[name] for name in names]))
         self._adapter_ids = np.zeros((slots,), np.int32)
+        # Multi-tenant load provenance: warm = restacked from paged
+        # storage, cold = factors shipped in from outside.
+        self.adapter_warm_loads = 0
+        self.adapter_cold_loads = 0
         self.positions = np.zeros((slots,), np.int32)
         self.active = np.zeros((slots,), bool)
         self.tokens = np.zeros((slots, 1), np.int32)
@@ -474,7 +479,12 @@ class ContinuousBatchingServer:
         # (tests and stats() read it directly) while every write also
         # lands in the process metrics registry under
         # ``aiko_server_<key>{instance=…}`` for the (metrics …) dump.
-        self._metrics_labels = {"instance": f"srv{id(self) & 0xffff:x}"}
+        # Process-monotonic instance id: ``id(self)`` hashes collide
+        # when the allocator reuses a freed server's address, silently
+        # MERGING two servers' registry series (histogram counts
+        # accumulate across unrelated servers).
+        self._instance_id = next(_SERVER_INSTANCE_IDS)
+        self._metrics_labels = {"instance": f"srv{self._instance_id}"}
         self.counters: Dict = CounterDict(dict(
             dispatches=0, decode_steps=0, tokens_committed=0,
             host_syncs=0, sync_wait_ms=0.0, sync_elements=0,
@@ -1145,6 +1155,15 @@ class ContinuousBatchingServer:
         """Names currently servable (operator telemetry)."""
         return sorted(self._adapter_index)
 
+    def adapter_slot_counts(self) -> Dict[str, int]:
+        """name -> decode slots currently pinned to that adapter
+        (dashboard pane + pool census; host-side reads only)."""
+        if not self._adapter_index:
+            return {}
+        slot_ids = np.asarray(self._adapter_ids).reshape(-1)
+        return {name: int(np.sum(slot_ids == index))
+                for name, index in sorted(self._adapter_index.items())}
+
     def _adapter_users(self, name: str) -> int:
         """Requests pinning adapter ``name`` — by NAME, not stacked
         index: a chunk-prefilling slot holds its request before
@@ -1154,15 +1173,52 @@ class ContinuousBatchingServer:
                    if r is not None and r.adapter == name)
         return live + sum(1 for r in self._queue if r.adapter == name)
 
-    def load_adapter(self, name: str, lora_params,
+    def _adapter_load_counter(self, kind: str):
+        """Lazily-created ``aiko_adapter_loads_total{kind=}`` mirror
+        of the warm/cold load attributes (lazy so base-model servers
+        never emit the series)."""
+        counters = getattr(self, "_adapter_load_counters", None)
+        if counters is None:
+            counters = self._adapter_load_counters = {}
+        counter = counters.get(kind)
+        if counter is None:
+            counter = REGISTRY.counter(
+                "aiko_adapter_loads_total",
+                "adapter hot-deploys by provenance (warm = restacked "
+                "from a paged pool copy, cold = client-uploaded "
+                "factor bytes)",
+                labels=dict(self._metrics_labels, kind=kind))
+            counters[kind] = counter
+        return counter
+
+    def load_adapter(self, name: str, lora_params=None,
                      lora_config=None) -> None:
         """Register (or replace) a LoRA adapter at RUNTIME — deploy a
         new fine-tune without restarting the replica.  The first load
         on an adapter-less server defines the shared LoRAConfig; later
         loads must match it (one stacked shape per server).  Replacing
-        a name requires no live request on it (``adapter_busy``)."""
+        a name requires no live request on it (``adapter_busy``).
+
+        ``lora_params=None`` is a WARM load: the factors restack from
+        the replica's paged adapter storage (any tier — the shared
+        pool keeps unloaded adapters warm) with no client re-upload;
+        ``KeyError`` when no paged copy survives (``adapter_cold``)."""
         from ..models import lora as lora_mod
         jnp = self._jnp
+
+        if lora_params is None:
+            fetched = self._fetch_adapter_pages(name)
+            if fetched is None:
+                raise KeyError(f"adapter_cold: no paged copy of "
+                               f"{name!r} to warm-load")
+            lora_params, paged_config = fetched
+            if lora_config is None:
+                lora_config = paged_config
+            self.adapter_warm_loads += 1
+            self._adapter_load_counter("warm").inc()
+        else:
+            self.adapter_cold_loads += 1
+            self._adapter_load_counter("cold").inc()
 
         if self._lora_config is None:
             if lora_config is None:
@@ -1198,8 +1254,9 @@ class ContinuousBatchingServer:
             self.config, candidate_config, [lora_params])
         self._lora_config = candidate_config
         if self._lora_shared is None:
-            self._lora_shared = stacked_one
+            self._lora_shared = self._place_lora(stacked_one)
             self._adapter_index[name] = 1
+            self._register_adapter_pages(name, lora_params)
             return
         existing = self._adapter_index.get(name)
         if existing is not None:
@@ -1234,16 +1291,21 @@ class ContinuousBatchingServer:
                         "b": factors["b"].at[index].set(fresh["b"][1]),
                     }
             new_layers.append(merged)
-        self._lora_shared = {"scale": self._lora_shared["scale"],
-                             "layers": new_layers}
+        self._lora_shared = self._place_lora(
+            {"scale": self._lora_shared["scale"],
+             "layers": new_layers})
         if index is None:
             index = self._lora_shared["layers"][0][
                 next(iter(new_layers[0]))]["a"].shape[0] - 1
         self._adapter_index[name] = index
+        self._register_adapter_pages(name, lora_params)
 
     def unload_adapter(self, name: str) -> None:
         """Remove a served adapter; its stacked index is zeroed and
-        recycled (no recompile).  Requires no live request on it."""
+        recycled (no recompile).  Requires no live request on it.
+        Paged adapter storage is deliberately NOT dropped: the pages
+        stay resident under the shared eviction clock, so a future
+        ``load_adapter(name)`` warm-loads with no re-upload."""
         jnp = self._jnp
         index = self._adapter_index.get(name)
         if index is None:
@@ -1262,8 +1324,9 @@ class ContinuousBatchingServer:
                         jnp.zeros_like(factors["b"][index])),
                 }
             new_layers.append(merged)
-        self._lora_shared = {"scale": self._lora_shared["scale"],
-                             "layers": new_layers}
+        self._lora_shared = self._place_lora(
+            {"scale": self._lora_shared["scale"],
+             "layers": new_layers})
         del self._adapter_index[name]
         # The id will be recycled: stale cached KV under it must go
         # before a future adapter can collide with its chain keys.
@@ -1274,6 +1337,30 @@ class ContinuousBatchingServer:
         """Layout hook: drop any cached state keyed by this stacked
         adapter id (the paged prefix cache overrides this; the
         contiguous layout caches nothing across requests)."""
+
+    def _register_adapter_pages(self, name: str, adapter) -> int:
+        """Layout hook: mirror a loaded adapter's factors into paged
+        storage so it stays warm across unloads (the paged layout
+        overrides this; the contiguous layout has no pool)."""
+        return 0
+
+    def _fetch_adapter_pages(self, name: str):
+        """Layout hook: recover ``(lora_params, LoRAConfig)`` for a
+        previously paged adapter, or None when cold (the paged layout
+        overrides this; the contiguous layout never pages)."""
+        return None
+
+    def _place_lora(self, lora_shared):
+        """Layout hook: place the stacked adapter tree for the serving
+        programs.  Single chip: host tree as-is.  Contiguous layout
+        under a replica mesh: REPLICATE the factors — the GSPMD
+        programs then compute every rank-r delta identically on each
+        device (exact; the factors are tiny).  The paged layout
+        overrides with the TPEngine's explicit column sharding
+        (:func:`~..models.llama_tp.shard_lora`)."""
+        if lora_shared is not None and self._mesh is not None:
+            return self._llama_tp.replicate(lora_shared, self._mesh)
+        return lora_shared
 
     def _make_lora(self, ids):
         """Assemble the batched lora argument for per-row adapter
@@ -2157,7 +2244,7 @@ class ContinuousBatchingServer:
         session = profiler.request(
             out_dir=out_dir, steps=steps, reason=reason,
             trace_id=trace_id,
-            service=f"srv{id(self) & 0xffff:x}")
+            service=f"srv{self._instance_id}")
         return session is not None
 
     def _profiled_step(self) -> None:
@@ -2672,6 +2759,15 @@ class ContinuousReplica(Actor):
         for phase, hist in hists.items():
             if hist.count:
                 updates[f"hist.{phase}"] = hist.encode()
+        slot_counts = self.server.adapter_slot_counts() \
+            if hasattr(self.server, "adapter_slot_counts") else {}
+        if slot_counts:
+            # Per-adapter slot occupancy for the dashboard's adapter
+            # pane — ``name=count`` pairs, space-joined like
+            # ``slow_requests``.
+            updates["adapter_slots"] = " ".join(
+                f"{name}={count}"
+                for name, count in slot_counts.items())
         if self._slow:
             updates["slow_requests"] = " ".join(
                 f"{request_id}:{total_ms}:" + ",".join(

@@ -63,8 +63,10 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
+from ..kvstore import adapters as _kvadp
 from ..kvstore import directory as _kvdir
 from ..kvstore import transfer as _kvxfer
+from ..models import lora_paged as _lorapg
 from ..obs import compiles, pool_audit, steplog
 from ..runtime import faults as _faults
 from ..runtime.lease import Lease
@@ -403,6 +405,15 @@ class PagedContinuousServer(ContinuousBatchingServer):
             kv_hbm_bytes=(self.total_blocks - len(self._free))
             * self._block_nbytes(),
         )
+        pages = self._adapter_page_counts()
+        out.update(
+            adapter_pages_hbm=pages["hbm"],
+            adapter_pages_host=pages["host"],
+            adapter_pages_disk=pages["disk"],
+            adapter_warm_loads=self.adapter_warm_loads,
+            adapter_cold_loads=self.adapter_cold_loads,
+            adapters_loaded_count=len(self._adapter_index),
+        )
         if pool_audit.AUDITOR is not None:
             out.update(
                 kv_audit_sweeps=pool_audit.AUDITOR.sweeps,
@@ -508,6 +519,13 @@ class PagedContinuousServer(ContinuousBatchingServer):
                 block_bytes=draft_block_bytes,
                 total_blocks=self.total_blocks,
                 blocks=used, bytes=used * draft_block_bytes)
+        # Multi-tenant adapter view: weight-page residency per tier
+        # (ADAPTER_SEED keys — a subset of the tier totals above, not
+        # a new tier) and per-adapter live slot occupancy from the
+        # host-side id mirror.  No device sync.
+        adapter_section = dict(
+            pages=self._adapter_page_counts(),
+            slots=self.adapter_slot_counts())
         return dict(
             ts=time.time(), dtype=dtype, block_bytes=block_bytes,
             total_blocks=self.total_blocks,
@@ -515,6 +533,7 @@ class PagedContinuousServer(ContinuousBatchingServer):
             restore_queue_depth=len(self._restoring),
             adopted_chains=len(self._adopted_keys),
             draft=draft_section,
+            adapters=adapter_section,
             tiers=dict(
                 hbm=dict(blocks=used, bytes=used * block_bytes),
                 host=dict(blocks=len(self._host),
@@ -634,13 +653,15 @@ class PagedContinuousServer(ContinuousBatchingServer):
         With a host tier (or spill tier) configured, eviction DEMOTES
         instead of deleting: the block's rows copy down the tower and
         the chain key stays addressable (restored on the next hit).
-        Adapter-seeded chains still delete — their stacked indices are
-        replica-local and hot unload must be able to purge them
-        synchronously."""
+        Positive-seeded KV chains (per-request adapter KV) still
+        delete — their stacked indices are replica-local and hot
+        unload must be able to purge them synchronously.  Adapter
+        WEIGHT pages (``ADAPTER_SEED``) demote like base KV: a cold
+        adapter sinking down the tower is the unified-paging win."""
         for key, block in self._evictable.items():          # LRU order
             if self._children.get(key, 0) == 0:
                 if self._tier_enabled() \
-                        and self._key_seed.get(key, 0) == 0:
+                        and self._key_seed.get(key, 0) <= 0:
                     self._demote(key, block)
                 else:
                     self._purge_cached(key, block)
@@ -704,7 +725,8 @@ class PagedContinuousServer(ContinuousBatchingServer):
         crash-consistent block group (kvstore/spill.py: every file
         staged + fsync'd, then renamed) — the tower's bottom rung.
         Entries the spill cannot take (no store, store disabled by a
-        write error, adapter-seeded) purge for good.  Disk overflow
+        write error, positive-seeded per-request adapter KV) purge
+        for good.  Disk overflow
         then drops the oldest-clock remnant, keeping the same
         leaf-first rootedness the host tier's ordering gives."""
         excess = []
@@ -715,7 +737,7 @@ class PagedContinuousServer(ContinuousBatchingServer):
         spilled = self._spill_entries(
             [(key, entry) for key, entry in excess
              if self.spill is not None and self.spill.enabled
-             and self._key_seed.get(key, 0) == 0])
+             and self._key_seed.get(key, 0) <= 0])
         for key, entry in excess:
             if key in spilled:
                 self._spill[key] = {"nbytes": entry["nbytes"],
@@ -743,7 +765,7 @@ class PagedContinuousServer(ContinuousBatchingServer):
             group.append((key.hex(), dict(
                 parent=parent.hex() if parent is not None else "",
                 depth=int(self._depth.get(key, 0)),
-                key_seed=0,
+                key_seed=int(self._key_seed.get(key, 0)),
                 hits=int(self._key_hits.get(key, 0)),
                 clock=int(entry.get("clock", 0))), entry["rows"]))
         if not self.spill.put_group(group):
@@ -873,7 +895,9 @@ class PagedContinuousServer(ContinuousBatchingServer):
         by_hex: Dict[str, dict] = {}
         for meta in metas:
             hex_key = str(meta.get("key", ""))
-            if len(hex_key) == 64 and meta.get("key_seed", 0) == 0 \
+            if len(hex_key) == 64 \
+                    and meta.get("key_seed", 0) \
+                    in (0, _kvadp.ADAPTER_SEED) \
                     and int(meta.get("depth", 0)) >= 1:
                 by_hex[hex_key] = meta
         adopted: Dict[str, dict] = {}
@@ -891,7 +915,9 @@ class PagedContinuousServer(ContinuousBatchingServer):
             key = bytes.fromhex(hex_key)
             depth = int(meta["depth"])
             self._depth[key] = depth
-            self._key_seed[key] = 0
+            # Adapter weight pages re-adopt under their sentinel seed,
+            # so a crash restart is a WARM start for adapters too.
+            self._key_seed[key] = int(meta.get("key_seed", 0))
             self._key_hits[key] = int(meta.get("hits", 0))
             self._hex_key[hex_key[:_kvdir.HEX_KEY_CHARS]] = key
             parent_hex = meta.get("parent", "")
@@ -1143,7 +1169,7 @@ class PagedContinuousServer(ContinuousBatchingServer):
         demote = []
         for key, block in self._select_victims(want):
             if self._tier_enabled() \
-                    and self._key_seed.get(key, 0) == 0:
+                    and self._key_seed.get(key, 0) <= 0:
                 demote.append((key, block))
             else:
                 self._purge_cached(key, block)
@@ -1305,6 +1331,17 @@ class PagedContinuousServer(ContinuousBatchingServer):
                         self._children.get(parent, 0) + 1
         return True
 
+    def _place_lora(self, lora_shared):
+        """Paged layout under a replica mesh: the stacked factors lay
+        out with the TPEngine's column sharding — A + scale replicated,
+        B sharded on its output axis like the base weight it adapts
+        (:func:`~..models.llama_tp.shard_lora`) — so the shard_map
+        programs take them as global arrays with exact local slices."""
+        if lora_shared is not None and self._mesh is not None:
+            return self._llama_tp.shard_lora(
+                lora_shared, self._mesh, self.replica_mesh.axis)
+        return lora_shared
+
     def _invalidate_adapter_cache(self, index: int) -> None:
         """Hot unload/replace: purge every cached chain seeded by this
         stacked adapter id — its KV was computed with weights that no
@@ -1318,6 +1355,189 @@ class PagedContinuousServer(ContinuousBatchingServer):
             block = self._index.get(key)
             if block is not None and not self._refs.get(block, 0):
                 self._purge_cached(key, block)
+
+    # ------------------------------------------------------------- #
+    # Paged adapter storage (multi-tenant LoRA — S-LoRA's unified
+    # paging).  An adapter's packed A/B factor bytes (models/
+    # lora_paged.py) live as name-keyed chain pages in the SAME pool
+    # as KV under ``_key_seed == ADAPTER_SEED``: census-visible,
+    # booked through the 12 accountant flows, demoted/spilled/
+    # restored/adopted by the exact tier machinery above.  Decode
+    # NEVER reads these pages — serving always runs from the stacked
+    # ``_lora_shared`` copy, so page movement is invisible to traced
+    # programs (ARCHITECTURE invariant 21).  The payoff: an unloaded
+    # adapter stays warm in some tier, `load_adapter(name)` restacks
+    # it from pages with no client re-upload, and the digest's
+    # adapter flag lets routers steer tenants at warm replicas.
+
+    def _adapter_page_counts(self) -> Dict[str, int]:
+        """ADAPTER_SEED page residency per tier — a subset of the
+        census tier totals, never a new tier."""
+        counts = dict(hbm=0, host=0, disk=0)
+        for key, seed in self._key_seed.items():
+            if seed != _kvadp.ADAPTER_SEED:
+                continue
+            if key in self._index:
+                counts["hbm"] += 1
+            elif key in self._host:
+                counts["host"] += 1
+            elif key in self._spill:
+                counts["disk"] += 1
+        return counts
+
+    def _register_adapter_pages(self, name: str, adapter) -> int:
+        """Layout hook (``load_adapter`` calls it after the stack
+        commit): mirror the adapter's canonical packed bytes into
+        pool pages.  Best-effort by design — a pool too tight to hold
+        the pages changes nothing (the stacked copy serves; the
+        adapter is just not warm-reloadable)."""
+        if not self.enable_prefix_cache or self._lora_config is None:
+            return 0
+        data = _lorapg.pack_adapter(self.config, self._lora_config,
+                                    adapter)
+        return self.store_adapter_bytes(name, data)
+
+    def store_adapter_bytes(self, name: str, data) -> int:
+        """Write one packed adapter stream into freshly allocated
+        pool pages keyed by ``name``'s chain, replacing any stale
+        chain first.  Pages register zero-ref EVICTABLE (MRU end):
+        from here on the shared eviction clock owns them.  Returns
+        the page count (0 = pool too tight right now)."""
+        layout = _kvxfer._field_layout(self)
+        pages = _lorapg.split_pages(
+            data, _lorapg.page_payload_nbytes(layout))
+        if not pages:
+            return 0
+        keys = _kvadp.adapter_chain_keys(name, len(pages))
+        self.drop_adapter_pages(name)
+        needed = len(pages)
+        self._evict_until(needed)
+        if needed > len(self._free):
+            return 0
+        blocks = [self._free.pop() for _ in range(needed)]
+        self._flow("alloc", needed)
+        _kvxfer.scatter_block_row_dicts(
+            self, blocks,
+            [_lorapg.payload_to_row_dict(page, layout)
+             for page in pages])
+        for position, (key, block) in enumerate(zip(keys, blocks)):
+            self._host_discard(key)   # a key never resolves two ways
+            self._index[key] = block
+            self._block_key[block] = key
+            self._refs[block] = 0
+            self._key_seed[key] = _kvadp.ADAPTER_SEED
+            self._depth[key] = position + 1
+            self._key_hits.setdefault(key, 0)
+            self._hex_key[key.hex()[:_kvdir.HEX_KEY_CHARS]] = key
+            if position > 0:
+                parent = keys[position - 1]
+                self._parent[key] = parent
+                self._children[parent] = \
+                    self._children.get(parent, 0) + 1
+            self._evictable[key] = block
+        return needed
+
+    def drop_adapter_pages(self, name: str) -> int:
+        """Purge ``name``'s page chain from every tier (weight
+        replacement under the same name — stale bytes must never
+        warm-load).  Plain unload does NOT call this: leaving pages
+        resident is the warm-pool win."""
+        dropped = 0
+        for key in _kvadp.adapter_key_iter(name):
+            if self._key_seed.get(key) != _kvadp.ADAPTER_SEED:
+                break
+            block = self._index.get(key)
+            if block is not None:
+                if self._refs.get(block, 0) \
+                        or block in self._producing:
+                    break          # defensive: never yank a busy page
+                self._purge_cached(key, block)
+            elif key in self._host:
+                self._purge_host_entry(key, self._host.pop(key))
+            elif key in self._spill:
+                self._purge_spill_entry(key, self._spill.pop(key))
+            else:
+                break
+            dropped += 1
+        return dropped
+
+    def _adapter_page_bytes(self, key) -> Optional[np.ndarray]:
+        """One page's bytes from whichever tier holds it (gathered
+        pool rows, a host entry's row dict, and the spill store's
+        wire rows all view to the same bytes — transfer.py's
+        byte-transparency).  None when absent or checksum-tripped."""
+        layout = _kvxfer._field_layout(self)
+        block = self._index.get(key)
+        if block is not None and block not in self._producing:
+            rows = _kvxfer.gather_block_rows(self, [block])
+            return _lorapg.row_dict_to_payload(
+                {name: stack[0] for name, stack in rows.items()},
+                layout)
+        entry = self._host.get(key)
+        if entry is not None:
+            return _lorapg.row_dict_to_payload(entry["rows"], layout)
+        if key in self._spill:
+            rows = self._spill_rows(key)
+            if rows is not None:
+                return _lorapg.row_dict_to_payload(rows, layout)
+        return None
+
+    def fetch_adapter_bytes(self, name: str) -> Optional[np.ndarray]:
+        """Reassemble ``name``'s packed stream from pages in ANY mix
+        of tiers.  Page 1's self-describing header bounds the walk;
+        any missing page degrades to None (cold load — a partially
+        purged chain never yields bytes)."""
+        first = self._adapter_page_bytes(
+            _kvadp.adapter_page_key(name, 0))
+        if first is None:
+            return None
+        try:
+            header_nbytes, payload_nbytes, _cfg = \
+                _lorapg.parse_header(first)
+        except ValueError:
+            return None
+        total = header_nbytes + payload_nbytes
+        count = _lorapg.page_count(
+            total, _lorapg.page_payload_nbytes(
+                _kvxfer._field_layout(self)))
+        pages = [first]
+        for position in range(1, count):
+            page = self._adapter_page_bytes(
+                _kvadp.adapter_page_key(name, position))
+            if page is None:
+                return None
+            pages.append(page)
+        for key in _kvadp.adapter_chain_keys(name, count):
+            self._key_hits[key] = self._key_hits.get(key, 0) + 1
+        return _lorapg.join_pages(pages)[:total]
+
+    def _fetch_adapter_pages(self, name: str):
+        """Layout hook: the warm ``load_adapter(name)`` path —
+        ``(lora_params, LoRAConfig)`` restacked from resident pages,
+        or None (cold: the caller must supply factors)."""
+        data = self.fetch_adapter_bytes(name)
+        if data is None:
+            return None
+        return _lorapg.unpack_adapter(self.config, data)
+
+    def adapter_residency(self, name: str) -> Optional[int]:
+        """Worst tier across ``name``'s resident page chain (0=HBM,
+        1=host, 2=disk) or None when page 1 is gone.  Best-effort —
+        a mid-chain purge surfaces at fetch time, not here."""
+        worst = None
+        for key in _kvadp.adapter_key_iter(name):
+            if self._key_seed.get(key) != _kvadp.ADAPTER_SEED:
+                break
+            if key in self._index:
+                tier = 0
+            elif key in self._host:
+                tier = 1
+            elif key in self._spill:
+                tier = 2
+            else:
+                break
+            worst = tier if worst is None else max(worst, tier)
+        return worst
 
     def _prefill_and_insert(self, admissions) -> None:
         """Append-attention admission: each request's chunk K/V lands
@@ -1390,7 +1610,8 @@ class PagedContinuousServer(ContinuousBatchingServer):
             if self._tp_engine is not None:
                 _, self.pool = self._tp_engine.prefill_append_paged(
                     self.params, jnp.asarray(chunk), self.pool,
-                    tables_row, jnp.int32(start), kv_limit=kv_limit)
+                    tables_row, jnp.int32(start), lora=lora,
+                    kv_limit=kv_limit)
             else:
                 _, self.pool = llama.prefill_append_paged(
                     self.params, jnp.asarray(chunk), self.pool,
@@ -1505,6 +1726,7 @@ class PagedContinuousServer(ContinuousBatchingServer):
             width = sp_width or self._next_slice_width(state)
             chunk = state["prompt_padded"][:, start:start + width]
             tables_row = jnp.asarray(self.tables[slot:slot + 1])
+            lora = self._request_lora(state["request"])
             if sp_width:
                 if compiles.LEDGER is not None:
                     # ONE window shape per (sp, cap) — the sp ladder
@@ -1514,19 +1736,19 @@ class PagedContinuousServer(ContinuousBatchingServer):
                         f"sp{self._tp_engine.sp}w{width}")
                 _, self.pool = self._tp_engine.prefill_append_sp(
                     self.params, jnp.asarray(chunk), self.pool,
-                    tables_row, jnp.int32(start),
+                    tables_row, jnp.int32(start), lora=lora,
                     kv_limit=state["kv_limit"])
                 self.counters["sp_prefill_dispatches"] += 1
             elif self._tp_engine is not None:
                 _, self.pool = self._tp_engine.prefill_append_paged(
                     self.params, jnp.asarray(chunk), self.pool,
-                    tables_row, jnp.int32(start),
+                    tables_row, jnp.int32(start), lora=lora,
                     kv_limit=state["kv_limit"])
             else:
                 _, self.pool = llama.prefill_append_paged(
                     self.params, jnp.asarray(chunk), self.pool,
                     tables_row, jnp.int32(start), self.config,
-                    lora=self._request_lora(state["request"]),
+                    lora=lora,
                     kv_limit=state["kv_limit"], compute_logits=False)
             state["start"] = start + width
             self._note_prefill(width)
@@ -1590,30 +1812,46 @@ class PagedContinuousServer(ContinuousBatchingServer):
                 w *= 2
             if sp > 1 and sp * cap <= bucket:
                 widths.append(sp * cap)
+            # With adapters stacked, every width warms BOTH programs:
+            # the adapter-free one (base requests keep it) and the
+            # lora-gather one — an adapter request hitting a fresh
+            # offset mid-traffic must not compile.  The warm lora uses
+            # id 0 (the identity row): shapes, not values, key the
+            # compile, and the masked writes land in scratch block 0
+            # either way.
+            loras = [None]
+            if self._lora_shared is not None:
+                loras.append(dict(ids=jnp.zeros((1,), jnp.int32),
+                                  **self._lora_shared))
             for width in widths:
                 is_window = width > cap
                 tokens = jnp.zeros((1, width), jnp.int32)
-                if compiles.LEDGER is not None:
-                    compiles.set_label(
-                        "paged_prefill",
-                        f"sp{sp}w{width}" if is_window
-                        else f"w{width}")
-                if is_window:
-                    _, self.pool = self._tp_engine.prefill_append_sp(
-                        self.params, tokens, self.pool, tables_row,
-                        jnp.int32(0), kv_limit=kv_limit)
-                elif self._tp_engine is not None:
-                    _, self.pool = \
-                        self._tp_engine.prefill_append_paged(
-                            self.params, tokens, self.pool,
-                            tables_row, jnp.int32(0),
-                            kv_limit=kv_limit)
-                else:
-                    _, self.pool = self._llama.prefill_append_paged(
-                        self.params, tokens, self.pool, tables_row,
-                        jnp.int32(0), self.config, kv_limit=kv_limit,
-                        compute_logits=False)
-                dispatched += 1
+                for lora in loras:
+                    if compiles.LEDGER is not None:
+                        compiles.set_label(
+                            "paged_prefill",
+                            f"sp{sp}w{width}" if is_window
+                            else f"w{width}")
+                    if is_window:
+                        _, self.pool = \
+                            self._tp_engine.prefill_append_sp(
+                                self.params, tokens, self.pool,
+                                tables_row, jnp.int32(0), lora=lora,
+                                kv_limit=kv_limit)
+                    elif self._tp_engine is not None:
+                        _, self.pool = \
+                            self._tp_engine.prefill_append_paged(
+                                self.params, tokens, self.pool,
+                                tables_row, jnp.int32(0), lora=lora,
+                                kv_limit=kv_limit)
+                    else:
+                        _, self.pool = \
+                            self._llama.prefill_append_paged(
+                                self.params, tokens, self.pool,
+                                tables_row, jnp.int32(0), self.config,
+                                lora=lora, kv_limit=kv_limit,
+                                compute_logits=False)
+                    dispatched += 1
         return dispatched
 
     def _release_slot(self, slot: int) -> None:
@@ -1661,7 +1899,7 @@ class PagedContinuousServer(ContinuousBatchingServer):
                     self._tp_engine.serve_chunk_paged(
                         self.params, state, self.pool, steps,
                         eos_id=eos_id, sampled=sampled,
-                        rng_key=rng_key)
+                        rng_key=rng_key, lora_shared=lora_shared)
             else:
                 tokens_d, counts_d, new_state, self.pool = \
                     llama.serve_chunk_paged(
@@ -1687,6 +1925,7 @@ class PagedContinuousServer(ContinuousBatchingServer):
                     self.params, state, self.pool, jnp.asarray(chunk),
                     jnp.int32(slot), jnp.int32(start), steps,
                     eos_id=eos_id, sampled=sampled, rng_key=rng_key,
+                    lora_shared=lora_shared,
                     prefill_kv_limit=prefill["kv_limit"],
                     sp_shard=True)
             self.counters["sp_prefill_dispatches"] += 1
@@ -1696,6 +1935,7 @@ class PagedContinuousServer(ContinuousBatchingServer):
                     self.params, state, self.pool, jnp.asarray(chunk),
                     jnp.int32(slot), jnp.int32(start), steps,
                     eos_id=eos_id, sampled=sampled, rng_key=rng_key,
+                    lora_shared=lora_shared,
                     prefill_kv_limit=prefill["kv_limit"])
         else:
             tokens_d, counts_d, new_state, self.pool = \
@@ -1726,7 +1966,7 @@ class PagedContinuousServer(ContinuousBatchingServer):
         if self._tp_engine is not None:
             logits, self.pool = self._tp_engine.verify_chunk_paged(
                 self.params, chunk, self.pool, st["tables"],
-                st["positions"], st["active"])
+                st["positions"], st["active"], lora=lora)
             return logits
         logits, self.pool = self._llama.verify_chunk_paged(
             self.params, chunk, self.pool, st["tables"],
@@ -1827,7 +2067,8 @@ class PagedContinuousServer(ContinuousBatchingServer):
                       migrating: bool = False) -> str:
         """Compact advertisement of this replica's cached prefix
         blocks for the cluster directory: content-complete (not
-        producing), base-adapter chains only, hottest + deepest first,
+        producing), base-model KV chains plus one flagged root entry
+        per warm adapter page chain, hottest + deepest first,
         capped at ``max_entries`` (the EC share rides MQTT control
         topics — the digest must stay small).  Host-tier entries
         advertise with ``tier=1`` and spilled entries with ``tier=2``
@@ -1835,24 +2076,33 @@ class PagedContinuousServer(ContinuousBatchingServer):
         router prices each rung: HBM hit > host restore > disk
         restore > recompute."""
         entries = []
+
+        def _entry(key, refs, tier, adopted=0):
+            # Positive seeds (per-request adapter KV) never leave the
+            # replica.  ADAPTER_SEED pages advertise their chain ROOT
+            # only, flagged in the 8th wire field — holding page 1
+            # implies the whole chain (lora_paged header walk), and
+            # one digest slot per warm adapter keeps the EC share
+            # small.
+            seed = self._key_seed.get(key, 0)
+            if seed > 0:
+                return
+            adapter = seed == _kvadp.ADAPTER_SEED
+            depth = self._depth.get(key, 0)
+            if adapter and depth != 1:
+                return
+            entries.append((key.hex()[:_kvdir.HEX_KEY_CHARS],
+                            depth, refs, self._key_hits.get(key, 0),
+                            tier, adopted, 0, int(adapter)))
+
         for key, block in self._index.items():
             if block in self._producing:
                 continue
-            if self._key_seed.get(key, 0) != 0:
-                continue        # adapter indices are replica-local
-            entries.append((key.hex()[:_kvdir.HEX_KEY_CHARS],
-                            self._depth.get(key, 0),
-                            self._refs.get(block, 0),
-                            self._key_hits.get(key, 0), 0))
+            _entry(key, self._refs.get(block, 0), 0)
         for key in self._host:
-            entries.append((key.hex()[:_kvdir.HEX_KEY_CHARS],
-                            self._depth.get(key, 0), 0,
-                            self._key_hits.get(key, 0), 1))
+            _entry(key, 0, 1)
         for key in self._spill:
-            entries.append((key.hex()[:_kvdir.HEX_KEY_CHARS],
-                            self._depth.get(key, 0), 0,
-                            self._key_hits.get(key, 0), 2,
-                            1 if key in self._adopted_keys else 0))
+            _entry(key, 0, 2, 1 if key in self._adopted_keys else 0)
         entries.sort(key=lambda e: (-e[3], -e[1], e[0]))
         return _kvdir.digest_encode(self.block_size, role,
                                     entries[:max_entries],

@@ -104,6 +104,12 @@ TELEMETRY_KEYS = (
     # paged server, audit counters only when an AUDITOR is installed)
     "kv_hbm_blocks", "kv_hbm_bytes",
     "kv_audit_sweeps", "kv_audit_violations",
+    # Multi-tenant adapters (PR 20): paged adapter-weight residency per
+    # tier plus warm-vs-cold load provenance, so the dashboard's
+    # adapter pane and the loadgen A/B read the same counters.
+    "adapter_pages_hbm", "adapter_pages_host", "adapter_pages_disk",
+    "adapter_warm_loads", "adapter_cold_loads",
+    "adapters_loaded_count",
 )
 
 
@@ -204,6 +210,7 @@ class ReplicaRouter(Actor):
                  prefix_alpha: float = 1.0,
                  host_prefix_weight: float = 0.5,
                  disk_prefix_weight: float = 0.25,
+                 adapter_affinity: float = 1.0,
                  kv_transfer: bool = False,
                  disaggregate: bool = False,
                  directory_lease_s: float = 30.0,
@@ -237,6 +244,18 @@ class ReplicaRouter(Actor):
         #: prices it below a host hit and still above a recompute —
         #: the tower's full ordering HBM > host > disk > nothing.
         self.disk_prefix_weight = disk_prefix_weight
+        #: Adapter-locality weight (multi-tenant LoRA serving): a
+        #: candidate whose digest advertises the request's adapter
+        #: scores an extra ``adapter_affinity`` when the factors sit
+        #: in HBM, discounted by ``host_prefix_weight`` /
+        #: ``disk_prefix_weight`` for demoted/spilled copies — the
+        #: same tier pricing as prefix blocks, because restoring a
+        #: paged adapter rides the same promotion machinery.  A warm
+        #: adapter ANYWHERE beats a cold one: when no prefix matches
+        #: at all, the route still prefers a warm-adapter replica over
+        #: plain P2C.  0 disables adapter-aware routing (adapter-blind
+        #: baseline for the loadgen A/B).
+        self.adapter_affinity = adapter_affinity
         #: Attach ``kv_source`` warm-start hints when the prefix
         #: owner is not the chosen target (opt-in: transfers cost
         #: wire bytes; prefix AFFINITY alone is free).
@@ -285,6 +304,7 @@ class ReplicaRouter(Actor):
             deadline_exceeded=0, cancel_unrouted=0,
             prefix_routed=0, prefix_routed_host=0,
             prefix_routed_disk=0, kv_tier_hints=0, kv_remote_hints=0,
+            adapter_warm_routes=0, adapter_cold_routes=0,
             anomaly_flags=0, fleet_captures=0, fleet_profiles=0,
             fleet_steady_compiles=0, fleet_censuses=0,
             fleet_audit_violations=0,
@@ -754,19 +774,54 @@ class ReplicaRouter(Actor):
         return {bs: chain_keys_hex(tokens, bs)
                 for bs in sizes if bs}
 
-    def _pick_prefix(self, candidates: List[str], payload):
-        """Score ``queue_depth − α·effective_matched_blocks`` (lower
-        wins; ties break by replica order for determinism), where a
-        matched block advertised in the HOST tier contributes
+    def _request_adapter_hex(self, payload) -> Optional[str]:
+        """Directory-width root key of the request's named adapter
+        (``payload["adapter"]``), or None for base-model requests —
+        the name alone determines the key (kvstore/adapters.py), so
+        the router never needs the factor bytes."""
+        if not payload or "adapter" not in payload:
+            return None
+        try:
+            name = decode_value(payload["adapter"])
+        except Exception:  # noqa: BLE001 - malformed → adapter-blind
+            return None
+        if not name:
+            return None
+        from ..kvstore.adapters import adapter_hex
+        return adapter_hex(str(name))
+
+    def _adapter_weights(self, candidates: List[str],
+                         adapter_hex: str, now) -> Dict[str, float]:
+        """Tier-weighted warmth of one adapter per candidate: 1.0 for
+        factors advertised in HBM, ``host_prefix_weight`` /
+        ``disk_prefix_weight`` for demoted / spilled copies, 0.0 when
+        the replica has no paged copy at all."""
+        tier_weight = (1.0, self.host_prefix_weight,
+                       self.disk_prefix_weight)
+        weights = {}
+        for replica in candidates:
+            tier = self.directory.adapter_tier(replica, adapter_hex,
+                                               now)
+            weights[replica] = tier_weight[tier] \
+                if tier is not None and tier < 3 else 0.0
+        return weights
+
+    def _pick_prefix(self, candidates: List[str], payload,
+                     adapter_weights: Optional[Dict[str, float]]
+                     = None):
+        """Score ``queue_depth − α·effective_matched_blocks −
+        adapter_affinity·adapter_warmth`` (lower wins; ties break by
+        replica order for determinism), where a matched block
+        advertised in the HOST tier contributes
         ``host_prefix_weight`` of an HBM block and one in the DISK
         tier ``disk_prefix_weight`` — each rung of the tower is
         cheaper than a recompute but dearer than the rung above, and
         the placement decision should reflect that.  Returns
         ``(target, owner, owner_matched, target_matched,
         target_host_matched, target_disk_matched)`` or None when
-        nothing matches — the caller falls back to EXACT P2C, so
-        fleets without paged prefix caches see PR-4 routing
-        unchanged."""
+        nothing matches — the caller falls back to an adapter-only
+        pick (warm adapter, no prefix) and then EXACT P2C, so fleets
+        without paged prefix caches see PR-4 routing unchanged."""
         if self.prefix_alpha <= 0 or not payload \
                 or not self.directory.size:
             return None
@@ -796,7 +851,10 @@ class ReplicaRouter(Actor):
 
         def score(replica):
             depth = self._loads.get(replica, {}).get("queue_depth", 0)
-            return depth - self.prefix_alpha * effective(replica)
+            warmth = adapter_weights.get(replica, 0.0) \
+                if adapter_weights else 0.0
+            return depth - self.prefix_alpha * effective(replica) \
+                - self.adapter_affinity * warmth
 
         target = min(candidates, key=lambda r: (score(r), r))
         owner = max(candidates,
@@ -860,9 +918,36 @@ class ReplicaRouter(Actor):
                        parent=ctx)
             return False
         decode = self._decode_candidates(candidates)
-        picked = self._pick_prefix(decode, payload)
+        adapter_hex = self._request_adapter_hex(payload) \
+            if self.adapter_affinity > 0 else None
+        adapter_weights = None
+        if adapter_hex is not None and self.directory.size:
+            weights = self._adapter_weights(
+                decode, adapter_hex, self.process.event.now())
+            warm = [r for r in decode if weights.get(r, 0.0) > 0]
+            if warm:
+                # A cold landing is not a SLOW request but a FAILED
+                # one (``unknown_adapter`` → the tenant re-uploads
+                # factors), so adapter warmth is a hard preference,
+                # not a score bonus load can outbid: restrict the
+                # candidate set to warm replicas and let prefix
+                # affinity, load, and tier order THEM — zero cold
+                # starts whenever the adapter is warm anywhere.
+                decode = warm
+                adapter_weights = weights
+            # Cold everywhere: route blind — any replica costs the
+            # same upload.
+        picked = self._pick_prefix(decode, payload, adapter_weights)
         if picked is None:
-            target = self._pick(decode)
+            if adapter_weights:
+                # No prefix match: among the warm replicas, trade
+                # queue depth against the copy's tier (an HBM-resident
+                # adapter beats one needing a restore from host/disk).
+                target = min(decode, key=lambda r: (
+                    self._loads.get(r, {}).get("queue_depth", 0)
+                    - self.adapter_affinity * adapter_weights[r], r))
+            else:
+                target = self._pick(decode)
             owner = owner_matched = target_matched = None
             target_host = target_disk = 0
         else:
@@ -875,6 +960,17 @@ class ReplicaRouter(Actor):
                 self._bump("prefix_routed_host")
             if target_disk:
                 self._bump("prefix_routed_disk")
+        if adapter_hex is not None:
+            # Provenance of every adapter-tagged route: did the chosen
+            # target already hold the factors (any tier), or does this
+            # request pay the cold-start?  The loadgen A/B asserts the
+            # aware router's cold count is ZERO when the adapter is
+            # warm anywhere in the fleet.
+            if adapter_weights is not None \
+                    and adapter_weights.get(target, 0.0) > 0:
+                self._bump("adapter_warm_routes")
+            else:
+                self._bump("adapter_cold_routes")
         send_payload = payload or {}
         if target_host or target_disk:
             # Tier-aware prefetch: tell the target NOW that this

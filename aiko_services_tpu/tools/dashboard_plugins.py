@@ -143,6 +143,36 @@ def _memory_pane(variables: Dict) -> List[str]:
     return lines
 
 
+def _adapter_pane(variables: Dict) -> List[str]:
+    """Multi-tenant adapter pane: loaded adapter names, paged factor
+    residency per tier (the pages live in the SAME audited pool as
+    KV), warm-vs-cold load provenance, and per-adapter live slot
+    occupancy off the ``adapter_slots`` share.  Renders only when the
+    replica serves adapters at all."""
+    adapters = _get(variables, "adapters", default=None)
+    if adapters in (None, "-", ""):
+        return []
+    lines = [f"  adapters:  {adapters}"]
+    pages_hbm = _get(variables, "adapter_pages_hbm", default=None)
+    if pages_hbm not in (None, "-"):
+        lines.append(
+            f"    pages:   {pages_hbm or 0} hbm / "
+            f"{_get(variables, 'adapter_pages_host', default=0)}"
+            f" host / "
+            f"{_get(variables, 'adapter_pages_disk', default=0)}"
+            f" disk (shared kv pool)")
+        lines.append(
+            f"    loads:   "
+            f"{_get(variables, 'adapter_warm_loads', default=0)}"
+            f" warm / "
+            f"{_get(variables, 'adapter_cold_loads', default=0)}"
+            f" cold uploads")
+    slots = _get(variables, "adapter_slots", default=None)
+    if slots not in (None, "-", ""):
+        lines.append("    slots:   " + str(slots))
+    return lines
+
+
 #: Bar width for the slowest-requests phase breakdown.
 _BAR_CELLS = 20
 _PHASE_ORDER = ("queue", "kv_restore", "prefill", "decode")
@@ -338,9 +368,7 @@ def model_replica_plugin(fields, variables) -> List[str]:
                     f" jump-forward tok, "
                     f"{_get(variables, 'spec_ngram_hits', default=0)}"
                     f" ngram hits")
-    adapters = _get(variables, "adapters", default=None)
-    if adapters not in (None, "-", ""):
-        lines.append(f"  adapters:  {adapters}")
+    lines += _adapter_pane(variables)
     ttft = _get(variables, "ttft_p50_ms", default=None)
     ttft95 = _get(variables, "ttft_p95_ms", default=None)
     total = _get(variables, "total_p50_ms", default=None)
@@ -443,6 +471,15 @@ def replica_router_plugin(fields, variables) -> List[str]:
             f"  kv dir:     {directory} advertised blocks, "
             f"{_get(variables, 'kv_remote_hints', default=0)}"
             f" transfer hints")
+    # Adapter-aware routing (multi-tenant LoRA): warm-vs-cold split
+    # over adapter-tagged routes.
+    warm_routes = _get(variables, "adapter_warm_routes", default=None)
+    cold_routes = _get(variables, "adapter_cold_routes", default=None)
+    if any(value not in (None, "-", 0)
+           for value in (warm_routes, cold_routes)):
+        lines.append(
+            f"  adapters:   {warm_routes or 0} warm-routed / "
+            f"{cold_routes or 0} cold (no paged copy in fleet)")
     # Fleet memory pane (PR 15): per-tier byte totals folded from
     # every replica's accountant broadcast, plus the prefix-routing
     # hbm/host split that used to live on the kv dir line.

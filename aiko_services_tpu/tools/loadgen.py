@@ -145,6 +145,18 @@ class LoadReport:
     #: ``PagedContinuousServer.pool_census()``; None on non-paged
     #: fleets.  :meth:`pool_census` renders it.
     census: Optional[Dict] = None
+    #: Multi-tenant adapters: client-observed cold starts — an
+    #: ``unknown_adapter`` rejection is a request that landed on a
+    #: replica without the tenant's factors and would force a factor
+    #: re-upload before retry.  The adapter-aware arm of the
+    #: multitenant A/B asserts this is ZERO whenever the adapter is
+    #: warm anywhere in the fleet.
+    adapter_cold_starts: int = 0
+    #: Router's warm/cold split over adapter-tagged routes (mirrors
+    #: ``router.counters``; both 0 under the adapter-blind baseline,
+    #: which never inspects the adapter field).
+    adapter_warm_routes: int = 0
+    adapter_cold_routes: int = 0
 
     def pool_census(self) -> str:
         """Readable end-of-run memory summary: per-tier blocks/bytes
@@ -282,6 +294,12 @@ class LoadReport:
             prefix += f" ({self.prefix_hit_rate_host:.0%} via host tier)"
         kv = (f", kv_xfer={self.kv_transfer_bytes}B"
               if self.kv_transfer_bytes else "")
+        adapters = ""
+        if (self.adapter_cold_starts or self.adapter_warm_routes
+                or self.adapter_cold_routes):
+            adapters = (f", adapters={self.adapter_warm_routes} warm"
+                        f"/{self.adapter_cold_routes} cold routes, "
+                        f"{self.adapter_cold_starts} cold starts")
         tp = ""
         if any(degree > 1 for degree in self.replica_tp.values()):
             tp = ", tp=" + "/".join(
@@ -312,7 +330,7 @@ class LoadReport:
                 f"{self.throughput_rps:.1f} req/s, "
                 f"{self.throughput_tps:.1f} tok/s, "
                 f"p50={self.p50_ms:.1f} ms, p99={self.p99_ms:.1f} ms"
-                f"{ttft}{goodput}{prefix}{kv}{tp}{attn}"
+                f"{ttft}{goodput}{prefix}{kv}{adapters}{tp}{attn}"
                 f"{compile_note})")
 
 
@@ -1070,6 +1088,182 @@ def run_longtail(n_requests: int = 36, rate_hz: float = 25.0,
         _attach_pool_census(report, [server])
         report.fleet_latency_ms = fleet_latency([server])
         report.server_stats = dict(router.counters, **totals)
+        return report
+    finally:
+        if generator is not None:
+            generator.close()
+        for process in reversed(processes):
+            try:
+                process.terminate()
+            except Exception:  # noqa: BLE001 - teardown best-effort
+                pass
+        engine.terminate()
+        thread.join(timeout=5)
+
+
+def multitenant_payloads(n_adapters: int = 4, zipf_s: float = 1.2,
+                         prompt_len: int = 12,
+                         max_new_tokens: int = 4, vocab: int = 1024,
+                         seed: int = 0, schedule_len: int = 4096
+                         ) -> Callable[[int], Dict]:
+    """Multi-tenant workload: every request names one of
+    ``n_adapters`` tenants' adapters, drawn from a zipf-shaped
+    popularity distribution (``weight ∝ 1/rank^zipf_s`` — a few hot
+    tenants, a long tail of cold ones, the shape S-LoRA serves).
+    Prompts are per-request random (NO shared prefix), so the A/B
+    isolates ADAPTER locality from prefix locality.  Deterministic
+    from ``seed``."""
+    import numpy as np
+
+    rng = np.random.RandomState(seed)
+    weights = 1.0 / np.arange(1, n_adapters + 1) ** zipf_s
+    weights /= weights.sum()
+    schedule = rng.choice(n_adapters, size=schedule_len, p=weights)
+
+    def payload_fn(index: int) -> Dict:
+        which = int(schedule[index % schedule_len])
+        prompt = np.asarray(
+            [1 + (7919 * (index + 1) + 31 * position) % (vocab - 1)
+             for position in range(prompt_len)], np.int32)
+        return {"tokens": prompt, "max_new_tokens": max_new_tokens,
+                "adapter": f"tenant-{which}"}
+
+    return payload_fn
+
+
+def _noisy_loadgen_adapter(config, lora_config, seed: int):
+    """A host-side random adapter whose B factors are non-zero (a
+    fresh-initialized adapter is an exact no-op) — numpy only, so the
+    rig can mint tenants without touching the device."""
+    import numpy as np
+
+    rng = np.random.RandomState(seed)
+    from ..models.lora import factor_dims
+    in_dims, out_dims = factor_dims(config)
+    layers = []
+    for _ in range(config.n_layers):
+        layer = {}
+        for target in lora_config.targets:
+            layer[target] = {
+                "a": (rng.randn(in_dims[target], lora_config.rank)
+                      * in_dims[target] ** -0.5).astype(np.float32),
+                "b": (rng.randn(lora_config.rank, out_dims[target])
+                      * 0.05).astype(np.float32)}
+        layers.append(layer)
+    return {"layers": layers}
+
+
+def run_multitenant(n_requests: int = 32, rate_hz: float = 25.0,
+                    n_adapters: int = 4, zipf_s: float = 1.2,
+                    adapter_aware: bool = True,
+                    warmup_requests: int = 8,
+                    drain_timeout_s: float = 120.0,
+                    seed: int = 0) -> LoadReport:
+    """Warm-adapter-routing A/B rig: TWO paged replicas, each holding
+    HALF the tenants' adapters (evens on A, odds on B — every adapter
+    is warm on exactly one replica), behind either the adapter-aware
+    router (``adapter_affinity=1``) or the adapter-blind baseline
+    (``adapter_affinity=0`` — PR-4 P2C, never inspects the adapter
+    field).  The blind router lands ~half the zipf-distributed
+    requests on the WRONG replica, each an ``unknown_adapter``
+    rejection the client must answer with a factor re-upload
+    (``adapter_cold_starts``); the aware router reads adapter
+    residency off the SAME prefix digests and must take ZERO cold
+    starts — a warm adapter anywhere in the fleet is a warm adapter
+    for every request that names it."""
+    from ..kvstore.adapters import adapter_hex
+    from ..models import llama
+    from ..models.lora import LoRAConfig
+    from ..orchestration.continuous import ContinuousReplica
+    from ..orchestration.paged import PagedContinuousServer
+    from ..orchestration.serving import ReplicaRouter
+    from ..registry import Registrar
+    from ..runtime import Process, actor_args, compose_instance
+    from ..runtime.event import EventEngine
+
+    def wait_for(predicate, timeout_s: float, what: str):
+        deadline = time.time() + timeout_s
+        while not predicate():
+            if time.time() > deadline:
+                raise TimeoutError(f"multitenant rig: {what}")
+            time.sleep(0.02)
+
+    engine = EventEngine()
+    thread = engine.run_in_thread()
+    broker = f"mtenant-{uuid.uuid4().hex[:6]}"
+    processes = []
+
+    def make_process(pid):
+        process = Process(namespace="mtenant", hostname="h",
+                          pid=str(pid), engine=engine, broker=broker)
+        processes.append(process)
+        return process
+
+    lora_config = LoRAConfig(rank=4, alpha=8.0, targets=("wq", "wv"))
+    config = llama.CONFIGS["tiny"]
+    generator = None
+    try:
+        registrar = Registrar(process=make_process(1))
+        wait_for(lambda: registrar.state == "primary", 10,
+                 "registrar primary")
+        servers = []
+        for index, name in enumerate(("replica_a", "replica_b")):
+            server = PagedContinuousServer(
+                config_name="tiny", slots=4, max_seq=64,
+                chunk_steps=4, seed=0, enable_prefix_cache=True,
+                total_blocks=96, max_queue=256, watchdog_s=10.0)
+            # Home placement: evens on A, odds on B — each tenant's
+            # factors are paged (and digest-advertised) on exactly
+            # one replica, so routing is the ONLY thing that decides
+            # warm vs cold.
+            for tenant in range(index, n_adapters, 2):
+                server.load_adapter(
+                    f"tenant-{tenant}",
+                    _noisy_loadgen_adapter(config, lora_config,
+                                           seed=100 + tenant),
+                    lora_config)
+            compose_instance(ContinuousReplica, actor_args(name),
+                             process=make_process(2 + index),
+                             server=server)
+            servers.append(server)
+        router = compose_instance(
+            ReplicaRouter, actor_args("router"),
+            process=make_process(8),
+            adapter_affinity=1.0 if adapter_aware else 0.0)
+        wait_for(lambda: router.share["replicas"] == 2, 30,
+                 "router discovery")
+        hexes = [adapter_hex(f"tenant-{t}") for t in range(n_adapters)]
+        wait_for(lambda: all(
+            router.directory.adapter_owners(
+                h, router.process.event.now()) for h in hexes),
+            30, "adapter residency in fleet digests")
+        generator = LoadGenerator(
+            make_process(9), f"{router.topic_path}/in",
+            payload_fn=multitenant_payloads(
+                n_adapters=n_adapters, zipf_s=zipf_s, seed=seed),
+            rate_hz=rate_hz)
+        if warmup_requests:
+            generator.run(warmup_requests,
+                          drain_timeout_s=drain_timeout_s)
+            for counter in ("adapter_warm_routes",
+                            "adapter_cold_routes"):
+                router.counters[counter] = 0
+        report = generator.run(n_requests,
+                               drain_timeout_s=drain_timeout_s)
+        report.adapter_cold_starts = \
+            report.error_kinds.get("unknown_adapter", 0)
+        report.adapter_warm_routes = \
+            router.counters.get("adapter_warm_routes", 0)
+        report.adapter_cold_routes = \
+            router.counters.get("adapter_cold_routes", 0)
+        totals = _fleet_kv_stats(servers)
+        _attach_kv_rates(report, totals)
+        _attach_pool_census(report, servers)
+        report.server_stats = dict(router.counters, **{
+            key: sum(server.stats().get(key, 0) for server in servers)
+            for key in ("adapter_warm_loads", "adapter_cold_loads",
+                        "adapter_pages_hbm", "adapter_pages_host",
+                        "adapter_pages_disk")})
         return report
     finally:
         if generator is not None:
@@ -2486,8 +2680,16 @@ def main(argv: Optional[List[str]] = None) -> int:
                              "control")
     parser.add_argument("--workload",
                         choices=["shared_prefix", "diurnal",
-                                 "longtail", "structured"],
+                                 "longtail", "structured",
+                                 "multitenant"],
                         help="named workload profile (in-process rig)")
+    parser.add_argument("--tenants", type=int, default=4,
+                        help="multitenant: distinct LoRA adapters "
+                             "(zipf-popular tenants split across two "
+                             "replicas)")
+    parser.add_argument("--zipf-s", type=float, default=1.2,
+                        help="multitenant: zipf exponent of adapter "
+                             "popularity (higher = hotter head)")
     parser.add_argument("--draft-mode", default="ngram",
                         choices=["ngram", "model"],
                         help="structured workload: proposer for the "
@@ -2786,6 +2988,38 @@ def main(argv: Optional[List[str]] = None) -> int:
               f"host share={report.prefix_hit_rate_host}, "
               f"mean TTFT={mean_ttft:.1f}ms")
         return 1 if (report.lost or report.timeouts) else 0
+    if args.workload == "multitenant":
+        aware = run_multitenant(
+            n_requests=args.requests, rate_hz=args.rate_hz,
+            n_adapters=args.tenants, zipf_s=args.zipf_s,
+            adapter_aware=True, seed=args.seed)
+        blind = run_multitenant(
+            n_requests=args.requests, rate_hz=args.rate_hz,
+            n_adapters=args.tenants, zipf_s=args.zipf_s,
+            adapter_aware=False, seed=args.seed)
+        print("adapter-aware:", aware)
+        print("adapter-blind:", blind)
+        print(f"fleet counters (aware): {aware.server_stats}")
+        print(f"warm-routing A/B ({args.tenants} tenants, zipf "
+              f"s={args.zipf_s}): aware {aware.adapter_cold_starts} "
+              f"cold starts ({aware.adapter_warm_routes} warm "
+              f"routes) vs blind {blind.adapter_cold_starts} cold "
+              f"starts")
+        failed = (aware.adapter_cold_starts or aware.lost
+                  or aware.timeouts
+                  or aware.adapter_warm_routes < aware.completed
+                  or blind.adapter_cold_starts == 0)
+        if failed:
+            print(f"MULTITENANT FAIL (seed={args.seed}): aware arm "
+                  f"{aware.adapter_cold_starts} cold starts / "
+                  f"{aware.lost} lost / {aware.timeouts} hung; blind "
+                  f"arm {blind.adapter_cold_starts} cold starts "
+                  f"(expected > 0)")
+            return 1
+        print(f"MULTITENANT OK (seed={args.seed}): every warm "
+              f"adapter routed warm; adapter-blind baseline paid "
+              f"{blind.adapter_cold_starts} re-uploads")
+        return 0
     if args.workload == "shared_prefix":
         report = run_shared_prefix(
             n_requests=args.requests, rate_hz=args.rate_hz,

@@ -910,6 +910,46 @@ def bench_serving_migration(trials=3, n_requests=6, rate_hz=60.0,
                 migrated_up.server_stats.get("upgrades_completed", 0)}
 
 
+def bench_serving_multitenant(n_requests=32, rate_hz=25.0,
+                              n_adapters=4, zipf_s=1.2):
+    """Multi-tenant adapter routing (PR 20): the adapter-aware vs
+    adapter-blind A/B over a 2-replica fleet with zipf-popular tenants
+    home-placed on disjoint replicas.  The aware router must land
+    every request on a replica with the adapter warm in some tier
+    (zero cold starts); the blind router's cold-start count is the
+    baseline the routing win is measured against.  Tiny config,
+    CPU-capable like serving_faults."""
+    from aiko_services_tpu.tools.loadgen import run_multitenant
+
+    aware = run_multitenant(n_requests=n_requests, rate_hz=rate_hz,
+                            n_adapters=n_adapters, zipf_s=zipf_s,
+                            adapter_aware=True)
+    blind = run_multitenant(n_requests=n_requests, rate_hz=rate_hz,
+                            n_adapters=n_adapters, zipf_s=zipf_s,
+                            adapter_aware=False)
+    assert aware.lost == 0 and aware.timeouts == 0, aware
+    assert aware.adapter_cold_starts == 0, aware
+    assert aware.adapter_warm_routes >= aware.completed, aware
+    assert blind.adapter_cold_starts > 0, blind
+
+    log(f"serving[multitenant] {n_adapters} tenants over 2 replicas: "
+        f"aware {aware.adapter_warm_routes} warm routes / "
+        f"{aware.adapter_cold_starts} cold starts "
+        f"(goodput {aware.goodput_rps:.1f} req/s) vs blind "
+        f"{blind.adapter_cold_starts} cold starts "
+        f"(goodput {blind.goodput_rps:.1f} req/s)")
+    return {"serving_multitenant_warm_routes":
+                aware.adapter_warm_routes,
+            "serving_multitenant_cold_starts":
+                aware.adapter_cold_starts,
+            "serving_multitenant_blind_cold_starts":
+                blind.adapter_cold_starts,
+            "serving_multitenant_goodput_rps":
+                round(aware.goodput_rps, 2),
+            "serving_multitenant_blind_goodput_rps":
+                round(blind.goodput_rps, 2)}
+
+
 def bench_serving_8b(paged=False, slots=16, prompt_len=128,
                      max_new=128, n_requests=32, chunk_steps=8,
                      lookahead=4, config_name="llama3_8b",
@@ -3200,6 +3240,9 @@ SECTIONS = [
      (lambda: bench_serving_migration(trials=1, n_requests=4,
                                       upgrade_duration_s=8.0))
      if SMOKE else bench_serving_migration),
+    ("serving_multitenant", 420,
+     (lambda: bench_serving_multitenant(n_requests=12, rate_hz=25.0))
+     if SMOKE else bench_serving_multitenant),
     ("serving_paged", 420,
      (lambda: bench_serving_paged(
          slots=2, prompt_len=24, max_new=8, n_requests=4,

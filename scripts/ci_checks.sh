@@ -216,4 +216,92 @@ finally:
     thread.join(timeout=5)
 EOF3
 
+echo "== multi-tenant smoke: 2 replicas, 4 adapters, census exact, zero steady compiles across adapter swap =="
+# The invariant-21 gate on every push: adapter factor pages live in
+# the SAME audited pool as KV (census exact, zero audit violations,
+# swept every step), a heterogeneous base+3-adapter batch decodes on
+# each replica, one adapter warm-loads cross-replica from the other's
+# pages, and an unload → warm-reload adapter swap compiles NOTHING in
+# the steady phase while reproducing the pre-swap tokens exactly.
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python - <<'EOF4'
+import numpy as np
+
+from aiko_services_tpu.models.lora import LoRAConfig
+from aiko_services_tpu.obs import compiles, metrics, pool_audit
+from aiko_services_tpu.orchestration.continuous import DecodeRequest
+from aiko_services_tpu.orchestration.paged import PagedContinuousServer
+from aiko_services_tpu.tools.loadgen import _noisy_loadgen_adapter
+
+auditor = pool_audit.install(service="ci-mtenant", sweep_every=1)
+ledger = compiles.install(service="ci-mtenant")
+
+lora_config = LoRAConfig(rank=4, alpha=8.0, targets=("wq", "wv"))
+replica_a, replica_b = (
+    PagedContinuousServer(config_name="tiny", slots=4, max_seq=64,
+                          chunk_steps=2, seed=0, total_blocks=96,
+                          enable_prefix_cache=True)
+    for _ in range(2))
+config = replica_a.config
+# Home placement: evens cold-upload to A, odds to B — 4 tenants.
+for tenant, server in ((0, replica_a), (2, replica_a),
+                       (1, replica_b), (3, replica_b)):
+    server.load_adapter(
+        f"tenant-{tenant}",
+        _noisy_loadgen_adapter(config, lora_config, 100 + tenant),
+        lora_config)
+# Cross-replica warm path: B pulls tenant-0's factor PAGES out of A's
+# pool and warm-loads them — no client re-upload anywhere.
+pages = replica_a.fetch_adapter_bytes("tenant-0")
+assert pages is not None, "tenant-0 pages missing from A's pool"
+replica_b.store_adapter_bytes("tenant-0", pages)
+replica_b.load_adapter("tenant-0")
+assert replica_b.adapter_warm_loads == 1, "warm load not counted"
+
+rng = np.random.default_rng(7)
+prompts = [rng.integers(1, 1024, 12).astype(np.int32)
+           for _ in range(4)]
+
+
+def heterogeneous_batch(server, tag, adapters):
+    for index, adapter in enumerate(adapters):
+        server.submit(DecodeRequest(
+            request_id=f"{tag}{index}", prompt=prompts[index],
+            max_new_tokens=6, adapter=adapter))
+    finished = {r.request_id: r.tokens
+                for r in server.run_until_drained()}
+    assert len(finished) == len(adapters), (tag, sorted(finished))
+    return finished
+
+
+MIXED_B = (None, "tenant-0", "tenant-1", "tenant-3")
+heterogeneous_batch(replica_a, "a", (None, "tenant-2"))
+want = heterogeneous_batch(replica_b, "warm", MIXED_B)
+# Warm the whole swap path: unload zeroes the stacked row, the warm
+# reload re-stacks from the paged copy into the recycled id.
+replica_b.unload_adapter("tenant-3")
+replica_b.load_adapter("tenant-3")
+heterogeneous_batch(replica_b, "warm2", MIXED_B)
+
+ledger.fence()
+replica_b.unload_adapter("tenant-3")
+replica_b.load_adapter("tenant-3")
+got = heterogeneous_batch(replica_b, "steady", MIXED_B)
+assert {key.replace("steady", "warm"): tokens
+        for key, tokens in got.items()} == want, \
+    "adapter swap changed greedy tokens"
+assert ledger.steady_compiles == 0, \
+    f"{ledger.steady_compiles} steady-state compiles across the swap"
+
+for server in (replica_a, replica_b):
+    assert auditor.sweep(server) == [], "census reconciliation failed"
+    census = server.pool_census()
+    assert census["adapters"]["pages"].get("hbm", 0) > 0, \
+        "adapter pages missing from census"
+assert auditor.violations_total == 0
+assert metrics.REGISTRY.snapshot()[
+    "aiko_kv_audit_violations_total"] == 0
+print("multi-tenant smoke: heterogeneous decode OK, census exact, "
+      "zero steady compiles across adapter swap")
+EOF4
+
 echo "ci_checks: OK"

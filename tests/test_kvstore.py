@@ -77,12 +77,13 @@ def test_shareable_blocks_excludes_admission_seed_block():
 
 def test_digest_roundtrip_and_malformed():
     # 4-field entries stay valid wire (pre-tier replicas); decode
-    # always returns 7-tuples with tier/adopted/migrating 0 appended.
+    # always returns 8-tuples with tier/adopted/migrating/adapter 0
+    # appended.
     entries = [("ab12cd34ef567890", 3, 1, 7),
                ("ffee001122334455", 2, 0, 1)]
     text = digest_encode(16, "decode", entries)
     assert digest_decode(text) == (
-        16, "decode", [entry + (0, 0, 0) for entry in entries])
+        16, "decode", [entry + (0, 0, 0, 0) for entry in entries])
     # Host-tier entries carry a 5th field; tier 0 encodes 4-field
     # (the wire only grows where the tier is actually in play).
     tiered = [("ab12cd34ef567890", 3, 1, 7, 0),
@@ -91,8 +92,8 @@ def test_digest_roundtrip_and_malformed():
     assert "ab12cd34ef567890/3/1/7," in text     # tier 0 stays 4-field
     assert text.endswith("/2/0/1/1")             # tier 1 appends
     assert digest_decode(text) == (
-        16, "decode", [("ab12cd34ef567890", 3, 1, 7, 0, 0, 0),
-                       ("ffee001122334455", 2, 0, 1, 1, 0, 0)])
+        16, "decode", [("ab12cd34ef567890", 3, 1, 7, 0, 0, 0, 0),
+                       ("ffee001122334455", 2, 0, 1, 1, 0, 0, 0)])
     # Spilled entries carry the adopted 6th field; a zero flag keeps
     # the 5-field tier wire (same back-compat move tier made).
     spilled = [("ab12cd34ef567890", 3, 1, 7, 2, 0),
@@ -101,7 +102,7 @@ def test_digest_roundtrip_and_malformed():
     assert "ab12cd34ef567890/3/1/7/2," in text   # adopted 0: 5-field
     assert text.endswith("/2/0/1/2/1")           # adopted 1 appends
     assert digest_decode(text) == (
-        16, "decode", [entry + (0,) for entry in spilled])
+        16, "decode", [entry + (0, 0) for entry in spilled])
     # S-expression safe: survives the EC-share broadcast wire.
     command, params = parse(generate("update", ["kv_prefixes", text]))
     assert (command, params[1]) == ("update", text)
@@ -130,22 +131,78 @@ def test_digest_migrating_flag_back_compat_matrix():
     # Set flag: the full 7-field entry, zeros written positionally.
     text = digest_encode(16, "decode", [four + (0, 0, 1)])
     assert text.endswith("/3/1/7/0/0/1")
-    assert digest_decode(text) == (16, "decode", [four + (0, 0, 1)])
+    assert digest_decode(text) == (16, "decode",
+                                   [four + (0, 0, 1, 0)])
     # Publisher-level flag ORs into every entry, whatever its arity.
     text = digest_encode(16, "decode", [four, five, six], migrating=1)
     _, _, decoded = digest_decode(text)
     assert [entry[6] for entry in decoded] == [1, 1, 1]
     assert decoded[1][:5] == five                # payload untouched
-    # Decode matrix: every arity 4..7 parses to the padded 7-tuple.
+    # Decode matrix: every arity 4..8 parses to the padded 8-tuple.
     for arity, wire in ((4, "aa" * 8 + "/3/1/7"),
                         (5, "aa" * 8 + "/3/1/7/1"),
                         (6, "aa" * 8 + "/3/1/7/1/1"),
-                        (7, "aa" * 8 + "/3/1/7/1/1/1")):
+                        (7, "aa" * 8 + "/3/1/7/1/1/1"),
+                        (8, "aa" * 8 + "/3/1/7/1/1/1/1")):
         decoded = digest_decode(f"16;decode;{wire}")
         assert decoded is not None, arity
         entry = decoded[2][0]
-        assert len(entry) == 7
+        assert len(entry) == 8
         assert entry[:4] == ("aa" * 8, 3, 1, 7)
+
+
+def test_digest_adapter_flag_back_compat_matrix():
+    """The 8th (``adapter``) field composes with every older wire
+    format: a zero flag leaves the 4/5/6/7-field encodings
+    byte-identical (pre-adapter routers parse them untouched), and a
+    set flag forces the full positional 8-field entry."""
+    four = ("ab12cd34ef567890", 3, 1, 7)
+    five = ("ffee001122334455", 2, 0, 1, 1)
+    six = ("0123456789abcdef", 1, 0, 2, 2, 1)
+    seven = ("aa" * 8, 1, 0, 2, 0, 0, 1)
+    # Zero flag: encodings byte-identical to the pre-adapter wire.
+    assert digest_encode(16, "decode", [four + (0, 0, 0, 0)]) \
+        == digest_encode(16, "decode", [four])
+    assert digest_encode(16, "decode", [five + (0, 0, 0)]) \
+        == digest_encode(16, "decode", [five])
+    assert digest_encode(16, "decode", [six + (0, 0)]) \
+        == digest_encode(16, "decode", [six])
+    assert digest_encode(16, "decode", [seven + (0,)]) \
+        == digest_encode(16, "decode", [seven])
+    # Set flag: the full positional 8-field entry.
+    text = digest_encode(16, "decode", [four + (0, 0, 0, 1)])
+    assert text.endswith("/3/1/7/0/0/0/1")
+    assert digest_decode(text) == (16, "decode",
+                                   [four + (0, 0, 0, 1)])
+    # Adapter + tier compose: a host-demoted adapter page entry.
+    demoted = ("ab12cd34ef567890", 1, 0, 4, 1, 0, 0, 1)
+    text = digest_encode(16, "decode", [demoted])
+    assert text.endswith("/1/0/4/1/0/0/1")
+    assert digest_decode(text) == (16, "decode", [demoted])
+
+
+def test_directory_adapter_residency_queries():
+    """``adapter_tier`` / ``adapter_owners`` read the 8th field:
+    per-replica tier lookup, warmest-first owner ordering, dead
+    replicas excluded by the lease, KV entries never counted."""
+    directory = PrefixDirectory(lease_s=30.0)
+    hexkey = "aa" * 8
+    directory.update("ra", digest_encode(
+        16, "decode", [(hexkey, 1, 0, 3, 0, 0, 0, 1)]), now=0.0)
+    directory.update("rb", digest_encode(
+        16, "decode", [(hexkey, 1, 0, 3, 1, 0, 0, 1)]), now=0.0)
+    directory.update("rc", digest_encode(
+        16, "decode", [(hexkey, 1, 0, 3, 0, 0, 0, 0)]), now=0.0)
+    assert directory.adapter_tier("ra", hexkey, now=1.0) == 0
+    assert directory.adapter_tier("rb", hexkey, now=1.0) == 1
+    # A plain KV advertisement of the same key is NOT residency.
+    assert directory.adapter_tier("rc", hexkey, now=1.0) is None
+    assert directory.adapter_owners(hexkey, now=1.0) == [
+        ("ra", 0), ("rb", 1)]
+    assert directory.adapter_owners(hexkey, now=1.0,
+                                    exclude=("ra",)) == [("rb", 1)]
+    # Leases apply: an expired replica is not an owner.
+    assert directory.adapter_owners(hexkey, now=100.0) == []
 
 
 def test_directory_migrating_flag_tracks_advertisements():

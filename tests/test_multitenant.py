@@ -1,0 +1,287 @@
+"""Multi-tenant LoRA serving (PR 20, ARCHITECTURE invariant 21).
+
+The acceptance gates:
+
+* **Merged-weights exactness, composed** — a heterogeneous batch
+  (base + three tenants sharing one decode batch) through the paged
+  server with int8 KV + chunked admission + prefix cache produces,
+  per request, exactly the greedy tokens of a server whose weights
+  are ``merge_lora(base, that_tenant)`` — single chip and TP=4 (the
+  f32 configs remove bf16 rounding-order noise, as in
+  test_multi_lora's oracle).
+* **Unified paging** — adapter factor pages live in the SAME audited
+  pool as KV: census-visible per tier, demotable to host/disk under
+  the shared eviction clock, and the packed bytes survive the full
+  HBM → host → disk round trip BIT-EXACT (the lora_paged codec never
+  bitcasts raw bytes into float pool fields).
+* **Warm loads** — ``load_adapter(name)`` with no factors re-stacks
+  from the paged copy in any tier; no copy anywhere raises
+  ``adapter_cold``.
+* **Cross-replica fetch** — adapter pages export through the standard
+  KV transfer wire (``kv_adapter`` flag), import under ADAPTER_SEED,
+  and warm-load on the importer with no client upload.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from aiko_services_tpu.kvstore.adapters import (
+    ADAPTER_SEED, adapter_chain_keys, adapter_hex,
+)
+from aiko_services_tpu.kvstore.directory import (
+    HEX_KEY_CHARS, digest_decode,
+)
+from aiko_services_tpu.models import llama
+from aiko_services_tpu.models.lora import LoRAConfig, merge_lora
+from aiko_services_tpu.obs import pool_audit
+from aiko_services_tpu.orchestration.continuous import DecodeRequest
+from aiko_services_tpu.orchestration.paged import PagedContinuousServer
+from aiko_services_tpu.parallel.mesh import ReplicaMesh
+
+from .test_multi_lora import LORA, _noisy_adapter
+
+COMPOSED = dict(slots=4, max_seq=128, chunk_steps=3, seed=5,
+                block_size=16, enable_prefix_cache=True,
+                chunk_prefill_tokens=32, quantize_kv=True)
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_auditor():
+    yield
+    pool_audit.uninstall()
+
+
+def _f32_config(base_name):
+    return dataclasses.replace(llama.CONFIGS[base_name],
+                               dtype=jnp.float32)
+
+
+def _tenants(config, count=3):
+    return {f"tenant-{i}": _noisy_adapter(config,
+                                          jax.random.PRNGKey(40 + i))
+            for i in range(count)}
+
+
+def _mixed_requests(config, adapters, prefix=32, seed=19):
+    """Base + one request per tenant, all sharing a ``prefix``-token
+    head so admission rides the chunked prefill path and the prefix
+    cache has adapter-scoped chains to hit."""
+    rng = np.random.default_rng(seed)
+    shared = rng.integers(1, config.vocab_size, prefix).astype(np.int32)
+    requests = []
+    for i, adapter in enumerate([None] + sorted(adapters)):
+        tail = rng.integers(1, config.vocab_size, 9 + i).astype(np.int32)
+        requests.append(DecodeRequest(
+            request_id=f"r{i}",
+            prompt=np.concatenate([shared, tail]),
+            max_new_tokens=5 + i, adapter=adapter))
+    return requests
+
+
+def _drain(server, requests):
+    for request in requests:
+        server.submit(DecodeRequest(
+            request_id=request.request_id,
+            prompt=request.prompt.copy(),
+            max_new_tokens=request.max_new_tokens,
+            adapter=request.adapter))
+    return {r.request_id: r.tokens for r in server.run_until_drained()}
+
+
+def _merged_oracle(config_name, adapters, requests, mesh=None):
+    """Per-request serving on merged weights: for each request, a
+    fresh paged server (same composed settings) whose params are
+    ``merge_lora(base, its adapter)`` serves it ALONE."""
+    want = {}
+    for request in requests:
+        oracle = PagedContinuousServer(config_name=config_name,
+                                       replica_mesh=mesh, **COMPOSED)
+        if request.adapter is not None:
+            oracle.params = merge_lora(
+                oracle.params, adapters[request.adapter], LORA)
+        # The oracle serves the merged weights as its BASE model, so
+        # the request rides in with no adapter name.
+        plain = DecodeRequest(request_id=request.request_id,
+                              prompt=request.prompt.copy(),
+                              max_new_tokens=request.max_new_tokens)
+        want.update(_drain(oracle, [plain]))
+    return want
+
+
+def test_heterogeneous_batch_matches_merged_oracle_composed_f32():
+    """Single chip: one mixed base+3-tenant batch with int8 KV +
+    chunked admission + prefix cache == per-request merged-weights
+    serving, token-exact."""
+    llama.CONFIGS["tiny_mt_f32"] = _f32_config("tiny")
+    try:
+        config = llama.CONFIGS["tiny_mt_f32"]
+        adapters = _tenants(config)
+        server = PagedContinuousServer(
+            config_name="tiny_mt_f32", adapters=adapters,
+            lora_config=LORA, **COMPOSED)
+        requests = _mixed_requests(config, adapters)
+        got = _drain(server, requests)
+        assert len(got) == 4
+        # Prefix chains are adapter-scoped, so the cold wave shares
+        # nothing across tenants; the SECOND wave hits every tenant's
+        # own cached chain and must reproduce the first exactly.
+        rerun = _drain(server, requests)
+        assert server.stats()["prefix_hits"] > 0   # cache really hit
+        assert rerun == got
+        want = _merged_oracle("tiny_mt_f32", adapters, requests)
+        assert got == want
+    finally:
+        del llama.CONFIGS["tiny_mt_f32"]
+
+
+@pytest.mark.multichip
+def test_tp4_heterogeneous_matches_single_chip_and_merged_oracle(
+        virtual_mesh_devices):
+    """TP=4: the same mixed batch on a 4-chip mesh equals both the
+    single-chip heterogeneous run and the per-request merged-weights
+    oracle — the column-sharded factors feed their delta into the
+    same all-gather the base matmul takes (no reduction reorder)."""
+    llama.CONFIGS["tiny_tp_mt_f32"] = _f32_config("tiny_tp")
+    try:
+        config = llama.CONFIGS["tiny_tp_mt_f32"]
+        adapters = _tenants(config)
+        requests = _mixed_requests(config, adapters)
+        outs = {}
+        for degree in (None, 4):
+            server = PagedContinuousServer(
+                config_name="tiny_tp_mt_f32", adapters=adapters,
+                lora_config=LORA,
+                replica_mesh=ReplicaMesh(tp=degree) if degree else None,
+                **COMPOSED)
+            outs[degree] = _drain(server, requests)
+            assert _drain(server, requests) == outs[degree]
+            assert server.stats()["prefix_hits"] > 0
+        assert outs[4] == outs[None]
+        want = _merged_oracle("tiny_tp_mt_f32", adapters, requests)
+        assert outs[4] == want
+    finally:
+        del llama.CONFIGS["tiny_tp_mt_f32"]
+
+
+def test_adapter_pages_demote_restore_bitwise_under_shared_clock(
+        tmp_path):
+    """Adapter pages ride the shared eviction clock through all three
+    tiers: census exact and zero audit violations at every stage, the
+    packed bytes BIT-identical after full demotion (host + disk), and
+    the warm reload serves the pre-demotion tokens exactly."""
+    auditor = pool_audit.install(service="mt_clock", sweep_every=1)
+    server = PagedContinuousServer(
+        config_name="tiny", host_tier_blocks=1,
+        spill_dir=str(tmp_path / "spill"), **COMPOSED)
+    config = server.config
+    adapter = _noisy_adapter(config, jax.random.PRNGKey(3))
+    server.load_adapter("acme", adapter, LORA)
+    assert server.adapter_cold_loads == 1
+    pages = server._adapter_page_counts()
+    assert pages["hbm"] > 0 and pages["host"] == pages["disk"] == 0
+    assert server.adapter_residency("acme") == 0
+    golden = server.fetch_adapter_bytes("acme")
+    assert golden is not None
+
+    rng = np.random.default_rng(29)
+    prompt = rng.integers(1, config.vocab_size, 21).astype(np.int32)
+    request = DecodeRequest("warm", prompt, 6, adapter="acme")
+    server.submit(request)
+    server.run_until_drained()
+    want = request.tokens
+    assert auditor.sweep(server) == []
+
+    # Unload (pages deliberately stay resident) and run the eviction
+    # clock dry: every evictable block — KV chains AND adapter pages —
+    # demotes, overflowing the 4-block host cap onto disk.
+    total_pages = sum(server._adapter_page_counts().values())
+    server.unload_adapter("acme")
+    while server._evict_one():
+        pass
+    pages = server._adapter_page_counts()
+    assert pages["hbm"] == 0
+    assert pages["host"] + pages["disk"] == total_pages
+    assert pages["disk"] > 0                 # host cap 1 overflowed
+    assert server.adapter_residency("acme") in (1, 2)
+    census = server.pool_census()
+    assert census["adapters"]["pages"] == pages
+    assert auditor.sweep(server) == []
+
+    # Bit-exact through the tiers, then a warm reload serves exactly.
+    demoted = server.fetch_adapter_bytes("acme")
+    assert demoted is not None and np.array_equal(golden, demoted)
+    server.load_adapter("acme")
+    assert server.adapter_warm_loads == 1
+    replay = DecodeRequest("replay", prompt.copy(), 6, adapter="acme")
+    server.submit(replay)
+    server.run_until_drained()
+    assert replay.tokens == want
+    assert auditor.sweep(server) == []
+    assert auditor.violations_total == 0
+
+
+def test_warm_load_without_paged_copy_raises_adapter_cold():
+    server = PagedContinuousServer(config_name="tiny", **COMPOSED)
+    with pytest.raises(KeyError, match="adapter_cold"):
+        server.load_adapter("ghost")
+    adapter = _noisy_adapter(server.config, jax.random.PRNGKey(8))
+    server.load_adapter("real", adapter, LORA)
+    # Replacing factors under the same name purges the stale chain
+    # first — a half-and-half mix must never warm-load.
+    fresh = _noisy_adapter(server.config, jax.random.PRNGKey(9))
+    server.load_adapter("real", fresh, LORA)
+    restacked, _config = server._fetch_adapter_pages("real")
+    got = restacked["layers"][0]["wq"]["b"]
+    assert np.allclose(np.asarray(got, np.float32),
+                       np.asarray(fresh["layers"][0]["wq"]["b"],
+                                  np.float32), atol=2e-2)
+
+
+def test_adapter_pages_export_import_and_warm_load_cross_replica():
+    """The fleet warm path end to end: owner's pages export through
+    the standard KV transfer wire flagged ``kv_adapter``, import
+    under ADAPTER_SEED on a replica that never saw the factors, and
+    that replica warm-loads + serves the owner's exact tokens.  The
+    owner's digest advertises exactly one flagged root entry."""
+    owner = PagedContinuousServer(config_name="tiny", **COMPOSED)
+    config = owner.config
+    adapter = _noisy_adapter(config, jax.random.PRNGKey(6))
+    owner.load_adapter("acme", adapter, LORA)
+    n_pages = owner._adapter_page_counts()["hbm"]
+    assert n_pages > 0
+
+    # Digest: one depth-1 root entry with the adapter flag — page 2+
+    # keys never advertise (one EC-share slot per warm adapter).
+    _block, _role, entries = digest_decode(owner.prefix_digest())
+    flagged = [e for e in entries if e[7]]
+    assert [(e[0], e[1]) for e in flagged] == [(adapter_hex("acme"), 1)]
+
+    keys_hex = [key.hex()[:HEX_KEY_CHARS]
+                for key in adapter_chain_keys("acme", n_pages)]
+    payload = owner.kv_export_payload(keys_hex, 0)
+    assert payload is not None and payload["kv_adapter"] == 1
+
+    importer = PagedContinuousServer(config_name="tiny", **COMPOSED)
+    assert importer.kv_import_payload(payload) == n_pages
+    imported_seeds = {importer._key_seed[key]
+                      for key in adapter_chain_keys("acme", n_pages)}
+    assert imported_seeds == {ADAPTER_SEED}
+    fetched = importer.fetch_adapter_bytes("acme")
+    assert fetched is not None and np.array_equal(
+        fetched, owner.fetch_adapter_bytes("acme"))
+    importer.load_adapter("acme")
+    assert importer.adapter_warm_loads == 1
+
+    rng = np.random.default_rng(31)
+    prompt = rng.integers(1, config.vocab_size, 17).astype(np.int32)
+    tokens = {}
+    for name, server in (("owner", owner), ("importer", importer)):
+        request = DecodeRequest(name, prompt.copy(), 7, adapter="acme")
+        server.submit(request)
+        server.run_until_drained()
+        tokens[name] = request.tokens
+    assert tokens["owner"] == tokens["importer"]

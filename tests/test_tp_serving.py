@@ -246,11 +246,15 @@ def test_tp_rejects_unsupported_compositions(virtual_mesh_devices):
                                       replica_mesh=ReplicaMesh(tp=2),
                                       draft_config_name="tiny_tp")
     assert server._draft is not None and server.tp_degree == 2
+    # The TP×LoRA rejection is gone too (PR 20): factors replicate on
+    # the contiguous layout (tiny, exact) or column-shard on the paged
+    # one, so the composition constructs — exactness is gated by
+    # tests/test_multitenant.py.
     from aiko_services_tpu.models.lora import LoRAConfig
-    with pytest.raises(ValueError, match="LoRA"):
-        ContinuousBatchingServer(config_name="tiny_tp",
-                                 replica_mesh=ReplicaMesh(tp=2),
-                                 lora_config=LoRAConfig(rank=2))
+    lora_server = ContinuousBatchingServer(
+        config_name="tiny_tp", replica_mesh=ReplicaMesh(tp=2),
+        lora_config=LoRAConfig(rank=2))
+    assert lora_server.tp_degree == 2
 
 
 def test_tp_param_and_pool_specs():
